@@ -1,0 +1,119 @@
+"""Futures: base, countable, and datacopy (lazy-trigger) futures.
+
+Reference behavior: parsec_future_t / parsec_countable_future_t /
+parsec_datacopy_future_t (ref: parsec/class/parsec_future.h:62-105,
+parsec/class/parsec_datacopy_future.c:1-319). The datacopy future is the
+substrate of the reshape engine: it is *triggered* lazily by the first
+consumer, runs a conversion callback once, dedups concurrent triggers, and
+cleans up the payload when released.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional
+
+from .object import Obj
+
+
+class Future(Obj):
+    """Single-assignment future with completion callbacks."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._cond = threading.Condition()
+        self._ready = False
+        self._value: Any = None
+        self._cbs: List[Callable[["Future"], None]] = []
+
+    def is_ready(self) -> bool:
+        return self._ready
+
+    def set(self, value: Any) -> None:
+        with self._cond:
+            assert not self._ready, "future set twice"
+            self._value = value
+            self._ready = True
+            cbs, self._cbs = self._cbs, []
+            self._cond.notify_all()
+        for cb in cbs:
+            cb(self)
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        with self._cond:
+            if not self._cond.wait_for(lambda: self._ready, timeout=timeout):
+                raise TimeoutError("future wait timed out")
+            return self._value
+
+    def peek(self) -> Any:
+        return self._value if self._ready else None
+
+    def on_ready(self, cb: Callable[["Future"], None]) -> None:
+        run = False
+        with self._cond:
+            if self._ready:
+                run = True
+            else:
+                self._cbs.append(cb)
+        if run:
+            cb(self)
+
+
+class CountableFuture(Future):
+    """Completes when ``count`` contributions have arrived."""
+
+    def __init__(self, count: int) -> None:
+        super().__init__()
+        assert count > 0
+        self._count = count
+
+    def contribute(self, value: Any = None) -> bool:
+        with self._cond:
+            assert self._count > 0
+            self._count -= 1
+            done = self._count == 0
+        if done:
+            self.set(value)
+        return done
+
+
+class DataCopyFuture(Future):
+    """Lazily-triggered future holding a (converted) data copy.
+
+    ``trigger_cb(spec)`` builds the payload on first request; concurrent
+    requesters dedup on the started flag; ``cleanup_cb`` runs at destruct.
+    A nested future chain is supported: if trigger returns another
+    DataCopyFuture, completion is forwarded (matches the reference's
+    chained reshape promises).
+    """
+
+    def __init__(self, spec: Any = None,
+                 trigger_cb: Optional[Callable[[Any], Any]] = None,
+                 cleanup_cb: Optional[Callable[[Any], None]] = None) -> None:
+        super().__init__()
+        self.spec = spec
+        self._trigger_cb = trigger_cb
+        self._cleanup_cb = cleanup_cb
+        self._started = False
+
+    def trigger(self) -> None:
+        """First caller runs the conversion; everyone else just waits."""
+        with self._cond:
+            if self._started or self._ready:
+                return
+            self._started = True
+        assert self._trigger_cb is not None, "untriggerable datacopy future"
+        result = self._trigger_cb(self.spec)
+        if isinstance(result, DataCopyFuture):
+            result.on_ready(lambda f: self.set(f.peek()))
+            result.trigger()
+        else:
+            self.set(result)
+
+    def get_or_trigger(self, timeout: Optional[float] = None) -> Any:
+        self.trigger()
+        return self.get(timeout=timeout)
+
+    def _destruct(self) -> None:
+        if self._cleanup_cb is not None and self._ready:
+            self._cleanup_cb(self._value)
+        super()._destruct()

@@ -1,0 +1,52 @@
+"""Refcounted object base with constructor/destructor chains.
+
+Reference behavior: PARSEC_OBJ_NEW/RETAIN/RELEASE refcounted object system
+(ref: parsec/class/parsec_object.h:89-190). Python has its own GC, but the
+runtime still needs *deterministic* lifetime events: data copies must be
+returned to arenas, device buffers freed, repo entries recycled — at the
+moment the last logical owner lets go, not when the GC runs. Obj keeps an
+explicit refcount with an ``_on_destruct`` chain for that.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+
+
+class Obj:
+    """Explicitly refcounted object. obj_ref/obj_unref manage lifetime."""
+
+    __slots__ = ("_refcount", "_lock", "__weakref__")
+    _id_iter = itertools.count()
+
+    def __init__(self) -> None:
+        self._refcount = 1
+        self._lock = threading.Lock()
+
+    # PARSEC_OBJ_RETAIN
+    def retain(self) -> "Obj":
+        with self._lock:
+            assert self._refcount > 0, "retain on destructed object"
+            self._refcount += 1
+        return self
+
+    # PARSEC_OBJ_RELEASE
+    def release(self) -> bool:
+        """Drop one reference; run destructor chain when it hits zero.
+
+        Returns True when the object was destructed.
+        """
+        with self._lock:
+            assert self._refcount > 0, "release on destructed object"
+            self._refcount -= 1
+            dead = self._refcount == 0
+        if dead:
+            self._destruct()
+        return dead
+
+    @property
+    def refcount(self) -> int:
+        return self._refcount
+
+    def _destruct(self) -> None:
+        """Destructor chain hook; subclasses override and call super()."""

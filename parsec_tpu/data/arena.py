@@ -1,0 +1,86 @@
+"""Arenas: sized freelist allocators for task/communication buffers.
+
+Reference behavior: per-(type, shape) freelists of buffers used for
+communication and NEW-tile allocation, with MCA caps ``arena_max_used`` /
+``arena_max_cached`` (ref: parsec/arena.c, parsec/parsec.c:681-686).
+
+TPU-native re-design: an arena vends numpy host buffers (or, via a device
+module hook, HBM-backed buffers) for a fixed Datatype. Freed buffers are
+cached for reuse up to max_cached; max_used caps total live allocations.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional
+
+import numpy as np
+
+from ..utils.params import params
+from .data import Data, DataCopy, Coherency
+from .datatype import Datatype
+
+
+class Arena:
+    def __init__(self, dtt: Datatype, max_used: Optional[int] = None,
+                 max_cached: Optional[int] = None, allocator=None) -> None:
+        self.dtt = dtt
+        mu = params.get("arena_max_used") if max_used is None else max_used
+        mc = params.get("arena_max_cached") if max_cached is None else max_cached
+        self.max_used = None if mu in (-1, None) else mu
+        self.max_cached = None if mc in (-1, None) else mc
+        self._free: List[Any] = []
+        self._used = 0
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        # allocator(dtt) -> backing buffer; default host numpy
+        self._alloc = allocator or (lambda d: np.empty(d.shape, dtype=d.dtype))
+
+    def allocate(self, block: bool = True) -> Any:
+        with self._cond:
+            while True:
+                if self._free:
+                    self._used += 1
+                    return self._free.pop()
+                if self.max_used is None or self._used < self.max_used:
+                    self._used += 1
+                    break
+                if not block:
+                    return None
+                self._cond.wait()
+        return self._alloc(self.dtt)
+
+    def free(self, buf: Any) -> None:
+        with self._cond:
+            self._used -= 1
+            if self.max_cached is None or len(self._free) < self.max_cached:
+                self._free.append(buf)
+            self._cond.notify()
+
+    @property
+    def used(self) -> int:
+        return self._used
+
+    @property
+    def cached(self) -> int:
+        return len(self._free)
+
+    # -- data-copy integration ---------------------------------------------
+    def new_copy(self, data: Data, device_id: int = 0) -> DataCopy:
+        """Allocate an arena-backed DataCopy (recycled on copy destruct)."""
+        buf = self.allocate()
+        copy = DataCopy(data, device_id, payload=buf, dtt=self.dtt)
+        copy.arena_chunk = _ArenaChunk(self, buf)
+        data.attach_copy(copy)
+        return copy
+
+
+class _ArenaChunk:
+    __slots__ = ("arena", "buf")
+
+    def __init__(self, arena: Arena, buf: Any) -> None:
+        self.arena = arena
+        self.buf = buf
+
+    def release_copy(self, copy: DataCopy) -> None:
+        self.arena.free(self.buf)
+        self.buf = None

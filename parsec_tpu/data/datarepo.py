@@ -1,0 +1,93 @@
+"""Data repos: task-output hash tables with usage-count reclamation.
+
+Reference behavior: each task class has a repo hashing task key ->
+entry of produced data copies; the entry stays until every consumer has
+taken its input (``usagecnt``), plus an explicit retain while the producer
+is still filling it (ref: parsec/datarepo.c/.h, SURVEY.md §2.1).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from ..core.hashtable import HashTable
+
+
+class RepoEntry:
+    __slots__ = ("key", "data", "usagecnt", "retained", "repo")
+
+    def __init__(self, repo: "DataRepo", key: Any, nb_flows: int) -> None:
+        self.repo = repo
+        self.key = key
+        self.data: List[Optional[Any]] = [None] * nb_flows  # DataCopy per out-flow
+        self.usagecnt = 0
+        self.retained = 0
+
+    def set_output(self, flow_index: int, copy: Any) -> None:
+        self.data[flow_index] = copy
+
+
+class DataRepo:
+    """Hash table keyed by task key, entries reclaimed when fully consumed."""
+
+    def __init__(self, nb_flows: int) -> None:
+        self.nb_flows = nb_flows
+        self._table = HashTable()
+        self._lock = threading.Lock()
+
+    def lookup_and_create(self, key: Any) -> RepoEntry:
+        """ref: data_repo_lookup_entry_and_create — creation retains."""
+        def factory() -> RepoEntry:
+            return RepoEntry(self, key, self.nb_flows)
+        entry, created = self._table.find_or_insert(key, factory)
+        with self._lock:
+            entry.retained += 1
+        return entry
+
+    def lookup(self, key: Any) -> Optional[RepoEntry]:
+        return self._table.find(key)
+
+    def entry_addto_usage_limit(self, key: Any, nb_usage: int) -> None:
+        """Producer declares how many consumptions the entry must survive."""
+        entry = self._table.find(key)
+        assert entry is not None
+        dead = False
+        with self._lock:
+            entry.usagecnt += nb_usage
+            dead = entry.usagecnt == 0 and entry.retained == 0
+        if dead:
+            self._reclaim(entry)
+
+    def entry_used_once(self, key: Any) -> None:
+        """ref: data_repo_entry_used_once — one consumer took its input."""
+        entry = self._table.find(key)
+        if entry is None:
+            return
+        dead = False
+        with self._lock:
+            entry.usagecnt -= 1
+            dead = entry.usagecnt == 0 and entry.retained == 0
+        if dead:
+            self._reclaim(entry)
+
+    def entry_release(self, key: Any) -> None:
+        """Drop the producer's retain."""
+        entry = self._table.find(key)
+        if entry is None:
+            return
+        dead = False
+        with self._lock:
+            entry.retained -= 1
+            dead = entry.usagecnt <= 0 and entry.retained == 0
+        if dead:
+            self._reclaim(entry)
+
+    def _reclaim(self, entry: RepoEntry) -> None:
+        self._table.remove(entry.key)
+        for copy in entry.data:
+            if copy is not None and hasattr(copy, "release"):
+                copy.release()
+        entry.data = []
+
+    def __len__(self) -> int:
+        return len(self._table)

@@ -1,0 +1,66 @@
+"""Datatype descriptors: dtype + shape + layout.
+
+Reference behavior: ``parsec_datatype_t`` wraps MPI datatypes describing a
+tile's memory layout (contiguous, vector/strided, triangular)
+(ref: parsec/datatype/datatype_mpi.c:15-27, parsec/datatype.h).
+
+TPU-native re-design: there is no wire datatype — data moves as device
+arrays. A Datatype here is a (dtype, shape, region) descriptor used for
+arena sizing, reshape decisions, and remote-edge type matching. ``region``
+captures non-rectangular views (upper/lower triangle) that the reference
+expressed as derived MPI types; conversion between regions is a compiled
+XLA gather/where, performed by the reshape engine.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class Datatype:
+    dtype: Any                    # numpy dtype-like
+    shape: Tuple[int, ...]
+    region: str = "full"          # "full" | "upper" | "lower" | "band"
+    band: Optional[Tuple[int, int]] = None  # (kl, ku) when region == "band"
+
+    @property
+    def nb_elts(self) -> int:
+        n = 1
+        for s in self.shape:
+            n *= s
+        return n
+
+    @property
+    def nbytes(self) -> int:
+        return self.nb_elts * np.dtype(self.dtype).itemsize
+
+    def contiguous(self) -> "Datatype":
+        return Datatype(self.dtype, self.shape, "full")
+
+    def compatible_wire(self, other: "Datatype") -> bool:
+        """Same bytes-on-the-wire? (drives remote reshape decisions)."""
+        return (np.dtype(self.dtype) == np.dtype(other.dtype)
+                and self.shape == other.shape and self.region == other.region)
+
+    def mask(self) -> Optional[np.ndarray]:
+        """Boolean mask of the valid region (None == everything valid)."""
+        if self.region == "full":
+            return None
+        assert len(self.shape) == 2, "regioned datatypes are 2-D"
+        m, n = self.shape
+        ii, jj = np.meshgrid(np.arange(m), np.arange(n), indexing="ij")
+        if self.region == "upper":
+            return jj >= ii
+        if self.region == "lower":
+            return jj <= ii
+        if self.region == "band":
+            kl, ku = self.band or (0, 0)
+            return (jj - ii <= ku) & (ii - jj <= kl)
+        raise ValueError(f"unknown region {self.region}")
+
+
+def dtt_of_array(arr: Any, region: str = "full") -> Datatype:
+    return Datatype(dtype=arr.dtype, shape=tuple(arr.shape), region=region)

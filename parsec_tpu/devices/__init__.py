@@ -1,0 +1,44 @@
+"""Device MCA framework: registry + construction.
+
+ref: parsec_mca_device_init/attach (parsec/parsec.c:832-837), component
+selection via MCA param ``device_tpu_enabled`` (analog of
+``device_cuda_enabled`` used throughout the reference test suite).
+"""
+from __future__ import annotations
+
+from typing import List
+
+from ..utils import logging as plog
+from ..utils.params import params
+from .cpu import CPUDevice
+from .device import Device, get_best_device
+
+params.reg_bool("device_tpu_enabled", True, "attach XLA devices as accelerators")
+params.reg_int("device_tpu_max", -1, "max number of XLA devices to attach (-1 all)")
+params.reg_string("device_tpu_platform", "",
+                  "XLA platform to attach (tpu|cpu|...); empty = jax default")
+
+
+def build_devices(context, enable_tpu: bool = True) -> List[Device]:
+    devices: List[Device] = [CPUDevice(0)]
+    if enable_tpu and params.get("device_tpu_enabled"):
+        try:
+            import jax
+            plat = params.get("device_tpu_platform")
+            jdevs = jax.devices(plat) if plat else jax.local_devices()
+        except Exception as exc:  # no jax backend available
+            plog.warning("no XLA devices attached: %s", exc)
+            jdevs = []
+        cap = params.get("device_tpu_max")
+        if cap >= 0:
+            jdevs = jdevs[:cap]
+        from .tpu import JaxDevice
+        for i, jd in enumerate(jdevs):
+            devices.append(JaxDevice(1 + i, jd))
+        if jdevs:
+            plog.device_stream.verbose(3, "attached %d XLA device(s): %s",
+                                       len(jdevs), [d.name for d in devices[1:]])
+    return devices
+
+
+__all__ = ["Device", "CPUDevice", "build_devices", "get_best_device"]

@@ -1,0 +1,16 @@
+"""Host CPU device: chores run inline on the calling worker thread.
+
+Reference behavior: the CPU incarnation's hook executes the BODY directly in
+``__parsec_execute`` on the selecting thread (ref: parsec/scheduling.c:124-203).
+Device index 0 is always the host.
+"""
+from __future__ import annotations
+
+from .device import Device
+
+
+class CPUDevice(Device):
+    def __init__(self, device_index: int = 0) -> None:
+        super().__init__("cpu", device_index, name="cpu")
+        # relative capability weight; accelerators are ~weight 0.1 of it
+        self.time_estimate_default = 10.0

@@ -1,0 +1,341 @@
+"""XLA/TPU device module: asynchronous offload engine over jax.
+
+Reference behavior reproduced (from the CUDA module, SURVEY.md §2.5, §3.4):
+- the accelerator chore hands the task to a per-device mini-scheduler and
+  returns HOOK_RETURN_ASYNC; the first thread to submit becomes the device
+  *manager* (atomic mutex CAS, ref: device_cuda_module.c:2574-2577), others
+  just enqueue to ``pending``;
+- stage-in reserves device space, pulls the newest copy, and respects the
+  coherency protocol (parsec_gpu_data_reserve_device_space / push,
+  ref: device_cuda_module.c:864-1040, 2099-2195);
+- two LRU lists (clean / dirty-owned) drive eviction with writeback
+  (ref: device_gpu.h:128-129);
+- per-stream in-flight tracking with events → here jax async dispatch with
+  readiness polling (progress_stream, ref: device_cuda_module.c:1961-2012);
+- the epilog hands ownership back OWNED→SHARED and bumps versions
+  (ref: device_cuda_module.c:2365-2430).
+
+TPU-native re-design: "streams" are jax's async dispatch queues — device_put
+and jitted execution return immediately; completion is observed with
+``jax.Array.is_ready``-style polling (committed arrays). Kernel bodies are
+jax-jit callables (XLA) or Pallas kernels; the runtime caches the jitted
+callable per task class. HBM capacity is tracked by payload accounting; an
+eviction drops our reference (clean) or writes back to host first (owned).
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..core.lists import Dequeue
+from ..data.data import Coherency, Data, DataCopy, FlowAccess
+from ..runtime.taskpool import HookReturn, Task
+from ..utils import logging as plog
+from ..utils.params import params
+from .device import Device
+
+_log = plog.device_stream
+
+
+def _array_ready(arr: Any) -> bool:
+    """True when the backing buffer is materialized (event-query analog)."""
+    try:
+        return arr.is_ready()
+    except AttributeError:
+        return True  # host/numpy arrays are always ready
+
+
+class _InFlight:
+    __slots__ = ("task", "outputs", "out_flows", "es_hint", "est")
+
+    def __init__(self, task: Task, outputs: List[Any], out_flows: List[int], est: float) -> None:
+        self.task = task
+        self.outputs = outputs
+        self.out_flows = out_flows
+        self.est = est
+
+
+class JaxDevice(Device):
+    """One jax.Device managed as a PaRSEC accelerator device."""
+
+    def __init__(self, device_index: int, jax_device: Any) -> None:
+        plat = getattr(jax_device, "platform", "tpu")
+        super().__init__("tpu", device_index, name=f"{plat}:{jax_device.id}")
+        self.jax_device = jax_device
+        self.time_estimate_default = 1.0
+        # device manager state (ref: gpu_device->mutex + pending)
+        self.pending = Dequeue()
+        self._manager_lock = threading.Lock()
+        self._inflight: List[_InFlight] = []
+        # memory accounting + LRU (ref: zone_malloc + gpu_mem_lru/_owned_lru)
+        self.mem_budget = self._probe_budget()
+        self.mem_used = 0
+        self._lru_clean: "OrderedDict[int, DataCopy]" = OrderedDict()
+        self._lru_owned: "OrderedDict[int, DataCopy]" = OrderedDict()
+        self._mem_lock = threading.Lock()
+        self.stats = {"stage_in_bytes": 0, "stage_out_bytes": 0,
+                      "evictions": 0, "tasks": 0}
+
+    def _probe_budget(self) -> int:
+        try:
+            stats = self.jax_device.memory_stats()
+            limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+            if limit:
+                return int(limit * params.get("tpu_memory_fraction_pct") / 100)
+        except Exception:
+            pass
+        return 8 << 30  # fall back to 8 GiB of accounting space
+
+    # ------------------------------------------------------------------ #
+    # submission: the accelerator chore calls this and returns ASYNC     #
+    # ------------------------------------------------------------------ #
+    def kernel_scheduler(self, es, task: Task) -> HookReturn:
+        """ref: parsec_cuda_kernel_scheduler (device_cuda_module.c:2537)."""
+        task.selected_device = self
+        est = (task.task_class.time_estimate(task, self)
+               if task.task_class.time_estimate else self.time_estimate_default)
+        self.load_add(est)
+        task.es_hint = es.th_id
+        self.pending.push_back((task, est))
+        # try to become the manager right away (first thread wins)
+        self.progress(es)
+        return HookReturn.ASYNC
+
+    # ------------------------------------------------------------------ #
+    # the manager loop, run opportunistically from idle workers          #
+    # ------------------------------------------------------------------ #
+    def progress(self, es) -> int:
+        if not self._manager_lock.acquire(blocking=False):
+            return 0  # someone else is the manager (CAS-owner pattern)
+        try:
+            n = 0
+            # push phase: submit everything pending
+            while True:
+                item = self.pending.pop_front()
+                if item is None:
+                    break
+                task, est = item
+                try:
+                    self._submit(task, est)
+                except Exception as exc:  # surfacing beats hanging the DAG
+                    plog.warning("tpu submit failed for %s: %s", task.snprintf(), exc)
+                    raise
+            # poll phase: complete ready in-flight tasks
+            still: List[_InFlight] = []
+            done: List[_InFlight] = []
+            for rec in self._inflight:
+                if all(_array_ready(a) for a in rec.outputs):
+                    done.append(rec)
+                else:
+                    still.append(rec)
+            self._inflight = still
+            for rec in done:
+                self._epilog(es, rec)
+                n += 1
+            return n
+        finally:
+            self._manager_lock.release()
+
+    # ------------------------------------------------------------------ #
+    # stage-in / execute                                                 #
+    # ------------------------------------------------------------------ #
+    def _stage_in(self, task: Task) -> List[Any]:
+        """Resolve every input flow to an array on this device
+        (ref: parsec_cuda_kernel_push, device_cuda_module.c:2099-2195)."""
+        import jax
+        arrays: List[Any] = []
+        for flow in task.task_class.flows:
+            access = task.access_of(flow)
+            ref = task.data[flow.flow_index]
+            if flow.ctl or ref.data_in is None:
+                arrays.append(None)
+                continue
+            data = ref.data_in.data
+            if data is None:
+                # detached copy (e.g. NEW tile scratch): move payload directly
+                arrays.append(jax.device_put(ref.data_in.payload, self.jax_device))
+                continue
+            copy = data.get_copy(self.device_index)
+            if copy is None:
+                copy = DataCopy(data, self.device_index, payload=None,
+                                dtt=ref.data_in.dtt)
+                data.attach_copy(copy)
+            src = data.start_transfer_ownership(self.device_index, access)
+            if src is not None:
+                nbytes = getattr(src.payload, "nbytes", 0)
+                # credit the stale payload being replaced before reserving
+                self._account(-getattr(copy.payload, "nbytes", 0))
+                self._reserve(nbytes)
+                copy.payload = jax.device_put(src.payload, self.jax_device)
+                self.stats["stage_in_bytes"] += nbytes
+            data.complete_transfer_ownership(self.device_index, access)
+            self._lru_touch(copy, owned=bool(access & FlowAccess.WRITE))
+            arrays.append(copy.payload)
+        return arrays
+
+    def _submit(self, task: Task, est: float) -> None:
+        tc = task.task_class
+        chore = tc.incarnations[task.selected_chore]
+        fn = chore.dyld_fn
+        assert fn is not None, f"tpu chore of {tc.name} has no executable"
+        inputs = self._stage_in(task)
+        # fn is the DSL's wrapper: (task, per-flow device arrays) -> outputs
+        outputs = fn(task, inputs)
+        if outputs is None:
+            outputs = ()
+        elif not isinstance(outputs, (tuple, list)):
+            outputs = (outputs,)
+        out_flows = [f.flow_index for f in tc.flows
+                     if (task.access_of(f) & FlowAccess.WRITE) and not f.ctl
+                     and task.data[f.flow_index].data_in is not None]
+        assert len(outputs) == len(out_flows), (
+            f"{tc.name} tpu body returned {len(outputs)} arrays for "
+            f"{len(out_flows)} written flows")
+        self._inflight.append(_InFlight(task, list(outputs), out_flows, est))
+        self.stats["tasks"] += 1
+
+    def _epilog(self, es, rec: _InFlight) -> None:
+        """ref: parsec_cuda_kernel_epilog (device_cuda_module.c:2365-2430)."""
+        from ..runtime.scheduling import complete_execution
+        task = rec.task
+        for arr, fidx in zip(rec.outputs, rec.out_flows):
+            ref = task.data[fidx]
+            data = ref.data_in.data if ref.data_in is not None else None
+            if data is not None:
+                copy = data.get_copy(self.device_index)
+                old = getattr(copy.payload, "nbytes", 0)
+                copy.payload = arr
+                self._account(getattr(arr, "nbytes", 0) - old)
+                data.version_bump(self.device_index)
+                ref.data_out = copy
+            else:
+                ref.data_in.payload = arr
+                ref.data_in.version += 1
+        for flow in task.task_class.flows:
+            if task.access_of(flow) == FlowAccess.READ and not flow.ctl:
+                ref = task.data[flow.flow_index]
+                if ref.data_in is not None and ref.data_in.data is not None:
+                    ref.data_in.data.release_reader(self.device_index)
+        self.load_sub(rec.est)
+        self.executed_tasks += 1
+        complete_execution(es, task)
+
+    # ------------------------------------------------------------------ #
+    # memory management: accounting arena + LRU eviction                 #
+    # ------------------------------------------------------------------ #
+    def _account(self, delta: int) -> None:
+        with self._mem_lock:
+            self.mem_used = max(0, self.mem_used + delta)
+
+    def _reserve(self, nbytes: int) -> None:
+        """ref: parsec_gpu_data_reserve_device_space w/ LRU eviction and
+        cycling guard (device_cuda_module.c:864-1040)."""
+        with self._mem_lock:
+            self.mem_used += nbytes
+            if self.mem_used <= self.mem_budget:
+                return
+            # evict clean copies first
+            for key in list(self._lru_clean):
+                if self.mem_used <= self.mem_budget:
+                    break
+                copy = self._lru_clean.pop(key)
+                self._evict(copy, writeback=False)
+            # then dirty (owned) copies with writeback
+            for key in list(self._lru_owned):
+                if self.mem_used <= self.mem_budget:
+                    break
+                copy = self._lru_owned.pop(key)
+                self._evict(copy, writeback=True)
+
+    def _evict(self, copy: DataCopy, writeback: bool) -> None:
+        if copy.payload is None or copy.data is None:
+            return
+        if copy.readers > 0:
+            return  # in use; cycling guard keeps it resident
+        import numpy as np
+        data = copy.data
+        if writeback and copy.coherency == Coherency.OWNED:
+            host = data.get_copy(0)
+            if host is not None:
+                host.payload = np.asarray(copy.payload)
+                host.version = copy.version
+                host.coherency = Coherency.OWNED
+                data.owner_device = 0
+                self.stats["stage_out_bytes"] += getattr(host.payload, "nbytes", 0)
+        self.mem_used = max(0, self.mem_used - getattr(copy.payload, "nbytes", 0))
+        copy.payload = None
+        copy.coherency = Coherency.INVALID
+        self.stats["evictions"] += 1
+
+    def _lru_touch(self, copy: DataCopy, owned: bool) -> None:
+        key = id(copy)
+        with self._mem_lock:
+            self._lru_clean.pop(key, None)
+            self._lru_owned.pop(key, None)
+            (self._lru_owned if owned else self._lru_clean)[key] = copy
+
+    # ------------------------------------------------------------------ #
+    # explicit transfers (used by DSLs for flush / pushout)              #
+    # ------------------------------------------------------------------ #
+    def pull_to_host(self, data: Data) -> Any:
+        """D2H writeback of this device's copy if it owns the newest version
+        (ref: parsec_cuda_kernel_pop D2H for pushout flows)."""
+        import numpy as np
+        copy = data.get_copy(self.device_index)
+        if copy is None or copy.payload is None:
+            return None
+        host = data.get_copy(0)
+        arr = np.asarray(copy.payload)
+        if host is None:
+            host = DataCopy(data, 0, payload=arr)
+            data.attach_copy(host)
+        else:
+            host.payload = arr
+        host.version = copy.version
+        host.coherency = Coherency.SHARED
+        copy.coherency = Coherency.SHARED
+        self.stats["stage_out_bytes"] += arr.nbytes
+        return arr
+
+    def data_advise(self, data: Data, advice: str) -> None:
+        if advice == "prefetch":
+            import jax
+            copy = data.get_copy(self.device_index)
+            src = data.newest_copy(exclude_device=self.device_index)
+            if src is None:
+                return
+            if copy is None:
+                copy = DataCopy(data, self.device_index, payload=None, dtt=src.dtt)
+                data.attach_copy(copy)
+            if copy.payload is None:
+                self._reserve(getattr(src.payload, "nbytes", 0))
+                copy.payload = jax.device_put(src.payload, self.jax_device)
+                copy.version = src.version
+                copy.coherency = Coherency.SHARED
+                self._lru_touch(copy, owned=False)
+        elif advice == "preferred_device":
+            data.preferred_device = self.device_index
+
+    def fini(self) -> None:
+        assert not self._inflight, "device finalized with in-flight tasks"
+
+
+def tpu_chore_hook(device_selector=None):
+    """Build the generic accelerator chore hook: pick a device, hand off.
+
+    ref: the generated CUDA hook (jdf2c.c:6557-6904) builds a gpu_task and
+    calls the kernel scheduler.
+    """
+    def hook(es, task: Task) -> HookReturn:
+        ctx = es.context
+        tpus = [d for d in ctx.devices if d.device_type == "tpu"]
+        if not tpus:
+            return HookReturn.NEXT  # fall through to the CPU incarnation
+        if device_selector is not None:
+            dev = device_selector(task, tpus)
+        else:
+            from .device import get_best_device
+            dev = get_best_device(task, tpus, eligible_types={"tpu"})
+        return dev.kernel_scheduler(es, task)
+    return hook

@@ -1,0 +1,460 @@
+"""DTD — Dynamic Task Discovery front end.
+
+Reference behavior: a sequential task-insertion API that discovers the DAG at
+runtime from data access modes (IN/OUT/INOUT + AFFINITY/DONT_TRACK), with
+per-tile last-user tracking (WAR/WAW chaining, read-after-read fan-out),
+sliding-window backpressure (window 8000 / threshold 4000), per-taskpool
+registries of task classes and tiles, NEW-tile support, accelerator chores
+via ``add_chore``, and explicit data flush back home
+(ref: parsec/interfaces/dtd/insert_function.c, insert_function.h:284-425,
+overlap_strategies.c:1-356, parsec_dtd_data_flush.c:1-397; call stack
+SURVEY.md §3.5).
+
+Public surface mirrors the reference:
+``DTDTaskpool.insert_task(fn, args...)``, ``tile_of(collection, key)``,
+``tile_new(...)``, ``data_flush/data_flush_all``, ``add_chore``, ``wait``.
+"""
+from __future__ import annotations
+
+import threading
+from enum import IntFlag
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...core.hashtable import HashTable
+from ...data.data import (Coherency, Data, DataCopy, FlowAccess,
+                          data_new_with_payload)
+from ...data.datatype import dtt_of_array
+from ...runtime.scheduling import schedule, schedule_keep_best, task_progress
+from ...runtime.taskpool import (Chore, Flow, HookReturn, Task, TaskClass,
+                                 Taskpool)
+from ...runtime.termdet import termdet_new
+from ...utils import logging as plog
+from ...utils.params import params
+
+
+class AccessMode(IntFlag):
+    """ref: parsec_dtd_op_t / flags in insert_function.h"""
+    INPUT = 0x1
+    OUTPUT = 0x2
+    INOUT = 0x3
+    VALUE = 0x10         # pass-by-value scalar argument
+    SCRATCH = 0x20       # per-task scratch buffer
+    REF = 0x40           # opaque reference, no tracking
+    AFFINITY = 0x100     # place the task where this tile lives
+    DONT_TRACK = 0x200   # do not build dependencies on this argument
+
+
+INPUT = AccessMode.INPUT
+OUTPUT = AccessMode.OUTPUT
+INOUT = AccessMode.INOUT
+VALUE = AccessMode.VALUE
+SCRATCH = AccessMode.SCRATCH
+REF = AccessMode.REF
+AFFINITY = AccessMode.AFFINITY
+DONT_TRACK = AccessMode.DONT_TRACK
+
+
+class DTDTile:
+    """ref: parsec_dtd_tile_t — tracked unit of data with last-user state."""
+
+    __slots__ = ("key", "rank", "data", "home_collection", "last_writer",
+                 "readers", "lock", "flushed")
+
+    def __init__(self, key: Any, data: Data, rank: int = 0,
+                 home_collection: Any = None) -> None:
+        self.key = key
+        self.rank = rank
+        self.data = data
+        self.home_collection = home_collection
+        self.last_writer: Optional["_DTDRecord"] = None
+        self.readers: List["_DTDRecord"] = []
+        self.lock = threading.Lock()
+        self.flushed = False
+
+
+class _DTDRecord:
+    """Per-task DTD bookkeeping: dependency counter + successor list."""
+
+    __slots__ = ("task", "deps_remaining", "successors", "completed", "lock")
+
+    def __init__(self, task: Task) -> None:
+        self.task = task
+        self.deps_remaining = 1   # +1 insertion guard, dropped when fully parsed
+        self.successors: List["_DTDRecord"] = []
+        self.completed = False
+        self.lock = threading.Lock()
+
+    def add_successor(self, succ: "_DTDRecord") -> bool:
+        """Register succ; returns False if we already completed (no dep)."""
+        with self.lock:
+            if self.completed:
+                return False
+            self.successors.append(succ)
+            return True
+
+    def dep_satisfied(self) -> bool:
+        with self.lock:
+            self.deps_remaining -= 1
+            assert self.deps_remaining >= 0
+            return self.deps_remaining == 0
+
+
+class _Param:
+    __slots__ = ("value", "mode", "tile", "flow_index")
+
+    def __init__(self, value: Any, mode: AccessMode, tile: Optional[DTDTile],
+                 flow_index: int = -1) -> None:
+        self.value = value
+        self.mode = mode
+        self.tile = tile
+        self.flow_index = flow_index
+
+
+def _dtd_cpu_hook(es, task: Task) -> HookReturn:
+    """Run the user body; host copies were resolved by prepare_input."""
+    fn = task.task_class.user_body
+    rc = fn(es, task)
+    return HookReturn.DONE if rc is None else rc
+
+
+class DTDTaskClass(TaskClass):
+    def __init__(self, name: str, tc_id: int, nb_flows: int,
+                 body: Callable, flows: List[Flow]) -> None:
+        super().__init__(name, tc_id, nb_flows, flows=flows,
+                         incarnations=[Chore("cpu", _dtd_cpu_hook)])
+        self.user_body = body
+        self.prepare_input = _dtd_prepare_input
+        self.release_deps = _dtd_release_deps
+
+
+def _dtd_prepare_input(es, task: Task) -> HookReturn:
+    """Resolve data_in copies (ref: data_lookup_of_dtd_task,
+    insert_function.c:2014). Accelerator chores stage in themselves; the host
+    path must pull the newest version back to the host copy."""
+    will_run_on_device = any(
+        ch.device_type != "cpu" and (task.chore_mask & (1 << i))
+        for i, ch in enumerate(task.task_class.incarnations))
+    for flow in task.task_class.flows:
+        p: _Param = task.body_args[flow.flow_index]
+        if p is None:
+            continue
+        if p.tile is None:
+            continue
+        data = p.tile.data
+        host = data.get_copy(0)
+        if host is None:
+            host = DataCopy(data, 0, payload=None)
+            data.attach_copy(host)
+        if not will_run_on_device:
+            newest = data.newest_copy()
+            if newest is not None and newest.device_id != 0 and \
+                    newest.version > host.version:
+                dev = es.context.devices[newest.device_id]
+                dev.pull_to_host(data)
+        task.data[flow.flow_index].data_in = data.get_copy(0) \
+            if not will_run_on_device else (data.newest_copy() or host)
+        task.data[flow.flow_index].fulfilled = True
+    return HookReturn.DONE
+
+
+def _dtd_release_deps(es, task: Task, action_mask: int) -> List[Task]:
+    """ref: dtd_release_dep_fct (insert_function.c:1603) — mark written
+    copies, wake satisfied successors."""
+    rec: _DTDRecord = task.dtd
+    # version bump for host-written flows (device epilog bumps its own)
+    if task.selected_device is None or task.selected_device.device_type == "cpu":
+        for flow in task.task_class.flows:
+            p: _Param = task.body_args[flow.flow_index]
+            if p is not None and p.tile is not None and \
+                    (task.access_of(flow) & FlowAccess.WRITE):
+                p.tile.data.version_bump(0)
+    ready: List[Task] = []
+    with rec.lock:
+        rec.completed = True
+        succs, rec.successors = rec.successors, []
+    for s in succs:
+        if s.dep_satisfied():
+            ready.append(s.task)
+    tp: DTDTaskpool = task.taskpool
+    tp._on_task_done()
+    return ready
+
+
+class DTDTaskpool(Taskpool):
+    """ref: parsec_dtd_taskpool_new (insert_function.c)"""
+
+    MAX_TASK_CLASSES = 25  # ref: insert_function_internal.h:30
+
+    def __init__(self, name: str = "dtd") -> None:
+        super().__init__(name=name)
+        self.window_size = params.get("dtd_window_size")
+        self.threshold_size = params.get("dtd_threshold_size")
+        self._task_classes: Dict[Any, DTDTaskClass] = {}
+        self._tiles = HashTable()
+        self._outstanding = 0
+        self._out_lock = threading.Lock()
+        self._inserted = 0
+        # keep-alive action until wait() (so an empty pool doesn't terminate)
+        self.tdm = termdet_new(params.get("termdet") if params.get("termdet") != "fourcounter" else "local", self)
+        self.tdm.taskpool_addto_runtime_actions(1)
+        self._alive = True
+
+    # ------------------------------------------------------------------ #
+    # tiles                                                              #
+    # ------------------------------------------------------------------ #
+    def tile_of(self, collection, key: Any) -> DTDTile:
+        """ref: parsec_dtd_tile_of (insert_function.h:219) — one DTDTile per
+        (collection, key), memoized."""
+        tkey = (id(collection), key)
+
+        def factory() -> DTDTile:
+            data = collection.data_of_key(key)
+            rank = collection.rank_of_key(key)
+            return DTDTile(key, data, rank=rank, home_collection=collection)
+        tile, _ = self._tiles.find_or_insert(tkey, factory)
+        return tile
+
+    def tile_of_data(self, data: Data) -> DTDTile:
+        tkey = ("data", data.key)
+
+        def factory() -> DTDTile:
+            return DTDTile(data.key, data, rank=0)
+        tile, _ = self._tiles.find_or_insert(tkey, factory)
+        return tile
+
+    def tile_of_array(self, arr: Any, key: Any = None) -> DTDTile:
+        """Wrap a host array as a tracked tile."""
+        data = data_new_with_payload(arr, device_id=0, key=key)
+        return self.tile_of_data(data)
+
+    def tile_new(self, shape: Tuple[int, ...], dtype=np.float32,
+                 key: Any = None) -> DTDTile:
+        """ref: NEW-tile support (dtd_test_new_tile) — runtime-allocated."""
+        return self.tile_of_array(np.zeros(shape, dtype=dtype), key=key)
+
+    # ------------------------------------------------------------------ #
+    # task classes + chores                                              #
+    # ------------------------------------------------------------------ #
+    def _task_class_of(self, body: Callable, nb_flows: int,
+                       name: Optional[str]) -> DTDTaskClass:
+        key = body
+        tc = self._task_classes.get(key)
+        if tc is None:
+            assert len(self._task_classes) < self.MAX_TASK_CLASSES, \
+                "too many DTD task classes (ref limit 25)"
+            flows = [Flow(f"flow{i}", FlowAccess.NONE, i) for i in range(nb_flows)]
+            tc = DTDTaskClass(name or getattr(body, "__name__", "dtd_task"),
+                              len(self._task_classes), nb_flows, body, flows)
+            self._task_classes[key] = tc
+            self.task_classes.append(tc)
+        assert tc.nb_flows == nb_flows, \
+            f"task class {tc.name} re-inserted with different flow count"
+        return tc
+
+    def add_chore(self, body: Callable, device_type: str, fn: Any) -> None:
+        """ref: parsec_dtd_task_class_add_chore (insert_function.c:2432).
+        ``fn`` for device_type "tpu" is a jax callable taking one argument
+        per inserted parameter in insertion order — device arrays for tiles,
+        raw Python values for VALUE params (same order as unpack_args); it
+        returns arrays for the written flows, in order."""
+        tc = self._task_classes.get(body)
+        assert tc is not None, "add_chore before first insert_task of this body"
+
+        def wrapped(task: Task, arrays: List[Any]) -> Any:
+            args = [arrays[p.flow_index] if p.tile is not None else p.value
+                    for p in task.user
+                    if p.tile is not None or (p.mode & VALUE)]
+            return fn(*args)
+
+        from ...devices.tpu import tpu_chore_hook
+        tc.incarnations.append(Chore(device_type, tpu_chore_hook(), dyld_fn=wrapped))
+
+    # ------------------------------------------------------------------ #
+    # insertion                                                          #
+    # ------------------------------------------------------------------ #
+    def insert_task(self, body: Callable, *args, name: Optional[str] = None,
+                    priority: int = 0) -> Task:
+        """ref: parsec_dtd_insert_task (insert_function.h:284, impl :3506).
+
+        ``args`` are (value, VALUE) / (tile, INPUT|INOUT|OUTPUT [|AFFINITY...])
+        pairs, or bare Python values (implicitly VALUE).
+        """
+        assert self._alive, "insert_task after wait()"
+        self._backpressure()
+        # parse the vararg list (ref: __parsec_dtd_taskpool_create_task :3219)
+        parsed: List[_Param] = []
+        flow_count = 0
+        for a in args:
+            if isinstance(a, tuple) and len(a) == 2 and isinstance(a[1], AccessMode):
+                val, mode = a
+            else:
+                val, mode = a, AccessMode.VALUE
+            if mode & (VALUE | REF | SCRATCH) or (mode & DONT_TRACK):
+                parsed.append(_Param(val, mode, None))
+                continue
+            assert isinstance(val, DTDTile), \
+                f"tracked argument must be a DTDTile, got {type(val)}"
+            p = _Param(val, mode, val, flow_index=flow_count)
+            flow_count += 1
+            parsed.append(p)
+
+        tc = self._task_class_of(body, flow_count, name)
+        task = Task(self, tc, locals_=(self._inserted,), priority=priority)
+        self._inserted += 1
+        rec = _DTDRecord(task)
+        task.dtd = rec
+        # per-INSTANCE access modes (the same body may be inserted with
+        # different modes; the shared class Flow objects stay untouched)
+        tracked = [p for p in parsed if p.tile is not None]
+        task.body_args = tracked
+        task.user = parsed
+        task.flow_access = [FlowAccess(int(p.mode) & 0x3) for p in tracked]
+        self.add_tasks(1)
+        with self._out_lock:
+            self._outstanding += 1
+
+        # dependency discovery from tile last-user state
+        # (ref: overlap_strategies.c WAR/fan-out resolution)
+        def _chain_after(pred: "_DTDRecord") -> None:
+            # take the dep BEFORE publishing rec to the predecessor: if the
+            # increment came after add_successor, a concurrently-completing
+            # predecessor could consume the insertion guard and schedule a
+            # half-built task (then the guard drop would schedule it twice)
+            with rec.lock:
+                rec.deps_remaining += 1
+            if not pred.add_successor(rec):
+                rec.dep_satisfied()  # already completed; cannot hit zero here
+
+        for p in tracked:
+            tile = p.tile
+            acc = int(p.mode) & 0x3
+            with tile.lock:
+                if acc == int(AccessMode.INPUT):
+                    lw = tile.last_writer
+                    if lw is not None and lw is not rec:
+                        _chain_after(lw)
+                    # prune completed readers so read-mostly tiles don't
+                    # retain every historical reader record
+                    tile.readers = [r for r in tile.readers if not r.completed]
+                    tile.readers.append(rec)
+                else:  # OUTPUT or INOUT: chain after writer and all readers
+                    preds = []
+                    if tile.last_writer is not None and tile.last_writer is not rec:
+                        preds.append(tile.last_writer)
+                    preds.extend(r for r in tile.readers if r is not rec)
+                    for pr in preds:
+                        _chain_after(pr)
+                    tile.last_writer = rec
+                    tile.readers = []
+
+        # affinity placement hint
+        for p in tracked:
+            if p.mode & AFFINITY:
+                task.taskpool_affinity_rank = p.tile.rank
+                break
+
+        # drop the insertion guard; schedule if ready
+        if rec.dep_satisfied():
+            self._schedule_new(task)
+        return task
+
+    def _schedule_new(self, task: Task) -> None:
+        ctx = self.context
+        assert ctx is not None, "insert_task before context.add_taskpool"
+        es = ctx.execution_streams[0]
+        schedule(es, [task])
+
+    def _on_task_done(self) -> None:
+        with self._out_lock:
+            self._outstanding -= 1
+
+    def _backpressure(self) -> None:
+        """ref: parsec_dtd_block_if_threshold_reached (insert_function.c:3215)
+        — over the window, the inserting thread helps execute."""
+        if self._outstanding <= self.window_size:
+            return
+        ctx = self.context
+        es = ctx.execution_streams[0]
+        while self._outstanding > self.threshold_size:
+            task = es.next_task
+            es.next_task = None
+            if task is None:
+                task = ctx.scheduler.select(es)
+            if task is not None:
+                task_progress(es, task)
+            elif ctx.progress_engines(es) == 0:
+                break  # nothing runnable; don't deadlock the inserter
+
+    # ------------------------------------------------------------------ #
+    # flush + wait                                                       #
+    # ------------------------------------------------------------------ #
+    def data_flush(self, tile: DTDTile) -> None:
+        """ref: parsec_dtd_data_flush — order a writeback of the tile to its
+        home (host copy / collection storage) after its last user. One shared
+        task class serves every flush (a per-call closure would exhaust the
+        25-class limit)."""
+        self.insert_task(_dtd_flush_body, (tile, INOUT), (tile, VALUE | REF),
+                         name="dtd_flush")
+
+    def data_flush_all(self) -> None:
+        for _, tile in self._tiles.items():
+            if not tile.flushed:
+                self.data_flush(tile)
+
+    def wait(self) -> None:
+        """ref: parsec_dtd_taskpool_wait — drop the keep-alive and help
+        execute until this taskpool terminates."""
+        assert self.context is not None
+        if self._alive:
+            self._alive = False
+            self.tdm.taskpool_addto_runtime_actions(-1)
+        ctx = self.context
+        ctx.start()
+        es = ctx.execution_streams[0]
+        from ...runtime.scheduling import _Backoff
+        backoff = _Backoff()
+        while not self.completed and not ctx._task_errors:
+            task = es.next_task
+            es.next_task = None
+            if task is None:
+                task = ctx.scheduler.select(es)
+            try:
+                if task is not None:
+                    task_progress(es, task)
+                    backoff.hit()
+                elif ctx.progress_engines(es):
+                    backoff.hit()
+                else:
+                    backoff.miss(ctx)
+            except BaseException as exc:
+                ctx.record_task_error(exc, task)
+        ctx.raise_pending_error()
+
+
+def _dtd_flush_body(es, task: Task) -> None:
+    """Shared flush task body: pull the newest copy back to the host."""
+    tile: DTDTile = next(p.value for p in task.user if p.tile is None)
+    d = tile.data
+    newest = d.newest_copy()
+    if newest is not None and newest.device_id != 0:
+        es.context.devices[newest.device_id].pull_to_host(d)
+    tile.flushed = True
+
+
+def taskpool_new(name: str = "dtd") -> DTDTaskpool:
+    return DTDTaskpool(name=name)
+
+
+def unpack_args(task: Task) -> List[Any]:
+    """ref: parsec_dtd_unpack_args — values for VALUE params, host ndarrays
+    for tracked tiles (in the original insertion order)."""
+    out: List[Any] = []
+    for p in task.user:
+        if p.tile is not None:
+            host = p.tile.data.get_copy(0)
+            out.append(host.payload if host is not None else None)
+        else:
+            out.append(p.value)
+    return out

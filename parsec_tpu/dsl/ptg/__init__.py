@@ -1,0 +1,1 @@
+"""ptg subpackage."""

@@ -1,0 +1,155 @@
+"""PINS — Performance INStrumentation callback framework.
+
+Reference behavior: typed callback sites compiled into the hot path
+(SELECT / PREPARE_INPUT / RELEASE_DEPS / EXEC / COMPLETE_EXEC / SCHEDULE
+begin/end pairs), with pluggable modules subscribing per event type
+(ref: parsec/mca/pins/pins.h:27-52, invoked as PARSEC_PINS(es, EXEC_BEGIN, task)
+from parsec/scheduling.c:152,182,447-456). Modules in-tree: task_profiler,
+papi, alperf, print_steals, iterators_checker, ptg_to_dtd.
+
+Here the sites are function-call hooks that are near-free when no module is
+registered (a module-count fast path).
+"""
+from __future__ import annotations
+
+import threading
+from enum import IntEnum
+from typing import Any, Callable, Dict, List
+
+
+class PinsEvent(IntEnum):
+    SELECT_BEGIN = 0
+    SELECT_END = 1
+    PREPARE_INPUT_BEGIN = 2
+    PREPARE_INPUT_END = 3
+    RELEASE_DEPS_BEGIN = 4
+    RELEASE_DEPS_END = 5
+    DATA_FLUSH_BEGIN = 6
+    DATA_FLUSH_END = 7
+    EXEC_BEGIN = 8
+    EXEC_END = 9
+    COMPLETE_EXEC_BEGIN = 10
+    COMPLETE_EXEC_END = 11
+    SCHEDULE_BEGIN = 12
+    SCHEDULE_END = 13
+
+
+_N_EVENTS = len(PinsEvent)
+_subscribers: List[List[Callable]] = [[] for _ in range(_N_EVENTS)]
+_active = 0
+_lock = threading.Lock()
+
+
+def PINS(es: Any, event: PinsEvent, payload: Any) -> None:
+    """The instrumentation site; inlined fast path when inactive."""
+    if _active == 0:
+        return
+    for cb in _subscribers[event]:
+        cb(es, event, payload)
+
+
+def pins_is_active() -> bool:
+    return _active > 0
+
+
+class PinsModule:
+    """Base class for PINS modules; override ``events`` + ``callback``."""
+
+    name = "base"
+    events: List[PinsEvent] = []
+
+    def enable(self) -> None:
+        global _active
+        with _lock:
+            for ev in self.events:
+                _subscribers[ev].append(self.callback)
+                _active_incr()
+
+    def disable(self) -> None:
+        with _lock:
+            for ev in self.events:
+                try:
+                    _subscribers[ev].remove(self.callback)
+                except ValueError:
+                    continue
+                _active_decr()
+
+    def callback(self, es: Any, event: PinsEvent, payload: Any) -> None:
+        raise NotImplementedError
+
+
+def _active_incr() -> None:
+    global _active
+    _active += 1
+
+
+def _active_decr() -> None:
+    global _active
+    _active -= 1
+
+
+class TaskProfilerModule(PinsModule):
+    """Turns EXEC/SELECT/COMPLETE PINS events into trace events
+    (ref: pins/task_profiler)."""
+
+    name = "task_profiler"
+    events = [PinsEvent.EXEC_BEGIN, PinsEvent.EXEC_END,
+              PinsEvent.PREPARE_INPUT_BEGIN, PinsEvent.PREPARE_INPUT_END,
+              PinsEvent.COMPLETE_EXEC_BEGIN, PinsEvent.COMPLETE_EXEC_END]
+
+    def __init__(self, profile) -> None:
+        self.profile = profile  # profiling.trace.Profile
+
+    def callback(self, es: Any, event: PinsEvent, payload: Any) -> None:
+        stream = self.profile.thread_stream(es)
+        name = payload.task_class.name if payload is not None and hasattr(payload, "task_class") else "runtime"
+        if event in (PinsEvent.EXEC_BEGIN,):
+            stream.begin("exec:" + name, tid=es.th_id,
+                         info={"task": payload.snprintf()} if payload is not None else None)
+        elif event in (PinsEvent.EXEC_END,):
+            stream.end("exec:" + name)
+        elif event == PinsEvent.PREPARE_INPUT_BEGIN:
+            stream.begin("prep:" + name, tid=es.th_id)
+        elif event == PinsEvent.PREPARE_INPUT_END:
+            stream.end("prep:" + name)
+        elif event == PinsEvent.COMPLETE_EXEC_BEGIN:
+            stream.begin("complete:" + name, tid=es.th_id)
+        elif event == PinsEvent.COMPLETE_EXEC_END:
+            stream.end("complete:" + name)
+
+
+class PrintStealsModule(PinsModule):
+    """Counts scheduler selects per thread (ref: pins/print_steals)."""
+
+    name = "print_steals"
+    events = [PinsEvent.SELECT_END]
+
+    def __init__(self) -> None:
+        self.counts: Dict[int, int] = {}
+
+    def callback(self, es: Any, event: PinsEvent, payload: Any) -> None:
+        if payload is not None:
+            self.counts[es.th_id] = self.counts.get(es.th_id, 0) + 1
+
+
+class AlperfModule(PinsModule):
+    """Algorithmic performance counters: tasks enabled/retired per class
+    (ref: pins/alperf)."""
+
+    name = "alperf"
+    events = [PinsEvent.COMPLETE_EXEC_END, PinsEvent.SCHEDULE_END]
+
+    def __init__(self) -> None:
+        self.retired: Dict[str, int] = {}
+        self.enabled: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def callback(self, es: Any, event: PinsEvent, payload: Any) -> None:
+        with self._lock:
+            if event == PinsEvent.COMPLETE_EXEC_END and payload is not None:
+                k = payload.task_class.name
+                self.retired[k] = self.retired.get(k, 0) + 1
+            elif event == PinsEvent.SCHEDULE_END and payload:
+                for t in payload:
+                    k = t.task_class.name
+                    self.enabled[k] = self.enabled.get(k, 0) + 1

@@ -1,0 +1,149 @@
+"""Event tracing: per-thread append-only streams + Chrome/Perfetto export.
+
+Reference behavior: the binary "dbp" trace format — per-thread append-only
+event buffers, a global dictionary of event classes (keyword -> key id,
+color, packed info), begin/end event pairs, one file per rank
+(ref: parsec/profiling.c, parsec/parsec_binary_profile.h:1-172,
+parsec_profiling_add_dictionary_keyword / parsec_profiling_trace_flags
+parsec/profiling.h:234-377). Offline conversion to pandas/HDF5 lives in
+tools/profiling.
+
+TPU-native re-design: events are appended to per-thread lists (no locking on
+the hot path) with monotonic-ns timestamps; export is Chrome trace-event JSON
+(loadable in Perfetto) plus a pandas DataFrame helper, replacing the dbp →
+pbt2ptt → HDF5 pipeline.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+class Dictionary:
+    """Event-class dictionary (keyword -> id, color) (ref: profiling.h:234)."""
+
+    def __init__(self) -> None:
+        self._by_name: Dict[str, int] = {}
+        self._info: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+
+    def add_keyword(self, name: str, color: str = "#888888") -> int:
+        with self._lock:
+            if name in self._by_name:
+                return self._by_name[name]
+            key = len(self._info)
+            self._by_name[name] = key
+            self._info.append({"name": name, "color": color})
+            return key
+
+    def name_of(self, key: int) -> str:
+        return self._info[key]["name"]
+
+
+class ThreadStream:
+    """Per-thread append-only event buffer (ref: parsec_profiling_stream_t)."""
+
+    def __init__(self, profile: "Profile", tid: int, name: str = "") -> None:
+        self.profile = profile
+        self.tid = tid
+        self.name = name or f"thread-{tid}"
+        self.events: List[tuple] = []  # (ts_ns, phase, key_or_name, info)
+
+    def trace(self, key: str, event_id: int = 0, info: Any = None,
+              phase: str = "i") -> None:
+        self.events.append((time.monotonic_ns(), phase, key, info))
+
+    def begin(self, key: str, tid: Optional[int] = None, info: Any = None) -> None:
+        self.events.append((time.monotonic_ns(), "B", key, info))
+
+    def end(self, key: str, info: Any = None) -> None:
+        self.events.append((time.monotonic_ns(), "E", key, info))
+
+    def counter(self, key: str, value: float) -> None:
+        self.events.append((time.monotonic_ns(), "C", key, value))
+
+
+class Profile:
+    """One trace per rank (ref: parsec_profiling_dbp_start, parsec.c:706-726)."""
+
+    def __init__(self, rank: int = 0, info: Optional[Dict[str, str]] = None) -> None:
+        self.rank = rank
+        self.dictionary = Dictionary()
+        self.info = dict(info or {})
+        self._streams: Dict[int, ThreadStream] = {}
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic_ns()
+
+    def thread_stream(self, es: Any) -> ThreadStream:
+        tid = getattr(es, "th_id", 0)
+        st = self._streams.get(tid)
+        if st is None:
+            with self._lock:
+                st = self._streams.setdefault(tid, ThreadStream(self, tid))
+        return st
+
+    def stream(self, tid: int, name: str = "") -> ThreadStream:
+        with self._lock:
+            st = self._streams.get(tid)
+            if st is None:
+                st = ThreadStream(self, tid, name)
+                self._streams[tid] = st
+            return st
+
+    def add_information(self, key: str, value: str) -> None:
+        self.info[key] = value
+
+    # -- export -------------------------------------------------------------
+    def to_chrome_trace(self) -> Dict[str, Any]:
+        events = []
+        for tid, st in sorted(self._streams.items()):
+            events.append({"name": "thread_name", "ph": "M", "pid": self.rank,
+                           "tid": tid, "args": {"name": st.name}})
+            for ts, ph, key, info in st.events:
+                ev: Dict[str, Any] = {
+                    "name": key, "pid": self.rank, "tid": tid,
+                    "ts": (ts - self._t0) / 1000.0,
+                }
+                if ph in ("B", "E"):
+                    ev["ph"] = ph
+                elif ph == "C":
+                    ev["ph"] = "C"
+                    ev["args"] = {key: info}
+                else:
+                    ev["ph"] = "i"
+                    ev["s"] = "t"
+                if info is not None and ph == "B":
+                    ev["args"] = info if isinstance(info, dict) else {"info": info}
+                events.append(ev)
+        return {"traceEvents": events, "metadata": self.info}
+
+    def dump(self, path: str) -> str:
+        """Write the Chrome trace JSON; returns the path written."""
+        out = path if path.endswith(".json") else f"{path}.rank{self.rank}.trace.json"
+        os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+        with open(out, "w") as fh:
+            json.dump(self.to_chrome_trace(), fh)
+        return out
+
+    def to_dataframe(self):
+        """Interval table like the reference's parsec_trace_tables.py."""
+        import pandas as pd  # local import; pandas is optional at runtime
+        rows = []
+        for tid, st in self._streams.items():
+            open_ev: Dict[str, List[int]] = {}
+            for ts, ph, key, info in st.events:
+                if ph == "B":
+                    open_ev.setdefault(key, []).append(ts)
+                elif ph == "E" and open_ev.get(key):
+                    b = open_ev[key].pop()
+                    rows.append({"tid": tid, "name": key,
+                                 "begin_ns": b - self._t0,
+                                 "end_ns": ts - self._t0,
+                                 "duration_ns": ts - b})
+        return pd.DataFrame(rows)
+
+    def nb_events(self) -> int:
+        return sum(len(s.events) for s in self._streams.values())

@@ -1,0 +1,265 @@
+"""The runtime context: init/fini, worker threads, taskpool lifecycle.
+
+Reference behavior: ``parsec_init`` builds the context (MCA params, topology,
+vpmap, worker threads parked on a barrier, profiling, comm, devices, data,
+scheduler selection); ``parsec_context_add_taskpool`` attaches a termination
+detector and runs the startup hook; ``parsec_context_start`` releases the
+workers; ``parsec_context_wait`` joins the progress loop until every active
+taskpool has terminated (ref: parsec/parsec.c:391-905,
+parsec/scheduling.c:535-790; call stacks SURVEY.md §3.1-3.2).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from ..utils import logging as plog
+from ..utils.params import params
+from ..profiling.trace import Profile
+from ..profiling.pins import TaskProfilerModule
+from .scheduling import ExecutionStream, context_wait_loop, schedule
+from .taskpool import Taskpool
+from .termdet import termdet_new
+from .vpmap import VPMap, VirtualProcess, default_nb_cores
+
+
+class Context:
+    """ref: parsec_context_t"""
+
+    def __init__(self, nb_cores: Optional[int] = None,
+                 argv: Optional[List[str]] = None,
+                 scheduler: Optional[str] = None,
+                 vpmap: Optional[VPMap] = None,
+                 rank: int = 0, nb_ranks: int = 1,
+                 comm: Any = None,
+                 enable_tpu: bool = True,
+                 profile: bool = False) -> None:
+        if argv:
+            params.parse_argv(argv)
+        self.rank = rank
+        self.nb_ranks = nb_ranks
+        self.comm = comm                       # comm engine / remote-dep driver
+        self.vpmap = vpmap or VPMap.from_flat(nb_cores or default_nb_cores())
+        self.nb_cores = self.vpmap.nb_total_threads
+
+        # profiling (ref: parsec.c:706-788)
+        prof_prefix = params.get("profile")
+        self.profile: Optional[Profile] = None
+        self._prof_prefix = None
+        if profile or prof_prefix:
+            self.profile = Profile(rank=rank)
+            self._prof_prefix = prof_prefix or "parsec_prof"
+            self._task_profiler = TaskProfilerModule(self.profile)
+            self._task_profiler.enable()
+
+        # virtual processes + execution streams
+        self.vps: List[VirtualProcess] = []
+        self.execution_streams: List[ExecutionStream] = []
+        th_id = 0
+        for vp_id, n in enumerate(self.vpmap.nb_threads_per_vp):
+            vp = VirtualProcess(vp_id, n)
+            self.vps.append(vp)
+            for local in range(n):
+                es = ExecutionStream(self, th_id, vp_id, vp_local_id=local)
+                if self.profile is not None:
+                    es.profiling_stream = self.profile.stream(th_id)
+                vp.execution_streams.append(es)
+                self.execution_streams.append(es)
+                th_id += 1
+
+        # devices (ref: parsec_mca_device_init/attach parsec.c:832-837)
+        from ..devices import build_devices
+        self.devices = build_devices(self, enable_tpu=enable_tpu)
+
+        # scheduler (ref: parsec_set_scheduler scheduling.c:246-272)
+        from ..sched import sched_new
+        name = scheduler or params.get("sched")
+        self.scheduler = sched_new(name)
+        self.scheduler.install(self)
+        for es in self.execution_streams:
+            self.scheduler.flow_init(es)
+        plog.debug.verbose(3, "context: %d threads, %d vps, %d devices, sched=%s",
+                           self.nb_cores, len(self.vps), len(self.devices), name)
+
+        # taskpool bookkeeping
+        self.taskpools: Dict[int, Taskpool] = {}
+        self._task_errors: List[BaseException] = []
+        self._active_taskpools = 0
+        self._tp_lock = threading.Lock()
+        self._started = False
+        self._finalized = False
+
+        # idle park/wake
+        self._work_cond = threading.Condition()
+
+        # worker threads (all but stream 0, which the caller's thread drives)
+        self._start_gen = 0
+        self._worker_gen: List[int] = [0] * (self.nb_cores - 1)
+        self._threads: List[threading.Thread] = []
+        for i, es in enumerate(self.execution_streams[1:]):
+            t = threading.Thread(target=self._worker_main, args=(es, i),
+                                 name=f"parsec-es{es.th_id}", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+        self.keep_highest_priority_task = params.get("runtime_keep_highest_priority_task")
+
+    # ------------------------------------------------------------------ #
+    # taskpool lifecycle                                                 #
+    # ------------------------------------------------------------------ #
+    def add_taskpool(self, tp: Taskpool) -> None:
+        """ref: parsec_context_add_taskpool (scheduling.c:668-735)."""
+        assert not self._finalized
+        assert tp.context is None, "taskpool already enqueued"
+        tp.context = self
+        if tp.tdm is None:  # DSL may have attached its own monitor
+            kind = params.get("termdet")
+            if kind == "fourcounter" and self.comm is not None and self.nb_ranks > 1:
+                tp.tdm = termdet_new("fourcounter", tp, comm=self.comm)
+            else:
+                tp.tdm = termdet_new("local", tp)
+        with self._tp_lock:
+            self.taskpools[tp.taskpool_id] = tp
+            self._active_taskpools += 1
+        if tp.on_enqueue is not None:
+            tp.on_enqueue(tp)
+        for dev in self.devices:
+            dev.taskpool_register(tp)
+        if self.comm is not None:
+            self.comm.taskpool_register(tp)
+        if tp.startup_hook is not None:
+            startup = tp.startup_hook(self, tp)
+            if startup:
+                es0 = self.execution_streams[0]
+                schedule(es0, list(startup))
+        tp.tdm.taskpool_ready()
+
+    def _taskpool_done(self, tp: Taskpool) -> None:
+        with self._tp_lock:
+            if tp.taskpool_id in self.taskpools:
+                del self.taskpools[tp.taskpool_id]
+                self._active_taskpools -= 1
+        self.wake_workers(self.nb_cores)
+
+    def all_tasks_done(self) -> bool:
+        """ref: all_tasks_done (scheduling.c:218-221)."""
+        return self._active_taskpools == 0 or bool(self._task_errors)
+
+    def record_task_error(self, exc: BaseException, task=None) -> None:
+        """A task body raised: abort the DAG and surface on the waiter."""
+        plog.warning("task %s raised: %r",
+                     task.snprintf() if task is not None else "<progress>", exc)
+        self._task_errors.append(exc)
+        self.wake_workers(self.nb_cores)
+
+    def raise_pending_error(self) -> None:
+        if self._task_errors:
+            exc = self._task_errors[0]
+            raise RuntimeError("a task body failed; DAG aborted") from exc
+
+    # ------------------------------------------------------------------ #
+    # start / test / wait                                                #
+    # ------------------------------------------------------------------ #
+    def start(self) -> None:
+        """Release the workers (ref: parsec_context_start scheduling.c:740)."""
+        if self._started:
+            return
+        self._started = True
+        with self._work_cond:
+            self._start_gen += 1
+            self._work_cond.notify_all()
+
+    def test(self) -> bool:
+        """Non-blocking completion probe (ref: parsec_context_test)."""
+        return self.all_tasks_done()
+
+    def wait(self) -> None:
+        """Caller joins the progress loop on stream 0 until all taskpools
+        terminate (ref: parsec_context_wait scheduling.c:766-790)."""
+        self.start()
+        es0 = self.execution_streams[0]
+        context_wait_loop(es0)
+        self._started = False
+        self.raise_pending_error()
+
+    def _worker_main(self, es: ExecutionStream, widx: int) -> None:
+        while True:
+            with self._work_cond:
+                self._work_cond.wait_for(
+                    lambda: self._finalized
+                    or (self._start_gen > self._worker_gen[widx]
+                        and not self.all_tasks_done()),
+                    timeout=0.05)
+                if self._finalized:
+                    return
+                if self.all_tasks_done():
+                    self._worker_gen[widx] = self._start_gen
+                    continue
+            context_wait_loop(es)
+
+    # ------------------------------------------------------------------ #
+    # idle-loop helpers                                                  #
+    # ------------------------------------------------------------------ #
+    def wake_workers(self, n: int = 1) -> None:
+        with self._work_cond:
+            self._work_cond.notify_all()
+
+    def park(self, max_sleep: float) -> None:
+        with self._work_cond:
+            self._work_cond.wait(timeout=max_sleep)
+
+    def progress_engines(self, es: ExecutionStream) -> int:
+        """Idle-cycle progress of device managers + comm engine
+        (the TPU analog of the CUDA manager/progress_stream polling and the
+        funnelled comm thread; SURVEY.md §3.3-3.4)."""
+        n = 0
+        for dev in self.devices:
+            n += dev.progress(es)
+        if self.comm is not None:
+            n += self.comm.progress(es)
+        return n
+
+    # ------------------------------------------------------------------ #
+    # shutdown                                                           #
+    # ------------------------------------------------------------------ #
+    def fini(self) -> None:
+        """ref: parsec_fini (parsec.c:1259)."""
+        if self._finalized:
+            return
+        assert self.all_tasks_done(), "fini with active taskpools"
+        if self._task_errors:
+            with self._tp_lock:
+                self.taskpools.clear()
+                self._active_taskpools = 0
+        self._finalized = True
+        with self._work_cond:
+            self._work_cond.notify_all()
+        for t in self._threads:
+            t.join(timeout=2.0)
+        for dev in self.devices:
+            dev.fini()
+        if self.comm is not None:
+            self.comm.fini()
+        if self.profile is not None and self._prof_prefix:
+            path = self.profile.dump(self._prof_prefix)
+            plog.inform("trace written to %s", path)
+        self.scheduler.remove(self)
+
+    def __enter__(self) -> "Context":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.fini()
+
+    # device helpers
+    def device_by_type(self, device_type: str):
+        for d in self.devices:
+            if d.device_type == device_type:
+                return d
+        return None
+
+
+def init(nb_cores: Optional[int] = None, argv: Optional[List[str]] = None,
+         **kw) -> Context:
+    """Module-level convenience mirroring parsec_init."""
+    return Context(nb_cores=nb_cores, argv=argv, **kw)

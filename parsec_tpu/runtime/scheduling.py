@@ -1,0 +1,211 @@
+"""The progress engine: select → prepare_input → execute → complete → release.
+
+Reference behavior: the worker-thread main loop ``__parsec_context_wait``
+(select with scheduler, exponential backoff when idle), task progress
+``__parsec_task_progress`` (prepare_input may return ASYNC; execute walks the
+incarnation list honoring ``evaluate`` vetoes; CPU hooks run inline while
+accelerator hooks hand off and return ASYNC), completion runs the generated
+``release_deps`` which feeds freshly-enabled tasks back to ``__parsec_schedule``
+— keeping the single highest-priority one on the releasing thread
+(ref: parsec/scheduling.c:124-203, 284-328, 439-533, 535-666, 610-615).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, List, Optional
+
+from ..utils import logging as plog
+from ..utils.params import params
+from ..profiling.pins import PINS, PinsEvent
+from .taskpool import HookReturn, Task, TaskStatus, ACTION_RELEASE_ALL
+
+_sched_log = plog.sched_stream
+
+
+class ExecutionStream:
+    """Per-worker execution stream (ref: parsec_execution_stream_t)."""
+
+    def __init__(self, context, th_id: int, vp_id: int = 0,
+                 vp_local_id: int = 0) -> None:
+        self.context = context
+        self.th_id = th_id
+        self.vp_id = vp_id
+        self.vp_local_id = vp_local_id  # position within the VP's stream list
+        self.next_task: Optional[Task] = None   # scheduler-bypass slot
+        self.sched_obj: Any = None               # scheduler-private queues
+        self.rnd_seed = (th_id * 2654435761) & 0xFFFFFFFF
+        self.profiling_stream = None
+        self.nb_tasks_executed = 0
+
+    @property
+    def virtual_process(self):
+        return self.context.vps[self.vp_id]
+
+    def rand(self) -> int:
+        # xorshift for scheduler tie-breaks / steal targets
+        x = self.rnd_seed or 0x9E3779B9
+        x ^= (x << 13) & 0xFFFFFFFF
+        x ^= x >> 17
+        x ^= (x << 5) & 0xFFFFFFFF
+        self.rnd_seed = x
+        return x
+
+
+def schedule(es: ExecutionStream, tasks: List[Task], distance: int = 0) -> None:
+    """ref: __parsec_schedule (scheduling.c:284-328) — hand a ring of ready
+    tasks to the scheduler module; paranoid checks that every task really is
+    ready (all input refs fulfilled)."""
+    if not tasks:
+        return
+    ctx = es.context
+    if __debug__:
+        for t in tasks:
+            assert t.status in (TaskStatus.NONE, TaskStatus.PREPARE_INPUT), \
+                f"scheduling task {t.snprintf()} in state {t.status}"
+    PINS(es, PinsEvent.SCHEDULE_BEGIN, tasks)
+    ctx.scheduler.schedule(es, tasks, distance)
+    PINS(es, PinsEvent.SCHEDULE_END, tasks)
+    ctx.wake_workers(len(tasks))
+
+
+def schedule_keep_best(es: ExecutionStream, tasks: List[Task], distance: int = 0) -> None:
+    """Keep the highest-priority freshly-enabled task on the releasing thread
+    (es.next_task) and hand the rest to the scheduler
+    (ref: scheduling.c:610-615, parsec_internal.h:463-470)."""
+    if not tasks:
+        return
+    if es.context.keep_highest_priority_task and es.next_task is None:
+        best = max(range(len(tasks)), key=lambda i: tasks[i].priority)
+        es.next_task = tasks.pop(best)
+    schedule(es, tasks, distance)
+
+
+def execute(es: ExecutionStream, task: Task) -> HookReturn:
+    """ref: __parsec_execute (scheduling.c:124-203) — walk incarnations by
+    chore mask; evaluate() may veto a chore; the first willing hook runs."""
+    tc = task.task_class
+    task.status = TaskStatus.HOOK
+    PINS(es, PinsEvent.EXEC_BEGIN, task)
+    try:
+        for idx in tc.chore_order():
+            chore = tc.incarnations[idx]
+            if not (task.chore_mask & (1 << idx)):
+                continue
+            if chore.evaluate is not None and not chore.evaluate(task):
+                continue
+            task.selected_chore = idx
+            rc = chore.hook(es, task)
+            if rc == HookReturn.NEXT:
+                task.chore_mask &= ~(1 << idx)
+                continue
+            if rc == HookReturn.DISABLE:
+                task.chore_mask &= ~(1 << idx)
+                continue
+            return rc
+        plog.warning("task %s has no eligible chore left", task.snprintf())
+        return HookReturn.ERROR
+    finally:
+        PINS(es, PinsEvent.EXEC_END, task)
+
+
+def complete_execution(es: ExecutionStream, task: Task) -> None:
+    """ref: __parsec_complete_execution (scheduling.c:439-468)."""
+    tc = task.task_class
+    task.status = TaskStatus.COMPLETE
+    PINS(es, PinsEvent.COMPLETE_EXEC_BEGIN, task)
+    if tc.prepare_output is not None:
+        tc.prepare_output(es, task)
+    if tc.complete_execution is not None:
+        tc.complete_execution(es, task)
+    if tc.release_deps is not None:
+        PINS(es, PinsEvent.RELEASE_DEPS_BEGIN, task)
+        ready = tc.release_deps(es, task, ACTION_RELEASE_ALL)
+        PINS(es, PinsEvent.RELEASE_DEPS_END, task)
+    else:
+        ready = []
+    es.nb_tasks_executed += 1
+    tp = task.taskpool
+    if tc.release_task is not None:
+        tc.release_task(es, task)
+    tp.task_completed()
+    if ready:
+        schedule_keep_best(es, list(ready))
+    PINS(es, PinsEvent.COMPLETE_EXEC_END, task)
+
+
+def task_progress(es: ExecutionStream, task: Task, distance: int = 0) -> None:
+    """ref: __parsec_task_progress (scheduling.c:470-533)."""
+    tc = task.task_class
+    if task.status < TaskStatus.PREPARE_INPUT:
+        task.status = TaskStatus.PREPARE_INPUT
+        if tc.prepare_input is not None:
+            PINS(es, PinsEvent.PREPARE_INPUT_BEGIN, task)
+            rc = tc.prepare_input(es, task)
+            PINS(es, PinsEvent.PREPARE_INPUT_END, task)
+            if rc == HookReturn.ASYNC:
+                return  # a future/stage-in will reschedule the task
+            if rc == HookReturn.AGAIN:
+                schedule(es, [task], distance + 1)
+                return
+            assert rc == HookReturn.DONE, f"prepare_input returned {rc}"
+    rc = execute(es, task)
+    if rc == HookReturn.DONE:
+        complete_execution(es, task)
+    elif rc == HookReturn.ASYNC:
+        pass  # device module owns completion now (SURVEY.md §3.4)
+    elif rc == HookReturn.AGAIN:
+        task.status = TaskStatus.PREPARE_INPUT
+        schedule(es, [task], distance + 1)
+    else:
+        plog.fatal("task %s execution failed (rc=%s)", task.snprintf(), rc)
+
+
+class _Backoff:
+    """Exponential idle backoff (ref: scheduling.c idle loop + utils/backoff)."""
+
+    __slots__ = ("misses",)
+    MAX_SLEEP = 2e-3
+
+    def __init__(self) -> None:
+        self.misses = 0
+
+    def hit(self) -> None:
+        self.misses = 0
+
+    def miss(self, context) -> None:
+        self.misses += 1
+        if self.misses < 4:
+            return  # spin
+        sleep = min(1e-5 * (1 << min(self.misses - 4, 8)), self.MAX_SLEEP)
+        context.park(sleep)
+
+
+def context_wait_loop(es: ExecutionStream) -> None:
+    """The worker main loop (ref: __parsec_context_wait scheduling.c:535-666).
+
+    Runs until the context signals completion of all active taskpools.
+    Idle cycles progress device managers and the communication engine.
+    """
+    ctx = es.context
+    backoff = _Backoff()
+    while not ctx.all_tasks_done():
+        task = es.next_task
+        es.next_task = None
+        if task is None:
+            PINS(es, PinsEvent.SELECT_BEGIN, None)
+            task = ctx.scheduler.select(es)
+            PINS(es, PinsEvent.SELECT_END, task)
+        try:
+            if task is not None:
+                backoff.hit()
+                task_progress(es, task)
+                continue
+            progressed = ctx.progress_engines(es)
+        except BaseException as exc:  # a task body blew up: abort the DAG,
+            ctx.record_task_error(exc, task)  # don't silently kill the worker
+            continue
+        if progressed:
+            backoff.hit()
+        else:
+            backoff.miss(ctx)

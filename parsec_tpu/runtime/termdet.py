@@ -1,0 +1,175 @@
+"""Termination detection modules (MCA framework ``termdet``).
+
+Reference behavior: every taskpool gets a termination-detector monitor that
+counts known tasks + pending runtime actions and fires the completion
+callback when both are provably zero. Modules: ``local`` (single atomic
+counter, ref: parsec/mca/termdet/local/termdet_local_module.c, 243 LoC) and
+``fourcounter`` (distributed credit algorithm over the comm engine,
+ref: parsec/mca/termdet/fourcounter/termdet_fourcounter_module.c, 706 LoC);
+interface parsec/mca/termdet/termdet.h:42-296.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional
+
+
+class TermDet:
+    """Monitor interface (ref: parsec_termdet_module_t)."""
+
+    name = "base"
+
+    def __init__(self, taskpool) -> None:
+        self.taskpool = taskpool
+
+    def taskpool_addto_nb_tasks(self, delta: int) -> int:
+        raise NotImplementedError
+
+    def taskpool_addto_runtime_actions(self, delta: int) -> int:
+        raise NotImplementedError
+
+    def taskpool_set_nb_tasks(self, n: int) -> int:
+        raise NotImplementedError
+
+    def taskpool_ready(self) -> None:
+        """Monitoring starts: zero counts before ready() do not terminate."""
+        raise NotImplementedError
+
+
+class LocalTermDet(TermDet):
+    """Single-process counting detector (ref: termdet_local_module.c).
+
+    Termination when (nb_tasks == 0 and runtime_actions == 0) after the
+    taskpool was declared ready. ``UNDEFINED_NB_TASKS`` semantics: DTD-style
+    pools that don't know their total keep a live insertion count.
+    """
+
+    name = "local"
+
+    def __init__(self, taskpool) -> None:
+        super().__init__(taskpool)
+        self._lock = threading.Lock()
+        self.nb_tasks = 0
+        self.runtime_actions = 0
+        self._ready = False
+        self._terminated = False
+
+    def _check(self) -> None:
+        fire = False
+        with self._lock:
+            if (self._ready and not self._terminated
+                    and self.nb_tasks == 0 and self.runtime_actions == 0):
+                self._terminated = True
+                fire = True
+        if fire:
+            self.taskpool.termination_detected()
+
+    def taskpool_addto_nb_tasks(self, delta: int) -> int:
+        with self._lock:
+            self.nb_tasks += delta
+            v = self.nb_tasks
+            assert v >= 0, "nb_tasks went negative"
+        if v == 0:
+            self._check()
+        return v
+
+    def taskpool_addto_runtime_actions(self, delta: int) -> int:
+        with self._lock:
+            self.runtime_actions += delta
+            v = self.runtime_actions
+            assert v >= 0, "runtime_actions went negative"
+        if v == 0:
+            self._check()
+        return v
+
+    def taskpool_set_nb_tasks(self, n: int) -> int:
+        with self._lock:
+            self.nb_tasks = n
+        if n == 0:
+            self._check()
+        return n
+
+    def taskpool_ready(self) -> None:
+        with self._lock:
+            self._ready = True
+        self._check()
+
+
+class UserTriggerTermDet(LocalTermDet):
+    """User-declared completion (ref: termdet user_trigger module)."""
+
+    name = "user_trigger"
+
+    def __init__(self, taskpool) -> None:
+        super().__init__(taskpool)
+        self.nb_tasks = 1  # held until the user triggers
+
+    def user_trigger(self) -> None:
+        self.taskpool_addto_nb_tasks(-1)
+
+
+class FourCounterTermDet(LocalTermDet):
+    """Distributed 4-counter credit termination detection.
+
+    ref: termdet_fourcounter_module.c — each rank tracks (sent, received)
+    message counts plus local activity; rank 0 aggregates waves of
+    (total_sent, total_received) and declares termination after two
+    consistent waves. Here the wave runs over the comm engine's AM channel;
+    single-rank degenerates to local counting.
+    """
+
+    name = "fourcounter"
+
+    def __init__(self, taskpool, comm=None) -> None:
+        super().__init__(taskpool)
+        self.comm = comm
+        self.msgs_sent = 0
+        self.msgs_received = 0
+        self._last_wave: Optional[tuple] = None
+
+    def msg_sent(self) -> None:
+        with self._lock:
+            self.msgs_sent += 1
+
+    def msg_received(self) -> None:
+        with self._lock:
+            self.msgs_received += 1
+
+    def _locally_quiet(self) -> bool:
+        return self._ready and self.nb_tasks == 0 and self.runtime_actions == 0
+
+    def local_counts(self) -> tuple:
+        with self._lock:
+            return (self.msgs_sent, self.msgs_received, self._locally_quiet())
+
+    # rank 0 drives waves through comm.termdet_wave(); see comm/remote_dep.py
+    def _check(self) -> None:
+        if self.comm is None or self.comm.nb_ranks <= 1:
+            super()._check()
+            return
+        if self._locally_quiet():
+            self.comm.termdet_local_quiet(self)
+
+    def distributed_terminate(self) -> None:
+        fire = False
+        with self._lock:
+            if not self._terminated:
+                self._terminated = True
+                fire = True
+        if fire:
+            self.taskpool.termination_detected()
+
+
+_MODULES: Dict[str, Any] = {
+    "local": LocalTermDet,
+    "user_trigger": UserTriggerTermDet,
+    "fourcounter": FourCounterTermDet,
+}
+
+
+def termdet_new(name: str, taskpool, **kw) -> TermDet:
+    try:
+        cls = _MODULES[name]
+    except KeyError:
+        raise ValueError(f"unknown termdet module {name!r}; have {sorted(_MODULES)}")
+    return cls(taskpool, **kw)

@@ -1,0 +1,75 @@
+"""Virtual-process map: work-stealing domains and thread layout.
+
+Reference behavior: virtual processes partition compute threads into
+work-stealing domains; layouts come from flat/hwloc/file/parameters init
+(ref: parsec/vpmap.c, parsec/parsec.c:549-592). Thread→core binding is in
+parsec/bindthread.c. On the TPU host we default to one flat VP (hwloc
+binding is a no-op under the Python threading model; a later C++ executor
+can bind).
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+
+class VPMap:
+    """nb_vp virtual processes, each with nb_threads[i] workers."""
+
+    def __init__(self, nb_threads_per_vp: List[int]) -> None:
+        assert nb_threads_per_vp and all(n > 0 for n in nb_threads_per_vp)
+        self.nb_threads_per_vp = nb_threads_per_vp
+
+    @property
+    def nb_vp(self) -> int:
+        return len(self.nb_threads_per_vp)
+
+    @property
+    def nb_total_threads(self) -> int:
+        return sum(self.nb_threads_per_vp)
+
+    def vp_of_thread(self, th_id: int) -> int:
+        acc = 0
+        for vp, n in enumerate(self.nb_threads_per_vp):
+            acc += n
+            if th_id < acc:
+                return vp
+        raise IndexError(th_id)
+
+    @staticmethod
+    def from_flat(nb_cores: int) -> "VPMap":
+        """ref: vpmap_init_from_flat — one VP with all threads."""
+        return VPMap([max(1, nb_cores)])
+
+    @staticmethod
+    def from_parameters(nb_vp: int, threads_per_vp: int) -> "VPMap":
+        return VPMap([threads_per_vp] * nb_vp)
+
+    @staticmethod
+    def from_file(path: str) -> "VPMap":
+        """One line per VP: number of threads (ref: vpmap_init_from_file)."""
+        counts = []
+        with open(path) as fh:
+            for line in fh:
+                line = line.split("#")[0].strip()
+                if line:
+                    counts.append(int(line))
+        if not counts:
+            raise ValueError(f"vpmap file {path} defines no virtual process")
+        return VPMap(counts)
+
+
+class VirtualProcess:
+    """ref: parsec_vp_t — holds this domain's execution streams."""
+
+    def __init__(self, vp_id: int, nb_threads: int) -> None:
+        self.vp_id = vp_id
+        self.nb_threads = nb_threads
+        self.execution_streams: List = []
+
+
+def default_nb_cores() -> int:
+    env = os.environ.get("PARSEC_NB_CORES")
+    if env:
+        return max(1, int(env))
+    return max(1, os.cpu_count() or 1)

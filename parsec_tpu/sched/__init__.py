@@ -1,0 +1,34 @@
+"""Scheduler MCA framework: module registry + selection.
+
+ref: mca_components_open_bytype / parsec_set_scheduler
+(parsec/scheduling.c:246-272, parsec/mca/mca_repository.c).
+"""
+from __future__ import annotations
+
+from typing import Dict, Type
+
+from .base import SchedulerModule
+from .modules import (APScheduler, GDScheduler, IPScheduler, LFQScheduler,
+                      LHQScheduler, LLScheduler, LTQScheduler, PBQScheduler,
+                      RNDScheduler, SPQScheduler)
+
+_REGISTRY: Dict[str, Type[SchedulerModule]] = {
+    cls.name: cls for cls in (
+        LFQScheduler, LHQScheduler, LTQScheduler, LLScheduler, GDScheduler,
+        APScheduler, IPScheduler, SPQScheduler, PBQScheduler, RNDScheduler)
+}
+
+
+def sched_new(name: str) -> SchedulerModule:
+    try:
+        return _REGISTRY[name]()
+    except KeyError:
+        raise ValueError(f"unknown scheduler {name!r}; have {sorted(_REGISTRY)}")
+
+
+def sched_register(cls: Type[SchedulerModule]) -> None:
+    _REGISTRY[cls.name] = cls
+
+
+def available() -> list:
+    return sorted(_REGISTRY)

@@ -1,0 +1,35 @@
+"""Scheduler module interface (MCA framework ``sched``).
+
+Reference behavior: pluggable policy modules with
+``{install, flow_init(per-ES), schedule(es, ring, distance), select(es),
+remove}`` (ref: parsec/mca/sched/sched.h;
+parsec/mca/sched/lfq/sched_lfq_module.c:39-49), selected at runtime by MCA
+parameter ``sched`` (default lfq).
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+
+class SchedulerModule:
+    name = "base"
+
+    def install(self, context) -> None:
+        self.context = context
+
+    def flow_init(self, es) -> None:
+        """Set up per-execution-stream queues (es.sched_obj)."""
+
+    def schedule(self, es, tasks: List, distance: int = 0) -> None:
+        raise NotImplementedError
+
+    def select(self, es) -> Optional[Any]:
+        raise NotImplementedError
+
+    def remove(self, context) -> None:
+        for es in context.execution_streams:
+            es.sched_obj = None
+
+    # PAPI-SDE-style introspection (ref: sched_lfq_module.c:141-151)
+    def pending_tasks(self, context) -> int:
+        return -1

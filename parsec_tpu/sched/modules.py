@@ -1,0 +1,271 @@
+"""The ten scheduler policy modules.
+
+Reference inventory (SURVEY.md §2.3): lfq, lhq, ltq, ll, gd, ap, ip, spq,
+pbq, rnd. Policies are reproduced semantically:
+
+- lfq  — per-thread bounded hbbuffer + NUMA-neighbor steal chain + global
+         system dequeue (ref: parsec/mca/sched/lfq/sched_lfq_module.c:59-199)
+- lhq  — hierarchical (two-level: per-thread then per-VP) buffers
+- ltq  — tree queues: steal order follows a binary-tree walk of thread ids
+- ll   — per-thread LIFO, steal from others (ref: sched/ll)
+- gd   — one global dequeue (ref: sched/gd)
+- ap   — global priority list, pop-front (ref: sched_ap_module.c:93-112)
+- ip   — same list, pop-back (ref: sched_ip_module.c:88-108)
+- spq  — shared priority queue with per-priority sublists (ref: sched_spq)
+- pbq  — priority-based local queues + system queue (ref: sched/pbq)
+- rnd  — random placement in a global list (baseline/debug, ref: sched/rnd)
+
+On the TPU host there is no NUMA topology worth modeling (single package);
+the steal *order* is preserved (ring / hierarchy / tree) which is what the
+policies actually encode.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from ..core.hbbuffer import HBBuffer
+from ..core.lists import Dequeue, Lifo, OrderedList
+from .base import SchedulerModule
+
+
+def _prio(t) -> int:
+    return t.priority
+
+
+class LFQScheduler(SchedulerModule):
+    """Local flat queues + steal ring + system dequeue."""
+
+    name = "lfq"
+    BUFSIZE = 64
+
+    def install(self, context) -> None:
+        super().install(context)
+        self.system_queue = Dequeue()
+
+    def flow_init(self, es) -> None:
+        def spill(items, distance):
+            self.system_queue.push_back_chain(items)
+        es.sched_obj = HBBuffer(self.BUFSIZE, spill, _prio)
+
+    def schedule(self, es, tasks: List, distance: int = 0) -> None:
+        if distance > 0:
+            self.system_queue.push_back_chain(tasks)
+        else:
+            es.sched_obj.push_all(tasks, distance)
+
+    def select(self, es) -> Optional[Any]:
+        t = es.sched_obj.pop_best()
+        if t is not None:
+            return t
+        # steal ring within the VP, then the system queue
+        vp = es.virtual_process
+        n = len(vp.execution_streams)
+        for k in range(1, n):
+            peer = vp.execution_streams[(es.vp_local_id + k) % n]
+            if peer.sched_obj is not None:
+                t = peer.sched_obj.pop_best()
+                if t is not None:
+                    return t
+        return self.system_queue.pop_front()
+
+    def pending_tasks(self, context) -> int:
+        n = len(self.system_queue)
+        for es in context.execution_streams:
+            if es.sched_obj is not None:
+                n += len(es.sched_obj)
+        return n
+
+
+class LHQScheduler(LFQScheduler):
+    """Local hierarchical queues: thread buffer → VP buffer → system."""
+
+    name = "lhq"
+
+    def install(self, context) -> None:
+        super().install(context)
+        self._vp_queues = {vp.vp_id: Dequeue() for vp in context.vps}
+
+    def flow_init(self, es) -> None:
+        vpq = self._vp_queues[es.vp_id]
+
+        def spill(items, distance):
+            if distance <= 1:
+                vpq.push_back_chain(items)
+            else:
+                self.system_queue.push_back_chain(items)
+        es.sched_obj = HBBuffer(self.BUFSIZE, spill, _prio)
+
+    def select(self, es) -> Optional[Any]:
+        t = es.sched_obj.pop_best()
+        if t is not None:
+            return t
+        t = self._vp_queues[es.vp_id].pop_front()
+        if t is not None:
+            return t
+        for vp_id, q in self._vp_queues.items():
+            if vp_id != es.vp_id:
+                t = q.pop_front()
+                if t is not None:
+                    return t
+        return self.system_queue.pop_front()
+
+
+class LTQScheduler(LFQScheduler):
+    """Local tree queues: steal order follows a binary tree of thread ids."""
+
+    name = "ltq"
+
+    def select(self, es) -> Optional[Any]:
+        t = es.sched_obj.pop_best()
+        if t is not None:
+            return t
+        vp = es.virtual_process
+        n = len(vp.execution_streams)
+        order = []
+        # walk: children first (2i+1, 2i+2), then parent, then the rest
+        base = es.vp_local_id
+        for c in (2 * base + 1, 2 * base + 2, (base - 1) // 2 if base else None):
+            if c is not None and 0 <= c < n and c != base:
+                order.append(c)
+        order += [k for k in range(n) if k != base and k not in order]
+        for k in order:
+            peer = vp.execution_streams[k]
+            if peer.sched_obj is not None:
+                t = peer.sched_obj.pop_best()
+                if t is not None:
+                    return t
+        return self.system_queue.pop_front()
+
+
+class LLScheduler(SchedulerModule):
+    """Per-thread LIFO with stealing."""
+
+    name = "ll"
+
+    def install(self, context) -> None:
+        super().install(context)
+
+    def flow_init(self, es) -> None:
+        es.sched_obj = Lifo()
+
+    def schedule(self, es, tasks: List, distance: int = 0) -> None:
+        es.sched_obj.push_chain(tasks)
+
+    def select(self, es) -> Optional[Any]:
+        t = es.sched_obj.pop()
+        if t is not None:
+            return t
+        streams = self.context.execution_streams
+        n = len(streams)
+        start = es.rand() % n
+        for k in range(n):
+            peer = streams[(start + k) % n]
+            if peer is not es and peer.sched_obj is not None:
+                t = peer.sched_obj.pop()
+                if t is not None:
+                    return t
+        return None
+
+    def pending_tasks(self, context) -> int:
+        return sum(len(es.sched_obj) for es in context.execution_streams
+                   if es.sched_obj is not None)
+
+
+class GDScheduler(SchedulerModule):
+    """Single global dequeue."""
+
+    name = "gd"
+
+    def install(self, context) -> None:
+        super().install(context)
+        self.queue = Dequeue()
+
+    def schedule(self, es, tasks: List, distance: int = 0) -> None:
+        if distance > 0:
+            self.queue.push_back_chain(tasks)
+        else:
+            self.queue.push_front_chain(tasks)
+
+    def select(self, es) -> Optional[Any]:
+        return self.queue.pop_front()
+
+    def pending_tasks(self, context) -> int:
+        return len(self.queue)
+
+
+class APScheduler(SchedulerModule):
+    """Absolute priority: global sorted list, pop the best."""
+
+    name = "ap"
+
+    def install(self, context) -> None:
+        super().install(context)
+        self.list = OrderedList()
+
+    def schedule(self, es, tasks: List, distance: int = 0) -> None:
+        self.list.push_sorted_chain(tasks, _prio)
+
+    def select(self, es) -> Optional[Any]:
+        return self.list.pop_front()
+
+    def pending_tasks(self, context) -> int:
+        return len(self.list)
+
+
+class IPScheduler(APScheduler):
+    """Inverse priority: same sorted list, pop the worst."""
+
+    name = "ip"
+
+    def select(self, es) -> Optional[Any]:
+        return self.list.pop_back()
+
+
+class SPQScheduler(APScheduler):
+    """Shared priority queue (list of per-priority sublists; same observable
+    order as the sorted list: priority desc, FIFO within)."""
+
+    name = "spq"
+
+
+class PBQScheduler(LFQScheduler):
+    """Priority-based local queues + system queue: like lfq but local pushes
+    that carry distance>0 target the *next* thread's buffer (round-robin
+    placement hint preserved from the reference)."""
+
+    name = "pbq"
+
+    def schedule(self, es, tasks: List, distance: int = 0) -> None:
+        if distance == 0:
+            es.sched_obj.push_all(tasks, 0)
+            return
+        vp = es.virtual_process
+        peer = vp.execution_streams[(es.vp_local_id + distance) % len(vp.execution_streams)]
+        (peer.sched_obj or es.sched_obj).push_all(tasks, 0)
+
+
+class RNDScheduler(SchedulerModule):
+    """Random pick from a global list."""
+
+    name = "rnd"
+
+    def install(self, context) -> None:
+        super().install(context)
+        self._items: List = []
+        import threading
+        self._lock = threading.Lock()
+
+    def schedule(self, es, tasks: List, distance: int = 0) -> None:
+        with self._lock:
+            self._items.extend(tasks)
+
+    def select(self, es) -> Optional[Any]:
+        with self._lock:
+            if not self._items:
+                return None
+            idx = es.rand() % len(self._items)
+            self._items[idx], self._items[-1] = self._items[-1], self._items[idx]
+            return self._items.pop()
+
+    def pending_tasks(self, context) -> int:
+        return len(self._items)

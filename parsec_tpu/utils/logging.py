@@ -1,0 +1,97 @@
+"""Verbosity-leveled debug output streams.
+
+Reference behavior: ``parsec_debug_verbose(level, stream, fmt...)`` with
+per-subsystem output streams and global verbosity, plus warning/inform/fatal
+helpers (ref: parsec/utils/debug.c, output.c; SURVEY.md §5.5).
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Dict, Optional
+
+_lock = threading.Lock()
+_streams: Dict[str, "OutputStream"] = {}
+_t0 = time.monotonic()
+
+
+class OutputStream:
+    """A named, verbosity-gated output stream."""
+
+    def __init__(self, name: str, verbosity: int = 0, fh=None) -> None:
+        self.name = name
+        self.verbosity = verbosity
+        self.fh = fh or sys.stderr
+
+    def verbose(self, level: int, msg: str, *args) -> None:
+        if level <= self.verbosity:
+            if args:
+                msg = msg % args
+            ts = time.monotonic() - _t0
+            with _lock:
+                self.fh.write(f"[{ts:10.6f}][{self.name}] {msg}\n")
+                self.fh.flush()
+
+
+def output_stream(name: str, verbosity: Optional[int] = None) -> OutputStream:
+    with _lock:
+        st = _streams.get(name)
+        if st is None:
+            env = os.environ.get(f"PARSEC_DEBUG_{name.upper()}")
+            default = int(env) if env else _default_verbosity()
+            st = OutputStream(name, verbosity=default)
+            _streams[name] = st
+        if verbosity is not None:
+            st.verbosity = verbosity
+        return st
+
+
+def _default_verbosity() -> int:
+    try:
+        return int(os.environ.get("PARSEC_DEBUG_VERBOSE", "0"))
+    except ValueError:
+        return 0
+
+
+#: the default debug stream, analogous to parsec_debug_output
+debug = output_stream("debug")
+comm_stream = output_stream("comm")
+sched_stream = output_stream("sched")
+device_stream = output_stream("device")
+
+
+def set_verbosity(level: int, stream: Optional[str] = None) -> None:
+    with _lock:
+        if stream is None:
+            for st in _streams.values():
+                st.verbosity = level
+        elif stream in _streams:
+            _streams[stream].verbosity = level
+
+
+def debug_verbose(level: int, stream: OutputStream, msg: str, *args) -> None:
+    stream.verbose(level, msg, *args)
+
+
+def warning(msg: str, *args) -> None:
+    if args:
+        msg = msg % args
+    sys.stderr.write(f"parsec_tpu: WARNING: {msg}\n")
+
+
+def inform(msg: str, *args) -> None:
+    if args:
+        msg = msg % args
+    sys.stderr.write(f"parsec_tpu: {msg}\n")
+
+
+class FatalError(RuntimeError):
+    pass
+
+
+def fatal(msg: str, *args) -> None:
+    if args:
+        msg = msg % args
+    raise FatalError(msg)

@@ -1,0 +1,216 @@
+"""MCA-style layered configuration parameters.
+
+Reference behavior reproduced: PaRSEC registers typed, named parameters per
+subsystem and resolves them from (in priority order) command line
+``--mca name value``, environment ``PARSEC_MCA_<name>``, per-user/system config
+files, and compiled defaults (ref: parsec/utils/mca_param.c, SURVEY.md §5.6).
+
+This is the TPU-native re-design: a small registry with the same resolution
+order; no libc, the config file format is ``name = value`` lines.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+_ENV_PREFIX = "PARSEC_MCA_"
+_lock = threading.RLock()
+
+
+@dataclass
+class Param:
+    name: str
+    type: str  # "int" | "string" | "sizet" | "bool"
+    default: Any
+    help: str = ""
+    # resolution cache
+    _value: Any = None
+    _source: str = "default"
+    _resolved: bool = False
+
+    def _coerce(self, raw: Any) -> Any:
+        if self.type == "int":
+            return int(raw)
+        if self.type == "sizet":
+            v = int(str(raw), 0)
+            if v < 0:
+                raise ValueError(f"sizet param {self.name} must be >= 0, got {v}")
+            return v
+        if self.type == "bool":
+            if isinstance(raw, bool):
+                return raw
+            return str(raw).strip().lower() in ("1", "true", "yes", "on")
+        return str(raw)
+
+
+class ParamRegistry:
+    """Registry of MCA parameters with layered resolution."""
+
+    def __init__(self) -> None:
+        self._params: Dict[str, Param] = {}
+        self._cmdline: Dict[str, str] = {}
+        self._file_values: Dict[str, str] = {}
+        self._files_loaded = False
+
+    # -- registration ------------------------------------------------------
+    def register(self, name: str, type: str, default: Any, help: str = "") -> Param:
+        with _lock:
+            p = self._params.get(name)
+            if p is None:
+                p = Param(name=name, type=type, default=default, help=help)
+                self._params[name] = p
+            return p
+
+    def reg_int(self, name: str, default: int, help: str = "") -> Param:
+        return self.register(name, "int", default, help)
+
+    def reg_sizet(self, name: str, default: int, help: str = "") -> Param:
+        return self.register(name, "sizet", default, help)
+
+    def reg_string(self, name: str, default: Optional[str], help: str = "") -> Param:
+        return self.register(name, "string", default, help)
+
+    def reg_bool(self, name: str, default: bool, help: str = "") -> Param:
+        return self.register(name, "bool", default, help)
+
+    # -- external value sources -------------------------------------------
+    def set_cmdline(self, name: str, value: str) -> None:
+        with _lock:
+            self._cmdline[name] = value
+            p = self._params.get(name)
+            if p is not None:
+                p._resolved = False
+
+    def parse_argv(self, argv: List[str]) -> List[str]:
+        """Consume ``--mca name value`` / ``--parsec name=value`` pairs.
+
+        Returns argv with consumed options removed (ref: parsec/parsec.c:418-454).
+        """
+        out: List[str] = []
+        i = 0
+        while i < len(argv):
+            a = argv[i]
+            if a == "--mca":
+                if i + 2 > len(argv) - 1:
+                    raise ValueError("--mca requires <name> <value>")
+                self.set_cmdline(argv[i + 1], argv[i + 2])
+                i += 3
+                continue
+            if a.startswith("--mca="):
+                body = a[len("--mca="):]
+                if "=" not in body:
+                    raise ValueError("--mca=<name>=<value> expected")
+                k, v = body.split("=", 1)
+                self.set_cmdline(k, v)
+                i += 1
+                continue
+            if a == "--parsec" and i + 1 < len(argv):
+                body = argv[i + 1]
+                if "=" in body:
+                    k, v = body.split("=", 1)
+                    self.set_cmdline(k, v)
+                i += 2
+                continue
+            out.append(a)
+            i += 1
+        return out
+
+    def _load_files(self) -> None:
+        if self._files_loaded:
+            return
+        self._files_loaded = True
+        paths = []
+        sysconf = os.environ.get("PARSEC_SYSCONF_PARAMS")
+        if sysconf:
+            paths.append(sysconf)
+        home = os.path.expanduser("~/.parsec/mca-params.conf")
+        paths.append(home)
+        for path in paths:
+            try:
+                with open(path) as fh:
+                    for line in fh:
+                        line = line.strip()
+                        if not line or line.startswith("#"):
+                            continue
+                        if "=" in line:
+                            k, v = line.split("=", 1)
+                            self._file_values[k.strip()] = v.strip()
+            except OSError:
+                continue
+
+    # -- resolution --------------------------------------------------------
+    def get(self, name: str) -> Any:
+        with _lock:
+            p = self._params.get(name)
+            if p is None:
+                raise KeyError(f"unknown MCA parameter: {name}")
+            if p._resolved:
+                return p._value
+            self._load_files()
+            if name in self._cmdline:
+                p._value, p._source = p._coerce(self._cmdline[name]), "cmdline"
+            elif _ENV_PREFIX + name in os.environ:
+                p._value, p._source = p._coerce(os.environ[_ENV_PREFIX + name]), "env"
+            elif name in self._file_values:
+                p._value, p._source = p._coerce(self._file_values[name]), "file"
+            else:
+                p._value, p._source = p.default, "default"
+            p._resolved = True
+            return p._value
+
+    def source(self, name: str) -> str:
+        self.get(name)
+        return self._params[name]._source
+
+    def get_or(self, name: str, type: str, default: Any) -> Any:
+        with _lock:
+            if name not in self._params:
+                self.register(name, type, default)
+            return self.get(name)
+
+    def dump(self) -> Dict[str, Any]:
+        return {n: self.get(n) for n in sorted(self._params)}
+
+    def reset(self) -> None:
+        """Test helper: clear caches so env changes are re-read."""
+        with _lock:
+            self._cmdline.clear()
+            self._file_values.clear()
+            self._files_loaded = False
+            for p in self._params.values():
+                p._resolved = False
+
+
+#: process-wide registry (mirrors the global MCA repository)
+params = ParamRegistry()
+
+
+def register_core_params() -> None:
+    """Default knobs carried over from the reference (SURVEY.md §5.6)."""
+    params.reg_string("sched", "lfq", "scheduler module to use")
+    params.reg_int("dtd_window_size", 8000, "DTD sliding window size")
+    params.reg_int("dtd_threshold_size", 4000, "DTD backpressure resume threshold")
+    params.reg_string("runtime_comm_coll_bcast", "binomial",
+                      "broadcast topology: star|chain|binomial")
+    params.reg_sizet("runtime_comm_short_limit", 4096,
+                     "max payload inlined in an activate message")
+    params.reg_int("arena_max_used", -1, "cap on arena allocated buffers (-1 off)")
+    params.reg_int("arena_max_cached", -1, "cap on arena cached buffers (-1 off)")
+    params.reg_int("task_startup_iter", 64, "startup enumerator chunk iterations")
+    params.reg_int("task_startup_chunk", 256, "startup enumerator chunk size")
+    params.reg_int("device_load_balance_skew", 20,
+                   "percent skew favoring the device already holding the data")
+    params.reg_bool("runtime_keep_highest_priority_task", True,
+                    "keep best ready task on releasing thread, bypass scheduler")
+    params.reg_int("verbose", 0, "global debug verbosity")
+    params.reg_string("profile", "", "enable profiling; path prefix for traces")
+    params.reg_string("termdet", "local", "termination detection module")
+    params.reg_int("gpu_max_streams", 4, "per-accelerator concurrent exec lanes")
+    params.reg_sizet("tpu_memory_fraction_pct", 85,
+                     "percent of HBM managed by the arena")
+    params.reg_int("comm_max_inflight", 16, "max concurrent gets/puts in comm thread")
+
+
+register_core_params()

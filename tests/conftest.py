@@ -1,0 +1,36 @@
+"""Test configuration: force a virtual 8-device CPU mesh before jax loads.
+
+Multi-chip TPU hardware is not available in CI; sharding and multi-device
+semantics are validated on XLA's host platform with 8 virtual devices
+(the reference's analog: oversubscribed mpiexec on one node, SURVEY.md §4).
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the sandbox's TPU plugin force-prepends itself to jax_platforms; pin the
+# device module to the virtual CPU platform explicitly
+os.environ.setdefault("PARSEC_MCA_device_tpu_platform", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ctx():
+    import parsec_tpu
+    c = parsec_tpu.init(nb_cores=2)
+    yield c
+    c.fini()
+
+
+@pytest.fixture
+def ctx4():
+    import parsec_tpu
+    c = parsec_tpu.init(nb_cores=4)
+    yield c
+    c.fini()

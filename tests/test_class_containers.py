@@ -1,0 +1,205 @@
+"""Container unit tests (mirrors reference tests/class/: lifo, list, hash,
+future, future_datacopy — multithreaded stress of the containers)."""
+import threading
+
+import pytest
+
+from parsec_tpu.core.lists import Dequeue, Fifo, Lifo, OrderedList
+from parsec_tpu.core.hashtable import HashTable
+from parsec_tpu.core.future import CountableFuture, DataCopyFuture, Future
+from parsec_tpu.core.hbbuffer import HBBuffer, MaxHeap
+from parsec_tpu.core.object import Obj
+
+
+def test_lifo_order():
+    q = Lifo()
+    for i in range(10):
+        q.push(i)
+    assert [q.pop() for _ in range(10)] == list(range(9, -1, -1))
+    assert q.pop() is None
+
+
+def test_fifo_order():
+    q = Fifo()
+    q.push_chain(range(10))
+    assert [q.pop() for _ in range(10)] == list(range(10))
+
+
+def test_dequeue_both_ends():
+    q = Dequeue()
+    q.push_back(1)
+    q.push_front(0)
+    q.push_back(2)
+    assert q.pop_front() == 0
+    assert q.pop_back() == 2
+    assert q.pop_front() == 1
+    assert q.pop_front() is None
+
+
+def test_ordered_list_priority_and_fifo_tiebreak():
+    ol = OrderedList()
+    ol.push_sorted("lo", 1)
+    ol.push_sorted("hi", 10)
+    ol.push_sorted("hi2", 10)
+    ol.push_sorted("mid", 5)
+    assert ol.pop_front() == "hi"
+    assert ol.pop_front() == "hi2"  # FIFO within equal priority
+    assert ol.pop_back() == "lo"    # inverse-priority pop
+    assert ol.pop_front() == "mid"
+    assert ol.pop_front() is None
+
+
+def test_lifo_mt_stress():
+    """Multithreaded push/pop conservation (ref: tests/class/lifo.c)."""
+    q = Lifo()
+    N, T = 2000, 4
+    popped = [[] for _ in range(T)]
+
+    def worker(t):
+        for i in range(N):
+            q.push((t, i))
+        while True:
+            item = q.pop()
+            if item is None:
+                break
+            popped[t].append(item)
+
+    ths = [threading.Thread(target=worker, args=(t,)) for t in range(T)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    leftover = []
+    while True:
+        it = q.pop()
+        if it is None:
+            break
+        leftover.append(it)
+    total = sum(len(p) for p in popped) + len(leftover)
+    assert total == N * T
+    allitems = set(leftover)
+    for p in popped:
+        allitems.update(p)
+    assert len(allitems) == N * T  # no duplication, no loss
+
+
+def test_hash_table_basic_and_locked_rmw():
+    h = HashTable()
+    h.insert("a", 1)
+    assert h.find("a") == 1
+    v, created = h.find_or_insert("b", lambda: 2)
+    assert v == 2 and created
+    v, created = h.find_or_insert("b", lambda: 99)
+    assert v == 2 and not created
+    assert h.remove("a") == 1
+    assert h.find("a") is None
+    h.update("c", lambda old: (old or 0) + 5)
+    h.update("c", lambda old: (old or 0) + 5)
+    assert h.find("c") == 10
+    assert len(h) == 2
+
+
+def test_hash_table_mt_find_or_insert():
+    h = HashTable()
+    hits = []
+
+    def worker():
+        for i in range(500):
+            v, created = h.find_or_insert(i % 50, lambda: threading.get_ident())
+            hits.append(v)
+
+    ths = [threading.Thread(target=worker) for _ in range(4)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    assert len(h) == 50
+    # every key resolves to exactly one creator
+    for i in range(50):
+        assert h.find(i) is not None
+
+
+def test_future_basic():
+    f = Future()
+    assert not f.is_ready()
+    seen = []
+    f.on_ready(lambda fut: seen.append(fut.peek()))
+    f.set(42)
+    assert f.is_ready() and f.get() == 42
+    assert seen == [42]
+    f.on_ready(lambda fut: seen.append("late"))
+    assert seen == [42, "late"]
+
+
+def test_countable_future():
+    f = CountableFuture(3)
+    assert not f.contribute()
+    assert not f.contribute()
+    assert f.contribute("done")
+    assert f.get() == "done"
+
+
+def test_datacopy_future_trigger_once():
+    """ref: tests/class/future_datacopy.c — dedup of concurrent triggers."""
+    calls = []
+
+    def conv(spec):
+        calls.append(spec)
+        return spec * 2
+
+    f = DataCopyFuture(spec=21, trigger_cb=conv)
+    results = []
+
+    def worker():
+        results.append(f.get_or_trigger(timeout=5))
+
+    ths = [threading.Thread(target=worker) for _ in range(4)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    assert results == [42] * 4
+    assert len(calls) == 1
+
+
+def test_datacopy_future_chained():
+    inner = DataCopyFuture(spec=5, trigger_cb=lambda s: s + 1)
+    outer = DataCopyFuture(spec=None, trigger_cb=lambda s: inner)
+    assert outer.get_or_trigger(timeout=5) == 6
+
+
+def test_obj_refcount_destructor():
+    destroyed = []
+
+    class MyObj(Obj):
+        def _destruct(self):
+            destroyed.append(True)
+            super()._destruct()
+
+    o = MyObj()
+    o.retain()
+    assert not o.release()
+    assert not destroyed
+    assert o.release()
+    assert destroyed == [True]
+
+
+def test_hbbuffer_spill_keeps_best():
+    spilled = []
+    hb = HBBuffer(2, lambda items, d: spilled.extend(items),
+                  prio_fn=lambda t: t)
+    hb.push_all([5, 1, 9, 3])
+    assert len(hb) == 2
+    assert sorted(spilled) == [1, 3]
+    assert hb.pop_best() == 9
+    assert hb.pop_best() == 5
+
+
+def test_maxheap_split():
+    h = MaxHeap()
+    for i in range(10):
+        h.insert(i, priority=i)
+    assert h.pop_max() == 9
+    stolen = h.split()
+    assert len(stolen) + len(h) == 9
+    assert len(stolen) >= 1

@@ -1,0 +1,119 @@
+"""Data substrate tests: coherency protocol, arenas, data repos.
+
+Mirrors the reference's data.c ownership-transfer semantics
+(parsec_data_transfer_ownership_to_copy, parsec/data.c:286-370).
+"""
+import numpy as np
+import pytest
+
+from parsec_tpu.data.data import (Coherency, Data, DataCopy, FlowAccess,
+                                  data_new_with_payload)
+from parsec_tpu.data.datatype import Datatype, dtt_of_array
+from parsec_tpu.data.arena import Arena
+from parsec_tpu.data.datarepo import DataRepo
+
+
+def test_single_copy_owned():
+    a = np.zeros(4)
+    d = data_new_with_payload(a)
+    c = d.get_copy(0)
+    assert c.coherency == Coherency.OWNED
+    assert c.version == 1
+    assert d.owner_device == 0
+
+
+def test_read_transfer_creates_shared():
+    d = data_new_with_payload(np.arange(4.0))
+    dev_copy = DataCopy(d, 1)
+    d.attach_copy(dev_copy)
+    src = d.start_transfer_ownership(1, FlowAccess.READ)
+    assert src is d.get_copy(0)
+    dev_copy.payload = src.payload.copy()
+    d.complete_transfer_ownership(1, FlowAccess.READ)
+    assert dev_copy.coherency == Coherency.SHARED
+    assert dev_copy.version == 1
+    assert dev_copy.readers == 1
+    # host copy still the owner
+    assert d.get_copy(0).coherency == Coherency.OWNED
+
+
+def test_write_transfer_moves_ownership():
+    d = data_new_with_payload(np.arange(4.0))
+    dev_copy = DataCopy(d, 1)
+    d.attach_copy(dev_copy)
+    src = d.start_transfer_ownership(1, FlowAccess.RW)
+    dev_copy.payload = src.payload.copy()
+    d.complete_transfer_ownership(1, FlowAccess.RW)
+    assert dev_copy.coherency == Coherency.OWNED
+    assert d.owner_device == 1
+    assert d.get_copy(0).coherency == Coherency.SHARED
+    v = d.version_bump(1)
+    assert v == 2
+    assert d.newest_copy() is dev_copy
+
+
+def test_valid_copy_no_transfer_needed():
+    d = data_new_with_payload(np.zeros(2))
+    assert d.start_transfer_ownership(0, FlowAccess.READ) is None
+
+
+def test_newest_copy_after_device_write():
+    d = data_new_with_payload(np.zeros(2))
+    dev = DataCopy(d, 1, payload=np.ones(2))
+    d.attach_copy(dev)
+    d.complete_transfer_ownership(1, FlowAccess.RW)
+    d.version_bump(1)
+    # host now stale: a host reader must pull from device 1
+    src = d.start_transfer_ownership(0, FlowAccess.READ)
+    assert src is dev
+
+
+def test_arena_reuse_and_caps():
+    dtt = Datatype(np.float32, (8, 8))
+    ar = Arena(dtt, max_used=2, max_cached=1)
+    b1 = ar.allocate()
+    b2 = ar.allocate()
+    assert ar.allocate(block=False) is None  # max_used cap
+    ar.free(b1)
+    b3 = ar.allocate()
+    assert b3 is b1  # recycled
+    ar.free(b2)
+    ar.free(b3)
+    assert ar.cached == 1  # max_cached cap
+    assert ar.used == 0
+
+
+def test_arena_backed_copy_recycles_on_release():
+    dtt = Datatype(np.float64, (4,))
+    ar = Arena(dtt, max_used=4, max_cached=4)
+    d = Data()
+    c = ar.new_copy(d)
+    assert ar.used == 1
+    c.release()
+    assert ar.used == 0
+    assert ar.cached == 1
+
+
+def test_datatype_regions():
+    dtt = Datatype(np.float32, (3, 3), region="lower")
+    m = dtt.mask()
+    assert m[2, 0] and m[1, 1] and not m[0, 2]
+    assert dtt.nb_elts == 9
+    full = dtt.contiguous()
+    assert full.mask() is None
+    assert not dtt.compatible_wire(full)
+
+
+def test_datarepo_usage_count_reclaim():
+    repo = DataRepo(nb_flows=2)
+    e = repo.lookup_and_create("k")
+    e.set_output(0, None)
+    repo.entry_addto_usage_limit("k", 2)
+    assert repo.lookup("k") is e
+    repo.entry_used_once("k")
+    assert repo.lookup("k") is e        # one consumer left + producer retain
+    repo.entry_release("k")              # producer done
+    assert repo.lookup("k") is e
+    repo.entry_used_once("k")            # last consumer
+    assert repo.lookup("k") is None
+    assert len(repo) == 0

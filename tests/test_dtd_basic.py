@@ -1,0 +1,235 @@
+"""DTD front-end tests.
+
+Mirrors reference tests/dsl/dtd: task insertion, dependency chaining
+(RAW/WAR/WAW), read fan-out, NEW tiles, window backpressure, multiple
+schedulers (ref: dtd_test_task_insertion.c, dtd_test_war.c, Testings.cmake).
+"""
+import threading
+
+import numpy as np
+import pytest
+
+import parsec_tpu
+from parsec_tpu import dtd
+from parsec_tpu.dsl.dtd import INOUT, INPUT, OUTPUT, VALUE, unpack_args
+
+
+def test_empty_taskpool_completes(ctx):
+    tp = dtd.taskpool_new()
+    ctx.add_taskpool(tp)
+    tp.wait()
+    assert tp.completed
+
+
+def test_single_task_runs(ctx):
+    tp = dtd.taskpool_new()
+    ctx.add_taskpool(tp)
+    ran = []
+
+    def body(es, task):
+        ran.append(task.snprintf())
+
+    tp.insert_task(body)
+    tp.wait()
+    assert len(ran) == 1
+
+
+def test_value_args(ctx):
+    tp = dtd.taskpool_new()
+    ctx.add_taskpool(tp)
+    got = []
+
+    def body(es, task):
+        got.append(unpack_args(task))
+
+    tp.insert_task(body, (7, VALUE), "hello")
+    tp.wait()
+    assert got == [[7, "hello"]]
+
+
+def test_raw_chain_order(ctx):
+    """A chain of INOUT tasks on one tile must serialize in insert order."""
+    tp = dtd.taskpool_new()
+    ctx.add_taskpool(tp)
+    arr = np.zeros(1, dtype=np.int64)
+    tile = tp.tile_of_array(arr)
+    N = 50
+
+    def body(es, task):
+        (a, k) = unpack_args(task)
+        assert a[0] == k, f"task {k} saw {a[0]}"
+        a[0] += 1
+
+    for k in range(N):
+        tp.insert_task(body, (tile, INOUT), (k, VALUE))
+    tp.wait()
+    assert arr[0] == N
+
+
+def test_read_fanout_then_war(ctx4):
+    """Readers run concurrently after a write; next writer waits for all
+    readers (ref: overlap_strategies.c WAR resolution)."""
+    tp = dtd.taskpool_new()
+    ctx4.add_taskpool(tp)
+    arr = np.array([10.0])
+    tile = tp.tile_of_array(arr)
+    reads = []
+    lock = threading.Lock()
+
+    def writer(es, task):
+        (a,) = unpack_args(task)
+        a[0] = 99.0
+
+    def reader(es, task):
+        (a, i) = unpack_args(task)
+        with lock:
+            reads.append((i, a[0]))
+
+    for i in range(8):
+        tp.insert_task(reader, (tile, INPUT), (i, VALUE))
+    tp.insert_task(writer, (tile, INOUT))
+    tp.wait()
+    assert len(reads) == 8
+    # every reader must have seen the pre-write value
+    assert all(v == 10.0 for _, v in reads)
+    assert arr[0] == 99.0
+
+
+def test_two_tile_diamond(ctx):
+    """t1 writes A; t2,t3 read A write B/C; t4 reads B,C."""
+    tp = dtd.taskpool_new()
+    ctx.add_taskpool(tp)
+    A = tp.tile_of_array(np.zeros(1))
+    B = tp.tile_of_array(np.zeros(1))
+    C = tp.tile_of_array(np.zeros(1))
+    out = []
+
+    def t1(es, task):
+        (a,) = unpack_args(task)
+        a[0] = 1.0
+
+    def t2(es, task):
+        a, b = unpack_args(task)
+        b[0] = a[0] + 10
+
+    def t3(es, task):
+        a, c = unpack_args(task)
+        c[0] = a[0] + 20
+
+    def t4(es, task):
+        b, c = unpack_args(task)
+        out.append(b[0] + c[0])
+
+    tp.insert_task(t1, (A, INOUT))
+    tp.insert_task(t2, (A, INPUT), (B, INOUT))
+    tp.insert_task(t3, (A, INPUT), (C, INOUT))
+    tp.insert_task(t4, (B, INPUT), (C, INPUT))
+    tp.wait()
+    assert out == [32.0]
+
+
+def test_new_tile(ctx):
+    tp = dtd.taskpool_new()
+    ctx.add_taskpool(tp)
+    t = tp.tile_new((4,), dtype=np.float64)
+
+    def init(es, task):
+        (a,) = unpack_args(task)
+        a[:] = 3.0
+
+    def check(es, task):
+        (a,) = unpack_args(task)
+        assert np.all(a == 3.0)
+
+    tp.insert_task(init, (t, INOUT))
+    tp.insert_task(check, (t, INPUT))
+    tp.wait()
+
+
+def test_many_independent_tasks_all_run(ctx4):
+    tp = dtd.taskpool_new()
+    ctx4.add_taskpool(tp)
+    counter = [0]
+    lock = threading.Lock()
+
+    def body(es, task):
+        with lock:
+            counter[0] += 1
+
+    for _ in range(500):
+        tp.insert_task(body)
+    tp.wait()
+    assert counter[0] == 500
+
+
+def test_window_backpressure():
+    """Insertion must not grow unbounded past the window (ref:
+    insert_function.c:69-70 window/threshold)."""
+    parsec_tpu.params.reset()
+    ctx = parsec_tpu.init(nb_cores=2)
+    try:
+        tp = dtd.taskpool_new()
+        tp.window_size = 50
+        tp.threshold_size = 25
+        ctx.add_taskpool(tp)
+        tile = tp.tile_of_array(np.zeros(1))
+
+        def body(es, task):
+            (a, _k) = unpack_args(task)
+            a[0] += 1
+
+        for k in range(300):
+            tp.insert_task(body, (tile, INOUT), (k, VALUE))
+            assert tp._outstanding <= 51
+        tp.wait()
+        assert tp._tiles is not None
+    finally:
+        ctx.fini()
+
+
+@pytest.mark.parametrize("sched", ["lfq", "gd", "ap", "ip", "ll", "rnd",
+                                   "spq", "pbq", "ltq", "lhq"])
+def test_all_schedulers_run_dag(sched):
+    """The full DAG correctness across every scheduler module
+    (ref: tests/runtime/sched semantics tests)."""
+    ctx = parsec_tpu.Context(nb_cores=2, scheduler=sched)
+    try:
+        tp = dtd.taskpool_new()
+        ctx.add_taskpool(tp)
+        arr = np.zeros(1)
+        tile = tp.tile_of_array(arr)
+
+        def body(es, task):
+            (a, k) = unpack_args(task)
+            assert a[0] == k
+            a[0] += 1
+
+        for k in range(30):
+            tp.insert_task(body, (tile, INOUT), (k, VALUE))
+        tp.wait()
+        assert arr[0] == 30
+    finally:
+        ctx.fini()
+
+
+def test_flush_and_multiple_taskpools(ctx):
+    tp1 = dtd.taskpool_new("one")
+    tp2 = dtd.taskpool_new("two")
+    ctx.add_taskpool(tp1)
+    ctx.add_taskpool(tp2)
+    a1 = np.zeros(1)
+    a2 = np.zeros(1)
+    t1 = tp1.tile_of_array(a1)
+    t2 = tp2.tile_of_array(a2)
+
+    def inc(es, task):
+        (a,) = unpack_args(task)
+        a[0] += 1
+
+    tp1.insert_task(inc, (t1, INOUT))
+    tp2.insert_task(inc, (t2, INOUT))
+    tp1.data_flush_all()
+    tp2.data_flush_all()
+    tp1.wait()
+    tp2.wait()
+    assert a1[0] == 1 and a2[0] == 1

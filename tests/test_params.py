@@ -1,0 +1,71 @@
+"""MCA parameter system tests (ref: parsec/utils/mca_param.c behavior)."""
+import os
+
+import pytest
+
+from parsec_tpu.utils.params import ParamRegistry
+
+
+@pytest.fixture
+def reg():
+    return ParamRegistry()
+
+
+def test_default_resolution(reg):
+    reg.reg_int("x", 7)
+    assert reg.get("x") == 7
+    assert reg.source("x") == "default"
+
+
+def test_env_overrides_default(reg, monkeypatch):
+    reg.reg_int("window", 100)
+    monkeypatch.setenv("PARSEC_MCA_window", "42")
+    assert reg.get("window") == 42
+    assert reg.source("window") == "env"
+
+
+def test_cmdline_overrides_env(reg, monkeypatch):
+    reg.reg_string("sched", "lfq")
+    monkeypatch.setenv("PARSEC_MCA_sched", "gd")
+    rest = reg.parse_argv(["prog", "--mca", "sched", "ap", "positional"])
+    assert rest == ["prog", "positional"]
+    assert reg.get("sched") == "ap"
+    assert reg.source("sched") == "cmdline"
+
+
+def test_parse_argv_forms(reg):
+    reg.reg_int("a", 0)
+    reg.reg_int("b", 0)
+    rest = reg.parse_argv(["--mca=a=1", "--parsec", "b=2", "keep"])
+    assert rest == ["keep"]
+    assert reg.get("a") == 1 and reg.get("b") == 2
+
+
+def test_typed_coercion(reg, monkeypatch):
+    reg.reg_bool("flag", False)
+    reg.reg_sizet("sz", 0)
+    monkeypatch.setenv("PARSEC_MCA_flag", "yes")
+    monkeypatch.setenv("PARSEC_MCA_sz", "0x100")
+    assert reg.get("flag") is True
+    assert reg.get("sz") == 256
+
+
+def test_sizet_rejects_negative(reg):
+    reg.reg_sizet("n", 0)
+    reg.set_cmdline("n", "-5")
+    with pytest.raises(ValueError):
+        reg.get("n")
+
+
+def test_unknown_param_raises(reg):
+    with pytest.raises(KeyError):
+        reg.get("nope")
+
+
+def test_file_values(reg, tmp_path, monkeypatch):
+    conf = tmp_path / "mca.conf"
+    conf.write_text("# comment\nfoo = 13\n")
+    monkeypatch.setenv("PARSEC_SYSCONF_PARAMS", str(conf))
+    reg.reg_int("foo", 1)
+    assert reg.get("foo") == 13
+    assert reg.source("foo") == "file"
