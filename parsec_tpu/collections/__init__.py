@@ -1,1 +1,10 @@
-"""collections subpackage."""
+"""Data collections & distributions (SURVEY.md §2.6)."""
+from .collection import DataCollection, DictCollection, LocalArrayCollection
+from .matrix import (SymTwoDimBlockCyclic, TiledMatrix, TwoDimBlockCyclic,
+                     TwoDimBlockCyclicBand, TwoDimTabular, VectorTwoDimCyclic)
+
+__all__ = [
+    "DataCollection", "DictCollection", "LocalArrayCollection", "TiledMatrix",
+    "TwoDimBlockCyclic", "SymTwoDimBlockCyclic", "TwoDimBlockCyclicBand",
+    "TwoDimTabular", "VectorTwoDimCyclic",
+]

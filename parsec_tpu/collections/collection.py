@@ -1,0 +1,121 @@
+"""Data collection base: the distribution *is* the collection vtable.
+
+Reference behavior: ``parsec_data_collection_t`` exposes
+``rank_of(...)/vpid_of(...)/data_of(...)/data_key(...)`` (+ ``*_of_key``)
+virtual functions; user code overrides them to define arbitrary
+distributions (ref: parsec/data_distribution.c,
+examples/Ex04_ChainData.jdf:127-133).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+import numpy as np
+
+from ..data.data import Data, DataCopy, Coherency, data_new_with_payload
+
+
+class DataCollection:
+    """Subclasses override rank_of/vpid_of/data_of/data_key."""
+
+    def __init__(self, nodes: int = 1, rank: int = 0, name: str = "") -> None:
+        self.nodes = nodes
+        self.rank = rank
+        self.name = name or type(self).__name__
+        self.dtt: Any = None  # default datatype descriptor of one element/tile
+
+    # -- key-based interface ------------------------------------------------
+    def data_key(self, *indices) -> Any:
+        return indices if len(indices) != 1 else indices[0]
+
+    def rank_of(self, *indices) -> int:
+        raise NotImplementedError
+
+    def vpid_of(self, *indices) -> int:
+        return 0
+
+    def data_of(self, *indices) -> Data:
+        raise NotImplementedError
+
+    # ``*_of_key`` variants (ref: rank_of_key/data_of_key)
+    def rank_of_key(self, key: Any) -> int:
+        idx = key if isinstance(key, tuple) else (key,)
+        return self.rank_of(*idx)
+
+    def data_of_key(self, key: Any) -> Data:
+        idx = key if isinstance(key, tuple) else (key,)
+        return self.data_of(*idx)
+
+    def is_local(self, *indices) -> bool:
+        return self.rank_of(*indices) == self.rank
+
+
+class LocalArrayCollection(DataCollection):
+    """A host ndarray split into equal chunks along axis 0; chunk k is one
+    datum. The simplest collection for examples/tests (the reference's
+    Ex01-Ex05 use hand-rolled single-datum collections like this)."""
+
+    def __init__(self, array: np.ndarray, nb_chunks: int,
+                 nodes: int = 1, rank: int = 0) -> None:
+        super().__init__(nodes, rank)
+        assert array.shape[0] % nb_chunks == 0, \
+            f"axis 0 ({array.shape[0]}) not divisible into {nb_chunks} chunks"
+        self.array = array
+        self.nb_chunks = nb_chunks
+        self.chunk = array.shape[0] // nb_chunks
+        self._data: Dict[int, Data] = {}
+        self._lock = threading.Lock()
+
+    def rank_of(self, k: int) -> int:
+        return k % self.nodes
+
+    def data_of(self, k: int) -> Data:
+        with self._lock:
+            d = self._data.get(k)
+            if d is None:
+                view = self.array[k * self.chunk:(k + 1) * self.chunk]
+                d = data_new_with_payload(view, device_id=0, key=(id(self), k))
+                d.collection = self
+                self._data[k] = d
+            return d
+
+    def keys(self) -> Iterable[int]:
+        return range(self.nb_chunks)
+
+
+class DictCollection(DataCollection):
+    """Key -> (rank, data) table; the irregular 'hash datadist'
+    (ref: parsec/data_dist/hash_datadist.c)."""
+
+    def __init__(self, nodes: int = 1, rank: int = 0) -> None:
+        super().__init__(nodes, rank)
+        self._entries: Dict[Any, Tuple[int, int, Optional[Data]]] = {}
+        self._lock = threading.Lock()
+
+    def add(self, key: Any, rank: int, payload: Any = None, vpid: int = 0) -> None:
+        with self._lock:
+            data = None
+            if payload is not None:
+                data = data_new_with_payload(payload, device_id=0,
+                                             key=(id(self), key))
+                data.collection = self
+            self._entries[key] = (rank, vpid, data)
+
+    def rank_of(self, *indices) -> int:
+        key = indices if len(indices) != 1 else indices[0]
+        return self._entries[key][0]
+
+    def vpid_of(self, *indices) -> int:
+        key = indices if len(indices) != 1 else indices[0]
+        return self._entries[key][1]
+
+    def data_of(self, *indices) -> Data:
+        key = indices if len(indices) != 1 else indices[0]
+        ent = self._entries[key]
+        if ent[2] is None:
+            raise KeyError(f"key {key} is remote (rank {ent[0]}); no local data")
+        return ent[2]
+
+    def keys(self):
+        return list(self._entries)

@@ -186,6 +186,28 @@ class Data(Obj):
                 if c.device_id != device_id:
                     c.coherency = Coherency.INVALID
 
+    # -- host-side helpers shared by the DSLs -------------------------------
+    def host_copy(self) -> DataCopy:
+        """The device-0 copy, attached on demand."""
+        with self._lock:
+            host = self._copies.get(0)
+            if host is None:
+                host = DataCopy(self, 0, payload=None)
+                self._copies[0] = host
+            return host
+
+    def sync_to_host(self, devices) -> DataCopy:
+        """Make the host copy hold the newest version, pulling from the
+        owning accelerator if needed. ``devices`` is the context device list
+        indexed by device_id."""
+        host = self.host_copy()
+        newest = self.newest_copy()
+        if newest is not None and newest.device_id != 0 and \
+                newest.version > host.version:
+            devices[newest.device_id].pull_to_host(self)
+            host = self.get_copy(0)
+        return host
+
     def _destruct(self) -> None:
         for c in list(self._copies.values()):
             c.data = None

@@ -240,19 +240,22 @@ class JaxDevice(Device):
                 if self.mem_used <= self.mem_budget:
                     break
                 copy = self._lru_clean.pop(key)
-                self._evict(copy, writeback=False)
+                if not self._evict(copy, writeback=False):
+                    self._lru_clean[key] = copy  # in use: keep tracked
             # then dirty (owned) copies with writeback
             for key in list(self._lru_owned):
                 if self.mem_used <= self.mem_budget:
                     break
                 copy = self._lru_owned.pop(key)
-                self._evict(copy, writeback=True)
+                if not self._evict(copy, writeback=True):
+                    self._lru_owned[key] = copy
 
-    def _evict(self, copy: DataCopy, writeback: bool) -> None:
+    def _evict(self, copy: DataCopy, writeback: bool) -> bool:
+        """Returns True when the copy was evicted (False: keep it listed)."""
         if copy.payload is None or copy.data is None:
-            return
+            return True
         if copy.readers > 0:
-            return  # in use; cycling guard keeps it resident
+            return False  # in use; cycling guard keeps it resident
         import numpy as np
         data = copy.data
         if writeback and copy.coherency == Coherency.OWNED:
@@ -267,6 +270,7 @@ class JaxDevice(Device):
         copy.payload = None
         copy.coherency = Coherency.INVALID
         self.stats["evictions"] += 1
+        return True
 
     def _lru_touch(self, copy: DataCopy, owned: bool) -> None:
         key = id(copy)

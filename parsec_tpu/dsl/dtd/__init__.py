@@ -143,18 +143,12 @@ def _dtd_prepare_input(es, task: Task) -> HookReturn:
         if p.tile is None:
             continue
         data = p.tile.data
-        host = data.get_copy(0)
-        if host is None:
-            host = DataCopy(data, 0, payload=None)
-            data.attach_copy(host)
-        if not will_run_on_device:
-            newest = data.newest_copy()
-            if newest is not None and newest.device_id != 0 and \
-                    newest.version > host.version:
-                dev = es.context.devices[newest.device_id]
-                dev.pull_to_host(data)
-        task.data[flow.flow_index].data_in = data.get_copy(0) \
-            if not will_run_on_device else (data.newest_copy() or host)
+        if will_run_on_device:
+            task.data[flow.flow_index].data_in = \
+                data.newest_copy() or data.host_copy()
+        else:
+            task.data[flow.flow_index].data_in = \
+                data.sync_to_host(es.context.devices)
         task.data[flow.flow_index].fulfilled = True
     return HookReturn.DONE
 
@@ -436,10 +430,7 @@ class DTDTaskpool(Taskpool):
 def _dtd_flush_body(es, task: Task) -> None:
     """Shared flush task body: pull the newest copy back to the host."""
     tile: DTDTile = next(p.value for p in task.user if p.tile is None)
-    d = tile.data
-    newest = d.newest_copy()
-    if newest is not None and newest.device_id != 0:
-        es.context.devices[newest.device_id].pull_to_host(d)
+    tile.data.sync_to_host(es.context.devices)
     tile.flushed = True
 
 

@@ -1,0 +1,340 @@
+"""JDF text parser.
+
+Reference behavior: flex/bison grammar for the JDF language
+(ref: parsec/interfaces/ptg/ptg-compiler/parsec.l:1-278, parsec.y:1-1345).
+The surface parsed here matches the examples (Ex01-Ex07) and test JDFs:
+
+    extern "C" %{ ...python prologue... %}
+    NAME [ type=... default=... hidden=on ]          # globals
+    Task(k, n)  [ properties ]
+    k = 0 .. NB [.. step]
+    n = expr                                          # derived local
+    : collection( exprs )                             # affinity
+    RW  A <- (guard) ? src : B Task(k-1)  [type=X]
+         -> dst Task(k+1, 0 .. N .. 2)
+    CTL X -> X Other(k)
+    ; priority_expr
+    BODY [type=tpu]
+      ...code...
+    END
+
+Prologue/epilogue blocks hold *Python* here (the reference embeds C).
+"""
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Tuple
+
+from .ast import (BodyAST, DepAST, DepTarget, Expr, FlowAST, GlobalDef,
+                  JDFFile, LocalDef, RangeExpr, TaskClassAST, c2py,
+                  parse_properties, split_top)
+
+_RE_EXTERN = re.compile(r'extern\s+"[A-Za-z]+"\s*%\{(.*?)%\}', re.S)
+_RE_HEADER = re.compile(r"^([A-Za-z_]\w*)\s*\(\s*([\w\s,]*)\s*\)\s*(\[.*\])?\s*$")
+_RE_GLOBAL = re.compile(r"^([A-Za-z_]\w*)\s*(\[.*\])?\s*$")
+_RE_LOCAL = re.compile(r"^([A-Za-z_]\w*)\s*=\s*(.+)$")
+_RE_FLOW = re.compile(r"^(RW|READ|WRITE|CTL)\s+([A-Za-z_]\w*)\s*(.*)$", re.S)
+_ACCESS = {"RW", "READ", "WRITE", "CTL"}
+
+
+class JDFParseError(SyntaxError):
+    pass
+
+
+def _strip_comments(text: str) -> str:
+    text = re.sub(r"/\*.*?\*/", "", text, flags=re.S)
+    out = []
+    for line in text.splitlines():
+        # '//' comments (avoid cutting inside strings - JDF rarely has them)
+        idx = line.find("//")
+        if idx >= 0:
+            line = line[:idx]
+        out.append(line)
+    return "\n".join(out)
+
+
+def parse_jdf(text: str, name: str = "jdf") -> JDFFile:
+    jdf = JDFFile(name=name)
+
+    # 1. pull out extern blocks (prologue before first task class, the rest
+    #    epilogue), in source order
+    externs: List[Tuple[int, str]] = [(m.start(), m.group(1))
+                                      for m in _RE_EXTERN.finditer(text)]
+    body_text = _RE_EXTERN.sub("", text)
+    body_text = _strip_comments(body_text)
+
+    lines = body_text.splitlines()
+    # find the first task header line to split prologue/epilogue externs
+    first_tc_pos = None
+    joined = _strip_comments(_RE_EXTERN.sub(lambda m: " " * (m.end() - m.start()), text))
+    for m in re.finditer(r"^[A-Za-z_]\w*\s*\([\w\s,]*\)\s*(\[.*\])?\s*$",
+                         joined, flags=re.M):
+        first_tc_pos = m.start()
+        break
+    for pos, code in externs:
+        if first_tc_pos is None or pos < first_tc_pos:
+            jdf.prologue.append(code)
+        else:
+            jdf.epilogue.append(code)
+
+    i = 0
+    n = len(lines)
+
+    def peek() -> Optional[str]:
+        return lines[i] if i < n else None
+
+    # 2. globals until the first task header
+    while i < n:
+        line = lines[i].strip()
+        if not line:
+            i += 1
+            continue
+        if _RE_HEADER.match(line) and i + 1 < n and _looks_like_task_start(lines, i):
+            break
+        m = _RE_GLOBAL.match(line)
+        if m and m.group(1) not in _ACCESS:
+            jdf.globals.append(GlobalDef(m.group(1),
+                                         parse_properties(m.group(2) or "")))
+            i += 1
+            continue
+        raise JDFParseError(f"line {i+1}: expected global or task class: {line!r}")
+
+    # 3. task classes
+    while i < n:
+        line = lines[i].strip()
+        if not line:
+            i += 1
+            continue
+        m = _RE_HEADER.match(line)
+        if not m:
+            raise JDFParseError(f"line {i+1}: expected task class header: {line!r}")
+        tc = TaskClassAST(
+            name=m.group(1),
+            params=[p.strip() for p in m.group(2).split(",") if p.strip()],
+            properties=parse_properties(m.group(3) or ""))
+        jdf.task_classes.append(tc)
+        i += 1
+        i = _parse_task_body(lines, i, tc)
+
+    _check(jdf)
+    return jdf
+
+
+def _looks_like_task_start(lines: List[str], i: int) -> bool:
+    """A task header is followed (eventually) by locals/affinity/flows."""
+    for j in range(i + 1, min(i + 12, len(lines))):
+        s = lines[j].strip()
+        if not s:
+            continue
+        if _RE_LOCAL.match(s) or s.startswith(":") or _RE_FLOW.match(s) \
+                or s == "BODY" or s.startswith("BODY"):
+            return True
+        return False
+    return False
+
+
+def _parse_task_body(lines: List[str], i: int, tc: TaskClassAST) -> int:
+    n = len(lines)
+    seen_affinity = False
+    while i < n:
+        raw = lines[i]
+        line = raw.strip()
+        if not line:
+            i += 1
+            continue
+        # BODY ... END
+        if line == "BODY" or (line.startswith("BODY") and
+                              line[4:].lstrip().startswith("[")):
+            props = parse_properties(line[4:]) if len(line) > 4 else {}
+            i += 1
+            code_lines: List[str] = []
+            while i < n and lines[i].strip() != "END":
+                code_lines.append(lines[i])
+            # never reached END?
+                i += 1
+            if i >= n:
+                raise JDFParseError(f"{tc.name}: BODY without END")
+            i += 1  # consume END
+            tc.bodies.append(BodyAST(code=_strip_braces("\n".join(code_lines)),
+                                     properties=props))
+            # after the (last) body, the class may end; another header or
+            # body may follow — loop handles both
+            if i < n and _is_next_task_header(lines, i):
+                return i
+            continue
+        if _is_next_task_header(lines, i) and tc.bodies:
+            return i
+        # affinity
+        if line.startswith(":"):
+            body = line[1:].strip()
+            m = re.match(r"([A-Za-z_]\w*)\s*\((.*)\)\s*$", body)
+            if not m:
+                raise JDFParseError(f"{tc.name}: bad affinity {line!r}")
+            tc.affinity_collection = m.group(1)
+            tc.affinity_args = [Expr(a) for a in split_top(m.group(2), ",") if a.strip()]
+            seen_affinity = True
+            i += 1
+            continue
+        # priority annotation ``; expr``
+        if line.startswith(";"):
+            tc.priority = Expr(line[1:])
+            i += 1
+            continue
+        # flow (may span lines: continuation lines start with <- or ->)
+        fm = _RE_FLOW.match(line)
+        if fm:
+            flow = FlowAST(name=fm.group(2), access=fm.group(1))
+            tc.flows.append(flow)
+            rest = fm.group(3).strip()
+            dep_srcs: List[str] = []
+            if rest:
+                dep_srcs.extend(_split_deps(rest))
+            i += 1
+            while i < n:
+                nxt = lines[i].strip()
+                if nxt.startswith("<-") or nxt.startswith("->"):
+                    dep_srcs.extend(_split_deps(nxt))
+                    i += 1
+                else:
+                    break
+            for ds in dep_srcs:
+                flow.deps.append(_parse_dep(ds, tc))
+            continue
+        # local definition (range or derived)
+        lm = _RE_LOCAL.match(line)
+        if lm and not seen_affinity and not tc.flows:
+            name, rhs = lm.group(1), lm.group(2).strip()
+            rng = RangeExpr.parse(rhs)
+            if isinstance(rng, RangeExpr):
+                tc.locals.append(LocalDef(name, rng))
+            else:
+                tc.locals.append(LocalDef(name, None, expr=rng))
+            i += 1
+            continue
+        raise JDFParseError(f"{tc.name}: unexpected line {i+1}: {line!r}")
+    return i
+
+
+def _is_next_task_header(lines: List[str], i: int) -> bool:
+    s = lines[i].strip()
+    return bool(_RE_HEADER.match(s)) and _looks_like_task_start(lines, i)
+
+
+def _split_deps(src: str) -> List[str]:
+    """Split ``<- x -> y -> z`` into ['<- x', '-> y', '-> z']."""
+    out: List[str] = []
+    tokens = re.split(r"(<-|->)", src)
+    cur = None
+    for t in tokens:
+        if t in ("<-", "->"):
+            if cur is not None:
+                out.append(cur)
+            cur = t
+        elif cur is not None:
+            cur += " " + t.strip()
+    if cur is not None:
+        out.append(cur)
+    return [c.strip() for c in out if c.strip() not in ("<-", "->")]
+
+
+def _parse_dep(src: str, tc: TaskClassAST) -> DepAST:
+    direction = "in" if src.startswith("<-") else "out"
+    body = src[2:].strip()
+    # trailing property list [type=...]
+    props = {}
+    pm = re.search(r"\[([^\]]*)\]\s*$", body)
+    if pm and "=" in pm.group(1):
+        props = parse_properties(pm.group(0))
+        body = body[:pm.start()].strip()
+    # guard: top-level ``cond ? a : b`` or ``cond ? a``
+    guard = None
+    alt = None
+    qparts = split_top(body, "?")
+    if len(qparts) == 2:
+        guard = Expr(qparts[0])
+        rest = qparts[1]
+        cparts = split_top(rest, ":")
+        if len(cparts) == 2:
+            target = _parse_target(cparts[0], tc)
+            alt = _parse_target(cparts[1], tc)
+        else:
+            target = _parse_target(rest, tc)
+    else:
+        target = _parse_target(body, tc)
+    return DepAST(direction=direction, guard=guard, target=target,
+                  alt_target=alt, properties=props)
+
+
+def _parse_target(src: str, tc: TaskClassAST) -> DepTarget:
+    src = src.strip()
+    if src.upper() == "NULL":
+        return DepTarget(kind="null")
+    if src.upper().startswith("NEW"):
+        return DepTarget(kind="new")
+    # ``FLOW Class( args )`` (task) or ``collection( args )`` (memory)
+    m = re.match(r"^([A-Za-z_]\w*)\s+([A-Za-z_]\w*)\s*\((.*)\)\s*$", src, re.S)
+    if m:
+        args = [RangeExpr.parse(a) for a in split_top(m.group(3), ",") if a.strip()]
+        return DepTarget(kind="task", flow=m.group(1), task_class=m.group(2),
+                         args=args)
+    m = re.match(r"^([A-Za-z_]\w*)\s*\((.*)\)\s*$", src, re.S)
+    if m:
+        args = [RangeExpr.parse(a) for a in split_top(m.group(2), ",") if a.strip()]
+        return DepTarget(kind="memory", collection=m.group(1), args=args)
+    raise JDFParseError(f"{tc.name}: bad dependency target {src!r}")
+
+
+def _strip_braces(code: str) -> str:
+    """JDF bodies are wrapped in { } like C blocks; unwrap for Python."""
+    s = code.strip()
+    if s.startswith("{") and s.endswith("}"):
+        inner = s[1:-1]
+        return _dedent(inner.strip("\n"))
+    return _dedent(code)
+
+
+def _dedent(code: str) -> str:
+    lines = [l for l in code.splitlines()]
+    margins = [len(l) - len(l.lstrip()) for l in lines if l.strip()]
+    if not margins:
+        return code
+    m = min(margins)
+    return "\n".join(l[m:] if l.strip() else "" for l in lines)
+
+
+def _check(jdf: JDFFile) -> None:
+    """Semantic checks (ref: jdf_sanity_checks, jdf.c)."""
+    gnames = {g.name for g in jdf.globals}
+    for tc in jdf.task_classes:
+        lnames = [l.name for l in tc.locals]
+        for p in tc.params:
+            if p not in lnames:
+                raise JDFParseError(
+                    f"{tc.name}: parameter {p} has no range definition")
+        if not tc.bodies:
+            raise JDFParseError(f"{tc.name}: no BODY")
+        if tc.affinity_collection is not None and \
+                tc.affinity_collection not in gnames:
+            raise JDFParseError(
+                f"{tc.name}: affinity references unknown collection "
+                f"{tc.affinity_collection!r}")
+        for fl in tc.flows:
+            if not fl.deps and not fl.is_ctl:
+                raise JDFParseError(f"{tc.name}.{fl.name}: flow with no deps")
+            for d in fl.deps:
+                for t in (d.target, d.alt_target):
+                    if t is None:
+                        continue
+                    if t.kind == "task":
+                        try:
+                            peer = jdf.task_class_by_name(t.task_class)
+                            peer.flow_by_name(t.flow)
+                        except KeyError as e:
+                            raise JDFParseError(
+                                f"{tc.name}.{fl.name}: bad dep target: {e}") \
+                                from None
+                    elif t.kind == "memory":
+                        if t.collection not in gnames:
+                            raise JDFParseError(
+                                f"{tc.name}.{fl.name}: unknown collection "
+                                f"{t.collection!r}")

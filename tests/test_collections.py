@@ -1,0 +1,107 @@
+"""Collection tests (mirrors reference tests/collections/: distribution
+math, storage variants, kcyclic, band)."""
+import numpy as np
+import pytest
+
+from parsec_tpu.collections import (DictCollection, LocalArrayCollection,
+                                    SymTwoDimBlockCyclic, TiledMatrix,
+                                    TwoDimBlockCyclic, TwoDimBlockCyclicBand,
+                                    TwoDimTabular, VectorTwoDimCyclic)
+
+
+def test_tiled_matrix_geometry():
+    A = TiledMatrix(100, 60, 32, 16)
+    assert (A.mt, A.nt) == (4, 4)
+    assert A.tile_shape(0, 0) == (32, 16)
+    assert A.tile_shape(3, 3) == (4, 12)  # partial edge tiles
+    assert len(list(A.tiles())) == 16
+
+
+def test_tiled_roundtrip_numpy():
+    A = TiledMatrix(48, 48, 16, 16, dtype=np.float64)
+    M = np.arange(48 * 48, dtype=np.float64).reshape(48, 48)
+    A.from_numpy(M)
+    np.testing.assert_array_equal(A.to_numpy(), M)
+    np.testing.assert_array_equal(A.tile(1, 2), M[16:32, 32:48])
+
+
+def test_block_cyclic_rank_math():
+    """2x2 grid, no k-cyclicity: classic round-robin both dims."""
+    A = TwoDimBlockCyclic(64, 64, 8, 8, P=2, Q=2)
+    assert A.nodes == 4
+    assert A.rank_of(0, 0) == 0
+    assert A.rank_of(0, 1) == 1
+    assert A.rank_of(1, 0) == 2
+    assert A.rank_of(1, 1) == 3
+    assert A.rank_of(2, 2) == 0
+    # every rank owns exactly 1/4 of the 8x8 tiles
+    counts = {}
+    for t in A.tiles():
+        counts[A.rank_of(*t)] = counts.get(A.rank_of(*t), 0) + 1
+    assert counts == {0: 16, 1: 16, 2: 16, 3: 16}
+
+
+def test_block_cyclic_kcyclic():
+    """krows=2: pairs of consecutive tile-rows land on the same P row."""
+    A = TwoDimBlockCyclic(64, 64, 8, 8, P=2, Q=1, krows=2)
+    assert A.rank_of(0, 0) == A.rank_of(1, 0) == 0
+    assert A.rank_of(2, 0) == A.rank_of(3, 0) == 1
+    assert A.rank_of(4, 0) == 0
+
+
+def test_sym_storage_rejects_wrong_triangle():
+    A = SymTwoDimBlockCyclic(64, 64, 16, 16, uplo="lower")
+    assert len(list(A.tiles())) == 10  # 4x4 lower triangle incl diagonal
+    A.data_of(2, 1)
+    with pytest.raises(AssertionError):
+        A.data_of(1, 2)
+
+
+def test_sym_to_numpy_mirrors():
+    A = SymTwoDimBlockCyclic(32, 32, 16, 16, uplo="lower")
+    t = np.random.RandomState(0).rand(16, 16).astype(np.float32)
+    A.set_tile(1, 0, t)
+    M = A.to_numpy()
+    np.testing.assert_allclose(M[16:32, 0:16], t)
+    np.testing.assert_allclose(M[0:16, 16:32], t.T)
+
+
+def test_band_distribution():
+    A = TwoDimBlockCyclicBand(64, 64, 8, 8, band_size=2, P=2, Q=2)
+    assert A.in_band(3, 3) and A.in_band(3, 4) and not A.in_band(3, 5)
+    with pytest.raises(AssertionError):
+        A.data_of(0, 5)
+    assert all(abs(m - n) < 2 for m, n in A.tiles())
+
+
+def test_tabular_distribution():
+    A = TwoDimTabular.random(32, 32, 8, 8, nodes=3, seed=42)
+    for (m, n) in A.tiles():
+        assert 0 <= A.rank_of(m, n) < 3
+    # table is what rank_of reports
+    assert A.rank_of(1, 2) == A.rank_table[1, 2]
+
+
+def test_vector_cyclic():
+    v = VectorTwoDimCyclic(100, 10, P=4)
+    assert v.mt == 10
+    assert [v.rank_of(k) for k in range(8)] == [0, 1, 2, 3, 0, 1, 2, 3]
+    d = v.data_of(3)
+    assert d.get_copy(0).payload.shape == (10, 1)
+
+
+def test_dict_collection_remote_entries():
+    c = DictCollection(nodes=2, rank=0)
+    c.add("x", 0, np.zeros(3))
+    c.add("y", 1)  # remote, no local payload
+    assert c.rank_of("x") == 0 and c.rank_of("y") == 1
+    with pytest.raises(KeyError):
+        c.data_of("y")
+
+
+def test_local_array_collection_views_alias():
+    base = np.zeros((8, 2))
+    c = LocalArrayCollection(base, 4)
+    d = c.data_of(1)
+    d.get_copy(0).payload[:] = 7.0
+    assert np.all(base[2:4] == 7.0)  # tiles are views, not copies

@@ -1,0 +1,324 @@
+"""PTG/JDF front-end tests.
+
+Mirrors the reference's tutorial examples and compiler tests:
+Ex02 (chain of CTL deps), Ex04_ChainData (RW chain through memory),
+Ex05_Broadcast (range fan-out), tests/dsl/ptg (branching, choice,
+local-indices, startup corner cases).
+"""
+import numpy as np
+import pytest
+
+import parsec_tpu
+from parsec_tpu.collections.collection import DictCollection, LocalArrayCollection
+from parsec_tpu.dsl import ptg
+
+
+CHAIN_JDF = """
+mydata  [ type="collection" ]
+NB      [ type="int" ]
+
+Task(k)
+
+k = 0 .. NB
+
+: mydata( k )
+
+RW  A <- (k == 0)  ? mydata( k ) : A Task( k-1 )
+      -> (k == NB) ? mydata( k ) : A Task( k+1 )
+
+BODY
+{
+    A[0] += 1
+}
+END
+"""
+
+
+def test_chain_data(ctx):
+    """Ex04_ChainData: a chain of NB+1 tasks each incrementing the datum."""
+    arr = np.array([[300.0]])
+
+    # single-datum collection where every index maps to datum 0 (the Ex04
+    # pattern: one memory cell walked by the whole chain)
+    class Single(DictCollection):
+        def data_of(self, *idx):
+            return DictCollection.data_of(self, 0)
+        def rank_of(self, *idx):
+            return 0
+    s = Single()
+    s.add(0, 0, arr[0])
+
+    factory = ptg.compile_jdf(CHAIN_JDF, name="chain")
+    tp = factory.new(mydata=s, NB=20)
+    ctx.add_taskpool(tp)
+    ctx.wait()
+    assert tp.completed
+    assert tp.nb_local_tasks == 21
+    assert arr[0, 0] == 321.0
+
+
+BCAST_JDF = """
+mydata  [ type="collection" ]
+NB      [ type="int" hidden=on default="(6)" ]
+
+TaskBcast(k)
+
+k = 0 .. 0
+
+: mydata( k )
+
+RW  A <- mydata( k )
+      -> A TaskRecv( 0 .. NB .. 2 )
+
+BODY
+{
+    A[0] = 42.0
+}
+END
+
+TaskRecv(n)
+
+n = 0 .. NB .. 2
+
+: mydata( n )
+
+READ A <- A TaskBcast( 0 )
+
+BODY
+{
+    sink(n, A[0])
+}
+END
+"""
+
+
+def test_broadcast_range_fanout(ctx):
+    """Ex05: one producer broadcasts to a strided range of consumers."""
+    received = []
+    arr = np.zeros((8, 1))
+    coll = LocalArrayCollection(arr, 8)
+    factory = ptg.compile_jdf(BCAST_JDF, name="bcast")
+    tp = factory.new(mydata=coll)
+    tp.global_env["sink"] = lambda n, v: received.append((n, v))
+    ctx.add_taskpool(tp)
+    ctx.wait()
+    assert sorted(received) == [(0, 42.0), (2, 42.0), (4, 42.0), (6, 42.0)]
+    assert tp.nb_local_tasks == 1 + 4
+
+
+CTL_JDF = """
+NT [ type="int" ]
+dummy [ type="collection" ]
+
+First(k)
+
+k = 0 .. NT
+
+: dummy( k )
+
+CTL X -> X Second( k )
+
+BODY
+{
+    order.append(("first", k))
+}
+END
+
+Second(k)
+
+k = 0 .. NT
+
+: dummy( k )
+
+CTL X <- X First( k )
+
+BODY
+{
+    order.append(("second", k))
+}
+END
+"""
+
+
+def test_ctl_flow_ordering(ctx):
+    """Pure control dependencies order tasks without moving data
+    (ref: tests/dsl/ptg controlgather)."""
+    order = []
+
+    class NoData(DictCollection):
+        def rank_of(self, *i):
+            return 0
+    nd = NoData()
+    factory = ptg.compile_jdf(CTL_JDF, name="ctl")
+    tp = factory.new(NT=5, dummy=nd)
+    tp.global_env["order"] = order
+    ctx.add_taskpool(tp)
+    ctx.wait()
+    assert len(order) == 12
+    for k in range(6):
+        assert order.index(("first", k)) < order.index(("second", k))
+
+
+DIAMOND_JDF = """
+A_coll [ type="collection" ]
+
+Top(k)
+k = 0 .. 0
+: A_coll( 0 )
+RW  A <- A_coll( 0 )
+      -> A Left( 0 )
+      -> A Right( 0 )
+BODY
+{
+    A[0] = 1.0
+}
+END
+
+Left(k)
+k = 0 .. 0
+: A_coll( 0 )
+READ A <- A Top( 0 )
+CTL  X -> X Bottom( 0 )
+BODY
+{
+    log.append(("L", A[0]))
+}
+END
+
+Right(k)
+k = 0 .. 0
+: A_coll( 0 )
+READ A <- A Top( 0 )
+CTL  X -> X Bottom( 0 )
+BODY
+{
+    log.append(("R", A[0]))
+}
+END
+
+Bottom(k)
+k = 0 .. 0
+: A_coll( 0 )
+CTL X <- X Left( 0 )
+      <- X Right( 0 )
+BODY
+{
+    log.append(("B", None))
+}
+END
+"""
+
+
+def test_diamond_multi_input(ctx):
+    """A task with two task-sourced inputs fires exactly once, after both."""
+    log = []
+    arr = np.zeros((1, 1))
+    coll = LocalArrayCollection(arr, 1)
+    tp = ptg.compile_jdf(DIAMOND_JDF, name="diamond").new(A_coll=coll)
+    tp.global_env["log"] = log
+    ctx.add_taskpool(tp)
+    ctx.wait()
+    assert len(log) == 3
+    assert log[-1] == ("B", None)
+    assert {l[0] for l in log[:2]} == {"L", "R"}
+    assert all(v == 1.0 for tag, v in log[:2])
+
+
+PRIO_JDF = """
+NT [ type="int" ]
+dummy [ type="collection" ]
+
+T(k)
+k = 0 .. NT
+: dummy( k )
+; k
+BODY
+{
+    out.append(k)
+}
+END
+"""
+
+
+def test_priority_expression():
+    """Higher-priority instances run first under the ap scheduler."""
+    ctx = parsec_tpu.Context(nb_cores=1, scheduler="ap")
+    try:
+        out = []
+
+        class NoData(DictCollection):
+            def rank_of(self, *i):
+                return 0
+        tp = ptg.compile_jdf(PRIO_JDF, name="prio").new(NT=9, dummy=NoData())
+        tp.global_env["out"] = out
+        ctx.add_taskpool(tp)
+        ctx.wait()
+        assert out == list(range(9, -1, -1))
+    finally:
+        ctx.fini()
+
+
+def test_parse_errors():
+    with pytest.raises(ptg.JDFParseError):
+        ptg.compile_jdf("T(k)\nk = 0 .. 3\n: c( k )\nBODY\nx\nEND\n")  # unknown coll
+    with pytest.raises(ptg.JDFParseError):
+        ptg.compile_jdf("c [type=x]\nT(k)\nk = 0 .. 3\n: c( k )\n")  # no body
+    with pytest.raises(ptg.JDFParseError):
+        # dep to unknown task class
+        ptg.compile_jdf("""
+c [type=x]
+T(k)
+k = 0 .. 1
+: c( k )
+RW A <- c( k ) -> A Nope( k )
+BODY
+x = 1
+END
+""")
+
+
+def test_missing_global_raises():
+    f = ptg.compile_jdf(PRIO_JDF, name="prio")
+    with pytest.raises(TypeError):
+        f.new(NT=3)  # dummy missing
+    with pytest.raises(TypeError):
+        f.new(NT=3, dummy=None, extra=1)
+
+
+GUARD_SINGLE_JDF = """
+NT [ type="int" ]
+dummy [ type="collection" ]
+
+P(k)
+k = 0 .. NT
+: dummy( k )
+RW A <- dummy( k )
+     -> (k < NT) ? A C( k+1 )
+BODY
+{
+    A[0] = k
+}
+END
+
+C(k)
+k = 1 .. NT
+: dummy( k )
+READ A <- A P( k-1 )
+BODY
+{
+    got.append((k, A[0]))
+}
+END
+"""
+
+
+def test_guarded_single_target_dep(ctx):
+    """``(cond) ? target`` with no alternative: edge exists only when true."""
+    got = []
+    arr = np.zeros((8, 1))
+    coll = LocalArrayCollection(arr, 8)
+    tp = ptg.compile_jdf(GUARD_SINGLE_JDF, name="guard").new(NT=3, dummy=coll)
+    tp.global_env["got"] = got
+    ctx.add_taskpool(tp)
+    ctx.wait()
+    assert sorted(got) == [(1, 0.0), (2, 1.0), (3, 2.0)]

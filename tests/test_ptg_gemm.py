@@ -1,0 +1,82 @@
+"""PTG tiled GEMM — integration of JDF + tiled collections + device bodies.
+
+The k-chained tile GEMM DAG (the SUMMA-like decomposition the reference's
+2D block-cyclic tile algorithms express, SURVEY.md §2.8) with both a host
+BODY and a BODY [type=tpu]; numerics checked against numpy.
+"""
+import numpy as np
+import pytest
+
+import parsec_tpu
+from parsec_tpu.collections import TwoDimBlockCyclic
+from parsec_tpu.dsl import ptg
+
+GEMM_JDF = """
+descA [ type="collection" ]
+descB [ type="collection" ]
+descC [ type="collection" ]
+MT [ type="int" ]
+NT [ type="int" ]
+KT [ type="int" ]
+
+GEMM(m, n, k)
+
+m = 0 .. MT-1
+n = 0 .. NT-1
+k = 0 .. KT-1
+
+: descC( m, n )
+
+READ A <- descA( m, k )
+READ B <- descB( k, n )
+RW   C <- (k == 0) ? descC( m, n ) : C GEMM( m, n, k-1 )
+       -> (k == KT-1) ? descC( m, n ) : C GEMM( m, n, k+1 )
+
+BODY [type=tpu]
+{
+    C = C + jnp.dot(A, B, preferred_element_type=jnp.float32)
+}
+END
+
+BODY
+{
+    C += A @ B
+}
+END
+"""
+
+
+def _run_gemm(ctx, mt, nt, kt, tile, enable_tpu):
+    rng = np.random.RandomState(7)
+    Am = rng.rand(mt * tile, kt * tile).astype(np.float32)
+    Bm = rng.rand(kt * tile, nt * tile).astype(np.float32)
+    Cm = rng.rand(mt * tile, nt * tile).astype(np.float32)
+    A = TwoDimBlockCyclic(mt * tile, kt * tile, tile, tile).from_numpy(Am)
+    B = TwoDimBlockCyclic(kt * tile, nt * tile, tile, tile).from_numpy(Bm)
+    C = TwoDimBlockCyclic(mt * tile, nt * tile, tile, tile).from_numpy(Cm)
+    tp = ptg.compile_jdf(GEMM_JDF, name="gemm").new(
+        descA=A, descB=B, descC=C, MT=mt, NT=nt, KT=kt)
+    ctx.add_taskpool(tp)
+    ctx.wait()
+    assert tp.completed
+    assert tp.nb_local_tasks == mt * nt * kt
+    np.testing.assert_allclose(C.to_numpy(), Cm + Am @ Bm, rtol=2e-4)
+
+
+def test_ptg_gemm_cpu():
+    ctx = parsec_tpu.Context(nb_cores=2, enable_tpu=False)
+    try:
+        _run_gemm(ctx, 3, 2, 4, 8, enable_tpu=False)
+    finally:
+        ctx.fini()
+
+
+def test_ptg_gemm_tpu(ctx4):
+    _run_gemm(ctx4, 2, 2, 3, 16, enable_tpu=True)
+
+
+def test_ptg_gemm_device_stats(ctx):
+    """The [type=tpu] body must actually run on the device module."""
+    _run_gemm(ctx, 2, 2, 2, 8, enable_tpu=True)
+    devs = [d for d in ctx.devices if d.device_type == "tpu"]
+    assert sum(d.stats["tasks"] for d in devs) == 8
