@@ -22,6 +22,9 @@ class DataCollection:
     def __init__(self, nodes: int = 1, rank: int = 0, name: str = "") -> None:
         self.nodes = nodes
         self.rank = rank
+        # the name is the collection's SPMD-wide identity on the wire
+        # (multi-rank DTD keys tile messages by it); give distinct logical
+        # collections distinct names
         self.name = name or type(self).__name__
         self.dtt: Any = None  # default datatype descriptor of one element/tile
 

@@ -1,1 +1,11 @@
-"""comm subpackage."""
+"""Communication: comm-engine abstraction, transports, remote-dep protocol
+(SURVEY.md §2.4)."""
+from .engine import (CommEngine, MemHandle, TAG_ACTIVATE, TAG_DTD_DATA,
+                     TAG_GET_DATA, TAG_GET_REQ, TAG_TERMDET, TAG_USER_BASE)
+from .local import LocalCommEngine, LocalFabric
+from .remote_dep import RemoteDepEngine, bcast_children
+
+__all__ = ["CommEngine", "MemHandle", "LocalFabric", "LocalCommEngine",
+           "RemoteDepEngine", "bcast_children", "TAG_ACTIVATE",
+           "TAG_DTD_DATA", "TAG_GET_DATA", "TAG_GET_REQ", "TAG_TERMDET",
+           "TAG_USER_BASE"]

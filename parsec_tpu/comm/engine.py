@@ -1,0 +1,108 @@
+"""Comm engine (CE) abstraction: transport-neutral messaging.
+
+Reference behavior: ``parsec_comm_engine_t`` — tagged active messages
+(callback per tag), ``mem_register/unregister``, one-sided put/get with
+local+remote completion callbacks, pack/unpack, sync, capabilities
+(ref: parsec/parsec_comm_engine.h:139-166). The only in-tree transport is
+funnelled MPI emulating one-sided ops over two-sided sends
+(parsec/parsec_mpi_funnelled.c).
+
+TPU-native re-design: the data plane between ranks ultimately rides
+ICI/DCN (XLA collectives / PJRT transfers — comm/collectives.py); the CE
+here is the *control* plane and host-memory data plane. Transports:
+LocalFabric (in-process ranks, the test fabric standing in for
+oversubscribed mpiexec, SURVEY.md §4) and, on real deployments, a DCN
+socket transport with the same interface.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+class Capabilities:
+    def __init__(self, sided: int = 1, noncontig: bool = True,
+                 multithread: bool = False) -> None:
+        self.sided = sided
+        self.supports_noncontiguous_datatypes = noncontig
+        self.multithreaded = multithread
+
+
+class MemHandle:
+    """Registered memory region handle (ref: parsec_ce_mem_reg_handle_t —
+    wraps {ptr, count, datatype}); here it wraps a host array + metadata."""
+
+    _iter = 0
+    _lock = threading.Lock()
+
+    def __init__(self, array: Any, meta: Any = None) -> None:
+        with MemHandle._lock:
+            MemHandle._iter += 1
+            self.handle_id = MemHandle._iter
+        self.array = array
+        self.meta = meta
+
+
+class CommEngine:
+    """Transport interface (ref: parsec_comm_engine_t function table)."""
+
+    def __init__(self, rank: int, nb_ranks: int) -> None:
+        self.rank = rank
+        self.nb_ranks = nb_ranks
+        self.capabilities = Capabilities()
+        self._tag_cbs: Dict[int, Callable] = {}
+        self._mem: Dict[int, MemHandle] = {}
+        self.on_get_served: Optional[Callable[[int], None]] = None
+
+    # -- active messages ----------------------------------------------------
+    def tag_register(self, tag: int, cb: Callable[[int, Any], None]) -> None:
+        """cb(src_rank, payload) runs during progress() on the receiver."""
+        self._tag_cbs[tag] = cb
+
+    def tag_unregister(self, tag: int) -> None:
+        self._tag_cbs.pop(tag, None)
+
+    def send_am(self, dst: int, tag: int, payload: Any) -> None:
+        raise NotImplementedError
+
+    # -- registered memory + one-sided emulation ----------------------------
+    def mem_register(self, array: Any, meta: Any = None) -> MemHandle:
+        h = MemHandle(array, meta)
+        self._mem[h.handle_id] = h
+        return h
+
+    def mem_unregister(self, handle: MemHandle) -> None:
+        self._mem.pop(handle.handle_id, None)
+
+    def get(self, src_rank: int, remote_handle_id: int,
+            on_complete: Callable[[Any], None]) -> None:
+        """One-sided get: fetch the remote registered region
+        (emulated with a GET-request AM + data reply, like the funnelled
+        MPI engine, parsec_mpi_funnelled.c:245-365)."""
+        raise NotImplementedError
+
+    def put(self, dst_rank: int, remote_handle_id: int, array: Any,
+            on_complete: Optional[Callable] = None) -> None:
+        raise NotImplementedError
+
+    # -- progress -----------------------------------------------------------
+    def progress(self) -> int:
+        """Drain incoming messages; returns #messages handled."""
+        raise NotImplementedError
+
+    def sync(self) -> None:
+        """Barrier across ranks."""
+        raise NotImplementedError
+
+    def fini(self) -> None:
+        pass
+
+
+# wire tags (ref: parsec/remote_dep.h:41-48)
+TAG_ACTIVATE = 1
+TAG_GET_REQ = 2
+TAG_GET_DATA = 3
+TAG_PUT_DATA = 4
+TAG_TERMDET = 5
+TAG_DTD_DATA = 6
+TAG_USER_BASE = 16

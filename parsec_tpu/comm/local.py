@@ -1,0 +1,134 @@
+"""LocalFabric: N SPMD ranks inside one process.
+
+The test transport: every rank is a thread with its own Context; messages
+are queued between per-rank inboxes with payload deep-copies to model the
+wire. This is the analog of the reference's CI strategy — distributed
+behavior validated by oversubscribed mpiexec on one node with no fake
+network backend (SURVEY.md §4) — except the "node" is one process.
+"""
+from __future__ import annotations
+
+import copy as _copy
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..core.lists import Fifo
+from .engine import CommEngine, MemHandle, TAG_GET_DATA, TAG_GET_REQ
+
+
+class LocalFabric:
+    """The shared 'network': per-rank inboxes + a barrier."""
+
+    def __init__(self, nb_ranks: int) -> None:
+        self.nb_ranks = nb_ranks
+        self.inboxes: List[Fifo] = [Fifo() for _ in range(nb_ranks)]
+        self.barrier = threading.Barrier(nb_ranks)
+        self.engines: List[Optional["LocalCommEngine"]] = [None] * nb_ranks
+        self.msg_count = 0
+        self.bytes_count = 0
+        self._stat_lock = threading.Lock()
+
+    def engine(self, rank: int) -> "LocalCommEngine":
+        eng = LocalCommEngine(self, rank)
+        self.engines[rank] = eng
+        return eng
+
+    def _post(self, dst: int, src: int, tag: int, payload: Any) -> None:
+        with self._stat_lock:
+            self.msg_count += 1
+            self.bytes_count += _payload_bytes(payload)
+        self.inboxes[dst].push((src, tag, payload))
+
+
+def _payload_bytes(payload: Any) -> int:
+    if isinstance(payload, np.ndarray):
+        return payload.nbytes
+    if isinstance(payload, dict):
+        return sum(_payload_bytes(v) for v in payload.values())
+    if isinstance(payload, (list, tuple)):
+        return sum(_payload_bytes(v) for v in payload)
+    return 8
+
+
+def _wire_copy(payload: Any) -> Any:
+    """Deep-copy ndarrays to model serialization (no aliasing across ranks)."""
+    if isinstance(payload, np.ndarray):
+        return payload.copy()
+    if isinstance(payload, dict):
+        return {k: _wire_copy(v) for k, v in payload.items()}
+    if isinstance(payload, tuple):
+        return tuple(_wire_copy(v) for v in payload)
+    if isinstance(payload, list):
+        return [_wire_copy(v) for v in payload]
+    return payload
+
+
+class LocalCommEngine(CommEngine):
+    def __init__(self, fabric: LocalFabric, rank: int) -> None:
+        super().__init__(rank, fabric.nb_ranks)
+        self.fabric = fabric
+        self._get_cbs: Dict[int, Callable] = {}
+        self._get_iter = 0
+        self._lock = threading.Lock()
+        self.tag_register(TAG_GET_REQ, self._on_get_req)
+        self.tag_register(TAG_GET_DATA, self._on_get_data)
+
+    # -- AMs ----------------------------------------------------------------
+    def send_am(self, dst: int, tag: int, payload: Any) -> None:
+        # self-sends also loop back through the inbox for ordering fidelity
+        self.fabric._post(dst, self.rank, tag, _wire_copy(payload))
+
+    # -- one-sided emulation (GET-req AM + data reply) ----------------------
+    def get(self, src_rank: int, remote_handle_id: int,
+            on_complete: Callable[[Any], None]) -> None:
+        with self._lock:
+            self._get_iter += 1
+            token = self._get_iter
+            self._get_cbs[token] = on_complete
+        self.send_am(src_rank, TAG_GET_REQ,
+                     {"handle": remote_handle_id, "token": token,
+                      "requester": self.rank})
+
+    def _on_get_req(self, src: int, payload: Any) -> None:
+        h = self._mem.get(payload["handle"])
+        assert h is not None, f"GET for unknown mem handle {payload['handle']}"
+        self.send_am(payload["requester"], TAG_GET_DATA,
+                     {"token": payload["token"], "data": h.array,
+                      "meta": h.meta})
+        if self.on_get_served is not None:
+            self.on_get_served(payload["handle"])
+
+    def _on_get_data(self, src: int, payload: Any) -> None:
+        with self._lock:
+            cb = self._get_cbs.pop(payload["token"])
+        cb(payload["data"])
+
+    def put(self, dst_rank: int, remote_handle_id: int, array: Any,
+            on_complete: Optional[Callable] = None) -> None:
+        def deliver(src, payload):
+            pass
+        self.send_am(dst_rank, TAG_GET_DATA,
+                     {"token": None, "put_handle": remote_handle_id,
+                      "data": array})
+        if on_complete is not None:
+            on_complete(array)
+
+    # -- progress -----------------------------------------------------------
+    def progress(self) -> int:
+        n = 0
+        inbox = self.fabric.inboxes[self.rank]
+        while True:
+            item = inbox.pop()
+            if item is None:
+                break
+            src, tag, payload = item
+            cb = self._tag_cbs.get(tag)
+            assert cb is not None, f"rank {self.rank}: no handler for tag {tag}"
+            cb(src, payload)
+            n += 1
+        return n
+
+    def sync(self) -> None:
+        self.fabric.barrier.wait()

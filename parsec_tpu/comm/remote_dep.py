@@ -1,0 +1,258 @@
+"""Remote dependencies: the dataflow protocol between ranks.
+
+Reference behavior (SURVEY.md §2.4, §3.3): on task completion the sender's
+``iterate_successors`` accumulates per-rank output masks; an **activate**
+control message (taskpool_id / task_class_id / locals + output mask) goes
+out; small payloads ride inline ("short" protocol, MCA
+``runtime_comm_short_limit``), larger ones rendezvous — the receiver issues
+a **GET** against the sender's registered memory; incoming data releases
+local successors; broadcasts propagate along a virtual topology
+(star / chain / binomial, MCA ``runtime_comm_coll_bcast``) with re-forwarding
+at each hop (ref: parsec/remote_dep.c:272-358,454;
+parsec/remote_dep_mpi.c:997-1082,1800-1906).
+
+The DTD data plane uses tile-sequence matching: SPMD insertion gives every
+rank an identical view of each tile's write sequence, so a cross-rank RAW
+edge is named by (tile key, write index) — the sender posts after the n-th
+write completes, the receiver's recv-task waits for exactly that message
+(ref: DTD remote deps inferred from rank_of, insert_function.c).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..data.data import DataCopy
+from ..runtime.scheduling import schedule
+from ..utils import logging as plog
+from ..utils.params import params
+from .engine import (CommEngine, TAG_ACTIVATE, TAG_DTD_DATA, TAG_GET_DATA,
+                     TAG_TERMDET)
+
+_log = plog.comm_stream
+
+
+def bcast_children(me_pos: int, nb: int, topology: str) -> List[int]:
+    """Children positions of ``me_pos`` in a broadcast over ``nb``
+    participants (position 0 == root)
+    (ref: remote_dep_bcast_star/chain/binomial_child, remote_dep.c:334-358)."""
+    if topology == "star":
+        return list(range(1, nb)) if me_pos == 0 else []
+    if topology == "chain":
+        return [me_pos + 1] if me_pos + 1 < nb else []
+    if topology == "binomial":
+        out = []
+        mask = 1
+        # classic binomial: position p sends to p | mask for masks above p
+        while mask < nb:
+            child = me_pos | mask
+            if child != me_pos and child < nb and (me_pos & mask) == 0:
+                out.append(child)
+            if me_pos & mask:
+                break
+            mask <<= 1
+        return out
+    raise ValueError(f"unknown bcast topology {topology!r}")
+
+
+class RemoteDepEngine:
+    """Per-rank driver bound to one Context (the comm-thread analog; progress
+    runs funnelled from the idle loop, ref: remote_dep_dequeue_main)."""
+
+    def __init__(self, ce: CommEngine) -> None:
+        self.ce = ce
+        self.rank = ce.rank
+        self.nb_ranks = ce.nb_ranks
+        self.context = None
+        self.topology = params.get("runtime_comm_coll_bcast")
+        self.short_limit = params.get("runtime_comm_short_limit")
+        self._taskpools: Dict[int, Any] = {}
+        self._lock = threading.Lock()
+        # DTD data-plane state: (tile_key, seq) -> payload | expectation
+        self._dtd_arrived: Dict[Tuple, Any] = {}
+        self._dtd_expect: Dict[Tuple, Callable] = {}
+        # rendezvous bookkeeping: handle_id -> (taskpool, remaining, handle)
+        self._pending_handles: Dict[int, Tuple] = {}
+        ce.tag_register(TAG_ACTIVATE, self._on_activate)
+        ce.tag_register(TAG_DTD_DATA, self._on_dtd_data)
+        ce.tag_register(TAG_TERMDET, self._on_termdet)
+        ce.on_get_served = self.note_get_served
+        self.stats = {"activates_sent": 0, "activates_recv": 0,
+                      "dtd_sends": 0, "dtd_recvs": 0, "forwards": 0}
+
+    # ------------------------------------------------------------------ #
+    # context integration                                                #
+    # ------------------------------------------------------------------ #
+    def attach(self, context) -> None:
+        self.context = context
+        context.comm = self
+
+    def taskpool_register(self, tp) -> None:
+        """Wire ids are assigned by registration order — SPMD ranks register
+        the same pools in the same order, so the index agrees everywhere
+        (the process-global taskpool_id does NOT when ranks share a
+        process, as in the test fabric)."""
+        with self._lock:
+            wire_id = len(self._taskpools)
+            self._taskpools[wire_id] = tp
+            tp.comm_tp_id = wire_id
+        if hasattr(tp, "comm"):
+            tp.comm = self
+
+    def progress(self, es) -> int:
+        return self.ce.progress()
+
+    def fini(self) -> None:
+        self.ce.fini()
+
+    # ------------------------------------------------------------------ #
+    # PTG activation protocol                                            #
+    # ------------------------------------------------------------------ #
+    def activate_batch(self, tp, task, flow_payloads: Dict[int, Any],
+                       remote_edges: Dict[int, List[Tuple]]) -> None:
+        """Send activations for one completed task.
+
+        remote_edges: dst_rank -> [(succ_tc_id, succ_locals, flow_name,
+        out_flow_idx), ...]; flow_payloads: out_flow_idx -> host ndarray.
+        One message per output flow per broadcast tree (the reference
+        aggregates by remote_deps struct, remote_dep.h:143-160).
+        """
+        by_flow: Dict[int, Dict[int, List[Tuple]]] = {}
+        for dst, edges in remote_edges.items():
+            for e in edges:
+                by_flow.setdefault(e[3], {}).setdefault(dst, []).append(e)
+        for out_idx, dsts in by_flow.items():
+            ranks = sorted(dsts)
+            payload_arr = flow_payloads.get(out_idx)
+            msg = {
+                "tp_id": tp.comm_tp_id,
+                "root": self.rank,
+                "ranks": ranks,                      # bcast participants
+                "edges": {r: dsts[r] for r in ranks},
+                "src_task": getattr(task, "locals", None),
+            }
+            inline = payload_arr is None or payload_arr.nbytes <= self.short_limit
+            if inline:
+                msg["data"] = payload_arr
+            else:
+                # SNAPSHOT the payload: a local successor released by the
+                # same completion may mutate the live host copy in place
+                # before the remote GET is served (the inline path copies
+                # at send time via the wire)
+                handle = self.ce.mem_register(np.array(payload_arr))
+                # every non-root participant eventually GETs from the root
+                tp.add_pending_action(1)
+                self._pending_handles[handle.handle_id] = (tp, len(ranks), handle)
+                msg["handle"] = handle.handle_id
+                msg["data_rank"] = self.rank
+                msg["nbytes"] = payload_arr.nbytes
+            # root (position 0 implicitly = the sender) forwards to children
+            positions = [self.rank] + ranks  # root first
+            for child_pos in bcast_children(0, len(positions), self.topology):
+                self.ce.send_am(positions[child_pos], TAG_ACTIVATE, msg)
+                self.stats["activates_sent"] += 1
+
+    def _on_activate(self, src: int, msg: Dict) -> None:
+        self.stats["activates_recv"] += 1
+        tp = self._taskpools.get(msg["tp_id"])
+        assert tp is not None, f"activate for unknown taskpool {msg['tp_id']}"
+        # re-forward to my children in the bcast tree
+        positions = [msg["root"]] + list(msg["ranks"])
+        me_pos = positions.index(self.rank)
+        for child_pos in bcast_children(me_pos, len(positions), self.topology):
+            self.ce.send_am(positions[child_pos], TAG_ACTIVATE, msg)
+            self.stats["forwards"] += 1
+        my_edges = msg["edges"].get(self.rank, [])
+        if not my_edges:
+            return
+        if "data" in msg or msg.get("handle") is None:
+            self._deliver_activation(tp, my_edges, msg.get("data"))
+        else:
+            # rendezvous: GET the payload from the data holder
+            def on_data(arr):
+                self._deliver_activation(tp, my_edges, arr)
+            self.ce.get(msg["data_rank"], msg["handle"], on_data)
+
+    def _deliver_activation(self, tp, edges: List[Tuple], arr) -> None:
+        """Incoming data releases local successors
+        (ref: remote_dep_release_incoming, remote_dep_mpi.c:997)."""
+        copy = None
+        if arr is not None:
+            from ..data.data import Data
+            d = Data(nb_elts=arr.size)
+            copy = DataCopy(d, 0, payload=np.asarray(arr))
+            copy.version = 1
+            from ..data.data import Coherency
+            copy.coherency = Coherency.OWNED
+            d.attach_copy(copy)
+        ready = []
+        for (succ_tc_id, succ_locals, flow_name, _out) in edges:
+            tc = tp.task_classes[succ_tc_id]
+            t = tc.activate(tuple(succ_locals), flow_name, copy)
+            if t is not None:
+                ready.append(t)
+        if ready and self.context is not None:
+            es0 = self.context.execution_streams[0]
+            schedule(es0, ready)
+
+    # GET service accounting: the local fabric serves GETs inside
+    # ce.progress; pending handles release when everyone fetched
+    def note_get_served(self, handle_id: int) -> None:
+        ent = self._pending_handles.get(handle_id)
+        if ent is None:
+            return
+        tp, remaining, handle = ent
+        remaining -= 1
+        if remaining == 0:
+            del self._pending_handles[handle_id]
+            self.ce.mem_unregister(handle)  # release the snapshot buffer
+            tp.pending_action_done(1)
+        else:
+            self._pending_handles[handle_id] = (tp, remaining, handle)
+
+    # ------------------------------------------------------------------ #
+    # DTD data plane                                                     #
+    # ------------------------------------------------------------------ #
+    def dtd_send(self, tp, tile_key: Any, seq: int, dst: int,
+                 arr: np.ndarray) -> None:
+        self.ce.send_am(dst, TAG_DTD_DATA,
+                        {"tp_id": tp.comm_tp_id, "tile": tile_key,
+                         "seq": seq, "data": arr})
+        self.stats["dtd_sends"] += 1
+
+    def dtd_expect(self, tile_key: Any, seq: int,
+                   cb: Callable[[np.ndarray], None]) -> None:
+        """Register interest in (tile, seq); fires immediately if already
+        arrived (sender may run ahead of the receiver's insertion)."""
+        key = (tile_key, seq)
+        with self._lock:
+            if key in self._dtd_arrived:
+                arr = self._dtd_arrived.pop(key)
+            else:
+                self._dtd_expect[key] = cb
+                return
+        cb(arr)
+
+    def _on_dtd_data(self, src: int, msg: Dict) -> None:
+        self.stats["dtd_recvs"] += 1
+        key = (msg["tile"], msg["seq"])
+        with self._lock:
+            cb = self._dtd_expect.pop(key, None)
+            if cb is None:
+                self._dtd_arrived[key] = msg["data"]
+                return
+        cb(msg["data"])
+
+    # ------------------------------------------------------------------ #
+    # distributed termination (fourcounter waves ride TAG_TERMDET)       #
+    # ------------------------------------------------------------------ #
+    def termdet_local_quiet(self, tdm) -> None:
+        # Single-counter-per-rank credit scheme is not needed for the
+        # static-count PTG pools or the recv-task-counted DTD pools; the
+        # hook exists for dynamically-discovered distributed pools.
+        tdm.distributed_terminate()
+
+    def _on_termdet(self, src: int, msg: Dict) -> None:  # pragma: no cover
+        pass

@@ -261,7 +261,8 @@ class JaxDevice(Device):
         if writeback and copy.coherency == Coherency.OWNED:
             host = data.get_copy(0)
             if host is not None:
-                host.payload = np.asarray(copy.payload)
+                # np.array (not asarray): jax arrays view as READ-ONLY numpy
+                host.payload = np.array(copy.payload)
                 host.version = copy.version
                 host.coherency = Coherency.OWNED
                 data.owner_device = 0
@@ -290,7 +291,9 @@ class JaxDevice(Device):
         if copy is None or copy.payload is None:
             return None
         host = data.get_copy(0)
-        arr = np.asarray(copy.payload)
+        # np.array (not asarray): numpy views of jax arrays are READ-ONLY,
+        # and host bodies mutate the pulled payload in place
+        arr = np.array(copy.payload)
         if host is None:
             host = DataCopy(data, 0, payload=arr)
             data.attach_copy(host)

@@ -56,22 +56,47 @@ AFFINITY = AccessMode.AFFINITY
 DONT_TRACK = AccessMode.DONT_TRACK
 
 
-class DTDTile:
-    """ref: parsec_dtd_tile_t — tracked unit of data with last-user state."""
+class RemoteWriter:
+    """SPMD-consistent marker: the tile's last write happened on ``rank``
+    and is the ``seq``-th write of the tile."""
 
-    __slots__ = ("key", "rank", "data", "home_collection", "last_writer",
-                 "readers", "lock", "flushed")
+    __slots__ = ("rank", "seq")
+
+    def __init__(self, rank: int, seq: int) -> None:
+        self.rank = rank
+        self.seq = seq
+
+
+class DTDTile:
+    """ref: parsec_dtd_tile_t — tracked unit of data with last-user state.
+
+    Multi-rank fields: ``writers_seq`` counts every write by any rank (the
+    insertion stream is SPMD-identical, so the count agrees everywhere);
+    ``last_writer`` may be a local record or a RemoteWriter; ``recv_proxy``
+    is the local recv-task record materializing a remote write (local-only
+    state used for chaining); ``sent_to`` dedups sends of one version.
+    """
+
+    __slots__ = ("key", "comm_key", "rank", "data", "home_collection",
+                 "last_writer", "readers", "lock", "flushed", "writers_seq",
+                 "sent_to", "recv_proxy", "recv_proxy_seq", "flushed_at_seq")
 
     def __init__(self, key: Any, data: Data, rank: int = 0,
-                 home_collection: Any = None) -> None:
+                 home_collection: Any = None, comm_key: Any = None) -> None:
         self.key = key
+        self.comm_key = comm_key if comm_key is not None else key
         self.rank = rank
         self.data = data
         self.home_collection = home_collection
-        self.last_writer: Optional["_DTDRecord"] = None
+        self.last_writer = None      # _DTDRecord | RemoteWriter | None
         self.readers: List["_DTDRecord"] = []
         self.lock = threading.Lock()
         self.flushed = False
+        self.writers_seq = 0
+        self.sent_to: set = set()
+        self.recv_proxy: Optional["_DTDRecord"] = None
+        self.recv_proxy_seq = -1
+        self.flushed_at_seq = -1  # SPMD-consistent (set at insertion time)
 
 
 class _DTDRecord:
@@ -187,6 +212,7 @@ class DTDTaskpool(Taskpool):
         self.threshold_size = params.get("dtd_threshold_size")
         self._task_classes: Dict[Any, DTDTaskClass] = {}
         self._tiles = HashTable()
+        self._coll_names: Dict[str, int] = {}
         self._outstanding = 0
         self._out_lock = threading.Lock()
         self._inserted = 0
@@ -194,19 +220,32 @@ class DTDTaskpool(Taskpool):
         self.tdm = termdet_new(params.get("termdet") if params.get("termdet") != "fourcounter" else "local", self)
         self.tdm.taskpool_addto_runtime_actions(1)
         self._alive = True
+        self.comm = None  # remote-dep driver, attached on register
 
     # ------------------------------------------------------------------ #
     # tiles                                                              #
     # ------------------------------------------------------------------ #
     def tile_of(self, collection, key: Any) -> DTDTile:
         """ref: parsec_dtd_tile_of (insert_function.h:219) — one DTDTile per
-        (collection, key), memoized."""
+        (collection, key), memoized. The wire key uses the collection *name*
+        so SPMD ranks agree on it (per-rank instances of one logical
+        collection must share a name in multi-rank runs)."""
         tkey = (id(collection), key)
+        # wire keys are (collection.name, key): catch two distinct
+        # collections sharing a name before they cross-deliver tile data
+        owner = self._coll_names.setdefault(collection.name, id(collection))
+        if owner != id(collection):
+            raise ValueError(
+                f"two collections share the name {collection.name!r}; "
+                f"set distinct .name values (the name keys tile messages "
+                f"between ranks)")
 
         def factory() -> DTDTile:
-            data = collection.data_of_key(key)
             rank = collection.rank_of_key(key)
-            return DTDTile(key, data, rank=rank, home_collection=collection)
+            data = collection.data_of_key(key) if rank == self.my_rank \
+                else Data(key=("remote", collection.name, key))
+            return DTDTile(key, data, rank=rank, home_collection=collection,
+                           comm_key=(collection.name, key))
         tile, _ = self._tiles.find_or_insert(tkey, factory)
         return tile
 
@@ -268,15 +307,41 @@ class DTDTaskpool(Taskpool):
     # ------------------------------------------------------------------ #
     # insertion                                                          #
     # ------------------------------------------------------------------ #
+    @property
+    def my_rank(self) -> int:
+        return self.context.rank if self.context is not None else 0
+
+    @property
+    def nb_ranks(self) -> int:
+        return self.context.nb_ranks if self.context is not None else 1
+
+    def _task_rank(self, tracked: List[_Param]) -> int:
+        """Placement: AFFINITY param's tile rank, else first written tile,
+        else first tracked tile (ref: PARSEC_AFFINITY placement)."""
+        for p in tracked:
+            if p.mode & AFFINITY:
+                return p.tile.rank
+        for p in tracked:
+            if int(p.mode) & 0x2:
+                return p.tile.rank
+        if tracked:
+            return tracked[0].tile.rank
+        return 0
+
     def insert_task(self, body: Callable, *args, name: Optional[str] = None,
-                    priority: int = 0) -> Task:
+                    priority: int = 0, _internal: bool = False) -> Optional[Task]:
         """ref: parsec_dtd_insert_task (insert_function.h:284, impl :3506).
 
         ``args`` are (value, VALUE) / (tile, INPUT|INOUT|OUTPUT [|AFFINITY...])
-        pairs, or bare Python values (implicitly VALUE).
+        pairs, or bare Python values (implicitly VALUE). SPMD: every rank
+        inserts every task; only the placement rank executes it — the others
+        update tile tracking state and synthesize send tasks for edges
+        leaving their rank (ref: remote deps inferred from rank_of,
+        SURVEY.md §2.2 DTD row).
         """
         assert self._alive, "insert_task after wait()"
-        self._backpressure()
+        if not _internal:
+            self._backpressure()
         # parse the vararg list (ref: __parsec_dtd_taskpool_create_task :3219)
         parsed: List[_Param] = []
         flow_count = 0
@@ -293,15 +358,24 @@ class DTDTaskpool(Taskpool):
             p = _Param(val, mode, val, flow_index=flow_count)
             flow_count += 1
             parsed.append(p)
+        tracked = [p for p in parsed if p.tile is not None]
+        t_rank = self._task_rank(tracked)
+        if t_rank != self.my_rank:
+            self._process_remote_insertion(tracked, t_rank)
+            return None
+        return self._insert_local(body, parsed, tracked, name, priority)
 
-        tc = self._task_class_of(body, flow_count, name)
+    def _insert_local(self, body: Callable, parsed: List[_Param],
+                      tracked: List[_Param], name: Optional[str],
+                      priority: int, hold_deps: int = 0) -> Task:
+        tc = self._task_class_of(body, len(tracked), name)
         task = Task(self, tc, locals_=(self._inserted,), priority=priority)
         self._inserted += 1
         rec = _DTDRecord(task)
+        rec.deps_remaining += hold_deps  # comm-gated tasks (recv) hold extra
         task.dtd = rec
         # per-INSTANCE access modes (the same body may be inserted with
         # different modes; the shared class Flow objects stay untouched)
-        tracked = [p for p in parsed if p.tile is not None]
         task.body_args = tracked
         task.user = parsed
         task.flow_access = [FlowAccess(int(p.mode) & 0x3) for p in tracked]
@@ -325,34 +399,132 @@ class DTDTaskpool(Taskpool):
             tile = p.tile
             acc = int(p.mode) & 0x3
             with tile.lock:
+                # only consumers need the remote data materialized; a pure
+                # OUTPUT has no RAW dep (and cross-rank WAR/WAW is vacuous)
+                local_pred = self._materialize_reader_pred(tile, rec) \
+                    if (acc & 0x1) else (tile.last_writer
+                                         if isinstance(tile.last_writer, _DTDRecord)
+                                         else None)
                 if acc == int(AccessMode.INPUT):
-                    lw = tile.last_writer
-                    if lw is not None and lw is not rec:
-                        _chain_after(lw)
+                    if local_pred is not None and local_pred is not rec:
+                        _chain_after(local_pred)
                     # prune completed readers so read-mostly tiles don't
                     # retain every historical reader record
                     tile.readers = [r for r in tile.readers if not r.completed]
                     tile.readers.append(rec)
                 else:  # OUTPUT or INOUT: chain after writer and all readers
                     preds = []
-                    if tile.last_writer is not None and tile.last_writer is not rec:
-                        preds.append(tile.last_writer)
+                    if local_pred is not None and local_pred is not rec:
+                        preds.append(local_pred)
                     preds.extend(r for r in tile.readers if r is not rec)
                     for pr in preds:
                         _chain_after(pr)
+                    tile.writers_seq += 1
                     tile.last_writer = rec
+                    tile.recv_proxy = None
                     tile.readers = []
-
-        # affinity placement hint
-        for p in tracked:
-            if p.mode & AFFINITY:
-                task.taskpool_affinity_rank = p.tile.rank
-                break
+                    tile.sent_to = set()
 
         # drop the insertion guard; schedule if ready
         if rec.dep_satisfied():
             self._schedule_new(task)
         return task
+
+    def _materialize_reader_pred(self, tile: DTDTile, rec) -> Optional["_DTDRecord"]:
+        """The record a local consumer must chain after. A RemoteWriter (or
+        remotely-homed pristine tile) is materialized by inserting a
+        recv-task whose record becomes the tile's local proxy. Caller holds
+        tile.lock."""
+        lw = tile.last_writer
+        if isinstance(lw, _DTDRecord):
+            return lw
+        if isinstance(lw, RemoteWriter):
+            seq = lw.seq
+        elif lw is None and tile.rank != self.my_rank:
+            seq = tile.writers_seq  # home data, possibly never written
+        else:
+            return None  # pristine local tile: no predecessor
+        if tile.recv_proxy is not None and tile.recv_proxy_seq == seq:
+            return tile.recv_proxy
+        proxy = self._insert_recv(tile, seq)
+        tile.recv_proxy = proxy
+        tile.recv_proxy_seq = seq
+        return proxy
+
+    def _insert_recv(self, tile: DTDTile, seq: int) -> "_DTDRecord":
+        """Insert the comm-gated recv-task materializing (tile, seq).
+        Caller holds tile.lock — the recv chains after current local readers
+        manually to avoid re-entering the tracking logic."""
+        box: Dict[str, Any] = {}
+        task = self._insert_local(
+            _dtd_recv_body,
+            [_Param(box, VALUE | REF, None), _Param(tile, VALUE | REF, None)],
+            [], name="dtd_recv", priority=0, hold_deps=1)
+        rec = task.dtd
+        # the recv overwrites the tile: order it after live local readers
+        for r in tile.readers:
+            if not r.completed:
+                with rec.lock:
+                    rec.deps_remaining += 1
+                if not r.add_successor(rec):
+                    rec.dep_satisfied()
+        tile.readers = []
+        assert self.comm is not None, \
+            "multi-rank DTD requires a comm engine"
+        tp = self
+
+        def on_data(arr):
+            box["data"] = arr
+            if rec.dep_satisfied():
+                tp._schedule_new(task)
+        self.comm.dtd_expect(tile.comm_key, seq, on_data)
+        return rec
+
+    def _process_remote_insertion(self, tracked: List[_Param],
+                                  t_rank: int) -> None:
+        """A task placed on another rank: emit sends for data leaving my
+        rank, update SPMD tile tracking."""
+        for p in tracked:
+            tile = p.tile
+            acc = int(p.mode) & 0x3
+            with tile.lock:
+                reads = bool(acc & 0x1)
+                if reads:
+                    lw = tile.last_writer
+                    i_hold = isinstance(lw, _DTDRecord) or \
+                        (lw is None and tile.rank == self.my_rank)
+                    if i_hold and (t_rank, tile.writers_seq) not in tile.sent_to:
+                        tile.sent_to.add((t_rank, tile.writers_seq))
+                        self._insert_send(tile, tile.writers_seq, t_rank)
+                if acc & 0x2:  # the remote task writes a new version
+                    tile.writers_seq += 1
+                    tile.last_writer = RemoteWriter(t_rank, tile.writers_seq)
+                    tile.recv_proxy = None
+                    # KEEP live local readers (incl. the send just inserted):
+                    # a future recv of the new version chains after them, so
+                    # the in-place overwrite of the host payload stays
+                    # ordered behind every consumer of the old version
+                    tile.readers = [r for r in tile.readers if not r.completed]
+                    tile.sent_to = set()
+
+    def _insert_send(self, tile: DTDTile, seq: int, dst: int) -> None:
+        """Insert the send-task shipping (tile, seq) to ``dst``. Caller
+        holds tile.lock; the send chains after the local writer manually."""
+        task = self._insert_local(
+            _dtd_send_body,
+            [_Param((tile, seq, dst), VALUE | REF, None)],
+            [], name="dtd_send", priority=0, hold_deps=1)
+        rec = task.dtd
+        lw = tile.last_writer
+        if isinstance(lw, _DTDRecord) and lw is not rec:
+            with rec.lock:
+                rec.deps_remaining += 1
+            if not lw.add_successor(rec):
+                rec.dep_satisfied()
+        tile.readers.append(rec)
+        # chaining complete: drop the hold (may schedule right away)
+        if rec.dep_satisfied():
+            self._schedule_new(task)
 
     def _schedule_new(self, task: Task) -> None:
         ctx = self.context
@@ -388,13 +560,17 @@ class DTDTaskpool(Taskpool):
         """ref: parsec_dtd_data_flush — order a writeback of the tile to its
         home (host copy / collection storage) after its last user. One shared
         task class serves every flush (a per-call closure would exhaust the
-        25-class limit)."""
-        self.insert_task(_dtd_flush_body, (tile, INOUT), (tile, VALUE | REF),
-                         name="dtd_flush")
+        25-class limit). The dedup marker is set at INSERTION time so every
+        SPMD rank makes the same decision (an execution-time flag would only
+        flip on the home rank and diverge the insertion streams)."""
+        self.insert_task(_dtd_flush_body, (tile, INOUT | AFFINITY),
+                         (tile, VALUE | REF), name="dtd_flush",
+                         _internal=True)
+        tile.flushed_at_seq = tile.writers_seq
 
     def data_flush_all(self) -> None:
         for _, tile in self._tiles.items():
-            if not tile.flushed:
+            if tile.flushed_at_seq != tile.writers_seq:
                 self.data_flush(tile)
 
     def wait(self) -> None:
@@ -432,6 +608,30 @@ def _dtd_flush_body(es, task: Task) -> None:
     tile: DTDTile = next(p.value for p in task.user if p.tile is None)
     tile.data.sync_to_host(es.context.devices)
     tile.flushed = True
+
+
+def _dtd_recv_body(es, task: Task) -> None:
+    """Comm-gated recv: materialize the received version into the tile's
+    host copy (the task was scheduled only after the data arrived)."""
+    box = task.user[0].value
+    tile: DTDTile = task.user[1].value
+    arr = box["data"]
+    host = tile.data.host_copy()
+    if host.payload is None:
+        host.payload = np.array(arr)
+    else:
+        np.copyto(host.payload, arr)
+    tile.data.version_bump(0)
+
+
+def _dtd_send_body(es, task: Task) -> None:
+    """Ship the tile's current version to the destination rank."""
+    tile, seq, dst = task.user[0].value
+    tp: DTDTaskpool = task.taskpool
+    host = tile.data.sync_to_host(es.context.devices)
+    assert host.payload is not None, \
+        f"dtd_send of tile {tile.comm_key} with no local payload"
+    tp.comm.dtd_send(tp, tile.comm_key, seq, dst, np.asarray(host.payload))
 
 
 def taskpool_new(name: str = "dtd") -> DTDTaskpool:

@@ -190,7 +190,7 @@ class PTGTaskClass(TaskClass):
         return self.tp.new_scratch_copy(f, env)
 
     def _iterate_successors(self, es, task: Task, cb: Callable) -> None:
-        """cb(succ_tc, succ_locals, succ_flow_name, copy, out_flow) per
+        """cb(succ_tc, succ_locals, succ_flow_name, copy, out_flow_idx) per
         satisfied output edge (ref: generated iterate_successors)."""
         env = self.env_of(task.locals)
         for i, f in enumerate(self.ast.flows):
@@ -203,24 +203,43 @@ class PTGTaskClass(TaskClass):
                     continue  # handled in prepare_output (writeback)
                 succ_tc = self.tp.class_by_name(t.task_class)
                 for succ_locals in _expand_args(t.args, env, succ_tc):
-                    cb(succ_tc, succ_locals, t.flow, copy, f)
+                    cb(succ_tc, succ_locals, t.flow, copy, i)
 
     def _release_deps(self, es, task: Task, action_mask: int) -> List[Task]:
+        """Local successors activate in place; remote ones accumulate into a
+        per-rank batch handed to the comm engine as one activation per output
+        flow (ref: parsec_remote_deps_t accumulation, remote_dep.h:143-160)."""
         ready: List[Task] = []
+        remote_edges: Dict[int, List[Tuple]] = {}
+        flow_payloads: Dict[int, Any] = {}
 
         def activate(succ_tc: "PTGTaskClass", succ_locals: Tuple,
-                     flow_name: str, copy, out_flow) -> None:
+                     flow_name: str, copy, out_idx: int) -> None:
             env = succ_tc.env_of(succ_locals)
-            if succ_tc.rank_of_instance(env) != self.tp.rank:
-                # remote successor: routed through the comm engine
-                self.tp.remote_activate(es, task, succ_tc, succ_locals,
-                                        flow_name, copy)
+            dst = succ_tc.rank_of_instance(env)
+            if dst == self.tp.rank:
+                t = succ_tc.activate(succ_locals, flow_name, copy)
+                if t is not None:
+                    ready.append(t)
                 return
-            t = succ_tc.activate(succ_locals, flow_name, copy)
-            if t is not None:
-                ready.append(t)
+            if self.tp.comm is None:
+                raise RuntimeError(
+                    f"{self.tp.name}: task {task.snprintf()} has a remote "
+                    f"successor {succ_tc.name}{succ_locals} but no comm "
+                    f"engine is attached (nb_ranks={self.tp.nb_ranks})")
+            remote_edges.setdefault(dst, []).append(
+                (succ_tc.task_class_id, succ_locals, flow_name, out_idx))
+            if out_idx not in flow_payloads and copy is not None:
+                if copy.data is not None:
+                    host = copy.data.sync_to_host(es.context.devices)
+                    flow_payloads[out_idx] = np.asarray(host.payload)
+                else:
+                    flow_payloads[out_idx] = np.asarray(copy.payload)
 
         self._iterate_successors(es, task, activate)
+        if remote_edges:
+            self.tp.comm.activate_batch(self.tp, task, flow_payloads,
+                                        remote_edges)
         return ready
 
     def activate(self, locals_: Tuple, flow_name: str, copy) -> Optional[Task]:
@@ -389,21 +408,6 @@ class PTGTaskpool(Taskpool):
         self.startup_hook = self._startup
         self.nb_local_tasks = 0
         self.comm = None  # remote-dep driver, attached by the comm engine
-        if nb_ranks > 1:
-            # multi-rank execution requires the comm engine to attach before
-            # the taskpool is enqueued (see comm/remote_dep.py)
-            pass
-
-    def remote_activate(self, es, task, succ_tc, succ_locals, flow_name, copy):
-        """A successor lives on another rank: hand the edge to the comm
-        engine (ref: parsec_remote_dep_activate, remote_dep.c:454)."""
-        if self.comm is None:
-            raise RuntimeError(
-                f"{self.name}: task {task.snprintf()} has a remote successor "
-                f"{succ_tc.name}{succ_locals} but no comm engine is attached "
-                f"(nb_ranks={self.nb_ranks})")
-        self.comm.send_activate(self, task, succ_tc, succ_locals,
-                                flow_name, copy)
 
     def class_by_name(self, name: str) -> PTGTaskClass:
         return self._classes[name]

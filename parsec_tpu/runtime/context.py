@@ -39,6 +39,14 @@ class Context:
         self.rank = rank
         self.nb_ranks = nb_ranks
         self.comm = comm                       # comm engine / remote-dep driver
+        # comm binding first: it defines our rank, which profiling and
+        # device setup label their output with
+        # (ref: parsec_remote_dep_init parsec.c:796)
+        if comm is not None and hasattr(comm, "attach"):
+            comm.attach(self)
+            self.rank = comm.rank
+            self.nb_ranks = comm.nb_ranks
+            rank = self.rank
         self.vpmap = vpmap or VPMap.from_flat(nb_cores or default_nb_cores())
         self.nb_cores = self.vpmap.nb_total_threads
 

@@ -1,0 +1,266 @@
+"""Multi-rank tests over the in-process fabric (the reference's analog:
+every distributed behavior validated by oversubscribed mpiexec on one node,
+SURVEY.md §4). SPMD: one thread per rank, each with its own Context.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+import parsec_tpu
+from parsec_tpu.comm import LocalFabric, RemoteDepEngine, bcast_children
+from parsec_tpu.collections import DictCollection, TwoDimBlockCyclic
+from parsec_tpu.dsl import dtd, ptg
+from parsec_tpu.dsl.dtd import AFFINITY, INOUT, INPUT, VALUE, unpack_args
+
+
+def spmd(nb_ranks, fn, timeout=60):
+    """Run fn(rank, fabric) on one thread per rank; propagate exceptions."""
+    fabric = LocalFabric(nb_ranks)
+    errors = [None] * nb_ranks
+    results = [None] * nb_ranks
+
+    def runner(r):
+        try:
+            results[r] = fn(r, fabric)
+        except BaseException as e:  # noqa: BLE001
+            errors[r] = e
+
+    threads = [threading.Thread(target=runner, args=(r,), daemon=True)
+               for r in range(nb_ranks)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+        assert not t.is_alive(), "rank thread hung"
+    for e in errors:
+        if e is not None:
+            raise e
+    return results, fabric
+
+
+def test_bcast_children_topologies():
+    # star: root sends to everyone
+    assert bcast_children(0, 5, "star") == [1, 2, 3, 4]
+    assert bcast_children(2, 5, "star") == []
+    # chain: each forwards to the next
+    assert bcast_children(0, 4, "chain") == [1]
+    assert bcast_children(2, 4, "chain") == [3]
+    assert bcast_children(3, 4, "chain") == []
+    # binomial: tree coverage — every position reached exactly once
+    for nb in (2, 3, 4, 5, 8, 13):
+        reached = {0}
+        frontier = [0]
+        while frontier:
+            nxt = []
+            for p in frontier:
+                for c in bcast_children(p, nb, "binomial"):
+                    assert c not in reached, f"nb={nb}: {c} reached twice"
+                    reached.add(c)
+                    nxt.append(c)
+            frontier = nxt
+        assert reached == set(range(nb)), f"nb={nb}: {sorted(reached)}"
+
+
+CHAIN_JDF = """
+descA [ type="collection" ]
+NB [ type="int" ]
+
+Step(k)
+
+k = 0 .. NB
+
+: descA( k, 0 )
+
+RW A <- (k == 0) ? descA( k, 0 ) : A Step( k-1 )
+     -> (k == NB) ? descA( k, 0 ) : A Step( k+1 )
+
+BODY
+{
+    A[0, 0] += 1.0
+}
+END
+"""
+
+
+def _ptg_chain_rank(rank, fabric, nb_ranks, NB, tile=4):
+    eng = RemoteDepEngine(fabric.engine(rank))
+    ctx = parsec_tpu.Context(nb_cores=1, comm=eng, enable_tpu=False)
+    try:
+        coll = TwoDimBlockCyclic((NB + 1) * tile, tile, tile, tile,
+                                 P=nb_ranks, Q=1, nodes=nb_ranks, rank=rank)
+        coll.name = "descA"
+        tp = ptg.compile_jdf(CHAIN_JDF, name="chain").new(
+            descA=coll, NB=NB, rank=rank, nb_ranks=nb_ranks)
+        ctx.add_taskpool(tp)
+        ctx.wait()
+        # collect final values of my local tiles
+        out = {}
+        for k in range(NB + 1):
+            if coll.rank_of(k, 0) == rank:
+                out[k] = float(coll.tile(k, 0)[0, 0])
+        return out
+    finally:
+        ctx.fini()
+
+
+@pytest.mark.parametrize("nb_ranks", [2, 4])
+def test_ptg_chain_across_ranks(nb_ranks):
+    """Ex04-style chain where consecutive tasks live on different ranks:
+    every hop is a remote dep (activation + data)."""
+    NB = 7
+    results, fabric = spmd(nb_ranks,
+                           lambda r, f: _ptg_chain_rank(r, f, nb_ranks, NB))
+    merged = {}
+    for r in results:
+        merged.update(r)
+    # the datum flows through task copies: tile 0 was incremented in place
+    # by task 0, tiles 1..NB-1 are untouched, tile NB gets the final
+    # writeback after NB+1 increments
+    expect = {k: 0.0 for k in range(NB + 1)}
+    expect[0] = 1.0
+    expect[NB] = float(NB + 1)
+    assert merged == expect
+    assert fabric.msg_count > 0
+
+
+BCAST_JDF = """
+descA [ type="collection" ]
+NR [ type="int" ]
+
+Root(k)
+k = 0 .. 0
+: descA( 0, 0 )
+RW A <- descA( 0, 0 )
+     -> A Leaf( 1 .. NR-1 )
+BODY
+{
+    A[0, 0] = 77.0
+}
+END
+
+Leaf(r)
+r = 1 .. NR-1
+: descA( r, 0 )
+READ A <- A Root( 0 )
+BODY
+{
+    got.append(float(A[0, 0]))
+}
+END
+"""
+
+
+@pytest.mark.parametrize("topology", ["star", "chain", "binomial"])
+def test_ptg_broadcast_topologies(topology):
+    """One root datum broadcast to every other rank under each topology
+    (ref: runtime_comm_coll_bcast, remote_dep.c:272-295)."""
+    nb_ranks = 4
+    parsec_tpu.params.reset()
+    parsec_tpu.params.set_cmdline("runtime_comm_coll_bcast", topology)
+
+    got_all = [[] for _ in range(nb_ranks)]
+
+    def rank_fn(rank, fabric):
+        eng = RemoteDepEngine(fabric.engine(rank))
+        ctx = parsec_tpu.Context(nb_cores=1, comm=eng, enable_tpu=False)
+        try:
+            coll = TwoDimBlockCyclic(nb_ranks * 4, 4, 4, 4, P=nb_ranks, Q=1,
+                                     nodes=nb_ranks, rank=rank)
+            coll.name = "descA"
+            tp = ptg.compile_jdf(BCAST_JDF, name="bcast").new(
+                descA=coll, NR=nb_ranks, rank=rank, nb_ranks=nb_ranks)
+            tp.global_env["got"] = got_all[rank]
+            ctx.add_taskpool(tp)
+            ctx.wait()
+        finally:
+            ctx.fini()
+
+    spmd(nb_ranks, rank_fn)
+    parsec_tpu.params.reset()
+    assert got_all[0] == []
+    for r in range(1, nb_ranks):
+        assert got_all[r] == [77.0], f"rank {r}: {got_all[r]}"
+
+
+def test_ptg_rendezvous_large_payload():
+    """Payloads over the short limit must travel via the GET rendezvous."""
+    nb_ranks = 2
+    parsec_tpu.params.reset()
+    parsec_tpu.params.set_cmdline("runtime_comm_short_limit", "64")
+
+    def rank_fn(rank, fabric):
+        eng = RemoteDepEngine(fabric.engine(rank))
+        ctx = parsec_tpu.Context(nb_cores=1, comm=eng, enable_tpu=False)
+        try:
+            coll = TwoDimBlockCyclic(2 * 64, 64, 64, 64, P=2, Q=1,
+                                     nodes=2, rank=rank)
+            coll.name = "descA"
+            tp = ptg.compile_jdf(CHAIN_JDF, name="chain").new(
+                descA=coll, NB=1, rank=rank, nb_ranks=2)
+            ctx.add_taskpool(tp)
+            ctx.wait()
+            if coll.rank_of(1, 0) == rank:
+                return float(coll.tile(1, 0)[0, 0])
+        finally:
+            ctx.fini()
+
+    results, fabric = spmd(nb_ranks, rank_fn)
+    parsec_tpu.params.reset()
+    assert 2.0 in [r for r in results if r is not None]
+
+
+def test_dtd_cross_rank_chain():
+    """DTD chain on one tile with tasks alternating between 2 ranks: every
+    edge is a cross-rank RAW resolved by (tile, seq) matching."""
+    nb_ranks = 2
+    N = 6
+
+    def rank_fn(rank, fabric):
+        eng = RemoteDepEngine(fabric.engine(rank))
+        ctx = parsec_tpu.Context(nb_cores=1, comm=eng, enable_tpu=False)
+        try:
+            # tile homed on rank 0
+            coll = DictCollection(nodes=nb_ranks, rank=rank)
+            coll.name = "C"
+            coll.add("x", 0, np.zeros(2) if rank == 0 else None)
+            # per-rank anchor tiles to place tasks via AFFINITY
+            anchors = {}
+            for r in range(nb_ranks):
+                a = DictCollection(nodes=nb_ranks, rank=rank)
+                a.name = f"anchor{r}"
+                a.add("a", r, np.zeros(1) if r == rank else None)
+                anchors[r] = a
+            tp = dtd.taskpool_new("xchain")
+            ctx.add_taskpool(tp)
+            tile = tp.tile_of(coll, "x")
+            history = []
+
+            def bump(es, task):
+                x, anchor, k = unpack_args(task)
+                assert x[0] == k, f"task {k} saw {x[0]}"
+                x[0] += 1.0
+                history.append(k)
+
+            for k in range(N):
+                owner = k % nb_ranks
+                at = tp.tile_of(anchors[owner], "a")
+                tp.insert_task(bump, (tile, INOUT),
+                               (at, INPUT | AFFINITY), (k, VALUE))
+            tp.data_flush_all()
+            tp.wait()
+            ctx.wait()
+            final = None
+            if rank == 0:
+                final = float(coll.data_of("x").get_copy(0).payload[0])
+            return (history, final)
+        finally:
+            ctx.fini()
+
+    results, fabric = spmd(nb_ranks, rank_fn)
+    hist0, final0 = results[0]
+    hist1, _ = results[1]
+    assert hist0 == [0, 2, 4]
+    assert hist1 == [1, 3, 5]
+    assert final0 == float(N)  # flushed back home
+    assert fabric.msg_count > 0
