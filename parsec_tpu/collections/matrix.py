@@ -89,7 +89,8 @@ class TiledMatrix(DataCollection):
         d.version_bump(0)
 
     def tile(self, m: int, n: int) -> np.ndarray:
-        return self.data_of(m, n).get_copy(0).payload
+        """Host view of the tile, synced from the newest device copy."""
+        return self.data_of(m, n).sync_to_host().payload
 
     def to_numpy(self) -> np.ndarray:
         """Assemble the full (local) matrix; missing symmetric tiles are

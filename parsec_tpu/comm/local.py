@@ -15,7 +15,8 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from ..core.lists import Fifo
-from .engine import CommEngine, MemHandle, TAG_GET_DATA, TAG_GET_REQ
+from .engine import (CommEngine, MemHandle, TAG_GET_DATA, TAG_GET_REQ,
+                     TAG_PUT_DATA)
 
 
 class LocalFabric:
@@ -74,6 +75,7 @@ class LocalCommEngine(CommEngine):
         self._lock = threading.Lock()
         self.tag_register(TAG_GET_REQ, self._on_get_req)
         self.tag_register(TAG_GET_DATA, self._on_get_data)
+        self.tag_register(TAG_PUT_DATA, self._on_put_data)
 
     # -- AMs ----------------------------------------------------------------
     def send_am(self, dst: int, tag: int, payload: Any) -> None:
@@ -107,13 +109,17 @@ class LocalCommEngine(CommEngine):
 
     def put(self, dst_rank: int, remote_handle_id: int, array: Any,
             on_complete: Optional[Callable] = None) -> None:
-        def deliver(src, payload):
-            pass
-        self.send_am(dst_rank, TAG_GET_DATA,
-                     {"token": None, "put_handle": remote_handle_id,
-                      "data": array})
+        """One-sided put: copy into the remote registered region
+        (PUT-data AM applied on the receiver's progress)."""
+        self.send_am(dst_rank, TAG_PUT_DATA,
+                     {"handle": remote_handle_id, "data": array})
         if on_complete is not None:
             on_complete(array)
+
+    def _on_put_data(self, src: int, payload: Any) -> None:
+        h = self._mem.get(payload["handle"])
+        assert h is not None, f"PUT for unknown mem handle {payload['handle']}"
+        np.copyto(h.array, payload["data"])
 
     # -- progress -----------------------------------------------------------
     def progress(self) -> int:

@@ -24,7 +24,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..data.data import DataCopy
+from ..data.data import Coherency, Data, DataCopy
 from ..runtime.scheduling import schedule
 from ..utils import logging as plog
 from ..utils.params import params
@@ -180,11 +180,9 @@ class RemoteDepEngine:
         (ref: remote_dep_release_incoming, remote_dep_mpi.c:997)."""
         copy = None
         if arr is not None:
-            from ..data.data import Data
             d = Data(nb_elts=arr.size)
             copy = DataCopy(d, 0, payload=np.asarray(arr))
             copy.version = 1
-            from ..data.data import Coherency
             copy.coherency = Coherency.OWNED
             d.attach_copy(copy)
         ready = []
@@ -200,17 +198,21 @@ class RemoteDepEngine:
     # GET service accounting: the local fabric serves GETs inside
     # ce.progress; pending handles release when everyone fetched
     def note_get_served(self, handle_id: int) -> None:
-        ent = self._pending_handles.get(handle_id)
-        if ent is None:
-            return
-        tp, remaining, handle = ent
-        remaining -= 1
+        # progress() fans out to every idle worker: the decrement must be
+        # atomic or concurrent GET-serves lose counts and wait() hangs
+        with self._lock:
+            ent = self._pending_handles.get(handle_id)
+            if ent is None:
+                return
+            tp, remaining, handle = ent
+            remaining -= 1
+            if remaining == 0:
+                del self._pending_handles[handle_id]
+            else:
+                self._pending_handles[handle_id] = (tp, remaining, handle)
         if remaining == 0:
-            del self._pending_handles[handle_id]
             self.ce.mem_unregister(handle)  # release the snapshot buffer
             tp.pending_action_done(1)
-        else:
-            self._pending_handles[handle_id] = (tp, remaining, handle)
 
     # ------------------------------------------------------------------ #
     # DTD data plane                                                     #
@@ -222,11 +224,13 @@ class RemoteDepEngine:
                          "seq": seq, "data": arr})
         self.stats["dtd_sends"] += 1
 
-    def dtd_expect(self, tile_key: Any, seq: int,
+    def dtd_expect(self, tp, tile_key: Any, seq: int,
                    cb: Callable[[np.ndarray], None]) -> None:
-        """Register interest in (tile, seq); fires immediately if already
-        arrived (sender may run ahead of the receiver's insertion)."""
-        key = (tile_key, seq)
+        """Register interest in (taskpool, tile, seq); fires immediately if
+        already arrived (the sender may run ahead of the receiver's
+        insertion). The taskpool wire id is part of the key: two pools can
+        reuse the same tiles with per-pool write sequences."""
+        key = (tp.comm_tp_id, tile_key, seq)
         with self._lock:
             if key in self._dtd_arrived:
                 arr = self._dtd_arrived.pop(key)
@@ -237,7 +241,7 @@ class RemoteDepEngine:
 
     def _on_dtd_data(self, src: int, msg: Dict) -> None:
         self.stats["dtd_recvs"] += 1
-        key = (msg["tile"], msg["seq"])
+        key = (msg["tp_id"], msg["tile"], msg["seq"])
         with self._lock:
             cb = self._dtd_expect.pop(key, None)
             if cb is None:

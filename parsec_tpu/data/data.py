@@ -196,16 +196,23 @@ class Data(Obj):
                 self._copies[0] = host
             return host
 
-    def sync_to_host(self, devices) -> DataCopy:
+    def sync_to_host(self, devices=None) -> DataCopy:
         """Make the host copy hold the newest version, pulling from the
         owning accelerator if needed. ``devices`` is the context device list
-        indexed by device_id."""
+        indexed by device_id (None: direct conversion, no device-module
+        stats/LRU bookkeeping)."""
         host = self.host_copy()
         newest = self.newest_copy()
         if newest is not None and newest.device_id != 0 and \
                 newest.version > host.version:
-            devices[newest.device_id].pull_to_host(self)
-            host = self.get_copy(0)
+            if devices is not None:
+                devices[newest.device_id].pull_to_host(self)
+                host = self.get_copy(0)
+            else:
+                import numpy as np
+                host.payload = np.array(newest.payload)
+                host.version = newest.version
+                host.coherency = Coherency.SHARED
         return host
 
     def _destruct(self) -> None:

@@ -477,7 +477,7 @@ class DTDTaskpool(Taskpool):
             box["data"] = arr
             if rec.dep_satisfied():
                 tp._schedule_new(task)
-        self.comm.dtd_expect(tile.comm_key, seq, on_data)
+        self.comm.dtd_expect(self, tile.comm_key, seq, on_data)
         return rec
 
     def _process_remote_insertion(self, tracked: List[_Param],

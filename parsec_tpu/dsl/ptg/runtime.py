@@ -481,8 +481,10 @@ class PTGTaskpool(Taskpool):
                 args = [a(env) for a in t.args]
                 dest = coll.data_of(*args)
                 if copy.data is dest:
-                    # already home; make sure host holds the newest bits
-                    self.pull_newest_to_host(es, dest)
+                    # already home: the Data owns the newest (device) copy;
+                    # do NOT force a device->host transfer here — readers
+                    # sync lazily (a per-task d2h pull would serialize the
+                    # DAG on transfer latency)
                     continue
                 src_host = copy if copy.device_id == 0 else None
                 if src_host is None and copy.data is not None:

@@ -1,1 +1,9 @@
-"""models subpackage."""
+"""Model zoo: the flagship 5-axis-parallel transformer + training step."""
+from .transformer import (TransformerConfig, forward_shard, init_params,
+                          loss_shard, param_specs)
+from .train import (adam_init, adam_update, make_forward, make_train_step,
+                    opt_state_specs, shard_params)
+
+__all__ = ["TransformerConfig", "init_params", "param_specs",
+           "forward_shard", "loss_shard", "make_train_step", "make_forward",
+           "adam_init", "adam_update", "opt_state_specs", "shard_params"]

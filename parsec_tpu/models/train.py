@@ -1,0 +1,89 @@
+"""Training step: shard_map'd fwd+bwd+Adam over the 5-axis mesh.
+
+Gradients of replicated leaves are psum'd over exactly the axes the leaf is
+replicated on (parallel.mesh.sync_axes) — the manual-collective discipline
+that keeps dp/sp/pp-distributed compute correct. The optimizer state
+mirrors the parameter sharding, so optimizer math is purely local.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..parallel.mesh import shard_map_compat, sync_axes
+from .transformer import (TransformerConfig, init_params, loss_shard,
+                          param_specs)
+
+
+def adam_init(params: Any) -> Dict[str, Any]:
+    zeros = jax.tree.map(jnp.zeros_like, params)
+    return {"mu": zeros, "nu": jax.tree.map(jnp.zeros_like, params),
+            "count": jnp.zeros((), jnp.int32)}
+
+
+def adam_update(params, grads, state, lr=1e-3, b1=0.9, b2=0.999, eps=1e-8):
+    count = state["count"] + 1
+    mu = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g, state["mu"], grads)
+    nu = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * g * g,
+                      state["nu"], grads)
+    c1 = 1 - b1 ** count.astype(jnp.float32)
+    c2 = 1 - b2 ** count.astype(jnp.float32)
+    new_params = jax.tree.map(
+        lambda p, m, v: p - lr * (m / c1) / (jnp.sqrt(v / c2) + eps),
+        params, mu, nu)
+    return new_params, {"mu": mu, "nu": nu, "count": count}
+
+
+def opt_state_specs(pspecs: Any) -> Dict[str, Any]:
+    return {"mu": pspecs, "nu": pspecs, "count": P()}
+
+
+def make_train_step(cfg: TransformerConfig, mesh, lr: float = 1e-3):
+    """Returns train_step(params, opt_state, tokens, labels) ->
+    (params, opt_state, loss), jit-compiled over the mesh."""
+    pspecs = param_specs(cfg)
+    ospecs = opt_state_specs(pspecs)
+    data_spec = P("dp", "sp")
+
+    def step_shard(params, opt_state, tokens, labels):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_shard(cfg, p, tokens, labels))(params)
+        # replicated leaves: sum gradient contributions over the axes the
+        # computation was distributed across
+        grads = jax.tree.map(
+            lambda g, s: lax.psum(g, sync_axes(s)) if sync_axes(s) else g,
+            grads, pspecs,
+            is_leaf=lambda x: isinstance(x, jax.Array) or hasattr(x, "shape"))
+        new_params, new_state = adam_update(params, grads, opt_state, lr=lr)
+        return new_params, new_state, loss
+
+    smapped = shard_map_compat(
+        step_shard, mesh,
+        in_specs=(pspecs, ospecs, data_spec, data_spec),
+        out_specs=(pspecs, ospecs, P()))
+    return jax.jit(smapped)
+
+
+def make_forward(cfg: TransformerConfig, mesh):
+    """Jittable forward: (params, tokens) -> logits (for inference/entry)."""
+    from .transformer import forward_shard
+    pspecs = param_specs(cfg)
+
+    def fwd_shard(params, tokens):
+        logits, _ = forward_shard(cfg, params, tokens)
+        from ..parallel.pipeline import last_stage_value
+        return last_stage_value(logits, "pp")
+
+    return shard_map_compat(fwd_shard, mesh,
+                            in_specs=(pspecs, P("dp", "sp")),
+                            out_specs=P("dp", "sp"))
+
+
+def shard_params(params, mesh, pspecs):
+    return jax.tree.map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+        params, pspecs)

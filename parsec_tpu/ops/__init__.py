@@ -1,1 +1,10 @@
-"""ops subpackage."""
+"""Tile kernels (XLA/Pallas executables for task BODYs) and tile
+algorithms (dpotrf)."""
+from .linalg import (axpy, gemm, gemm_nn, gemm_nt, potrf, scal, syrk_ln,
+                     transpose, trsm_panel)
+from . import dpotrf as dpotrf_module
+from .dpotrf import dpotrf, dpotrf_factory, dpotrf_taskpool, make_spd
+
+__all__ = ["potrf", "trsm_panel", "syrk_ln", "gemm_nt", "gemm_nn", "gemm",
+           "axpy", "scal", "transpose", "dpotrf", "dpotrf_factory",
+           "dpotrf_taskpool", "make_spd"]

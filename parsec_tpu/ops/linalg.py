@@ -1,0 +1,72 @@
+"""Tile linear-algebra kernels: the BODY payloads of dense tile algorithms.
+
+The reference delegates tile kernels to BLAS/LAPACK (DPLASMA sits on top of
+the runtime; tests use hand-rolled GEMMs, e.g. dtd_test_simple_gemm.c).
+Here each kernel is a jax-jit executable — XLA fuses scale/add into the
+matmul and keeps the MXU fed; jit caches one executable per (shape, dtype)
+so steady-state dispatch is a cache hit.
+
+All kernels are functional (return new arrays) to match the device module's
+stage-out convention; bf16 accumulation is avoided by pinning
+``preferred_element_type`` to f32.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.linalg import solve_triangular as _solve_tri
+
+
+@jax.jit
+def potrf(t: Any) -> Any:
+    """Cholesky of one diagonal tile: T = chol_L(T)."""
+    return jnp.linalg.cholesky(t)
+
+
+@jax.jit
+def trsm_panel(t: Any, c: Any) -> Any:
+    """Right-looking panel solve: C <- C * T^{-T} with T lower triangular
+    (L[m,k] = A[m,k] L[k,k]^{-T})."""
+    return _solve_tri(t, c.T, lower=True).T
+
+
+@jax.jit
+def syrk_ln(t: Any, a: Any) -> Any:
+    """T <- T - A A^T (lower, no-transpose SYRK)."""
+    return t - jnp.dot(a, a.T, preferred_element_type=jnp.float32)
+
+
+@jax.jit
+def gemm_nt(c: Any, a: Any, b: Any) -> Any:
+    """C <- C - A B^T."""
+    return c - jnp.dot(a, b.T, preferred_element_type=jnp.float32)
+
+
+@jax.jit
+def gemm_nn(c: Any, a: Any, b: Any) -> Any:
+    """C <- C + A B."""
+    return c + jnp.dot(a, b, preferred_element_type=jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4))
+def gemm(c: Any, a: Any, b: Any, alpha: float = 1.0, beta: float = 1.0) -> Any:
+    """C <- beta*C + alpha*A@B (general tile GEMM)."""
+    return beta * c + alpha * jnp.dot(a, b, preferred_element_type=jnp.float32)
+
+
+@jax.jit
+def axpy(y: Any, x: Any, alpha: float = 1.0) -> Any:
+    return y + alpha * x
+
+
+@jax.jit
+def scal(x: Any, alpha: float) -> Any:
+    return alpha * x
+
+
+@jax.jit
+def transpose(x: Any) -> Any:
+    return x.T

@@ -1,1 +1,13 @@
-"""parallel subpackage."""
+"""Mesh-level parallelism: the five canonical axes (dp/pp/tp/sp/ep) with
+ring attention, Ulysses sequence parallelism, GPipe pipelining, and
+expert-parallel MoE as compiled XLA collectives over ICI."""
+from .mesh import AXES, make_mesh, shard_map_compat, spec, sync_axes
+from .ring_attention import local_attention, ring_attention
+from .sequence import heads_to_sequence, sequence_to_heads, ulysses_attention
+from .pipeline import gpipe, last_stage_value
+from .moe import load_balance_loss, moe_ffn
+
+__all__ = ["AXES", "make_mesh", "spec", "sync_axes", "shard_map_compat",
+           "ring_attention", "local_attention", "ulysses_attention",
+           "heads_to_sequence", "sequence_to_heads", "gpipe",
+           "last_stage_value", "moe_ffn", "load_balance_loss"]

@@ -1,0 +1,107 @@
+"""Device mesh construction with the five canonical parallel axes.
+
+TPU-native scaling model (SURVEY.md §5.8): pick a mesh, annotate shardings,
+let XLA insert collectives over ICI. Axes: dp (data), pp (pipeline stages),
+tp (tensor/heads), sp (sequence/context), ep (experts). Any axis may be
+size 1 — the sharding code paths stay identical.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+AXES = ("dp", "pp", "tp", "sp", "ep")
+
+
+def _factor(n: int, order: Sequence[str]) -> Dict[str, int]:
+    """Greedy power-of-small-primes factoring of n over the axes in
+    ``order`` (round-robin halving keeps the mesh balanced)."""
+    sizes = {a: 1 for a in AXES}
+    remaining = n
+    # round-robin: repeatedly give the next axis the smallest prime factor
+    i = 0
+    while remaining > 1:
+        p = _smallest_prime(remaining)
+        sizes[order[i % len(order)]] *= p
+        remaining //= p
+        i += 1
+    return sizes
+
+
+def _smallest_prime(n: int) -> int:
+    for p in (2, 3, 5, 7, 11, 13):
+        if n % p == 0:
+            return p
+    return n
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              sizes: Optional[Dict[str, int]] = None,
+              devices: Optional[List] = None,
+              order: Sequence[str] = ("dp", "tp", "sp", "pp", "ep")):
+    """Build a 5-axis jax Mesh over ``n_devices`` (or explicit devices).
+
+    With explicit ``sizes`` missing axes default to 1; otherwise n_devices
+    is factored over ``order``.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devs = jax.devices()
+        if n_devices is not None and len(devs) < n_devices:
+            # a tunneled accelerator plugin may shadow the virtual CPU
+            # mesh (xla_force_host_platform_device_count); fall back to it
+            try:
+                cpu = jax.devices("cpu")
+                if len(cpu) >= n_devices:
+                    devs = cpu
+            except RuntimeError:
+                pass
+        if n_devices is not None:
+            assert len(devs) >= n_devices, \
+                f"need {n_devices} devices, have {len(devs)}"
+            devs = devs[:n_devices]
+    else:
+        devs = list(devices)
+    n = len(devs)
+    if sizes is None:
+        sizes = _factor(n, order)
+    else:
+        sizes = {**{a: 1 for a in AXES}, **sizes}
+    total = int(np.prod([sizes[a] for a in AXES]))
+    assert total == n, f"mesh sizes {sizes} != {n} devices"
+    arr = np.array(devs).reshape([sizes[a] for a in AXES])
+    return Mesh(arr, AXES)
+
+
+def spec(*axes) -> "object":
+    """PartitionSpec shorthand."""
+    from jax.sharding import PartitionSpec as P
+    return P(*axes)
+
+
+def sync_axes(leaf_spec, mesh_axes: Sequence[str] = AXES) -> Tuple[str, ...]:
+    """Mesh axes a parameter is REPLICATED over (its gradients must be
+    psum'd across exactly these after manual-collective backprop)."""
+    used = set()
+    for entry in tuple(leaf_spec):
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            used.update(entry)
+        else:
+            used.add(entry)
+    return tuple(a for a in mesh_axes if a not in used)
+
+
+def shard_map_compat(f, mesh, in_specs, out_specs):
+    """jax.shard_map across jax versions (check_vma vs check_rep kw)."""
+    import jax
+    try:
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    except TypeError:
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_rep=False)

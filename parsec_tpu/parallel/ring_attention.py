@@ -1,0 +1,79 @@
+"""Ring attention: sequence-parallel exact attention over an ICI ring.
+
+Each sp shard holds a local Q/K/V sequence chunk; K/V blocks rotate around
+the ring with ``lax.ppermute`` while a flash-style online softmax
+accumulates (running max + denominator), so memory stays O(T_local) and
+the collective rides neighbor links. Causal masking uses global positions
+reconstructed from the ring step. Differentiable end-to-end (scan +
+ppermute are AD-capable), so the same code serves training.
+
+This fills the reference's sequence-parallelism gap (SURVEY.md §2.8, §5.7)
+the TPU-native way.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def ring_attention(q: Any, k: Any, v: Any, axis_name: str = "sp",
+                   causal: bool = True, scale: float | None = None) -> Any:
+    """q, k, v: [B, H, T_local, Dh] per-shard chunks (inside shard_map over
+    ``axis_name``). Returns [B, H, T_local, Dh]."""
+    sp = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    B, H, Tl, Dh = q.shape
+    if scale is None:
+        scale = Dh ** -0.5
+    q_pos = idx * Tl + jnp.arange(Tl)
+
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    def step(carry, t):
+        k_blk, v_blk, m, l, acc = carry
+        # the block we hold at step t originated on rank (idx - t) mod sp
+        src = (idx - t) % sp
+        k_pos = src * Tl + jnp.arange(Tl)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask[None, None], s, -1e30)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=-1)
+        acc_new = acc * corr[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_blk, preferred_element_type=jnp.float32)
+        k_nxt = lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = lax.ppermute(v_blk, axis_name, perm)
+        return (k_nxt, v_nxt, m_new, l_new, acc_new), None
+
+    m0 = jnp.full((B, H, Tl), -jnp.inf, dtype=jnp.float32)
+    l0 = jnp.zeros((B, H, Tl), dtype=jnp.float32)
+    acc0 = jnp.zeros((B, H, Tl, Dh), dtype=jnp.float32)
+    (k_f, v_f, m, l, acc), _ = lax.scan(
+        step, (k, v, m0, l0, acc0), jnp.arange(sp))
+    out = acc / l[..., None]
+    return out.astype(q.dtype)
+
+
+def local_attention(q: Any, k: Any, v: Any, causal: bool = True,
+                    scale: float | None = None) -> Any:
+    """Plain single-shard attention (used by the Ulysses path after the
+    head<->sequence all-to-all, and as the sp=1 reference)."""
+    B, H, T, Dh = q.shape
+    if scale is None:
+        scale = Dh ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)

@@ -19,6 +19,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import pytest  # noqa: E402
 
+# the axon plugin shadows JAX_PLATFORMS=cpu: pin eager computation to the
+# virtual CPU devices and full matmul precision so references match
+import jax  # noqa: E402
+
+jax.config.update("jax_default_matmul_precision", "highest")
+try:
+    jax.config.update("jax_default_device", jax.devices("cpu")[0])
+except RuntimeError:
+    pass
+
 
 @pytest.fixture
 def ctx():
