@@ -90,3 +90,21 @@ class HashTable:
         for i in range(self._n):
             with self._locks[i]:
                 self._stripes[i].clear()
+
+
+class HashTable64(HashTable):
+    """Hash table restricted to 64-bit integer keys (the reference's
+    ``parsec_key_t`` is a 64-bit word, parsec_hash_table.h:93). Rebound to
+    the native C++ bucket-locked resizable table when available."""
+
+    def keys(self):
+        return [k for k, _ in self.items()]
+
+
+try:
+    from ..native import native as _native
+    if _native is not None:
+        PyHashTable64 = HashTable64
+        HashTable64 = _native.HashTable64  # type: ignore[misc,assignment]
+except ImportError:  # pragma: no cover
+    pass

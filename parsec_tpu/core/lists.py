@@ -4,11 +4,12 @@ Reference behavior: lock-free LIFO (128-bit CAS), FIFO, dequeue, and
 priority-ordered list used by every scheduler (ref: parsec/class/lifo.h,
 parsec/class/parsec_list.h; SURVEY.md §2.1 "Class system").
 
-TPU-native re-design: the host side of this framework is Python + (later)
-a C++ extension; here the containers are mutex-based with the same API and
-semantics (push/pop/chain, priority ordering with FIFO tie-break). The hot
-schedulers use deque which is itself lock-free-ish under the GIL; the C++
-versions can be swapped in behind the same interface.
+TPU-native re-design: the hot containers are implemented in C++
+(``parsec_tpu/native/_native.cpp`` — Treiber-stack LIFO, spinlocked
+deque/FIFO, priority-ordered map) and rebound over the pure-Python
+versions below at import time when the native core builds; the Python
+classes remain as documented fallbacks (``PARSEC_TPU_NATIVE=0``) and
+as the reference implementations for the native stress tests.
 """
 from __future__ import annotations
 
@@ -160,3 +161,17 @@ class OrderedList:
 
     def __len__(self) -> int:
         return len(self._heap)
+
+
+# keep the pure-Python implementations importable under stable names
+PyLifo, PyFifo, PyDequeue, PyOrderedList = Lifo, Fifo, Dequeue, OrderedList
+
+try:  # rebind to the native C++ core when it is available
+    from ..native import native as _native
+    if _native is not None:
+        Lifo = _native.Lifo              # type: ignore[misc,assignment]
+        Fifo = _native.Fifo              # type: ignore[misc,assignment]
+        Dequeue = _native.Dequeue        # type: ignore[misc,assignment]
+        OrderedList = _native.OrderedList  # type: ignore[misc,assignment]
+except ImportError:  # pragma: no cover
+    pass

@@ -84,3 +84,73 @@ class _ArenaChunk:
     def release_copy(self, copy: DataCopy) -> None:
         self.arena.free(self.buf)
         self.buf = None
+
+
+class ZoneMalloc:
+    """Segment-based arena allocator for device-heap offset bookkeeping
+    (ref: parsec/utils/zone_malloc.c — the GPU heap sub-allocator).
+
+    ``malloc(nbytes) -> offset`` (-1 when full, caller evicts), ``free``,
+    with first-fit + coalescing. Backed by the native C++ implementation
+    when available; this Python fallback keeps identical semantics.
+    """
+
+    def __init__(self, total: int, align: int = 512) -> None:
+        if total <= 0 or align <= 0 or (align & (align - 1)):
+            raise ValueError("total must be > 0, align a positive power of two")
+        self.total = total
+        self.align = align
+        self._used = 0
+        self._lock = threading.Lock()
+        self._segs: List[List[int]] = [[0, total, 1]]  # [off, size, free]
+
+    def malloc(self, nbytes: int) -> int:
+        if nbytes <= 0:
+            raise ValueError("nbytes must be > 0")
+        want = (nbytes + self.align - 1) & ~(self.align - 1)
+        with self._lock:
+            for i, seg in enumerate(self._segs):
+                off, size, free = seg
+                if not free or size < want:
+                    continue
+                if size > want:
+                    self._segs.insert(i + 1, [off + want, size - want, 1])
+                    seg[1] = want
+                seg[2] = 0
+                self._used += want
+                return off
+        return -1
+
+    def free(self, offset: int) -> None:
+        with self._lock:
+            for i, seg in enumerate(self._segs):
+                if seg[0] == offset and not seg[2]:
+                    seg[2] = 1
+                    self._used -= seg[1]
+                    if i + 1 < len(self._segs) and self._segs[i + 1][2]:
+                        seg[1] += self._segs[i + 1][1]
+                        del self._segs[i + 1]
+                    if i > 0 and self._segs[i - 1][2]:
+                        self._segs[i - 1][1] += seg[1]
+                        del self._segs[i]
+                    return
+        raise ValueError("invalid or double free")
+
+    def used(self) -> int:
+        return self._used
+
+    def available(self) -> int:
+        return self.total - self._used
+
+    def largest_free(self) -> int:
+        with self._lock:
+            return max((s[1] for s in self._segs if s[2]), default=0)
+
+
+try:  # prefer the native C++ zone allocator
+    from ..native import native as _native
+    if _native is not None:
+        PyZoneMalloc = ZoneMalloc
+        ZoneMalloc = _native.ZoneMalloc  # type: ignore[misc,assignment]
+except ImportError:  # pragma: no cover
+    pass

@@ -1,0 +1,33 @@
+"""Native (C++) runtime core: lock-free containers, hash table, zone-malloc.
+
+The reference implements its entire hot host path in C (SURVEY.md §2.1);
+this package is the equivalent layer for the TPU framework. On import it
+lazily compiles ``_native.cpp`` with g++ and loads the extension. Pure-
+Python fallbacks remain in ``parsec_tpu.core`` — set ``PARSEC_TPU_NATIVE=0``
+to force them (useful for debugging).
+
+Exports: ``native`` (the extension module or None) and ``available``.
+"""
+from __future__ import annotations
+
+import importlib
+import os
+import sys
+
+native = None
+available = False
+
+if os.environ.get("PARSEC_TPU_NATIVE", "1") != "0":
+    try:
+        try:
+            native = importlib.import_module("parsec_tpu.native._parsec_native")
+        except ImportError:
+            from . import build as _build
+            _build.build()
+            native = importlib.import_module("parsec_tpu.native._parsec_native")
+        available = True
+    except Exception as exc:  # pragma: no cover - toolchain-dependent
+        print(f"parsec_tpu: native core unavailable ({exc}); "
+              "using pure-Python containers", file=sys.stderr)
+        native = None
+        available = False

@@ -1,0 +1,831 @@
+/* Native runtime core for parsec_tpu.
+ *
+ * C++ implementations of the hot host-side containers and allocators that
+ * the reference implements in C (SURVEY.md §2.1 "Class system"):
+ *   - Lifo      : Treiber stack            (ref: parsec/class/lifo.h)
+ *   - Fifo      : linked queue             (ref: parsec/class/fifo.h)
+ *   - Dequeue   : double-ended queue       (ref: parsec/class/dequeue.h)
+ *   - OrderedList : priority-sorted list   (ref: parsec/class/parsec_list.h,
+ *                   used by ap/ip/spq schedulers)
+ *   - HashTable64 : bucket-locked resizable hash table with 64-bit keys
+ *                   (ref: parsec/class/parsec_hash_table.c:1-745)
+ *   - ZoneMalloc  : segment-based arena allocator for device-heap
+ *                   bookkeeping (ref: parsec/utils/zone_malloc.c)
+ *
+ * Exposed as the CPython extension module `_parsec_native` (built by
+ * parsec_tpu/native/build.py with g++; no pybind11 in this environment).
+ * Containers store PyObject* with ownership transferred on push and
+ * returned on pop.  Internal spinlocks keep the structures correct when
+ * the GIL is released between bytecodes of concurrent worker threads and
+ * keep the design ready for free-threaded builds.
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <new>
+#include <utility>
+#include <vector>
+
+namespace {
+
+/* ------------------------------------------------------------------ */
+/* small spinlock (containers are held only for pointer swaps)        */
+/* ------------------------------------------------------------------ */
+class SpinLock {
+  std::atomic_flag f_ = ATOMIC_FLAG_INIT;
+ public:
+  void lock() noexcept {
+    while (f_.test_and_set(std::memory_order_acquire)) {
+#if defined(__x86_64__)
+      __builtin_ia32_pause();
+#endif
+    }
+  }
+  void unlock() noexcept { f_.clear(std::memory_order_release); }
+};
+using SpinGuard = std::lock_guard<SpinLock>;
+
+/* ================================================================== */
+/* Lifo                                                               */
+/* ================================================================== */
+struct LifoNode {
+  PyObject* item;
+  LifoNode* next;
+};
+
+struct LifoObject {
+  PyObject_HEAD
+  std::atomic<LifoNode*> head;
+  std::atomic<Py_ssize_t> count;
+};
+
+static PyObject* Lifo_new(PyTypeObject* type, PyObject*, PyObject*) {
+  LifoObject* self = (LifoObject*)type->tp_alloc(type, 0);
+  if (self) {
+    new (&self->head) std::atomic<LifoNode*>(nullptr);
+    new (&self->count) std::atomic<Py_ssize_t>(0);
+  }
+  return (PyObject*)self;
+}
+
+static void lifo_push_node(LifoObject* self, LifoNode* n) {
+  LifoNode* old = self->head.load(std::memory_order_relaxed);
+  do {
+    n->next = old;
+  } while (!self->head.compare_exchange_weak(old, n, std::memory_order_release,
+                                             std::memory_order_relaxed));
+  self->count.fetch_add(1, std::memory_order_relaxed);
+}
+
+static PyObject* Lifo_push(LifoObject* self, PyObject* item) {
+  LifoNode* n = new LifoNode{item, nullptr};
+  Py_INCREF(item);
+  lifo_push_node(self, n);
+  Py_RETURN_NONE;
+}
+
+static PyObject* Lifo_push_chain(LifoObject* self, PyObject* iterable) {
+  PyObject* it = PyObject_GetIter(iterable);
+  if (!it) return nullptr;
+  PyObject* item;
+  while ((item = PyIter_Next(it)) != nullptr) {
+    lifo_push_node(self, new LifoNode{item, nullptr}); /* steals ref */
+  }
+  Py_DECREF(it);
+  if (PyErr_Occurred()) return nullptr;
+  Py_RETURN_NONE;
+}
+
+static PyObject* Lifo_pop(LifoObject* self, PyObject*) {
+  /* CAS pop; ABA is prevented because nodes are only freed here while the
+   * GIL serializes Python-level callers of this function. */
+  LifoNode* old = self->head.load(std::memory_order_acquire);
+  while (old != nullptr &&
+         !self->head.compare_exchange_weak(old, old->next,
+                                           std::memory_order_acquire,
+                                           std::memory_order_acquire)) {
+  }
+  if (old == nullptr) Py_RETURN_NONE;
+  self->count.fetch_sub(1, std::memory_order_relaxed);
+  PyObject* item = old->item; /* ownership transferred to caller */
+  delete old;
+  return item;
+}
+
+static PyObject* Lifo_is_empty(LifoObject* self, PyObject*) {
+  return PyBool_FromLong(self->head.load(std::memory_order_acquire) == nullptr);
+}
+
+static Py_ssize_t Lifo_len(PyObject* o) {
+  return ((LifoObject*)o)->count.load(std::memory_order_relaxed);
+}
+
+static void Lifo_dealloc(LifoObject* self) {
+  LifoNode* n = self->head.load(std::memory_order_relaxed);
+  while (n) {
+    LifoNode* nx = n->next;
+    Py_DECREF(n->item);
+    delete n;
+    n = nx;
+  }
+  Py_TYPE(self)->tp_free((PyObject*)self);
+}
+
+static PyMethodDef Lifo_methods[] = {
+    {"push", (PyCFunction)Lifo_push, METH_O, "Push one item."},
+    {"push_chain", (PyCFunction)Lifo_push_chain, METH_O, "Push an iterable."},
+    {"pop", (PyCFunction)Lifo_pop, METH_NOARGS, "Pop newest or None."},
+    {"try_pop", (PyCFunction)Lifo_pop, METH_NOARGS, "Alias of pop."},
+    {"is_empty", (PyCFunction)Lifo_is_empty, METH_NOARGS, ""},
+    {nullptr, nullptr, 0, nullptr}};
+
+static PySequenceMethods Lifo_as_seq = {Lifo_len};
+
+static PyTypeObject LifoType = []{
+  PyTypeObject t = {PyVarObject_HEAD_INIT(nullptr, 0)};
+  t.tp_name = "_parsec_native.Lifo";
+  t.tp_basicsize = sizeof(LifoObject);
+  t.tp_flags = Py_TPFLAGS_DEFAULT;
+  t.tp_doc = "Lock-free LIFO (Treiber stack).";
+  t.tp_new = Lifo_new;
+  t.tp_dealloc = (destructor)Lifo_dealloc;
+  t.tp_methods = Lifo_methods;
+  t.tp_as_sequence = &Lifo_as_seq;
+  return t;
+}();
+
+/* ================================================================== */
+/* Fifo / Dequeue share a spinlocked std::deque core                   */
+/* ================================================================== */
+struct DequeObject {
+  PyObject_HEAD
+  SpinLock* lock;
+  std::deque<PyObject*>* d;
+};
+
+static PyObject* Deque_new(PyTypeObject* type, PyObject*, PyObject*) {
+  DequeObject* self = (DequeObject*)type->tp_alloc(type, 0);
+  if (self) {
+    self->lock = new SpinLock();
+    self->d = new std::deque<PyObject*>();
+  }
+  return (PyObject*)self;
+}
+
+static void Deque_dealloc(DequeObject* self) {
+  for (PyObject* o : *self->d) Py_DECREF(o);
+  delete self->d;
+  delete self->lock;
+  Py_TYPE(self)->tp_free((PyObject*)self);
+}
+
+static PyObject* Deque_push_back(DequeObject* self, PyObject* item) {
+  Py_INCREF(item);
+  { SpinGuard g(*self->lock); self->d->push_back(item); }
+  Py_RETURN_NONE;
+}
+
+static PyObject* Deque_push_front(DequeObject* self, PyObject* item) {
+  Py_INCREF(item);
+  { SpinGuard g(*self->lock); self->d->push_front(item); }
+  Py_RETURN_NONE;
+}
+
+static int collect_iterable(PyObject* iterable, std::vector<PyObject*>& out) {
+  PyObject* it = PyObject_GetIter(iterable);
+  if (!it) return -1;
+  PyObject* item;
+  while ((item = PyIter_Next(it)) != nullptr) out.push_back(item);
+  Py_DECREF(it);
+  return PyErr_Occurred() ? -1 : 0;
+}
+
+static PyObject* Deque_push_back_chain(DequeObject* self, PyObject* iterable) {
+  std::vector<PyObject*> items;
+  if (collect_iterable(iterable, items) < 0) return nullptr;
+  { SpinGuard g(*self->lock);
+    for (PyObject* o : items) self->d->push_back(o); }
+  Py_RETURN_NONE;
+}
+
+static PyObject* Deque_push_front_chain(DequeObject* self, PyObject* iterable) {
+  std::vector<PyObject*> items;
+  if (collect_iterable(iterable, items) < 0) return nullptr;
+  { SpinGuard g(*self->lock);
+    for (auto r = items.rbegin(); r != items.rend(); ++r)
+      self->d->push_front(*r); }
+  Py_RETURN_NONE;
+}
+
+static PyObject* Deque_pop_front(DequeObject* self, PyObject*) {
+  PyObject* item = nullptr;
+  { SpinGuard g(*self->lock);
+    if (!self->d->empty()) { item = self->d->front(); self->d->pop_front(); } }
+  if (!item) Py_RETURN_NONE;
+  return item;
+}
+
+static PyObject* Deque_pop_back(DequeObject* self, PyObject*) {
+  PyObject* item = nullptr;
+  { SpinGuard g(*self->lock);
+    if (!self->d->empty()) { item = self->d->back(); self->d->pop_back(); } }
+  if (!item) Py_RETURN_NONE;
+  return item;
+}
+
+static PyObject* Deque_is_empty(DequeObject* self, PyObject*) {
+  SpinGuard g(*self->lock);
+  return PyBool_FromLong(self->d->empty());
+}
+
+static Py_ssize_t Deque_len(PyObject* o) {
+  DequeObject* self = (DequeObject*)o;
+  SpinGuard g(*self->lock);
+  return (Py_ssize_t)self->d->size();
+}
+
+static PyMethodDef Dequeue_methods[] = {
+    {"push_front", (PyCFunction)Deque_push_front, METH_O, ""},
+    {"push_back", (PyCFunction)Deque_push_back, METH_O, ""},
+    {"push_front_chain", (PyCFunction)Deque_push_front_chain, METH_O, ""},
+    {"push_back_chain", (PyCFunction)Deque_push_back_chain, METH_O, ""},
+    {"pop_front", (PyCFunction)Deque_pop_front, METH_NOARGS, ""},
+    {"pop_back", (PyCFunction)Deque_pop_back, METH_NOARGS, ""},
+    {"is_empty", (PyCFunction)Deque_is_empty, METH_NOARGS, ""},
+    {nullptr, nullptr, 0, nullptr}};
+
+static PySequenceMethods Deque_as_seq = {Deque_len};
+
+static PyTypeObject DequeueType = []{
+  PyTypeObject t = {PyVarObject_HEAD_INIT(nullptr, 0)};
+  t.tp_name = "_parsec_native.Dequeue";
+  t.tp_basicsize = sizeof(DequeObject);
+  t.tp_flags = Py_TPFLAGS_DEFAULT;
+  t.tp_doc = "Double-ended queue (spinlocked).";
+  t.tp_new = Deque_new;
+  t.tp_dealloc = (destructor)Deque_dealloc;
+  t.tp_methods = Dequeue_methods;
+  t.tp_as_sequence = &Deque_as_seq;
+  return t;
+}();
+
+/* Fifo: the same core, restricted API (push == push_back, pop == front). */
+static PyMethodDef Fifo_methods[] = {
+    {"push", (PyCFunction)Deque_push_back, METH_O, ""},
+    {"push_chain", (PyCFunction)Deque_push_back_chain, METH_O, ""},
+    {"pop", (PyCFunction)Deque_pop_front, METH_NOARGS, ""},
+    {"is_empty", (PyCFunction)Deque_is_empty, METH_NOARGS, ""},
+    {nullptr, nullptr, 0, nullptr}};
+
+static PyTypeObject FifoType = []{
+  PyTypeObject t = {PyVarObject_HEAD_INIT(nullptr, 0)};
+  t.tp_name = "_parsec_native.Fifo";
+  t.tp_basicsize = sizeof(DequeObject);
+  t.tp_flags = Py_TPFLAGS_DEFAULT;
+  t.tp_doc = "FIFO queue (spinlocked).";
+  t.tp_new = Deque_new;
+  t.tp_dealloc = (destructor)Deque_dealloc;
+  t.tp_methods = Fifo_methods;
+  t.tp_as_sequence = &Deque_as_seq;
+  return t;
+}();
+
+/* ================================================================== */
+/* OrderedList: priority-sorted with FIFO tie-break                    */
+/* ================================================================== */
+struct OrderedObject {
+  PyObject_HEAD
+  SpinLock* lock;
+  /* key = (-priority, seq) so begin() is highest priority, oldest first */
+  std::map<std::pair<int64_t, uint64_t>, PyObject*>* m;
+  uint64_t seq;
+};
+
+static PyObject* Ordered_new(PyTypeObject* type, PyObject*, PyObject*) {
+  OrderedObject* self = (OrderedObject*)type->tp_alloc(type, 0);
+  if (self) {
+    self->lock = new SpinLock();
+    self->m = new std::map<std::pair<int64_t, uint64_t>, PyObject*>();
+    self->seq = 0;
+  }
+  return (PyObject*)self;
+}
+
+static void Ordered_dealloc(OrderedObject* self) {
+  for (auto& kv : *self->m) Py_DECREF(kv.second);
+  delete self->m;
+  delete self->lock;
+  Py_TYPE(self)->tp_free((PyObject*)self);
+}
+
+static PyObject* Ordered_push_sorted(OrderedObject* self, PyObject* args) {
+  PyObject* item;
+  long long prio = 0;
+  if (!PyArg_ParseTuple(args, "O|L", &item, &prio)) return nullptr;
+  Py_INCREF(item);
+  { SpinGuard g(*self->lock);
+    self->m->emplace(std::make_pair(-(int64_t)prio, self->seq++), item); }
+  Py_RETURN_NONE;
+}
+
+static PyObject* Ordered_push_sorted_chain(OrderedObject* self, PyObject* args) {
+  PyObject *iterable, *prio_fn;
+  if (!PyArg_ParseTuple(args, "OO", &iterable, &prio_fn)) return nullptr;
+  PyObject* it = PyObject_GetIter(iterable);
+  if (!it) return nullptr;
+  PyObject* item;
+  while ((item = PyIter_Next(it)) != nullptr) {
+    PyObject* pr = PyObject_CallFunctionObjArgs(prio_fn, item, nullptr);
+    if (!pr) { Py_DECREF(item); Py_DECREF(it); return nullptr; }
+    long long prio = PyLong_AsLongLong(pr);
+    Py_DECREF(pr);
+    if (prio == -1 && PyErr_Occurred()) { Py_DECREF(item); Py_DECREF(it); return nullptr; }
+    { SpinGuard g(*self->lock);
+      self->m->emplace(std::make_pair(-(int64_t)prio, self->seq++), item); }
+  }
+  Py_DECREF(it);
+  if (PyErr_Occurred()) return nullptr;
+  Py_RETURN_NONE;
+}
+
+static PyObject* Ordered_pop_front(OrderedObject* self, PyObject*) {
+  PyObject* item = nullptr;
+  { SpinGuard g(*self->lock);
+    auto b = self->m->begin();
+    if (b != self->m->end()) { item = b->second; self->m->erase(b); } }
+  if (!item) Py_RETURN_NONE;
+  return item;
+}
+
+static PyObject* Ordered_pop_back(OrderedObject* self, PyObject*) {
+  PyObject* item = nullptr;
+  { SpinGuard g(*self->lock);
+    if (!self->m->empty()) {
+      auto e = std::prev(self->m->end());
+      item = e->second;
+      self->m->erase(e);
+    } }
+  if (!item) Py_RETURN_NONE;
+  return item;
+}
+
+static PyObject* Ordered_is_empty(OrderedObject* self, PyObject*) {
+  SpinGuard g(*self->lock);
+  return PyBool_FromLong(self->m->empty());
+}
+
+static Py_ssize_t Ordered_len(PyObject* o) {
+  OrderedObject* self = (OrderedObject*)o;
+  SpinGuard g(*self->lock);
+  return (Py_ssize_t)self->m->size();
+}
+
+static PyMethodDef Ordered_methods[] = {
+    {"push_sorted", (PyCFunction)Ordered_push_sorted, METH_VARARGS, ""},
+    {"push_sorted_chain", (PyCFunction)Ordered_push_sorted_chain, METH_VARARGS, ""},
+    {"pop_front", (PyCFunction)Ordered_pop_front, METH_NOARGS, ""},
+    {"pop_back", (PyCFunction)Ordered_pop_back, METH_NOARGS, ""},
+    {"is_empty", (PyCFunction)Ordered_is_empty, METH_NOARGS, ""},
+    {nullptr, nullptr, 0, nullptr}};
+
+static PySequenceMethods Ordered_as_seq = {Ordered_len};
+
+static PyTypeObject OrderedType = []{
+  PyTypeObject t = {PyVarObject_HEAD_INIT(nullptr, 0)};
+  t.tp_name = "_parsec_native.OrderedList";
+  t.tp_basicsize = sizeof(OrderedObject);
+  t.tp_flags = Py_TPFLAGS_DEFAULT;
+  t.tp_doc = "Priority-sorted list, FIFO within equal priority.";
+  t.tp_new = Ordered_new;
+  t.tp_dealloc = (destructor)Ordered_dealloc;
+  t.tp_methods = Ordered_methods;
+  t.tp_as_sequence = &Ordered_as_seq;
+  return t;
+}();
+
+/* ================================================================== */
+/* HashTable64: bucket-locked, resizable, 64-bit keys                  */
+/* ================================================================== */
+struct HT64Entry {
+  uint64_t key;
+  PyObject* value;
+  HT64Entry* next;
+};
+
+struct HT64Object {
+  PyObject_HEAD
+  std::vector<HT64Entry*>* buckets;
+  std::vector<SpinLock>* locks; /* stripes, fixed count */
+  std::atomic<Py_ssize_t> count;
+  SpinLock* resize_lock;
+};
+
+static const size_t HT64_NSTRIPES = 64;
+
+static inline uint64_t ht64_mix(uint64_t k) {
+  /* splitmix64 finalizer */
+  k += 0x9e3779b97f4a7c15ULL;
+  k = (k ^ (k >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  k = (k ^ (k >> 27)) * 0x94d049bb133111ebULL;
+  return k ^ (k >> 31);
+}
+
+static PyObject* HT64_new(PyTypeObject* type, PyObject*, PyObject*) {
+  HT64Object* self = (HT64Object*)type->tp_alloc(type, 0);
+  if (self) {
+    self->buckets = new std::vector<HT64Entry*>(256, nullptr);
+    self->locks = new std::vector<SpinLock>(HT64_NSTRIPES);
+    new (&self->count) std::atomic<Py_ssize_t>(0);
+    self->resize_lock = new SpinLock();
+  }
+  return (PyObject*)self;
+}
+
+static void HT64_dealloc(HT64Object* self) {
+  for (HT64Entry* e : *self->buckets) {
+    while (e) {
+      HT64Entry* nx = e->next;
+      Py_DECREF(e->value);
+      delete e;
+      e = nx;
+    }
+  }
+  delete self->buckets;
+  delete self->locks;
+  delete self->resize_lock;
+  Py_TYPE(self)->tp_free((PyObject*)self);
+}
+
+static void ht64_maybe_resize(HT64Object* self) {
+  size_t nb = self->buckets->size();
+  if ((size_t)self->count.load(std::memory_order_relaxed) < nb * 2) return;
+  /* take all stripe locks in order, then rehash (ref resizes under a
+   * global section the same way: parsec_hash_table.c) */
+  SpinGuard rg(*self->resize_lock);
+  nb = self->buckets->size();
+  if ((size_t)self->count.load(std::memory_order_relaxed) < nb * 2) return;
+  for (auto& l : *self->locks) l.lock();
+  auto* nb_v = new std::vector<HT64Entry*>(nb * 4, nullptr);
+  for (HT64Entry* e : *self->buckets) {
+    while (e) {
+      HT64Entry* nx = e->next;
+      size_t idx = ht64_mix(e->key) & (nb_v->size() - 1);
+      e->next = (*nb_v)[idx];
+      (*nb_v)[idx] = e;
+      e = nx;
+    }
+  }
+  delete self->buckets;
+  self->buckets = nb_v;
+  for (auto& l : *self->locks) l.unlock();
+}
+
+struct HT64Locked {
+  HT64Object* self;
+  size_t stripe;
+  HT64Locked(HT64Object* s, uint64_t h) : self(s), stripe(h % HT64_NSTRIPES) {
+    (*self->locks)[stripe].lock();
+  }
+  ~HT64Locked() { (*self->locks)[stripe].unlock(); }
+};
+
+/* key conversion: accept anything the 'K' format accepts (wraps negative
+ * ints mod 2^64) so insert/find/remove are symmetric */
+static int ht64_key(PyObject* arg, uint64_t* out) {
+  unsigned long long k = PyLong_AsUnsignedLongLongMask(arg);
+  if (k == (unsigned long long)-1 && PyErr_Occurred()) return -1;
+  *out = k;
+  return 0;
+}
+
+static PyObject* HT64_insert(HT64Object* self, PyObject* args) {
+  unsigned long long key;
+  PyObject* value;
+  if (!PyArg_ParseTuple(args, "KO", &key, &value)) return nullptr;
+  uint64_t h = ht64_mix(key);
+  PyObject* replaced = nullptr;
+  {
+    HT64Locked g(self, h);
+    size_t idx = h & (self->buckets->size() - 1);
+    HT64Entry* found = nullptr;
+    for (HT64Entry* e = (*self->buckets)[idx]; e; e = e->next) {
+      if (e->key == key) { found = e; break; }
+    }
+    if (found) {
+      Py_INCREF(value);
+      replaced = found->value; /* DECREF outside the stripe lock: it may
+                                * run __del__ / GC, which can re-enter
+                                * this table on the same stripe */
+      found->value = value;
+    } else {
+      Py_INCREF(value);
+      (*self->buckets)[idx] = new HT64Entry{key, value, (*self->buckets)[idx]};
+      self->count.fetch_add(1, std::memory_order_relaxed);
+    }
+  }
+  Py_XDECREF(replaced);
+  ht64_maybe_resize(self);
+  Py_RETURN_NONE;
+}
+
+static PyObject* HT64_find(HT64Object* self, PyObject* arg) {
+  uint64_t key;
+  if (ht64_key(arg, &key) < 0) return nullptr;
+  uint64_t h = ht64_mix(key);
+  HT64Locked g(self, h);
+  size_t idx = h & (self->buckets->size() - 1);
+  for (HT64Entry* e = (*self->buckets)[idx]; e; e = e->next) {
+    if (e->key == key) {
+      Py_INCREF(e->value);
+      return e->value;
+    }
+  }
+  Py_RETURN_NONE;
+}
+
+static PyObject* HT64_remove(HT64Object* self, PyObject* arg) {
+  uint64_t key;
+  if (ht64_key(arg, &key) < 0) return nullptr;
+  uint64_t h = ht64_mix(key);
+  HT64Locked g(self, h);
+  size_t idx = h & (self->buckets->size() - 1);
+  HT64Entry** pe = &(*self->buckets)[idx];
+  while (*pe) {
+    if ((*pe)->key == key) {
+      HT64Entry* e = *pe;
+      *pe = e->next;
+      self->count.fetch_sub(1, std::memory_order_relaxed);
+      PyObject* v = e->value; /* transfer */
+      delete e;
+      return v;
+    }
+    pe = &(*pe)->next;
+  }
+  Py_RETURN_NONE;
+}
+
+static PyObject* HT64_find_or_insert(HT64Object* self, PyObject* args) {
+  unsigned long long key;
+  PyObject* factory;
+  if (!PyArg_ParseTuple(args, "KO", &key, &factory)) return nullptr;
+  uint64_t h = ht64_mix(key);
+  {
+    HT64Locked g(self, h);
+    size_t idx = h & (self->buckets->size() - 1);
+    for (HT64Entry* e = (*self->buckets)[idx]; e; e = e->next) {
+      if (e->key == key) {
+        PyObject* r = PyTuple_New(2);
+        Py_INCREF(e->value);
+        PyTuple_SET_ITEM(r, 0, e->value);
+        Py_INCREF(Py_False);
+        PyTuple_SET_ITEM(r, 1, Py_False);
+        return r;
+      }
+    }
+  }
+  /* call the factory OUTSIDE the stripe lock: it may run arbitrary Python
+   * (incl. re-entering this table); then retry-insert */
+  PyObject* v = PyObject_CallNoArgs(factory);
+  if (!v) return nullptr;
+  {
+    HT64Locked g(self, h);
+    size_t idx = h & (self->buckets->size() - 1);
+    for (HT64Entry* e = (*self->buckets)[idx]; e; e = e->next) {
+      if (e->key == key) { /* lost the race */
+        PyObject* r = PyTuple_New(2);
+        Py_INCREF(e->value);
+        PyTuple_SET_ITEM(r, 0, e->value);
+        Py_INCREF(Py_False);
+        PyTuple_SET_ITEM(r, 1, Py_False);
+        Py_DECREF(v);
+        return r;
+      }
+    }
+    Py_INCREF(v);
+    (*self->buckets)[idx] = new HT64Entry{key, v, (*self->buckets)[idx]};
+    self->count.fetch_add(1, std::memory_order_relaxed);
+  }
+  ht64_maybe_resize(self);
+  PyObject* r = PyTuple_New(2);
+  PyTuple_SET_ITEM(r, 0, v);
+  Py_INCREF(Py_True);
+  PyTuple_SET_ITEM(r, 1, Py_True);
+  return r;
+}
+
+static PyObject* HT64_keys(HT64Object* self, PyObject*) {
+  PyObject* lst = PyList_New(0);
+  if (!lst) return nullptr;
+  for (size_t s = 0; s < HT64_NSTRIPES; ++s) (*self->locks)[s].lock();
+  for (HT64Entry* e : *self->buckets) {
+    for (; e; e = e->next) {
+      PyObject* k = PyLong_FromUnsignedLongLong(e->key);
+      PyList_Append(lst, k);
+      Py_DECREF(k);
+    }
+  }
+  for (size_t s = 0; s < HT64_NSTRIPES; ++s) (*self->locks)[s].unlock();
+  return lst;
+}
+
+static Py_ssize_t HT64_len(PyObject* o) {
+  return ((HT64Object*)o)->count.load(std::memory_order_relaxed);
+}
+
+static PyMethodDef HT64_methods[] = {
+    {"insert", (PyCFunction)HT64_insert, METH_VARARGS, "insert(key, value)"},
+    {"find", (PyCFunction)HT64_find, METH_O, "find(key) -> value|None"},
+    {"remove", (PyCFunction)HT64_remove, METH_O, "remove(key) -> value|None"},
+    {"find_or_insert", (PyCFunction)HT64_find_or_insert, METH_VARARGS,
+     "find_or_insert(key, factory) -> (value, inserted)"},
+    {"keys", (PyCFunction)HT64_keys, METH_NOARGS, ""},
+    {nullptr, nullptr, 0, nullptr}};
+
+static PySequenceMethods HT64_as_seq = {HT64_len};
+
+static PyTypeObject HT64Type = []{
+  PyTypeObject t = {PyVarObject_HEAD_INIT(nullptr, 0)};
+  t.tp_name = "_parsec_native.HashTable64";
+  t.tp_basicsize = sizeof(HT64Object);
+  t.tp_flags = Py_TPFLAGS_DEFAULT;
+  t.tp_doc = "Bucket-locked resizable hash table, uint64 keys.";
+  t.tp_new = HT64_new;
+  t.tp_dealloc = (destructor)HT64_dealloc;
+  t.tp_methods = HT64_methods;
+  t.tp_as_sequence = &HT64_as_seq;
+  return t;
+}();
+
+/* ================================================================== */
+/* ZoneMalloc: segment/offset arena allocator                          */
+/* ================================================================== */
+struct ZoneSeg {
+  int64_t off;
+  int64_t size;
+  bool free_;
+};
+
+struct ZoneObject {
+  PyObject_HEAD
+  SpinLock* lock;
+  /* ordered by offset; adjacent free segments are coalesced */
+  std::map<int64_t, ZoneSeg>* segs;
+  int64_t total;
+  int64_t align;
+  int64_t used;
+};
+
+static PyObject* Zone_new(PyTypeObject* type, PyObject* args, PyObject* kwds) {
+  long long total = 0, align = 512;
+  static const char* kwlist[] = {"total", "align", nullptr};
+  if (!PyArg_ParseTupleAndKeywords(args, kwds, "L|L", (char**)kwlist, &total,
+                                   &align))
+    return nullptr;
+  if (total <= 0 || align <= 0 || (align & (align - 1)) != 0) {
+    PyErr_SetString(PyExc_ValueError,
+                    "total must be > 0, align a positive power of two");
+    return nullptr;
+  }
+  ZoneObject* self = (ZoneObject*)type->tp_alloc(type, 0);
+  if (self) {
+    self->lock = new SpinLock();
+    self->segs = new std::map<int64_t, ZoneSeg>();
+    self->total = total;
+    self->align = align;
+    self->used = 0;
+    self->segs->emplace(0, ZoneSeg{0, total, true});
+  }
+  return (PyObject*)self;
+}
+
+static void Zone_dealloc(ZoneObject* self) {
+  delete self->segs;
+  delete self->lock;
+  Py_TYPE(self)->tp_free((PyObject*)self);
+}
+
+static PyObject* Zone_malloc(ZoneObject* self, PyObject* arg) {
+  long long nbytes = PyLong_AsLongLong(arg);
+  if (nbytes <= 0) {
+    if (!PyErr_Occurred())
+      PyErr_SetString(PyExc_ValueError, "nbytes must be > 0");
+    return nullptr;
+  }
+  int64_t want = (nbytes + self->align - 1) & ~(self->align - 1);
+  SpinGuard g(*self->lock);
+  for (auto it = self->segs->begin(); it != self->segs->end(); ++it) {
+    ZoneSeg& s = it->second;
+    if (!s.free_ || s.size < want) continue;
+    if (s.size > want) {
+      /* split: tail remains free */
+      self->segs->emplace(s.off + want, ZoneSeg{s.off + want, s.size - want, true});
+      s.size = want;
+    }
+    s.free_ = false;
+    self->used += want;
+    return PyLong_FromLongLong(s.off);
+  }
+  return PyLong_FromLongLong(-1); /* out of memory: caller evicts (LRU) */
+}
+
+static PyObject* Zone_free(ZoneObject* self, PyObject* arg) {
+  long long off = PyLong_AsLongLong(arg);
+  if (off == -1 && PyErr_Occurred()) return nullptr;
+  SpinGuard g(*self->lock);
+  auto it = self->segs->find(off);
+  if (it == self->segs->end() || it->second.free_) {
+    PyErr_SetString(PyExc_ValueError, "invalid or double free");
+    return nullptr;
+  }
+  it->second.free_ = true;
+  self->used -= it->second.size;
+  /* coalesce with next */
+  auto nx = std::next(it);
+  if (nx != self->segs->end() && nx->second.free_) {
+    it->second.size += nx->second.size;
+    self->segs->erase(nx);
+  }
+  /* coalesce with prev */
+  if (it != self->segs->begin()) {
+    auto pv = std::prev(it);
+    if (pv->second.free_) {
+      pv->second.size += it->second.size;
+      self->segs->erase(it);
+    }
+  }
+  Py_RETURN_NONE;
+}
+
+static PyObject* Zone_used(ZoneObject* self, PyObject*) {
+  SpinGuard g(*self->lock);
+  return PyLong_FromLongLong(self->used);
+}
+
+static PyObject* Zone_available(ZoneObject* self, PyObject*) {
+  SpinGuard g(*self->lock);
+  return PyLong_FromLongLong(self->total - self->used);
+}
+
+static PyObject* Zone_largest_free(ZoneObject* self, PyObject*) {
+  SpinGuard g(*self->lock);
+  int64_t best = 0;
+  for (auto& kv : *self->segs)
+    if (kv.second.free_ && kv.second.size > best) best = kv.second.size;
+  return PyLong_FromLongLong(best);
+}
+
+static PyMethodDef Zone_methods[] = {
+    {"malloc", (PyCFunction)Zone_malloc, METH_O,
+     "malloc(nbytes) -> offset | -1 when full"},
+    {"free", (PyCFunction)Zone_free, METH_O, "free(offset)"},
+    {"used", (PyCFunction)Zone_used, METH_NOARGS, ""},
+    {"available", (PyCFunction)Zone_available, METH_NOARGS, ""},
+    {"largest_free", (PyCFunction)Zone_largest_free, METH_NOARGS, ""},
+    {nullptr, nullptr, 0, nullptr}};
+
+static PyTypeObject ZoneType = []{
+  PyTypeObject t = {PyVarObject_HEAD_INIT(nullptr, 0)};
+  t.tp_name = "_parsec_native.ZoneMalloc";
+  t.tp_basicsize = sizeof(ZoneObject);
+  t.tp_flags = Py_TPFLAGS_DEFAULT;
+  t.tp_doc = "Segment-based arena allocator (offset bookkeeping).";
+  t.tp_new = Zone_new;
+  t.tp_dealloc = (destructor)Zone_dealloc;
+  t.tp_methods = Zone_methods;
+  return t;
+}();
+
+/* ================================================================== */
+/* module                                                              */
+/* ================================================================== */
+static PyModuleDef native_module = {
+    PyModuleDef_HEAD_INIT, "_parsec_native",
+    "Native runtime core for parsec_tpu.", -1, nullptr};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__parsec_native(void) {
+  PyObject* m = PyModule_Create(&native_module);
+  if (!m) return nullptr;
+  struct {
+    const char* name;
+    PyTypeObject* type;
+  } types[] = {
+      {"Lifo", &LifoType},       {"Fifo", &FifoType},
+      {"Dequeue", &DequeueType}, {"OrderedList", &OrderedType},
+      {"HashTable64", &HT64Type}, {"ZoneMalloc", &ZoneType},
+  };
+  for (auto& t : types) {
+    if (PyType_Ready(t.type) < 0) return nullptr;
+    Py_INCREF(t.type);
+    if (PyModule_AddObject(m, t.name, (PyObject*)t.type) < 0) return nullptr;
+  }
+  PyModule_AddStringConstant(m, "__version__", "0.1.0");
+  return m;
+}

@@ -1,0 +1,45 @@
+"""Build driver for the native C++ runtime core.
+
+Compiles ``_native.cpp`` into the ``_parsec_native`` CPython extension with
+g++ directly (no pybind11 / setuptools dance in this environment), caching
+by source mtime. The reference builds its native runtime with CMake; here
+the native layer is one translation unit so a direct driver keeps the
+from-source experience dependency-free.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import sysconfig
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "_native.cpp")
+
+
+def _soname() -> str:
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    return os.path.join(_DIR, "_parsec_native" + suffix)
+
+
+def build(force: bool = False, verbose: bool = False) -> str:
+    """Compile the extension if missing or stale; return the .so path."""
+    so = _soname()
+    if (not force and os.path.exists(so)
+            and os.path.getmtime(so) >= os.path.getmtime(_SRC)):
+        return so
+    include = sysconfig.get_paths()["include"]
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+        "-fvisibility=hidden", "-Wall",
+        f"-I{include}", _SRC, "-o", so,
+    ]
+    if verbose:
+        print("+", " ".join(cmd), file=sys.stderr)
+    subprocess.run(cmd, check=True, capture_output=not verbose)
+    return so
+
+
+if __name__ == "__main__":
+    path = build(force="--force" in sys.argv, verbose=True)
+    print(path)

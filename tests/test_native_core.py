@@ -1,0 +1,176 @@
+"""Multithreaded stress tests of the native C++ runtime core.
+
+Mirrors the reference's container stress tests (tests/class/: lifo, list,
+hash, atomics — SURVEY.md §4 "Unit tests") against the C++ extension, and
+runs the same battery against the pure-Python fallbacks so both stay
+behaviorally identical.
+"""
+import threading
+
+import pytest
+
+from parsec_tpu.core import hashtable as ht_mod
+from parsec_tpu.core import lists as lists_mod
+from parsec_tpu.data import arena as arena_mod
+from parsec_tpu.native import available as native_available
+
+
+def _variants(primary, fallback_name):
+    out = [primary]
+    fb = globals_lookup = None
+    for mod in (lists_mod, ht_mod, arena_mod):
+        fb = getattr(mod, fallback_name, None)
+        if fb is not None:
+            break
+    if fb is not None and fb is not primary:
+        out.append(fb)
+    return out
+
+
+@pytest.mark.parametrize("cls", _variants(lists_mod.Lifo, "PyLifo"))
+def test_lifo_mt(cls):
+    q = cls()
+    N, T = 2000, 4
+    results = []
+
+    def producer(base):
+        for i in range(N):
+            q.push(base + i)
+
+    def consumer():
+        got = []
+        while len(got) < N:
+            v = q.pop()
+            if v is not None:
+                got.append(v)
+        results.append(got)
+
+    ps = [threading.Thread(target=producer, args=(t * N,)) for t in range(T)]
+    cs = [threading.Thread(target=consumer) for _ in range(T)]
+    for t in ps + cs:
+        t.start()
+    for t in ps + cs:
+        t.join()
+    allv = sorted(x for got in results for x in got)
+    assert allv == list(range(N * T))
+    assert q.pop() is None and q.is_empty()
+
+
+@pytest.mark.parametrize("cls", _variants(lists_mod.Dequeue, "PyDequeue"))
+def test_dequeue_chains_and_steal(cls):
+    d = cls()
+    d.push_back_chain(range(5))
+    d.push_front_chain([-2, -1])
+    assert len(d) == 7
+    assert d.pop_front() == -2 and d.pop_back() == 4
+    # concurrent steals drain it exactly once
+    seen = []
+    lock = threading.Lock()
+
+    def steal():
+        while True:
+            v = d.pop_back()
+            if v is None:
+                return
+            with lock:
+                seen.append(v)
+
+    ts = [threading.Thread(target=steal) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert sorted(seen) == [-1, 0, 1, 2, 3]
+
+
+@pytest.mark.parametrize("cls", _variants(lists_mod.OrderedList, "PyOrderedList"))
+def test_ordered_list_priority_and_fifo_tiebreak(cls):
+    ol = cls()
+    ol.push_sorted("low", 1)
+    ol.push_sorted("hi-first", 9)
+    ol.push_sorted("hi-second", 9)
+    ol.push_sorted_chain(["mid"], lambda t: 5)
+    assert ol.pop_front() == "hi-first"      # highest priority, oldest first
+    assert ol.pop_back() == "low"            # inverse-priority pop (ip sched)
+    assert ol.pop_front() == "hi-second"
+    assert ol.pop_front() == "mid"
+    assert ol.pop_front() is None and ol.is_empty()
+
+
+@pytest.mark.parametrize("cls", _variants(ht_mod.HashTable64, "PyHashTable64"))
+def test_hashtable64_mt_resize(cls):
+    h = cls()
+    T, N = 8, 1500
+
+    def worker(tid):
+        for i in range(N):
+            k = tid * N + i
+            h.insert(k, ("v", k))
+        for i in range(N):
+            k = tid * N + i
+            assert h.find(k) == ("v", k)
+
+    ts = [threading.Thread(target=worker, args=(t,)) for t in range(T)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert len(h) == T * N
+    assert h.find(0) == ("v", 0)
+    assert h.remove(0) == ("v", 0)
+    assert h.find(0) is None and h.remove(0) is None
+    assert len(h) == T * N - 1
+
+
+@pytest.mark.parametrize("cls", _variants(ht_mod.HashTable64, "PyHashTable64"))
+def test_hashtable64_find_or_insert_once(cls):
+    h = cls()
+    calls = []
+
+    def factory():
+        calls.append(1)
+        return "made"
+
+    v1, ins1 = h.find_or_insert(7, factory)
+    v2, ins2 = h.find_or_insert(7, factory)
+    assert (v1, ins1) == ("made", True)
+    assert (v2, ins2) == ("made", False)
+    assert len(calls) == 1
+
+
+@pytest.mark.parametrize("cls", _variants(arena_mod.ZoneMalloc, "PyZoneMalloc"))
+def test_zone_malloc_coalescing(cls):
+    z = cls(1 << 20, 512)
+    offs = [z.malloc(1000) for _ in range(100)]
+    assert all(o >= 0 for o in offs)
+    assert len(set(offs)) == 100
+    assert z.used() == 100 * 1024  # rounded to alignment
+    # free every other block: fragmentation, then fill a big one fails
+    for o in offs[::2]:
+        z.free(o)
+    assert z.used() == 50 * 1024
+    big = z.malloc(1 << 20)
+    assert big == -1  # fragmented: no contiguous MB
+    # free the rest: full coalescing back to one segment
+    for o in offs[1::2]:
+        z.free(o)
+    assert z.used() == 0
+    assert z.largest_free() == 1 << 20
+    assert z.malloc(1 << 20) == 0
+
+
+@pytest.mark.parametrize("cls", _variants(arena_mod.ZoneMalloc, "PyZoneMalloc"))
+def test_zone_malloc_errors(cls):
+    z = cls(4096, 256)
+    with pytest.raises(Exception):
+        z.free(128)  # never allocated
+    o = z.malloc(100)
+    z.free(o)
+    with pytest.raises(Exception):
+        z.free(o)  # double free
+
+
+def test_native_layer_is_active():
+    """The driver environment has g++; the native core must actually load."""
+    assert native_available
+    assert lists_mod.Lifo.__module__ == "_parsec_native"
