@@ -10,7 +10,7 @@ sustains ~80% of the A100's 19.5 TFLOP/s FP64-TC peak), making the target
 0.6 * 15500 = 9300 GFLOP/s; vs_baseline = measured / 9300.
 
 Knobs (env): BENCH_N (matrix size, default 8192), BENCH_NB (tile size,
-default 2048), BENCH_DTYPE (float32), BENCH_REPS (default 3, best-of).
+default 1024), BENCH_DTYPE (float32), BENCH_REPS (default 3, best-of).
 """
 import json
 import os

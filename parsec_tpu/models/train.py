@@ -50,14 +50,10 @@ def make_train_step(cfg: TransformerConfig, mesh, lr: float = 1e-3):
     data_spec = P("dp", "sp")
 
     def step_shard(params, opt_state, tokens, labels):
+        # under check_vma=True shard_map, jax.grad of a replicated leaf is
+        # already reduced over exactly the right axes (see shard_map_compat)
         loss, grads = jax.value_and_grad(
             lambda p: loss_shard(cfg, p, tokens, labels))(params)
-        # replicated leaves: sum gradient contributions over the axes the
-        # computation was distributed across
-        grads = jax.tree.map(
-            lambda g, s: lax.psum(g, sync_axes(s)) if sync_axes(s) else g,
-            grads, pspecs,
-            is_leaf=lambda x: isinstance(x, jax.Array) or hasattr(x, "shape"))
         new_params, new_state = adam_update(params, grads, opt_state, lr=lr)
         return new_params, new_state, loss
 

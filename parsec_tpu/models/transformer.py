@@ -141,10 +141,10 @@ def _layer(cfg: TransformerConfig, lp: Dict[str, Any], x: Any,
     x = x + o
     h2 = _rmsnorm(x, lp["ln2"])
     if "w1e" in lp:
-        f = moe_ffn(h2, lp["gate"], lp["w1e"], lp["w2e"], "ep",
-                    top_k=cfg.moe_top_k)
-        f = lax.psum(f, "tp")              # expert FFN hidden is tp-sharded
         gate_logits = jnp.einsum("btd,de->bte", h2, lp["gate"])
+        f = moe_ffn(h2, lp["gate"], lp["w1e"], lp["w2e"], "ep",
+                    top_k=cfg.moe_top_k, gate_logits=gate_logits)
+        f = lax.psum(f, "tp")              # expert FFN hidden is tp-sharded
         aux = aux + load_balance_loss(gate_logits)
     else:
         u = jnp.einsum("btd,df->btf", h2, lp["w1"],
@@ -180,26 +180,32 @@ def forward_shard(cfg: TransformerConfig, params: Dict[str, Any],
     stage = jax.tree.map(lambda a: a.reshape(-1, *a.shape[2:]),
                          params["stages"])
 
-    # NOTE: the MoE load-balance aux is not threaded through the pipeline
-    # yet (gpipe carries activations only); forward returns aux == 0 and
-    # load_balance_loss remains available as a standalone regularizer
-    aux_box = jnp.zeros((), jnp.float32)
+    with_aux = bool(cfg.n_experts)
 
     def stage_fn(sparams, xm):
         def body(carry, lp):
             y, aux = carry
             y, aux = _layer(cfg, lp, y, aux)
             return (y, aux), None
-        (y, aux), _ = lax.scan(body, (xm, jnp.zeros((), jnp.float32)),
-                               sparams)
-        return y
+        from ..parallel.mesh import vary_on
+        aux0 = vary_on(jnp.zeros((), jnp.float32), ("pp",), like=xm)
+        (y, aux), _ = lax.scan(body, (xm, aux0), sparams)
+        return (y, aux) if with_aux else y
 
-    y_micro = gpipe(stage_fn, stage, x_micro, "pp")
+    if with_aux:
+        # aux_local: this pp stage's load-balance sum over its layers and
+        # every real (stage, microbatch) tick; all stages contribute, so
+        # the per-layer mean needs a psum over pp (loss_shard does it)
+        y_micro, aux_local = gpipe(stage_fn, stage, x_micro, "pp",
+                                   with_aux=True)
+    else:
+        y_micro = gpipe(stage_fn, stage, x_micro, "pp")
+        aux_local = jnp.zeros((), jnp.float32)
     y = y_micro.reshape(B_local, Tl, -1)
     y = _rmsnorm(y, params["ln_f"])
     logits = jnp.einsum("btd,vd->btv", y.astype(jnp.float32),
                         params["embed"].astype(jnp.float32))
-    return logits, aux_box
+    return logits, aux_local
 
 
 def loss_shard(cfg: TransformerConfig, params: Dict[str, Any],
@@ -214,4 +220,11 @@ def loss_shard(cfg: TransformerConfig, params: Dict[str, Any],
     total = lax.psum(local_sum, ("dp", "sp"))
     n_tokens = labels.size * lax.psum(1, "dp") * lax.psum(1, "sp")
     loss = total / n_tokens
-    return loss + cfg.aux_loss_weight * last_stage_value(aux, "pp")
+    if cfg.n_experts:
+        # per-layer / per-microbatch mean of the Switch aux, averaged over
+        # the token shards; every pp stage contributed its own layers
+        n_layers = cfg.n_stages * cfg.layers_per_stage
+        aux_mean = lax.psum(aux, "pp") / (n_layers * cfg.n_micro)
+        aux_mean = lax.pmean(aux_mean, ("dp", "sp"))
+        loss = loss + cfg.aux_loss_weight * aux_mean
+    return loss

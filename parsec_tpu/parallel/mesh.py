@@ -96,12 +96,63 @@ def sync_axes(leaf_spec, mesh_axes: Sequence[str] = AXES) -> Tuple[str, ...]:
     return tuple(a for a in mesh_axes if a not in used)
 
 
+def _vma_of(x):
+    import jax
+    try:
+        return set(jax.typeof(x).vma)
+    except (AttributeError, TypeError):
+        return None
+
+
+def _pcast_varying(x, axes):
+    from jax import lax
+    try:
+        return lax.pcast(x, axes, to="varying")
+    except (AttributeError, TypeError):  # older jax spelling
+        return lax.pvary(x, axes)
+
+
+def match_vma(x, ref):
+    """Promote ``x``'s varying-manual-axes (VMA) to cover ``ref``'s.
+
+    Under check_vma=True, lax.scan requires carry input/output types to
+    match exactly — fresh-zeros initial carries are 'unvarying' while the
+    loop body makes them varying. Promote initials with this before scan.
+    """
+    cur, want_src = _vma_of(x), _vma_of(ref)
+    if cur is None or want_src is None:
+        return x
+    want = tuple(sorted(want_src - cur))
+    return _pcast_varying(x, want) if want else x
+
+
+def vary_on(x, axes, like=None):
+    """Promote ``x`` to be varying on ``axes`` (plus ``like``'s VMA)."""
+    cur = _vma_of(x)
+    if cur is None:
+        return x
+    target = set(axes)
+    if like is not None:
+        target |= _vma_of(like) or set()
+    want = tuple(sorted(target - cur))
+    return _pcast_varying(x, want) if want else x
+
+
 def shard_map_compat(f, mesh, in_specs, out_specs):
-    """jax.shard_map across jax versions (check_vma vs check_rep kw)."""
+    """jax.shard_map with VMA (varying-manual-axes) tracking ON.
+
+    check_vma=True is load-bearing for gradient correctness, not just
+    checking: with it, psum transposes via the replication-aware rule and
+    jax.grad of a REPLICATED leaf comes out already psum'd over exactly
+    the axes its contributions were partial on — including the subtle
+    cases (axes the forward never touches produce identity, mixed
+    redundant+partial paths split correctly). With check_vma=False, psum
+    transposes to psum and no per-leaf psum/pmean recipe is exact.
+    """
     import jax
     try:
         return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_vma=False)
-    except TypeError:
+                             out_specs=out_specs, check_vma=True)
+    except TypeError:  # older jax spelling
         return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_rep=False)
+                             out_specs=out_specs, check_rep=True)

@@ -20,15 +20,18 @@ from jax import lax
 
 
 def moe_ffn(x: Any, gate_w: Any, w1: Any, w2: Any,
-            axis_name: str = "ep", top_k: int = 2) -> Any:
+            axis_name: str = "ep", top_k: int = 2,
+            gate_logits: Any = None) -> Any:
     """x: [..., D]; gate_w: [D, E_total] (replicated); w1: [E_local, D, F];
-    w2: [E_local, F, D]. Returns [..., D]."""
+    w2: [E_local, F, D]. Returns [..., D]. Pass precomputed ``gate_logits``
+    to share the gating einsum with the load-balance loss."""
     E_local = w1.shape[0]
     ep = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     E_total = E_local * ep
 
-    logits = jnp.einsum("...d,de->...e", x, gate_w)  # [..., E_total]
+    logits = (gate_logits if gate_logits is not None
+              else jnp.einsum("...d,de->...e", x, gate_w))  # [..., E_total]
     # top-k gating with renormalized probabilities (straight-through mask)
     probs = jax.nn.softmax(logits, axis=-1)
     if top_k < E_total:

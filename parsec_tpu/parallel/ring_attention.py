@@ -52,9 +52,10 @@ def ring_attention(q: Any, k: Any, v: Any, axis_name: str = "sp",
         v_nxt = lax.ppermute(v_blk, axis_name, perm)
         return (k_nxt, v_nxt, m_new, l_new, acc_new), None
 
-    m0 = jnp.full((B, H, Tl), -jnp.inf, dtype=jnp.float32)
-    l0 = jnp.zeros((B, H, Tl), dtype=jnp.float32)
-    acc0 = jnp.zeros((B, H, Tl, Dh), dtype=jnp.float32)
+    from .mesh import match_vma
+    m0 = match_vma(jnp.full((B, H, Tl), -jnp.inf, dtype=jnp.float32), q)
+    l0 = match_vma(jnp.zeros((B, H, Tl), dtype=jnp.float32), q)
+    acc0 = match_vma(jnp.zeros((B, H, Tl, Dh), dtype=jnp.float32), q)
     (k_f, v_f, m, l, acc), _ = lax.scan(
         step, (k, v, m0, l0, acc0), jnp.arange(sp))
     out = acc / l[..., None]
