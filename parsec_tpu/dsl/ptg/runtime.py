@@ -16,6 +16,7 @@ final assignments to written flow names become the staged-out results.
 """
 from __future__ import annotations
 
+import dataclasses
 import itertools
 import threading
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
@@ -24,6 +25,8 @@ import numpy as np
 
 from ...core.hashtable import HashTable
 from ...data.data import Coherency, Data, DataCopy, FlowAccess
+from ...data.datatype import Datatype, dtt_of_array
+from ...data.reshape import ReshapeRepo
 from ...runtime.scheduling import schedule_keep_best
 from ...runtime.taskpool import (Chore, Flow, HookReturn, Task, TaskClass,
                                  Taskpool)
@@ -177,7 +180,43 @@ class PTGTaskClass(TaskClass):
                 raise RuntimeError(
                     f"{task.snprintf()}: input flow {f.name} unresolved "
                     f"(activation missing)")
+        # reshape pass: a consumer-declared [type=...] differing from the
+        # producer's datatype converts through a shared reshape promise —
+        # activation-sourced (remote) and memory/task-sourced (local) flows
+        # alike (ref: parsec_reshape.c; receiver-side datatype lookup,
+        # remote_dep_mpi.c:766)
+        for i, f in enumerate(self.ast.flows):
+            ref = task.data[i]
+            if f.is_ctl or ref.data_in is None:
+                continue
+            dtt = self._input_dtt(f, env, ref.data_in)
+            if dtt is not None:
+                ref.data_in = self.tp.reshape_repo.reshaped_copy(
+                    ref.data_in, dtt, es)
         return HookReturn.DONE
+
+    def _input_dtt(self, f: FlowAST, env: Dict[str, Any], copy):
+        """The datatype this instance's input edge declares, or None.
+
+        The first in-dep applicable under ``env`` is the edge that bound
+        the input (same rule as the binding loop — SPMD-consistent on
+        both ends of a remote edge)."""
+        for d in f.deps_in():
+            if d.resolve(env) is None:
+                continue
+            tname = d.properties.get("type")
+            if tname is None:
+                return None
+            val = self.tp.global_env.get(tname)
+            if isinstance(val, Datatype):
+                return val
+            if tname in ("lower", "upper", "full"):
+                base = copy.dtt or dtt_of_array(copy.payload)
+                return dataclasses.replace(base, region=tname)
+            raise TypeError(
+                f"{self.name}.{f.name}: [type={tname}] is neither a "
+                f"Datatype global nor a region shorthand")
+        return None
 
     def _output_binding(self, f: FlowAST, env: Dict[str, Any]):
         """WRITE-only flow: bind to its memory out-target or a NEW buffer."""
@@ -405,6 +444,7 @@ class PTGTaskpool(Taskpool):
             self._classes[tc_ast.name] = tc
             self.task_classes.append(tc)
         self._scratch_lock = threading.Lock()
+        self.reshape_repo = ReshapeRepo()
         self.startup_hook = self._startup
         self.nb_local_tasks = 0
         self.comm = None  # remote-dep driver, attached by the comm engine

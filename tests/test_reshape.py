@@ -1,0 +1,262 @@
+"""Reshape engine tests: conversion kernels, promise dedup, PTG edges.
+
+Mirrors the reference's reshape coverage (tests/collections/reshape/ — 18
+files exercising local and remote conversion paths, SURVEY.md §4) at the
+engine and DSL levels.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+import parsec_tpu
+from parsec_tpu.comm import RemoteDepEngine
+from parsec_tpu.collections import TwoDimBlockCyclic
+from parsec_tpu.data.datatype import Datatype, dtt_of_array
+from parsec_tpu.data.data import Coherency, Data, DataCopy
+from parsec_tpu.data.reshape import ReshapeRepo, reshape_array
+from parsec_tpu.dsl import ptg
+
+from test_comm_multirank import spmd
+
+
+def _copy_of(arr, dtt=None):
+    d = Data(nb_elts=arr.size)
+    c = DataCopy(d, 0, payload=arr, dtt=dtt)
+    c.version = 1
+    c.coherency = Coherency.OWNED
+    d.attach_copy(c)
+    return c
+
+
+# --------------------------------------------------------------------- #
+# conversion kernel                                                     #
+# --------------------------------------------------------------------- #
+def test_reshape_array_regions_and_cast():
+    a = np.arange(16, dtype=np.float64).reshape(4, 4) + 1
+    lo = reshape_array(a, Datatype(np.float32, (4, 4), "lower"))
+    assert lo.dtype == np.float32
+    assert lo[2, 1] == a[2, 1] and lo[1, 2] == 0.0
+    up = reshape_array(a, Datatype(np.float64, (4, 4), "upper"))
+    assert up[1, 2] == a[1, 2] and up[2, 1] == 0.0
+    band = reshape_array(a, Datatype(np.float64, (4, 4), "band", band=(1, 0)))
+    assert band[1, 0] == a[1, 0] and band[3, 1] == 0.0 and band[0, 1] == 0.0
+    # element-count-preserving reshape
+    flat = reshape_array(a, Datatype(np.float64, (16,)))
+    assert flat.shape == (16,)
+    with pytest.raises(ValueError):
+        reshape_array(a, Datatype(np.float64, (3, 3)))
+
+
+def test_reshape_array_jax():
+    import jax.numpy as jnp
+    a = jnp.ones((4, 4), jnp.float32)
+    lo = reshape_array(a, Datatype(np.float32, (4, 4), "lower"))
+    assert float(lo[0, 3]) == 0.0 and float(lo[3, 0]) == 1.0
+
+
+# --------------------------------------------------------------------- #
+# promise dedup                                                         #
+# --------------------------------------------------------------------- #
+def test_repo_dedups_concurrent_consumers():
+    repo = ReshapeRepo()
+    src = _copy_of(np.arange(16, dtype=np.float32).reshape(4, 4))
+    dst = Datatype(np.float32, (4, 4), "lower")
+    got = []
+    lock = threading.Lock()
+
+    def consume():
+        c = repo.reshaped_copy(src, dst)
+        with lock:
+            got.append(c)
+
+    ts = [threading.Thread(target=consume) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert repo.stats["conversions"] == 1  # one conversion for 8 consumers
+    assert all(c is got[0] for c in got)  # shared converted copy
+    assert got[0].payload[1, 2] == 0.0
+    # a different target type converts separately
+    c2 = repo.reshaped_copy(src, Datatype(np.float32, (4, 4), "upper"))
+    assert repo.stats["conversions"] == 2
+    assert c2.payload[2, 1] == 0.0
+    # matching type short-circuits without a promise
+    same = repo.reshaped_copy(src, dtt_of_array(src.payload))
+    assert same is src
+
+
+def test_incoming_promise_remote_variant():
+    repo = ReshapeRepo()
+    dst = Datatype(np.float32, (4, 4), "lower")
+    fut, deliver = repo.incoming_promise(("tp0", "T", (3,), "A"), dst)
+    # same edge+type re-arms onto the same promise
+    fut2, _ = repo.incoming_promise(("tp0", "T", (3,), "A"), dst)
+    assert fut is fut2
+    got = []
+
+    def consume():
+        got.append(fut.get_or_trigger(timeout=10))
+
+    ts = [threading.Thread(target=consume) for _ in range(4)]
+    for t in ts:
+        t.start()
+    deliver(np.ones((4, 4), np.float32))
+    for t in ts:
+        t.join(10)
+    assert len(got) == 4 and all(g is got[0] for g in got)
+    assert got[0].payload[0, 3] == 0.0 and got[0].payload[3, 0] == 1.0
+    assert repo.stats["conversions"] == 1
+
+
+# --------------------------------------------------------------------- #
+# PTG edges                                                             #
+# --------------------------------------------------------------------- #
+RESHAPE_JDF = """
+descA [ type="collection" ]
+out [ type="object" ]
+
+Prod(k)
+k = 0 .. 0
+: descA( 0, 0 )
+RW A <- descA( 0, 0 )
+     -> A Lo( 0 ) [type=lower]
+     -> A Lo( 1 ) [type=lower]
+     -> A Up( 0 ) [type=upper]
+BODY
+{
+    A += 1.0
+}
+END
+
+Lo(k)
+k = 0 .. 1
+: descA( 0, 0 )
+READ A <- A Prod( 0 ) [type=lower]
+BODY
+{
+    out['lo%d' % k] = np.array(A)
+}
+END
+
+Up(k)
+k = 0 .. 0
+: descA( 0, 0 )
+READ A <- A Prod( 0 ) [type=upper]
+BODY
+{
+    out['up'] = np.array(A)
+}
+END
+"""
+
+
+def test_ptg_local_reshape_edges(ctx):
+    n = 4
+    coll = TwoDimBlockCyclic(n, n, n, n, dtype=np.float64)
+    base = np.arange(n * n, dtype=np.float64).reshape(n, n)
+    coll.from_numpy(base.copy())
+    out = {}
+    tp = ptg.compile_jdf(RESHAPE_JDF, name="reshape_local").new(
+        descA=coll, out=out)
+    ctx.add_taskpool(tp)
+    ctx.wait()
+    prod = base + 1.0
+    tril = np.tril(prod)
+    triu = np.triu(prod)
+    np.testing.assert_array_equal(out["lo0"], tril)
+    np.testing.assert_array_equal(out["lo1"], tril)
+    np.testing.assert_array_equal(out["up"], triu)
+    # two lower-consumers shared one conversion; upper adds one more
+    assert tp.reshape_repo.stats["conversions"] == 2
+
+
+MEM_TYPE_JDF = """
+descA [ type="collection" ]
+out [ type="object" ]
+
+T(k)
+k = 0 .. 0
+: descA( 0, 0 )
+READ A <- descA( 0, 0 ) [type=lower]
+BODY
+{
+    out['seen'] = np.array(A)
+}
+END
+"""
+
+
+def test_ptg_memory_input_type(ctx):
+    n = 4
+    coll = TwoDimBlockCyclic(n, n, n, n, dtype=np.float64)
+    base = np.arange(n * n, dtype=np.float64).reshape(n, n) + 1
+    coll.from_numpy(base.copy())
+    out = {}
+    tp = ptg.compile_jdf(MEM_TYPE_JDF, name="reshape_mem").new(
+        descA=coll, out=out)
+    ctx.add_taskpool(tp)
+    ctx.wait()
+    np.testing.assert_array_equal(out["seen"], np.tril(base))
+    # the home tile was not mutated by the read-side conversion
+    np.testing.assert_array_equal(coll.data_of(0, 0).host_copy().payload, base)
+
+
+REMOTE_RESHAPE_JDF = """
+descA [ type="collection" ]
+out [ type="object" ]
+
+Prod(k)
+k = 0 .. 0
+: descA( 0, 0 )
+RW A <- descA( 0, 0 )
+     -> A Cons( 0 )
+BODY
+{
+    A += 1.0
+}
+END
+
+Cons(k)
+k = 0 .. 0
+: descA( 1, 0 )
+READ A <- A Prod( 0 ) [type=lower]
+BODY
+{
+    out['seen'] = np.array(A)
+}
+END
+"""
+
+
+def test_ptg_remote_reshape_edge():
+    """Producer on rank 0, consumer on rank 1 declaring [type=lower]: the
+    conversion happens on the receiver from the wire payload."""
+    n = 4
+    outs = [dict() for _ in range(2)]
+
+    def rank_fn(rank, fabric):
+        eng = RemoteDepEngine(fabric.engine(rank))
+        ctx = parsec_tpu.Context(nb_cores=1, comm=eng, enable_tpu=False)
+        try:
+            coll = TwoDimBlockCyclic(2 * n, n, n, n, P=2, Q=1, nodes=2,
+                                     rank=rank, dtype=np.float64)
+            coll.name = "descA"
+            base = np.tile(np.arange(n, dtype=np.float64), (2 * n, 1))
+            coll.from_numpy(base)
+            tp = ptg.compile_jdf(REMOTE_RESHAPE_JDF, name="reshape_remote").new(
+                descA=coll, out=outs[rank], rank=rank, nb_ranks=2)
+            ctx.add_taskpool(tp)
+            ctx.wait()
+            return tp.reshape_repo.stats.copy()
+        finally:
+            ctx.fini()
+
+    results, _ = spmd(2, rank_fn)
+    expect = np.tril(np.tile(np.arange(n, dtype=np.float64), (n, 1)) + 1.0)
+    np.testing.assert_array_equal(outs[1]["seen"], expect)
+    assert "seen" not in outs[0]
+    # conversion ran on the consumer rank only
+    assert results[1]["conversions"] == 1
+    assert results[0]["conversions"] == 0
