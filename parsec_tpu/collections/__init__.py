@@ -2,9 +2,10 @@
 from .collection import DataCollection, DictCollection, LocalArrayCollection
 from .matrix import (SymTwoDimBlockCyclic, TiledMatrix, TwoDimBlockCyclic,
                      TwoDimBlockCyclicBand, TwoDimTabular, VectorTwoDimCyclic)
+from .redistribute import redistribute, reshard_array
 
 __all__ = [
     "DataCollection", "DictCollection", "LocalArrayCollection", "TiledMatrix",
     "TwoDimBlockCyclic", "SymTwoDimBlockCyclic", "TwoDimBlockCyclicBand",
-    "TwoDimTabular", "VectorTwoDimCyclic",
+    "TwoDimTabular", "VectorTwoDimCyclic", "redistribute", "reshard_array",
 ]

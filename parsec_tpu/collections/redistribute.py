@@ -1,0 +1,123 @@
+"""Redistribution engine: move an M×N submatrix between two arbitrary
+tiled distributions.
+
+Reference behavior: ``parsec_redistribute(Y, T, size_row, size_col,
+disi_Y, disj_Y, disi_T, disj_T)`` — PTG- and DTD-based full submatrix
+redistribution between any two block-cyclic distributions with unaligned
+offsets and different tile sizes; each target tile assembles up to nine
+source-fragment classes (NW/N/NE/W/I/E/SW/S/SE)
+(ref: parsec/data_dist/matrix/redistribute/redistribute.jdf,
+redistribute_wrapper.c:185, SURVEY.md §2.6).
+
+TPU-native re-design: expressed through the DTD front end — one assembly
+task per target tile, with INPUT deps on every intersecting source tile
+and INOUT on the target tile. Task placement follows the target tile's
+owner (AFFINITY); cross-rank fragments ride the DTD data plane
+automatically, so the same code is the single-process and the
+distributed path. For mesh-resident jax arrays, ``reshard_array`` is the
+XLA fast path: device_put between NamedShardings compiles to all-to-all
+collectives over ICI.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from ..dsl import dtd
+from ..dsl.dtd import AFFINITY, INOUT, INPUT, VALUE, unpack_args
+from .matrix import TiledMatrix
+
+
+def _tile_range(lo: int, hi: int, tb: int) -> range:
+    """Tiles of size tb intersecting global element rows [lo, hi)."""
+    return range(lo // tb, (hi - 1) // tb + 1)
+
+
+def _copy_frag(es, task) -> None:
+    """One fragment: DTD task classes have a fixed flow signature (ref
+    limit, insert_function_internal.h:30), so assembly is one 2-flow task
+    per (target tile, source tile) pair; the INOUT chain on the target
+    tile orders the disjoint fragment writes."""
+    tgt, frag, src = unpack_args(task)
+    dr0, dr1, dc0, dc1, sr0, sr1, sc0, sc1 = frag
+    tgt[dr0:dr1, dc0:dc1] = src[sr0:sr1, sc0:sc1]
+
+
+def redistribute(source: TiledMatrix, target: TiledMatrix,
+                 size_row: int, size_col: int,
+                 disi_Y: int = 0, disj_Y: int = 0,
+                 disi_T: int = 0, disj_T: int = 0,
+                 context: Any = None,
+                 taskpool: Optional[Any] = None) -> Any:
+    """Copy source[disi_Y:disi_Y+size_row, disj_Y:disj_Y+size_col] into
+    target[disi_T:..., disj_T:...] across distributions.
+
+    SPMD: call on every rank. With ``taskpool`` the tasks are inserted
+    into an existing DTD pool (composing with other work); otherwise a
+    fresh pool is created, and with ``context`` it is enqueued + waited.
+    Returns the taskpool.
+    """
+    assert disi_Y + size_row <= source.lm and disj_Y + size_col <= source.ln, \
+        "source region out of bounds"
+    assert disi_T + size_row <= target.lm and disj_T + size_col <= target.ln, \
+        "target region out of bounds"
+    # the DTD tile registry keys messages by collection name: give the two
+    # ends deterministic distinct names when the user didn't (SPMD-safe)
+    if getattr(source, "name", None) in (None, type(source).__name__):
+        source.name = "redist_Y"
+    if getattr(target, "name", None) in (None, type(target).__name__):
+        target.name = "redist_T"
+    assert source.name != target.name, \
+        "source and target collections need distinct .name values"
+    tp = taskpool if taskpool is not None else dtd.taskpool_new(
+        name=f"redistribute_{source.lm}x{source.ln}")
+    own = taskpool is None
+    if own and context is not None:
+        context.add_taskpool(tp)
+
+    mbT, nbT = target.mb, target.nb
+    mbY, nbY = source.mb, source.nb
+    # walk target tiles intersecting the target region
+    for tm in _tile_range(disi_T, disi_T + size_row, mbT):
+        # this target tile's rows ∩ region, in global-region coordinates r
+        tr_lo = max(tm * mbT, disi_T) - disi_T
+        tr_hi = min((tm + 1) * mbT, disi_T + size_row) - disi_T
+        for tn in _tile_range(disj_T, disj_T + size_col, nbT):
+            tc_lo = max(tn * nbT, disj_T) - disj_T
+            tc_hi = min((tn + 1) * nbT, disj_T + size_col) - disj_T
+            ttile = tp.tile_of(target, (tm, tn))
+            # source tiles covering region rows [tr_lo, tr_hi) / cols ...
+            for sm in _tile_range(disi_Y + tr_lo, disi_Y + tr_hi, mbY):
+                sr_lo = max(sm * mbY, disi_Y + tr_lo)
+                sr_hi = min((sm + 1) * mbY, disi_Y + tr_hi)
+                for sn in _tile_range(disj_Y + tc_lo, disj_Y + tc_hi, nbY):
+                    sc_lo = max(sn * nbY, disj_Y + tc_lo)
+                    sc_hi = min((sn + 1) * nbY, disj_Y + tc_hi)
+                    # fragment in region coords → slices in each tile
+                    r0, r1 = sr_lo - disi_Y, sr_hi - disi_Y
+                    c0, c1 = sc_lo - disj_Y, sc_hi - disj_Y
+                    frag = (
+                        r0 + disi_T - tm * mbT, r1 + disi_T - tm * mbT,
+                        c0 + disj_T - tn * nbT, c1 + disj_T - tn * nbT,
+                        sr_lo - sm * mbY, sr_hi - sm * mbY,
+                        sc_lo - sn * nbY, sc_hi - sn * nbY)
+                    tp.insert_task(
+                        _copy_frag, (ttile, INOUT | AFFINITY),
+                        (frag, VALUE), (tp.tile_of(source, (sm, sn)), INPUT),
+                        name=f"redist({tm},{tn})<-({sm},{sn})")
+    if own:
+        tp.data_flush_all()
+        if context is not None:
+            tp.wait()
+    return tp
+
+
+def reshard_array(arr: Any, mesh: Any, spec: Any) -> Any:
+    """XLA fast path for mesh-resident arrays: re-lay ``arr`` out as
+    NamedSharding(mesh, spec). XLA compiles the movement to all-to-all /
+    collective-permute over ICI — the sharded-array analog of the tile
+    redistribution above (SURVEY.md §5.7)."""
+    import jax
+    from jax.sharding import NamedSharding
+    return jax.device_put(arr, NamedSharding(mesh, spec))

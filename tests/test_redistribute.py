@@ -1,0 +1,131 @@
+"""Redistribution engine tests (ref coverage model:
+tests/collections/redistribute/ — PTG redistribution with checking
+variants incl. random sizes, SURVEY.md §4).
+"""
+import numpy as np
+import pytest
+
+import parsec_tpu
+from parsec_tpu.collections import (TwoDimBlockCyclic, TwoDimTabular,
+                                    redistribute, reshard_array)
+from parsec_tpu.comm import RemoteDepEngine
+
+from test_comm_multirank import spmd
+
+
+def _check(source_np, target_np_before, target_after,
+           size_row, size_col, diY, djY, diT, djT):
+    expect = target_np_before.copy()
+    expect[diT:diT + size_row, djT:djT + size_col] = \
+        source_np[diY:diY + size_row, djY:djY + size_col]
+    np.testing.assert_array_equal(target_after, expect)
+
+
+@pytest.mark.parametrize("geometry", [
+    # (lmY, lnY, mbY, nbY, lmT, lnT, mbT, nbT, M, N, diY, djY, diT, djT)
+    (8, 8, 4, 4, 8, 8, 4, 4, 8, 8, 0, 0, 0, 0),        # aligned same-tile
+    (12, 12, 4, 4, 12, 12, 3, 3, 12, 12, 0, 0, 0, 0),  # different tile sizes
+    (16, 12, 5, 4, 12, 16, 3, 5, 7, 9, 2, 1, 3, 4),    # unaligned submatrix
+])
+def test_redistribute_single_process(ctx, geometry):
+    (lmY, lnY, mbY, nbY, lmT, lnT, mbT, nbT,
+     M, N, diY, djY, diT, djT) = geometry
+    rng = np.random.RandomState(42)
+    src_np = rng.rand(lmY, lnY)
+    tgt_np = rng.rand(lmT, lnT)
+    Y = TwoDimBlockCyclic(lmY, lnY, mbY, nbY, dtype=np.float64).from_numpy(src_np)
+    T = TwoDimBlockCyclic(lmT, lnT, mbT, nbT, dtype=np.float64).from_numpy(tgt_np)
+    redistribute(Y, T, M, N, diY, djY, diT, djT, context=ctx)
+    _check(src_np, tgt_np, T.to_numpy(), M, N, diY, djY, diT, djT)
+
+
+def test_redistribute_random_sizes(ctx):
+    rng = np.random.RandomState(7)
+    for trial in range(4):
+        lmY, lnY = rng.randint(6, 20, size=2)
+        lmT, lnT = rng.randint(6, 20, size=2)
+        mbY, nbY = rng.randint(2, 6, size=2)
+        mbT, nbT = rng.randint(2, 6, size=2)
+        M = rng.randint(1, min(lmY, lmT) + 1)
+        N = rng.randint(1, min(lnY, lnT) + 1)
+        diY = rng.randint(0, lmY - M + 1)
+        djY = rng.randint(0, lnY - N + 1)
+        diT = rng.randint(0, lmT - M + 1)
+        djT = rng.randint(0, lnT - N + 1)
+        src_np = rng.rand(lmY, lnY)
+        tgt_np = rng.rand(lmT, lnT)
+        Y = TwoDimBlockCyclic(int(lmY), int(lnY), int(mbY), int(nbY),
+                              dtype=np.float64).from_numpy(src_np)
+        T = TwoDimBlockCyclic(int(lmT), int(lnT), int(mbT), int(nbT),
+                              dtype=np.float64).from_numpy(tgt_np)
+        redistribute(Y, T, int(M), int(N), int(diY), int(djY),
+                     int(diT), int(djT), context=ctx)
+        _check(src_np, tgt_np, T.to_numpy(), M, N, diY, djY, diT, djT)
+
+
+@pytest.mark.parametrize("nb_ranks", [2, 4])
+def test_redistribute_multirank(nb_ranks):
+    """Block-cyclic P×1 source -> 1×Q target with different tile sizes:
+    most fragments cross ranks."""
+    lm = ln = 12
+    rng = np.random.RandomState(3)
+    src_np = rng.rand(lm, ln)
+    tgt_np = rng.rand(lm, ln)
+
+    def rank_fn(rank, fabric):
+        eng = RemoteDepEngine(fabric.engine(rank))
+        ctx = parsec_tpu.Context(nb_cores=1, comm=eng, enable_tpu=False)
+        try:
+            Y = TwoDimBlockCyclic(lm, ln, 4, 4, P=nb_ranks, Q=1,
+                                  nodes=nb_ranks, rank=rank,
+                                  dtype=np.float64).from_numpy(src_np)
+            T = TwoDimBlockCyclic(lm, ln, 3, 3, P=1, Q=nb_ranks,
+                                  nodes=nb_ranks, rank=rank,
+                                  dtype=np.float64).from_numpy(tgt_np)
+            redistribute(Y, T, 10, 10, disi_Y=1, disj_Y=2,
+                         disi_T=2, disj_T=1, context=ctx)
+            # collect this rank's local target tiles
+            out = {}
+            for (m, n) in T.local_tiles():
+                out[(m, n)] = np.array(T.tile(m, n))
+            return out
+        finally:
+            ctx.fini()
+
+    results, _ = spmd(nb_ranks, rank_fn)
+    # assemble the distributed result
+    expect = tgt_np.copy()
+    expect[2:12, 1:11] = src_np[1:11, 2:12]
+    got = np.zeros_like(expect)
+    T_geom = TwoDimBlockCyclic(lm, ln, 3, 3, P=1, Q=nb_ranks, nodes=nb_ranks)
+    for r, tiles in enumerate(results):
+        for (m, n), arr in tiles.items():
+            tm, tn = T_geom.tile_shape(m, n)
+            got[m * 3:m * 3 + tm, n * 3:n * 3 + tn] = arr
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_redistribute_tabular_target(ctx):
+    """Irregular per-tile rank table target (single process)."""
+    lm = ln = 10
+    rng = np.random.RandomState(11)
+    src_np = rng.rand(lm, ln)
+    Y = TwoDimBlockCyclic(lm, ln, 3, 3, dtype=np.float64).from_numpy(src_np)
+    T = TwoDimTabular.random(lm, ln, 4, 4, nodes=1, dtype=np.float64)
+    tgt_np = np.zeros((lm, ln))
+    T.from_numpy(tgt_np)
+    redistribute(Y, T, lm, ln, context=ctx)
+    np.testing.assert_array_equal(T.to_numpy(), src_np)
+
+
+def test_reshard_array_roundtrip():
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from parsec_tpu.parallel import make_mesh
+    mesh = make_mesh(sizes={"dp": 2, "tp": 2},
+                     devices=jax.devices("cpu")[:4])
+    x = np.arange(64, dtype=np.float32).reshape(8, 8)
+    a = reshard_array(jax.numpy.asarray(x), mesh, P("dp", "tp"))
+    b = reshard_array(a, mesh, P("tp", "dp"))
+    c = reshard_array(b, mesh, P())
+    np.testing.assert_array_equal(np.asarray(c), x)
