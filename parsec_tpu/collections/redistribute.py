@@ -62,19 +62,27 @@ def redistribute(source: TiledMatrix, target: TiledMatrix,
         "source region out of bounds"
     assert disi_T + size_row <= target.lm and disj_T + size_col <= target.ln, \
         "target region out of bounds"
-    # the DTD tile registry keys messages by collection name: give the two
-    # ends deterministic distinct names when the user didn't (SPMD-safe)
-    if getattr(source, "name", None) in (None, type(source).__name__):
-        source.name = "redist_Y"
-    if getattr(target, "name", None) in (None, type(target).__name__):
-        target.name = "redist_T"
-    assert source.name != target.name, \
-        "source and target collections need distinct .name values"
+    if taskpool is None and context is None:
+        raise ValueError(
+            "redistribute() needs a context (fresh pool, enqueued + waited) "
+            "or an existing taskpool to compose into")
     tp = taskpool if taskpool is not None else dtd.taskpool_new(
         name=f"redistribute_{source.lm}x{source.ln}")
     own = taskpool is None
     if own and context is not None:
         context.add_taskpool(tp)
+    # the DTD tile registry keys messages by collection name: give the two
+    # ends deterministic distinct names when the user didn't. A per-taskpool
+    # counter keeps composed calls collision-free (insertion streams are
+    # identical on every rank, so the counter is SPMD-consistent)
+    seq = getattr(tp, "_redist_seq", 0)
+    tp._redist_seq = seq + 1
+    if getattr(source, "name", None) in (None, type(source).__name__):
+        source.name = f"redist{seq}_Y"
+    if getattr(target, "name", None) in (None, type(target).__name__):
+        target.name = f"redist{seq}_T"
+    assert source.name != target.name, \
+        "source and target collections need distinct .name values"
 
     mbT, nbT = target.mb, target.nb
     mbY, nbY = source.mb, source.nb

@@ -177,9 +177,12 @@ class PTGTaskClass(TaskClass):
                 bound = True
                 break
             if not bound and not ref.fulfilled:
-                raise RuntimeError(
-                    f"{task.snprintf()}: input flow {f.name} unresolved "
-                    f"(activation missing)")
+                # every input dep's guard evaluated false with no
+                # alternative: a NULL input (reference: a guarded dep with
+                # no ':' alternative yields NULL in that instance;
+                # DepAST.resolve returns None, parser.py `cond ? a` form)
+                ref.data_in = None
+                ref.fulfilled = True
         # reshape pass: a consumer-declared [type=...] differing from the
         # producer's datatype converts through a shared reshape promise —
         # activation-sourced (remote) and memory/task-sourced (local) flows

@@ -1,0 +1,140 @@
+"""Collection-wide operations (apply / map_operator / tree reductions /
+broadcast / diag_band_to_rect) — numerics vs numpy, including
+non-power-of-two tile grids (the reference's reduce JDFs are tested at
+power-of-two extents only; ours must pass both).
+
+Reference analogs: parsec/data_dist/matrix/{apply,reduce,reduce_col,
+reduce_row,broadcast,diag_band_to_rect}.jdf, map_operator.c;
+tests/collections/reduce.
+"""
+import numpy as np
+import pytest
+
+from parsec_tpu.collections import TwoDimBlockCyclic
+from parsec_tpu.collections import ops as cops
+
+TILE = 4
+
+
+def _mk(mt, nt, seed=0):
+    rng = np.random.RandomState(seed)
+    M = rng.rand(mt * TILE, nt * TILE).astype(np.float32)
+    A = TwoDimBlockCyclic(mt * TILE, nt * TILE, TILE, TILE).from_numpy(M)
+    return M, A
+
+
+def _add(a, b, _args):
+    return a + b
+
+
+def test_apply_full(ctx):
+    M, A = _mk(3, 3)
+    cops.apply(ctx, A, lambda t, region, m, n, args: t * 2.0)
+    np.testing.assert_allclose(A.to_numpy(), M * 2.0, rtol=1e-6)
+
+
+def test_apply_lower(ctx):
+    M, A = _mk(3, 3, seed=1)
+    cops.apply(ctx, A, lambda t, region, m, n, args: t + 1.0, uplo="lower")
+    got = A.to_numpy()
+    exp = M.copy()
+    for m in range(3):
+        for n in range(3):
+            if n <= m:
+                exp[m * TILE:(m + 1) * TILE, n * TILE:(n + 1) * TILE] += 1.0
+    np.testing.assert_allclose(got, exp, rtol=1e-6)
+
+
+def test_apply_upper_region_arg(ctx):
+    """The diagonal task receives region=uplo so ops can mask."""
+    seen = []
+
+    def op(t, region, m, n, args):
+        seen.append((region, m, n))
+        return t
+
+    _, A = _mk(2, 2, seed=2)
+    cops.apply(ctx, A, op, uplo="upper")
+    regions = {s[0] for s in seen if s[1] == s[2]}
+    assert regions == {"upper"}
+    assert ("full", 0, 1) in seen
+    assert all(not (m > n) for (_, m, n) in seen)
+
+
+def test_map_operator(ctx):
+    Ms, S = _mk(2, 3, seed=3)
+    Md, D = _mk(2, 3, seed=4)
+    cops.map_operator(ctx, S, D, lambda s, d, m, n, args: s * d + m + n)
+    exp = np.empty_like(Md)
+    for m in range(2):
+        for n in range(3):
+            sl = np.s_[m * TILE:(m + 1) * TILE, n * TILE:(n + 1) * TILE]
+            exp[sl] = Ms[sl] * Md[sl] + m + n
+    np.testing.assert_allclose(D.to_numpy(), exp, rtol=1e-6)
+
+
+@pytest.mark.parametrize("mt", [1, 2, 3, 5, 8])
+def test_reduce_col(ctx, mt):
+    M, A = _mk(mt, 2, seed=mt)
+    dest = cops.reduce_col(ctx, A, _add)
+    exp = sum(M[m * TILE:(m + 1) * TILE] for m in range(mt))
+    np.testing.assert_allclose(dest.to_numpy(), exp, rtol=1e-5)
+    # source untouched by the reduction
+    np.testing.assert_allclose(A.to_numpy(), M, rtol=0)
+
+
+@pytest.mark.parametrize("nt", [1, 3, 4, 7])
+def test_reduce_row(ctx, nt):
+    M, A = _mk(2, nt, seed=10 + nt)
+    dest = cops.reduce_row(ctx, A, _add)
+    exp = sum(M[:, n * TILE:(n + 1) * TILE] for n in range(nt))
+    np.testing.assert_allclose(dest.to_numpy(), exp, rtol=1e-5)
+
+
+@pytest.mark.parametrize("mt,nt", [(1, 1), (2, 2), (3, 5)])
+def test_reduce_all(ctx, mt, nt):
+    M, A = _mk(mt, nt, seed=20 + mt + nt)
+    dest = cops.reduce_all(ctx, A, _add)
+    exp = np.zeros((TILE, TILE), dtype=np.float32)
+    for m in range(mt):
+        for n in range(nt):
+            exp += M[m * TILE:(m + 1) * TILE, n * TILE:(n + 1) * TILE]
+    np.testing.assert_allclose(dest.to_numpy(), exp, rtol=1e-5)
+
+
+def test_reduce_max_op(ctx):
+    """Non-additive fold: elementwise max."""
+    M, A = _mk(5, 1, seed=42)
+    dest = cops.reduce_col(ctx, A, lambda a, b, _: np.maximum(a, b))
+    exp = np.max(M.reshape(5, TILE, TILE), axis=0)
+    np.testing.assert_allclose(dest.to_numpy(), exp, rtol=0)
+
+
+def test_broadcast(ctx):
+    Ms, S = _mk(2, 2, seed=7)
+    _, D = _mk(3, 3, seed=8)
+    cops.broadcast(ctx, S, D, root=(1, 0))
+    root = Ms[TILE:2 * TILE, 0:TILE]
+    got = D.to_numpy()
+    for m in range(3):
+        for n in range(3):
+            np.testing.assert_allclose(
+                got[m * TILE:(m + 1) * TILE, n * TILE:(n + 1) * TILE], root,
+                rtol=0)
+
+
+def test_band_to_rect(ctx):
+    M, A = _mk(4, 4, seed=9)
+    rect = TwoDimBlockCyclic(2 * TILE, 4 * TILE, TILE, TILE)
+    tp = cops.band_to_rect_taskpool(A, rect)
+    ctx.add_taskpool(tp)
+    ctx.wait()
+    got = rect.to_numpy()
+    for k in range(4):
+        sl = np.s_[k * TILE:(k + 1) * TILE]
+        np.testing.assert_allclose(got[0:TILE, sl],
+                                   M[sl, k * TILE:(k + 1) * TILE], rtol=0)
+        if k >= 1:
+            np.testing.assert_allclose(
+                got[TILE:2 * TILE, sl],
+                M[(k - 1) * TILE:k * TILE, k * TILE:(k + 1) * TILE], rtol=0)

@@ -322,3 +322,33 @@ def test_guarded_single_target_dep(ctx):
     ctx.add_taskpool(tp)
     ctx.wait()
     assert sorted(got) == [(1, 0.0), (2, 1.0), (3, 2.0)]
+
+
+NULL_INPUT_JDF = """
+dummy [ type="collection" ]
+NT [ type="int" ]
+
+T(k)
+k = 0 .. NT-1
+: dummy( k )
+READ A <- (k > 0) ? dummy( k-1 )
+BODY
+{
+    got.append((k, None if A is None else float(A[0])))
+}
+END
+"""
+
+
+def test_null_input_when_all_guards_false(ctx):
+    """A guarded input dep with no ':' alternative binds NULL (None) in the
+    instances where the guard is false (reference: alternative-less guarded
+    input deps yield NULL; parser.py ``cond ? a`` form)."""
+    got = []
+    arr = np.arange(8, dtype=np.float64).reshape(8, 1)
+    coll = LocalArrayCollection(arr, 8)
+    tp = ptg.compile_jdf(NULL_INPUT_JDF, name="nullin").new(NT=3, dummy=coll)
+    tp.global_env["got"] = got
+    ctx.add_taskpool(tp)
+    ctx.wait()
+    assert sorted(got, key=lambda x: x[0]) == [(0, None), (1, 0.0), (2, 1.0)]
