@@ -13,6 +13,8 @@ Public API mirrors the reference's surface (parsec/runtime.h):
     ctx.fini()
 """
 from .runtime.context import Context, init
+from .runtime.compound import CompoundTaskpool, compose
+from .runtime.recursive import recursive_call
 from .runtime.taskpool import (Chore, Dep, Flow, HookReturn, Task, TaskClass,
                                Taskpool, TaskStatus)
 from .data.data import Coherency, Data, DataCopy, FlowAccess, data_new_with_payload
@@ -28,5 +30,6 @@ __all__ = [
     "Context", "init", "Taskpool", "TaskClass", "Task", "Chore", "Flow",
     "Dep", "HookReturn", "TaskStatus", "Data", "DataCopy", "Coherency",
     "FlowAccess", "Datatype", "Arena", "params", "dtd", "dsl",
+    "CompoundTaskpool", "compose", "recursive_call",
     "data_new_with_payload", "dtt_of_array", "__version__",
 ]

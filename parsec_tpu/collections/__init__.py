@@ -3,11 +3,12 @@ from .collection import DataCollection, DictCollection, LocalArrayCollection
 from .matrix import (SymTwoDimBlockCyclic, TiledMatrix, TwoDimBlockCyclic,
                      TwoDimBlockCyclicBand, TwoDimTabular, VectorTwoDimCyclic)
 from .redistribute import redistribute, reshard_array
+from .subtile import SubtileView
 from . import ops
 
 __all__ = [
     "DataCollection", "DictCollection", "LocalArrayCollection", "TiledMatrix",
     "TwoDimBlockCyclic", "SymTwoDimBlockCyclic", "TwoDimBlockCyclicBand",
     "TwoDimTabular", "VectorTwoDimCyclic", "redistribute", "reshard_array",
-    "ops",
+    "ops", "SubtileView",
 ]

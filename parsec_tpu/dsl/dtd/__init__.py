@@ -221,6 +221,16 @@ class DTDTaskpool(Taskpool):
         self.tdm.taskpool_addto_runtime_actions(1)
         self._alive = True
         self.comm = None  # remote-dep driver, attached on register
+        # inserts before context.add_taskpool are buffered and replayed at
+        # enqueue time, so DTD pools compose (parsec_compose chains enqueue
+        # parts later) and nest (recursive_call) naturally
+        self._pending_inserts: List[tuple] = []
+        self.on_enqueue = self._replay_pending_inserts
+
+    def _replay_pending_inserts(self, tp) -> None:
+        pending, self._pending_inserts = self._pending_inserts, []
+        for body, args, kw in pending:
+            self.insert_task(body, *args, **kw)
 
     # ------------------------------------------------------------------ #
     # tiles                                                              #
@@ -340,6 +350,10 @@ class DTDTaskpool(Taskpool):
         SURVEY.md §2.2 DTD row).
         """
         assert self._alive, "insert_task after wait()"
+        if self.context is None:
+            self._pending_inserts.append(
+                (body, args, dict(name=name, priority=priority)))
+            return None
         if not _internal:
             self._backpressure()
         # parse the vararg list (ref: __parsec_dtd_taskpool_create_task :3219)
@@ -572,6 +586,18 @@ class DTDTaskpool(Taskpool):
         for _, tile in self._tiles.items():
             if tile.flushed_at_seq != tile.writers_seq:
                 self.data_flush(tile)
+
+    def seal(self) -> None:
+        """No further inserts will come: flush dirty tiles and drop the
+        keep-alive so the pool terminates once its tasks finish. Used when
+        the pool runs without a blocking ``wait()`` — compound parts and
+        recursive sub-pools (compose/recursive_call call this on enqueue)."""
+        if not self._alive:
+            return
+        # flush while still alive: data_flush inserts flush tasks
+        self.data_flush_all()
+        self._alive = False
+        self.tdm.taskpool_addto_runtime_actions(-1)
 
     def wait(self) -> None:
         """ref: parsec_dtd_taskpool_wait — drop the keep-alive and help

@@ -11,6 +11,7 @@ parsec/scheduling.c:535-790; call stacks SURVEY.md §3.1-3.2).
 from __future__ import annotations
 
 import threading
+from collections import deque
 from typing import Any, Dict, List, Optional
 
 from ..utils import logging as plog
@@ -89,6 +90,12 @@ class Context:
         plog.debug.verbose(3, "context: %d threads, %d vps, %d devices, sched=%s",
                            self.nb_cores, len(self.vps), len(self.devices), name)
 
+        # deferred work: callbacks that must run on a scheduler thread with
+        # a live execution stream (e.g. completing a generator task when its
+        # nested taskpool terminates — the detection fires on an arbitrary
+        # thread; ref: HOOK_RETURN_ASYNC re-entry, scheduling.c:503-506)
+        self._deferred: "deque" = deque()
+
         # taskpool bookkeeping
         self.taskpools: Dict[int, Taskpool] = {}
         self._task_errors: List[BaseException] = []
@@ -129,12 +136,14 @@ class Context:
         with self._tp_lock:
             self.taskpools[tp.taskpool_id] = tp
             self._active_taskpools += 1
-        if tp.on_enqueue is not None:
-            tp.on_enqueue(tp)
         for dev in self.devices:
             dev.taskpool_register(tp)
         if self.comm is not None:
             self.comm.taskpool_register(tp)
+        # after device+comm registration: DTD's buffered-insert replay may
+        # synthesize remote send/recv tasks, which need tp.comm attached
+        if tp.on_enqueue is not None:
+            tp.on_enqueue(tp)
         if tp.startup_hook is not None:
             startup = tp.startup_hook(self, tp)
             if startup:
@@ -221,11 +230,26 @@ class Context:
         (the TPU analog of the CUDA manager/progress_stream polling and the
         funnelled comm thread; SURVEY.md §3.3-3.4)."""
         n = 0
+        while True:
+            try:
+                cb = self._deferred.popleft()
+            except IndexError:
+                break
+            try:
+                cb(es)
+            except BaseException as exc:  # surface on the waiter like a task
+                self.record_task_error(exc)
+            n += 1
         for dev in self.devices:
             n += dev.progress(es)
         if self.comm is not None:
             n += self.comm.progress(es)
         return n
+
+    def defer(self, cb) -> None:
+        """Run ``cb(es)`` on a scheduler thread during idle-cycle progress."""
+        self._deferred.append(cb)
+        self.wake_workers(1)
 
     # ------------------------------------------------------------------ #
     # shutdown                                                           #

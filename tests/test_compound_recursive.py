@@ -1,0 +1,166 @@
+"""Compound taskpools (sequential composition) and recursive task calls.
+
+Reference analogs: parsec_compose (parsec/compound.c:13-30) exercised by
+tests/api/compose.c; recursive calls (parsec/recursive.h:44-70) with
+subtile descriptors (parsec/data_dist/matrix/subtile.c).
+"""
+import numpy as np
+import pytest
+
+import parsec_tpu
+from parsec_tpu import compose, recursive_call
+from parsec_tpu.collections import SubtileView, TwoDimBlockCyclic
+from parsec_tpu.collections import ops as cops
+from parsec_tpu.dsl import dtd
+from parsec_tpu.dsl.dtd import INOUT, VALUE, unpack_args
+from parsec_tpu.ops import dpotrf_taskpool, make_spd
+
+TILE = 4
+
+
+def test_compose_orders_pools(ctx):
+    """(M*2)+1 != (M+1)*2 — the compound must run parts in order."""
+    M = np.arange(TILE * TILE * 4, dtype=np.float32).reshape(2 * TILE, 2 * TILE)
+    A = TwoDimBlockCyclic(2 * TILE, 2 * TILE, TILE, TILE).from_numpy(M)
+    a = cops.apply_taskpool(A, lambda t, r, m, n, _: t * 2.0)
+    b = cops.apply_taskpool(A, lambda t, r, m, n, _: t + 1.0)
+    ctx.add_taskpool(compose(a, b))
+    ctx.wait()
+    np.testing.assert_allclose(A.to_numpy(), M * 2.0 + 1.0, rtol=1e-6)
+
+
+def test_compose_appends_to_compound(ctx):
+    """compose(compound, c) appends in place (three-stage chain)."""
+    order = []
+
+    def stage(tag):
+        tp = dtd.taskpool_new(name=f"stage_{tag}")
+
+        def body(es, task):
+            order.append(tag)
+
+        tp.insert_task(body, name=f"t_{tag}")
+        return tp
+
+    c1 = compose(stage("a"), stage("b"))
+    c2 = compose(c1, stage("c"))
+    assert c2 is c1
+    ctx.add_taskpool(c2)
+    ctx.wait()
+    assert order == ["a", "b", "c"]
+
+
+def test_compose_rejects_enqueued(ctx):
+    tp1 = dtd.taskpool_new()
+    tp1.insert_task(lambda es, task: None, name="t")
+    ctx.add_taskpool(tp1)
+    tp1.wait()
+    tp2 = dtd.taskpool_new()
+    with pytest.raises(AssertionError):
+        compose(tp1, tp2)
+
+
+def test_recursive_call_completes_parent(ctx):
+    """A DTD task spawns a nested DTD pool; the parent task completes only
+    after the nested pool terminates."""
+    events = []
+
+    def parent_body(es, task):
+        sub = dtd.taskpool_new(name="nested")
+
+        def child(es2, t2):
+            events.append("child")
+
+        sub.insert_task(child, name="child")
+
+        def cb(sub_tp, ptask):
+            events.append("callback")
+
+        return recursive_call(es, task, sub, callback=cb)
+
+    tp = dtd.taskpool_new(name="parent")
+    ctx.add_taskpool(tp)
+    tp.insert_task(parent_body, name="parent")
+    tp.wait()
+    assert events == ["child", "callback"]
+
+
+def test_recursive_dpotrf_on_subtiles(ctx):
+    """The reference's flagship recursive pattern: a diagonal-tile POTRF
+    re-expressed as a nested tile Cholesky over sub-tiles, updating the
+    parent tile in place through SubtileView."""
+    n = 4 * TILE
+    M = make_spd(n, dtype=np.float32, seed=3)
+
+    tp = dtd.taskpool_new(name="recursive_potrf")
+    ctx.add_taskpool(tp)
+    tile = tp.tile_of_array(M.copy())
+
+    def factor(es, task):
+        (t,) = unpack_args(task)
+        sub = SubtileView(t, TILE, TILE)
+        return recursive_call(es, task, dpotrf_taskpool(sub))
+
+    tp.insert_task(factor, (tile, INOUT), name="factor")
+    tp.data_flush_all()
+    tp.wait()
+
+    got = np.asarray(tile.data.get_copy(0).payload)
+    L = np.tril(got)
+    np.testing.assert_allclose(L @ L.T, M, atol=5e-4)
+
+
+def test_recursive_inside_compound(ctx):
+    """Recursion composes with compound chaining."""
+    log = []
+
+    def rec_stage(tag):
+        tp = dtd.taskpool_new(name=f"outer_{tag}")
+
+        def outer(es, task):
+            sub = dtd.taskpool_new(name=f"inner_{tag}")
+            sub.insert_task(lambda e, t: log.append(f"in_{tag}"), name="i")
+            return recursive_call(es, task, sub,
+                                  callback=lambda s, t: log.append(f"cb_{tag}"))
+
+        tp.insert_task(outer, name="o")
+        return tp
+
+    ctx.add_taskpool(compose(rec_stage("x"), rec_stage("y")))
+    ctx.wait()
+    assert log == ["in_x", "cb_x", "in_y", "cb_y"]
+
+
+def test_subtile_view_geometry():
+    arr = np.arange(36, dtype=np.float32).reshape(6, 6)
+    v = SubtileView(arr, 4, 4)
+    assert (v.mt, v.nt) == (2, 2)
+    assert v.tile_shape(1, 1) == (2, 2)
+    # tiles are views: writes reach the parent array
+    t = v.data_of(0, 0).get_copy(0).payload
+    t[0, 0] = 99.0
+    assert arr[0, 0] == 99.0
+
+
+def test_compose_dtd_with_tracked_tiles(ctx):
+    """Composed DTD pools that write tracked tiles must seal cleanly
+    (flush runs before the pool stops accepting inserts)."""
+    from parsec_tpu.dsl.dtd import INOUT, unpack_args
+
+    arr1 = np.zeros((TILE, TILE), np.float32)
+    arr2 = np.zeros((TILE, TILE), np.float32)
+
+    def writer(value):
+        tp = dtd.taskpool_new(name=f"w{value}")
+        tile = tp.tile_of_array(arr1 if value == 1 else arr2)
+
+        def body(es, task):
+            (t,) = unpack_args(task)
+            t += value
+
+        tp.insert_task(body, (tile, INOUT), name="w")
+        return tp
+
+    ctx.add_taskpool(compose(writer(1), writer(2)))
+    ctx.wait()
+    assert arr1[0, 0] == 1.0 and arr2[0, 0] == 2.0
