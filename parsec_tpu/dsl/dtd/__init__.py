@@ -23,6 +23,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ...core.hashtable import HashTable
+from ...profiling.grapher import grapher
 from ...data.data import (Coherency, Data, DataCopy, FlowAccess,
                           data_new_with_payload)
 from ...data.datatype import dtt_of_array
@@ -194,6 +195,8 @@ def _dtd_release_deps(es, task: Task, action_mask: int) -> List[Task]:
         rec.completed = True
         succs, rec.successors = rec.successors, []
     for s in succs:
+        if grapher.enabled:
+            grapher.dep(task, s.task.snprintf())
         if s.dep_satisfied():
             ready.append(s.task)
     tp: DTDTaskpool = task.taskpool

@@ -24,6 +24,7 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tupl
 import numpy as np
 
 from ...core.hashtable import HashTable
+from ...profiling.grapher import grapher
 from ...data.data import Coherency, Data, DataCopy, FlowAccess
 from ...data.datatype import Datatype, dtt_of_array
 from ...data.reshape import ReshapeRepo
@@ -257,6 +258,8 @@ class PTGTaskClass(TaskClass):
 
         def activate(succ_tc: "PTGTaskClass", succ_locals: Tuple,
                      flow_name: str, copy, out_idx: int) -> None:
+            if grapher.enabled:
+                grapher.dep(task, f"{succ_tc.name}{succ_locals}", flow_name)
             env = succ_tc.env_of(succ_locals)
             dst = succ_tc.rank_of_instance(env)
             if dst == self.tp.rank:

@@ -1,0 +1,184 @@
+"""Replay a PTG taskpool through the DTD engine.
+
+Reference behavior: the ``ptg_to_dtd`` PINS module intercepts a PTG
+taskpool and re-executes it with the dynamic-task-discovery front end —
+a cross-DSL consistency check and a migration aid (ref:
+parsec/mca/pins/ptg_to_dtd/).
+
+TPU-native re-design: instead of intercepting at the scheduler, we
+*compile* the PTG's instance graph into a DTD insertion stream:
+
+1. enumerate every task instance of every class;
+2. build the dependency edges with the same resolution logic the PTG
+   runtime uses (input deps that resolve to task sources);
+3. topologically order the instances (DTD discovers deps from the
+   *sequential* insertion order, so the stream must be a valid sequential
+   schedule);
+4. map each data flow to its *memory anchor* — the collection tile the
+   flow chain ultimately originates from / writes back to — by walking
+   input-dep chains backwards; that tile becomes the DTD tracked datum
+   with IN/INOUT access derived from the flow access.
+
+Flows with no memory anchor (NEW scratch, CTL) carry no data dependency —
+same restriction as the reference module. Bodies run the JDF's host BODY
+code with flow names bound to the DTD tile payloads.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...data.data import FlowAccess
+from .runtime import PTGTaskpool, PTGTaskClass
+
+__all__ = ["ptg_to_dtd"]
+
+_ACCESS = {"RW": "inout", "READ": "input", "WRITE": "inout", "CTL": None}
+
+
+def _instances(tp: PTGTaskpool):
+    for tc in tp.task_classes:
+        for locals_ in tc.iter_space():
+            yield (tc, locals_)
+
+
+def _producer_edges(tc: PTGTaskClass, locals_: Tuple):
+    """(producer_class_name, producer_locals) for each task-sourced input."""
+    env = tc.env_of(locals_)
+    for f in tc.ast.flows:
+        for d in f.deps_in():
+            t = d.resolve(env)
+            if t is not None and t.kind == "task":
+                args = tuple(a(env) for a in t.args)
+                yield (t.task_class, args)
+
+
+def _memory_anchor(tp: PTGTaskpool, tc: PTGTaskClass, locals_: Tuple,
+                   flow_name: str, memo: Dict) -> Optional[Tuple[str, Tuple]]:
+    """The (collection, indices) a flow's data chain originates from,
+    following task-sourced inputs backwards (the same datatype-lookup walk
+    the reference does on the receiver side, remote_dep_mpi.c:766)."""
+    key = (tc.task_class_id, locals_, flow_name)
+    if key in memo:
+        return memo[key]
+    memo[key] = None  # cycle guard; RW chains terminate at memory
+    env = tc.env_of(locals_)
+    fl = tc.ast.flow_by_name(flow_name)
+    anchor = None
+    for d in fl.deps_in():
+        t = d.resolve(env)
+        if t is None:
+            continue
+        if t.kind == "memory":
+            anchor = (t.collection, tuple(a(env) for a in t.args))
+        elif t.kind == "task":
+            args = tuple(a(env) for a in t.args)
+            anchor = _memory_anchor(tp, tp.class_by_name(t.task_class),
+                                    args, t.flow, memo)
+        break  # first resolving dep defines the chain, as in prepare_input
+    if anchor is None:
+        for d in fl.deps_out():
+            t = d.resolve(env)
+            if t is not None and t.kind == "memory":
+                anchor = (t.collection, tuple(a(env) for a in t.args))
+                break
+    memo[key] = anchor
+    return anchor
+
+
+def ptg_to_dtd(ptg_tp: PTGTaskpool, context) -> Any:
+    """Execute ``ptg_tp``'s DAG through a fresh DTD taskpool on ``context``
+    (blocking). The PTG pool itself is never enqueued. Returns the DTD pool
+    (already waited)."""
+    from ..dtd import (AccessMode, taskpool_new)
+
+    assert ptg_tp.context is None, "ptg_to_dtd wants a non-enqueued PTG pool"
+
+    # 1-2: instances + edges
+    nodes: List[Tuple[PTGTaskClass, Tuple]] = list(_instances(ptg_tp))
+    index = {(tc.name, loc): i for i, (tc, loc) in enumerate(nodes)}
+    indeg = [0] * len(nodes)
+    succs: List[List[int]] = [[] for _ in nodes]
+    for i, (tc, loc) in enumerate(nodes):
+        for pname, plocals in _producer_edges(tc, loc):
+            j = index.get((pname, plocals))
+            if j is not None:
+                succs[j].append(i)
+                indeg[i] += 1
+
+    # 3: Kahn topological order (deterministic: FIFO over definition order)
+    order: List[int] = []
+    q = deque(i for i in range(len(nodes)) if indeg[i] == 0)
+    while q:
+        i = q.popleft()
+        order.append(i)
+        for s in succs[i]:
+            indeg[s] -= 1
+            if indeg[s] == 0:
+                q.append(s)
+    assert len(order) == len(nodes), "PTG dependency graph has a cycle"
+
+    # 4: insert in topo order with memory-anchored tiles
+    dtd_tp = taskpool_new(name=f"{ptg_tp.name}_as_dtd")
+    context.add_taskpool(dtd_tp)
+    memo: Dict = {}
+    for i in order:
+        tc, locals_ = nodes[i]
+        flow_binds: List[Tuple[str, Optional[Any], str]] = []
+        args = []
+        for f in tc.ast.flows:
+            if f.is_ctl:
+                continue
+            anchor = _memory_anchor(ptg_tp, tc, locals_, f.name, memo)
+            if anchor is None:
+                flow_binds.append((f.name, None, f.access))
+                continue
+            coll = ptg_tp.global_env[anchor[0]]
+            # the DTD tile registry keys by collection name; default-named
+            # collections get their (unique) PTG global name
+            if getattr(coll, "name", None) == type(coll).__name__:
+                coll.name = f"{ptg_tp.name}.{anchor[0]}"
+            tile = dtd_tp.tile_of(coll, coll.data_key(*anchor[1]))
+            mode = AccessMode.INPUT if f.access == "READ" else AccessMode.INOUT
+            flow_binds.append((f.name, tile, f.access))
+            args.append((tile, mode))
+
+        host_bodies = [b for b in tc.ast.bodies
+                       if b.device_type in ("cpu", "recursive")]
+        body_src = (host_bodies[0] if host_bodies else tc.ast.bodies[0]).code
+        code = compile(body_src, f"<ptg_to_dtd:{tc.name}>", "exec")
+
+        def make_body(tc=tc, locals_=locals_, code=code, flow_binds=flow_binds):
+            def body(es, task):
+                env = tc.env_of(locals_)
+                payloads = {}
+                for fname, tile, access in flow_binds:
+                    if tile is None:
+                        env[fname] = None
+                        continue
+                    arr = tile.data.sync_to_host(es.context.devices).payload
+                    env[fname] = arr
+                    payloads[fname] = arr
+                env["np"] = np
+                try:
+                    import jax.numpy as jnp
+                    env.setdefault("jnp", jnp)
+                except Exception:
+                    pass
+                exec(code, env)
+                for fname, tile, access in flow_binds:
+                    if tile is None or access == "READ":
+                        continue
+                    new_val = env.get(fname)
+                    old = payloads[fname]
+                    if new_val is not None and new_val is not old:
+                        np.copyto(old, np.asarray(new_val))
+            return body
+
+        dtd_tp.insert_task(make_body(), *args,
+                           name=f"{tc.name}{locals_}")
+    dtd_tp.data_flush_all()
+    dtd_tp.wait()
+    return dtd_tp

@@ -153,3 +153,89 @@ class AlperfModule(PinsModule):
                 for t in payload:
                     k = t.task_class.name
                     self.enabled[k] = self.enabled.get(k, 0) + 1
+
+
+class IteratorsCheckerModule(PinsModule):
+    """Race/correctness checker: re-runs a PTG task's iterate_successors at
+    release time and validates (a) every successor instance lies inside its
+    class's iteration space and (b) the successor's input dep on that flow
+    resolves back to this producer instance (ref: pins/iterators_checker —
+    "validates iterate_successors consistency", SURVEY.md §5.1)."""
+
+    name = "iterators_checker"
+    events = [PinsEvent.RELEASE_DEPS_BEGIN]
+
+    def __init__(self) -> None:
+        self.errors: List[str] = []
+        self.checked = 0
+
+    def callback(self, es: Any, event: PinsEvent, task: Any) -> None:
+        tc = task.task_class
+        if not hasattr(tc, "ast") or not hasattr(tc, "_iterate_successors"):
+            return  # PTG-only checker, like the reference
+        self.checked += 1
+
+        def check(succ_tc, succ_locals, flow_name, copy, out_idx):
+            # (a) successor locals within its iteration-space ranges
+            env = dict(succ_tc.tp.global_env)
+            it = iter(succ_locals)
+            for ld in succ_tc.ast.locals:
+                if ld.range is not None:
+                    v = next(it)
+                    if v not in ld.range.values(env):
+                        self.errors.append(
+                            f"{task.snprintf()} -> {succ_tc.name}{succ_locals}"
+                            f": local {ld.name}={v} outside its range")
+                    env[ld.name] = v
+                else:
+                    env[ld.name] = ld.expr(env)
+            # (b) reciprocal input dep resolves back to the producer
+            fl = succ_tc.ast.flow_by_name(flow_name)
+            for d in fl.deps_in():
+                t = d.resolve(env)
+                if t is None or t.kind != "task":
+                    continue
+                if t.task_class == tc.name:
+                    args = tuple(a(env) for a in t.args)
+                    if args == tuple(task.locals):
+                        return
+            self.errors.append(
+                f"{succ_tc.name}{succ_locals}.{flow_name}: no input dep "
+                f"resolving back to producer {task.snprintf()}")
+
+        tc._iterate_successors(es, task, check)
+
+
+class TaskTimeModule(PinsModule):
+    """Per-task-class wall + thread-CPU time accumulation — the software
+    stand-in for the reference's papi PINS module (hardware counters per
+    event, ref: pins/papi; no PMU access from userspace here, so the
+    counters are clock-based)."""
+
+    name = "task_time"
+    events = [PinsEvent.EXEC_BEGIN, PinsEvent.EXEC_END]
+
+    def __init__(self) -> None:
+        import time
+        self._time = time
+        self._open: Dict[int, tuple] = {}
+        self.wall_ns: Dict[str, int] = {}
+        self.cpu_ns: Dict[str, int] = {}
+        self.count: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def callback(self, es: Any, event: PinsEvent, payload: Any) -> None:
+        t = self._time
+        if event == PinsEvent.EXEC_BEGIN:
+            self._open[es.th_id] = (t.monotonic_ns(), t.thread_time_ns())
+            return
+        opened = self._open.pop(es.th_id, None)
+        if opened is None or payload is None:
+            return
+        name = payload.task_class.name
+        dw = t.monotonic_ns() - opened[0]
+        dc = t.thread_time_ns() - opened[1]
+        with self._lock:
+            self.wall_ns[name] = self.wall_ns.get(name, 0) + dw
+            self.cpu_ns[name] = self.cpu_ns.get(name, 0) + dc
+            self.count[name] = self.count.get(name, 0) + 1

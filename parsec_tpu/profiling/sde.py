@@ -1,0 +1,81 @@
+"""Software-defined event counters (the PAPI-SDE analog).
+
+Reference behavior: the runtime exports named software counters that
+external tools can poll — ready-task queue lengths per scheduler, tasks
+enabled/retired — registered either as owned integers or as pull
+callbacks (ref: parsec/papi_sde.c + vendored sde_lib.h; registrations in
+parsec/scheduling.c:319-323,455 and per-scheduler e.g.
+parsec/mca/sched/lfq/sched_lfq_module.c:141-151).
+
+TPU-native re-design: a process-wide registry of named counters. Two
+kinds, matching the reference's owned-vs-callback split:
+
+- ``inc(name, v)`` — an owned accumulating counter (lock-free via GIL int
+  adds on the hot path);
+- ``register_poll(name, fn)`` — a gauge computed on read (queue lengths).
+
+``read(name)`` / ``snapshot()`` serve tools; counters use the reference's
+``PARSEC::``-style namespacing so dashboards can group them.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict
+
+__all__ = ["SDERegistry", "sde",
+           "TASKS_ENABLED", "TASKS_RETIRED", "PENDING_TASKS"]
+
+TASKS_ENABLED = "PARSEC::TASKS_ENABLED"
+TASKS_RETIRED = "PARSEC::TASKS_RETIRED"
+PENDING_TASKS = "PARSEC::SCHEDULER::PENDING_TASKS"
+
+
+class SDERegistry:
+    def __init__(self) -> None:
+        self._counters: Dict[str, int] = {}
+        self._polls: Dict[str, Callable[[], Any]] = {}
+        self._lock = threading.Lock()
+
+    # -- owned accumulating counters ---------------------------------------
+    def inc(self, name: str, v: int = 1) -> None:
+        # dict int add under the GIL; registration is implicit like
+        # sde_lib's create-on-first-use counters
+        self._counters[name] = self._counters.get(name, 0) + v
+
+    # -- pull gauges --------------------------------------------------------
+    def register_poll(self, name: str, fn: Callable[[], Any]) -> None:
+        with self._lock:
+            self._polls[name] = fn
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._polls.pop(name, None)
+            self._counters.pop(name, None)
+
+    # -- reading ------------------------------------------------------------
+    def read(self, name: str) -> Any:
+        fn = self._polls.get(name)
+        if fn is not None:
+            return fn()
+        return self._counters.get(name, 0)
+
+    def snapshot(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = dict(self._counters)
+        for name, fn in list(self._polls.items()):
+            try:
+                out[name] = fn()
+            except Exception:
+                out[name] = None
+        return out
+
+    def names(self):
+        return sorted(set(self._counters) | set(self._polls))
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._polls.clear()
+
+
+#: process-wide registry (the reference's sde handle is process-global too)
+sde = SDERegistry()

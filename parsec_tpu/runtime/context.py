@@ -16,6 +16,8 @@ from typing import Any, Dict, List, Optional
 
 from ..utils import logging as plog
 from ..utils.params import params
+from ..profiling.grapher import grapher
+from ..profiling.sde import PENDING_TASKS, sde
 from ..profiling.trace import Profile
 from ..profiling.pins import TaskProfilerModule
 from .scheduling import ExecutionStream, context_wait_loop, schedule
@@ -60,6 +62,10 @@ class Context:
             self._prof_prefix = prof_prefix or "parsec_prof"
             self._task_profiler = TaskProfilerModule(self.profile)
             self._task_profiler.enable()
+        # executed-DAG capture (ref: --parsec_dot, parsec.c:596-614)
+        self._dot_prefix = params.get("profiling_dot") or None
+        if self._dot_prefix:
+            grapher.enable()
 
         # virtual processes + execution streams
         self.vps: List[VirtualProcess] = []
@@ -87,6 +93,10 @@ class Context:
         self.scheduler.install(self)
         for es in self.execution_streams:
             self.scheduler.flow_init(es)
+        # SDE gauge: ready-task backlog (ref: per-scheduler PAPI-SDE
+        # registration, sched_lfq_module.c:141-151)
+        sde.register_poll(PENDING_TASKS,
+                          lambda: self.scheduler.pending_tasks(self))
         plog.debug.verbose(3, "context: %d threads, %d vps, %d devices, sched=%s",
                            self.nb_cores, len(self.vps), len(self.devices), name)
 
@@ -275,6 +285,10 @@ class Context:
         if self.profile is not None and self._prof_prefix:
             path = self.profile.dump(self._prof_prefix)
             plog.inform("trace written to %s", path)
+        if self._dot_prefix:
+            path = grapher.dump(f"{self._dot_prefix}.rank{self.rank}.dot")
+            grapher.disable()
+            plog.inform("DAG written to %s", path)
         self.scheduler.remove(self)
 
     def __enter__(self) -> "Context":

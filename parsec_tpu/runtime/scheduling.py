@@ -17,7 +17,9 @@ from typing import Any, List, Optional
 
 from ..utils import logging as plog
 from ..utils.params import params
+from ..profiling.grapher import grapher
 from ..profiling.pins import PINS, PinsEvent
+from ..profiling.sde import TASKS_ENABLED, TASKS_RETIRED, sde
 from .taskpool import HookReturn, Task, TaskStatus, ACTION_RELEASE_ALL
 
 _sched_log = plog.sched_stream
@@ -66,6 +68,7 @@ def schedule(es: ExecutionStream, tasks: List[Task], distance: int = 0) -> None:
     PINS(es, PinsEvent.SCHEDULE_BEGIN, tasks)
     ctx.scheduler.schedule(es, tasks, distance)
     PINS(es, PinsEvent.SCHEDULE_END, tasks)
+    sde.inc(TASKS_ENABLED, len(tasks))
     ctx.wake_workers(len(tasks))
 
 
@@ -125,6 +128,8 @@ def complete_execution(es: ExecutionStream, task: Task) -> None:
     else:
         ready = []
     es.nb_tasks_executed += 1
+    sde.inc(TASKS_RETIRED)
+    grapher.task_executed(es, task)
     tp = task.taskpool
     if tc.release_task is not None:
         tc.release_task(es, task)

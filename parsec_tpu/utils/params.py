@@ -206,6 +206,9 @@ def register_core_params() -> None:
                     "keep best ready task on releasing thread, bypass scheduler")
     params.reg_int("verbose", 0, "global debug verbosity")
     params.reg_string("profile", "", "enable profiling; path prefix for traces")
+    params.reg_string("profiling_dot", "",
+                      "capture the executed DAG; path prefix for DOT files "
+                      "(ref: --parsec_dot)")
     params.reg_string("termdet", "local", "termination detection module")
     params.reg_int("gpu_max_streams", 4, "per-accelerator concurrent exec lanes")
     params.reg_sizet("tpu_memory_fraction_pct", 85,

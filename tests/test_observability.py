@@ -1,0 +1,176 @@
+"""Observability: DOT grapher, SDE counters, PINS checker modules,
+Chrome-trace export, and the ptg_to_dtd replay.
+
+Reference analogs: parsec_prof_grapher.c (DOT capture), papi_sde.c
+(software counters), pins/iterators_checker, pins/papi, pins/ptg_to_dtd,
+profiling.c + tools/profiling (trace export / pandas tables),
+tests/profiling/check-async.py.
+"""
+import numpy as np
+import pytest
+
+import parsec_tpu
+from parsec_tpu.collections import TwoDimBlockCyclic
+from parsec_tpu.collections import ops as cops
+from parsec_tpu.dsl import ptg
+from parsec_tpu.profiling import (IteratorsCheckerModule, TaskTimeModule,
+                                  TASKS_RETIRED, grapher, sde)
+from parsec_tpu.profiling.trace import Profile
+from parsec_tpu.profiling.pins import TaskProfilerModule
+
+TILE = 4
+
+CHAIN_JDF = """
+descA [ type="collection" ]
+NT [ type="int" ]
+
+STEP(k)
+k = 0 .. NT-1
+: descA( 0, 0 )
+RW A <- (k == 0) ? descA( 0, 0 ) : A STEP( k-1 )
+     -> (k < NT-1) ? A STEP( k+1 )
+     -> (k == NT-1) ? descA( 0, 0 )
+BODY
+{
+    A = A + 1.0
+}
+END
+"""
+
+
+def _chain_tp(nt=4):
+    A = TwoDimBlockCyclic(TILE, TILE, TILE, TILE).from_numpy(
+        np.zeros((TILE, TILE), np.float32))
+    tp = ptg.compile_jdf(CHAIN_JDF, name="chain").new(descA=A, NT=nt)
+    return tp, A
+
+
+def test_grapher_captures_nodes_and_edges(ctx):
+    grapher.enable()
+    try:
+        tp, A = _chain_tp(5)
+        ctx.add_taskpool(tp)
+        ctx.wait()
+        assert grapher.nb_nodes() == 5
+        assert grapher.nb_edges() == 4
+        dot = grapher.to_dot()
+        assert "digraph" in dot and "STEP_0_" in dot
+        assert dot.count("->") == 4
+    finally:
+        grapher.disable()
+
+
+def test_grapher_dtd_edges(ctx):
+    from parsec_tpu.dsl import dtd
+    from parsec_tpu.dsl.dtd import INOUT, unpack_args
+    grapher.enable()
+    try:
+        tp = dtd.taskpool_new()
+        ctx.add_taskpool(tp)
+        tile = tp.tile_of_array(np.zeros((2, 2), np.float32))
+
+        def bump(es, task):
+            (t,) = unpack_args(task)
+            t += 1
+
+        for _ in range(3):
+            tp.insert_task(bump, (tile, INOUT), name="bump")
+        tp.data_flush_all()
+        tp.wait()
+        assert grapher.nb_nodes() >= 3
+        assert grapher.nb_edges() >= 2  # the INOUT chain
+    finally:
+        grapher.disable()
+
+
+def test_sde_counters(ctx):
+    before = sde.read(TASKS_RETIRED)
+    tp, A = _chain_tp(6)
+    ctx.add_taskpool(tp)
+    ctx.wait()
+    assert sde.read(TASKS_RETIRED) >= before + 6
+    snap = sde.snapshot()
+    assert TASKS_RETIRED in snap
+    # the scheduler gauge answers (possibly -1 when unsupported)
+    assert "PARSEC::SCHEDULER::PENDING_TASKS" in snap
+
+
+def test_iterators_checker_clean_dag(ctx):
+    from parsec_tpu.ops import dpotrf, make_spd
+    mod = IteratorsCheckerModule()
+    mod.enable()
+    try:
+        n = 4 * TILE
+        M = make_spd(n)
+        A = TwoDimBlockCyclic(n, n, TILE, TILE).from_numpy(M)
+        dpotrf(ctx, A)
+        assert mod.checked > 0
+        assert mod.errors == [], mod.errors[:3]
+    finally:
+        mod.disable()
+
+
+def test_task_time_module(ctx):
+    mod = TaskTimeModule()
+    mod.enable()
+    try:
+        tp, A = _chain_tp(4)
+        ctx.add_taskpool(tp)
+        ctx.wait()
+        assert mod.count.get("STEP", 0) == 4
+        assert mod.wall_ns.get("STEP", 0) > 0
+    finally:
+        mod.disable()
+
+
+def test_chrome_trace_and_dataframe(ctx, tmp_path):
+    prof = Profile(rank=0)
+    mod = TaskProfilerModule(prof)
+    mod.enable()
+    try:
+        tp, A = _chain_tp(3)
+        ctx.add_taskpool(tp)
+        ctx.wait()
+    finally:
+        mod.disable()
+    doc = prof.to_chrome_trace()
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert "exec:STEP" in names
+    out = prof.dump(str(tmp_path / "t.json"))
+    assert out.endswith(".json")
+    df = prof.to_dataframe()
+    assert (df["name"] == "exec:STEP").sum() == 3
+    assert (df["duration_ns"] > 0).all()
+
+
+def test_ptg_to_dtd_replay(ctx):
+    """The GEMM k-chain JDF replayed through DTD matches numpy."""
+    from parsec_tpu.dsl.ptg.to_dtd import ptg_to_dtd
+    from tests.test_ptg_gemm import GEMM_JDF
+
+    mt = nt = kt = 2
+    rng = np.random.RandomState(11)
+    Am = rng.rand(mt * TILE, kt * TILE).astype(np.float32)
+    Bm = rng.rand(kt * TILE, nt * TILE).astype(np.float32)
+    Cm = rng.rand(mt * TILE, nt * TILE).astype(np.float32)
+    A = TwoDimBlockCyclic(mt * TILE, kt * TILE, TILE, TILE).from_numpy(Am)
+    B = TwoDimBlockCyclic(kt * TILE, nt * TILE, TILE, TILE).from_numpy(Bm)
+    C = TwoDimBlockCyclic(mt * TILE, nt * TILE, TILE, TILE).from_numpy(Cm)
+    tp = ptg.compile_jdf(GEMM_JDF, name="gemm").new(
+        descA=A, descB=B, descC=C, MT=mt, NT=nt, KT=kt)
+    ptg_to_dtd(tp, ctx)
+    np.testing.assert_allclose(C.to_numpy(), Cm + Am @ Bm, rtol=2e-5)
+
+
+def test_ptg_to_dtd_replay_dpotrf(ctx):
+    """Cross-DSL consistency on a non-trivial DAG: dpotrf via DTD."""
+    from parsec_tpu.dsl.ptg.to_dtd import ptg_to_dtd
+    from parsec_tpu.ops import make_spd
+    from parsec_tpu.ops.dpotrf import dpotrf_taskpool
+
+    n = 3 * TILE
+    M = make_spd(n)
+    A = TwoDimBlockCyclic(n, n, TILE, TILE).from_numpy(M)
+    ptg_to_dtd(dpotrf_taskpool(A), ctx)
+    L = np.tril(A.to_numpy())
+    np.testing.assert_allclose(L @ L.T, M, atol=5e-4)
