@@ -238,27 +238,30 @@ class DTDTaskpool(Taskpool):
     # ------------------------------------------------------------------ #
     # tiles                                                              #
     # ------------------------------------------------------------------ #
-    def tile_of(self, collection, key: Any) -> DTDTile:
+    def tile_of(self, collection, key: Any,
+                wire_name: Optional[str] = None) -> DTDTile:
         """ref: parsec_dtd_tile_of (insert_function.h:219) — one DTDTile per
         (collection, key), memoized. The wire key uses the collection *name*
-        so SPMD ranks agree on it (per-rank instances of one logical
-        collection must share a name in multi-rank runs)."""
+        (or the explicit ``wire_name`` override) so SPMD ranks agree on it
+        (per-rank instances of one logical collection must share a name in
+        multi-rank runs)."""
+        name = wire_name if wire_name is not None else collection.name
         tkey = (id(collection), key)
-        # wire keys are (collection.name, key): catch two distinct
-        # collections sharing a name before they cross-deliver tile data
-        owner = self._coll_names.setdefault(collection.name, id(collection))
+        # wire keys are (name, key): catch two distinct collections sharing
+        # a name before they cross-deliver tile data
+        owner = self._coll_names.setdefault(name, id(collection))
         if owner != id(collection):
             raise ValueError(
-                f"two collections share the name {collection.name!r}; "
+                f"two collections share the name {name!r}; "
                 f"set distinct .name values (the name keys tile messages "
                 f"between ranks)")
 
         def factory() -> DTDTile:
             rank = collection.rank_of_key(key)
             data = collection.data_of_key(key) if rank == self.my_rank \
-                else Data(key=("remote", collection.name, key))
+                else Data(key=("remote", name, key))
             return DTDTile(key, data, rank=rank, home_collection=collection,
-                           comm_key=(collection.name, key))
+                           comm_key=(name, key))
         tile, _ = self._tiles.find_or_insert(tkey, factory)
         return tile
 

@@ -259,7 +259,9 @@ class PTGTaskClass(TaskClass):
         def activate(succ_tc: "PTGTaskClass", succ_locals: Tuple,
                      flow_name: str, copy, out_idx: int) -> None:
             if grapher.enabled:
-                grapher.dep(task, f"{succ_tc.name}{succ_locals}", flow_name)
+                # must match Task.snprintf() so DOT edges hit real nodes
+                grapher.dep(task, f"{succ_tc.name}"
+                            f"({', '.join(map(str, succ_locals))})", flow_name)
             env = succ_tc.env_of(succ_locals)
             dst = succ_tc.rank_of_instance(env)
             if dst == self.tp.rank:

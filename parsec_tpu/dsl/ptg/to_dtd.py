@@ -35,7 +35,6 @@ from .runtime import PTGTaskpool, PTGTaskClass
 
 __all__ = ["ptg_to_dtd"]
 
-_ACCESS = {"RW": "inout", "READ": "input", "WRITE": "inout", "CTL": None}
 
 
 def _instances(tp: PTGTaskpool):
@@ -137,10 +136,13 @@ def ptg_to_dtd(ptg_tp: PTGTaskpool, context) -> Any:
                 continue
             coll = ptg_tp.global_env[anchor[0]]
             # the DTD tile registry keys by collection name; default-named
-            # collections get their (unique) PTG global name
+            # collections ride their (unique) PTG global name on the wire
+            # without mutating the caller's object
+            wire = None
             if getattr(coll, "name", None) == type(coll).__name__:
-                coll.name = f"{ptg_tp.name}.{anchor[0]}"
-            tile = dtd_tp.tile_of(coll, coll.data_key(*anchor[1]))
+                wire = f"{ptg_tp.name}.{anchor[0]}"
+            tile = dtd_tp.tile_of(coll, coll.data_key(*anchor[1]),
+                                  wire_name=wire)
             mode = AccessMode.INPUT if f.access == "READ" else AccessMode.INOUT
             flow_binds.append((f.name, tile, f.access))
             args.append((tile, mode))

@@ -5,6 +5,14 @@ from .linalg import (axpy, gemm, gemm_nn, gemm_nt, potrf, scal, syrk_ln,
 from . import dpotrf as dpotrf_module
 from .dpotrf import dpotrf, dpotrf_factory, dpotrf_taskpool, make_spd
 
+try:  # pallas.tpu is optional at import time (older/partial jax builds)
+    from . import pallas_kernels
+    from .pallas_kernels import flash_attention
+except ImportError:  # pragma: no cover
+    pallas_kernels = None
+    flash_attention = None
+
 __all__ = ["potrf", "trsm_panel", "syrk_ln", "gemm_nt", "gemm_nn", "gemm",
            "axpy", "scal", "transpose", "dpotrf", "dpotrf_factory",
-           "dpotrf_taskpool", "make_spd"]
+           "dpotrf_taskpool", "make_spd", "pallas_kernels",
+           "flash_attention"]

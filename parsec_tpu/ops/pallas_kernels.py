@@ -1,0 +1,305 @@
+"""Pallas TPU kernels for the hot ops.
+
+The reference keeps its hot math in hand-tuned native kernels (CUDA chores
+generated per task class, ref: parsec/interfaces/ptg/ptg-compiler/jdf2c.c:6557;
+the lone .cu kernel tests/dsl/dtd/dtd_test_new_tile_cuda_kernels.cu). The
+TPU-native analog is Pallas: Mosaic kernels that tile onto MXU/VPU with
+explicit VMEM residency. Two kernels live here:
+
+- ``flash_attention``: blockwise online-softmax attention (fwd is a single
+  Pallas kernel with grid (BH, q_blocks, k_blocks); m/l/acc live in VMEM
+  scratch that persists across the sequential k dimension). Differentiable
+  via custom_vjp; the backward recomputes blockwise with the same online
+  softmax inside ``lax.scan`` (memory O(T·block), not O(T^2)).
+- ``matmul``: blocked GEMM with a float32 VMEM accumulator across the
+  sequential K grid dimension (the MXU-feeding pattern the dpotrf update
+  kernels ride on).
+
+Off-TPU (the virtual-CPU test mesh) the same kernels run with
+``interpret=True``, so tests validate the exact kernel code path.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _on_tpu() -> bool:
+    """True when Mosaic-compiled kernels can actually run.
+
+    The MCA param ``device_tpu_platform`` (the same knob the device module
+    honors, parsec_tpu/devices/__init__.py) pins this for tests: the
+    virtual-CPU mesh sets it to "cpu", where only interpret mode exists.
+    """
+    from ..utils.params import params
+    plat = params.get_or("device_tpu_platform", "string", "")
+    if plat:
+        return plat == "tpu"
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def _interpret() -> bool:
+    return not _on_tpu()
+
+
+def use_pallas() -> bool:
+    """Policy knob: MCA param ``device_tpu_use_pallas`` (default: on-TPU)."""
+    from ..utils.params import params
+    v = params.get_or("device_tpu_use_pallas", "string", "")
+    if v:
+        return str(v).strip().lower() in ("1", "true", "yes", "on")
+    return _on_tpu()
+
+
+# ---------------------------------------------------------------------------
+# Flash attention
+# ---------------------------------------------------------------------------
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+                      *, causal: bool, scale: float, block_q: int,
+                      block_k: int, num_k: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # causal: skip blocks entirely above the diagonal
+    needed = (ki * block_k <= (qi + 1) * block_q - 1) if causal else True
+
+    @pl.when(needed)
+    def _body():
+        # inputs stay in their native dtype (bf16 rides the MXU natively);
+        # only the accumulation is f32
+        s = jax.lax.dot_general(
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale      # [bq, bk]
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        m_prev = m_scr[:, :1]                                 # [bq, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)            # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                                # [bq, bk]
+        corr = jnp.exp(m_prev - m_new)                        # [bq, 1]
+        l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == num_k - 1)
+    def _fin():
+        l = l_scr[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+def _flash_fwd(q3: Any, k3: Any, v3: Any, causal: bool, scale: float,
+               block_q: int, block_k: int) -> Any:
+    BH, T, D = q3.shape
+    Tk = k3.shape[1]
+    num_q = pl.cdiv(T, block_q)
+    num_k = pl.cdiv(Tk, block_k)
+    kernel = functools.partial(
+        _flash_fwd_kernel, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k, num_k=num_k)
+    grid = (BH, num_q, num_k)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, T, D), q3.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+        interpret=_interpret(),
+    )(q3, k3, v3)
+
+
+def _pick_block(t: int, pref: int) -> int:
+    b = min(pref, t)
+    while t % b:
+        b //= 2
+    return max(b, 1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q3, k3, v3, causal, scale, block_q, block_k):
+    return _flash_fwd(q3, k3, v3, causal, scale, block_q, block_k)
+
+
+def _flash_vjp_fwd(q3, k3, v3, causal, scale, block_q, block_k):
+    o = _flash_fwd(q3, k3, v3, causal, scale, block_q, block_k)
+    return o, (q3, k3, v3)
+
+
+def _flash_vjp_bwd(causal, scale, block_q, block_k, res, g):
+    q3, k3, v3 = res
+    # blockwise recompute in three scans over k blocks (stats, dv+delta,
+    # dq/dk); no per-block tensor is ever stacked, so memory is O(T*block_k)
+    BH, T, D = q3.shape
+    Tk = k3.shape[1]
+    bk = _pick_block(Tk, block_k)
+    nk = Tk // bk
+    qf = q3.astype(jnp.float32)
+    kf = k3.reshape(BH, nk, bk, D).astype(jnp.float32)
+    vf = v3.reshape(BH, nk, bk, D).astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    qpos = jnp.arange(T)
+
+    def stats_step(carry, blk):
+        m, l = carry
+        kb, j = blk
+        s = jnp.einsum("bqd,bkd->bqk", qf, kb,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            kpos = j * bk + jnp.arange(bk)
+            s = jnp.where(qpos[:, None] >= kpos[None, :], s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(-1))
+        l = l * jnp.exp(m - m_new) + jnp.exp(s - m_new[..., None]).sum(-1)
+        return (m_new, l), None
+
+    (m, l), _ = jax.lax.scan(
+        stats_step,
+        (jnp.full((BH, T), _NEG_INF, jnp.float32),
+         jnp.zeros((BH, T), jnp.float32)),
+        (kf.transpose(1, 0, 2, 3), jnp.arange(nk)))
+    l = jnp.where(l == 0.0, 1.0, l)
+
+    def _block_p_dp(kb, vb, j):
+        """Recompute this k block's normalized probs and dP (never stacked
+        across blocks — memory stays O(T*bk))."""
+        s = jnp.einsum("bqd,bkd->bqk", qf, kb,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            kpos = j * bk + jnp.arange(bk)
+            s = jnp.where(qpos[:, None] >= kpos[None, :], s, _NEG_INF)
+        p = jnp.exp(s - m[..., None]) / l[..., None]          # [B,T,bk]
+        dp = jnp.einsum("bqd,bkd->bqk", gf, vb,
+                        preferred_element_type=jnp.float32)
+        return p, dp
+
+    # pass 2: dv per block (legitimately O(Tk) — it IS the gradient) and
+    # delta = rowsum(dO * O), accumulated blockwise
+    def delta_step(delta_acc, blk):
+        kb, vb, j = blk
+        p, dp = _block_p_dp(kb, vb, j)
+        dv = jnp.einsum("bqk,bqd->bkd", p, gf,
+                        preferred_element_type=jnp.float32)
+        return delta_acc + jnp.einsum("bqk,bqk->bq", p, dp), dv
+
+    kfT = kf.transpose(1, 0, 2, 3)
+    vfT = vf.transpose(1, 0, 2, 3)
+    delta, dvs = jax.lax.scan(
+        delta_step, jnp.zeros((BH, T), jnp.float32),
+        (kfT, vfT, jnp.arange(nk)))
+
+    # pass 3: recompute p/dp per block for dq/dk
+    def dq_step(dq, blk):
+        kb, vb, j = blk
+        p, dp = _block_p_dp(kb, vb, j)
+        ds = p * (dp - delta[..., None]) * scale
+        dq = dq + jnp.einsum("bqk,bkd->bqd", ds, kb,
+                             preferred_element_type=jnp.float32)
+        return dq, jnp.einsum("bqk,bqd->bkd", ds, qf,
+                              preferred_element_type=jnp.float32)
+
+    dq, dks = jax.lax.scan(dq_step, jnp.zeros_like(qf),
+                           (kfT, vfT, jnp.arange(nk)))
+    dk = dks.transpose(1, 0, 2, 3).reshape(BH, Tk, D)
+    dv = dvs.transpose(1, 0, 2, 3).reshape(BH, Tk, D)
+    return dq.astype(q3.dtype), dk.astype(k3.dtype), dv.astype(v3.dtype)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q: Any, k: Any, v: Any, causal: bool = True,
+                    scale: float | None = None, block_q: int = 512,
+                    block_k: int = 512) -> Any:
+    """Pallas flash attention. q,k,v: [B, H, T, Dh] -> [B, H, T, Dh]."""
+    B, H, T, D = q.shape
+    Tk = k.shape[2]
+    if scale is None:
+        scale = D ** -0.5
+    bq = _pick_block(T, block_q)
+    bk = _pick_block(Tk, block_k)
+    q3 = q.reshape(B * H, T, D)
+    k3 = k.reshape(B * H, Tk, D)
+    v3 = v.reshape(B * H, Tk, D)
+    o = _flash(q3, k3, v3, causal, float(scale), bq, bk)
+    return o.reshape(B, H, T, D)
+
+
+# ---------------------------------------------------------------------------
+# Blocked GEMM
+# ---------------------------------------------------------------------------
+
+def _matmul_kernel(a_ref, b_ref, o_ref, acc_scr, *, num_k: int):
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    acc_scr[:] += jax.lax.dot_general(
+        a_ref[:], b_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(ki == num_k - 1)
+    def _fin():
+        o_ref[:] = acc_scr[:].astype(o_ref.dtype)
+
+
+def matmul(a: Any, b: Any, block_m: int = 256, block_n: int = 256,
+           block_k: int = 512) -> Any:
+    """Blocked Pallas GEMM: [M, K] @ [K, N] with f32 VMEM accumulation."""
+    M, K = a.shape
+    K2, N = b.shape
+    assert K == K2
+    bm = _pick_block(M, block_m)
+    bn = _pick_block(N, block_n)
+    bk = _pick_block(K, block_k)
+    num_k = K // bk
+    kernel = functools.partial(_matmul_kernel, num_k=num_k)
+    return pl.pallas_call(
+        kernel,
+        grid=(M // bm, N // bn, num_k),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
+            pl.BlockSpec((bk, bn), lambda i, j, k: (k, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, k: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((M, N), a.dtype),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(a, b)

@@ -290,6 +290,9 @@ class Context:
             grapher.disable()
             plog.inform("DAG written to %s", path)
         self.scheduler.remove(self)
+        # drop the poll gauge registered in __init__: it closes over self
+        # and would keep this finalized context (and its scheduler) alive
+        sde.unregister(PENDING_TASKS)
 
     def __enter__(self) -> "Context":
         return self

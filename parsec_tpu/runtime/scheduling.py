@@ -81,6 +81,7 @@ def schedule_keep_best(es: ExecutionStream, tasks: List[Task], distance: int = 0
     if es.context.keep_highest_priority_task and es.next_task is None:
         best = max(range(len(tasks)), key=lambda i: tasks[i].priority)
         es.next_task = tasks.pop(best)
+        sde.inc(TASKS_ENABLED, 1)  # bypasses schedule()'s count
     schedule(es, tasks, distance)
 
 
