@@ -1,0 +1,11 @@
+"""Tutorial examples, mirroring the reference's examples/Ex00-Ex07 series
+(ref: examples/Ex00_StartStop.c .. Ex07_RAW_CTL.jdf). Each module is a
+runnable script (``python examples/ex02_chain.py``) and exports ``main()``
+so the test suite can execute it (tests/test_examples.py).
+"""
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)

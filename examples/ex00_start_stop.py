@@ -1,0 +1,30 @@
+"""Ex00: runtime start/stop — the minimal lifecycle.
+
+Teaches: parsec_tpu.init() / Context / start / wait / fini
+(ref: examples/Ex00_StartStop.c — parsec_init, parsec_context_start,
+parsec_context_wait, parsec_fini).
+"""
+import os, sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import parsec_tpu
+
+
+def main() -> int:
+    # init builds the context: config params, worker threads, devices,
+    # the scheduler (MCA-selected, default lfq) — ref: parsec/parsec.c:391
+    ctx = parsec_tpu.init(nb_cores=2)
+
+    # start releases the workers; with no taskpool enqueued they idle
+    ctx.start()
+
+    # wait blocks until every enqueued taskpool completed (none here)
+    ctx.wait()
+
+    ctx.fini()
+    print("runtime started and stopped cleanly")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

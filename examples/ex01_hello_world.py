@@ -1,0 +1,49 @@
+"""Ex01: one task.
+
+Teaches: the minimal JDF — an execution space (even of size 1), a task
+placement (affinity), and at least one flow (here READ <- NULL)
+(ref: examples/Ex01_HelloWorld.jdf).
+"""
+import os, sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import parsec_tpu
+from parsec_tpu.collections import LocalArrayCollection
+from parsec_tpu.dsl import ptg
+
+HELLO_JDF = """
+taskdist [ type="collection" ]
+
+HelloWorld(k)
+
+k = 0 .. 0
+
+: taskdist( k )
+
+READ A <- NULL
+
+BODY
+{
+    print("Hello World!")
+}
+END
+"""
+
+
+def main() -> int:
+    ctx = parsec_tpu.init(nb_cores=2)
+    try:
+        taskdist = LocalArrayCollection(np.zeros((1, 1)), 1)
+        tp = ptg.compile_jdf(HELLO_JDF, name="hello").new(taskdist=taskdist)
+        ctx.add_taskpool(tp)
+        ctx.wait()
+        assert tp.completed and tp.nb_local_tasks == 1
+    finally:
+        ctx.fini()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

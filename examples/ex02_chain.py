@@ -1,0 +1,58 @@
+"""Ex02: a chain circulating an engine-created datum.
+
+Teaches: taskpool globals (NB), guarded deps, RW flows, and NEW — the
+engine allocates the datum at the head of the chain and it flows task to
+task without ever touching a user collection
+(ref: examples/Ex02_Chain.jdf; NEW semantics parsec.y "NEW" token).
+"""
+import os, sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import parsec_tpu
+from parsec_tpu.collections import LocalArrayCollection
+from parsec_tpu.dsl import ptg
+
+CHAIN_JDF = """
+taskdist [ type="collection" ]
+NB       [ type="int" ]
+
+Task(k)
+
+k = 0 .. NB
+
+: taskdist( k )
+
+RW  A <- (k == 0) ? NEW : A Task( k-1 )   [ shape=1 dtype=int64 ]
+      -> (k < NB) ? A Task( k+1 )
+
+BODY
+{
+    if k == 0:
+        A[...] = 0
+    else:
+        A[...] += 1
+    print(f"I am element {int(A.ravel()[0])} in the chain")
+}
+END
+"""
+
+
+def main(NB: int = 10) -> int:
+    ctx = parsec_tpu.init(nb_cores=2)
+    try:
+        taskdist = LocalArrayCollection(np.zeros((NB + 1, 1), dtype=np.int64),
+                                        NB + 1)
+        tp = ptg.compile_jdf(CHAIN_JDF, name="chain02").new(
+            taskdist=taskdist, NB=NB)
+        ctx.add_taskpool(tp)
+        ctx.wait()
+        assert tp.completed and tp.nb_local_tasks == NB + 1
+    finally:
+        ctx.fini()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

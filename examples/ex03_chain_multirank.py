@@ -1,0 +1,83 @@
+"""Ex03: the Ex02 chain distributed over ranks.
+
+Teaches: SPMD execution — every rank compiles the same JDF and evaluates
+it locally; task placement comes from the collection's rank_of(), and the
+datum hops between ranks through the remote-dep engine (activation + data
+messages) with no master (ref: examples/Ex03_ChainMPI.jdf; SPMD model
+README.rst:23-27). Ranks here are threads on an in-process fabric; see
+parsec_tpu.comm.SocketFabric for real multi-process runs.
+"""
+import os, sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import threading
+
+import numpy as np
+
+import parsec_tpu
+from parsec_tpu.collections import LocalArrayCollection
+from parsec_tpu.comm import LocalFabric, RemoteDepEngine
+from parsec_tpu.dsl import ptg
+
+CHAIN_JDF = """
+taskdist [ type="collection" ]
+NB       [ type="int" ]
+
+Task(k)
+
+k = 0 .. NB
+
+: taskdist( k )
+
+RW  A <- (k == 0) ? NEW : A Task( k-1 )   [ shape=1 dtype=int64 ]
+      -> (k < NB) ? A Task( k+1 )
+
+BODY
+{
+    if k == 0:
+        A[...] = 0
+    else:
+        A[...] += 1
+    print(f"I am element {int(A.ravel()[0])} in the chain on rank {es_rank}")
+}
+END
+"""
+
+
+def run_rank(rank: int, fabric: LocalFabric, nb_ranks: int, NB: int,
+             out: list) -> None:
+    eng = RemoteDepEngine(fabric.engine(rank))
+    ctx = parsec_tpu.Context(nb_cores=1, comm=eng, enable_tpu=False)
+    try:
+        # round-robin placement: task k runs on rank k % nb_ranks
+        taskdist = LocalArrayCollection(
+            np.zeros((NB + 1, 1), dtype=np.int64), NB + 1,
+            nodes=nb_ranks, rank=rank)
+        tp = ptg.compile_jdf(CHAIN_JDF, name="chain03").new(
+            taskdist=taskdist, NB=NB, rank=rank, nb_ranks=nb_ranks)
+        ctx.add_taskpool(tp)
+        ctx.wait()
+        out[rank] = tp.nb_local_tasks
+    finally:
+        ctx.fini()
+
+
+def main(NB: int = 10, nb_ranks: int = 4) -> int:
+    fabric = LocalFabric(nb_ranks)
+    out = [0] * nb_ranks
+    threads = [threading.Thread(target=run_rank,
+                                args=(r, fabric, nb_ranks, NB, out))
+               for r in range(nb_ranks)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+        assert not t.is_alive(), "rank hung"
+    assert sum(out) == NB + 1, out
+    print(f"chain of {NB + 1} tasks over {nb_ranks} ranks: "
+          f"{out} tasks/rank, {fabric.msg_count} messages on the wire")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

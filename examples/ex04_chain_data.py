@@ -1,0 +1,65 @@
+"""Ex04: a chain reading and writing user data in place.
+
+Teaches: data_of() — the first task pulls its input from the collection
+(memory), the chain mutates it, and the last task writes it back
+(ref: examples/Ex04_ChainData.jdf:18-45, the SURVEY.md worked example).
+"""
+import os, sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import parsec_tpu
+from parsec_tpu.collections import DictCollection
+from parsec_tpu.dsl import ptg
+
+CHAIN_JDF = """
+mydata  [ type="collection" ]
+NB      [ type="int" ]
+
+Task(k)
+
+k = 0 .. NB
+
+: mydata( k )
+
+RW  A <- (k == 0)  ? mydata( k ) : A Task( k-1 )
+      -> (k == NB) ? mydata( k ) : A Task( k+1 )
+
+BODY
+{
+    A[...] += 1
+    print(f"I am element {int(A.ravel()[0])} in the chain")
+}
+END
+"""
+
+
+def main(NB: int = 10) -> int:
+    # one memory cell walked by the whole chain: every index maps to datum 0
+    class Single(DictCollection):
+        def data_of(self, *idx):
+            return DictCollection.data_of(self, 0)
+
+        def rank_of(self, *idx):
+            return 0
+
+    cell = np.array([300], dtype=np.int64)
+    mydata = Single()
+    mydata.add(0, 0, cell)
+
+    ctx = parsec_tpu.init(nb_cores=2)
+    try:
+        tp = ptg.compile_jdf(CHAIN_JDF, name="chain04").new(
+            mydata=mydata, NB=NB)
+        ctx.add_taskpool(tp)
+        ctx.wait()
+    finally:
+        ctx.fini()
+    assert cell[0] == 300 + NB + 1, cell
+    print(f"final value written back to memory: {cell[0]}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

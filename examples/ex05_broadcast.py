@@ -1,0 +1,69 @@
+"""Ex05: broadcast — one producer, a range of consumers.
+
+Teaches: range fan-out in an output dep (``-> A TaskRecv( 0 .. NB )``):
+one task's output becomes the input of many tasks in a single dep line.
+Across ranks this is what triggers the dynamic bcast topologies
+(star/chain/binomial, ref: examples/Ex05_Broadcast.jdf;
+parsec/remote_dep.c:272-358).
+"""
+import os, sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import parsec_tpu
+from parsec_tpu.collections import LocalArrayCollection
+from parsec_tpu.dsl import ptg
+
+BCAST_JDF = """
+mydata [ type="collection" ]
+NB     [ type="int" ]
+
+TaskSend(k)
+
+k = 0 .. 0
+
+: mydata( 0 )
+
+RW  A <- mydata( 0 )
+      -> A TaskRecv( 0 .. NB )
+
+BODY
+{
+    A[...] = 42
+    print("send 42")
+}
+END
+
+TaskRecv(k)
+
+k = 0 .. NB
+
+: mydata( k )
+
+READ A <- A TaskSend( 0 )
+
+BODY
+{
+    print(f"recv {int(A.ravel()[0])} at {k}")
+}
+END
+"""
+
+
+def main(NB: int = 7) -> int:
+    ctx = parsec_tpu.init(nb_cores=2)
+    try:
+        mydata = LocalArrayCollection(np.zeros((NB + 1, 1), dtype=np.int64),
+                                      NB + 1)
+        tp = ptg.compile_jdf(BCAST_JDF, name="bcast").new(mydata=mydata, NB=NB)
+        ctx.add_taskpool(tp)
+        ctx.wait()
+        assert tp.nb_local_tasks == NB + 2
+    finally:
+        ctx.fini()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,91 @@
+"""Ex06: read-after-write hazard, visible under dataflow alone.
+
+Teaches: one producer (TaskBcast) feeding both a reader fan-out (TaskRecv
+over a stepped range ``0 .. NB .. 2``) and a writer (TaskUpdate). All
+consumers share the producer's copy, and nothing orders readers vs the
+writer — on shared memory a reader scheduled after the update observes
+the updated value. That *is* the demonstrated hazard; Ex07 adds a CTL
+flow to force readers-before-writer (ref: examples/Ex06_RAW.jdf; derived
+locals ``loc = k + n``).
+"""
+import os, sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import parsec_tpu
+from parsec_tpu.collections import LocalArrayCollection
+from parsec_tpu.dsl import ptg
+
+RAW_JDF = """
+mydata [ type="collection" ]
+NB     [ type="int" ]
+
+TaskBcast(k)
+
+k = 0 .. 0
+
+: mydata( k )
+
+RW  A <- mydata( k )
+      -> A TaskUpdate( k )
+      -> A TaskRecv( k, 0 .. NB .. 2 )
+
+BODY
+{
+    A[...] = k + 1
+    print(f"send {k + 1}")
+}
+END
+
+TaskRecv(k, n)
+
+k = 0 .. 0
+n = 0 .. NB .. 2
+loc = k + n
+
+: mydata( loc )
+
+READ A <- A TaskBcast( k )
+
+BODY
+{
+    print(f"recv {int(A.ravel()[0])} at loc {loc}")
+}
+END
+
+TaskUpdate(k)
+
+k = 0 .. 0
+
+: mydata( k )
+
+RW  A <- A TaskBcast( k )
+      -> mydata( k )
+
+BODY
+{
+    A[...] += 100
+    print(f"update -> {int(A.ravel()[0])}")
+}
+END
+"""
+
+
+def main(NB: int = 6) -> int:
+    ctx = parsec_tpu.init(nb_cores=2)
+    try:
+        mydata = LocalArrayCollection(np.zeros((NB + 1, 1), dtype=np.int64),
+                                      NB + 1)
+        tp = ptg.compile_jdf(RAW_JDF, name="raw").new(mydata=mydata, NB=NB)
+        ctx.add_taskpool(tp)
+        ctx.wait()
+        # writeback: mydata(0) holds the updated value
+        assert mydata.array[0, 0] == 101, mydata.array[:, 0]
+    finally:
+        ctx.fini()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

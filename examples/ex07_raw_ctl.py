@@ -1,0 +1,99 @@
+"""Ex07: CTL flows — ordering without data.
+
+Teaches: a CTL (control) flow carries no payload, only an ordering edge:
+every TaskRecv signals TaskUpdate's ctl input, so the update cannot start
+until all readers finished — the RAW hazard of Ex06 is now an enforced
+readers-then-writer schedule (ref: examples/Ex07_RAW_CTL.jdf; CTL
+semantics parsec.y control-flow rules).
+"""
+import os, sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import parsec_tpu
+from parsec_tpu.collections import LocalArrayCollection
+from parsec_tpu.dsl import ptg
+
+RAW_CTL_JDF = """
+mydata [ type="collection" ]
+NB     [ type="int" ]
+
+TaskBcast(k)
+
+k = 0 .. 0
+
+: mydata( k )
+
+RW  A <- mydata( k )
+      -> A TaskUpdate( k )
+      -> A TaskRecv( k, 0 .. NB .. 2 )
+
+BODY
+{
+    A[...] = k + 1
+}
+END
+
+TaskRecv(k, n)
+
+k = 0 .. 0
+n = 0 .. NB .. 2
+loc = k + n
+
+: mydata( loc )
+
+READ A <- A TaskBcast( k )
+
+CTL ctl -> ctl TaskUpdate( k )
+
+BODY
+{
+    order.append(("recv", loc))
+}
+END
+
+TaskUpdate(k)
+
+k = 0 .. 0
+
+: mydata( k )
+
+RW  A <- A TaskBcast( k )
+      -> mydata( k )
+
+CTL ctl <- ctl TaskRecv( k, 0 .. NB .. 2 )
+
+BODY
+{
+    A[...] += 100
+    order.append(("update", k))
+}
+END
+"""
+
+
+def main(NB: int = 6) -> int:
+    order = []
+    ctx = parsec_tpu.init(nb_cores=2)
+    try:
+        mydata = LocalArrayCollection(np.zeros((NB + 1, 1), dtype=np.int64),
+                                      NB + 1)
+        factory = ptg.compile_jdf(RAW_CTL_JDF, name="rawctl")
+        tp = factory.new(mydata=mydata, NB=NB)
+        # taskpool globals are visible in BODY scope: share the order log
+        tp.global_env["order"] = order
+        ctx.add_taskpool(tp)
+        ctx.wait()
+    finally:
+        ctx.fini()
+    # the CTL edge guarantees every recv precedes the update
+    upd = order.index(("update", 0))
+    recvs = [i for i, e in enumerate(order) if e[0] == "recv"]
+    assert len(recvs) == NB // 2 + 1 and all(i < upd for i in recvs), order
+    print(f"order: {order} — all recvs before update, as forced by CTL")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -117,13 +117,18 @@ class PTGTaskClass(TaskClass):
     # dependency analysis per instance                                   #
     # ------------------------------------------------------------------ #
     def input_goal(self, env: Dict[str, Any]) -> int:
-        """#input deps that resolve to task sources (activation count)."""
+        """#input deps that resolve to task sources (activation count).
+
+        A ranged input target (CTL gather, ``ctl <- ctl R( 0 .. N )``)
+        produces one activation per expanded predecessor instance, so the
+        goal must count the expansion, not the dep line (ref: generated
+        dependency counters cover each control-gather edge, jdf2c.c)."""
         goal = 0
         for f in self.ast.flows:
             for d in f.deps_in():
                 t = d.resolve(env)
                 if t is not None and t.kind == "task":
-                    goal += 1
+                    goal += sum(1 for _ in _expand_args(t.args, env))
         return goal
 
     def is_startup(self, env: Dict[str, Any]) -> bool:
@@ -245,7 +250,7 @@ class PTGTaskClass(TaskClass):
                 if t.kind == "memory":
                     continue  # handled in prepare_output (writeback)
                 succ_tc = self.tp.class_by_name(t.task_class)
-                for succ_locals in _expand_args(t.args, env, succ_tc):
+                for succ_locals in _expand_args(t.args, env):
                     cb(succ_tc, succ_locals, t.flow, copy, i)
 
     def _release_deps(self, es, task: Task, action_mask: int) -> List[Task]:
@@ -405,8 +410,7 @@ class PTGTaskClass(TaskClass):
         return fn
 
 
-def _expand_args(args: List[Any], env: Dict[str, Any],
-                 succ_tc: PTGTaskClass) -> Iterator[Tuple]:
+def _expand_args(args: List[Any], env: Dict[str, Any]) -> Iterator[Tuple]:
     """Expand Expr/RangeExpr argument lists into concrete locals tuples
     (a range arg == broadcast edge, ref Ex05 ``TaskRecv(k, 0 .. NB .. 2)``)."""
     dims: List[List[int]] = []

@@ -280,7 +280,33 @@ def _matmul_kernel(a_ref, b_ref, o_ref, acc_scr, *, num_k: int):
 
 def matmul(a: Any, b: Any, block_m: int = 256, block_n: int = 256,
            block_k: int = 512) -> Any:
-    """Blocked Pallas GEMM: [M, K] @ [K, N] with f32 VMEM accumulation."""
+    """Blocked Pallas GEMM: [M, K] @ [K, N] with f32 VMEM accumulation.
+    Differentiable: the VJP runs the same kernel on the transposes
+    (dA = g @ B^T, dB = A^T @ g)."""
+    return _matmul_vjp(a, b, block_m, block_n, block_k)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _matmul_vjp(a, b, block_m, block_n, block_k):
+    return _matmul_impl(a, b, block_m, block_n, block_k)
+
+
+def _matmul_vjp_fwd(a, b, block_m, block_n, block_k):
+    return _matmul_impl(a, b, block_m, block_n, block_k), (a, b)
+
+
+def _matmul_vjp_bwd(block_m, block_n, block_k, res, g):
+    a, b = res
+    da = _matmul_impl(g, b.T, block_m, block_n, block_k)
+    db = _matmul_impl(a.T, g, block_m, block_n, block_k)
+    return da.astype(a.dtype), db.astype(b.dtype)
+
+
+_matmul_vjp.defvjp(_matmul_vjp_fwd, _matmul_vjp_bwd)
+
+
+def _matmul_impl(a: Any, b: Any, block_m: int, block_n: int,
+                 block_k: int) -> Any:
     M, K = a.shape
     K2, N = b.shape
     assert K == K2

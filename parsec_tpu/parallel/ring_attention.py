@@ -63,15 +63,23 @@ def ring_attention(q: Any, k: Any, v: Any, axis_name: str = "sp",
 
 
 def local_attention(q: Any, k: Any, v: Any, causal: bool = True,
-                    scale: float | None = None) -> Any:
+                    scale: float | None = None,
+                    use_pallas: bool | None = None) -> Any:
     """Plain single-shard attention (used by the Ulysses path after the
     head<->sequence all-to-all, and as the sp=1 reference).
 
     On TPU this dispatches to the Pallas flash kernel (2.7x the XLA
     attention on v5e at T=2048); the jnp path is the reference/fallback.
+    ``use_pallas=False`` forces the jnp path (tests use it as the oracle);
+    None = auto. Auto only fires when both sequence dims are 128-lane
+    aligned (so every block _pick_block derives is a 128-multiple) and
+    Dh is sublane-aligned — conservative bounds Mosaic always accepts.
     """
     B, H, T, Dh = q.shape
-    if T >= 8 and Dh % 8 == 0:
+    Tk = k.shape[2]
+    if use_pallas is None:
+        use_pallas = T % 128 == 0 and Tk % 128 == 0 and Dh % 8 == 0
+    if use_pallas:
         from ..ops import pallas_kernels as _pk
         if _pk is not None and _pk.use_pallas():
             return _pk.flash_attention(q, k, v, causal=causal, scale=scale)
@@ -80,7 +88,9 @@ def local_attention(q: Any, k: Any, v: Any, causal: bool = True,
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
     if causal:
-        mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+        # local-index convention (row i attends to keys 0..i), matching
+        # the Pallas kernel when Tk != T
+        mask = jnp.arange(T)[:, None] >= jnp.arange(Tk)[None, :]
         s = jnp.where(mask[None, None], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bhqk,bhkd->bhqd", p, v,

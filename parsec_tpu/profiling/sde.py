@@ -20,7 +20,7 @@ kinds, matching the reference's owned-vs-callback split:
 from __future__ import annotations
 
 import threading
-from typing import Any, Callable, Dict
+from typing import Any, Callable, Dict, Optional
 
 __all__ = ["SDERegistry", "sde",
            "TASKS_ENABLED", "TASKS_RETIRED", "PENDING_TASKS"]
@@ -47,8 +47,13 @@ class SDERegistry:
         with self._lock:
             self._polls[name] = fn
 
-    def unregister(self, name: str) -> None:
+    def unregister(self, name: str, fn: Optional[Callable[[], Any]] = None) -> None:
+        """Drop a gauge/counter. With ``fn``, only when the registered
+        poll is that exact callable — a later registration under the same
+        name (another live Context) is left untouched."""
         with self._lock:
+            if fn is not None and self._polls.get(name) is not fn:
+                return
             self._polls.pop(name, None)
             self._counters.pop(name, None)
 

@@ -95,8 +95,8 @@ class Context:
             self.scheduler.flow_init(es)
         # SDE gauge: ready-task backlog (ref: per-scheduler PAPI-SDE
         # registration, sched_lfq_module.c:141-151)
-        sde.register_poll(PENDING_TASKS,
-                          lambda: self.scheduler.pending_tasks(self))
+        self._pending_gauge = lambda: self.scheduler.pending_tasks(self)
+        sde.register_poll(PENDING_TASKS, self._pending_gauge)
         plog.debug.verbose(3, "context: %d threads, %d vps, %d devices, sched=%s",
                            self.nb_cores, len(self.vps), len(self.devices), name)
 
@@ -291,8 +291,9 @@ class Context:
             plog.inform("DAG written to %s", path)
         self.scheduler.remove(self)
         # drop the poll gauge registered in __init__: it closes over self
-        # and would keep this finalized context (and its scheduler) alive
-        sde.unregister(PENDING_TASKS)
+        # and would keep this finalized context (and its scheduler) alive.
+        # Identity-guarded so a newer Context's gauge survives our fini.
+        sde.unregister(PENDING_TASKS, self._pending_gauge)
 
     def __enter__(self) -> "Context":
         return self

@@ -1,0 +1,21 @@
+"""Run every tutorial example end to end (the reference builds and runs
+examples/Ex00-Ex07 as part of its test tree; ref: examples/CMakeLists.txt).
+"""
+import importlib
+
+import pytest
+
+
+@pytest.mark.parametrize("mod", [
+    "examples.ex00_start_stop",
+    "examples.ex01_hello_world",
+    "examples.ex02_chain",
+    "examples.ex03_chain_multirank",
+    "examples.ex04_chain_data",
+    "examples.ex05_broadcast",
+    "examples.ex06_raw",
+    "examples.ex07_raw_ctl",
+])
+def test_example_runs(mod):
+    m = importlib.import_module(mod)
+    assert m.main() == 0

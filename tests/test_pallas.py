@@ -23,7 +23,7 @@ def _qkv(B=2, H=2, T=64, D=16, seed=0, dtype=jnp.float32):
 def test_flash_attention_matches_reference(causal):
     q, k, v = _qkv()
     out = pk.flash_attention(q, k, v, causal=causal, block_q=16, block_k=16)
-    ref = local_attention(q, k, v, causal=causal)
+    ref = local_attention(q, k, v, causal=causal, use_pallas=False)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
 
@@ -32,7 +32,7 @@ def test_flash_attention_uneven_blocks():
     # T not a multiple of the preferred block: _pick_block must adapt
     q, k, v = _qkv(T=48)
     out = pk.flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
-    ref = local_attention(q, k, v, causal=True)
+    ref = local_attention(q, k, v, causal=True, use_pallas=False)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
 
@@ -47,7 +47,7 @@ def test_flash_attention_grads(causal):
         return jnp.sum(o * o)
 
     def loss_ref(q, k, v):
-        o = local_attention(q, k, v, causal=causal)
+        o = local_attention(q, k, v, causal=causal, use_pallas=False)
         return jnp.sum(o * o)
 
     gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
@@ -72,4 +72,12 @@ def test_matmul_jit_and_grad():
     b = jnp.asarray(rng.randn(48, 32), dtype=jnp.float32)
     f = jax.jit(lambda a, b: pk.matmul(a, b, 16, 16, 16))
     np.testing.assert_allclose(np.asarray(f(a, b)), np.asarray(a @ b),
+                               rtol=1e-4, atol=1e-4)
+    # gradient: VJP reruns the kernel on transposes (dA = g@B^T, dB = A^T@g)
+    ga, gb = jax.grad(lambda a, b: jnp.sum(pk.matmul(a, b, 16, 16, 16) ** 2),
+                      argnums=(0, 1))(a, b)
+    g = 2.0 * np.asarray(a @ b)
+    np.testing.assert_allclose(np.asarray(ga), g @ np.asarray(b).T,
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gb), np.asarray(a).T @ g,
                                rtol=1e-4, atol=1e-4)
