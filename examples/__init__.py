@@ -3,9 +3,3 @@
 runnable script (``python examples/ex02_chain.py``) and exports ``main()``
 so the test suite can execute it (tests/test_examples.py).
 """
-import os
-import sys
-
-_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-if _ROOT not in sys.path:
-    sys.path.insert(0, _ROOT)

@@ -1,9 +1,15 @@
 """Tile kernels (XLA/Pallas executables for task BODYs) and tile
-algorithms (dpotrf)."""
-from .linalg import (axpy, gemm, gemm_nn, gemm_nt, potrf, scal, syrk_ln,
-                     transpose, trsm_panel)
+algorithms (dpotrf, dgeqrf, dgetrf_nopiv, pdgemm)."""
+from .linalg import (axpy, gemm, gemm_nn, gemm_nn_sub, gemm_nt, geqrt,
+                     getrf_nopiv, potrf, scal, syrk_ln, transpose,
+                     trsm_lower_unit, trsm_panel, trsm_upper_right, tsmqr,
+                     tsqrt, unmqr)
 from . import dpotrf as dpotrf_module
 from .dpotrf import dpotrf, dpotrf_factory, dpotrf_taskpool, make_spd
+from .dgeqrf import dgeqrf, dgeqrf_factory, dgeqrf_taskpool
+from .dgetrf import (dgetrf_factory, dgetrf_nopiv, dgetrf_nopiv_taskpool,
+                     make_diag_dominant)
+from .pdgemm import pdgemm, pdgemm_factory, pdgemm_taskpool
 
 try:  # pallas.tpu is optional at import time (older/partial jax builds)
     from . import pallas_kernels
@@ -12,7 +18,13 @@ except ImportError:  # pragma: no cover
     pallas_kernels = None
     flash_attention = None
 
-__all__ = ["potrf", "trsm_panel", "syrk_ln", "gemm_nt", "gemm_nn", "gemm",
-           "axpy", "scal", "transpose", "dpotrf", "dpotrf_factory",
-           "dpotrf_taskpool", "make_spd", "pallas_kernels",
-           "flash_attention"]
+__all__ = ["potrf", "trsm_panel", "syrk_ln", "gemm_nt", "gemm_nn",
+           "gemm_nn_sub", "gemm", "axpy", "scal", "transpose",
+           "geqrt", "unmqr", "tsqrt", "tsmqr",
+           "getrf_nopiv", "trsm_lower_unit", "trsm_upper_right",
+           "dpotrf", "dpotrf_factory", "dpotrf_taskpool", "make_spd",
+           "dgeqrf", "dgeqrf_factory", "dgeqrf_taskpool",
+           "dgetrf_nopiv", "dgetrf_nopiv_taskpool", "dgetrf_factory",
+           "make_diag_dominant",
+           "pdgemm", "pdgemm_factory", "pdgemm_taskpool",
+           "pallas_kernels", "flash_attention"]

@@ -1,0 +1,160 @@
+"""Tile LU factorization without pivoting (dgetrf_nopiv) as a PTG graph.
+
+The right-looking tile LU with the DPLASMA task classes GETRF / TRSM_L
+(row panel, yields U(k,n)) / TRSM_U (column panel, yields L(m,k)) / GEMM
+(trailing update) — the dataflow of DPLASMA's zgetrf_nopiv.jdf on the
+reference runtime (SURVEY.md §2.6, §7.2-10). No pivoting: intended for
+diagonally-dominant or otherwise LU-stable matrices, as in the reference's
+nopiv variant.
+
+The diagonal-tile kernel is a fully static-shape masked update loop
+(ops.getrf_nopiv) so XLA compiles one executable per tile shape; panel and
+trailing updates are triangular solves and one GEMM per tile — all
+MXU-shaped.
+
+On return descA holds unit-lower L strictly below the diagonal and U on
+and above: A = L U (verify by reconstruction).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..collections.matrix import TiledMatrix
+from ..dsl import ptg
+
+DGETRF_JDF = """
+descA [ type="collection" ]
+MT [ type="int" ]
+NT [ type="int" ]
+KT [ type="int" ]
+
+GETRF(k)
+
+k = 0 .. KT-1
+
+: descA( k, k )
+
+RW A <- (k == 0) ? descA( k, k ) : C GEMM( k-1, k, k )
+     -> descA( k, k )
+     -> T TRSM_L( k, k+1 .. NT-1 )
+     -> T TRSM_U( k, k+1 .. MT-1 )
+
+; (KT - k) * 1000
+
+BODY [type=tpu]
+{
+    A = ops.getrf_nopiv(A)
+}
+END
+
+TRSM_L(k, n)
+
+k = 0 .. KT-1
+n = k+1 .. NT-1
+
+: descA( k, n )
+
+READ T <- A GETRF( k )
+RW   C <- (k == 0) ? descA( k, n ) : C GEMM( k-1, k, n )
+       -> descA( k, n )
+       -> B GEMM( k, k+1 .. MT-1, n )
+
+; (KT - k) * 100
+
+BODY [type=tpu]
+{
+    C = ops.trsm_lower_unit(T, C)
+}
+END
+
+TRSM_U(k, m)
+
+k = 0 .. KT-1
+m = k+1 .. MT-1
+
+: descA( m, k )
+
+READ T <- A GETRF( k )
+RW   C <- (k == 0) ? descA( m, k ) : C GEMM( k-1, m, k )
+       -> descA( m, k )
+       -> A GEMM( k, m, k+1 .. NT-1 )
+
+; (KT - k) * 100
+
+BODY [type=tpu]
+{
+    C = ops.trsm_upper_right(T, C)
+}
+END
+
+GEMM(k, m, n)
+
+k = 0 .. KT-1
+m = k+1 .. MT-1
+n = k+1 .. NT-1
+
+: descA( m, n )
+
+READ A <- C TRSM_U( k, m )
+READ B <- C TRSM_L( k, n )
+RW   C <- (k == 0) ? descA( m, n ) : C GEMM( k-1, m, n )
+       -> ((m == k+1) and (n == k+1)) ? A GETRF( k+1 )
+       -> ((m == k+1) and (n > k+1)) ? C TRSM_L( k+1, n )
+       -> ((m > k+1) and (n == k+1)) ? C TRSM_U( k+1, m )
+       -> ((m > k+1) and (n > k+1)) ? C GEMM( k+1, m, n )
+
+; (KT - k) * 10
+
+BODY [type=tpu]
+{
+    C = ops.gemm_nn_sub(C, A, B)
+}
+END
+"""
+
+_factory = None
+
+
+def dgetrf_factory() -> "ptg.JDFFactory":
+    global _factory
+    if _factory is None:
+        _factory = ptg.compile_jdf(DGETRF_JDF, name="dgetrf_nopiv")
+    return _factory
+
+
+def dgetrf_nopiv_taskpool(A: TiledMatrix, rank: int = 0, nb_ranks: int = 1):
+    from .. import ops as ops_module
+    kt = min(A.mt, A.nt)
+    # every diagonal tile must be square (triangular solves need a square
+    # factor): square full tiles, and a square trailing tile if partial
+    last_rows = A.lm - (kt - 1) * A.mb
+    last_cols = A.ln - (kt - 1) * A.nb
+    if A.mb != A.nb or min(last_rows, A.mb) != min(last_cols, A.nb):
+        raise ValueError(
+            f"dgetrf_nopiv needs square diagonal tiles; got mb={A.mb} "
+            f"nb={A.nb}, trailing diagonal tile "
+            f"{min(last_rows, A.mb)}x{min(last_cols, A.nb)}")
+    tp = dgetrf_factory().new(descA=A, MT=A.mt, NT=A.nt, KT=kt,
+                              rank=rank, nb_ranks=nb_ranks)
+    tp.global_env["ops"] = ops_module
+    return tp
+
+
+def dgetrf_nopiv(context, A: TiledMatrix, rank: int = 0,
+                 nb_ranks: int = 1) -> None:
+    """Factor A = L U in place (no pivoting): unit-lower L strictly below
+    the diagonal, U on and above. Blocking: enqueue + wait."""
+    tp = dgetrf_nopiv_taskpool(A, rank=rank, nb_ranks=nb_ranks)
+    context.add_taskpool(tp)
+    context.wait()
+
+
+def make_diag_dominant(m: int, n: int = None, dtype=np.float32,
+                       seed: int = 0) -> np.ndarray:
+    """A diagonally-dominant matrix — LU-stable without pivoting."""
+    n = m if n is None else n
+    rng = np.random.RandomState(seed)
+    A = rng.rand(m, n).astype(np.float64) - 0.5
+    for i in range(min(m, n)):
+        A[i, i] = np.sum(np.abs(A[i])) + 1.0
+    return A.astype(dtype)

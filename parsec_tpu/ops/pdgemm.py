@@ -1,0 +1,121 @@
+"""Distributed tile GEMM (C = alpha A B + beta C) as a PTG task graph.
+
+The SUMMA pattern as DPLASMA expresses it on the reference runtime:
+owner-placed READ_A/READ_B tasks load each A/B tile at its home rank and
+broadcast it over task edges to the full row/column of GEMM consumers
+(the runtime fans the one output copy out via its bcast topologies,
+parsec/remote_dep.c:272-358); each GEMM(m,n,k) accumulates C(m,n) in
+place at C's home rank, chained over k. Tile body is one MXU matmul.
+"""
+from __future__ import annotations
+
+from ..collections.matrix import TiledMatrix
+from ..dsl import ptg
+
+PDGEMM_JDF = """
+descA [ type="collection" ]
+descB [ type="collection" ]
+descC [ type="collection" ]
+MT [ type="int" ]
+NT [ type="int" ]
+KT [ type="int" ]
+ALPHA [ type="float" default="1.0" ]
+BETA [ type="float" default="1.0" ]
+
+READ_A(m, k)
+
+m = 0 .. MT-1
+k = 0 .. KT-1
+
+: descA( m, k )
+
+READ A <- descA( m, k )
+       -> A GEMM( m, 0 .. NT-1, k )
+
+; (KT - k) * 10
+
+BODY
+{
+    pass
+}
+END
+
+READ_B(k, n)
+
+k = 0 .. KT-1
+n = 0 .. NT-1
+
+: descB( k, n )
+
+READ B <- descB( k, n )
+       -> B GEMM( 0 .. MT-1, n, k )
+
+; (KT - k) * 10
+
+BODY
+{
+    pass
+}
+END
+
+GEMM(m, n, k)
+
+m = 0 .. MT-1
+n = 0 .. NT-1
+k = 0 .. KT-1
+
+: descC( m, n )
+
+READ A <- A READ_A( m, k )
+READ B <- B READ_B( k, n )
+RW   C <- (k == 0) ? descC( m, n ) : C GEMM( m, n, k-1 )
+       -> (k == KT-1) ? descC( m, n ) : C GEMM( m, n, k+1 )
+
+; KT - k
+
+BODY [type=tpu]
+{
+    C = ops.gemm(C, A, B, float(ALPHA), float(BETA) if k == 0 else 1.0)
+}
+END
+"""
+
+_factory = None
+
+
+def pdgemm_factory() -> "ptg.JDFFactory":
+    global _factory
+    if _factory is None:
+        _factory = ptg.compile_jdf(PDGEMM_JDF, name="pdgemm")
+    return _factory
+
+
+def pdgemm_taskpool(A: TiledMatrix, B: TiledMatrix, C: TiledMatrix,
+                    alpha: float = 1.0, beta: float = 1.0,
+                    rank: int = 0, nb_ranks: int = 1):
+    from .. import ops as ops_module
+    if A.nt != B.mt or A.mt != C.mt or B.nt != C.nt:
+        raise ValueError("pdgemm: inner/outer tile grids do not agree "
+                         f"(A {A.mt}x{A.nt}, B {B.mt}x{B.nt}, C {C.mt}x{C.nt})")
+    if A.ln != B.lm or A.lm != C.lm or B.ln != C.ln:
+        raise ValueError("pdgemm: element extents do not agree "
+                         f"(A {A.lm}x{A.ln}, B {B.lm}x{B.ln}, C {C.lm}x{C.ln})")
+    if A.nb != B.mb or A.mb != C.mb or B.nb != C.nb:
+        raise ValueError("pdgemm: tile sizes do not conform "
+                         f"(A {A.mb}x{A.nb}, B {B.mb}x{B.nb}, C {C.mb}x{C.nb})")
+    tp = pdgemm_factory().new(descA=A, descB=B, descC=C,
+                              MT=C.mt, NT=C.nt, KT=A.nt,
+                              ALPHA=float(alpha), BETA=float(beta),
+                              rank=rank, nb_ranks=nb_ranks)
+    tp.global_env["ops"] = ops_module
+    return tp
+
+
+def pdgemm(context, A: TiledMatrix, B: TiledMatrix, C: TiledMatrix,
+           alpha: float = 1.0, beta: float = 1.0,
+           rank: int = 0, nb_ranks: int = 1) -> None:
+    """C <- alpha A B + beta C over tiled collections. Blocking."""
+    tp = pdgemm_taskpool(A, B, C, alpha=alpha, beta=beta,
+                         rank=rank, nb_ranks=nb_ranks)
+    context.add_taskpool(tp)
+    context.wait()
