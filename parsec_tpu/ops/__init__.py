@@ -1,9 +1,9 @@
 """Tile kernels (XLA/Pallas executables for task BODYs) and tile
 algorithms (dpotrf, dgeqrf, dgetrf_nopiv, pdgemm)."""
 from .linalg import (axpy, gemm, gemm_nn, gemm_nn_sub, gemm_nt, geqrt,
-                     getrf_nopiv, potrf, scal, syrk_ln, transpose,
+                     geqrt_r, getrf_nopiv, potrf, scal, syrk_ln, transpose,
                      trsm_lower_unit, trsm_panel, trsm_upper_right, tsmqr,
-                     tsqrt, unmqr)
+                     tsqrt, tsqrt_r, unmqr)
 from . import dpotrf as dpotrf_module
 from .dpotrf import dpotrf, dpotrf_factory, dpotrf_taskpool, make_spd
 from .dgeqrf import dgeqrf, dgeqrf_factory, dgeqrf_taskpool
@@ -20,7 +20,7 @@ except ImportError:  # pragma: no cover
 
 __all__ = ["potrf", "trsm_panel", "syrk_ln", "gemm_nt", "gemm_nn",
            "gemm_nn_sub", "gemm", "axpy", "scal", "transpose",
-           "geqrt", "unmqr", "tsqrt", "tsmqr",
+           "geqrt", "geqrt_r", "unmqr", "tsqrt", "tsqrt_r", "tsmqr",
            "getrf_nopiv", "trsm_lower_unit", "trsm_upper_right",
            "dpotrf", "dpotrf_factory", "dpotrf_taskpool", "make_spd",
            "dgeqrf", "dgeqrf_factory", "dgeqrf_taskpool",

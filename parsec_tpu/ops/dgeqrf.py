@@ -43,7 +43,7 @@ WRITE Q -> Q UNMQR( k, k+1 .. NT-1 )  [shape=NBxNB]
 
 BODY [type=tpu]
 {
-    A, Q = ops.geqrt(A)
+    A, Q = ops.geqrt(A) if k < NT - 1 else ops.geqrt_r(A)
 }
 END
 
@@ -84,7 +84,7 @@ WRITE Q2 -> Q2 TSMQR( k, m, k+1 .. NT-1 )  [shape=(2*NB)x(2*NB)]
 
 BODY [type=tpu]
 {
-    R, A2, Q2 = ops.tsqrt(R, A2)
+    R, A2, Q2 = ops.tsqrt(R, A2) if k < NT - 1 else ops.tsqrt_r(R, A2)
 }
 END
 

@@ -80,6 +80,13 @@ def geqrt(a: Any) -> Any:
 
 
 @jax.jit
+def geqrt_r(a: Any) -> Any:
+    """Last-panel geqrt: no Q consumers exist, so skip forming the
+    orthogonal factor (mode="r") and return a zero placeholder."""
+    return jnp.linalg.qr(a, mode="r"), jnp.zeros_like(a)
+
+
+@jax.jit
 def unmqr(q: Any, c: Any) -> Any:
     """Apply Q^T from geqrt to a tile right of the diagonal: C <- Q^T C."""
     return jnp.dot(q.T, c, preferred_element_type=jnp.float32)
@@ -98,6 +105,16 @@ def tsqrt(r: Any, a: Any) -> Any:
 
 
 @jax.jit
+def tsqrt_r(r: Any, a: Any) -> Any:
+    """Last-panel tsqrt: R-only factorization of [R; A], zero Q2
+    placeholder (no tsmqr consumers on the final panel)."""
+    nb = r.shape[0]
+    rf = jnp.linalg.qr(jnp.concatenate([r, a], axis=0), mode="r")
+    n2 = r.shape[0] + a.shape[0]
+    return rf[:nb, :], jnp.zeros_like(a), jnp.zeros((n2, n2), r.dtype)
+
+
+@jax.jit
 def tsmqr(q2: Any, a1: Any, a2: Any) -> Any:
     """Apply Q2^T from tsqrt to a stacked tile pair: [A1; A2] <- Q2^T [A1; A2]."""
     top = a1.shape[0]
@@ -112,8 +129,10 @@ def getrf_nopiv(a: Any) -> Any:
     unit-lower L below the diagonal, U on and above).
 
     Full-shape masked rank-1 updates inside a fori_loop keep shapes static
-    for XLA (no dynamic slicing); same flop count as the unblocked
-    right-looking LU."""
+    for XLA (no dynamic slicing). Each of the n steps does a full m x n
+    outer-product update (masked lanes compute zeros), ~3x the flops of a
+    true unblocked LU — the price of one cached executable with no
+    dynamic shapes."""
     n = min(a.shape)
     rows = jnp.arange(a.shape[0])
     cols = jnp.arange(a.shape[1])

@@ -30,6 +30,37 @@ except RuntimeError:
     pass
 
 
+def spmd(nb_ranks, fn, timeout=120):
+    """Run fn(rank, fabric) on one thread per rank over an in-process
+    LocalFabric; propagate exceptions (the reference's analog:
+    oversubscribed mpiexec on one node, SURVEY.md §4)."""
+    import threading
+
+    from parsec_tpu.comm import LocalFabric
+
+    fabric = LocalFabric(nb_ranks)
+    errors = [None] * nb_ranks
+    results = [None] * nb_ranks
+
+    def runner(r):
+        try:
+            results[r] = fn(r, fabric)
+        except BaseException as e:  # noqa: BLE001
+            errors[r] = e
+
+    threads = [threading.Thread(target=runner, args=(r,), daemon=True)
+               for r in range(nb_ranks)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+        assert not t.is_alive(), "rank thread hung"
+    for e in errors:
+        if e is not None:
+            raise e
+    return results, fabric
+
+
 @pytest.fixture
 def ctx():
     import parsec_tpu

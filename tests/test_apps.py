@@ -1,0 +1,221 @@
+"""Mini-apps as integration tests (ref: tests/apps/ — stencil, merge_sort,
+haar_tree, generalized_reduction). Single-process apps here; the
+communication apps (rtt/bandwidth/all2all, ref tests/apps/pingpong,
+all2all) live in test_apps_comm.py.
+
+Each app follows the reference's measurement pattern: the stencil prints
+GFLOPS from its flop count (ref: testing_stencil_1D.c:141-199).
+"""
+import time
+
+import numpy as np
+import pytest
+
+import parsec_tpu
+from parsec_tpu import dtd
+from parsec_tpu.collections import VectorTwoDimCyclic
+from parsec_tpu.dsl import ptg
+from parsec_tpu.dsl.dtd import INOUT, INPUT, OUTPUT, VALUE, unpack_args
+
+# --------------------------------------------------------------------- #
+# 1D stencil (ref: tests/apps/stencil/testing_stencil_1D.c)             #
+# --------------------------------------------------------------------- #
+STENCIL_JDF = """
+descU [ type="collection" ]
+NT [ type="int" ]
+NI [ type="int" ]
+W0 [ type="float" default="0.25" ]
+W1 [ type="float" default="0.5" ]
+W2 [ type="float" default="0.25" ]
+
+ST(t, i)
+
+t = 0 .. NT-1
+i = 0 .. NI
+
+: descU( t, 0 )
+
+READ L <- ((i > 0) and (t > 0)) ? GR ST( t-1, i-1 )
+READ R <- ((i > 0) and (t < NT-1)) ? GL ST( t+1, i-1 )
+RW X <- (i == 0) ? descU( t, 0 ) : X ST( t, i-1 )
+     -> (i == NI) ? descU( t, 0 )
+     -> (i < NI) ? X ST( t, i+1 )
+WRITE GL -> ((i < NI) and (t > 0)) ? R ST( t-1, i+1 )  [shape=1x1]
+WRITE GR -> ((i < NI) and (t < NT-1)) ? L ST( t+1, i+1 )  [shape=1x1]
+
+; NI - i
+
+BODY
+{
+    # i == 0 only snapshots the boundary ghosts; i > 0 applies the
+    # 3-point update using the neighbors' iteration i-1 ghosts
+    if i > 0:
+        x = X[:, 0]
+        ghost_l = L[-1, 0] if L is not None else 0.0
+        ghost_r = R[0, 0] if R is not None else 0.0
+        xm = np.concatenate([[ghost_l], x[:-1]])
+        xp = np.concatenate([x[1:], [ghost_r]])
+        X = (W0 * xm + W1 * x + W2 * xp)[:, None]
+    GL = X[:1, :]
+    GR = X[-1:, :]
+}
+END
+"""
+
+
+def _stencil_reference(u0: np.ndarray, ni: int, w=(0.25, 0.5, 0.25)):
+    u = u0.astype(np.float64)
+    for _ in range(ni):
+        um = np.concatenate([[0.0], u[:-1]])
+        up = np.concatenate([u[1:], [0.0]])
+        u = w[0] * um + w[1] * u + w[2] * up
+    return u
+
+
+@pytest.mark.parametrize("nt,mb,ni", [(4, 16, 3), (6, 32, 8), (1, 16, 4)])
+def test_stencil_1d(ctx, nt, mb, ni):
+    rng = np.random.RandomState(1)
+    u0 = rng.rand(nt * mb).astype(np.float32)
+    U = VectorTwoDimCyclic(nt * mb, mb)
+    for t in range(nt):
+        np.copyto(U.tile(t, 0), u0[t * mb:(t + 1) * mb][:, None])
+    tp = ptg.compile_jdf(STENCIL_JDF, name="stencil").new(
+        descU=U, NT=nt, NI=ni)
+    t0 = time.perf_counter()
+    ctx.add_taskpool(tp)
+    ctx.wait()
+    dt = time.perf_counter() - t0
+    assert tp.completed
+    got = np.concatenate([U.tile(t, 0)[:, 0] for t in range(nt)])
+    np.testing.assert_allclose(got, _stencil_reference(u0, ni), atol=1e-5)
+    flops = 5.0 * nt * mb * ni  # 3 mul + 2 add per point per iteration
+    print(f"stencil_1D NT={nt} MB={mb} NI={ni}: "
+          f"{flops / dt / 1e9:.6f} gflops")
+
+
+# --------------------------------------------------------------------- #
+# merge sort (ref: tests/apps/merge_sort)                               #
+# --------------------------------------------------------------------- #
+def test_merge_sort(ctx):
+    """Tile-sort leaves then a DTD merge tree; dynamic task insertion
+    discovers the tree edges from tile access modes."""
+    n_leaves, leaf = 8, 64
+    rng = np.random.RandomState(2)
+    arrays = [rng.rand(leaf).astype(np.float32) for _ in range(n_leaves)]
+    tp = dtd.taskpool_new()
+    ctx.add_taskpool(tp)
+
+    def sort_leaf(es, task):
+        (x,) = unpack_args(task)
+        x.sort(axis=0)
+
+    def merge(es, task):
+        out, a, b = unpack_args(task)
+        m = np.concatenate([a, b], axis=0)
+        m.sort(axis=0)
+        out[:] = m
+
+    level = [tp.tile_of_array(a[:, None]) for a in arrays]
+    for t in level:
+        tp.insert_task(sort_leaf, (t, INOUT))
+    width = leaf
+    while len(level) > 1:
+        width *= 2
+        nxt = []
+        for i in range(0, len(level), 2):
+            out = tp.tile_new((width, 1), dtype=np.float32)
+            tp.insert_task(merge, (out, OUTPUT),
+                           (level[i], INPUT), (level[i + 1], INPUT))
+            nxt.append(out)
+        level = nxt
+    tp.data_flush_all()
+    tp.wait()
+    got = np.asarray(level[0].data.get_copy(0).payload)[:, 0]
+    np.testing.assert_allclose(got, np.sort(np.concatenate(arrays)))
+
+
+# --------------------------------------------------------------------- #
+# generalized reduction (ref: tests/apps/generalized_reduction)         #
+# --------------------------------------------------------------------- #
+@pytest.mark.parametrize("n_tiles", [1, 5, 8])
+def test_generalized_reduction(ctx, n_tiles):
+    """Binary-tree reduction with a user-supplied elementwise op, built by
+    dynamic insertion (non-power-of-two tile counts exercise the odd
+    carry path)."""
+    rng = np.random.RandomState(3)
+    tiles_np = [rng.rand(16, 1).astype(np.float32) for _ in range(n_tiles)]
+    tp = dtd.taskpool_new()
+    ctx.add_taskpool(tp)
+
+    def reduce_pair(es, task):
+        a, b = unpack_args(task)
+        np.maximum(a, b, out=a)
+
+    level = [tp.tile_of_array(t.copy()) for t in tiles_np]
+    while len(level) > 1:
+        nxt = []
+        for i in range(0, len(level) - 1, 2):
+            tp.insert_task(reduce_pair, (level[i], INOUT),
+                           (level[i + 1], INPUT))
+            nxt.append(level[i])
+        if len(level) % 2:
+            nxt.append(level[-1])
+        level = nxt
+    tp.data_flush_all()
+    tp.wait()
+    got = np.asarray(level[0].data.get_copy(0).payload)
+    np.testing.assert_allclose(got, np.maximum.reduce(tiles_np))
+
+
+# --------------------------------------------------------------------- #
+# haar wavelet tree (ref: tests/apps/haar-tree, dynamic DAG)            #
+# --------------------------------------------------------------------- #
+def test_haar_tree(ctx):
+    """Bottom-up Haar transform: each level computes (a+b)/sqrt2 averages
+    (feeding the next level — a dynamically-discovered dependency chain)
+    and (a-b)/sqrt2 details (leaves of the output)."""
+    depth = 4
+    n = 1 << depth
+    rng = np.random.RandomState(4)
+    x = rng.rand(n).astype(np.float64)
+
+    tp = dtd.taskpool_new()
+    ctx.add_taskpool(tp)
+    s = 1.0 / np.sqrt(2.0)
+
+    def haar_step(es, task):
+        avg, det, a, b = unpack_args(task)
+        avg[0, 0] = (a[0, 0] + b[0, 0]) * s
+        det[0, 0] = (a[0, 0] - b[0, 0]) * s
+
+    level = [tp.tile_of_array(np.array([[v]])) for v in x]
+    details = []
+    while len(level) > 1:
+        nxt = []
+        lvl_details = []
+        for i in range(0, len(level), 2):
+            avg = tp.tile_new((1, 1), dtype=np.float64)
+            det = tp.tile_new((1, 1), dtype=np.float64)
+            tp.insert_task(haar_step, (avg, OUTPUT), (det, OUTPUT),
+                           (level[i], INPUT), (level[i + 1], INPUT))
+            nxt.append(avg)
+            lvl_details.append(det)
+        details.append(lvl_details)
+        level = nxt
+    tp.data_flush_all()
+    tp.wait()
+
+    def val(tile):
+        return float(np.asarray(tile.data.get_copy(0).payload)[0, 0])
+
+    # reference Haar analysis
+    ref = x.copy()
+    ref_details = []
+    while len(ref) > 1:
+        a, b = ref[0::2], ref[1::2]
+        ref_details.append((a - b) * s)
+        ref = (a + b) * s
+    np.testing.assert_allclose(val(level[0]), ref[0], atol=1e-12)
+    for lvl, ref_lvl in zip(details, ref_details):
+        np.testing.assert_allclose([val(t) for t in lvl], ref_lvl,
+                                   atol=1e-12)

@@ -1,0 +1,230 @@
+"""Communication mini-apps (ref: tests/apps/pingpong/rtt.jdf,
+bandwidth.jdf, tests/apps/all2all) over the in-process fabric, SPMD one
+thread per rank — the reference's oversubscribed-mpiexec analog
+(SURVEY.md §4). rtt and bandwidth print their measured metric the way the
+reference apps do.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import parsec_tpu
+from conftest import spmd
+from parsec_tpu.comm import RemoteDepEngine
+from parsec_tpu.collections import TwoDimBlockCyclic, TwoDimTabular
+from parsec_tpu.dsl import ptg
+
+
+# --------------------------------------------------------------------- #
+# round-trip time (ref: tests/apps/pingpong/rtt.jdf)                    #
+# --------------------------------------------------------------------- #
+RTT_JDF = """
+descX [ type="collection" ]
+NB [ type="int" ]
+
+PING(k)
+
+k = 0 .. NB-1
+
+: descX( k % 2, 0 )
+
+RW X <- (k == 0) ? descX( 0, 0 ) : X PING( k-1 )
+     -> (k < NB-1) ? X PING( k+1 )
+     -> (k == NB-1) ? descX( (NB-1) % 2, 0 )
+
+BODY
+{
+    X[0, 0] = X[0, 0] + 1.0
+}
+END
+"""
+
+
+def test_rtt():
+    """A tile bounces rank0 <-> rank1 for NB hops; every hop is one
+    activation + data move. Prints the per-roundtrip latency."""
+    nb_ranks, hops, mb = 2, 20, 8
+
+    def rank_fn(rank, fabric):
+        eng = RemoteDepEngine(fabric.engine(rank))
+        ctx = parsec_tpu.Context(nb_cores=1, comm=eng, enable_tpu=False)
+        try:
+            coll = TwoDimBlockCyclic(2 * mb, mb, mb, mb, P=2, Q=1,
+                                     nodes=2, rank=rank, dtype=np.float32)
+            coll.name = "descX"
+            tp = ptg.compile_jdf(RTT_JDF, name="rtt").new(
+                descX=coll, NB=hops, rank=rank, nb_ranks=nb_ranks)
+            t0 = time.perf_counter()
+            ctx.add_taskpool(tp)
+            ctx.wait()
+            dt = time.perf_counter() - t0
+            if rank == (hops - 1) % 2 and coll.rank_of((hops - 1) % 2, 0) == rank:
+                val = float(coll.tile((hops - 1) % 2, 0)[0, 0])
+                print(f"rtt: {hops} hops in {dt:.4f}s = "
+                      f"{dt / (hops / 2) * 1e6:.1f} us/roundtrip")
+                return val
+        finally:
+            ctx.fini()
+
+    results, fabric = spmd(nb_ranks, rank_fn)
+    vals = [v for v in results if v is not None]
+    assert vals == [float(hops)]
+    assert fabric.msg_count >= hops - 1
+
+
+# --------------------------------------------------------------------- #
+# bandwidth (ref: tests/apps/pingpong/bandwidth.jdf)                    #
+# --------------------------------------------------------------------- #
+BW_JDF = """
+descS [ type="collection" ]
+descD [ type="collection" ]
+NT [ type="int" ]
+
+SRC(t)
+
+t = 0 .. NT-1
+
+: descS( 0, t )
+
+READ X <- descS( 0, t )
+       -> Y SNK( t )
+
+BODY
+{
+    pass
+}
+END
+
+SNK(t)
+
+t = 0 .. NT-1
+
+: descD( 0, t )
+
+RW Y <- X SRC( t )
+     -> descD( 0, t )
+
+BODY
+{
+    pass
+}
+END
+"""
+
+
+def test_bandwidth():
+    """NT tiles stream rank0 -> rank1 concurrently; prints MB/s."""
+    nt, mb = 8, 256  # 8 tiles x 256 KiB
+
+    def rank_fn(rank, fabric):
+        eng = RemoteDepEngine(fabric.engine(rank))
+        ctx = parsec_tpu.Context(nb_cores=2, comm=eng, enable_tpu=False)
+        try:
+            # descS: one tile row, all on rank 0; descD: all on rank 1
+            S = TwoDimBlockCyclic(mb, nt * mb, mb, mb, P=1, Q=1, nodes=2,
+                                  rank=rank, dtype=np.float32)
+            D = TwoDimTabular(mb, nt * mb, mb, mb,
+                              np.ones((1, nt), dtype=int),
+                              nodes=2, rank=rank, dtype=np.float32)
+            S.name, D.name = "descS", "descD"
+            if rank == 0:
+                for t in range(nt):
+                    S.tile(0, t)[:] = float(t + 1)
+            tp = ptg.compile_jdf(BW_JDF, name="bw").new(
+                descS=S, descD=D, NT=nt, rank=rank, nb_ranks=2)
+            t0 = time.perf_counter()
+            ctx.add_taskpool(tp)
+            ctx.wait()
+            dt = time.perf_counter() - t0
+            if rank == 1:
+                got = [float(D.tile(0, t)[0, 0]) for t in range(nt)]
+                nbytes = nt * mb * mb * 4
+                print(f"bandwidth: {nbytes / 1e6:.1f} MB in {dt:.4f}s = "
+                      f"{nbytes / dt / 1e6:.0f} MB/s")
+                return got
+        finally:
+            ctx.fini()
+
+    results, _ = spmd(2, rank_fn)
+    assert results[1] == [float(t + 1) for t in range(nt)]
+
+
+# --------------------------------------------------------------------- #
+# all-to-all (ref: tests/apps/all2all)                                  #
+# --------------------------------------------------------------------- #
+A2A_JDF = """
+descS [ type="collection" ]
+descD [ type="collection" ]
+NR [ type="int" ]
+
+SND(s, d)
+
+s = 0 .. NR-1
+d = 0 .. NR-1
+
+: descS( s, d )
+
+READ X <- descS( s, d )
+       -> Y RCV( s, d )
+
+BODY
+{
+    pass
+}
+END
+
+RCV(s, d)
+
+s = 0 .. NR-1
+d = 0 .. NR-1
+
+: descD( d, s )
+
+RW Y <- X SND( s, d )
+     -> descD( d, s )
+
+BODY
+{
+    pass
+}
+END
+"""
+
+
+@pytest.mark.parametrize("nb_ranks", [2, 4])
+def test_all2all(nb_ranks):
+    """Every rank sends a distinct tile to every rank (incl. itself);
+    rank d ends with column s holding s's payload — NR*(NR-1) remote
+    edges active at once."""
+    mb = 4
+
+    def rank_fn(rank, fabric):
+        eng = RemoteDepEngine(fabric.engine(rank))
+        ctx = parsec_tpu.Context(nb_cores=2, comm=eng, enable_tpu=False)
+        try:
+            S = TwoDimBlockCyclic(nb_ranks * mb, nb_ranks * mb, mb, mb,
+                                  P=nb_ranks, Q=1, nodes=nb_ranks,
+                                  rank=rank, dtype=np.float32)
+            D = TwoDimBlockCyclic(nb_ranks * mb, nb_ranks * mb, mb, mb,
+                                  P=nb_ranks, Q=1, nodes=nb_ranks,
+                                  rank=rank, dtype=np.float32)
+            S.name, D.name = "descS", "descD"
+            for d in range(nb_ranks):
+                if S.rank_of(rank, d) == rank:
+                    S.tile(rank, d)[:] = rank * 100.0 + d
+            tp = ptg.compile_jdf(A2A_JDF, name="a2a").new(
+                descS=S, descD=D, NR=nb_ranks, rank=rank,
+                nb_ranks=nb_ranks)
+            ctx.add_taskpool(tp)
+            ctx.wait()
+            return {s: float(D.tile(rank, s)[0, 0])
+                    for s in range(nb_ranks)}
+        finally:
+            ctx.fini()
+
+    results, fabric = spmd(nb_ranks, rank_fn)
+    for d in range(nb_ranks):
+        assert results[d] == {s: s * 100.0 + d for s in range(nb_ranks)}
+    # every off-diagonal (s != d) edge crossed the fabric
+    assert fabric.msg_count >= nb_ranks * (nb_ranks - 1)

@@ -131,9 +131,8 @@ def test_pdgemm_multirank_distributed():
     """SUMMA across 4 ranks over the in-process fabric: each rank owns only
     its block-cyclic tiles; A/B tiles reach consumers via READ_A/READ_B
     broadcast task edges (no cross-rank memory reads)."""
-    import threading
-
-    from parsec_tpu.comm import LocalFabric, RemoteDepEngine
+    from conftest import spmd
+    from parsec_tpu.comm import RemoteDepEngine
     from parsec_tpu.ops import pdgemm_factory
     from parsec_tpu import ops as ops_module
 
@@ -144,57 +143,41 @@ def test_pdgemm_multirank_distributed():
     Bm = (rng.rand(k, n) - 0.5).astype(np.float32)
     Cm = (rng.rand(m, n) - 0.5).astype(np.float32)
 
-    fabric = LocalFabric(nb_ranks)
-    out = [None] * nb_ranks
-    errors = [None] * nb_ranks
-
-    def rank_fn(rank):
+    def rank_fn(rank, fabric):
         import parsec_tpu
+        eng = RemoteDepEngine(fabric.engine(rank))
+        c = parsec_tpu.Context(nb_cores=1, comm=eng, enable_tpu=False)
         try:
-            eng = RemoteDepEngine(fabric.engine(rank))
-            c = parsec_tpu.Context(nb_cores=1, comm=eng, enable_tpu=False)
-            try:
-                def dist(lm, ln, M):
-                    d = TwoDimBlockCyclic(lm, ln, nb, nb, P=P, Q=Q,
-                                          nodes=nb_ranks, rank=rank,
-                                          dtype=np.float32)
-                    # populate only locally-owned tiles (true distribution)
-                    for i in range(d.mt):
-                        for j in range(d.nt):
-                            if d.rank_of(i, j) == rank:
-                                np.copyto(
-                                    d.tile(i, j),
-                                    M[i * nb:(i + 1) * nb, j * nb:(j + 1) * nb])
-                    return d
-                A, B, C = dist(m, k, Am), dist(k, n, Bm), dist(m, n, Cm)
-                A.name, B.name, C.name = "descA", "descB", "descC"
-                tp = pdgemm_factory().new(
-                    descA=A, descB=B, descC=C, MT=C.mt, NT=C.nt, KT=A.nt,
-                    ALPHA=1.0, BETA=1.0, rank=rank, nb_ranks=nb_ranks)
-                tp.global_env["ops"] = ops_module
-                c.add_taskpool(tp)
-                c.wait()
-                local = {}
-                for i in range(C.mt):
-                    for j in range(C.nt):
-                        if C.rank_of(i, j) == rank:
-                            local[(i, j)] = np.array(C.tile(i, j))
-                out[rank] = local
-            finally:
-                c.fini()
-        except BaseException as e:  # noqa: BLE001
-            errors[rank] = e
+            def dist(lm, ln, M):
+                d = TwoDimBlockCyclic(lm, ln, nb, nb, P=P, Q=Q,
+                                      nodes=nb_ranks, rank=rank,
+                                      dtype=np.float32)
+                # populate only locally-owned tiles (true distribution)
+                for i in range(d.mt):
+                    for j in range(d.nt):
+                        if d.rank_of(i, j) == rank:
+                            np.copyto(
+                                d.tile(i, j),
+                                M[i * nb:(i + 1) * nb, j * nb:(j + 1) * nb])
+                return d
+            A, B, C = dist(m, k, Am), dist(k, n, Bm), dist(m, n, Cm)
+            A.name, B.name, C.name = "descA", "descB", "descC"
+            tp = pdgemm_factory().new(
+                descA=A, descB=B, descC=C, MT=C.mt, NT=C.nt, KT=A.nt,
+                ALPHA=1.0, BETA=1.0, rank=rank, nb_ranks=nb_ranks)
+            tp.global_env["ops"] = ops_module
+            c.add_taskpool(tp)
+            c.wait()
+            local = {}
+            for i in range(C.mt):
+                for j in range(C.nt):
+                    if C.rank_of(i, j) == rank:
+                        local[(i, j)] = np.array(C.tile(i, j))
+            return local
+        finally:
+            c.fini()
 
-    threads = [threading.Thread(target=rank_fn, args=(r,), daemon=True)
-               for r in range(nb_ranks)]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join(120)
-        assert not t.is_alive(), "rank thread hung"
-    for e in errors:
-        if e is not None:
-            raise e
+    out, _fabric = spmd(nb_ranks, rank_fn)
     ref = Am.astype(np.float64) @ Bm.astype(np.float64) + Cm
     got = np.zeros((m, n))
     for local in out:
