@@ -1,0 +1,119 @@
+"""Binary trace file format ("ptt" — parsec-tpu trace), the dbp analog.
+
+Reference behavior: per-rank binary profile files with a header, a
+dictionary of event classes, and per-thread event buffers
+(ref: parsec/parsec_binary_profile.h:1-172, dbp readers in
+tools/profiling/dbpreader.c). The offline toolchain converts these to
+pandas/HDF5 (tools/profiling/python/pbt2ptt.pyx, profile2h5.py).
+
+Layout (little-endian):
+
+    magic   b"PTTB1\\n"
+    u32     header JSON length, then header JSON
+            {"rank": int, "info": {...}, "version": 1}
+    u32     string-table entry count, then per entry: u16 len + utf8 bytes
+    u32     stream count
+    per stream:
+        u32 tid; u16 name len + utf8; u32 event count
+        per event: i64 ts_rel_ns; u8 phase; u32 key_id;
+                   u32 info JSON length (0 = None) + bytes
+
+Timestamps are stored relative to the profile's t0 so files from
+different ranks merge on a common clock base (the in-process fabric
+shares one monotonic clock; cross-host merge aligns on each file's t0
+like the reference's dbp merge does).
+"""
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any, BinaryIO, Dict, List
+
+MAGIC = b"PTTB1\n"
+
+
+def _w_u32(fh: BinaryIO, v: int) -> None:
+    fh.write(struct.pack("<I", v))
+
+
+def _w_u16(fh: BinaryIO, v: int) -> None:
+    fh.write(struct.pack("<H", v))
+
+
+def _r(fh: BinaryIO, fmt: str):
+    size = struct.calcsize(fmt)
+    buf = fh.read(size)
+    if len(buf) != size:
+        raise EOFError("truncated ptt file")
+    return struct.unpack(fmt, buf)
+
+
+def write_profile(profile, path: str) -> str:
+    """Serialize a profiling.trace.Profile to one binary file."""
+    keys: Dict[str, int] = {}
+    for st in profile._streams.values():
+        for _ts, _ph, key, _info in st.events:
+            if key not in keys:
+                keys[key] = len(keys)
+    with open(path, "wb") as fh:
+        fh.write(MAGIC)
+        header = json.dumps({"rank": profile.rank, "info": profile.info,
+                             "version": 1}).encode()
+        _w_u32(fh, len(header))
+        fh.write(header)
+        _w_u32(fh, len(keys))
+        for key in keys:  # insertion order == id order
+            kb = key.encode()
+            _w_u16(fh, len(kb))
+            fh.write(kb)
+        streams = sorted(profile._streams.items())
+        _w_u32(fh, len(streams))
+        for tid, st in streams:
+            _w_u32(fh, tid)
+            nb = st.name.encode()
+            _w_u16(fh, len(nb))
+            fh.write(nb)
+            _w_u32(fh, len(st.events))
+            for ts, ph, key, info in st.events:
+                ib = b"" if info is None else json.dumps(info).encode()
+                fh.write(struct.pack("<qBI", ts - profile._t0,
+                                     ord(ph[0]), keys[key]))
+                _w_u32(fh, len(ib))
+                fh.write(ib)
+    return path
+
+
+def read_profile(path: str):
+    """Read a .ptt file back into a Profile (timestamps re-based at 0)."""
+    from .trace import Profile
+
+    with open(path, "rb") as fh:
+        if fh.read(len(MAGIC)) != MAGIC:
+            raise ValueError(f"{path}: not a ptt trace (bad magic)")
+        (hlen,) = _r(fh, "<I")
+        header = json.loads(fh.read(hlen).decode())
+        if header.get("version") != 1:
+            raise ValueError(f"{path}: unsupported ptt version "
+                             f"{header.get('version')}")
+        (nkeys,) = _r(fh, "<I")
+        keys: List[str] = []
+        for _ in range(nkeys):
+            (klen,) = _r(fh, "<H")
+            keys.append(fh.read(klen).decode())
+        prof = Profile(rank=header.get("rank", 0), info=header.get("info"))
+        prof._t0 = 0
+        (nstreams,) = _r(fh, "<I")
+        for _ in range(nstreams):
+            (tid,) = _r(fh, "<I")
+            (nlen,) = _r(fh, "<H")
+            name = fh.read(nlen).decode()
+            st = prof.stream(tid, name)
+            (nev,) = _r(fh, "<I")
+            for _ in range(nev):
+                ts, ph, key_id = _r(fh, "<qBI")
+                (ilen,) = _r(fh, "<I")
+                info: Any = None
+                if ilen:
+                    info = json.loads(fh.read(ilen).decode())
+                st.events.append((ts, chr(ph), keys[key_id], info))
+    return prof

@@ -82,5 +82,9 @@ class SDERegistry:
             self._polls.clear()
 
 
-#: process-wide registry (the reference's sde handle is process-global too)
+#: process-wide scratch registry for contextless/user counters. The
+#: runtime's own counters live on each Context's ``ctx.sde`` — per-context
+#: so the in-process SPMD mode (several "ranks" in one process) keeps
+#: per-rank counts, matching the reference where the process-global
+#: registry IS per-rank (one rank per process).
 sde = SDERegistry()

@@ -128,6 +128,14 @@ class Profile:
             json.dump(self.to_chrome_trace(), fh)
         return out
 
+    def dump_binary(self, path: str) -> str:
+        """Write the binary .ptt trace (the reference's per-rank dbp file;
+        read back with profiling.binfmt.read_profile or the tools/ CLIs)."""
+        from .binfmt import write_profile
+        out = path if path.endswith(".ptt") else f"{path}.rank{self.rank}.ptt"
+        os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+        return write_profile(self, out)
+
     def to_dataframe(self):
         """Interval table like the reference's parsec_trace_tables.py."""
         import pandas as pd  # local import; pandas is optional at runtime

@@ -17,7 +17,7 @@ from typing import Any, Dict, List, Optional
 from ..utils import logging as plog
 from ..utils.params import params
 from ..profiling.grapher import grapher
-from ..profiling.sde import PENDING_TASKS, sde
+from ..profiling.sde import PENDING_TASKS, SDERegistry
 from ..profiling.trace import Profile
 from ..profiling.pins import TaskProfilerModule
 from .scheduling import ExecutionStream, context_wait_loop, schedule
@@ -57,9 +57,12 @@ class Context:
         prof_prefix = params.get("profile")
         self.profile: Optional[Profile] = None
         self._prof_prefix = None
+        self._task_profiler = None
         if profile or prof_prefix:
             self.profile = Profile(rank=rank)
-            self._prof_prefix = prof_prefix or "parsec_prof"
+            # files written at fini only when a prefix was configured;
+            # profile=True alone keeps the trace in memory for the caller
+            self._prof_prefix = prof_prefix or None
             self._task_profiler = TaskProfilerModule(self.profile)
             self._task_profiler.enable()
         # executed-DAG capture (ref: --parsec_dot, parsec.c:596-614)
@@ -96,7 +99,10 @@ class Context:
         # SDE gauge: ready-task backlog (ref: per-scheduler PAPI-SDE
         # registration, sched_lfq_module.c:141-151)
         self._pending_gauge = lambda: self.scheduler.pending_tasks(self)
-        sde.register_poll(PENDING_TASKS, self._pending_gauge)
+        # per-context registry: each in-process rank keeps its own counts
+        # (the reference's registry is per-process, which IS per-rank there)
+        self.sde = SDERegistry()
+        self.sde.register_poll(PENDING_TASKS, self._pending_gauge)
         plog.debug.verbose(3, "context: %d threads, %d vps, %d devices, sched=%s",
                            self.nb_cores, len(self.vps), len(self.devices), name)
 
@@ -166,7 +172,22 @@ class Context:
             if tp.taskpool_id in self.taskpools:
                 del self.taskpools[tp.taskpool_id]
                 self._active_taskpools -= 1
+        self.sample_sde_counters()
         self.wake_workers(self.nb_cores)
+
+    def sample_sde_counters(self) -> None:
+        """Snapshot every SDE counter/gauge into the trace as counter
+        events (ref: PAPI-SDE counters feeding the live aggregator,
+        tools/aggregator_visu; sampled at taskpool boundaries and on
+        demand)."""
+        if self.profile is None:
+            return
+        st = self.profile.stream(0)
+        for name, value in self.sde.snapshot().items():
+            try:
+                st.counter(name, float(value))
+            except (TypeError, ValueError):
+                continue
 
     def all_tasks_done(self) -> bool:
         """ref: all_tasks_done (scheduling.c:218-221)."""
@@ -282,18 +303,23 @@ class Context:
             dev.fini()
         if self.comm is not None:
             self.comm.fini()
+        if self._task_profiler is not None:
+            # unhook from the global PINS sites: a later context's events
+            # must not leak into this finalized profile
+            self._task_profiler.disable()
         if self.profile is not None and self._prof_prefix:
+            self.sample_sde_counters()
             path = self.profile.dump(self._prof_prefix)
-            plog.inform("trace written to %s", path)
+            bpath = self.profile.dump_binary(self._prof_prefix)
+            plog.inform("trace written to %s + %s", path, bpath)
         if self._dot_prefix:
             path = grapher.dump(f"{self._dot_prefix}.rank{self.rank}.dot")
             grapher.disable()
             plog.inform("DAG written to %s", path)
         self.scheduler.remove(self)
         # drop the poll gauge registered in __init__: it closes over self
-        # and would keep this finalized context (and its scheduler) alive.
-        # Identity-guarded so a newer Context's gauge survives our fini.
-        sde.unregister(PENDING_TASKS, self._pending_gauge)
+        # and would keep this finalized context (and its scheduler) alive
+        self.sde.unregister(PENDING_TASKS, self._pending_gauge)
 
     def __enter__(self) -> "Context":
         return self

@@ -19,7 +19,7 @@ from ..utils import logging as plog
 from ..utils.params import params
 from ..profiling.grapher import grapher
 from ..profiling.pins import PINS, PinsEvent
-from ..profiling.sde import TASKS_ENABLED, TASKS_RETIRED, sde
+from ..profiling.sde import TASKS_ENABLED, TASKS_RETIRED
 from .taskpool import HookReturn, Task, TaskStatus, ACTION_RELEASE_ALL
 
 _sched_log = plog.sched_stream
@@ -68,7 +68,7 @@ def schedule(es: ExecutionStream, tasks: List[Task], distance: int = 0) -> None:
     PINS(es, PinsEvent.SCHEDULE_BEGIN, tasks)
     ctx.scheduler.schedule(es, tasks, distance)
     PINS(es, PinsEvent.SCHEDULE_END, tasks)
-    sde.inc(TASKS_ENABLED, len(tasks))
+    ctx.sde.inc(TASKS_ENABLED, len(tasks))
     ctx.wake_workers(len(tasks))
 
 
@@ -81,7 +81,7 @@ def schedule_keep_best(es: ExecutionStream, tasks: List[Task], distance: int = 0
     if es.context.keep_highest_priority_task and es.next_task is None:
         best = max(range(len(tasks)), key=lambda i: tasks[i].priority)
         es.next_task = tasks.pop(best)
-        sde.inc(TASKS_ENABLED, 1)  # bypasses schedule()'s count
+        es.context.sde.inc(TASKS_ENABLED, 1)  # bypasses schedule()'s count
     schedule(es, tasks, distance)
 
 
@@ -129,7 +129,7 @@ def complete_execution(es: ExecutionStream, task: Task) -> None:
     else:
         ready = []
     es.nb_tasks_executed += 1
-    sde.inc(TASKS_RETIRED)
+    es.context.sde.inc(TASKS_RETIRED)
     grapher.task_executed(es, task)
     tp = task.taskpool
     if tc.release_task is not None:

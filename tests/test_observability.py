@@ -84,15 +84,33 @@ def test_grapher_dtd_edges(ctx):
 
 
 def test_sde_counters(ctx):
-    before = sde.read(TASKS_RETIRED)
+    """Counters are per-context: each in-process rank counts only its own
+    tasks (the reference's registry is per-process == per-rank)."""
+    before = ctx.sde.read(TASKS_RETIRED)
     tp, A = _chain_tp(6)
     ctx.add_taskpool(tp)
     ctx.wait()
-    assert sde.read(TASKS_RETIRED) >= before + 6
-    snap = sde.snapshot()
+    assert ctx.sde.read(TASKS_RETIRED) >= before + 6
+    snap = ctx.sde.snapshot()
     assert TASKS_RETIRED in snap
     # the scheduler gauge answers (possibly -1 when unsupported)
     assert "PARSEC::SCHEDULER::PENDING_TASKS" in snap
+
+
+def test_sde_counters_isolated_between_contexts():
+    """A second context's work must not inflate the first's counters."""
+    import parsec_tpu
+    c1 = parsec_tpu.Context(nb_cores=1, enable_tpu=False)
+    c2 = parsec_tpu.Context(nb_cores=1, enable_tpu=False)
+    try:
+        tp, _ = _chain_tp(4)
+        c2.add_taskpool(tp)
+        c2.wait()
+        assert c1.sde.read(TASKS_RETIRED) == 0
+        assert c2.sde.read(TASKS_RETIRED) >= 4
+    finally:
+        c1.fini()
+        c2.fini()
 
 
 def test_iterators_checker_clean_dag(ctx):

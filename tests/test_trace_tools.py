@@ -1,0 +1,183 @@
+"""Offline trace toolchain tests (ref: tools/profiling — dbpreader,
+dbp2xml, pbt2ptt/profile2h5, aggregator_visu; trace-validating tests
+mirror tests/profiling/check-async.py / check-comms.py).
+"""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import parsec_tpu
+from parsec_tpu import dtd
+from parsec_tpu.dsl.dtd import INOUT, VALUE, unpack_args
+from parsec_tpu.profiling.binfmt import read_profile, write_profile
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "tools")
+if TOOLS not in sys.path:
+    sys.path.insert(0, TOOLS)
+
+import counter_aggregate  # noqa: E402
+import ptt2h5  # noqa: E402
+import ptt_dump  # noqa: E402
+import trace_merge  # noqa: E402
+
+
+def _traced_run(rank=0):
+    """Run a tiny DTD graph with profiling on; return the live Profile."""
+    ctx = parsec_tpu.Context(nb_cores=2, enable_tpu=False, profile=True)
+    try:
+        tp = dtd.taskpool_new()
+        ctx.add_taskpool(tp)
+        tile = tp.tile_of_array(np.zeros((4, 4), np.float32))
+
+        def bump(es, task):
+            x, a = unpack_args(task)
+            x += a
+
+        for i in range(5):
+            tp.insert_task(bump, (tile, INOUT), (1.0, VALUE))
+        tp.data_flush_all()
+        tp.wait()
+        prof = ctx.profile
+        prof.rank = rank
+        ctx.sample_sde_counters()
+    finally:
+        ctx.fini()
+    return prof
+
+
+@pytest.fixture(scope="module")
+def trace_files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("traces")
+    paths = []
+    for rank in (0, 1):
+        prof = _traced_run(rank)
+        p = str(d / f"t.rank{rank}.ptt")
+        write_profile(prof, p)
+        paths.append((p, prof))
+    return paths
+
+
+def test_binary_roundtrip(trace_files):
+    for path, prof in trace_files:
+        back = read_profile(path)
+        assert back.rank == prof.rank
+        assert back.nb_events() == prof.nb_events()
+        assert sorted(back._streams) == sorted(prof._streams)
+        for tid, st in prof._streams.items():
+            rst = back._streams[tid]
+            # timestamps re-based at t0, everything else identical
+            for (ts, ph, key, info), (rts, rph, rkey, rinfo) in zip(
+                    st.events, rst.events):
+                assert rts == ts - prof._t0
+                assert (rph, rkey, rinfo) == (ph, key, info)
+
+
+def test_bad_magic_rejected(tmp_path):
+    p = tmp_path / "junk.ptt"
+    p.write_bytes(b"NOTATRACE")
+    with pytest.raises(ValueError, match="bad magic"):
+        read_profile(str(p))
+
+
+def test_exec_intervals_present(trace_files):
+    """The task profiler must have produced one exec interval per task
+    (5 bump tasks + flush tasks), with positive durations."""
+    path, _ = trace_files[0]
+    prof = read_profile(path)
+    ivals = []
+    for st in prof._streams.values():
+        ivals += [iv for iv in ptt_dump.intervals_of(st)
+                  if iv[0].startswith("exec:")]
+    assert len(ivals) >= 5
+    assert all(e > b for _, b, e, _ in ivals)
+
+
+def test_ptt_dump_formats(trace_files, capsys):
+    paths = [p for p, _ in trace_files]
+    assert ptt_dump.main(paths) == 0
+    out = capsys.readouterr().out
+    assert "rank 0" in out and "exec:" in out and "n=" in out
+
+    assert ptt_dump.main(["--format", "xml"] + paths[:1]) == 0
+    out = capsys.readouterr().out
+    assert out.startswith('<?xml') and "<stream" in out and "<event" in out
+    import xml.etree.ElementTree as ET
+    root = ET.fromstring(out)  # must be well-formed, incl. quoted JSON info
+    assert root.tag == "profiles" and root.find(".//event") is not None
+
+    assert ptt_dump.main(["--format", "json"] + paths[:1]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc[0]["rank"] == 0 and doc[0]["streams"]
+
+
+def test_ptt2h5_and_load(trace_files, tmp_path, capsys):
+    paths = [p for p, _ in trace_files]
+    out = str(tmp_path / "t.h5")
+    assert ptt2h5.main([out] + paths) == 0
+    df = ptt2h5.load(out)
+    assert set(df.columns) >= {"rank", "tid", "name", "begin_ns", "end_ns",
+                               "duration_ns"}
+    assert sorted(df["rank"].unique()) == [0, 1]
+    assert (df["duration_ns"] > 0).all()
+    assert df["name"].str.startswith("exec:").any()
+
+
+def test_ptt2parquet(trace_files, tmp_path):
+    paths = [p for p, _ in trace_files]
+    out = str(tmp_path / "t.parquet")
+    assert ptt2h5.main(["--format", "parquet", out] + paths) == 0
+    df = ptt2h5.load(out)
+    assert len(df) > 0 and sorted(df["rank"].unique()) == [0, 1]
+
+
+def test_trace_merge(trace_files, tmp_path):
+    paths = [p for p, _ in trace_files]
+    out = str(tmp_path / "merged.json")
+    assert trace_merge.main([out] + paths) == 0
+    doc = json.load(open(out))
+    pids = {ev["pid"] for ev in doc["traceEvents"]}
+    assert pids == {0, 1}
+    names = {ev["name"] for ev in doc["traceEvents"] if ev.get("ph") == "M"}
+    assert "process_name" in names
+
+
+def test_counter_aggregate(trace_files, tmp_path, capsys):
+    paths = [p for p, _ in trace_files]
+    series = counter_aggregate.collect(paths)
+    assert any("TASKS" in k for k in series), series.keys()
+    agg = counter_aggregate.aggregate(series)
+    key = next(k for k in agg if "RETIRED" in k)
+    assert set(agg[key]["ranks"]) == {0, 1}
+    assert agg[key]["fleet"]["n"] >= 2
+    # CLI with timeline + json out
+    out = str(tmp_path / "agg.json")
+    assert counter_aggregate.main(
+        ["--timeline", "4", "--json", out] + paths) == 0
+    doc = json.load(open(out))
+    assert "aggregate" in doc and "timeline" in doc
+    assert capsys.readouterr().out.strip()
+
+
+def test_context_fini_writes_both_formats(tmp_path, monkeypatch):
+    """profile=<prefix> MCA param: fini writes chrome JSON + binary ptt."""
+    parsec_tpu.params.reset()
+    prefix = str(tmp_path / "prof")
+    parsec_tpu.params.set_cmdline("profile", prefix)
+    try:
+        ctx = parsec_tpu.Context(nb_cores=1, enable_tpu=False)
+        tp = dtd.taskpool_new()
+        ctx.add_taskpool(tp)
+        tp.insert_task(lambda es, task: None)
+        tp.wait()
+        ctx.fini()
+    finally:
+        parsec_tpu.params.reset()
+    json_files = [f for f in os.listdir(tmp_path) if f.endswith(".json")]
+    ptt_files = [f for f in os.listdir(tmp_path) if f.endswith(".ptt")]
+    assert json_files and ptt_files
+    back = read_profile(str(tmp_path / ptt_files[0]))
+    assert back.nb_events() > 0

@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Aggregate SDE-style counter samples across per-rank traces
+(ref: tools/aggregator_visu — the live PAPI-SDE aggregator; this is the
+offline equivalent: min/max/last/mean per counter per rank and fleet-wide,
+plus an optional binned timeline for plotting).
+
+    python tools/counter_aggregate.py trace.rank*.ptt
+    python tools/counter_aggregate.py --timeline 10 --json out.json *.ptt
+"""
+import argparse
+import json
+import os
+import sys
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from parsec_tpu.profiling.binfmt import read_profile  # noqa: E402
+
+
+def collect(paths):
+    """{counter: {rank: [(ts, value), ...]}} across all streams."""
+    series = defaultdict(lambda: defaultdict(list))
+    for p in paths:
+        prof = read_profile(p)
+        for _tid, st in sorted(prof._streams.items()):
+            for ts, ph, key, info in st.events:
+                if ph == "C":
+                    series[key][prof.rank].append((ts, float(info)))
+    for per_rank in series.values():
+        for samples in per_rank.values():
+            samples.sort()
+    return series
+
+
+def aggregate(series):
+    agg = {}
+    for key, per_rank in sorted(series.items()):
+        ranks = {}
+        for rank, samples in sorted(per_rank.items()):
+            vals = [v for _, v in samples]
+            ranks[rank] = {"n": len(vals), "min": min(vals),
+                           "max": max(vals), "last": vals[-1],
+                           "mean": sum(vals) / len(vals)}
+        allvals = [v for s in per_rank.values() for _, v in s]
+        agg[key] = {"ranks": ranks,
+                    "fleet": {"n": len(allvals), "min": min(allvals),
+                              "max": max(allvals),
+                              "sum_of_last": sum(r["last"]
+                                                 for r in ranks.values()),
+                              "mean": sum(allvals) / len(allvals)}}
+    return agg
+
+
+def timeline(series, nbins):
+    """Fleet-wide per-bin mean of each counter (for plotting)."""
+    out = {}
+    for key, per_rank in series.items():
+        samples = sorted(s for ss in per_rank.values() for s in ss)
+        if not samples:
+            continue
+        t0, t1 = samples[0][0], samples[-1][0]
+        span = max(t1 - t0, 1)
+        bins = [[] for _ in range(nbins)]
+        for ts, v in samples:
+            bins[min(int((ts - t0) * nbins / span), nbins - 1)].append(v)
+        out[key] = [sum(b) / len(b) if b else None for b in bins]
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="+", help=".ptt trace files")
+    ap.add_argument("--timeline", type=int, metavar="NBINS", default=0)
+    ap.add_argument("--json", metavar="PATH",
+                    help="also write the aggregate as JSON")
+    args = ap.parse_args(argv)
+    series = collect(args.paths)
+    agg = aggregate(series)
+    for key, a in agg.items():
+        f = a["fleet"]
+        print(f"{key}: n={f['n']} min={f['min']:g} max={f['max']:g} "
+              f"mean={f['mean']:g} sum_of_last={f['sum_of_last']:g}")
+        for rank, r in a["ranks"].items():
+            print(f"  rank {rank}: n={r['n']} last={r['last']:g} "
+                  f"mean={r['mean']:g}")
+    doc = {"aggregate": agg}
+    if args.timeline:
+        doc["timeline"] = timeline(series, args.timeline)
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump(doc, fh, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Convert binary .ptt traces to an interval table in HDF5 or Parquet
+(ref: tools/profiling/python/pbt2ptt.pyx + profile2h5.py — dbp files in,
+pandas/HDF5 store out).
+
+    python tools/ptt2h5.py out.h5 trace.rank0.ptt trace.rank1.ptt
+    python tools/ptt2h5.py --format parquet out.parquet *.ptt
+
+The table has one row per begin/end interval: rank, tid, name, begin_ns,
+end_ns, duration_ns. Counter samples land in a second table
+(rank, tid, name, ts_ns, value). Load back with ``load(path)`` (h5py /
+pyarrow underneath — no pytables dependency).
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from parsec_tpu.profiling.binfmt import read_profile  # noqa: E402
+from ptt_dump import intervals_of  # noqa: E402
+
+
+def tables_from(paths):
+    import pandas as pd
+    ivals, counters = [], []
+    for p in paths:
+        prof = read_profile(p)
+        for tid, st in sorted(prof._streams.items()):
+            for key, b, e, _info in intervals_of(st):
+                ivals.append((prof.rank, tid, key, b, e, e - b))
+            for ts, ph, key, info in st.events:
+                if ph == "C":
+                    counters.append((prof.rank, tid, key, ts, float(info)))
+    iv = pd.DataFrame(ivals, columns=["rank", "tid", "name", "begin_ns",
+                                      "end_ns", "duration_ns"])
+    ct = pd.DataFrame(counters, columns=["rank", "tid", "name", "ts_ns",
+                                         "value"])
+    return iv, ct
+
+
+def write_h5(path, iv, ct):
+    import h5py
+    with h5py.File(path, "w") as f:
+        for group, df in (("intervals", iv), ("counters", ct)):
+            g = f.create_group(group)
+            for col in df.columns:
+                data = df[col].to_numpy()
+                if data.dtype == object:
+                    g.create_dataset(
+                        col, data=[str(x).encode() for x in data])
+                else:
+                    g.create_dataset(col, data=data)
+
+
+def write_parquet(path, iv, ct):
+    base, ext = os.path.splitext(path)
+    iv.to_parquet(path)
+    ct.to_parquet(f"{base}.counters{ext or '.parquet'}")
+
+
+def load(path):
+    """Load an interval table written by this tool back into pandas."""
+    import pandas as pd
+    if path.endswith((".parquet", ".pq")):
+        return pd.read_parquet(path)
+    import h5py
+    with h5py.File(path, "r") as f:
+        g = f["intervals"]
+        cols = {}
+        for col in g:
+            data = g[col][()]
+            if data.dtype.kind in ("S", "O"):
+                data = [x.decode() for x in data]
+            cols[col] = data
+        return pd.DataFrame(cols)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("out", help="output .h5/.parquet path")
+    ap.add_argument("paths", nargs="+", help=".ptt trace files")
+    ap.add_argument("--format", choices=["h5", "parquet"], default="h5")
+    args = ap.parse_args(argv)
+    iv, ct = tables_from(args.paths)
+    if args.format == "h5":
+        write_h5(args.out, iv, ct)
+    else:
+        write_parquet(args.out, iv, ct)
+    print(f"{args.out}: {len(iv)} intervals, {len(ct)} counter samples "
+          f"from {len(args.paths)} rank file(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Dump/summarize binary .ptt traces (ref: tools/profiling/dbpreader.c,
+dbp2xml.c).
+
+    python tools/ptt_dump.py trace.rank0.ptt [more.ptt ...]
+    python tools/ptt_dump.py --format xml trace.rank0.ptt
+    python tools/ptt_dump.py --format json trace.rank0.ptt
+
+``summary`` prints per-stream event counts and per-event-class interval
+statistics (count, total/mean/max duration) the way dbpreader's report
+does; ``xml`` mirrors dbp2xml's full event dump; ``json`` emits the raw
+events for scripting.
+"""
+import argparse
+import json
+import os
+import sys
+from collections import defaultdict
+from xml.sax.saxutils import escape, quoteattr
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from parsec_tpu.profiling.binfmt import read_profile  # noqa: E402
+
+
+def intervals_of(stream):
+    """Pair B/E events per key (LIFO nesting, like the dbp readers)."""
+    out = []
+    open_ev = defaultdict(list)
+    for ts, ph, key, info in stream.events:
+        if ph == "B":
+            open_ev[key].append((ts, info))
+        elif ph == "E" and open_ev.get(key):
+            b, binfo = open_ev[key].pop()
+            out.append((key, b, ts, binfo))
+    return out
+
+
+def cmd_summary(profiles, out=None):
+    out = out or sys.stdout
+    for path, prof in profiles:
+        print(f"== {path}: rank {prof.rank}, {len(prof._streams)} streams, "
+              f"{prof.nb_events()} events", file=out)
+        for k, v in sorted(prof.info.items()):
+            print(f"   info {k} = {v}", file=out)
+        for tid, st in sorted(prof._streams.items()):
+            stats = defaultdict(lambda: [0, 0, 0])  # count, total, max
+            for key, b, e, _ in intervals_of(st):
+                s = stats[key]
+                s[0] += 1
+                s[1] += e - b
+                s[2] = max(s[2], e - b)
+            counters = sum(1 for ev in st.events if ev[1] == "C")
+            print(f"   stream {tid} ({st.name}): {len(st.events)} events, "
+                  f"{counters} counter samples", file=out)
+            for key in sorted(stats):
+                c, tot, mx = stats[key]
+                print(f"     {key:32s} n={c:6d} total={tot/1e6:10.3f}ms "
+                      f"mean={tot/c/1e3:8.1f}us max={mx/1e3:8.1f}us",
+                      file=out)
+
+
+def cmd_xml(profiles, out=None):
+    out = out or sys.stdout
+    print('<?xml version="1.0"?>', file=out)
+    print("<profiles>", file=out)
+    for path, prof in profiles:
+        print(f'  <profile file="{escape(path)}" rank="{prof.rank}">',
+              file=out)
+        for tid, st in sorted(prof._streams.items()):
+            print(f'    <stream tid="{tid}" name="{escape(st.name)}">',
+                  file=out)
+            for ts, ph, key, info in st.events:
+                attr = f" info={quoteattr(json.dumps(info))}" if info is not None else ""
+                print(f'      <event ts="{ts}" ph="{ph}" '
+                      f"key={quoteattr(key)}{attr}/>", file=out)
+            print("    </stream>", file=out)
+        print("  </profile>", file=out)
+    print("</profiles>", file=out)
+
+
+def cmd_json(profiles, out=None):
+    out = out or sys.stdout
+    doc = []
+    for path, prof in profiles:
+        doc.append({
+            "file": path, "rank": prof.rank, "info": prof.info,
+            "streams": [
+                {"tid": tid, "name": st.name,
+                 "events": [{"ts": ts, "ph": ph, "key": key, "info": info}
+                            for ts, ph, key, info in st.events]}
+                for tid, st in sorted(prof._streams.items())],
+        })
+    json.dump(doc, out, indent=1)
+    out.write("\n")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="+", help=".ptt trace files")
+    ap.add_argument("--format", choices=["summary", "xml", "json"],
+                    default="summary")
+    args = ap.parse_args(argv)
+    profiles = [(p, read_profile(p)) for p in args.paths]
+    {"summary": cmd_summary, "xml": cmd_xml, "json": cmd_json}[args.format](
+        profiles)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,46 @@
+#!/usr/bin/env python
+"""Merge per-rank .ptt traces into one Chrome/Perfetto trace JSON
+(the reference merges per-rank dbp files inside dbpreader; Perfetto's
+pid lane plays the role of the rank axis).
+
+    python tools/trace_merge.py out.trace.json trace.rank*.ptt
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from parsec_tpu.profiling.binfmt import read_profile  # noqa: E402
+
+
+def merge(paths):
+    events = []
+    meta = {}
+    for p in paths:
+        prof = read_profile(p)
+        doc = prof.to_chrome_trace()
+        events.append({"name": "process_name", "ph": "M", "pid": prof.rank,
+                       "tid": 0, "args": {"name": f"rank {prof.rank}"}})
+        events.extend(doc["traceEvents"])
+        for k, v in doc.get("metadata", {}).items():
+            meta[f"rank{prof.rank}.{k}"] = v
+    return {"traceEvents": events, "metadata": meta}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("out", help="output chrome trace json")
+    ap.add_argument("paths", nargs="+", help=".ptt trace files")
+    args = ap.parse_args(argv)
+    doc = merge(args.paths)
+    with open(args.out, "w") as fh:
+        json.dump(doc, fh)
+    print(f"{args.out}: {len(doc['traceEvents'])} events from "
+          f"{len(args.paths)} rank file(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
