@@ -1,0 +1,4 @@
+"""Foreign-language bindings (ref: parsec/fortran/ — here the host
+runtime is Python, so the foreign side is C: parsec_tpu_c.h + the
+libparsec_tpu_c embedding shim, with chelper.py as the marshalling
+layer)."""

@@ -1,0 +1,53 @@
+"""Build driver for the C embedding library (libparsec_tpu_c.so).
+
+    python -m parsec_tpu.bindings.build [--force]
+
+Compiles parsec_tpu_c.c against the running interpreter's libpython
+(python3-config --embed equivalent), cached by source mtime. C programs
+then build with:
+
+    cc app.c -I <this dir> -L <this dir> -lparsec_tpu_c \
+       -L$(python3-config --prefix)/lib -lpython3.X \
+       -Wl,-rpath,<this dir> -Wl,-rpath,$LIBDIR
+
+and run with PYTHONPATH including the repo root.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import sysconfig
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "parsec_tpu_c.c")
+
+
+def libpath() -> str:
+    return os.path.join(_DIR, "libparsec_tpu_c.so")
+
+
+def python_link_flags() -> list:
+    libdir = sysconfig.get_config_var("LIBDIR")
+    ver = sysconfig.get_config_var("VERSION")
+    return [f"-L{libdir}", f"-lpython{ver}",
+            f"-Wl,-rpath,{libdir}"] + \
+        (sysconfig.get_config_var("LIBS") or "").split()
+
+
+def build(force: bool = False, verbose: bool = False) -> str:
+    so = libpath()
+    if (not force and os.path.exists(so)
+            and os.path.getmtime(so) >= os.path.getmtime(_SRC)):
+        return so
+    include = sysconfig.get_paths()["include"]
+    cmd = ["gcc", "-O2", "-shared", "-fPIC", "-Wall",
+           f"-I{include}", f"-I{_DIR}", _SRC, "-o", so] + python_link_flags()
+    if verbose:
+        print("+", " ".join(cmd), file=sys.stderr)
+    subprocess.run(cmd, check=True, capture_output=not verbose)
+    return so
+
+
+if __name__ == "__main__":
+    print(build(force="--force" in sys.argv, verbose=True))

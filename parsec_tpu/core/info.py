@@ -1,0 +1,149 @@
+"""Named info-slot registry + per-object info arrays.
+
+Reference behavior: components register named info IDs in a registry
+(``parsec_info_register`` -> IID); runtime objects (taskpools, devices,
+streams) carry an info object-array whose entries are created lazily by
+the registered constructor on first access and torn down by the
+destructor (ref: parsec/class/info.h, parsec/class/info.c — used e.g.
+for per-taskpool device state).
+
+The TPU-native runtime uses the same shape: a registry per hosting object
+class, plus InfoObjectArray instances hanging off taskpools/contexts.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+
+class InfoRegistry:
+    """ref: parsec_info_t — name -> small dense id space."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._by_name: Dict[str, int] = {}
+        self._entries: List[Optional[dict]] = []
+
+    def register(self, name: str,
+                 constructor: Optional[Callable[[Any], Any]] = None,
+                 destructor: Optional[Callable[[Any], None]] = None) -> int:
+        """Register (or look up) a named slot; returns its IID."""
+        with self._lock:
+            if name in self._by_name:
+                return self._by_name[name]
+            # reuse the lowest unregistered id (ref: info.c id recycling)
+            for iid, e in enumerate(self._entries):
+                if e is None:
+                    break
+            else:
+                iid = len(self._entries)
+                self._entries.append(None)
+            self._entries[iid] = {"name": name, "constructor": constructor,
+                                  "destructor": destructor}
+            self._by_name[name] = iid
+            return iid
+
+    def unregister(self, name_or_id) -> bool:
+        with self._lock:
+            if isinstance(name_or_id, str):
+                iid = self._by_name.pop(name_or_id, None)
+                if iid is None:
+                    return False
+            else:
+                iid = name_or_id
+                e = self._entries[iid] if 0 <= iid < len(self._entries) else None
+                if e is None:
+                    return False
+                del self._by_name[e["name"]]
+            self._entries[iid] = None
+            return True
+
+    def lookup(self, name: str) -> int:
+        """-1 when unknown (ref: PARSEC_INFO_ID_UNDEFINED)."""
+        with self._lock:
+            return self._by_name.get(name, -1)
+
+    def entry(self, iid: int) -> Optional[dict]:
+        with self._lock:
+            if 0 <= iid < len(self._entries):
+                return self._entries[iid]
+            return None
+
+    def nb_registered(self) -> int:
+        with self._lock:
+            return len(self._by_name)
+
+
+class InfoObjectArray:
+    """ref: parsec_info_object_array_t — per-object items keyed by IID,
+    lazily constructed."""
+
+    def __init__(self, registry: InfoRegistry, cons_arg: Any = None) -> None:
+        self.registry = registry
+        self.cons_arg = cons_arg  # passed to constructors (the host object)
+        self._items: Dict[int, Any] = {}
+        self._lock = threading.Lock()
+
+    def get(self, iid: int) -> Any:
+        """The item for this slot, running the constructor on first use.
+
+        Items remember the registry entry that created them: if the iid
+        was unregistered and recycled for a new slot, the stale item is
+        invisible (a fresh one is constructed for the new slot) and its
+        original destructor still runs at clear()."""
+        e = self.registry.entry(iid)
+        if e is None:
+            raise KeyError(f"info id {iid} is not registered")
+        with self._lock:
+            cell = self._items.get(iid)
+            if cell is not None and cell[0] is e:
+                return cell[1]
+        # construct OUTSIDE the lock: constructors may touch other slots
+        # of this same array (reentrancy)
+        item = e["constructor"](self.cons_arg) if e["constructor"] else None
+        with self._lock:
+            cell = self._items.get(iid)
+            if cell is not None and cell[0] is e:
+                return cell[1]  # another thread won the race
+            stale = cell  # a recycled iid's previous-slot item, if any
+            self._items[iid] = (e, item)
+        self._destroy_cell(stale)
+        return item
+
+    def set(self, iid: int, value: Any) -> Any:
+        e = self.registry.entry(iid)
+        if e is None:
+            raise KeyError(f"info id {iid} is not registered")
+        with self._lock:
+            cell = self._items.get(iid)
+            stale = cell if (cell is not None and cell[0] is not e) else None
+            self._items[iid] = (e, value)
+        self._destroy_cell(stale)
+        return value
+
+    @staticmethod
+    def _destroy_cell(cell) -> None:
+        """Run a displaced stale item's original destructor (its slot was
+        unregistered and the iid recycled)."""
+        if cell is not None and cell[0]["destructor"] is not None \
+                and cell[1] is not None:
+            cell[0]["destructor"](cell[1])
+
+    def get_by_name(self, name: str) -> Any:
+        return self.get(self.registry.lookup(name))
+
+    def clear(self) -> None:
+        """Run destructors and drop all items (object teardown). Each
+        item's destructor is the one from the entry that created it, even
+        if the iid has since been recycled."""
+        with self._lock:
+            items, self._items = self._items, {}
+        for _iid, (e, item) in items.items():
+            if e["destructor"] is not None and item is not None:
+                e["destructor"](item)
+
+
+#: process-level registries for the runtime's own object classes
+#: (ref: parsec_per_stream_infos / per-taskpool info registries)
+taskpool_infos = InfoRegistry()
+stream_infos = InfoRegistry()

@@ -27,7 +27,9 @@ def build_devices(context, enable_tpu: bool = True) -> List[Device]:
             plat = params.get("device_tpu_platform")
             jdevs = jax.devices(plat) if plat else jax.local_devices()
         except Exception as exc:  # no jax backend available
-            plog.warning("no XLA devices attached: %s", exc)
+            from ..utils.show_help import show_help
+            show_help("help-runtime.txt", "tpu-device-unavailable",
+                      want_error=True, error=exc)
             jdevs = []
         cap = params.get("device_tpu_max")
         if cap >= 0:

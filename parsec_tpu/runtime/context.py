@@ -172,6 +172,7 @@ class Context:
             if tp.taskpool_id in self.taskpools:
                 del self.taskpools[tp.taskpool_id]
                 self._active_taskpools -= 1
+        tp.info.clear()  # run per-taskpool info destructors
         self.sample_sde_counters()
         self.wake_workers(self.nb_cores)
 

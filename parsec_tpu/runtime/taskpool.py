@@ -227,6 +227,10 @@ class Taskpool(Obj):
         self._complete_cbs: List[Callable] = []
         self._lock = threading.Lock()
         self._completed = threading.Event()
+        # lazily-constructed per-taskpool info items (ref: info object
+        # arrays hanging off parsec_taskpool_t; torn down on completion)
+        from ..core.info import InfoObjectArray, taskpool_infos
+        self.info = InfoObjectArray(taskpool_infos, self)
 
     # -- task accounting (delegated to the termination detector) ------------
     def add_tasks(self, n: int) -> None:

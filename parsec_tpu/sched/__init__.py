@@ -23,7 +23,13 @@ def sched_new(name: str) -> SchedulerModule:
     try:
         return _REGISTRY[name]()
     except KeyError:
-        raise ValueError(f"unknown scheduler {name!r}; have {sorted(_REGISTRY)}")
+        # the reference's MCA select logs help and falls back to the
+        # default component rather than failing init (scheduling.c:246-272)
+        from ..utils.show_help import show_help
+        show_help("help-runtime.txt", "unknown-scheduler", want_error=True,
+                  name=name, available=", ".join(sorted(_REGISTRY)),
+                  fallback="lfq")
+        return _REGISTRY["lfq"]()
 
 
 def sched_register(cls: Type[SchedulerModule]) -> None:

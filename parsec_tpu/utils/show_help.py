@@ -1,0 +1,81 @@
+"""Once-per-topic help catalog (the show_help analog).
+
+Reference behavior: verbose, actionable error/help texts live in catalog
+files keyed by (file, topic); ``parsec_show_help("help-mca-param.txt",
+"missing-param", ...)`` prints the formatted topic once and suppresses
+repeats (ref: parsec/utils/show_help.c, show-help text catalogs).
+
+Catalogs here are ini-style text files in ``parsec_tpu/utils/help/``:
+
+    [topic-name]
+    Multi-line message with {placeholders}.
+"""
+from __future__ import annotations
+
+import os
+import re
+import threading
+from typing import Dict, Set, Tuple
+
+from . import logging as plog
+
+_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "help")
+_lock = threading.Lock()
+_seen: Set[Tuple[str, str]] = set()
+_cache: Dict[str, Dict[str, str]] = {}
+
+
+def _load(filename: str) -> Dict[str, str]:
+    topics = _cache.get(filename)
+    if topics is not None:
+        return topics
+    topics = {}
+    path = os.path.join(_DIR, filename)
+    if os.path.exists(path):
+        cur = None
+        buf: list = []
+        with open(path) as fh:
+            for line in fh:
+                m = re.match(r"^\[([^\]]+)\]\s*$", line)
+                if m:
+                    if cur is not None:
+                        topics[cur] = "".join(buf).strip()
+                    cur, buf = m.group(1), []
+                elif cur is not None:
+                    buf.append(line)
+        if cur is not None:
+            topics[cur] = "".join(buf).strip()
+    _cache[filename] = topics
+    return topics
+
+
+def show_help(filename: str, topic: str, want_error: bool = False,
+              **fmt) -> str:
+    """Emit the catalog text for (filename, topic) once; later calls for
+    the same pair are suppressed (returns the text either way)."""
+    topics = _load(filename)
+    text = topics.get(topic)
+    if text is None:
+        text = (f"[no help found for {topic!r} in {filename}; "
+                f"args: {fmt or '{}'}]")
+    else:
+        try:
+            text = text.format(**fmt)
+        except (KeyError, IndexError):
+            pass
+    with _lock:
+        if (filename, topic) in _seen:
+            return text
+        _seen.add((filename, topic))
+    if want_error:
+        plog.warning("%s", text)
+    else:
+        plog.inform("%s", text)
+    return text
+
+
+def reset() -> None:
+    """Forget suppression state and cached catalogs (tests)."""
+    with _lock:
+        _seen.clear()
+        _cache.clear()
