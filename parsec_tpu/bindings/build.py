@@ -21,6 +21,7 @@ import sysconfig
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "parsec_tpu_c.c")
+_HDR = os.path.join(_DIR, "parsec_tpu_c.h")
 
 
 def libpath() -> str:
@@ -29,7 +30,8 @@ def libpath() -> str:
 
 def python_link_flags() -> list:
     libdir = sysconfig.get_config_var("LIBDIR")
-    ver = sysconfig.get_config_var("VERSION")
+    ver = (sysconfig.get_config_var("LDVERSION")
+           or sysconfig.get_config_var("VERSION"))
     return [f"-L{libdir}", f"-lpython{ver}",
             f"-Wl,-rpath,{libdir}"] + \
         (sysconfig.get_config_var("LIBS") or "").split()
@@ -37,8 +39,8 @@ def python_link_flags() -> list:
 
 def build(force: bool = False, verbose: bool = False) -> str:
     so = libpath()
-    if (not force and os.path.exists(so)
-            and os.path.getmtime(so) >= os.path.getmtime(_SRC)):
+    src_mtime = max(os.path.getmtime(_SRC), os.path.getmtime(_HDR))
+    if not force and os.path.exists(so) and os.path.getmtime(so) >= src_mtime:
         return so
     include = sysconfig.get_paths()["include"]
     cmd = ["gcc", "-O2", "-shared", "-fPIC", "-Wall",

@@ -47,6 +47,7 @@ static PyObject *helper(void) {
 const char *ptc_last_error(void) { return g_err; }
 
 ptc_context *ptc_init(int nb_cores) {
+    g_err[0] = '\0';
     int owns = 0;
     if (!Py_IsInitialized()) {
         Py_Initialize();
@@ -66,7 +67,6 @@ ptc_context *ptc_init(int nb_cores) {
             out = (ptc_context *)malloc(sizeof(*out));
             out->ctx = ctx;
             out->owns_interp = owns;
-            g_err[0] = '\0';
         }
     }
     PyGILState_Release(st);
@@ -74,6 +74,7 @@ ptc_context *ptc_init(int nb_cores) {
 }
 
 void ptc_fini(ptc_context *ctx) {
+    g_err[0] = '\0';
     if (ctx == NULL) return;
     PyGILState_STATE st = PyGILState_Ensure();
     PyObject *r = PyObject_CallMethod(helper(), "fini", "O", ctx->ctx);
@@ -87,6 +88,7 @@ void ptc_fini(ptc_context *ctx) {
 }
 
 ptc_taskpool *ptc_dtd_taskpool_new(ptc_context *ctx) {
+    g_err[0] = '\0';
     if (ctx == NULL) return NULL;
     PyGILState_STATE st = PyGILState_Ensure();
     ptc_taskpool *out = NULL;
@@ -103,6 +105,7 @@ ptc_taskpool *ptc_dtd_taskpool_new(ptc_context *ctx) {
 
 ptc_tile *ptc_tile_of_dense(ptc_taskpool *tp, float *data,
                             long rows, long cols) {
+    g_err[0] = '\0';
     if (tp == NULL || data == NULL) return NULL;
     PyGILState_STATE st = PyGILState_Ensure();
     ptc_tile *out = NULL;
@@ -120,6 +123,7 @@ ptc_tile *ptc_tile_of_dense(ptc_taskpool *tp, float *data,
 
 int ptc_insert_task(ptc_taskpool *tp, ptc_body_fn fn, void *user,
                     int ntiles, ptc_tile **tiles, const int *modes) {
+    g_err[0] = '\0';
     if (tp == NULL || fn == NULL) return -1;
     PyGILState_STATE st = PyGILState_Ensure();
     int rc = -1;
@@ -145,6 +149,7 @@ int ptc_insert_task(ptc_taskpool *tp, ptc_body_fn fn, void *user,
 }
 
 static int call_int_method(ptc_taskpool *tp, const char *name) {
+    g_err[0] = '\0';
     if (tp == NULL) return -1;
     PyGILState_STATE st = PyGILState_Ensure();
     int rc = -1;

@@ -5,6 +5,13 @@ overflow spills to a parent push function (ultimately the global system
 dequeue); used by all local-queue schedulers (ref: parsec/hbbuffer.c:1-277).
 ``parsec_maxheap`` orders tasks by priority for heap-based stealing
 (ref: parsec/maxheap.c:1-384).
+
+Like the list containers (core/lists.py), both are implemented in C++
+(native/_native.cpp) and rebound here when the native core builds; the
+Python classes below are the documented fallbacks (PARSEC_TPU_NATIVE=0)
+and the reference implementations for the native parity tests. The
+native HBBuffer reads ``item.priority`` directly when ``prio_fn`` is
+omitted — the schedulers' fast path.
 """
 from __future__ import annotations
 
@@ -83,7 +90,7 @@ class MaxHeap:
 
     def split(self) -> "MaxHeap":
         """Steal roughly half the heap (heap-split stealing)."""
-        out = MaxHeap()
+        out = type(self)()
         with self._lock:
             half = len(self._h) // 2
             if half:
@@ -96,3 +103,13 @@ class MaxHeap:
 
     def __len__(self) -> int:
         return len(self._h)
+
+
+PyHBBuffer, PyMaxHeap = HBBuffer, MaxHeap
+try:  # rebind to the native C++ core when it is available
+    from ..native import native as _native
+    if _native is not None and hasattr(_native, "HBBuffer"):
+        HBBuffer = _native.HBBuffer      # type: ignore[misc,assignment]
+        MaxHeap = _native.MaxHeap        # type: ignore[misc,assignment]
+except ImportError:  # pragma: no cover
+    pass

@@ -104,9 +104,15 @@ class InfoObjectArray:
         with self._lock:
             cell = self._items.get(iid)
             if cell is not None and cell[0] is e:
-                return cell[1]  # another thread won the race
-            stale = cell  # a recycled iid's previous-slot item, if any
-            self._items[iid] = (e, item)
+                winner = cell[1]  # another thread won the race
+            else:
+                winner = None
+                stale = cell  # a recycled iid's previous-slot item, if any
+                self._items[iid] = (e, item)
+        if winner is not None:
+            # our freshly built item lost the race: release it properly
+            self._destroy_cell((e, item))
+            return winner
         self._destroy_cell(stale)
         return item
 

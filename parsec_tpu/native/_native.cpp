@@ -25,6 +25,7 @@
 #include <atomic>
 #include <cstdint>
 #include <cstring>
+#include <algorithm>
 #include <deque>
 #include <map>
 #include <mutex>
@@ -802,6 +803,291 @@ static PyTypeObject ZoneType = []{
 }();
 
 /* ================================================================== */
+/* HBBuffer: bounded per-thread priority buffer with spill             */
+/* (ref: parsec/hbbuffer.c:1-277 — the local-queue schedulers' hot     */
+/*  structure; overflow spills to a parent push fn)                    */
+/* ================================================================== */
+struct HBItem {
+  int64_t prio;
+  uint64_t seq;
+  PyObject* item;
+};
+
+/* max-heap: highest priority first, FIFO (lowest seq) within a priority */
+static inline bool hb_less(const HBItem& a, const HBItem& b) {
+  return a.prio < b.prio || (a.prio == b.prio && a.seq > b.seq);
+}
+
+struct HBBufferObject {
+  PyObject_HEAD
+  SpinLock* lock;
+  std::vector<HBItem>* heap;
+  PyObject* parent_push;  /* callable(list, distance) */
+  PyObject* prio_fn;      /* callable(item) -> int, or NULL */
+  Py_ssize_t cap;
+  uint64_t seq;
+};
+
+static int hb_prio_of(HBBufferObject* self, PyObject* item, int64_t* out) {
+  if (self->prio_fn != nullptr && self->prio_fn != Py_None) {
+    PyObject* pr = PyObject_CallFunctionObjArgs(self->prio_fn, item, nullptr);
+    if (!pr) return -1;
+    *out = (int64_t)PyLong_AsLongLong(pr);
+    Py_DECREF(pr);
+    if (*out == -1 && PyErr_Occurred()) return -1;
+    return 0;
+  }
+  PyObject* pr = PyObject_GetAttrString(item, "priority");
+  if (!pr) { PyErr_Clear(); *out = 0; return 0; }
+  *out = (int64_t)PyLong_AsLongLong(pr);
+  Py_DECREF(pr);
+  if (*out == -1 && PyErr_Occurred()) { PyErr_Clear(); *out = 0; }
+  return 0;
+}
+
+static PyObject* HBBuffer_new(PyTypeObject* type, PyObject*, PyObject*) {
+  HBBufferObject* self = (HBBufferObject*)type->tp_alloc(type, 0);
+  if (self) {
+    self->lock = new SpinLock();
+    self->heap = new std::vector<HBItem>();
+    self->parent_push = nullptr;
+    self->prio_fn = nullptr;
+    self->cap = 0;
+    self->seq = 0;
+  }
+  return (PyObject*)self;
+}
+
+static int HBBuffer_init(PyObject* o, PyObject* args, PyObject* kwds) {
+  HBBufferObject* self = (HBBufferObject*)o;
+  static const char* kwlist[] = {"size", "parent_push", "prio_fn", nullptr};
+  Py_ssize_t size = 0;
+  PyObject *parent = nullptr, *prio_fn = nullptr;
+  if (!PyArg_ParseTupleAndKeywords(args, kwds, "nO|O", (char**)kwlist,
+                                   &size, &parent, &prio_fn))
+    return -1;
+  if (size <= 0) {
+    PyErr_SetString(PyExc_ValueError, "HBBuffer size must be > 0");
+    return -1;
+  }
+  self->cap = size;
+  Py_INCREF(parent);
+  Py_XSETREF(self->parent_push, parent);
+  Py_XINCREF(prio_fn);
+  Py_XSETREF(self->prio_fn, prio_fn);
+  return 0;
+}
+
+static void HBBuffer_dealloc(HBBufferObject* self) {
+  for (auto& e : *self->heap) Py_DECREF(e.item);
+  delete self->heap;
+  delete self->lock;
+  Py_XDECREF(self->parent_push);
+  Py_XDECREF(self->prio_fn);
+  Py_TYPE(self)->tp_free((PyObject*)self);
+}
+
+static PyObject* HBBuffer_push_all(HBBufferObject* self, PyObject* args) {
+  PyObject* iterable;
+  long long distance = 0;
+  if (!PyArg_ParseTuple(args, "O|L", &iterable, &distance)) return nullptr;
+  PyObject* it = PyObject_GetIter(iterable);
+  if (!it) return nullptr;
+  PyObject* spill = PyList_New(0);
+  if (!spill) { Py_DECREF(it); return nullptr; }
+  PyObject* item;
+  int failed = 0;
+  while (!failed && (item = PyIter_Next(it)) != nullptr) {
+    int64_t prio = 0;
+    if (hb_prio_of(self, item, &prio) < 0) { Py_DECREF(item); failed = 1; break; }
+    PyObject* displaced = nullptr;
+    { SpinGuard g(*self->lock);
+      if ((Py_ssize_t)self->heap->size() < self->cap) {
+        self->heap->push_back({prio, self->seq++, item});
+        std::push_heap(self->heap->begin(), self->heap->end(), hb_less);
+        item = nullptr;
+      } else {
+        /* find the worst element: lowest priority, newest within ties
+         * (matches the Python fallback's max() over (-prio, seq)) */
+        size_t worst = 0;
+        for (size_t i = 1; i < self->heap->size(); i++) {
+          const HBItem &a = (*self->heap)[i], &b = (*self->heap)[worst];
+          if (a.prio < b.prio || (a.prio == b.prio && a.seq > b.seq))
+            worst = i;
+        }
+        if (prio > (*self->heap)[worst].prio) {
+          displaced = (*self->heap)[worst].item;
+          (*self->heap)[worst] = {prio, self->seq++, item};
+          std::make_heap(self->heap->begin(), self->heap->end(), hb_less);
+          item = nullptr;
+        }
+      } }
+    PyObject* to_spill = item != nullptr ? item : displaced;
+    if (to_spill != nullptr) {
+      if (PyList_Append(spill, to_spill) < 0) failed = 1;
+      Py_DECREF(to_spill);
+    }
+  }
+  Py_DECREF(it);
+  if (failed || PyErr_Occurred()) { Py_DECREF(spill); return nullptr; }
+  if (PyList_GET_SIZE(spill) > 0) {
+    PyObject* r = PyObject_CallFunction(self->parent_push, "OL", spill,
+                                        distance + 1);
+    if (!r) { Py_DECREF(spill); return nullptr; }
+    Py_DECREF(r);
+  }
+  Py_DECREF(spill);
+  Py_RETURN_NONE;
+}
+
+static PyObject* HBBuffer_pop_best(HBBufferObject* self, PyObject*) {
+  PyObject* item = nullptr;
+  { SpinGuard g(*self->lock);
+    if (!self->heap->empty()) {
+      std::pop_heap(self->heap->begin(), self->heap->end(), hb_less);
+      item = self->heap->back().item;
+      self->heap->pop_back();
+    } }
+  if (!item) Py_RETURN_NONE;
+  return item;
+}
+
+static PyObject* HBBuffer_is_empty(HBBufferObject* self, PyObject*) {
+  SpinGuard g(*self->lock);
+  return PyBool_FromLong(self->heap->empty());
+}
+
+static Py_ssize_t HBBuffer_len(PyObject* o) {
+  HBBufferObject* self = (HBBufferObject*)o;
+  SpinGuard g(*self->lock);
+  return (Py_ssize_t)self->heap->size();
+}
+
+static PyMethodDef HBBuffer_methods[] = {
+    {"push_all", (PyCFunction)HBBuffer_push_all, METH_VARARGS, ""},
+    {"pop_best", (PyCFunction)HBBuffer_pop_best, METH_NOARGS, ""},
+    {"is_empty", (PyCFunction)HBBuffer_is_empty, METH_NOARGS, ""},
+    {nullptr, nullptr, 0, nullptr}};
+
+static PySequenceMethods HBBuffer_as_seq = {HBBuffer_len};
+
+static PyTypeObject HBBufferType = []{
+  PyTypeObject t = {PyVarObject_HEAD_INIT(nullptr, 0)};
+  t.tp_name = "_parsec_native.HBBuffer";
+  t.tp_basicsize = sizeof(HBBufferObject);
+  t.tp_flags = Py_TPFLAGS_DEFAULT;
+  t.tp_doc = "Bounded priority buffer; overflow spills to parent_push.";
+  t.tp_new = HBBuffer_new;
+  t.tp_init = HBBuffer_init;
+  t.tp_dealloc = (destructor)HBBuffer_dealloc;
+  t.tp_methods = HBBuffer_methods;
+  t.tp_as_sequence = &HBBuffer_as_seq;
+  return t;
+}();
+
+/* ================================================================== */
+/* MaxHeap (ref: parsec/maxheap.c — heap-split stealing)               */
+/* ================================================================== */
+struct MaxHeapObject {
+  PyObject_HEAD
+  SpinLock* lock;
+  std::vector<HBItem>* heap;
+  uint64_t seq;
+};
+
+static PyObject* MaxHeap_new(PyTypeObject* type, PyObject*, PyObject*) {
+  MaxHeapObject* self = (MaxHeapObject*)type->tp_alloc(type, 0);
+  if (self) {
+    self->lock = new SpinLock();
+    self->heap = new std::vector<HBItem>();
+    self->seq = 0;
+  }
+  return (PyObject*)self;
+}
+
+static void MaxHeap_dealloc(MaxHeapObject* self) {
+  for (auto& e : *self->heap) Py_DECREF(e.item);
+  delete self->heap;
+  delete self->lock;
+  Py_TYPE(self)->tp_free((PyObject*)self);
+}
+
+static PyObject* MaxHeap_insert(MaxHeapObject* self, PyObject* args,
+                                PyObject* kwds) {
+  static const char* kwlist[] = {"item", "priority", nullptr};
+  PyObject* item;
+  long long prio = 0;
+  if (!PyArg_ParseTupleAndKeywords(args, kwds, "O|L", (char**)kwlist,
+                                   &item, &prio))
+    return nullptr;
+  Py_INCREF(item);
+  { SpinGuard g(*self->lock);
+    self->heap->push_back({(int64_t)prio, self->seq++, item});
+    std::push_heap(self->heap->begin(), self->heap->end(), hb_less); }
+  Py_RETURN_NONE;
+}
+
+static PyObject* MaxHeap_pop_max(MaxHeapObject* self, PyObject*) {
+  PyObject* item = nullptr;
+  { SpinGuard g(*self->lock);
+    if (!self->heap->empty()) {
+      std::pop_heap(self->heap->begin(), self->heap->end(), hb_less);
+      item = self->heap->back().item;
+      self->heap->pop_back();
+    } }
+  if (!item) Py_RETURN_NONE;
+  return item;
+}
+
+static PyObject* MaxHeap_split(MaxHeapObject* self, PyObject*) {
+  PyObject* outo = PyObject_CallObject((PyObject*)Py_TYPE(self), nullptr);
+  if (!outo) return nullptr;
+  MaxHeapObject* out = (MaxHeapObject*)outo;
+  std::vector<HBItem> stolen;
+  { SpinGuard g(*self->lock);
+    size_t half = self->heap->size() / 2;
+    if (half > 0) {
+      stolen.assign(self->heap->end() - half, self->heap->end());
+      self->heap->resize(self->heap->size() - half);
+      std::make_heap(self->heap->begin(), self->heap->end(), hb_less);
+    } }
+  if (!stolen.empty()) {
+    /* references move (no incref): items leave self, enter out */
+    *out->heap = std::move(stolen);
+    std::make_heap(out->heap->begin(), out->heap->end(), hb_less);
+    out->seq = self->seq;
+  }
+  return outo;
+}
+
+static Py_ssize_t MaxHeap_len(PyObject* o) {
+  MaxHeapObject* self = (MaxHeapObject*)o;
+  SpinGuard g(*self->lock);
+  return (Py_ssize_t)self->heap->size();
+}
+
+static PyMethodDef MaxHeap_methods[] = {
+    {"insert", (PyCFunction)MaxHeap_insert, METH_VARARGS | METH_KEYWORDS, ""},
+    {"pop_max", (PyCFunction)MaxHeap_pop_max, METH_NOARGS, ""},
+    {"split", (PyCFunction)MaxHeap_split, METH_NOARGS, ""},
+    {nullptr, nullptr, 0, nullptr}};
+
+static PySequenceMethods MaxHeap_as_seq = {MaxHeap_len};
+
+static PyTypeObject MaxHeapType = []{
+  PyTypeObject t = {PyVarObject_HEAD_INIT(nullptr, 0)};
+  t.tp_name = "_parsec_native.MaxHeap";
+  t.tp_basicsize = sizeof(MaxHeapObject);
+  t.tp_flags = Py_TPFLAGS_DEFAULT;
+  t.tp_doc = "Priority max-heap with heap-split stealing.";
+  t.tp_new = MaxHeap_new;
+  t.tp_dealloc = (destructor)MaxHeap_dealloc;
+  t.tp_methods = MaxHeap_methods;
+  t.tp_as_sequence = &MaxHeap_as_seq;
+  return t;
+}();
+
+/* ================================================================== */
 /* module                                                              */
 /* ================================================================== */
 static PyModuleDef native_module = {
@@ -820,6 +1106,7 @@ PyMODINIT_FUNC PyInit__parsec_native(void) {
       {"Lifo", &LifoType},       {"Fifo", &FifoType},
       {"Dequeue", &DequeueType}, {"OrderedList", &OrderedType},
       {"HashTable64", &HT64Type}, {"ZoneMalloc", &ZoneType},
+      {"HBBuffer", &HBBufferType}, {"MaxHeap", &MaxHeapType},
   };
   for (auto& t : types) {
     if (PyType_Ready(t.type) < 0) return nullptr;

@@ -45,7 +45,7 @@ class LFQScheduler(SchedulerModule):
     def flow_init(self, es) -> None:
         def spill(items, distance):
             self.system_queue.push_back_chain(items)
-        es.sched_obj = HBBuffer(self.BUFSIZE, spill, _prio)
+        es.sched_obj = HBBuffer(self.BUFSIZE, spill)
 
     def schedule(self, es, tasks: List, distance: int = 0) -> None:
         if distance > 0:
@@ -93,7 +93,7 @@ class LHQScheduler(LFQScheduler):
                 vpq.push_back_chain(items)
             else:
                 self.system_queue.push_back_chain(items)
-        es.sched_obj = HBBuffer(self.BUFSIZE, spill, _prio)
+        es.sched_obj = HBBuffer(self.BUFSIZE, spill)
 
     def select(self, es) -> Optional[Any]:
         t = es.sched_obj.pop_best()

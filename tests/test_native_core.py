@@ -174,3 +174,107 @@ def test_native_layer_is_active():
     """The driver environment has g++; the native core must actually load."""
     assert native_available
     assert lists_mod.Lifo.__module__ == "_parsec_native"
+
+
+# --------------------------------------------------------------------- #
+# HBBuffer / MaxHeap (native vs Python fallback parity + MT stress)     #
+# --------------------------------------------------------------------- #
+from parsec_tpu.core import hbbuffer as hb_mod  # noqa: E402
+
+
+class _Prio:
+    __slots__ = ("priority", "tag")
+
+    def __init__(self, p, tag=0):
+        self.priority = p
+        self.tag = tag
+
+
+@pytest.mark.parametrize("cls", [hb_mod.HBBuffer, hb_mod.PyHBBuffer])
+def test_hbbuffer_parity_spill_and_order(cls):
+    spilled = []
+    hb = cls(4, lambda items, d: spilled.extend(items))
+    tasks = [_Prio(p) for p in (3, 9, 1, 7, 5, 8, 2)]
+    hb.push_all(tasks)
+    # the four best stay local, the rest spilled
+    assert len(hb) == 4
+    assert sorted(t.priority for t in spilled) == [1, 2, 3]
+    got = [hb.pop_best().priority for _ in range(4)]
+    assert got == [9, 8, 7, 5]
+    assert hb.pop_best() is None
+    assert hb.is_empty()
+
+
+@pytest.mark.parametrize("cls", [hb_mod.HBBuffer, hb_mod.PyHBBuffer])
+def test_hbbuffer_fifo_within_priority(cls):
+    hb = cls(8, lambda items, d: None)
+    tasks = [_Prio(5, tag=i) for i in range(6)]
+    hb.push_all(tasks)
+    assert [hb.pop_best().tag for _ in range(6)] == list(range(6))
+
+
+@pytest.mark.parametrize("cls", [hb_mod.HBBuffer, hb_mod.PyHBBuffer])
+def test_hbbuffer_mt_stress(cls):
+    """Concurrent pushers + poppers: no loss, no duplication."""
+    spilled = []
+    slock = threading.Lock()
+
+    def spill(items, d):
+        with slock:
+            spilled.extend(items)
+
+    hb = cls(32, spill)
+    N, NT = 500, 4
+    popped = [[] for _ in range(NT)]
+
+    def pusher(base):
+        hb.push_all([_Prio(p % 17, tag=base + p) for p in range(N)])
+
+    def popper(out):
+        import time
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            t = hb.pop_best()
+            if t is None:
+                if all(not th.is_alive() for th in pushers) and hb.is_empty():
+                    return
+                continue
+            out.append(t)
+
+    pushers = [threading.Thread(target=pusher, args=(i * N,))
+               for i in range(NT)]
+    poppers = [threading.Thread(target=popper, args=(popped[i],))
+               for i in range(NT)]
+    for t in pushers + poppers:
+        t.start()
+    for t in pushers + poppers:
+        t.join(30)
+        assert not t.is_alive()
+    tags = sorted([t.tag for t in spilled] +
+                  [t.tag for out in popped for t in out])
+    assert tags == list(range(NT * N))
+
+
+@pytest.mark.parametrize("cls", [hb_mod.MaxHeap, hb_mod.PyMaxHeap])
+def test_maxheap_parity(cls):
+    h = cls()
+    for i, p in enumerate((4, 9, 2, 9, 1)):
+        h.insert(_Prio(p, tag=i), priority=p)
+    assert h.pop_max().priority == 9
+    assert h.pop_max().priority == 9
+    stolen = h.split()
+    assert len(stolen) + len(h) == 3
+    remaining = []
+    for heap in (h, stolen):
+        while True:
+            t = heap.pop_max()
+            if t is None:
+                break
+            remaining.append(t.priority)
+    assert sorted(remaining) == [1, 2, 4]
+
+
+def test_native_hbbuffer_active():
+    if native_available:
+        assert hb_mod.HBBuffer.__module__ == "_parsec_native"
+        assert hb_mod.MaxHeap.__module__ == "_parsec_native"
