@@ -91,6 +91,11 @@ class MaxHeap:
     def split(self) -> "MaxHeap":
         """Steal roughly half the heap (heap-split stealing)."""
         out = type(self)()
+        # share the tie-break counter: stolen entries keep their seq, so a
+        # fresh counter would collide with them (TypeError on heapq tuple
+        # comparison) and break FIFO-within-priority; the native split
+        # does the same by continuing from self->seq
+        out._ctr = self._ctr
         with self._lock:
             half = len(self._h) // 2
             if half:

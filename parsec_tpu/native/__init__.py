@@ -19,12 +19,16 @@ available = False
 
 if os.environ.get("PARSEC_TPU_NATIVE", "1") != "0":
     try:
+        # build() is mtime-cached: it recompiles only when _native.cpp is
+        # newer than the .so. Running it BEFORE the import means a stale
+        # prebuilt extension from an older checkout is refreshed rather
+        # than silently loaded without the newer types.
         try:
-            native = importlib.import_module("parsec_tpu.native._parsec_native")
-        except ImportError:
             from . import build as _build
             _build.build()
-            native = importlib.import_module("parsec_tpu.native._parsec_native")
+        except Exception:
+            pass  # no toolchain: fall through to importing a prebuilt .so
+        native = importlib.import_module("parsec_tpu.native._parsec_native")
         available = True
     except Exception as exc:  # pragma: no cover - toolchain-dependent
         print(f"parsec_tpu: native core unavailable ({exc}); "
