@@ -51,7 +51,7 @@ def main() -> None:
         # dominant); make_spd's Gram-matrix form is O(N^3) on the host
         # and would dominate wall time at large N
         rng0 = np.random.RandomState(0)
-        B = rng0.rand(n, n).astype(np.float64) - 0.5
+        B = rng0.rand(n, n) - 0.5
         M = ((B + B.T) / 2 + n * np.eye(n)).astype(dtype)
         tpu_devs = [d for d in ctx.devices if d.device_type == "tpu"]
         best = None

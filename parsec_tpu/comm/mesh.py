@@ -1,0 +1,110 @@
+"""MeshFabric: the device-mesh transport — data plane over ICI.
+
+Reference behavior being replaced: the funnelled-MPI comm engine moves
+tile payloads host-to-host with Isend/Irecv on negotiated tags
+(parsec/parsec_mpi_funnelled.c:245-365). TPU-native re-design per
+SURVEY.md §5.8: the *data plane* is device-to-device transfers between
+the ranks' chips — ``jax.device_put`` onto the consumer's device, which
+PJRT routes over ICI on a real slice — while the small, latency-bound
+*control plane* (activations, GET requests) travels host-side (the
+in-process queues here; gRPC/DCN in a multi-host deployment). Tile
+payloads therefore never round-trip through host memory on the data
+path.
+
+Each rank of the SPMD run is pinned to one ``jax.Device`` of a mesh.
+Registered memory handles may hold device arrays; a GET is served by
+transferring the producer's device buffer directly onto the requester's
+device. On CI this runs over the 8-virtual-device CPU mesh; the
+transfer calls are identical on TPU hardware.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+from .engine import TAG_GET_DATA, TAG_GET_REQ, TAG_PUT_DATA
+from .local import LocalCommEngine, LocalFabric
+
+
+def _devices(n: Optional[int] = None) -> List[Any]:
+    import jax
+    devs = jax.devices()
+    if n is not None:
+        if len(devs) < n:
+            raise RuntimeError(
+                f"mesh fabric needs {n} devices, jax has {len(devs)}")
+        devs = devs[:n]
+    return devs
+
+
+class MeshFabric(LocalFabric):
+    """One rank per mesh device; control messages in-process, payloads
+    moved device-to-device."""
+
+    def __init__(self, nb_ranks: Optional[int] = None,
+                 devices: Optional[List[Any]] = None) -> None:
+        devices = list(devices) if devices is not None else _devices(nb_ranks)
+        super().__init__(len(devices))
+        self.devices = devices
+        self.d2d_transfers = 0
+        self.d2d_bytes = 0
+
+    def engine(self, rank: int) -> "MeshCommEngine":
+        eng = MeshCommEngine(self, rank)
+        self.engines[rank] = eng
+        return eng
+
+    def _count_d2d(self, nbytes: int) -> None:
+        with self._stat_lock:
+            self.d2d_transfers += 1
+            self.d2d_bytes += nbytes
+
+
+class MeshCommEngine(LocalCommEngine):
+    """GET/PUT data rides the mesh interconnect; AMs stay host-side."""
+
+    fabric: MeshFabric
+
+    @property
+    def device(self) -> Any:
+        return self.fabric.devices[self.rank]
+
+    def _to_device_of(self, rank: int, array: Any) -> Any:
+        """Move a payload onto ``rank``'s device (ICI D2D on hardware;
+        numpy sources are an H2D staging upload)."""
+        import jax
+        out = jax.device_put(array, self.fabric.devices[rank])
+        self.fabric._count_d2d(getattr(out, "nbytes", 0))
+        return out
+
+    # -- GET: serve by pushing the buffer onto the requester's device ----
+    def _on_get_req(self, src: int, payload: Any) -> None:
+        h = self._mem.get(payload["handle"])
+        assert h is not None, f"GET for unknown mem handle {payload['handle']}"
+        data = self._to_device_of(payload["requester"], h.array)
+        self.send_am(payload["requester"], TAG_GET_DATA,
+                     {"token": payload["token"], "data": data,
+                      "meta": h.meta})
+        if self.on_get_served is not None:
+            self.on_get_served(payload["handle"])
+
+    # -- PUT: transfer first, land in the registered region on arrival --
+    def put(self, dst_rank: int, remote_handle_id: int, array: Any,
+            on_complete: Optional[Callable] = None) -> None:
+        data = self._to_device_of(dst_rank, array)
+        self.send_am(dst_rank, TAG_PUT_DATA,
+                     {"handle": remote_handle_id, "data": data})
+        if on_complete is not None:
+            on_complete(array)
+
+    def _on_put_data(self, src: int, payload: Any) -> None:
+        h = self._mem.get(payload["handle"])
+        assert h is not None, f"PUT for unknown mem handle {payload['handle']}"
+        if isinstance(h.array, np.ndarray):
+            np.copyto(h.array, np.asarray(payload["data"]))
+        else:
+            # device-resident region: rebind to the arrived buffer (jax
+            # arrays are immutable; the handle is the indirection layer)
+            h.array = payload["data"]

@@ -187,6 +187,23 @@ class Data(Obj):
                     c.coherency = Coherency.INVALID
 
     # -- host-side helpers shared by the DSLs -------------------------------
+    @staticmethod
+    def materialize_host(copy: "DataCopy") -> Any:
+        """Ensure ``copy.payload`` is a writable host ndarray and return it.
+
+        A host (device-0) copy can transiently hold an immutable device
+        array — e.g. a payload that arrived over the mesh transport's
+        device-to-device data plane (comm/mesh.py). Host task bodies
+        mutate payloads in place, so the first host consumer materializes
+        a writable numpy buffer here; device consumers keep the zero-copy
+        device array."""
+        import numpy as _np
+        p = copy.payload
+        if p is not None and not (isinstance(p, _np.ndarray)
+                                  and p.flags.writeable):
+            copy.payload = _np.array(p)
+        return copy.payload
+
     def host_copy(self) -> DataCopy:
         """The device-0 copy, attached on demand."""
         with self._lock:

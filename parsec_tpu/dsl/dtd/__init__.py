@@ -139,7 +139,18 @@ class _Param:
 
 
 def _dtd_cpu_hook(es, task: Task) -> HookReturn:
-    """Run the user body; host copies were resolved by prepare_input."""
+    """Run the user body; host copies were resolved by prepare_input.
+
+    Materialization happens here (not in prepare_input) so the
+    device-chore fallback path is covered too: when an accelerator hook
+    returns NEXT and the task lands on this host incarnation, payloads
+    that arrived as immutable device arrays (mesh transport data plane,
+    or a device-resident newest copy) become writable ndarrays before
+    the body runs."""
+    for p in task.user or ():
+        if p is not None and getattr(p, "tile", None) is not None:
+            host = p.tile.data.sync_to_host(es.context.devices)
+            Data.materialize_host(host)
     fn = task.task_class.user_body
     rc = fn(es, task)
     return HookReturn.DONE if rc is None else rc

@@ -364,10 +364,10 @@ class PTGTaskClass(TaskClass):
                 if copy.data is not None:
                     # host execution needs the newest version on device 0
                     host = self.tp.pull_newest_to_host(es, copy.data)
-                    payloads[f.name] = host.payload
+                    payloads[f.name] = Data.materialize_host(host)
                     task.data[i].data_in = host
                 else:
-                    payloads[f.name] = copy.payload
+                    payloads[f.name] = Data.materialize_host(copy)
             env = self._body_env(task, payloads)
             exec(code, env)
             for i, f in enumerate(self.ast.flows):

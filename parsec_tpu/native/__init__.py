@@ -26,8 +26,12 @@ if os.environ.get("PARSEC_TPU_NATIVE", "1") != "0":
         try:
             from . import build as _build
             _build.build()
-        except Exception:
-            pass  # no toolchain: fall through to importing a prebuilt .so
+        except Exception as build_exc:
+            # fall through to importing a prebuilt .so, but say why the
+            # rebuild failed: silently loading a stale extension hides
+            # compile errors from native development
+            print(f"parsec_tpu: native rebuild failed ({build_exc}); "
+                  "importing prebuilt extension", file=sys.stderr)
         native = importlib.import_module("parsec_tpu.native._parsec_native")
         available = True
     except Exception as exc:  # pragma: no cover - toolchain-dependent
