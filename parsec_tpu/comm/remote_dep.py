@@ -75,6 +75,11 @@ class RemoteDepEngine:
         self._dtd_expect: Dict[Tuple, Callable] = {}
         # rendezvous bookkeeping: handle_id -> (taskpool, remaining, handle)
         self._pending_handles: Dict[int, Tuple] = {}
+        # activations that raced ahead of our local taskpool registration
+        # (a faster rank can start pool N+1 while we are still in pool
+        # N's wait; the reference holds such activations until the
+        # taskpool is attached): wire_id -> [(src, msg), ...]
+        self._early_activations: Dict[int, List[Tuple[int, Dict]]] = {}
         ce.tag_register(TAG_ACTIVATE, self._on_activate)
         ce.tag_register(TAG_DTD_DATA, self._on_dtd_data)
         ce.tag_register(TAG_TERMDET, self._on_termdet)
@@ -98,8 +103,11 @@ class RemoteDepEngine:
             wire_id = len(self._taskpools)
             self._taskpools[wire_id] = tp
             tp.comm_tp_id = wire_id
+            early = self._early_activations.pop(wire_id, [])
         if hasattr(tp, "comm"):
             tp.comm = self
+        for src, msg in early:
+            self._on_activate(src, msg)
 
     def progress(self, es) -> int:
         return self.ce.progress()
@@ -156,8 +164,14 @@ class RemoteDepEngine:
 
     def _on_activate(self, src: int, msg: Dict) -> None:
         self.stats["activates_recv"] += 1
-        tp = self._taskpools.get(msg["tp_id"])
-        assert tp is not None, f"activate for unknown taskpool {msg['tp_id']}"
+        with self._lock:
+            tp = self._taskpools.get(msg["tp_id"])
+            if tp is None:
+                # raced ahead of our registration: hold until the SPMD
+                # program reaches this taskpool locally
+                self._early_activations.setdefault(
+                    msg["tp_id"], []).append((src, msg))
+                return
         # re-forward to my children in the bcast tree
         positions = [msg["root"]] + list(msg["ranks"])
         me_pos = positions.index(self.rank)

@@ -1,7 +1,8 @@
 """Tile kernels (XLA/Pallas executables for task BODYs) and tile
 algorithms (dpotrf, dgeqrf, dgetrf_nopiv, pdgemm)."""
-from .linalg import (axpy, gemm, gemm_nn, gemm_nn_sub, gemm_nt, geqrt,
-                     geqrt_r, getrf_nopiv, potrf, scal, syrk_ln, transpose,
+from .linalg import (axpy, gemm, gemm_nn, gemm_nn_sub, gemm_nt,
+                     gemm_tn_sub, geqrt, geqrt_r, getrf_nopiv, potrf, scal,
+                     syrk_ln, transpose, trsm_lower, trsm_lower_trans,
                      trsm_lower_unit, trsm_panel, trsm_upper_right, tsmqr,
                      tsqrt, tsqrt_r, unmqr)
 from . import dpotrf as dpotrf_module
@@ -10,6 +11,7 @@ from .dgeqrf import dgeqrf, dgeqrf_factory, dgeqrf_taskpool
 from .dgetrf import (dgetrf_factory, dgetrf_nopiv, dgetrf_nopiv_taskpool,
                      make_diag_dominant)
 from .pdgemm import pdgemm, pdgemm_factory, pdgemm_taskpool
+from .dtrsm import (dposv, dtrsm_lower_taskpool, dtrsm_lower_trans_taskpool)
 
 try:  # pallas.tpu is optional at import time (older/partial jax builds)
     from . import pallas_kernels
@@ -27,4 +29,6 @@ __all__ = ["potrf", "trsm_panel", "syrk_ln", "gemm_nt", "gemm_nn",
            "dgetrf_nopiv", "dgetrf_nopiv_taskpool", "dgetrf_factory",
            "make_diag_dominant",
            "pdgemm", "pdgemm_factory", "pdgemm_taskpool",
+           "dposv", "dtrsm_lower_taskpool", "dtrsm_lower_trans_taskpool",
+           "trsm_lower", "trsm_lower_trans", "gemm_tn_sub",
            "pallas_kernels", "flash_attention"]

@@ -155,6 +155,24 @@ def trsm_lower_unit(t: Any, c: Any) -> Any:
 
 
 @jax.jit
+def trsm_lower(t: Any, c: Any) -> Any:
+    """C <- L^{-1} C, L = (non-unit) lower of T (forward substitution)."""
+    return _solve_tri(t, c, lower=True)
+
+
+@jax.jit
+def trsm_lower_trans(t: Any, c: Any) -> Any:
+    """C <- L^{-T} C, L = lower of T (backward substitution)."""
+    return _solve_tri(t, c, lower=True, trans="T")
+
+
+@jax.jit
+def gemm_tn_sub(c: Any, a: Any, b: Any) -> Any:
+    """C <- C - A^T B (backward-substitution update)."""
+    return c - jnp.dot(a.T, b, preferred_element_type=jnp.float32)
+
+
+@jax.jit
 def trsm_upper_right(t: Any, c: Any) -> Any:
     """Column-panel update for LU: C <- C U^{-1}, U = upper of T
     (solved as U^T X^T = C^T)."""

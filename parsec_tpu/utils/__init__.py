@@ -1,1 +1,2 @@
 """utils subpackage."""
+from . import checkpoint

@@ -1,0 +1,98 @@
+"""Collection checkpoint/resume.
+
+The reference has NO runtime-level checkpointing (SURVEY.md §5.4 —
+"absent"; apps re-run from user data, with parsec_dtd_data_flush as the
+only return-data-to-home building block). This module is the TPU-native
+answer the survey calls for: since all application state lives in data
+collections (tiles), a checkpoint is a consistent snapshot of a
+collection's local tiles taken between taskpools (when no DAG is in
+flight), and resume rebuilds the collection tile-by-tile. SPMD: each
+rank writes only the tiles it owns; a restore on R ranks reads each
+rank's own shard file set.
+
+Format: one ``.npz`` per (collection, rank) holding tile arrays keyed
+``t<m>_<n>`` plus a JSON-encoded manifest (geometry, dtype, distribution
+parameters) used to validate compatibility at restore time.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+def _manifest_of(coll: Any) -> Dict[str, Any]:
+    man = {"lm": coll.lm, "ln": coll.ln, "mb": coll.mb, "nb": coll.nb,
+           "dtype": np.dtype(coll.dtype).name,
+           "kind": type(coll).__name__}
+    for attr in ("P", "Q", "krows", "kcols", "uplo"):
+        if hasattr(coll, attr):
+            man[attr] = getattr(coll, attr)
+    return man
+
+
+def checkpoint_path(prefix: str, rank: int) -> str:
+    return f"{prefix}.rank{rank}.npz"
+
+
+def save_collection(coll: Any, prefix: str, context: Optional[Any] = None) -> str:
+    """Write this rank's local tiles. Call between taskpools (quiescent
+    point); device-resident newest copies are pulled back first."""
+    tiles: Dict[str, Any] = {}
+    for (m, n) in coll.local_tiles():
+        copy = coll.data_of(m, n).sync_to_host(
+            context.devices if context is not None else None)
+        if copy.payload is not None:
+            tiles[f"t{m}_{n}"] = np.asarray(copy.payload)
+    path = checkpoint_path(prefix, coll.rank)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    np.savez(path, __manifest__=json.dumps(_manifest_of(coll)), **tiles)
+    return path
+
+
+def restore_collection(coll: Any, prefix: str) -> int:
+    """Load this rank's tiles back into ``coll``; returns #tiles restored.
+    Geometry must match the manifest (same tiling and dtype)."""
+    path = checkpoint_path(prefix, coll.rank)
+    with np.load(path, allow_pickle=False) as z:
+        man = json.loads(str(z["__manifest__"]))
+        ours = _manifest_of(coll)
+        # geometry AND distribution must match: a rank file holds only
+        # the tiles the saving rank owned, so restoring under a
+        # different kind/grid would silently leave foreign tiles empty
+        for key in ("lm", "ln", "mb", "nb", "dtype", "kind", "P", "Q",
+                    "krows", "kcols", "uplo"):
+            if man.get(key) != ours.get(key):
+                raise ValueError(
+                    f"checkpoint {path} is incompatible: {key} "
+                    f"{man.get(key)!r} != {ours.get(key)!r}")
+        n = 0
+        for name in z.files:
+            if not name.startswith("t"):
+                continue
+            m_, n_ = (int(x) for x in name[1:].split("_"))
+            coll.set_tile(m_, n_, z[name])
+            n += 1
+    return n
+
+
+def arrays_path(prefix: str, rank: int) -> str:
+    """Namespaced separately from collection shards so the two can share
+    one prefix without clobbering each other."""
+    return f"{prefix}.arrays.rank{rank}.npz"
+
+
+def save_arrays(prefix: str, rank: int = 0, **arrays: Any) -> str:
+    """Checkpoint loose named arrays (e.g. model/optimizer state from
+    parallel/ training) alongside collections."""
+    path = arrays_path(prefix, rank)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    np.savez(path, **{k: np.asarray(v) for k, v in arrays.items()})
+    return path
+
+
+def load_arrays(prefix: str, rank: int = 0) -> Dict[str, np.ndarray]:
+    with np.load(arrays_path(prefix, rank), allow_pickle=False) as z:
+        return {k: z[k] for k in z.files}

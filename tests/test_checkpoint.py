@@ -1,0 +1,102 @@
+"""Checkpoint/resume of collections (SURVEY.md §5.4 — absent in the
+reference; here: quiescent-point tile snapshots per rank).
+"""
+import numpy as np
+import pytest
+
+import parsec_tpu
+from conftest import spmd
+from parsec_tpu.collections import TwoDimBlockCyclic
+from parsec_tpu.utils import checkpoint as ckpt
+
+
+def test_roundtrip_single_rank(tmp_path):
+    rng = np.random.RandomState(0)
+    M = rng.rand(96, 96).astype(np.float32)
+    A = TwoDimBlockCyclic(96, 96, 32, 32, dtype=np.float32).from_numpy(M)
+    prefix = str(tmp_path / "ck")
+    path = ckpt.save_collection(A, prefix)
+    B = TwoDimBlockCyclic(96, 96, 32, 32, dtype=np.float32)
+    n = ckpt.restore_collection(B, prefix)
+    assert n == 9
+    np.testing.assert_array_equal(B.to_numpy(), M)
+    assert path.endswith(".rank0.npz")
+
+
+def test_restore_rejects_incompatible_geometry(tmp_path):
+    A = TwoDimBlockCyclic(64, 64, 32, 32).from_numpy(
+        np.ones((64, 64), np.float32))
+    prefix = str(tmp_path / "ck")
+    ckpt.save_collection(A, prefix)
+    wrong = TwoDimBlockCyclic(64, 64, 16, 16)
+    with pytest.raises(ValueError, match="incompatible"):
+        ckpt.restore_collection(wrong, prefix)
+
+
+def test_checkpoint_resume_mid_computation(ctx, tmp_path):
+    """Factor, checkpoint at the quiescent point, clobber, restore, and
+    continue with a solve — the resume path a failed run would take."""
+    from parsec_tpu.ops import (dpotrf_taskpool, dtrsm_lower_taskpool,
+                                dtrsm_lower_trans_taskpool, make_spd)
+    n, nb = 96, 32
+    M = make_spd(n)
+    rng = np.random.RandomState(1)
+    Bm = (rng.rand(n, 16) - 0.5).astype(np.float32)
+    A = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float32).from_numpy(M)
+    ctx.add_taskpool(dpotrf_taskpool(A))
+    ctx.wait()
+    prefix = str(tmp_path / "factored")
+    ckpt.save_collection(A, prefix, context=ctx)
+
+    # "restart": fresh collection restored from the checkpoint
+    A2 = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float32)
+    assert ckpt.restore_collection(A2, prefix) == 9
+    B = TwoDimBlockCyclic(n, 16, nb, nb, dtype=np.float32).from_numpy(Bm)
+    ctx.add_taskpool(dtrsm_lower_taskpool(A2, B))
+    ctx.wait()
+    ctx.add_taskpool(dtrsm_lower_trans_taskpool(A2, B))
+    ctx.wait()
+    ref = np.linalg.solve(M.astype(np.float64), Bm.astype(np.float64))
+    np.testing.assert_allclose(B.to_numpy(), ref, atol=5e-3)
+
+
+def test_spmd_per_rank_shards(tmp_path):
+    """Each rank writes only its own tiles; restore on the same grid
+    reads them back rank-locally."""
+    nb_ranks, n, nb = 4, 128, 32
+    rng = np.random.RandomState(2)
+    M = rng.rand(n, n).astype(np.float32)
+    prefix = str(tmp_path / "shards")
+
+    def save_rank(rank, fabric):
+        d = TwoDimBlockCyclic(n, n, nb, nb, P=2, Q=2, nodes=nb_ranks,
+                              rank=rank, dtype=np.float32)
+        for (i, j) in d.local_tiles():
+            np.copyto(d.tile(i, j),
+                      M[i * nb:(i + 1) * nb, j * nb:(j + 1) * nb])
+        return ckpt.save_collection(d, prefix)
+
+    paths, _ = spmd(nb_ranks, save_rank)
+    assert len(set(paths)) == nb_ranks
+
+    def restore_rank(rank, fabric):
+        d = TwoDimBlockCyclic(n, n, nb, nb, P=2, Q=2, nodes=nb_ranks,
+                              rank=rank, dtype=np.float32)
+        count = ckpt.restore_collection(d, prefix)
+        ok = all(np.array_equal(
+            d.tile(i, j), M[i * nb:(i + 1) * nb, j * nb:(j + 1) * nb])
+            for (i, j) in d.local_tiles())
+        return count, ok
+
+    results, _ = spmd(nb_ranks, restore_rank)
+    assert sum(c for c, _ in results) == 16
+    assert all(ok for _, ok in results)
+
+
+def test_loose_array_roundtrip(tmp_path):
+    prefix = str(tmp_path / "state")
+    ckpt.save_arrays(prefix, step=np.int64(7),
+                     w=np.arange(6.0).reshape(2, 3))
+    back = ckpt.load_arrays(prefix)
+    assert back["step"] == 7
+    np.testing.assert_array_equal(back["w"], np.arange(6.0).reshape(2, 3))
