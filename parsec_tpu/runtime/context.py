@@ -69,6 +69,12 @@ class Context:
         self._dot_prefix = params.get("profiling_dot") or None
         if self._dot_prefix:
             grapher.enable()
+        # debug history ring (ref: PARSEC_DEBUG_HISTORY, debug_marks.c)
+        hist_size = params.get("debug_history_size")
+        self._debug_history_on = bool(hist_size)
+        if self._debug_history_on:
+            from ..utils import debug_history
+            debug_history.enable(int(hist_size))
 
         # virtual processes + execution streams
         self.vps: List[VirtualProcess] = []
@@ -198,6 +204,12 @@ class Context:
         """A task body raised: abort the DAG and surface on the waiter."""
         plog.warning("task %s raised: %r",
                      task.snprintf() if task is not None else "<progress>", exc)
+        from ..utils import debug_history
+        if debug_history.enabled():
+            debug_history.history.mark(
+                "TASK_ERROR", f"{task.snprintf() if task else '<progress>'}: "
+                              f"{exc!r}")
+            plog.warning("%s", debug_history.history.dump(limit=64))
         self._task_errors.append(exc)
         self.wake_workers(self.nb_cores)
 
@@ -308,6 +320,10 @@ class Context:
             # unhook from the global PINS sites: a later context's events
             # must not leak into this finalized profile
             self._task_profiler.disable()
+        if self._debug_history_on:
+            from ..utils import debug_history
+            debug_history.disable()  # refcounted across live contexts
+            self._debug_history_on = False
         if self.profile is not None and self._prof_prefix:
             self.sample_sde_counters()
             path = self.profile.dump(self._prof_prefix)

@@ -192,3 +192,77 @@ def test_ptg_to_dtd_replay_dpotrf(ctx):
     ptg_to_dtd(dpotrf_taskpool(A), ctx)
     L = np.tril(A.to_numpy())
     np.testing.assert_allclose(L @ L.T, M, atol=5e-4)
+
+
+# --------------------------------------------------------------------- #
+# debug history ring (ref: PARSEC_DEBUG_HISTORY, debug_marks.c, §5.2)   #
+# --------------------------------------------------------------------- #
+def test_debug_history_ring_wraps():
+    from parsec_tpu.utils import debug_history as dh
+    ring = dh.DebugHistory(capacity=4)
+    for i in range(7):
+        ring.mark("M", i)
+    ents = ring.entries()
+    assert len(ents) == 4
+    assert [e[3] for e in ents] == [3, 4, 5, 6]  # oldest dropped, order kept
+    assert "newest last" in ring.dump()
+    assert len(ring) == 4
+
+
+def test_debug_history_records_transitions(ctx):
+    from parsec_tpu import dtd
+    from parsec_tpu.utils import debug_history as dh
+    dh.enable(256)
+    try:
+        tp = dtd.taskpool_new()
+        ctx.add_taskpool(tp)
+        for _ in range(3):
+            tp.insert_task(lambda es, task: None)
+        tp.wait()
+        names = {e[2] for e in dh.history.entries()}
+        assert "EXEC_BEGIN" in names and "COMPLETE_EXEC_END" in names
+    finally:
+        dh.disable()
+    assert not dh.enabled()
+
+
+def test_debug_history_dumped_on_task_error(capsys):
+    import parsec_tpu
+    from parsec_tpu import dtd
+    from parsec_tpu.utils import debug_history as dh
+    parsec_tpu.params.reset()
+    parsec_tpu.params.set_cmdline("debug_history_size", "128")
+    try:
+        c = parsec_tpu.Context(nb_cores=1, enable_tpu=False)
+        try:
+            tp = dtd.taskpool_new()
+            c.add_taskpool(tp)
+
+            def boom(es, task):
+                raise ValueError("intentional")
+
+            tp.insert_task(boom)
+            with pytest.raises(RuntimeError):
+                tp.wait()
+        finally:
+            c.fini()
+        err = capsys.readouterr().err
+        assert "debug history" in err and "TASK_ERROR" in err
+    finally:
+        dh.disable()
+        parsec_tpu.params.reset()
+
+
+def test_debug_history_unhooked_at_fini():
+    """A fini'd context must not leave the global PINS feed enabled."""
+    import parsec_tpu
+    from parsec_tpu.utils import debug_history as dh
+    from parsec_tpu.profiling.pins import pins_is_active
+    parsec_tpu.params.reset()
+    parsec_tpu.params.set_cmdline("debug_history_size", "64")
+    c = parsec_tpu.Context(nb_cores=1, enable_tpu=False)
+    parsec_tpu.params.reset()
+    assert dh.enabled()
+    c.fini()
+    assert not dh.enabled()
+    assert not pins_is_active()
