@@ -1,0 +1,61 @@
+"""Ex08: Cholesky solve + checkpoint/resume (beyond the reference's
+Ex00-Ex07 series: the DPLASMA-slice solver composed from three PTG
+taskpools, with a quiescent-point checkpoint between factorization and
+solve — the workflow a restartable application uses).
+
+Run: python examples/ex08_dposv_checkpoint.py
+"""
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import parsec_tpu  # noqa: E402
+from parsec_tpu.collections import TwoDimBlockCyclic  # noqa: E402
+from parsec_tpu.ops import (dpotrf_taskpool, dtrsm_lower_taskpool,  # noqa: E402
+                            dtrsm_lower_trans_taskpool, make_spd)
+from parsec_tpu.utils import checkpoint as ckpt  # noqa: E402
+
+
+def main(n: int = 256, nb: int = 64, nrhs: int = 32) -> int:
+    ctx = parsec_tpu.init(nb_cores=2)
+    try:
+        M = make_spd(n)
+        rng = np.random.RandomState(0)
+        Bm = (rng.rand(n, nrhs) - 0.5).astype(np.float32)
+
+        # factor A = L L^T (PTG dpotrf, bodies on the TPU when attached)
+        A = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float32).from_numpy(M)
+        ctx.add_taskpool(dpotrf_taskpool(A))
+        ctx.wait()
+
+        # checkpoint the factor at the quiescent point ...
+        with tempfile.TemporaryDirectory() as d:
+            prefix = os.path.join(d, "factor")
+            ckpt.save_collection(A, prefix, context=ctx)
+            # ... simulate a restart: a fresh collection, restored
+            A2 = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float32)
+            restored = ckpt.restore_collection(A2, prefix)
+            print(f"restored {restored} tiles from {prefix}.rank0.npz")
+
+        # solve L (L^T X) = B with the restored factor
+        B = TwoDimBlockCyclic(n, nrhs, nb, nb, dtype=np.float32).from_numpy(Bm)
+        ctx.add_taskpool(dtrsm_lower_taskpool(A2, B))
+        ctx.wait()
+        ctx.add_taskpool(dtrsm_lower_trans_taskpool(A2, B))
+        ctx.wait()
+
+        ref = np.linalg.solve(M.astype(np.float64), Bm.astype(np.float64))
+        err = float(np.abs(B.to_numpy() - ref).max())
+        print(f"dposv n={n} nrhs={nrhs}: max |X - X_ref| = {err:.2e}")
+        assert err < 5e-3
+        return 0
+    finally:
+        ctx.fini()
+
+
+if __name__ == "__main__":
+    main()

@@ -15,6 +15,7 @@ import pytest
     "examples.ex05_broadcast",
     "examples.ex06_raw",
     "examples.ex07_raw_ctl",
+    "examples.ex08_dposv_checkpoint",
 ])
 def test_example_runs(mod):
     m = importlib.import_module(mod)
