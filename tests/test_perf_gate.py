@@ -1,0 +1,72 @@
+"""DTD tile-GEMM with a sustained-rate watchdog gate
+(ref: tests/dsl/dtd/dtd_test_simple_gemm.c:651-670 — the test computes a
+deadline from an expected GFLOP/s floor and alarm()s if execution
+exceeds it; SURVEY.md §4 "Performance gating" calls this the pattern to
+reuse for TPU CI).
+
+The gate is opt-in: set PARSEC_TEST_MIN_GFLOPS to a floor (e.g. "5" on a
+CPU runner, "5000" on a TPU chip) to turn the timing assertion on; by
+default only correctness is checked, so the suite stays robust on
+arbitrary shared CI machines. The measured rate prints either way, like
+the reference's DTD_GEMM report line.
+"""
+import os
+import time
+
+import numpy as np
+
+import parsec_tpu
+from parsec_tpu import dtd
+from parsec_tpu.dsl.dtd import INOUT, INPUT, unpack_args
+
+
+def test_dtd_simple_gemm_rate(ctx4):
+    mt = nt = kt = 3
+    nb = 64
+    rng = np.random.RandomState(0)
+    A = [[rng.rand(nb, nb).astype(np.float32) for _ in range(kt)]
+         for _ in range(mt)]
+    B = [[rng.rand(nb, nb).astype(np.float32) for _ in range(nt)]
+         for _ in range(kt)]
+    C = [[np.zeros((nb, nb), np.float32) for _ in range(nt)]
+         for _ in range(mt)]
+
+    tp = dtd.taskpool_new()
+    ctx4.add_taskpool(tp)
+    ta = [[tp.tile_of_array(A[m][k]) for k in range(kt)] for m in range(mt)]
+    tb = [[tp.tile_of_array(B[k][n]) for n in range(nt)] for k in range(kt)]
+    tc = [[tp.tile_of_array(C[m][n]) for n in range(nt)] for m in range(mt)]
+
+    def gemm_body(es, task):
+        c, a, b = unpack_args(task)
+        c += a @ b
+
+    t0 = time.perf_counter()
+    for m in range(mt):
+        for n in range(nt):
+            for k in range(kt):
+                tp.insert_task(gemm_body, (tc[m][n], INOUT),
+                               (ta[m][k], INPUT), (tb[k][n], INPUT))
+    tp.data_flush_all()
+    tp.wait()
+    dt = time.perf_counter() - t0
+
+    flops = 2.0 * mt * nt * kt * nb ** 3
+    gflops = flops / dt / 1e9
+    print(f"DTD_GEMM {mt}x{nt}x{kt} nb={nb}: {gflops:.2f} gflops "
+          f"({dt * 1e3:.1f} ms)")
+
+    # correctness always gates
+    for m in range(mt):
+        for n in range(nt):
+            ref = sum(A[m][k].astype(np.float64) @ B[k][n]
+                      for k in range(kt))
+            got = np.asarray(tc[m][n].data.get_copy(0).payload)
+            np.testing.assert_allclose(got, ref, atol=1e-3)
+
+    # rate gates only when the runner declares its floor (the reference
+    # takes min_perf on the command line the same way)
+    floor = os.environ.get("PARSEC_TEST_MIN_GFLOPS")
+    if floor:
+        assert gflops >= float(floor), \
+            f"sustained {gflops:.2f} gflops below the {floor} floor"

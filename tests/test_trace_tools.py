@@ -181,3 +181,63 @@ def test_context_fini_writes_both_formats(tmp_path, monkeypatch):
     assert json_files and ptt_files
     back = read_profile(str(tmp_path / ptt_files[0]))
     assert back.nb_events() > 0
+
+
+# --------------------------------------------------------------------- #
+# ptgpp CLI (ref: parsec_ptgpp build-time compiler, main.c:46-78)       #
+# --------------------------------------------------------------------- #
+SMALL_JDF = """
+descA [ type="collection" ]
+NT [ type="int" ]
+
+STEP(k)
+k = 0 .. NT-1
+: descA( 0, 0 )
+RW A <- (k == 0) ? descA( 0, 0 ) : A STEP( k-1 )
+     -> (k < NT-1) ? A STEP( k+1 )
+     -> (k == NT-1) ? descA( 0, 0 )
+BODY
+{
+    A = A + 1.0
+}
+END
+"""
+
+
+def test_ptgpp_check_and_generate(tmp_path, capsys):
+    import importlib.util
+
+    import ptgpp
+
+    src = tmp_path / "stepper.jdf"
+    src.write_text(SMALL_JDF)
+    # validate-only
+    assert ptgpp.main(["--check", str(src)]) == 0
+    assert "1 task classes" in capsys.readouterr().out
+
+    out = tmp_path / "stepper_gen.py"
+    assert ptgpp.main([str(src), "-o", str(out)]) == 0
+    spec = importlib.util.spec_from_file_location("stepper_gen", out)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert "STEP(k)" in mod.__doc__
+
+    import parsec_tpu
+    from parsec_tpu.collections import TwoDimBlockCyclic
+    A = TwoDimBlockCyclic(4, 4, 4, 4).from_numpy(np.zeros((4, 4), np.float32))
+    ctx = parsec_tpu.Context(nb_cores=1, enable_tpu=False)
+    try:
+        tp = mod.stepper_new(descA=A, NT=5)
+        ctx.add_taskpool(tp)
+        ctx.wait()
+    finally:
+        ctx.fini()
+    np.testing.assert_allclose(A.to_numpy(), 5.0)
+
+
+def test_ptgpp_rejects_bad_jdf(tmp_path, capsys):
+    import ptgpp
+    bad = tmp_path / "bad.jdf"
+    bad.write_text("STEP(k)\nk = 0 .. 3\n: nowhere( k )\nBODY\n{\n pass\n}\nEND\n")
+    assert ptgpp.main(["--check", str(bad)]) == 1
+    assert "bad.jdf" in capsys.readouterr().err
