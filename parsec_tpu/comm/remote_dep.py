@@ -233,9 +233,26 @@ class RemoteDepEngine:
     # ------------------------------------------------------------------ #
     def dtd_send(self, tp, tile_key: Any, seq: int, dst: int,
                  arr: np.ndarray) -> None:
-        self.ce.send_am(dst, TAG_DTD_DATA,
-                        {"tp_id": tp.comm_tp_id, "tile": tile_key,
-                         "seq": seq, "data": arr})
+        """Small payloads ride inline in the AM; larger ones go through
+        the same GET rendezvous as PTG edges (short proto vs rendezvous,
+        ref: remote_dep_mpi.c:244-252) — which on the mesh transport is
+        the device-to-device data plane."""
+        msg = {"tp_id": tp.comm_tp_id, "tile": tile_key, "seq": seq}
+        nbytes = getattr(arr, "nbytes", 0)
+        if nbytes <= self.short_limit:
+            msg["data"] = arr
+        else:
+            # snapshot mutable host buffers (a later local task may write
+            # in place before the GET is served); immutable device arrays
+            # register as-is so the transfer stays on the data plane
+            snap = np.array(arr) if isinstance(arr, np.ndarray) else arr
+            handle = self.ce.mem_register(snap)
+            tp.add_pending_action(1)
+            with self._lock:
+                self._pending_handles[handle.handle_id] = (tp, 1, handle)
+            msg["handle"] = handle.handle_id
+            msg["data_rank"] = self.rank
+        self.ce.send_am(dst, TAG_DTD_DATA, msg)
         self.stats["dtd_sends"] += 1
 
     def dtd_expect(self, tp, tile_key: Any, seq: int,
@@ -256,12 +273,20 @@ class RemoteDepEngine:
     def _on_dtd_data(self, src: int, msg: Dict) -> None:
         self.stats["dtd_recvs"] += 1
         key = (msg["tp_id"], msg["tile"], msg["seq"])
+        if "handle" in msg:
+            # rendezvous: fetch through the data plane, deliver on arrival
+            self.ce.get(msg["data_rank"], msg["handle"],
+                        lambda arr, k=key: self._dtd_deliver(k, arr))
+            return
+        self._dtd_deliver(key, msg["data"])
+
+    def _dtd_deliver(self, key: Tuple, arr: Any) -> None:
         with self._lock:
             cb = self._dtd_expect.pop(key, None)
             if cb is None:
-                self._dtd_arrived[key] = msg["data"]
+                self._dtd_arrived[key] = arr
                 return
-        cb(msg["data"])
+        cb(arr)
 
     # ------------------------------------------------------------------ #
     # distributed termination (fourcounter waves ride TAG_TERMDET)       #

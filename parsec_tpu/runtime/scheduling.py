@@ -187,6 +187,33 @@ class _Backoff:
         context.park(sleep)
 
 
+def es_rusage_report(es: ExecutionStream) -> dict:
+    """Per-ES thread resource usage delta since the last call on the SAME
+    OS thread (ref: the per-ES getrusage reports, scheduling.c:45-90);
+    logged at verbosity >= 3 from each wait-loop exit. Baselines are kept
+    per calling thread: ES 0 runs on whichever thread drives wait(), so a
+    baseline from another thread must not pollute the delta. maxrss_kb is
+    reported as the absolute process high-water mark (getrusage has no
+    per-thread rss)."""
+    import resource
+    ru = resource.getrusage(getattr(resource, "RUSAGE_THREAD",
+                                    resource.RUSAGE_SELF))
+    tid = threading.get_ident()
+    cur = {"utime_s": ru.ru_utime, "stime_s": ru.ru_stime,
+           "vcsw": ru.ru_nvcsw, "ivcsw": ru.ru_nivcsw,
+           "minflt": ru.ru_minflt, "maxrss_kb": ru.ru_maxrss}
+    prevs = getattr(es, "_last_rusage", None)
+    if prevs is None:
+        prevs = es._last_rusage = {}
+    prev = prevs.get(tid)
+    prevs[tid] = cur
+    if prev is None:
+        return dict(cur)
+    out = {k: cur[k] - prev[k] for k in cur if k != "maxrss_kb"}
+    out["maxrss_kb"] = cur["maxrss_kb"]
+    return out
+
+
 def context_wait_loop(es: ExecutionStream) -> None:
     """The worker main loop (ref: __parsec_context_wait scheduling.c:535-666).
 
@@ -215,3 +242,6 @@ def context_wait_loop(es: ExecutionStream) -> None:
             backoff.hit()
         else:
             backoff.miss(ctx)
+    if plog.debug.verbosity >= 3:
+        plog.debug.verbose(3, "es %d rusage: %s", es.th_id,
+                           es_rusage_report(es))

@@ -30,15 +30,18 @@ except RuntimeError:
     pass
 
 
-def spmd(nb_ranks, fn, timeout=120):
+def spmd(nb_ranks, fn, timeout=120, fabric=None):
     """Run fn(rank, fabric) on one thread per rank over an in-process
-    LocalFabric; propagate exceptions (the reference's analog:
+    fabric (LocalFabric by default; pass e.g. a MeshFabric to change the
+    transport); propagate exceptions (the reference's analog:
     oversubscribed mpiexec on one node, SURVEY.md §4)."""
     import threading
 
     from parsec_tpu.comm import LocalFabric
 
-    fabric = LocalFabric(nb_ranks)
+    if fabric is None:
+        fabric = LocalFabric(nb_ranks)
+    assert fabric.nb_ranks == nb_ranks
     errors = [None] * nb_ranks
     results = [None] * nb_ranks
 

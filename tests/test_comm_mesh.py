@@ -64,27 +64,8 @@ def _run_chain(nb_ranks, mb=48):
         finally:
             ctx.fini()
 
-    # reuse the conftest spmd harness but with our mesh fabric
-    import threading
-    results = [None] * nb_ranks
-    errors = [None] * nb_ranks
-
-    def runner(r):
-        try:
-            results[r] = rank_fn(r, fabric)
-        except BaseException as e:  # noqa: BLE001
-            errors[r] = e
-
-    threads = [threading.Thread(target=runner, args=(r,), daemon=True)
-               for r in range(nb_ranks)]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join(120)
-        assert not t.is_alive(), "rank thread hung"
-    for e in errors:
-        if e is not None:
-            raise e
+    from conftest import spmd
+    results, fabric = spmd(nb_ranks, rank_fn, fabric=fabric)
     parsec_tpu.params.reset()
     return results, fabric
 
@@ -134,3 +115,61 @@ def test_mesh_put_device_region_rebinds():
 def test_mesh_fabric_needs_enough_devices():
     with pytest.raises(RuntimeError):
         MeshFabric(nb_ranks=10 ** 6)
+
+
+def test_dtd_chain_over_mesh():
+    """The DTD cross-rank (tile, seq) data plane also rides the mesh
+    transport: a chain alternating between 2 device-pinned ranks, with
+    the payload above the short limit so hops move device-to-device."""
+    from conftest import spmd
+    from parsec_tpu import dtd
+    from parsec_tpu.collections import DictCollection
+    from parsec_tpu.dsl.dtd import AFFINITY, INOUT, INPUT, VALUE, unpack_args
+
+    nb_ranks, N = 2, 6
+    parsec_tpu.params.reset()
+    parsec_tpu.params.set_cmdline("runtime_comm_short_limit", "64")
+    fabric = _mesh_fabric(nb_ranks)
+
+    def rank_fn(rank, fabric):
+        eng = RemoteDepEngine(fabric.engine(rank))
+        ctx = parsec_tpu.Context(nb_cores=1, comm=eng, enable_tpu=False)
+        try:
+            coll = DictCollection(nodes=nb_ranks, rank=rank)
+            coll.name = "C"
+            # 128-element payload: above the 64-byte short limit
+            coll.add("x", 0, np.zeros(128) if rank == 0 else None)
+            anchors = {}
+            for r in range(nb_ranks):
+                a = DictCollection(nodes=nb_ranks, rank=rank)
+                a.name = f"anchor{r}"
+                a.add("a", r, np.zeros(1) if r == rank else None)
+                anchors[r] = a
+            tp = dtd.taskpool_new("meshchain")
+            ctx.add_taskpool(tp)
+            tile = tp.tile_of(coll, "x")
+
+            def bump(es, task):
+                x, anchor, k = unpack_args(task)
+                assert x[0] == k, f"task {k} saw {x[0]}"
+                x[0] += 1.0
+
+            for k in range(N):
+                at = tp.tile_of(anchors[k % nb_ranks], "a")
+                tp.insert_task(bump, (tile, INOUT),
+                               (at, INPUT | AFFINITY), (k, VALUE))
+            tp.data_flush_all()
+            tp.wait()
+            ctx.wait()
+            if rank == 0:
+                return float(coll.data_of("x").get_copy(0).payload[0])
+        finally:
+            ctx.fini()
+
+    results, fabric = spmd(nb_ranks, rank_fn, fabric=fabric)
+    parsec_tpu.params.reset()
+    assert results[0] == float(N)
+    # the 1KB payload exceeded the 64B short limit: hops rode the GET
+    # rendezvous, i.e. the mesh device-to-device data plane
+    assert fabric.d2d_transfers > 0
+    assert fabric.d2d_bytes > 0

@@ -1,0 +1,87 @@
+"""Randomized DTD dependency stress against a sequential oracle
+(ref: the dsl/dtd battery's corner tests + the reference's multithreaded
+container stress philosophy, SURVEY.md §4: random graphs catch ordering
+bugs the structured tests miss).
+
+Random programs over a pool of tiles with random access modes run on 4
+worker threads; DTD sequential-consistency semantics say the outcome
+must equal replaying the same insertion order serially.
+"""
+import numpy as np
+import pytest
+
+import parsec_tpu
+from parsec_tpu import dtd
+from parsec_tpu.dsl.dtd import INOUT, INPUT, VALUE, unpack_args
+
+
+def _apply(args):
+    coef = args[-1]
+    out = args[0]
+    acc = float(coef)
+    for a in args[1:-1]:
+        acc += float(a[0, 0])
+    out += acc  # INOUT accumulate: order-sensitive across tasks
+    out *= 1.0 + 1e-3 * coef  # non-commutative with the add
+
+
+# a DTD task class has a fixed flow signature (ref: class per body with
+# constant arity) -> one body per input count
+def _body0(es, task):
+    _apply(unpack_args(task))
+
+
+def _body1(es, task):
+    _apply(unpack_args(task))
+
+
+def _body2(es, task):
+    _apply(unpack_args(task))
+
+
+_BODIES = {0: _body0, 1: _body1, 2: _body2}
+
+
+def _oracle(tiles, program):
+    state = [t.copy() for t in tiles]
+    for (out, ins, coef) in program:
+        acc = float(coef)
+        for i in ins:
+            acc += float(state[i][0, 0])
+        state[out] += acc
+        state[out] *= 1.0 + 1e-3 * coef
+    return state
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_random_dag_matches_sequential_oracle(ctx4, seed):
+    rng = np.random.RandomState(seed)
+    n_tiles, n_tasks = 8, 120
+    tiles_np = [rng.rand(4, 4).astype(np.float64) for _ in range(n_tiles)]
+
+    # random program: (out_tile, [in_tiles], coef)
+    program = []
+    for t in range(n_tasks):
+        out = int(rng.randint(n_tiles))
+        nin = int(rng.randint(0, 3))
+        ins = [int(x) for x in rng.choice(
+            [i for i in range(n_tiles) if i != out],
+            size=nin, replace=False)] if nin else []
+        program.append((out, ins, float(t % 7)))
+
+    tp = dtd.taskpool_new()
+    ctx4.add_taskpool(tp)
+    handles = [tp.tile_of_array(t.copy()) for t in tiles_np]
+    for (out, ins, coef) in program:
+        args = [(handles[out], INOUT)]
+        args += [(handles[i], INPUT) for i in ins]
+        args.append((coef, VALUE))
+        tp.insert_task(_BODIES[len(ins)], *args)
+    tp.data_flush_all()
+    tp.wait()
+
+    expect = _oracle(tiles_np, program)
+    for i, h in enumerate(handles):
+        got = np.asarray(h.data.get_copy(0).payload)
+        np.testing.assert_allclose(got, expect[i], rtol=1e-12,
+                                   err_msg=f"tile {i} diverged (seed {seed})")

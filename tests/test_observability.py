@@ -266,3 +266,25 @@ def test_debug_history_unhooked_at_fini():
     c.fini()
     assert not dh.enabled()
     assert not pins_is_active()
+
+
+def test_es_rusage_report(ctx):
+    """Per-ES rusage deltas (ref: getrusage reports, scheduling.c:45-90)."""
+    from parsec_tpu.runtime.scheduling import es_rusage_report
+    es = ctx.execution_streams[0]
+    first = es_rusage_report(es)  # absolute thread counters at baseline
+    assert {"utime_s", "stime_s", "vcsw", "ivcsw", "maxrss_kb"} <= set(first)
+    tp, _ = _chain_tp(4)
+    ctx.add_taskpool(tp)
+    ctx.wait()
+    delta = es_rusage_report(es)
+    # deltas must be non-negative and bounded by the wall time of the
+    # chain run — absolute counters leaking through would exceed this
+    # (the baseline call above already accrued test-session utime)
+    assert 0.0 <= delta["utime_s"] <= 5.0
+    assert delta["vcsw"] >= 0 and delta["ivcsw"] >= 0
+    sum(i * i for i in range(2_000_000))  # measurable cpu burn
+    delta2 = es_rusage_report(es)
+    # the burn happened on THIS thread: its delta sees it, stays small,
+    # and a wrong-direction subtraction would go negative
+    assert 0.0 <= delta2["utime_s"] <= 5.0
