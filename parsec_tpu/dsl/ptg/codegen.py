@@ -1,0 +1,222 @@
+"""Per-task-class code generation: the jdf2c analog.
+
+The reference's PTG compiler emits C for the hot per-instance functions —
+``iterate_successors`` loops over dep ranges and the dependency-counter
+lookups (ref: jdf2c.c:44 iterate_successors, the generated dep counters,
+and the startup enumerator, jdf2c.c:2975). Interpreting the AST per task
+instance costs a dict-env build plus an Expr eval per guard/argument;
+here we generate the same specializations as Python source once per
+taskpool (globals bound), so guards become inline ``if``s, dep ranges
+become ``for`` loops, and locals unpack positionally.
+
+Generated per task class:
+
+- ``goal(locals) -> int`` — #task-sourced input activations for one
+  instance (ref: the generated dependency goal);
+- ``succ(locals, copies, cb)`` — enumerate satisfied output edges,
+  calling ``cb(succ_class_name, succ_locals, succ_flow, copy, out_idx)``.
+
+The interpreted path (runtime.py) stays as the fallback: any codegen
+failure logs and falls back per class (MCA param ``ptg_codegen`` turns
+the generator off globally).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+from .ast import Expr, RangeExpr, TaskClassAST
+from .ast import _SAFE_BUILTINS
+
+
+class CodegenUnsupported(Exception):
+    """The task class uses a construct whose generated code would diverge
+    from the interpreted semantics; the caller falls back to the AST walk."""
+
+
+def _names_of(e: Expr):
+    return set(e._code.co_names)
+
+
+def _exprs_of_target(t) -> List[Expr]:
+    out: List[Expr] = []
+    if t is None:
+        return out
+    for a in t.args:
+        if isinstance(a, RangeExpr):
+            out += [a.lo, a.hi] + ([a.step] if a.step is not None else [])
+        else:
+            out.append(a)
+    return out
+
+
+def _validate(tc: TaskClassAST, global_env: Dict[str, Any]) -> None:
+    """Two build-time checks that guarantee generated == interpreted:
+
+    1. every name an expression references must resolve in the
+       interpreted path too (globals, locals, or the safe builtins) —
+       otherwise the generated function would silently reach full
+       builtins the Expr evaluator denies;
+    2. a derived local's expression must not read a name that only
+       becomes a local LATER in definition order — in the generated
+       function that name is function-local for the whole body
+       (UnboundLocalError) while env_of would have read the global.
+    """
+    local_names = [ld.name for ld in tc.locals]
+    known = set(global_env) | set(local_names) | set(_SAFE_BUILTINS) | {
+        "__ptg_range"}
+    exprs: List[Expr] = []
+    for i, ld in enumerate(tc.locals):
+        if ld.range is None:
+            later = set(local_names[i + 1:])
+            bad = _names_of(ld.expr) & later
+            if bad:
+                raise CodegenUnsupported(
+                    f"{tc.name}: derived local {ld.name} reads "
+                    f"later-defined locals {sorted(bad)}")
+            exprs.append(ld.expr)
+        else:
+            exprs += [ld.range.lo, ld.range.hi] + (
+                [ld.range.step] if ld.range.step is not None else [])
+    for f in tc.flows:
+        for d in f.deps:
+            if d.guard is not None:
+                exprs.append(d.guard)
+            exprs += _exprs_of_target(d.target)
+            exprs += _exprs_of_target(d.alt_target)
+    for e in exprs:
+        unknown = _names_of(e) - known
+        if unknown:
+            raise CodegenUnsupported(
+                f"{tc.name}: expression {e.src!r} references names "
+                f"{sorted(unknown)} outside globals/locals/safe builtins")
+
+_PREAMBLE = """\
+def __ptg_range(lo, hi, st=1):
+    return range(lo, hi + (1 if st > 0 else -1), st)
+"""
+
+
+def _emit_unpack(tc: TaskClassAST, out: List[str], indent: str) -> None:
+    """Positional locals unpack, interleaving derived locals in definition
+    order (matches PTGTaskClass.env_of)."""
+    pos = 0
+    for ld in tc.locals:
+        if ld.range is not None:
+            out.append(f"{indent}{ld.name} = __ptg_L[{pos}]")
+            pos += 1
+        else:
+            out.append(f"{indent}{ld.name} = ({ld.expr.src})")
+
+
+def _arg_dims(args: List[Any]) -> Tuple[List[str], List[str]]:
+    """Per target-arg: (scalar source or loop var, loop headers)."""
+    elems: List[str] = []
+    loops: List[str] = []
+    for j, a in enumerate(args):
+        if isinstance(a, RangeExpr):
+            var = f"__ptg_a{j}"
+            st = a.step.src if a.step is not None else "1"
+            loops.append(f"for {var} in __ptg_range(({a.lo.src}), "
+                         f"({a.hi.src}), ({st})):")
+            elems.append(var)
+        else:
+            elems.append(f"({a.src})")
+    return elems, loops
+
+
+def _tuple_src(elems: List[str]) -> str:
+    if not elems:
+        return "()"
+    return "(" + ", ".join(elems) + ("," if len(elems) == 1 else "") + ")"
+
+
+def _emit_goal_target(t, out: List[str], indent: str) -> None:
+    if t is None or t.kind != "task":
+        return
+    sizes = []
+    for a in t.args:
+        if isinstance(a, RangeExpr):
+            st = a.step.src if a.step is not None else "1"
+            sizes.append(f"len(__ptg_range(({a.lo.src}), ({a.hi.src}), "
+                         f"({st})))")
+    if sizes:
+        out.append(f"{indent}__ptg_g += " + " * ".join(sizes))
+    else:
+        out.append(f"{indent}__ptg_g += 1")
+
+
+def _emit_succ_target(t, flow_idx: int, out: List[str], indent: str) -> None:
+    if t is None or t.kind != "task":
+        return
+    elems, loops = _arg_dims(t.args)
+    for lp in loops:
+        out.append(indent + lp)
+        indent += "    "
+    out.append(f"{indent}__ptg_cb({t.task_class!r}, {_tuple_src(elems)}, "
+               f"{t.flow!r}, __ptg_c{flow_idx}, {flow_idx})")
+
+
+def generate_source(tc: TaskClassAST) -> str:
+    """The module source for one task class's generated functions."""
+    src: List[str] = [_PREAMBLE]
+
+    # -- goal ----------------------------------------------------------
+    src.append(f"def __ptg_goal_{tc.name}(__ptg_L):")
+    _emit_unpack(tc, src, "    ")
+    src.append("    __ptg_g = 0")
+    for f in tc.flows:
+        for d in f.deps_in():
+            if d.guard is None:
+                _emit_goal_target(d.target, src, "    ")
+            else:
+                body: List[str] = []
+                _emit_goal_target(d.target, body, "        ")
+                alt: List[str] = []
+                _emit_goal_target(d.alt_target, alt, "        ")
+                if body or alt:
+                    src.append(f"    if ({d.guard.src}):")
+                    src.extend(body or ["        pass"])
+                    if alt:
+                        src.append("    else:")
+                        src.extend(alt)
+    src.append("    return __ptg_g")
+    src.append("")
+
+    # -- successors ----------------------------------------------------
+    src.append(f"def __ptg_succ_{tc.name}(__ptg_L, __ptg_copies, __ptg_cb):")
+    _emit_unpack(tc, src, "    ")
+    for i, f in enumerate(tc.flows):
+        if not any(d.direction == "out" for d in f.deps):
+            continue
+        src.append(f"    __ptg_c{i} = None" if f.is_ctl
+                   else f"    __ptg_c{i} = __ptg_copies[{i}]")
+        for d in f.deps_out():
+            if d.guard is None:
+                _emit_succ_target(d.target, i, src, "    ")
+            else:
+                body = []
+                _emit_succ_target(d.target, i, body, "        ")
+                alt = []
+                _emit_succ_target(d.alt_target, i, alt, "        ")
+                if body or alt:
+                    src.append(f"    if ({d.guard.src}):")
+                    src.extend(body or ["        pass"])
+                    if alt:
+                        src.append("    else:")
+                        src.extend(alt)
+    src.append("    return None")
+    src.append("")
+    return "\n".join(src)
+
+
+def build_fns(tc: TaskClassAST, global_env: Dict[str, Any]):
+    """Compile the generated source against the taskpool's globals;
+    returns (goal_fn, succ_fn)."""
+    _validate(tc, global_env)
+    source = generate_source(tc)
+    code = compile(source, f"<jdf-codegen:{tc.name}>", "exec")
+    # run IN global_env so JDF global names resolve exactly like the
+    # interpreted env (locals shadow globals inside the functions)
+    exec(code, global_env)
+    return (global_env[f"__ptg_goal_{tc.name}"],
+            global_env[f"__ptg_succ_{tc.name}"])

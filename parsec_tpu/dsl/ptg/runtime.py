@@ -32,6 +32,7 @@ from ...runtime.scheduling import schedule_keep_best
 from ...runtime.taskpool import (Chore, Flow, HookReturn, Task, TaskClass,
                                  Taskpool)
 from ...utils import logging as plog
+from ...utils.params import params
 from .ast import (BodyAST, DepAST, DepTarget, Expr, FlowAST, JDFFile,
                   LocalDef, RangeExpr, TaskClassAST)
 
@@ -61,6 +62,17 @@ class PTGTaskClass(TaskClass):
         self.tp = tp
         self.ast = ast
         self.dep_table = HashTable()
+        # generated specializations (the jdf2c analog, codegen.py);
+        # interpreted AST walk below remains the fallback
+        self._gen_goal = self._gen_succ = None
+        if params.get("ptg_codegen"):
+            try:
+                from .codegen import build_fns
+                self._gen_goal, self._gen_succ = build_fns(ast, tp.global_env)
+            except Exception as exc:  # pragma: no cover - defensive
+                plog.debug.verbose(
+                    1, "ptg codegen failed for %s (%s); interpreting",
+                    ast.name, exc)
         self.prepare_input = self._prepare_input
         self.release_deps = self._release_deps
         self.iterate_successors = self._iterate_successors
@@ -131,8 +143,15 @@ class PTGTaskClass(TaskClass):
                     goal += sum(1 for _ in _expand_args(t.args, env))
         return goal
 
-    def is_startup(self, env: Dict[str, Any]) -> bool:
-        return self.input_goal(env) == 0
+    def goal_of(self, locals_: Tuple, env: Optional[Dict[str, Any]] = None) -> int:
+        """input_goal via the generated counter when available."""
+        if self._gen_goal is not None:
+            return self._gen_goal(locals_)
+        return self.input_goal(env if env is not None else self.env_of(locals_))
+
+    def is_startup(self, locals_: Tuple,
+                   env: Optional[Dict[str, Any]] = None) -> bool:
+        return self.goal_of(locals_, env) == 0
 
     # ------------------------------------------------------------------ #
     # task lifecycle                                                     #
@@ -240,6 +259,16 @@ class PTGTaskClass(TaskClass):
     def _iterate_successors(self, es, task: Task, cb: Callable) -> None:
         """cb(succ_tc, succ_locals, succ_flow_name, copy, out_flow_idx) per
         satisfied output edge (ref: generated iterate_successors)."""
+        if self._gen_succ is not None:
+            copies = [None if f.is_ctl
+                      else (task.data[i].data_out or task.data[i].data_in)
+                      for i, f in enumerate(self.ast.flows)]
+            resolve = self.tp.class_by_name
+            self._gen_succ(
+                task.locals, copies,
+                lambda name, loc, fl, cp, idx: cb(resolve(name), loc, fl,
+                                                  cp, idx))
+            return
         env = self.env_of(task.locals)
         for i, f in enumerate(self.ast.flows):
             copy = None if f.is_ctl else (task.data[i].data_out or task.data[i].data_in)
@@ -302,8 +331,7 @@ class PTGTaskClass(TaskClass):
         try:
             entry = self.dep_table.nolock_find(key)
             if entry is None:
-                env = self.env_of(locals_)
-                entry = _DepEntry(self.input_goal(env))
+                entry = _DepEntry(self.goal_of(locals_))
                 self.dep_table.nolock_insert(key, entry)
             if copy is not None:
                 entry.bindings[flow_name] = copy
@@ -476,7 +504,7 @@ class PTGTaskpool(Taskpool):
                 if tc.rank_of_instance(env) != self.rank:
                     continue
                 total += 1
-                if tc.is_startup(env):
+                if tc.goal_of(locals_, env) == 0:
                     startup.append(tc.make_task(locals_, None))
         self.nb_local_tasks = total
         self.set_nb_tasks(total)
