@@ -100,3 +100,104 @@ def test_codegen_disabled_falls_back(ctx):
         np.testing.assert_allclose(L @ L.T, M, atol=5e-4)
     finally:
         parsec_tpu.params.reset()
+
+
+# --------------------------------------------------------------------- #
+# unparse roundtrip (ref: jdf_unparse.c)                                #
+# --------------------------------------------------------------------- #
+@pytest.mark.parametrize("which", ["dpotrf", "dgeqrf", "dgetrf"])
+def test_unparse_roundtrip(which):
+    """parse(unparse(ast)) preserves the whole structure: classes,
+    locals, flows, deps (guards/targets), priorities, bodies."""
+    from parsec_tpu.dsl.ptg.parser import parse_jdf
+    from parsec_tpu.dsl.ptg.unparse import unparse
+
+    tp = _taskpool_for(which)
+    jdf1 = tp.jdf
+    text = unparse(jdf1)
+    jdf2 = parse_jdf(text, name=jdf1.name)
+    assert [t.name for t in jdf2.task_classes] == \
+        [t.name for t in jdf1.task_classes]
+    for t1, t2 in zip(jdf1.task_classes, jdf2.task_classes):
+        assert t1.params == t2.params
+        assert [l.name for l in t1.locals] == [l.name for l in t2.locals]
+        assert [f.name for f in t1.flows] == [f.name for f in t2.flows]
+        for f1, f2 in zip(t1.flows, t2.flows):
+            assert f1.access == f2.access
+            assert len(f1.deps) == len(f2.deps)
+            for d1, d2 in zip(f1.deps, f2.deps):
+                assert d1.direction == d2.direction
+                assert (d1.guard is None) == (d2.guard is None)
+                assert d1.target.kind == d2.target.kind
+                assert d1.target.task_class == d2.target.task_class
+        assert (t1.priority is None) == (t2.priority is None)
+        assert len(t1.bodies) == len(t2.bodies)
+    # and the unparsed text is itself compilable into a working factory
+    import parsec_tpu
+    from parsec_tpu.dsl import ptg as ptg_mod
+    ptg_mod.compile_jdf(text, name="roundtrip")
+
+
+FANCY_JDF = """
+extern "PYTHON" %{
+def helper(x):
+    return x + 1
+%}
+
+descA [ type="collection" ]
+NT [ type="int" default="4" ]
+LBL [ type="string" default="'two words'" ]
+
+T(k)  [ high_priority=on note="two words" ]
+
+k = 0 .. NT-1
+kk = helper(k)
+
+: descA( 0, 0 )
+
+RW A <- (k == 0) ? descA( 0, 0 ) : A T( k-1 )
+     -> (k < NT-1) ? A T( k+1 )
+     -> (k == NT-1) ? descA( 0, 0 )
+
+; NT - k
+
+BODY
+{
+    A = A + kk
+}
+END
+
+extern "PYTHON" %{
+EPILOGUE_MARK = 1
+%}
+"""
+
+
+def test_unparse_roundtrip_prologue_props_epilogue():
+    """Prologue/epilogue externs, header properties, and quoted property
+    values must survive the roundtrip."""
+    from parsec_tpu.dsl.ptg.parser import parse_jdf
+    from parsec_tpu.dsl.ptg.unparse import unparse
+
+    j1 = parse_jdf(FANCY_JDF, name="fancy")
+    text = unparse(j1)
+    j2 = parse_jdf(text, name="fancy")
+    assert j2.prologue == j1.prologue
+    assert j2.epilogue == j1.epilogue
+    t1, t2 = j1.task_classes[0], j2.task_classes[0]
+    assert t2.properties == t1.properties
+    assert t2.properties.get("note") == "two words"
+    assert [l.name for l in t2.locals] == ["k", "kk"]
+    # and the roundtripped JDF still runs
+    from parsec_tpu.collections import TwoDimBlockCyclic
+    from parsec_tpu.dsl import ptg as ptg_mod
+    A = TwoDimBlockCyclic(2, 2, 2, 2).from_numpy(np.zeros((2, 2), np.float32))
+    c = parsec_tpu.Context(nb_cores=1, enable_tpu=False)
+    try:
+        tp = ptg_mod.compile_jdf(text, name="fancy2").new(descA=A, NT=3)
+        c.add_taskpool(tp)
+        c.wait()
+    finally:
+        c.fini()
+    # sum of helper(k)=k+1 for k=0..2 is 6
+    np.testing.assert_allclose(A.to_numpy(), 6.0)
