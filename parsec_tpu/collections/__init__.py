@@ -1,7 +1,8 @@
 """Data collections & distributions (SURVEY.md §2.6)."""
 from .collection import DataCollection, DictCollection, LocalArrayCollection
-from .matrix import (SymTwoDimBlockCyclic, TiledMatrix, TwoDimBlockCyclic,
-                     TwoDimBlockCyclicBand, TwoDimTabular, VectorTwoDimCyclic)
+from .matrix import (SymTwoDimBlockCyclic, SymTwoDimBlockCyclicBand,
+                     TiledMatrix, TwoDimBlockCyclic, TwoDimBlockCyclicBand,
+                     TwoDimTabular, VectorTwoDimCyclic)
 from .redistribute import redistribute, reshard_array
 from .subtile import SubtileView
 from . import ops
@@ -9,6 +10,7 @@ from . import ops
 __all__ = [
     "DataCollection", "DictCollection", "LocalArrayCollection", "TiledMatrix",
     "TwoDimBlockCyclic", "SymTwoDimBlockCyclic", "TwoDimBlockCyclicBand",
+    "SymTwoDimBlockCyclicBand",
     "TwoDimTabular", "VectorTwoDimCyclic", "redistribute", "reshard_array",
     "ops", "SubtileView",
 ]

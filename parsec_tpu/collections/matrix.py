@@ -179,6 +179,16 @@ class TwoDimBlockCyclicBand(TwoDimBlockCyclic):
         return super().data_of(m, n)
 
 
+class SymTwoDimBlockCyclicBand(TwoDimBlockCyclicBand):
+    """Band + triangular storage: only in-band tiles on the stored side
+    (ref: sym_two_dim_rectangle_cyclic_band.c)."""
+
+    def __init__(self, lm: int, ln: int, mb: int, nb: int, band_size: int,
+                 uplo: str = "lower", **kw) -> None:
+        assert uplo in ("lower", "upper")
+        super().__init__(lm, ln, mb, nb, band_size, uplo=uplo, **kw)
+
+
 class TwoDimTabular(TiledMatrix):
     """Arbitrary per-tile rank table (ref: two_dim_tabular.c)."""
 

@@ -6,7 +6,8 @@ import pytest
 from parsec_tpu.collections import (DictCollection, LocalArrayCollection,
                                     SymTwoDimBlockCyclic, TiledMatrix,
                                     TwoDimBlockCyclic, TwoDimBlockCyclicBand,
-                                    TwoDimTabular, VectorTwoDimCyclic)
+                                    TwoDimTabular, VectorTwoDimCyclic,
+                                    SymTwoDimBlockCyclicBand)
 
 
 def test_tiled_matrix_geometry():
@@ -105,3 +106,18 @@ def test_local_array_collection_views_alias():
     d = c.data_of(1)
     d.get_copy(0).payload[:] = 7.0
     assert np.all(base[2:4] == 7.0)  # tiles are views, not copies
+
+
+def test_sym_band_collection():
+    """Band + triangular storage (ref: sym_two_dim_rectangle_cyclic_band)."""
+    A = SymTwoDimBlockCyclicBand(8 * 8, 8 * 8, 8, 8, band_size=2,
+                                 uplo="lower", P=2, Q=1, nodes=2)
+    ts = list(A.tiles())
+    # lower-triangular AND within the band
+    assert all(n <= m and m - n < 2 for (m, n) in ts)
+    assert (3, 2) in ts and (3, 3) in ts
+    assert (3, 0) not in ts and (2, 3) not in ts
+    # distribution math still block-cyclic over P
+    assert A.rank_of(2, 2) != A.rank_of(3, 3)
+    with pytest.raises(AssertionError):
+        A.data_of(7, 0)
