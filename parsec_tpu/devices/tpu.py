@@ -76,6 +76,12 @@ class JaxDevice(Device):
         self._mem_lock = threading.Lock()
         self.stats = {"stage_in_bytes": 0, "stage_out_bytes": 0,
                       "evictions": 0, "tasks": 0}
+        # eager completion (async dispatch IS completion; XLA orders the
+        # dataflow) with a bounded in-flight window
+        self.eager_complete = bool(params.get("tpu_eager_complete"))
+        self.eager_window = int(params.get("tpu_eager_window"))
+        self._window: List[_InFlight] = []
+        self._eager_done: List[_InFlight] = []
 
     def _probe_budget(self) -> int:
         try:
@@ -117,13 +123,18 @@ class JaxDevice(Device):
                     break
                 task, est = item
                 try:
-                    self._submit(task, est)
+                    self._submit(es, task, est)
                 except Exception as exc:  # surfacing beats hanging the DAG
                     plog.warning("tpu submit failed for %s: %s", task.snprintf(), exc)
                     raise
             # poll phase: complete ready in-flight tasks
+            if self._eager_done:
+                done, self._eager_done = self._eager_done, []
+                for rec in done:
+                    self._epilog(es, rec)
+                    n += 1
             still: List[_InFlight] = []
-            done: List[_InFlight] = []
+            done = []
             for rec in self._inflight:
                 if all(_array_ready(a) for a in rec.outputs):
                     done.append(rec)
@@ -174,7 +185,7 @@ class JaxDevice(Device):
             arrays.append(copy.payload)
         return arrays
 
-    def _submit(self, task: Task, est: float) -> None:
+    def _submit(self, es, task: Task, est: float) -> None:
         tc = task.task_class
         chore = tc.incarnations[task.selected_chore]
         fn = chore.dyld_fn
@@ -192,8 +203,31 @@ class JaxDevice(Device):
         assert len(outputs) == len(out_flows), (
             f"{tc.name} tpu body returned {len(outputs)} arrays for "
             f"{len(out_flows)} written flows")
-        self._inflight.append(_InFlight(task, list(outputs), out_flows, est))
+        rec = _InFlight(task, list(outputs), out_flows, est)
         self.stats["tasks"] += 1
+        if self.eager_complete:
+            # TPU-native completion model: jax dispatch is async and XLA's
+            # execution queue already orders consumers after producers, so
+            # dependency release need not wait for the kernel — successors
+            # chain their jit calls on the in-flight arrays. Host-side
+            # reads still block on conversion (device->host sync point).
+            # A bounded window keeps the queue from running unboundedly
+            # ahead (ref: the CUDA module bounds in-flight per stream).
+            self._window.append(rec)
+            if len(self._window) > self.eager_window:
+                old = self._window.pop(0)
+                self.load_sub(old.est)  # deferred from _epilog (eager mode)
+                try:
+                    for a in old.outputs:
+                        if a is not None and hasattr(a, "block_until_ready"):
+                            a.block_until_ready()
+                except Exception as exc:
+                    # the async kernel error belongs to the task that
+                    # dispatched it, not the one being submitted now
+                    es.context.record_task_error(exc, old.task)
+            self._eager_done.append(rec)
+        else:
+            self._inflight.append(rec)
 
     def _epilog(self, es, rec: _InFlight) -> None:
         """ref: parsec_cuda_kernel_epilog (device_cuda_module.c:2365-2430)."""
@@ -217,7 +251,8 @@ class JaxDevice(Device):
                 ref = task.data[flow.flow_index]
                 if ref.data_in is not None and ref.data_in.data is not None:
                     ref.data_in.data.release_reader(self.device_index)
-        self.load_sub(rec.est)
+        if not self.eager_complete:
+            self.load_sub(rec.est)  # eager mode releases at window exit
         self.executed_tasks += 1
         complete_execution(es, task)
 
@@ -326,6 +361,16 @@ class JaxDevice(Device):
 
     def fini(self) -> None:
         assert not self._inflight, "device finalized with in-flight tasks"
+        for rec in self._window:
+            self.load_sub(rec.est)
+            try:
+                for a in rec.outputs:
+                    if a is not None and hasattr(a, "block_until_ready"):
+                        a.block_until_ready()
+            except Exception as exc:  # teardown must finalize every device
+                plog.warning("async kernel of %s failed at drain: %s",
+                             rec.task.snprintf(), exc)
+        self._window.clear()
 
 
 def tpu_chore_hook(device_selector=None):

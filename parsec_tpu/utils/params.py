@@ -215,6 +215,11 @@ def register_core_params() -> None:
                       "(ref: --parsec_dot)")
     params.reg_string("termdet", "local", "termination detection module")
     params.reg_int("gpu_max_streams", 4, "per-accelerator concurrent exec lanes")
+    params.reg_bool("tpu_eager_complete", True,
+                    "release deps at async dispatch (XLA orders the "
+                    "dataflow); off = wait for buffer readiness")
+    params.reg_int("tpu_eager_window", 32,
+                   "max in-flight eager submissions before blocking")
     params.reg_sizet("tpu_memory_fraction_pct", 85,
                      "percent of HBM managed by the arena")
     params.reg_int("comm_max_inflight", 16, "max concurrent gets/puts in comm thread")
