@@ -133,6 +133,16 @@ class JaxDevice(Device):
                 for rec in done:
                     self._epilog(es, rec)
                     n += 1
+            if self._window:
+                # retire finished window entries so device_load drains on
+                # idle devices and async errors surface during the run
+                still_w = []
+                for rec in self._window:
+                    if all(_array_ready(a) for a in rec.outputs):
+                        self._retire(rec, es)
+                    else:
+                        still_w.append(rec)
+                self._window = still_w
             still: List[_InFlight] = []
             done = []
             for rec in self._inflight:
@@ -215,19 +225,27 @@ class JaxDevice(Device):
             # ahead (ref: the CUDA module bounds in-flight per stream).
             self._window.append(rec)
             if len(self._window) > self.eager_window:
-                old = self._window.pop(0)
-                self.load_sub(old.est)  # deferred from _epilog (eager mode)
-                try:
-                    for a in old.outputs:
-                        if a is not None and hasattr(a, "block_until_ready"):
-                            a.block_until_ready()
-                except Exception as exc:
-                    # the async kernel error belongs to the task that
-                    # dispatched it, not the one being submitted now
-                    es.context.record_task_error(exc, old.task)
+                # backpressure: block on the oldest submission
+                self._retire(self._window.pop(0), es)
             self._eager_done.append(rec)
         else:
             self._inflight.append(rec)
+
+    def _retire(self, rec: _InFlight, es=None) -> None:
+        """Release a window entry: drop its load contribution and surface
+        any async kernel error — against the task that DISPATCHED it
+        (es present: recorded as a task error; teardown: logged)."""
+        self.load_sub(rec.est)
+        try:
+            for a in rec.outputs:
+                if a is not None and hasattr(a, "block_until_ready"):
+                    a.block_until_ready()
+        except Exception as exc:
+            if es is not None:
+                es.context.record_task_error(exc, rec.task)
+            else:
+                plog.warning("async kernel of %s failed at drain: %s",
+                             rec.task.snprintf(), exc)
 
     def _epilog(self, es, rec: _InFlight) -> None:
         """ref: parsec_cuda_kernel_epilog (device_cuda_module.c:2365-2430)."""
@@ -362,14 +380,7 @@ class JaxDevice(Device):
     def fini(self) -> None:
         assert not self._inflight, "device finalized with in-flight tasks"
         for rec in self._window:
-            self.load_sub(rec.est)
-            try:
-                for a in rec.outputs:
-                    if a is not None and hasattr(a, "block_until_ready"):
-                        a.block_until_ready()
-            except Exception as exc:  # teardown must finalize every device
-                plog.warning("async kernel of %s failed at drain: %s",
-                             rec.task.snprintf(), exc)
+            self._retire(rec)  # teardown: must finalize every device
         self._window.clear()
 
 

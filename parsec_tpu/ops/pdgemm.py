@@ -6,6 +6,12 @@ broadcast it over task edges to the full row/column of GEMM consumers
 (the runtime fans the one output copy out via its bcast topologies,
 parsec/remote_dep.c:272-358); each GEMM(m,n,k) accumulates C(m,n) in
 place at C's home rank, chained over k. Tile body is one MXU matmul.
+
+Transpose variants (transa/transb in {"n","t"}): the reader tasks index
+the source collection as (m,k) or (k,m) — collection argument
+expressions are Python, so the swap is a conditional on the TRANSA/
+TRANSB globals — and the GEMM body transposes the tile operand before
+the matmul (XLA folds the transpose into the dot's dimension numbers).
 """
 from __future__ import annotations
 
@@ -21,15 +27,17 @@ NT [ type="int" ]
 KT [ type="int" ]
 ALPHA [ type="float" default="1.0" ]
 BETA [ type="float" default="1.0" ]
+TRANSA [ type="string" default="'n'" ]
+TRANSB [ type="string" default="'n'" ]
 
 READ_A(m, k)
 
 m = 0 .. MT-1
 k = 0 .. KT-1
 
-: descA( m, k )
+: descA( m if TRANSA == 'n' else k, k if TRANSA == 'n' else m )
 
-READ A <- descA( m, k )
+READ A <- descA( m if TRANSA == 'n' else k, k if TRANSA == 'n' else m )
        -> A GEMM( m, 0 .. NT-1, k )
 
 ; (KT - k) * 10
@@ -45,9 +53,9 @@ READ_B(k, n)
 k = 0 .. KT-1
 n = 0 .. NT-1
 
-: descB( k, n )
+: descB( k if TRANSB == 'n' else n, n if TRANSB == 'n' else k )
 
-READ B <- descB( k, n )
+READ B <- descB( k if TRANSB == 'n' else n, n if TRANSB == 'n' else k )
        -> B GEMM( 0 .. MT-1, n, k )
 
 ; (KT - k) * 10
@@ -75,7 +83,9 @@ RW   C <- (k == 0) ? descC( m, n ) : C GEMM( m, n, k-1 )
 
 BODY [type=tpu]
 {
-    C = ops.gemm(C, A, B, float(ALPHA), float(BETA) if k == 0 else 1.0)
+    Ae = A if TRANSA == 'n' else jnp.swapaxes(A, 0, 1)
+    Be = B if TRANSB == 'n' else jnp.swapaxes(B, 0, 1)
+    C = ops.gemm(C, Ae, Be, float(ALPHA), float(BETA) if k == 0 else 1.0)
 }
 END
 """
@@ -90,22 +100,39 @@ def pdgemm_factory() -> "ptg.JDFFactory":
     return _factory
 
 
+def _eff(coll, trans):
+    """(rows, cols) tile-grid / extents / tile dims after the transpose."""
+    if trans == "n":
+        return (coll.mt, coll.nt, coll.lm, coll.ln, coll.mb, coll.nb)
+    return (coll.nt, coll.mt, coll.ln, coll.lm, coll.nb, coll.mb)
+
+
 def pdgemm_taskpool(A: TiledMatrix, B: TiledMatrix, C: TiledMatrix,
                     alpha: float = 1.0, beta: float = 1.0,
+                    transa: str = "n", transb: str = "n",
                     rank: int = 0, nb_ranks: int = 1):
     from .. import ops as ops_module
-    if A.nt != B.mt or A.mt != C.mt or B.nt != C.nt:
+    if transa not in ("n", "t") or transb not in ("n", "t"):
+        raise ValueError(f"pdgemm: transa/transb must be 'n' or 't', got "
+                         f"{transa!r}/{transb!r}")
+    amt, ant, alm, aln, amb, anb = _eff(A, transa)
+    bmt, bnt, blm, bln, bmb, bnb = _eff(B, transb)
+    if ant != bmt or amt != C.mt or bnt != C.nt:
         raise ValueError("pdgemm: inner/outer tile grids do not agree "
-                         f"(A {A.mt}x{A.nt}, B {B.mt}x{B.nt}, C {C.mt}x{C.nt})")
-    if A.ln != B.lm or A.lm != C.lm or B.ln != C.ln:
+                         f"(opA {amt}x{ant}, opB {bmt}x{bnt}, "
+                         f"C {C.mt}x{C.nt})")
+    if aln != blm or alm != C.lm or bln != C.ln:
         raise ValueError("pdgemm: element extents do not agree "
-                         f"(A {A.lm}x{A.ln}, B {B.lm}x{B.ln}, C {C.lm}x{C.ln})")
-    if A.nb != B.mb or A.mb != C.mb or B.nb != C.nb:
+                         f"(opA {alm}x{aln}, opB {blm}x{bln}, "
+                         f"C {C.lm}x{C.ln})")
+    if anb != bmb or amb != C.mb or bnb != C.nb:
         raise ValueError("pdgemm: tile sizes do not conform "
-                         f"(A {A.mb}x{A.nb}, B {B.mb}x{B.nb}, C {C.mb}x{C.nb})")
+                         f"(opA {amb}x{anb}, opB {bmb}x{bnb}, "
+                         f"C {C.mb}x{C.nb})")
     tp = pdgemm_factory().new(descA=A, descB=B, descC=C,
-                              MT=C.mt, NT=C.nt, KT=A.nt,
+                              MT=C.mt, NT=C.nt, KT=ant,
                               ALPHA=float(alpha), BETA=float(beta),
+                              TRANSA=transa, TRANSB=transb,
                               rank=rank, nb_ranks=nb_ranks)
     tp.global_env["ops"] = ops_module
     return tp
@@ -113,9 +140,11 @@ def pdgemm_taskpool(A: TiledMatrix, B: TiledMatrix, C: TiledMatrix,
 
 def pdgemm(context, A: TiledMatrix, B: TiledMatrix, C: TiledMatrix,
            alpha: float = 1.0, beta: float = 1.0,
+           transa: str = "n", transb: str = "n",
            rank: int = 0, nb_ranks: int = 1) -> None:
-    """C <- alpha A B + beta C over tiled collections. Blocking."""
+    """C <- alpha op(A) op(B) + beta C over tiled collections. Blocking."""
     tp = pdgemm_taskpool(A, B, C, alpha=alpha, beta=beta,
+                         transa=transa, transb=transb,
                          rank=rank, nb_ranks=nb_ranks)
     context.add_taskpool(tp)
     context.wait()

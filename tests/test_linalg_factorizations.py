@@ -283,3 +283,33 @@ def test_dposv_multirank_distributed():
     ref = np.linalg.solve(M.astype(np.float64), Bm.astype(np.float64))
     np.testing.assert_allclose(got, ref, atol=5e-3)
     assert fabric.msg_count > 0
+
+
+@pytest.mark.parametrize("transa,transb,m,n,k,nb", [
+    ("t", "n", 96, 64, 80, 16), ("n", "t", 96, 64, 80, 16),
+    ("t", "t", 96, 64, 80, 16),
+    # ragged edge tiles under transposition
+    ("t", "n", 100, 60, 84, 32), ("n", "t", 100, 60, 84, 32),
+    ("t", "t", 100, 60, 84, 32)])
+def test_pdgemm_transposes(ctx, transa, transb, m, n, k, nb):
+    rng = np.random.RandomState(6)
+    Am = (rng.rand(*((k, m) if transa == "t" else (m, k))) - 0.5).astype(
+        np.float32)
+    Bm = (rng.rand(*((n, k) if transb == "t" else (k, n))) - 0.5).astype(
+        np.float32)
+    Cm = (rng.rand(m, n) - 0.5).astype(np.float32)
+    A = TwoDimBlockCyclic(*Am.shape, nb, nb, dtype=np.float32).from_numpy(Am)
+    B = TwoDimBlockCyclic(*Bm.shape, nb, nb, dtype=np.float32).from_numpy(Bm)
+    C = TwoDimBlockCyclic(m, n, nb, nb, dtype=np.float32).from_numpy(Cm)
+    _run(ctx, pdgemm_taskpool(A, B, C, alpha=1.5, beta=0.5,
+                              transa=transa, transb=transb))
+    opA = Am.T if transa == "t" else Am
+    opB = Bm.T if transb == "t" else Bm
+    ref = 1.5 * (opA.astype(np.float64) @ opB.astype(np.float64)) + 0.5 * Cm
+    np.testing.assert_allclose(C.to_numpy(), ref, atol=2e-3)
+
+
+def test_pdgemm_bad_trans_rejected(ctx):
+    A = TwoDimBlockCyclic(64, 64, 32, 32)
+    with pytest.raises(ValueError, match="transa"):
+        pdgemm_taskpool(A, A, A, transa="x")
