@@ -78,9 +78,23 @@ class LocalCommEngine(CommEngine):
         self.tag_register(TAG_PUT_DATA, self._on_put_data)
 
     # -- AMs ----------------------------------------------------------------
+    # transport extension points: subclasses replace these two to carry
+    # the same AM/GET/PUT emulation over another wire (comm/tcp.py)
+    def _transport_post(self, dst: int, src: int, tag: int, payload: Any) -> None:
+        self.fabric._post(dst, src, tag, payload)
+
+    def _transport_drain(self):
+        """Yield pending (src, tag, payload) messages."""
+        inbox = self.fabric.inboxes[self.rank]
+        while True:
+            item = inbox.pop()
+            if item is None:
+                return
+            yield item
+
     def send_am(self, dst: int, tag: int, payload: Any) -> None:
         # self-sends also loop back through the inbox for ordering fidelity
-        self.fabric._post(dst, self.rank, tag, _wire_copy(payload))
+        self._transport_post(dst, self.rank, tag, _wire_copy(payload))
 
     # -- one-sided emulation (GET-req AM + data reply) ----------------------
     def get(self, src_rank: int, remote_handle_id: int,
@@ -124,12 +138,7 @@ class LocalCommEngine(CommEngine):
     # -- progress -----------------------------------------------------------
     def progress(self) -> int:
         n = 0
-        inbox = self.fabric.inboxes[self.rank]
-        while True:
-            item = inbox.pop()
-            if item is None:
-                break
-            src, tag, payload = item
+        for src, tag, payload in self._transport_drain():
             cb = self._tag_cbs.get(tag)
             assert cb is not None, f"rank {self.rank}: no handler for tag {tag}"
             cb(src, payload)

@@ -1,0 +1,238 @@
+"""TCP transport: the cross-process/cross-host comm engine.
+
+Reference behavior being replaced: the funnelled MPI engine is the only
+in-tree transport and carries both the control plane (activations, GET
+requests) and the data plane over two-sided MPI
+(parsec/parsec_mpi_funnelled.c). Here the same activation/GET/PUT
+emulation (inherited from LocalCommEngine) rides length-prefixed pickle
+frames over TCP sockets — one duplex connection per rank pair, receiver
+threads feeding a local inbox, callbacks dispatched from progress() on
+the caller's thread (funnelled semantics preserved).
+
+This is the DCN control-plane story of SURVEY.md §5.8 made concrete: on
+a multi-host TPU deployment the small latency-bound messages travel this
+engine while bulk tile payloads ride the ICI data plane (comm/mesh.py);
+single-host multi-process runs (the tests) carry both over TCP.
+
+Connection setup: rank r listens on ``endpoints[r]``; r dials every rank
+s < r and accepts from every s > r (one connection per unordered pair),
+with a rank-identifying handshake byte frame.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..core.lists import Fifo
+from .engine import TAG_USER_BASE
+from .local import LocalCommEngine, _wire_copy
+
+TAG_BARRIER = TAG_USER_BASE - 1  # reserved by the transport for sync()
+
+
+def free_ports(n: int) -> List[int]:
+    """Reserve n distinct free localhost ports (test/launcher helper)."""
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+class _FabricShim:
+    """Satisfies the tiny surface LocalCommEngine expects of a fabric."""
+
+    def __init__(self, nb_ranks: int) -> None:
+        self.nb_ranks = nb_ranks
+        self.msg_count = 0
+        self.bytes_count = 0
+
+
+class TCPCommEngine(LocalCommEngine):
+    def __init__(self, rank: int, endpoints: List[Tuple[str, int]],
+                 connect_timeout: float = 30.0) -> None:
+        self._inbox: Fifo = Fifo()
+        self._conns: Dict[int, socket.socket] = {}
+        self._send_locks: Dict[int, threading.Lock] = {}
+        self._recv_threads: List[threading.Thread] = []
+        self._closing = False
+        self._barrier_seen = 0
+        self._barrier_release = 0
+        self._barrier_lock = threading.Lock()
+        self._conn_cond = threading.Condition()
+        super().__init__(_FabricShim(len(endpoints)), rank)
+        self.endpoints = endpoints
+        self.connect_timeout = connect_timeout
+        self.tag_register(TAG_BARRIER, self._on_barrier)
+
+        host, port = endpoints[rank]
+        self._listener = socket.create_server((host, port), backlog=len(endpoints))
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"tcp-accept-r{rank}")
+        self._accept_thread.start()
+        # dial lower ranks (they accept); retry while peers boot
+        deadline = time.time() + connect_timeout
+        for peer in range(rank):
+            self._dial(peer, deadline)
+
+    # -- connection management ------------------------------------------
+    def _dial(self, peer: int, deadline: float) -> None:
+        host, port = self.endpoints[peer]
+        while True:
+            try:
+                sock = socket.create_connection((host, port), timeout=2.0)
+                break
+            except OSError:
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"rank {self.rank}: cannot reach rank {peer} at "
+                        f"{host}:{port}")
+                time.sleep(0.05)
+        sock.settimeout(None)  # create_connection left timeout mode on
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.sendall(struct.pack("<I", self.rank))
+        self._register_conn(peer, sock)
+
+    def _accept_loop(self) -> None:
+        try:
+            while not self._closing:
+                sock, _addr = self._listener.accept()
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                hdr = self._recv_exact(sock, 4)
+                if hdr is None:
+                    sock.close()
+                    continue
+                (peer,) = struct.unpack("<I", hdr)
+                self._register_conn(peer, sock)
+        except OSError:
+            return  # listener closed during fini
+
+    def _register_conn(self, peer: int, sock: socket.socket) -> None:
+        with self._conn_cond:
+            self._conns[peer] = sock
+            self._send_locks[peer] = threading.Lock()
+            self._conn_cond.notify_all()
+        t = threading.Thread(target=self._recv_loop, args=(peer, sock),
+                             daemon=True, name=f"tcp-recv-r{self.rank}p{peer}")
+        t.start()
+        self._recv_threads.append(t)
+
+    def _conn_to(self, peer: int) -> socket.socket:
+        with self._conn_cond:
+            ok = self._conn_cond.wait_for(lambda: peer in self._conns,
+                                          timeout=self.connect_timeout)
+            if not ok:
+                raise TimeoutError(
+                    f"rank {self.rank}: no connection from rank {peer}")
+            return self._conns[peer]
+
+    # -- framing --------------------------------------------------------
+    @staticmethod
+    def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def _recv_loop(self, peer: int, sock: socket.socket) -> None:
+        try:
+            while True:
+                hdr = self._recv_exact(sock, 8)
+                if hdr is None:
+                    return  # peer closed
+                (size,) = struct.unpack("<Q", hdr)
+                frame = self._recv_exact(sock, size)
+                if frame is None:
+                    return
+                src, tag, payload = pickle.loads(frame)
+                self._inbox.push((src, tag, payload))
+        except OSError:
+            return  # torn down under us (peer fini'd first)
+
+    # -- the LocalCommEngine transport extension points -----------------
+    def send_am(self, dst: int, tag: int, payload: Any) -> None:
+        # remote sends serialize via pickle (its own copy); only loopback
+        # needs the anti-aliasing wire copy the local fabric applies
+        if dst == self.rank:
+            payload = _wire_copy(payload)
+        self._transport_post(dst, self.rank, tag, payload)
+
+    def _transport_post(self, dst: int, src: int, tag: int, payload: Any) -> None:
+        self.fabric.msg_count += 1
+        if dst == self.rank:
+            self._inbox.push((src, tag, payload))
+            return
+        frame = pickle.dumps((src, tag, payload), protocol=5)
+        self.fabric.bytes_count += len(frame)
+        sock = self._conn_to(dst)
+        with self._send_locks[dst]:
+            sock.sendall(struct.pack("<Q", len(frame)) + frame)
+
+    def _transport_drain(self):
+        while True:
+            item = self._inbox.pop()
+            if item is None:
+                return
+            yield item
+
+    # -- barrier over AMs (ref: ce.sync) --------------------------------
+    def _on_barrier(self, src: int, payload: Any) -> None:
+        # progress() runs on every scheduler thread: counter updates must
+        # be atomic or arrivals are lost and sync() deadlocks
+        with self._barrier_lock:
+            if payload == "arrive":
+                self._barrier_seen += 1
+            else:
+                self._barrier_release += 1
+
+    def sync(self) -> None:
+        if self.nb_ranks == 1:
+            return
+        if self.rank == 0:
+            want = self.nb_ranks - 1
+            while True:
+                with self._barrier_lock:
+                    if self._barrier_seen >= want:
+                        self._barrier_seen -= want
+                        break
+                self.progress()
+                time.sleep(0.001)
+            for peer in range(1, self.nb_ranks):
+                self.send_am(peer, TAG_BARRIER, "release")
+        else:
+            self.send_am(0, TAG_BARRIER, "arrive")
+            while True:
+                with self._barrier_lock:
+                    if self._barrier_release >= 1:
+                        self._barrier_release -= 1
+                        break
+                self.progress()
+                time.sleep(0.001)
+
+    def fini(self) -> None:
+        self._closing = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for sock in self._conns.values():
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass

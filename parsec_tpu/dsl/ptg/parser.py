@@ -240,9 +240,10 @@ def _split_deps(src: str) -> List[str]:
 def _parse_dep(src: str, tc: TaskClassAST) -> DepAST:
     direction = "in" if src.startswith("<-") else "out"
     body = src[2:].strip()
-    # trailing property list [type=...]
+    # trailing property list [type=...]; quoted values may contain
+    # brackets (e.g. shape="(descA.tile_shape(k, k)[0],) * 2")
     props = {}
-    pm = re.search(r"\[([^\]]*)\]\s*$", body)
+    pm = re.search(r'\[((?:"[^"]*"|[^\]"])*)\]\s*$', body)
     if pm and "=" in pm.group(1):
         props = parse_properties(pm.group(0))
         body = body[:pm.start()].strip()

@@ -525,7 +525,10 @@ class PTGTaskpool(Taskpool):
 
     def new_scratch_copy(self, f: FlowAST, env: Dict[str, Any]) -> DataCopy:
         """NEW target: a runtime-allocated buffer (ref: arena-backed NEW
-        tiles). Shape comes from the flow's [shape=...] / taskpool default."""
+        tiles). Shape comes from the flow's [shape=...] property: either
+        the ``AxB`` dimension form or (quoted) one Python expression
+        evaluating to an int/tuple — instance-dependent shapes like
+        partial edge tiles need the latter."""
         shape_src = None
         for d in f.deps:
             if "shape" in d.properties:
@@ -534,7 +537,16 @@ class PTGTaskpool(Taskpool):
         if shape_src is None:
             raise RuntimeError(
                 f"flow {f.name}: NEW target needs a [shape=...] property")
-        shape = tuple(int(Expr(x)(env)) for x in shape_src.split("x"))
+        try:
+            val = Expr(shape_src)(env)
+        except (SyntaxError, NameError, TypeError):
+            val = None
+        if isinstance(val, (tuple, list)):
+            shape = tuple(int(v) for v in val)
+        elif isinstance(val, (int, np.integer)):
+            shape = (int(val),)
+        else:
+            shape = tuple(int(Expr(x)(env)) for x in shape_src.split("x"))
         dt = np.dtype(f_prop(f, "dtype", "float32"))
         data = Data(nb_elts=int(np.prod(shape)))
         copy = DataCopy(data, 0, payload=np.zeros(shape, dtype=dt))

@@ -37,7 +37,7 @@ k = 0 .. KT-1
 RW A <- (k == 0) ? descA( k, k ) : A2 TSMQR( k-1, k, k )
      -> (k < MT-1) ? R TSQRT( k, k+1 )
      -> (k == MT-1) ? descA( k, k )
-WRITE Q -> Q UNMQR( k, k+1 .. NT-1 )  [shape=NBxNB]
+WRITE Q -> Q UNMQR( k, k+1 .. NT-1 )  [shape="(descA.tile_shape(k, k)[0],) * 2"]
 
 ; (KT - k) * 1000
 
@@ -78,7 +78,7 @@ RW R  <- (m == k+1) ? A GEQRT( k ) : R TSQRT( k, m-1 )
       -> (m == MT-1) ? descA( k, k ) : R TSQRT( k, m+1 )
 RW A2 <- (k == 0) ? descA( m, k ) : A2 TSMQR( k-1, m, k )
       -> descA( m, k )
-WRITE Q2 -> Q2 TSMQR( k, m, k+1 .. NT-1 )  [shape=(2*NB)x(2*NB)]
+WRITE Q2 -> Q2 TSMQR( k, m, k+1 .. NT-1 )  [shape="(descA.tile_shape(k, k)[0] + descA.tile_shape(m, k)[0],) * 2"]
 
 ; (KT - k) * 1000 + (MT - m)
 
@@ -126,10 +126,14 @@ def dgeqrf_factory() -> "ptg.JDFFactory":
 
 def dgeqrf_taskpool(A: TiledMatrix, rank: int = 0, nb_ranks: int = 1):
     from .. import ops as ops_module
-    if A.lm % A.mb or A.ln % A.nb or A.mb != A.nb:
-        raise ValueError("dgeqrf requires square tiles evenly dividing the "
-                         "matrix (partial-tile Q scratch shapes NYI)")
     kt = min(A.mt, A.nt)
+    # the panel factorizations need square diagonal tiles (ragged edges
+    # are fine as long as the trailing diagonal tile stays square)
+    last_rows, last_cols = A.tile_shape(kt - 1, kt - 1)
+    if A.mb != A.nb or last_rows != last_cols:
+        raise ValueError(
+            f"dgeqrf needs square diagonal tiles; got mb={A.mb} nb={A.nb}, "
+            f"trailing diagonal tile {last_rows}x{last_cols}")
     tp = dgeqrf_factory().new(descA=A, MT=A.mt, NT=A.nt, KT=kt, NB=A.nb,
                               rank=rank, nb_ranks=nb_ranks)
     tp.global_env["ops"] = ops_module

@@ -1,0 +1,81 @@
+"""Per-process SPMD driver for the TCP transport tests (launched as a
+subprocess by test_comm_tcp.py — real process isolation, the reference's
+mpiexec analog with an actual wire between ranks).
+
+Usage: python tcp_rank_main.py <rank> <nb_ranks> <port0,port1,...> <hops>
+Prints one JSON line with this rank's observations.
+"""
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("PARSEC_MCA_device_tpu_platform", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import parsec_tpu  # noqa: E402
+from parsec_tpu.comm import RemoteDepEngine  # noqa: E402
+from parsec_tpu.comm.tcp import TCPCommEngine  # noqa: E402
+from parsec_tpu.collections import TwoDimBlockCyclic  # noqa: E402
+from parsec_tpu.dsl import ptg  # noqa: E402
+
+CHAIN_JDF = """
+descA [ type="collection" ]
+NB [ type="int" ]
+
+T(k)
+
+k = 0 .. NB
+
+: descA( k, 0 )
+
+RW X <- (k == 0) ? descA( 0, 0 ) : X T( k-1 )
+     -> (k < NB) ? X T( k+1 )
+     -> (k == NB) ? descA( NB, 0 )
+
+BODY
+{
+    X[0, 0] = X[0, 0] + 1.0
+}
+END
+"""
+
+
+def main() -> int:
+    rank = int(sys.argv[1])
+    nb_ranks = int(sys.argv[2])
+    ports = [int(p) for p in sys.argv[3].split(",")]
+    hops = int(sys.argv[4])
+    # payloads above the short limit must take the GET rendezvous over TCP
+    parsec_tpu.params.set_cmdline("runtime_comm_short_limit", "64")
+
+    eng = TCPCommEngine(rank, [("127.0.0.1", p) for p in ports])
+    rdep = RemoteDepEngine(eng)
+    ctx = parsec_tpu.Context(nb_cores=2, comm=rdep, enable_tpu=False)
+    try:
+        mb = 16  # 16x16 f32 tile = 1KB > short limit
+        coll = TwoDimBlockCyclic((hops + 1) * mb, mb, mb, mb, P=nb_ranks,
+                                 Q=1, nodes=nb_ranks, rank=rank,
+                                 dtype=np.float32)
+        coll.name = "descA"
+        tp = ptg.compile_jdf(CHAIN_JDF, name="tcpchain").new(
+            descA=coll, NB=hops, rank=rank, nb_ranks=nb_ranks)
+        ctx.add_taskpool(tp)
+        ctx.wait()
+        eng.sync()  # transport barrier before teardown
+        out = {"rank": rank, "msgs": eng.fabric.msg_count,
+               "bytes": eng.fabric.bytes_count}
+        if coll.rank_of(hops, 0) == rank:
+            out["final"] = float(coll.tile(hops, 0)[0, 0])
+        print(json.dumps(out), flush=True)
+        return 0
+    finally:
+        ctx.fini()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -55,10 +55,24 @@ def test_dgeqrf_single_tile_matches_numpy(ctx):
                                np.abs(Rref), atol=2e-3)
 
 
-def test_dgeqrf_rejects_partial_tiles(ctx):
-    A = TwoDimBlockCyclic(100, 100, 32, 32, dtype=np.float32)
+def test_dgeqrf_partial_edge_tiles(ctx):
+    """Ragged edges factor correctly (Q scratch shapes are computed per
+    instance from the tile geometry)."""
+    rng = np.random.RandomState(13)
+    M = (rng.rand(100, 100) - 0.5).astype(np.float32)
+    A = TwoDimBlockCyclic(100, 100, 32, 32, dtype=np.float32).from_numpy(M)
+    _run(ctx, dgeqrf_taskpool(A))
+    R = np.triu(A.to_numpy())
+    np.testing.assert_allclose(
+        R.T @ R, M.astype(np.float64).T @ M.astype(np.float64), atol=2e-3)
+
+
+def test_dgeqrf_rejects_nonsquare_diag_tiles(ctx):
+    # trailing diagonal tile 32x26: not factorable panel-wise
     with pytest.raises(ValueError):
-        dgeqrf_taskpool(A)
+        dgeqrf_taskpool(TwoDimBlockCyclic(100, 90, 32, 32, dtype=np.float32))
+    with pytest.raises(ValueError):
+        dgeqrf_taskpool(TwoDimBlockCyclic(64, 64, 32, 16, dtype=np.float32))
 
 
 # --------------------------------------------------------------------- #
