@@ -32,7 +32,8 @@ from .matrix import TiledMatrix, TwoDimBlockCyclic
 __all__ = ["apply", "apply_taskpool", "map_operator", "map_operator_taskpool",
            "reduce_col", "reduce_row", "reduce_all",
            "reduce_col_taskpool", "reduce_row_taskpool", "reduce_all_taskpool",
-           "broadcast", "broadcast_taskpool", "band_to_rect_taskpool"]
+           "broadcast", "broadcast_taskpool", "band_to_rect_taskpool",
+           "allreduce", "allreduce_taskpool"]
 
 # --------------------------------------------------------------------------
 # apply: elementwise unary operation over (triangular) tile sets
@@ -425,6 +426,29 @@ def reduce_all(context, A, operation, dest=None, op_args=None):
     context.add_taskpool(tp)
     context.wait()
     return dest
+
+
+def allreduce_taskpool(A: TiledMatrix, operation: Callable,
+                       op_args: Any = None, rank: int = 0,
+                       nb_ranks: int = 1):
+    """Every tile of A folds to one value which then lands back in every
+    tile of A — the reduce+broadcast composition the reference's DTD
+    allreduce test builds by hand (no allreduce primitive exists in the
+    runtime; reductions and broadcasts are task graphs, SURVEY.md §2.4).
+    Returns one compound taskpool (reduce ; broadcast)."""
+    from ..runtime.compound import compose
+    red, scratch = reduce_all_taskpool(A, operation, None, op_args,
+                                       rank=rank, nb_ranks=nb_ranks)
+    bc = broadcast_taskpool(scratch, A, root=(0, 0), rank=rank,
+                            nb_ranks=nb_ranks)
+    return compose(red, bc)
+
+
+def allreduce(context, A, operation, op_args=None):
+    """In-place allreduce over A's tiles. Blocking."""
+    tp = allreduce_taskpool(A, operation, op_args)
+    context.add_taskpool(tp)
+    context.wait()
 
 
 # --------------------------------------------------------------------------

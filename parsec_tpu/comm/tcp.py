@@ -29,6 +29,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..core.lists import Fifo
 from .engine import TAG_USER_BASE
+from ..utils import logging as plog
 from .local import LocalCommEngine, _wire_copy
 
 TAG_BARRIER = TAG_USER_BASE - 1  # reserved by the transport for sync()
@@ -67,6 +68,7 @@ class TCPCommEngine(LocalCommEngine):
         self._barrier_seen = 0
         self._barrier_release = 0
         self._barrier_lock = threading.Lock()
+        self._stat_lock = threading.Lock()
         self._conn_cond = threading.Condition()
         super().__init__(_FabricShim(len(endpoints)), rank)
         self.endpoints = endpoints
@@ -107,10 +109,17 @@ class TCPCommEngine(LocalCommEngine):
             while not self._closing:
                 sock, _addr = self._listener.accept()
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                hdr = self._recv_exact(sock, 4)
+                # bounded handshake: a stray connection that never sends
+                # its rank must not starve accepts from real peers
+                sock.settimeout(5.0)
+                try:
+                    hdr = self._recv_exact(sock, 4)
+                except OSError:
+                    hdr = None
                 if hdr is None:
                     sock.close()
                     continue
+                sock.settimeout(None)
                 (peer,) = struct.unpack("<I", hdr)
                 self._register_conn(peer, sock)
         except OSError:
@@ -160,6 +169,11 @@ class TCPCommEngine(LocalCommEngine):
                 self._inbox.push((src, tag, payload))
         except OSError:
             return  # torn down under us (peer fini'd first)
+        except Exception as exc:  # frame desync / unpickle failure: a
+            # silent receiver death would hang both ranks — make it loud
+            plog.warning("tcp rank %d: receiver for peer %d died: %r",
+                         self.rank, peer, exc)
+            return
 
     # -- the LocalCommEngine transport extension points -----------------
     def send_am(self, dst: int, tag: int, payload: Any) -> None:
@@ -170,12 +184,14 @@ class TCPCommEngine(LocalCommEngine):
         self._transport_post(dst, self.rank, tag, payload)
 
     def _transport_post(self, dst: int, src: int, tag: int, payload: Any) -> None:
-        self.fabric.msg_count += 1
+        with self._stat_lock:
+            self.fabric.msg_count += 1
         if dst == self.rank:
             self._inbox.push((src, tag, payload))
             return
         frame = pickle.dumps((src, tag, payload), protocol=5)
-        self.fabric.bytes_count += len(frame)
+        with self._stat_lock:
+            self.fabric.bytes_count += len(frame)
         sock = self._conn_to(dst)
         with self._send_locks[dst]:
             sock.sendall(struct.pack("<Q", len(frame)) + frame)

@@ -138,3 +138,23 @@ def test_band_to_rect(ctx):
             np.testing.assert_allclose(
                 got[TILE:2 * TILE, sl],
                 M[(k - 1) * TILE:k * TILE, k * TILE:(k + 1) * TILE], rtol=0)
+
+
+def test_allreduce_in_place(ctx):
+    """reduce+broadcast composition: every tile ends with the global fold
+    (the reference's DTD allreduce pattern as one compound taskpool)."""
+    import numpy as np
+    from parsec_tpu.collections import TwoDimBlockCyclic
+    from parsec_tpu.collections.ops import allreduce
+
+    rng = np.random.RandomState(5)
+    M = rng.rand(6 * 4, 4 * 4).astype(np.float32)
+    A = TwoDimBlockCyclic(6 * 4, 4 * 4, 4, 4, dtype=np.float32).from_numpy(M)
+    allreduce(ctx, A, lambda a, b, args: np.maximum(a, b))
+    # per-tile elementwise max across all 24 tiles
+    ref = M.reshape(6, 4, 4, 4).transpose(0, 2, 1, 3).reshape(24, 4, 4)
+    expect = np.maximum.reduce(ref)
+    out = A.to_numpy()
+    for i in range(6):
+        for j in range(4):
+            np.testing.assert_allclose(out[i*4:(i+1)*4, j*4:(j+1)*4], expect)
