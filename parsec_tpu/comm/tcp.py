@@ -121,6 +121,13 @@ class TCPCommEngine(LocalCommEngine):
                     continue
                 sock.settimeout(None)
                 (peer,) = struct.unpack("<I", hdr)
+                with self._conn_cond:
+                    known = peer in self._conns
+                if peer >= self.nb_ranks or peer == self.rank or known:
+                    # stray/duplicate connection: never displace a real
+                    # peer's socket
+                    sock.close()
+                    continue
                 self._register_conn(peer, sock)
         except OSError:
             return  # listener closed during fini
@@ -184,13 +191,14 @@ class TCPCommEngine(LocalCommEngine):
         self._transport_post(dst, self.rank, tag, payload)
 
     def _transport_post(self, dst: int, src: int, tag: int, payload: Any) -> None:
-        with self._stat_lock:
-            self.fabric.msg_count += 1
         if dst == self.rank:
+            with self._stat_lock:
+                self.fabric.msg_count += 1
             self._inbox.push((src, tag, payload))
             return
         frame = pickle.dumps((src, tag, payload), protocol=5)
         with self._stat_lock:
+            self.fabric.msg_count += 1
             self.fabric.bytes_count += len(frame)
         sock = self._conn_to(dst)
         with self._send_locks[dst]:

@@ -239,11 +239,36 @@ class Context:
         terminate (ref: parsec_context_wait scheduling.c:766-790)."""
         self.start()
         es0 = self.execution_streams[0]
-        context_wait_loop(es0)
+        # the reference binds EVERY ES including the master: pin the
+        # caller's thread for the duration of the loop, then restore
+        # (it is an application thread, not ours to keep pinned)
+        from .vpmap import bind_current_thread, binding_for
+        core = binding_for(0, self.nb_cores)
+        prev_affinity = None
+        if core is not None:
+            try:
+                import os as _os
+                prev_affinity = _os.sched_getaffinity(0)
+            except (AttributeError, OSError):
+                prev_affinity = None
+            bind_current_thread(core)
+        try:
+            context_wait_loop(es0)
+        finally:
+            if prev_affinity is not None:
+                try:
+                    import os as _os
+                    _os.sched_setaffinity(0, prev_affinity)
+                except (AttributeError, OSError):
+                    pass
         self._started = False
         self.raise_pending_error()
 
     def _worker_main(self, es: ExecutionStream, widx: int) -> None:
+        from .vpmap import bind_current_thread, binding_for
+        core = binding_for(es.th_id, self.nb_cores)
+        if core is not None:
+            bind_current_thread(core)  # ref: parsec_bindthread at ES boot
         while True:
             with self._work_cond:
                 self._work_cond.wait_for(

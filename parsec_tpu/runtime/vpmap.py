@@ -3,9 +3,9 @@
 Reference behavior: virtual processes partition compute threads into
 work-stealing domains; layouts come from flat/hwloc/file/parameters init
 (ref: parsec/vpmap.c, parsec/parsec.c:549-592). Thread→core binding is in
-parsec/bindthread.c. On the TPU host we default to one flat VP (hwloc
-binding is a no-op under the Python threading model; a later C++ executor
-can bind).
+parsec/bindthread.c — reproduced here with os.sched_setaffinity (Linux),
+opt-in via the ``bind_threads`` MCA param ("rr" round-robin over the
+allowed cores, or an explicit core list "0,2,4,..." like --parsec_bind).
 """
 from __future__ import annotations
 
@@ -73,3 +73,37 @@ def default_nb_cores() -> int:
     if env:
         return max(1, int(env))
     return max(1, os.cpu_count() or 1)
+
+
+def bind_current_thread(core: int) -> bool:
+    """Pin the CALLING thread to one core (ref: parsec_bindthread,
+    bindthread.c). Returns False where unsupported (non-Linux) or the
+    core is not in the process's allowed set."""
+    try:
+        os.sched_setaffinity(0, {core})
+        return True
+    except (AttributeError, OSError, ValueError):
+        return False
+
+
+def binding_for(th_id: int, nb_threads: int) -> Optional[int]:
+    """The core th_id should pin to under the ``bind_threads`` MCA param,
+    or None when binding is off (the default)."""
+    from ..utils.params import params
+    spec = params.get("bind_threads")
+    if not spec:
+        return None
+    try:
+        allowed = sorted(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        return None
+    if spec == "rr":
+        return allowed[th_id % len(allowed)]
+    cores = []
+    for part in str(spec).split(","):
+        part = part.strip()
+        if part.isdigit() and int(part) in allowed:
+            cores.append(int(part))
+    if not cores:
+        return None
+    return cores[th_id % len(cores)]

@@ -190,6 +190,8 @@ params = ParamRegistry()
 def register_core_params() -> None:
     """Default knobs carried over from the reference (SURVEY.md §5.6)."""
     params.reg_string("sched", "lfq", "scheduler module to use")
+    params.reg_string("bind_threads", "",
+                      "worker core binding: \"rr\" or a core list \"0,2,4\" (ref --parsec_bind)")
     params.reg_bool("ptg_codegen", True,
                     "generate per-task-class successor/goal code (jdf2c analog)")
     params.reg_sizet("debug_history_size", 0,

@@ -2,7 +2,8 @@
 subprocess by test_comm_tcp.py — real process isolation, the reference's
 mpiexec analog with an actual wire between ranks).
 
-Usage: python tcp_rank_main.py <rank> <nb_ranks> <port0,port1,...> <hops>
+Usage: python tcp_rank_main.py <rank> <nb_ranks> <port0,...> <hops> [mode]
+mode: "ptg" (default — chain JDF) or "dtd" (insert-task chain).
 Prints one JSON line with this rank's observations.
 """
 import json
@@ -45,11 +46,48 @@ END
 """
 
 
+def run_dtd(ctx, eng, rank, nb_ranks, hops):
+    """Cross-rank DTD chain: tasks alternate ranks on one tile."""
+    from parsec_tpu import dtd
+    from parsec_tpu.collections import DictCollection
+    from parsec_tpu.dsl.dtd import AFFINITY, INOUT, INPUT, VALUE, unpack_args
+
+    coll = DictCollection(nodes=nb_ranks, rank=rank)
+    coll.name = "C"
+    coll.add("x", 0, np.zeros(512) if rank == 0 else None)  # 4KB payload
+    anchors = {}
+    for r in range(nb_ranks):
+        a = DictCollection(nodes=nb_ranks, rank=rank)
+        a.name = f"anchor{r}"
+        a.add("a", r, np.zeros(1) if r == rank else None)
+        anchors[r] = a
+    tp = dtd.taskpool_new("tcpdtd")
+    ctx.add_taskpool(tp)
+    tile = tp.tile_of(coll, "x")
+
+    def bump(es, task):
+        x, anchor, k = unpack_args(task)
+        assert x[0] == k, f"task {k} saw {x[0]}"
+        x[0] += 1.0
+
+    for k in range(hops):
+        at = tp.tile_of(anchors[k % nb_ranks], "a")
+        tp.insert_task(bump, (tile, INOUT), (at, INPUT | AFFINITY),
+                       (k, VALUE))
+    tp.data_flush_all()
+    tp.wait()
+    ctx.wait()
+    if rank == 0:
+        return float(coll.data_of("x").get_copy(0).payload[0])
+    return None
+
+
 def main() -> int:
     rank = int(sys.argv[1])
     nb_ranks = int(sys.argv[2])
     ports = [int(p) for p in sys.argv[3].split(",")]
     hops = int(sys.argv[4])
+    mode = sys.argv[5] if len(sys.argv) > 5 else "ptg"
     # payloads above the short limit must take the GET rendezvous over TCP
     parsec_tpu.params.set_cmdline("runtime_comm_short_limit", "64")
 
@@ -57,6 +95,15 @@ def main() -> int:
     rdep = RemoteDepEngine(eng)
     ctx = parsec_tpu.Context(nb_cores=2, comm=rdep, enable_tpu=False)
     try:
+        if mode == "dtd":
+            final = run_dtd(ctx, eng, rank, nb_ranks, hops)
+            eng.sync()
+            out = {"rank": rank, "msgs": eng.fabric.msg_count,
+                   "bytes": eng.fabric.bytes_count}
+            if final is not None:
+                out["final"] = final
+            print(json.dumps(out), flush=True)
+            return 0
         mb = 16  # 16x16 f32 tile = 1KB > short limit
         coll = TwoDimBlockCyclic((hops + 1) * mb, mb, mb, mb, P=nb_ranks,
                                  Q=1, nodes=nb_ranks, rank=rank,

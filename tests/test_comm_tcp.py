@@ -120,3 +120,31 @@ def test_spmd_chain_across_processes(nb_ranks):
     assert finals == [float(hops + 1)]
     assert all(o["msgs"] > 0 for o in outs)
     assert sum(o["bytes"] for o in outs) > hops * 1024  # data went over TCP
+
+
+def test_dtd_chain_across_processes():
+    """DTD cross-rank chain over real sockets: the (tile, seq) data plane
+    with the 4KB payload taking the GET rendezvous."""
+    nb_ranks, hops = 2, 6
+    ports = free_ports(nb_ranks)
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.join(ROOT, "tests", "tcp_rank_main.py"),
+         str(r), str(nb_ranks), ",".join(map(str, ports)), str(hops),
+         "dtd"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+        for r in range(nb_ranks)]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, (out, err)
+        outs.append(json.loads(out.strip().splitlines()[-1]))
+    finals = [o["final"] for o in outs if "final" in o]
+    assert finals == [float(hops)]

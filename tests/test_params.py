@@ -69,3 +69,66 @@ def test_file_values(reg, tmp_path, monkeypatch):
     reg.reg_int("foo", 1)
     assert reg.get("foo") == 13
     assert reg.source("foo") == "file"
+
+
+def test_thread_binding_param():
+    """bind_threads MCA param (ref: --parsec_bind / bindthread.c)."""
+    import os
+    import parsec_tpu
+    from parsec_tpu.runtime.vpmap import binding_for, bind_current_thread
+
+    parsec_tpu.params.reset()
+    assert binding_for(0, 4) is None  # off by default
+    allowed = sorted(os.sched_getaffinity(0))
+    parsec_tpu.params.set_cmdline("bind_threads", "rr")
+    try:
+        assert binding_for(0, 4) == allowed[0]
+        assert binding_for(1, 4) == allowed[1 % len(allowed)]
+        parsec_tpu.params.set_cmdline("bind_threads",
+                                      f"{allowed[0]},{allowed[-1]}")
+        assert binding_for(0, 2) == allowed[0]
+        assert binding_for(1, 2) == allowed[-1]
+        # binding the calling thread really takes effect and is undoable
+        before = os.sched_getaffinity(0)
+        try:
+            assert bind_current_thread(allowed[0])
+            assert os.sched_getaffinity(0) == {allowed[0]}
+        finally:
+            os.sched_setaffinity(0, before)
+    finally:
+        parsec_tpu.params.reset()
+
+
+def test_workers_bound_when_enabled():
+    import parsec_tpu
+    import os
+    allowed = sorted(os.sched_getaffinity(0))
+    if len(allowed) < 2:
+        import pytest
+        pytest.skip("needs >= 2 allowed cores")
+    parsec_tpu.params.reset()
+    parsec_tpu.params.set_cmdline("bind_threads", "rr")
+    try:
+        ctx = parsec_tpu.Context(nb_cores=2, enable_tpu=False)
+        from parsec_tpu import dtd
+        tp = dtd.taskpool_new()
+        ctx.add_taskpool(tp)
+        seen = {}
+
+        def probe(es, task):
+            seen[es.th_id] = os.sched_getaffinity(0)
+
+        for _ in range(8):
+            tp.insert_task(probe)
+        # keep inserting until worker thread 1 has actually run a task
+        # (otherwise the assertion would be vacuous)
+        for _ in range(40):
+            tp.insert_task(probe)
+            if 1 in seen:
+                break
+        tp.wait()
+        ctx.fini()
+        assert 1 in seen, "worker thread never ran a task"
+        assert seen[1] == {allowed[1 % len(allowed)]}
+    finally:
+        parsec_tpu.params.reset()
