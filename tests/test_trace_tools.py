@@ -241,3 +241,11 @@ def test_ptgpp_rejects_bad_jdf(tmp_path, capsys):
     bad.write_text("STEP(k)\nk = 0 .. 3\n: nowhere( k )\nBODY\n{\n pass\n}\nEND\n")
     assert ptgpp.main(["--check", str(bad)]) == 1
     assert "bad.jdf" in capsys.readouterr().err
+
+
+def test_counter_aggregate_watch_mode(trace_files, capsys):
+    paths = [p for p, _ in trace_files]
+    assert counter_aggregate.main(
+        ["--watch", "0.05", "--watch-rounds", "2"] + paths) == 0
+    out = capsys.readouterr().out
+    assert out.count("rank files") == 2  # two refreshes printed

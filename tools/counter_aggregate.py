@@ -6,11 +6,17 @@ plus an optional binned timeline for plotting).
 
     python tools/counter_aggregate.py trace.rank*.ptt
     python tools/counter_aggregate.py --timeline 10 --json out.json *.ptt
+    python tools/counter_aggregate.py --watch 2 trace.rank*.ptt
+
+``--watch N`` re-reads the trace files every N seconds and reprints the
+fleet table — the offline stand-in for the reference's live GUI fed by
+PAPI-SDE pushes.
 """
 import argparse
 import json
 import os
 import sys
+import time
 from collections import defaultdict
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -68,29 +74,54 @@ def timeline(series, nbins):
     return out
 
 
+def _print_table(agg, out=None):
+    out = out or sys.stdout
+    for key, a in agg.items():
+        f = a["fleet"]
+        print(f"{key}: n={f['n']} min={f['min']:g} max={f['max']:g} "
+              f"mean={f['mean']:g} sum_of_last={f['sum_of_last']:g}",
+              file=out)
+        for rank, r in a["ranks"].items():
+            print(f"  rank {rank}: n={r['n']} last={r['last']:g} "
+                  f"mean={r['mean']:g}", file=out)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("paths", nargs="+", help=".ptt trace files")
     ap.add_argument("--timeline", type=int, metavar="NBINS", default=0)
     ap.add_argument("--json", metavar="PATH",
                     help="also write the aggregate as JSON")
+    ap.add_argument("--watch", type=float, metavar="SECONDS", default=0,
+                    help="re-read and reprint every N seconds "
+                         "(live-aggregator mode; ^C to stop)")
+    ap.add_argument("--watch-rounds", type=int, default=0,
+                    help="stop --watch after N refreshes (0 = forever)")
     args = ap.parse_args(argv)
-    series = collect(args.paths)
-    agg = aggregate(series)
-    for key, a in agg.items():
-        f = a["fleet"]
-        print(f"{key}: n={f['n']} min={f['min']:g} max={f['max']:g} "
-              f"mean={f['mean']:g} sum_of_last={f['sum_of_last']:g}")
-        for rank, r in a["ranks"].items():
-            print(f"  rank {rank}: n={r['n']} last={r['last']:g} "
-                  f"mean={r['mean']:g}")
-    doc = {"aggregate": agg}
-    if args.timeline:
-        doc["timeline"] = timeline(series, args.timeline)
-    if args.json:
-        with open(args.json, "w") as fh:
-            json.dump(doc, fh, indent=1)
-    return 0
+    rounds = 0
+    while True:
+        existing = [p for p in args.paths if os.path.exists(p)]
+        series = collect(existing)
+        agg = aggregate(series)
+        if args.watch:
+            print(f"\n== {time.strftime('%H:%M:%S')} "
+                  f"({len(existing)}/{len(args.paths)} rank files) ==")
+        _print_table(agg)
+        doc = {"aggregate": agg}
+        if args.timeline:
+            doc["timeline"] = timeline(series, args.timeline)
+        if args.json:
+            with open(args.json, "w") as fh:
+                json.dump(doc, fh, indent=1)
+        if not args.watch:
+            return 0
+        rounds += 1
+        if args.watch_rounds and rounds >= args.watch_rounds:
+            return 0
+        try:
+            time.sleep(args.watch)
+        except KeyboardInterrupt:
+            return 0
 
 
 if __name__ == "__main__":
