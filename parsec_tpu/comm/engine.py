@@ -105,4 +105,5 @@ TAG_GET_DATA = 3
 TAG_PUT_DATA = 4
 TAG_TERMDET = 5
 TAG_DTD_DATA = 6
+TAG_MEM_PUT = 7
 TAG_USER_BASE = 16

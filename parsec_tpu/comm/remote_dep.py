@@ -29,7 +29,7 @@ from ..runtime.scheduling import schedule
 from ..utils import logging as plog
 from ..utils.params import params
 from .engine import (CommEngine, TAG_ACTIVATE, TAG_DTD_DATA, TAG_GET_DATA,
-                     TAG_TERMDET)
+                     TAG_MEM_PUT, TAG_TERMDET)
 
 _log = plog.comm_stream
 
@@ -75,6 +75,12 @@ class RemoteDepEngine:
         self._dtd_expect: Dict[Tuple, Callable] = {}
         # rendezvous bookkeeping: handle_id -> (taskpool, remaining, handle)
         self._pending_handles: Dict[int, Tuple] = {}
+        # memory writebacks buffered until the taskpool's startup has
+        # credited the expected arrivals as pending actions (delivering
+        # sooner would drive runtime_actions negative):
+        # wire_id -> [(src, msg), ...]; ready ids in _mem_ready
+        self._early_mem_puts: Dict[int, List[Tuple[int, Dict]]] = {}
+        self._mem_ready: set = set()
         # activations that raced ahead of our local taskpool registration
         # (a faster rank can start pool N+1 while we are still in pool
         # N's wait; the reference holds such activations until the
@@ -82,10 +88,12 @@ class RemoteDepEngine:
         self._early_activations: Dict[int, List[Tuple[int, Dict]]] = {}
         ce.tag_register(TAG_ACTIVATE, self._on_activate)
         ce.tag_register(TAG_DTD_DATA, self._on_dtd_data)
+        ce.tag_register(TAG_MEM_PUT, self._on_mem_put)
         ce.tag_register(TAG_TERMDET, self._on_termdet)
         ce.on_get_served = self.note_get_served
         self.stats = {"activates_sent": 0, "activates_recv": 0,
-                      "dtd_sends": 0, "dtd_recvs": 0, "forwards": 0}
+                      "dtd_sends": 0, "dtd_recvs": 0, "forwards": 0,
+                      "mem_puts_sent": 0, "mem_puts_recv": 0}
 
     # ------------------------------------------------------------------ #
     # context integration                                                #
@@ -227,6 +235,55 @@ class RemoteDepEngine:
         if remaining == 0:
             self.ce.mem_unregister(handle)  # release the snapshot buffer
             tp.pending_action_done(1)
+
+    # ------------------------------------------------------------------ #
+    # memory writeback plane: a task's out-dep targets a collection tile
+    # owned by another rank (ref: the final write of a dataflow edge to
+    # remote memory travels the same remote-dep machinery; the owner
+    # counts statically-known incoming writes as runtime actions so its
+    # termination waits for them)                                        #
+    # ------------------------------------------------------------------ #
+    def mem_writeback(self, tp, coll_name: str, args: Tuple, arr,
+                      dst: int) -> None:
+        """arr=None is a release-only notification: the owner counted
+        this edge but the producing flow carried no data copy — retire
+        the pending action without writing."""
+        self.ce.send_am(dst, TAG_MEM_PUT,
+                        {"tp_id": tp.comm_tp_id, "coll": coll_name,
+                         "args": tuple(args),
+                         "data": None if arr is None else np.asarray(arr)})
+        self.stats["mem_puts_sent"] += 1
+
+    def mem_puts_ready(self, tp) -> None:
+        """The taskpool counted its expected incoming writebacks (its
+        startup ran add_pending_action): deliver buffered puts and stop
+        buffering for this pool."""
+        with self._lock:
+            self._mem_ready.add(tp.comm_tp_id)
+            held = self._early_mem_puts.pop(tp.comm_tp_id, [])
+        for src, msg in held:
+            self._on_mem_put(src, msg)
+
+    def _on_mem_put(self, src: int, msg: Dict) -> None:
+        with self._lock:
+            tp = self._taskpools.get(msg["tp_id"])
+            if tp is None or msg["tp_id"] not in self._mem_ready:
+                self._early_mem_puts.setdefault(
+                    msg["tp_id"], []).append((src, msg))
+                return
+        self.stats["mem_puts_recv"] += 1
+        if msg["data"] is not None:
+            # generic collection write (mirrors the local writeback path;
+            # set_tile is matrix-only)
+            dest = tp.global_env[msg["coll"]].data_of(*msg["args"])
+            host = dest.host_copy()
+            arr = np.asarray(msg["data"])
+            if host.payload is None:
+                host.payload = np.array(arr)
+            else:
+                np.copyto(Data.materialize_host(host), arr)
+            dest.version_bump(0)
+        tp.pending_action_done(1)
 
     # ------------------------------------------------------------------ #
     # DTD data plane                                                     #

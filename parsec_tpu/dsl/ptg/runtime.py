@@ -498,14 +498,26 @@ class PTGTaskpool(Taskpool):
     def _startup(self, context, tp) -> List[Task]:
         total = 0
         startup: List[Task] = []
+        count_foreign = self.nb_ranks > 1 and self.comm is not None
+        expected_mem_puts = 0
         for tc in self._classes.values():
             for locals_ in tc.iter_space():
                 env = tc.env_of(locals_)
                 if tc.rank_of_instance(env) != self.rank:
+                    if count_foreign:
+                        # a foreign task whose out-dep targets MY memory
+                        # will ship a writeback: hold termination for it
+                        expected_mem_puts += self._count_mem_puts_to_me(
+                            tc, env)
                     continue
                 total += 1
                 if tc.goal_of(locals_, env) == 0:
                     startup.append(tc.make_task(locals_, None))
+        if expected_mem_puts:
+            self.add_pending_action(expected_mem_puts)
+        if count_foreign:
+            # expectations credited: buffered early arrivals may deliver
+            self.comm.mem_puts_ready(self)
         self.nb_local_tasks = total
         self.set_nb_tasks(total)
         plog.debug.verbose(4, "ptg %s: %d local tasks, %d startup",
@@ -522,6 +534,23 @@ class PTGTaskpool(Taskpool):
         if es is None:
             return data.host_copy()
         return data.sync_to_host(es.context.devices)
+
+    def _count_mem_puts_to_me(self, tc: "PTGTaskClass",
+                              env: Dict[str, Any]) -> int:
+        """#memory out-deps of one FOREIGN instance that land on a tile
+        this rank owns (must mirror writeback_outputs' emission)."""
+        n = 0
+        for i, f in enumerate(tc.ast.flows):
+            if f.is_ctl or not (tc.flows[i].access & FlowAccess.WRITE):
+                continue
+            for d in f.deps_out():
+                t = d.resolve(env)
+                if t is None or t.kind != "memory":
+                    continue
+                coll = self.global_env[t.collection]
+                if coll.rank_of(*[a(env) for a in t.args]) == self.rank:
+                    n += 1
+        return n
 
     def new_scratch_copy(self, f: FlowAST, env: Dict[str, Any]) -> DataCopy:
         """NEW target: a runtime-allocated buffer (ref: arena-backed NEW
@@ -563,14 +592,33 @@ class PTGTaskpool(Taskpool):
             if f.is_ctl or not (tc.flows[i].access & FlowAccess.WRITE):
                 continue
             copy = task.data[i].data_out or task.data[i].data_in
-            if copy is None:
-                continue
+            src_host = None
+            if copy is not None:
+                src_host = copy if copy.device_id == 0 else None
+                if src_host is None and copy.data is not None:
+                    src_host = self.pull_newest_to_host(es, copy.data)
             for d in f.deps_out():
                 t = d.resolve(env)
                 if t is None or t.kind != "memory":
                     continue
                 coll = self.global_env[t.collection]
                 args = [a(env) for a in t.args]
+                dst_rank = coll.rank_of(*args)
+                if dst_rank != self.rank:
+                    # cross-rank memory writeback: ship to the owner, who
+                    # counted this arrival as a pending runtime action at
+                    # startup; a copy-less flow still sends a release-only
+                    # notification so the owner's count retires (the
+                    # static count cannot see dynamic copy-None)
+                    assert self.comm is not None, \
+                        "remote memory target without a comm engine"
+                    payload = src_host.payload if src_host is not None \
+                        else None
+                    self.comm.mem_writeback(self, t.collection, tuple(args),
+                                            payload, dst_rank)
+                    continue
+                if copy is None:
+                    continue
                 dest = coll.data_of(*args)
                 if copy.data is dest:
                     # already home: the Data owns the newest (device) copy;
@@ -578,9 +626,6 @@ class PTGTaskpool(Taskpool):
                     # sync lazily (a per-task d2h pull would serialize the
                     # DAG on transfer latency)
                     continue
-                src_host = copy if copy.device_id == 0 else None
-                if src_host is None and copy.data is not None:
-                    src_host = self.pull_newest_to_host(es, copy.data)
                 dh = self.host_copy_of(es, dest)
                 if dh.payload is None:
                     dh.payload = np.array(np.asarray(src_host.payload))

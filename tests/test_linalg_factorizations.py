@@ -327,3 +327,43 @@ def test_pdgemm_bad_trans_rejected(ctx):
     A = TwoDimBlockCyclic(64, 64, 32, 32)
     with pytest.raises(ValueError, match="transa"):
         pdgemm_taskpool(A, A, A, transa="x")
+
+
+def test_dgeqrf_multirank_distributed():
+    """QR across 4 ranks. The R triangle returns to descA(k,k) from the
+    END of each TSQRT chain — a cross-rank memory writeback."""
+    from conftest import spmd
+    from parsec_tpu.comm import RemoteDepEngine
+    from parsec_tpu.ops import dgeqrf_taskpool
+
+    nb_ranks, n, nb = 4, 128, 32
+    rng = np.random.RandomState(21)
+    M = (rng.rand(n, n) - 0.5).astype(np.float32)
+
+    def rank_fn(rank, fabric):
+        import parsec_tpu
+        eng = RemoteDepEngine(fabric.engine(rank))
+        c = parsec_tpu.Context(nb_cores=1, comm=eng, enable_tpu=False)
+        try:
+            A = TwoDimBlockCyclic(n, n, nb, nb, P=2, Q=2, nodes=nb_ranks,
+                                  rank=rank, dtype=np.float32)
+            A.name = "descA"
+            for (i, j) in A.local_tiles():
+                np.copyto(A.tile(i, j),
+                          M[i * nb:(i + 1) * nb, j * nb:(j + 1) * nb])
+            tp = dgeqrf_taskpool(A, rank=rank, nb_ranks=nb_ranks)
+            c.add_taskpool(tp)
+            c.wait()
+            return {(i, j): np.array(A.tile(i, j))
+                    for (i, j) in A.local_tiles()}
+        finally:
+            c.fini()
+
+    results, _ = spmd(nb_ranks, rank_fn)
+    got = np.zeros((n, n), np.float64)
+    for local in results:
+        for (i, j), t in local.items():
+            got[i * nb:(i + 1) * nb, j * nb:(j + 1) * nb] = t
+    R = np.triu(got)
+    ref = M.astype(np.float64).T @ M.astype(np.float64)
+    np.testing.assert_allclose(R.T @ R, ref, atol=2e-3)

@@ -100,7 +100,10 @@ def main(argv=None):
     args = ap.parse_args(argv)
     rounds = 0
     while True:
-        existing = [p for p in args.paths if os.path.exists(p)]
+        # only --watch tolerates not-yet-written rank files; one-shot mode
+        # must fail loudly on a bad path
+        existing = [p for p in args.paths if os.path.exists(p)] \
+            if args.watch else args.paths
         series = collect(existing)
         agg = aggregate(series)
         if args.watch:
