@@ -367,3 +367,42 @@ def test_dgeqrf_multirank_distributed():
     R = np.triu(got)
     ref = M.astype(np.float64).T @ M.astype(np.float64)
     np.testing.assert_allclose(R.T @ R, ref, atol=2e-3)
+
+
+def test_dgetrf_multirank_distributed():
+    """LU across 4 ranks (all writes are affinity-local; panels travel
+    task edges)."""
+    from conftest import spmd
+    from parsec_tpu.comm import RemoteDepEngine
+    from parsec_tpu.ops import dgetrf_nopiv_taskpool, make_diag_dominant
+
+    nb_ranks, n, nb = 4, 128, 32
+    M = make_diag_dominant(n)
+
+    def rank_fn(rank, fabric):
+        import parsec_tpu
+        eng = RemoteDepEngine(fabric.engine(rank))
+        c = parsec_tpu.Context(nb_cores=1, comm=eng, enable_tpu=False)
+        try:
+            A = TwoDimBlockCyclic(n, n, nb, nb, P=2, Q=2, nodes=nb_ranks,
+                                  rank=rank, dtype=np.float32)
+            A.name = "descA"
+            for (i, j) in A.local_tiles():
+                np.copyto(A.tile(i, j),
+                          M[i * nb:(i + 1) * nb, j * nb:(j + 1) * nb])
+            tp = dgetrf_nopiv_taskpool(A, rank=rank, nb_ranks=nb_ranks)
+            c.add_taskpool(tp)
+            c.wait()
+            return {(i, j): np.array(A.tile(i, j))
+                    for (i, j) in A.local_tiles()}
+        finally:
+            c.fini()
+
+    results, _ = spmd(nb_ranks, rank_fn)
+    got = np.zeros((n, n), np.float64)
+    for local in results:
+        for (i, j), t in local.items():
+            got[i * nb:(i + 1) * nb, j * nb:(j + 1) * nb] = t
+    L = np.tril(got, -1) + np.eye(n)
+    U = np.triu(got)
+    np.testing.assert_allclose(L @ U, M.astype(np.float64), atol=5e-3)
