@@ -169,8 +169,17 @@ class Context:
         if tp.startup_hook is not None:
             startup = tp.startup_hook(self, tp)
             if startup:
+                # chunked hand-off (ref: task_startup_iter/chunk,
+                # parsec.c:688-694): the first chunk lands in the local
+                # queues, the rest overflow to the system queue so a huge
+                # startup set cannot flood per-thread buffers
                 es0 = self.execution_streams[0]
-                schedule(es0, list(startup))
+                chunk = max(1, int(params.get("task_startup_chunk") or 0)
+                            or len(startup))
+                startup = list(startup)
+                for i in range(0, len(startup), chunk):
+                    schedule(es0, startup[i:i + chunk],
+                             distance=0 if i == 0 else 1)
         tp.tdm.taskpool_ready()
 
     def _taskpool_done(self, tp: Taskpool) -> None:

@@ -3,7 +3,8 @@ subprocess by test_comm_tcp.py — real process isolation, the reference's
 mpiexec analog with an actual wire between ranks).
 
 Usage: python tcp_rank_main.py <rank> <nb_ranks> <port0,...> <hops> [mode]
-mode: "ptg" (default — chain JDF) or "dtd" (insert-task chain).
+mode: "ptg" (default — chain JDF), "dtd" (insert-task chain), or
+"dposv" (distributed Cholesky solve: 3 sequential taskpools).
 Prints one JSON line with this rank's observations.
 """
 import json
@@ -82,6 +83,35 @@ def run_dtd(ctx, eng, rank, nb_ranks, hops):
     return None
 
 
+def run_dposv(ctx, eng, rank, nb_ranks, n=96, nb=32, nrhs=16):
+    """Distributed Cholesky solve across real processes."""
+    from parsec_tpu.ops import dposv, make_spd
+
+    M = make_spd(n)
+    rng = np.random.RandomState(1)
+    Bm = (rng.rand(n, nrhs) - 0.5).astype(np.float32)
+
+    def dist(lm, ln, src, P, Q):
+        d = TwoDimBlockCyclic(lm, ln, nb, nb, P=P, Q=Q, nodes=nb_ranks,
+                              rank=rank, dtype=np.float32)
+        for (i, j) in d.local_tiles():
+            np.copyto(d.tile(i, j),
+                      src[i * nb:(i + 1) * nb, j * nb:(j + 1) * nb])
+        return d
+
+    A = dist(n, n, M, 2, nb_ranks // 2)
+    B = dist(n, nrhs, Bm, nb_ranks, 1)
+    A.name, B.name = "descA", "descB"
+    dposv(ctx, A, B, rank=rank, nb_ranks=nb_ranks)
+    ref = np.linalg.solve(M.astype(np.float64), Bm.astype(np.float64))
+    err = 0.0
+    for (i, j) in B.local_tiles():
+        err = max(err, float(np.abs(
+            B.tile(i, j) - ref[i * nb:(i + 1) * nb,
+                               j * nb:(j + 1) * nb]).max()))
+    return err
+
+
 def main() -> int:
     rank = int(sys.argv[1])
     nb_ranks = int(sys.argv[2])
@@ -95,6 +125,12 @@ def main() -> int:
     rdep = RemoteDepEngine(eng)
     ctx = parsec_tpu.Context(nb_cores=2, comm=rdep, enable_tpu=False)
     try:
+        if mode == "dposv":
+            err = run_dposv(ctx, eng, rank, nb_ranks)
+            eng.sync()
+            print(json.dumps({"rank": rank, "max_err": err,
+                              "msgs": eng.fabric.msg_count}), flush=True)
+            return 0
         if mode == "dtd":
             final = run_dtd(ctx, eng, rank, nb_ranks, hops)
             eng.sync()

@@ -91,31 +91,41 @@ def test_barrier():
             e.fini()
 
 
-@pytest.mark.parametrize("nb_ranks", [2, 3])
-def test_spmd_chain_across_processes(nb_ranks):
-    """Full PTG chain with every hop a remote dep over real sockets
-    between OS processes; payloads above the short limit take the GET
-    rendezvous."""
-    hops = 2 * nb_ranks
+
+
+def _run_ranks(nb_ranks, hops, mode=None, timeout=180):
+    """Launch one tcp_rank_main.py process per rank and collect each
+    rank's JSON report."""
     ports = free_ports(nb_ranks)
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
+    argv_tail = [str(hops)] + ([mode] if mode else [])
     procs = [subprocess.Popen(
         [sys.executable, os.path.join(ROOT, "tests", "tcp_rank_main.py"),
-         str(r), str(nb_ranks), ",".join(map(str, ports)), str(hops)],
+         str(r), str(nb_ranks), ",".join(map(str, ports))] + argv_tail,
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
         for r in range(nb_ranks)]
     outs = []
     for p in procs:
         try:
-            out, err = p.communicate(timeout=180)
+            out, err = p.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
             raise
         assert p.returncode == 0, (out, err)
         outs.append(json.loads(out.strip().splitlines()[-1]))
+    return outs
+
+
+@pytest.mark.parametrize("nb_ranks", [2, 3])
+def test_spmd_chain_across_processes(nb_ranks):
+    """Full PTG chain with every hop a remote dep over real sockets
+    between OS processes; payloads above the short limit take the GET
+    rendezvous."""
+    hops = 2 * nb_ranks
+    outs = _run_ranks(nb_ranks, hops)
     finals = [o["final"] for o in outs if "final" in o]
     assert finals == [float(hops + 1)]
     assert all(o["msgs"] > 0 for o in outs)
@@ -126,25 +136,15 @@ def test_dtd_chain_across_processes():
     """DTD cross-rank chain over real sockets: the (tile, seq) data plane
     with the 4KB payload taking the GET rendezvous."""
     nb_ranks, hops = 2, 6
-    ports = free_ports(nb_ranks)
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    procs = [subprocess.Popen(
-        [sys.executable, os.path.join(ROOT, "tests", "tcp_rank_main.py"),
-         str(r), str(nb_ranks), ",".join(map(str, ports)), str(hops),
-         "dtd"],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
-        for r in range(nb_ranks)]
-    outs = []
-    for p in procs:
-        try:
-            out, err = p.communicate(timeout=180)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise
-        assert p.returncode == 0, (out, err)
-        outs.append(json.loads(out.strip().splitlines()[-1]))
+    outs = _run_ranks(nb_ranks, hops, mode="dtd")
     finals = [o["final"] for o in outs if "final" in o]
     assert finals == [float(hops)]
+
+
+def test_dposv_across_processes():
+    """Distributed Cholesky solve across 4 real OS processes: three
+    sequential taskpools, panel broadcasts, cross-rank writebacks and
+    the early-activation buffering, all over sockets."""
+    outs = _run_ranks(4, 0, mode="dposv", timeout=300)
+    assert all(o["max_err"] < 5e-3 for o in outs), outs
+    assert all(o["msgs"] > 0 for o in outs)

@@ -329,16 +329,11 @@ def test_pdgemm_bad_trans_rejected(ctx):
         pdgemm_taskpool(A, A, A, transa="x")
 
 
-def test_dgeqrf_multirank_distributed():
-    """QR across 4 ranks. The R triangle returns to descA(k,k) from the
-    END of each TSQRT chain — a cross-rank memory writeback."""
+def _spmd_factor(taskpool_factory, M, n, nb, nb_ranks=4):
+    """Scatter M block-cyclically over nb_ranks, run the factorization
+    SPMD over the in-process fabric, gather the local tiles back."""
     from conftest import spmd
     from parsec_tpu.comm import RemoteDepEngine
-    from parsec_tpu.ops import dgeqrf_taskpool
-
-    nb_ranks, n, nb = 4, 128, 32
-    rng = np.random.RandomState(21)
-    M = (rng.rand(n, n) - 0.5).astype(np.float32)
 
     def rank_fn(rank, fabric):
         import parsec_tpu
@@ -351,7 +346,7 @@ def test_dgeqrf_multirank_distributed():
             for (i, j) in A.local_tiles():
                 np.copyto(A.tile(i, j),
                           M[i * nb:(i + 1) * nb, j * nb:(j + 1) * nb])
-            tp = dgeqrf_taskpool(A, rank=rank, nb_ranks=nb_ranks)
+            tp = taskpool_factory(A, rank=rank, nb_ranks=nb_ranks)
             c.add_taskpool(tp)
             c.wait()
             return {(i, j): np.array(A.tile(i, j))
@@ -364,6 +359,15 @@ def test_dgeqrf_multirank_distributed():
     for local in results:
         for (i, j), t in local.items():
             got[i * nb:(i + 1) * nb, j * nb:(j + 1) * nb] = t
+    return got
+
+
+def test_dgeqrf_multirank_distributed():
+    """QR across 4 ranks. The R triangle returns to descA(k,k) from the
+    END of each TSQRT chain — a cross-rank memory writeback."""
+    rng = np.random.RandomState(21)
+    M = (rng.rand(128, 128) - 0.5).astype(np.float32)
+    got = _spmd_factor(dgeqrf_taskpool, M, 128, 32)
     R = np.triu(got)
     ref = M.astype(np.float64).T @ M.astype(np.float64)
     np.testing.assert_allclose(R.T @ R, ref, atol=2e-3)
@@ -372,37 +376,9 @@ def test_dgeqrf_multirank_distributed():
 def test_dgetrf_multirank_distributed():
     """LU across 4 ranks (all writes are affinity-local; panels travel
     task edges)."""
-    from conftest import spmd
-    from parsec_tpu.comm import RemoteDepEngine
-    from parsec_tpu.ops import dgetrf_nopiv_taskpool, make_diag_dominant
-
-    nb_ranks, n, nb = 4, 128, 32
+    n = 128
     M = make_diag_dominant(n)
-
-    def rank_fn(rank, fabric):
-        import parsec_tpu
-        eng = RemoteDepEngine(fabric.engine(rank))
-        c = parsec_tpu.Context(nb_cores=1, comm=eng, enable_tpu=False)
-        try:
-            A = TwoDimBlockCyclic(n, n, nb, nb, P=2, Q=2, nodes=nb_ranks,
-                                  rank=rank, dtype=np.float32)
-            A.name = "descA"
-            for (i, j) in A.local_tiles():
-                np.copyto(A.tile(i, j),
-                          M[i * nb:(i + 1) * nb, j * nb:(j + 1) * nb])
-            tp = dgetrf_nopiv_taskpool(A, rank=rank, nb_ranks=nb_ranks)
-            c.add_taskpool(tp)
-            c.wait()
-            return {(i, j): np.array(A.tile(i, j))
-                    for (i, j) in A.local_tiles()}
-        finally:
-            c.fini()
-
-    results, _ = spmd(nb_ranks, rank_fn)
-    got = np.zeros((n, n), np.float64)
-    for local in results:
-        for (i, j), t in local.items():
-            got[i * nb:(i + 1) * nb, j * nb:(j + 1) * nb] = t
+    got = _spmd_factor(dgetrf_nopiv_taskpool, M, n, 32)
     L = np.tril(got, -1) + np.eye(n)
     U = np.triu(got)
     np.testing.assert_allclose(L @ U, M.astype(np.float64), atol=5e-3)
