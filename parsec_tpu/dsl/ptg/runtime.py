@@ -592,11 +592,27 @@ class PTGTaskpool(Taskpool):
             if f.is_ctl or not (tc.flows[i].access & FlowAccess.WRITE):
                 continue
             copy = task.data[i].data_out or task.data[i].data_in
-            src_host = None
-            if copy is not None:
-                src_host = copy if copy.device_id == 0 else None
-                if src_host is None and copy.data is not None:
-                    src_host = self.pull_newest_to_host(es, copy.data)
+
+            # lazy: a D2H pull only when some dep really needs host bytes —
+            # the dominant case (tile already home, newest copy on device)
+            # must not pay a device->host transfer per task (at tunnel
+            # bandwidths that serializes the whole DAG on PCIe/DCN)
+            _src_host_cell: List[Any] = []
+
+            def src_host_of():
+                if not _src_host_cell:
+                    if copy is None or copy.device_id == 0:
+                        _src_host_cell.append(copy)
+                    elif copy.data is not None:
+                        _src_host_cell.append(
+                            self.pull_newest_to_host(es, copy.data))
+                    else:
+                        # detached device copy (Data destructed): no host
+                        # source exists; remote path sends a release-only
+                        # notification, local path errors loudly below
+                        _src_host_cell.append(None)
+                return _src_host_cell[0]
+
             for d in f.deps_out():
                 t = d.resolve(env)
                 if t is None or t.kind != "memory":
@@ -612,8 +628,8 @@ class PTGTaskpool(Taskpool):
                     # static count cannot see dynamic copy-None)
                     assert self.comm is not None, \
                         "remote memory target without a comm engine"
-                    payload = src_host.payload if src_host is not None \
-                        else None
+                    sh = src_host_of()
+                    payload = sh.payload if sh is not None else None
                     self.comm.mem_writeback(self, t.collection, tuple(args),
                                             payload, dst_rank)
                     continue
@@ -626,11 +642,16 @@ class PTGTaskpool(Taskpool):
                     # sync lazily (a per-task d2h pull would serialize the
                     # DAG on transfer latency)
                     continue
+                sh = src_host_of()
+                if sh is None:
+                    raise RuntimeError(
+                        f"{task.snprintf()}: memory writeback of flow "
+                        f"{f.name} from a detached device copy")
                 dh = self.host_copy_of(es, dest)
                 if dh.payload is None:
-                    dh.payload = np.array(np.asarray(src_host.payload))
+                    dh.payload = np.array(np.asarray(sh.payload))
                 else:
-                    np.copyto(dh.payload, np.asarray(src_host.payload))
+                    np.copyto(dh.payload, np.asarray(sh.payload))
                 dest.version_bump(0)
 
 

@@ -335,12 +335,17 @@ def _spmd_factor(taskpool_factory, M, n, nb, nb_ranks=4):
     from conftest import spmd
     from parsec_tpu.comm import RemoteDepEngine
 
+    # largest P with P | nb_ranks and P <= sqrt: a valid PxQ grid for any
+    # rank count (4 -> 2x2, 2 -> 1x2, 6 -> 2x3)
+    P = max(p for p in range(1, int(nb_ranks ** 0.5) + 1) if nb_ranks % p == 0)
+    Q = nb_ranks // P
+
     def rank_fn(rank, fabric):
         import parsec_tpu
         eng = RemoteDepEngine(fabric.engine(rank))
         c = parsec_tpu.Context(nb_cores=1, comm=eng, enable_tpu=False)
         try:
-            A = TwoDimBlockCyclic(n, n, nb, nb, P=2, Q=2, nodes=nb_ranks,
+            A = TwoDimBlockCyclic(n, n, nb, nb, P=P, Q=Q, nodes=nb_ranks,
                                   rank=rank, dtype=np.float32)
             A.name = "descA"
             for (i, j) in A.local_tiles():
