@@ -1,0 +1,179 @@
+"""Live cross-rank counter aggregation — the aggregator_visu analog.
+
+Reference behavior: a demo TCP server (tools/aggregator_visu/demo_server.c)
+receives PAPI-SDE counter pushes from every rank of a running job; a
+Python GUI aggregates and plots them live (tools/aggregator_visu/, SURVEY
+§5.1 "live telemetry").
+
+TPU-native re-design: a threaded line-JSON TCP server
+(``AggregatorServer``) plus a per-context daemon pusher (``SDEPusher``)
+that samples ``ctx.sde`` every interval and ships
+``{"rank", "ts", "counters": {...}}``. The server keeps the latest and
+extremal samples per (counter, rank) and serves a fleet-wide aggregate —
+the same min/max/last/sum_of_last table ``tools/counter_aggregate.py``
+computes offline — to pull clients that send the single line ``QUERY``.
+Enable from any run with ``--mca sde_push host:port`` (interval knob
+``sde_push_interval_ms``); the CLI front end is ``tools/aggregator_server.py``.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+import time
+from typing import Any, Dict, Optional
+
+__all__ = ["AggregatorServer", "SDEPusher"]
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self) -> None:
+        server: "AggregatorServer" = self.server.owner  # type: ignore[attr-defined]
+        for raw in self.rfile:
+            line = raw.strip()
+            if not line:
+                continue
+            if line == b"QUERY":
+                payload = json.dumps(server.fleet()).encode() + b"\n"
+                self.wfile.write(payload)
+                self.wfile.flush()
+                continue
+            try:
+                msg = json.loads(line.decode())
+            except ValueError:
+                continue
+            server._ingest(msg)
+
+
+class _TCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class AggregatorServer:
+    """Collects counter pushes; query with :meth:`fleet` (in-process) or
+    by sending ``QUERY`` over a TCP connection."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        self._srv = _TCPServer((host, port), _Handler)
+        self._srv.owner = self  # type: ignore[attr-defined]
+        self.host, self.port = self._srv.server_address[:2]
+        self._lock = threading.Lock()
+        # {counter: {rank: {"last", "min", "max", "n", "ts"}}}
+        self._series: Dict[str, Dict[int, Dict[str, Any]]] = {}
+        self.nb_pushes = 0
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> "AggregatorServer":
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        name="sde-aggregator", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _ingest(self, msg: Dict[str, Any]) -> None:
+        rank = int(msg.get("rank", 0))
+        ts = float(msg.get("ts", time.time()))
+        counters = msg.get("counters") or {}
+        with self._lock:
+            self.nb_pushes += 1
+            for name, value in counters.items():
+                try:
+                    v = float(value)
+                except (TypeError, ValueError):
+                    continue
+                per_rank = self._series.setdefault(name, {})
+                cell = per_rank.get(rank)
+                if cell is None:
+                    per_rank[rank] = {"last": v, "min": v, "max": v,
+                                      "n": 1, "ts": ts}
+                else:
+                    cell["last"] = v
+                    cell["min"] = min(cell["min"], v)
+                    cell["max"] = max(cell["max"], v)
+                    cell["n"] += 1
+                    cell["ts"] = ts
+
+    def fleet(self) -> Dict[str, Any]:
+        """The live analog of counter_aggregate.aggregate(): per-rank
+        stats plus fleet-wide min/max/sum_of_last per counter."""
+        with self._lock:
+            out: Dict[str, Any] = {}
+            for name, per_rank in sorted(self._series.items()):
+                ranks = {str(r): dict(cell)
+                         for r, cell in sorted(per_rank.items())}
+                lasts = [cell["last"] for cell in per_rank.values()]
+                out[name] = {
+                    "ranks": ranks,
+                    "fleet": {"nb_ranks": len(per_rank),
+                              "min": min(lasts), "max": max(lasts),
+                              "sum_of_last": sum(lasts)},
+                }
+            return {"counters": out, "nb_pushes": self.nb_pushes}
+
+
+class SDEPusher:
+    """Daemon thread sampling an SDERegistry and pushing snapshots to an
+    AggregatorServer address (host:port). One per Context (= per rank)."""
+
+    def __init__(self, sde, addr: str, rank: int = 0,
+                 interval: float = 1.0) -> None:
+        host, _, port = addr.rpartition(":")
+        self._addr = (host or "127.0.0.1", int(port))
+        self._sde = sde
+        self.rank = rank
+        self.interval = interval
+        self._stop = threading.Event()
+        self._sock: Optional[socket.socket] = None
+        self._thread = threading.Thread(target=self._run, name="sde-push",
+                                        daemon=True)
+
+    def start(self) -> "SDEPusher":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+    def push_once(self) -> bool:
+        """One synchronous sample+send; False if the server is unreachable
+        (pushes are best-effort: telemetry must never take down the run)."""
+        snap = {k: v for k, v in self._sde.snapshot().items()
+                if isinstance(v, (int, float))}
+        msg = json.dumps({"rank": self.rank, "ts": time.time(),
+                          "counters": snap}) + "\n"
+        try:
+            if self._sock is None:
+                self._sock = socket.create_connection(self._addr, timeout=2)
+            self._sock.sendall(msg.encode())
+            return True
+        except OSError:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+            return False
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self.push_once()
+            self._stop.wait(self.interval)
+        self.push_once()  # final sample so short runs are visible
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass

@@ -109,6 +109,16 @@ class Context:
         # (the reference's registry is per-process, which IS per-rank there)
         self.sde = SDERegistry()
         self.sde.register_poll(PENDING_TASKS, self._pending_gauge)
+        # live telemetry: push SDE snapshots to an aggregator if configured
+        # (ref: PAPI-SDE counters feeding tools/aggregator_visu)
+        self._sde_pusher = None
+        push_addr = params.get("sde_push")
+        if push_addr:
+            from ..profiling.aggregator import SDEPusher
+            self._sde_pusher = SDEPusher(
+                self.sde, push_addr, rank=self.rank,
+                interval=max(0.05, params.get("sde_push_interval_ms") / 1000.0),
+            ).start()
         plog.debug.verbose(3, "context: %d threads, %d vps, %d devices, sched=%s",
                            self.nb_cores, len(self.vps), len(self.devices), name)
 
@@ -167,7 +177,7 @@ class Context:
         if tp.on_enqueue is not None:
             tp.on_enqueue(tp)
         if tp.startup_hook is not None:
-            startup = tp.startup_hook(self, tp)
+            startup = list(tp.startup_hook(self, tp) or ())
             if startup:
                 # chunked hand-off (ref: task_startup_iter/chunk,
                 # parsec.c:688-694): the first chunk lands in the local
@@ -176,7 +186,6 @@ class Context:
                 es0 = self.execution_streams[0]
                 chunk = max(1, int(params.get("task_startup_chunk") or 0)
                             or len(startup))
-                startup = list(startup)
                 for i in range(0, len(startup), chunk):
                     schedule(es0, startup[i:i + chunk],
                              distance=0 if i == 0 else 1)
@@ -350,6 +359,8 @@ class Context:
             dev.fini()
         if self.comm is not None:
             self.comm.fini()
+        if self._sde_pusher is not None:
+            self._sde_pusher.stop()  # sends one final snapshot
         if self._task_profiler is not None:
             # unhook from the global PINS sites: a later context's events
             # must not leak into this finalized profile

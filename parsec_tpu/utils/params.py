@@ -225,6 +225,11 @@ def register_core_params() -> None:
     params.reg_sizet("tpu_memory_fraction_pct", 85,
                      "percent of HBM managed by the arena")
     params.reg_int("comm_max_inflight", 16, "max concurrent gets/puts in comm thread")
+    params.reg_string("sde_push", "",
+                      "host:port of a live counter aggregator to push SDE "
+                      "snapshots to (ref: tools/aggregator_visu)")
+    params.reg_int("sde_push_interval_ms", 1000,
+                   "milliseconds between SDE pushes")
 
 
 register_core_params()

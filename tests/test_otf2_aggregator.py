@@ -1,0 +1,224 @@
+"""OTF2 export + live counter aggregation tests (ref: the two remaining
+observability back ends — parsec/profiling_otf2.c and
+tools/aggregator_visu's PAPI-SDE demo server/GUI)."""
+import json
+import os
+import socket
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import parsec_tpu
+from parsec_tpu import dtd
+from parsec_tpu.dsl.dtd import INOUT, VALUE, unpack_args
+from parsec_tpu.profiling.aggregator import AggregatorServer, SDEPusher
+from parsec_tpu.profiling.binfmt import write_profile
+from parsec_tpu.profiling.otf2 import read_otf2, write_otf2
+from parsec_tpu.profiling.sde import SDERegistry
+from parsec_tpu.profiling.trace import Profile
+from parsec_tpu.utils.params import params
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "tools")
+if TOOLS not in sys.path:
+    sys.path.insert(0, TOOLS)
+
+import ptt2otf2  # noqa: E402
+import ptt2paje  # noqa: E402
+
+
+def _sample_profile(rank=3):
+    prof = Profile(rank=rank, info={"app": "unit"})
+    prof._t0 = 0
+    st = prof.stream(0, "worker-0")
+    st.events = [(10, "B", "exec:gemm", None), (40, "E", "exec:gemm", None),
+                 (45, "C", "PARSEC::TASKS_RETIRED", 1.0),
+                 (50, "i", "mark", None)]
+    st2 = prof.stream(1, "comm")
+    st2.events = [(12, "B", "am:activate", None), (20, "E", "am:activate", None)]
+    return prof
+
+
+# --------------------------------------------------------------------- #
+# OTF2                                                                  #
+# --------------------------------------------------------------------- #
+
+def test_otf2_roundtrip(tmp_path):
+    prof = _sample_profile()
+    anchor = write_otf2(prof, str(tmp_path / "arch"))
+    assert os.path.exists(anchor)
+    back = read_otf2(anchor)
+    assert back.rank == prof.rank
+    assert back.info["app"] == "unit"
+    assert sorted(back._streams) == [0, 1]
+    for tid in (0, 1):
+        orig = [(ts, ph, key) for ts, ph, key, _ in
+                prof._streams[tid].events]
+        got = [(ts, ph, key) for ts, ph, key, _ in
+               back._streams[tid].events]
+        assert got == orig
+    # counter values survive as floats
+    cv = [e for e in back._streams[0].events if e[1] == "C"]
+    assert cv and cv[0][3] == 1.0
+
+
+def test_otf2_preserves_noncontiguous_stream_ids(tmp_path):
+    prof = Profile(rank=0)
+    prof._t0 = 0
+    prof.stream(0, "worker").events = [(5, "B", "x", None), (9, "E", "x", None)]
+    prof.stream(100, "comm").events = [(7, "i", "mark", None)]
+    back = read_otf2(write_otf2(prof, str(tmp_path / "arch")))
+    assert sorted(back._streams) == [0, 100]
+    assert back._streams[100].name == "comm"
+
+
+def test_paje_globally_time_ordered(tmp_path):
+    p = str(tmp_path / "t.rank0.ptt")
+    write_profile(_sample_profile(rank=0), p)
+    out = str(tmp_path / "run.paje")
+    assert ptt2paje.main([p, "-o", out]) == 0
+    times = [float(line.split()[1]) for line in open(out)
+             if line[0] in "456" and line[1] == " "]
+    assert times == sorted(times)
+
+
+def test_otf2_archive_structure(tmp_path):
+    """Anchor + traces/global.def + one .evt per location — the OTF2
+    archive layout."""
+    anchor = write_otf2(_sample_profile(), str(tmp_path / "arch"))
+    root = os.path.dirname(anchor)
+    assert os.path.basename(anchor) == "anchor.otf2"
+    assert os.path.exists(os.path.join(root, "traces", "global.def"))
+    assert os.path.exists(os.path.join(root, "traces", "0.evt"))
+    assert os.path.exists(os.path.join(root, "traces", "1.evt"))
+
+
+def test_otf2_rejects_garbage(tmp_path):
+    p = tmp_path / "arch"
+    os.makedirs(p)
+    (p / "anchor.otf2").write_bytes(b"not an anchor at all")
+    with pytest.raises(ValueError):
+        read_otf2(str(p))
+
+
+def test_ptt2otf2_cli(tmp_path, capsys):
+    ptt = str(tmp_path / "t.rank0.ptt")
+    write_profile(_sample_profile(rank=0), ptt)
+    assert ptt2otf2.main([ptt, "-o", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "6 events" in out
+    back = read_otf2(str(tmp_path / "t.rank0.otf2-archive"))
+    assert back.nb_events() == 6
+
+
+# --------------------------------------------------------------------- #
+# Paje                                                                  #
+# --------------------------------------------------------------------- #
+
+def test_ptt2paje_merges_ranks(tmp_path):
+    paths = []
+    for rank in (0, 1):
+        p = str(tmp_path / f"t.rank{rank}.ptt")
+        write_profile(_sample_profile(rank=rank), p)
+        paths.append(p)
+    out = str(tmp_path / "run.paje")
+    assert ptt2paje.main(paths + ["-o", out]) == 0
+    text = open(out).read()
+    assert "%EventDef PajeDefineContainerType" in text
+    # both rank containers, thread sub-containers, state set/reset pairs
+    assert '3 0.0 rank0 CT_Rank 0 "rank0"' in text
+    assert '3 0.0 rank1 CT_Rank 0 "rank1"' in text
+    assert '4 ' in text and '5 ' in text
+    # the counter became a variable type + SetVariable line
+    assert 'PARSEC::TASKS_RETIRED' in text
+    assert "\n6 " in text
+
+
+# --------------------------------------------------------------------- #
+# live aggregation                                                      #
+# --------------------------------------------------------------------- #
+
+def test_aggregator_push_and_fleet():
+    srv = AggregatorServer().start()
+    try:
+        pushers = []
+        for rank in (0, 1, 2):
+            sde = SDERegistry()
+            sde.inc("PARSEC::TASKS_RETIRED", 10 * (rank + 1))
+            p = SDEPusher(sde, srv.address, rank=rank, interval=60)
+            assert p.push_once()
+            pushers.append(p)
+        deadline = time.time() + 5
+        while srv.nb_pushes < 3 and time.time() < deadline:
+            time.sleep(0.01)
+        fleet = srv.fleet()
+        agg = fleet["counters"]["PARSEC::TASKS_RETIRED"]
+        assert agg["fleet"]["nb_ranks"] == 3
+        assert agg["fleet"]["sum_of_last"] == 10 + 20 + 30
+        assert agg["ranks"]["2"]["last"] == 30
+    finally:
+        srv.stop()
+
+
+def test_aggregator_query_over_tcp():
+    srv = AggregatorServer().start()
+    try:
+        sde = SDERegistry()
+        sde.inc("X", 7)
+        SDEPusher(sde, srv.address, rank=0, interval=60).push_once()
+        deadline = time.time() + 5
+        while srv.nb_pushes < 1 and time.time() < deadline:
+            time.sleep(0.01)
+        with socket.create_connection((srv.host, srv.port), timeout=5) as s:
+            s.sendall(b"QUERY\n")
+            buf = b""
+            while not buf.endswith(b"\n"):
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                buf += chunk
+        fleet = json.loads(buf.decode())
+        assert fleet["counters"]["X"]["fleet"]["sum_of_last"] == 7
+    finally:
+        srv.stop()
+
+
+def test_pusher_survives_dead_server():
+    sde = SDERegistry()
+    sde.inc("X", 1)
+    p = SDEPusher(sde, "127.0.0.1:1", rank=0, interval=60)  # port 1: refused
+    assert p.push_once() is False  # best-effort, no raise
+
+
+def test_context_sde_push_param():
+    """End-to-end: --mca sde_push wires a pusher into the context; real
+    task counters arrive at the server, including the final at-fini push."""
+    srv = AggregatorServer().start()
+    try:
+        params.set_cmdline("sde_push", srv.address)
+        params.set_cmdline("sde_push_interval_ms", "50")
+        ctx = parsec_tpu.Context(nb_cores=2, enable_tpu=False)
+        try:
+            tp = dtd.taskpool_new()
+            ctx.add_taskpool(tp)
+            tile = tp.tile_of_array(np.zeros((4, 4), np.float32))
+
+            def bump(es, task):
+                x, a = unpack_args(task)
+                x += a
+
+            for _ in range(5):
+                tp.insert_task(bump, (tile, INOUT), (1.0, VALUE))
+            tp.data_flush_all()
+            tp.wait()
+        finally:
+            ctx.fini()
+        fleet = srv.fleet()["counters"]
+        retired = fleet.get("PARSEC::TASKS_RETIRED")
+        assert retired is not None
+        assert retired["fleet"]["sum_of_last"] >= 5
+    finally:
+        params.reset()
+        srv.stop()

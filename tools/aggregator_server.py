@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Live counter-aggregation server (the aggregator_visu demo_server
+analog). Run it, point ranks at it with ``--mca sde_push host:port``,
+and it reprints the fleet counter table every ``--interval`` seconds.
+
+    python tools/aggregator_server.py --port 9321
+    # in the job's environment:
+    PARSEC_MCA_sde_push=127.0.0.1:9321 python my_app.py
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from parsec_tpu.profiling.aggregator import AggregatorServer  # noqa: E402
+
+
+def print_fleet(fleet) -> None:
+    counters = fleet["counters"]
+    print(f"\n== {time.strftime('%H:%M:%S')} — {fleet['nb_pushes']} pushes, "
+          f"{len(counters)} counters ==")
+    if not counters:
+        return
+    wid = max(len(n) for n in counters)
+    print(f"{'counter':<{wid}}  ranks      min        max        sum(last)")
+    for name, agg in counters.items():
+        f = agg["fleet"]
+        print(f"{name:<{wid}}  {f['nb_ranks']:>5}  {f['min']:>9g}  "
+              f"{f['max']:>9g}  {f['sum_of_last']:>9g}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=9321)
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--max-seconds", type=float, default=0.0,
+                    help="exit after this long (0 = run until ^C)")
+    args = ap.parse_args(argv)
+    srv = AggregatorServer(args.host, args.port).start()
+    print(f"aggregator listening on {srv.address} "
+          f"(PARSEC_MCA_sde_push={srv.address})")
+    t0 = time.time()
+    try:
+        while True:
+            time.sleep(args.interval)
+            print_fleet(srv.fleet())
+            if args.max_seconds and time.time() - t0 > args.max_seconds:
+                break
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

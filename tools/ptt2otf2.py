@@ -1,0 +1,37 @@
+#!/usr/bin/env python
+"""Convert .ptt binary traces to OTF2 archives (the offline face of the
+reference's direct-to-OTF2 trace backend, parsec/profiling_otf2.c).
+
+    python tools/ptt2otf2.py trace.rank0.ptt [-o outdir]
+
+One archive per input file (OTF2 archives are per-rank like the
+reference's; Vampir/otf2-print merge them by opening all anchors).
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from parsec_tpu.profiling.binfmt import read_profile  # noqa: E402
+from parsec_tpu.profiling.otf2 import write_otf2  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("traces", nargs="+", help=".ptt input files")
+    ap.add_argument("-o", "--outdir", default=".",
+                    help="directory to place the archives in")
+    args = ap.parse_args(argv)
+    for path in args.traces:
+        prof = read_profile(path)
+        base = os.path.basename(path)
+        if base.endswith(".ptt"):
+            base = base[:-4]
+        anchor = write_otf2(prof, os.path.join(args.outdir, base + ".otf2-archive"))
+        print(f"{path}: {prof.nb_events()} events -> {anchor}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

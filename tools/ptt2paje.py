@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Convert .ptt binary traces to the Paje trace format (text).
+
+The reference's Python trace tooling ships a Paje export example
+(tools/profiling/python/examples/); this is the supported equivalent.
+Multiple per-rank .ptt files merge into one Paje file: each rank is a
+container, each thread stream a sub-container, begin/end event pairs
+become PajeSetState/PajeResetState, counters become PajeSetVariable.
+
+    python tools/ptt2paje.py trace.rank*.ptt -o run.paje
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from parsec_tpu.profiling.binfmt import read_profile  # noqa: E402
+
+HEADER = """\
+%EventDef PajeDefineContainerType 0
+%  Alias string
+%  Type string
+%  Name string
+%EndEventDef
+%EventDef PajeDefineStateType 1
+%  Alias string
+%  Type string
+%  Name string
+%EndEventDef
+%EventDef PajeDefineVariableType 2
+%  Alias string
+%  Type string
+%  Name string
+%EndEventDef
+%EventDef PajeCreateContainer 3
+%  Time date
+%  Alias string
+%  Type string
+%  Container string
+%  Name string
+%EndEventDef
+%EventDef PajeSetState 4
+%  Time date
+%  Type string
+%  Container string
+%  Value string
+%EndEventDef
+%EventDef PajeResetState 5
+%  Time date
+%  Type string
+%  Container string
+%EndEventDef
+%EventDef PajeSetVariable 6
+%  Time date
+%  Type string
+%  Container string
+%  Value double
+%EndEventDef
+"""
+
+
+def convert(paths, out):
+    profs = [read_profile(p) for p in paths]
+    out.write(HEADER)
+    out.write('0 CT_Rank 0 "Rank"\n')
+    out.write('0 CT_Thread CT_Rank "Thread"\n')
+    out.write('1 ST_Task CT_Thread "Task"\n')
+    # one Paje variable type per distinct counter name
+    counters = sorted({key
+                       for prof in profs
+                       for _tid, st in prof._streams.items()
+                       for _ts, ph, key, _info in st.events if ph == "C"})
+    var_alias = {}
+    for i, name in enumerate(counters):
+        var_alias[name] = f"V{i}"
+        out.write(f'2 V{i} CT_Thread "{name}"\n')
+    # Paje consumers (pj_dump/pj_validate, ViTE) require globally
+    # non-decreasing timestamps: emit all containers at t=0, then merge
+    # every stream's events into one time-sorted sequence
+    merged = []
+    for prof in profs:
+        rc = f"rank{prof.rank}"
+        out.write(f'3 0.0 {rc} CT_Rank 0 "{rc}"\n')
+        for tid, st in sorted(prof._streams.items()):
+            tc = f"{rc}.t{tid}"
+            out.write(f'3 0.0 {tc} CT_Thread {rc} "{st.name}"\n')
+            for ts, ph, key, info in st.events:
+                merged.append((ts, tc, ph, key, info))
+    merged.sort(key=lambda e: e[0])
+    for ts, tc, ph, key, info in merged:
+        t = ts / 1e9
+        if ph == "B":
+            out.write(f'4 {t:.9f} ST_Task {tc} "{key}"\n')
+        elif ph == "E":
+            out.write(f"5 {t:.9f} ST_Task {tc}\n")
+        elif ph == "C":
+            out.write(f"6 {t:.9f} {var_alias[key]} {tc} {float(info)}\n")
+    return sum(p.nb_events() for p in profs)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("traces", nargs="+", help=".ptt input files")
+    ap.add_argument("-o", "--output", default="trace.paje")
+    args = ap.parse_args(argv)
+    with open(args.output, "w") as fh:
+        n = convert(args.traces, fh)
+    print(f"{len(args.traces)} trace(s), {n} events -> {args.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
