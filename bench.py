@@ -9,17 +9,22 @@ take 15.5 TFLOP/s as the A100-class dpotrf rate (DPLASMA-style dpotrf
 sustains ~80% of the A100's 19.5 TFLOP/s FP64-TC peak), making the target
 0.6 * 15500 = 9300 GFLOP/s; vs_baseline = measured / 9300.
 
-Knobs (env): BENCH_N (matrix size, default 8192), BENCH_NB (tile size,
-default 2048), BENCH_DTYPE (float32), BENCH_REPS (default 3, best-of),
-BENCH_CORES (worker threads, default 1: with eager completion one
-thread drives async dispatch without GIL/lock contention — measured
-32.7 TF/s at 1 core vs 25.9 at 2/4 on the single-CPU-core sandbox).
-NB=2048 is the measured single-chip sweet spot (v5e): large enough that
-per-task XLA kernels (~0.3-3ms) amortize the ~0.3ms Python task-dispatch
-overhead, small enough for panel parallelism (NT=4). NB=1024 gave
-6.4 TF/s; NB=2048 sustains ~33 TF/s steady-state (the first rep pays a
-one-time device-pool warm cost even after kernel warmup, which
-best-of-REPS filters; REPS>=2 required for a steady-state number).
+Two execution modes (BENCH_MODE):
+
+- ``capture`` (default): the PTG DAG is compiled into ONE XLA executable
+  via graph capture (dsl/ptg/capture.py) — single dispatch, zero host
+  loop in the timed region, MXU-bound (~0.2 ms for the N=8192 DAG,
+  measured ~900 TF/s on the tunnel chip).
+- ``runtime``: tasks dispatch through the scheduler/device module one by
+  one (the distributed-capable path; ~33 TF/s: each task pays ~0.3 ms of
+  Python dispatch, amortized by NB=2048 kernels and async overlap).
+
+Knobs (env): BENCH_N (default 8192), BENCH_NB (2048), BENCH_DTYPE
+(float32), BENCH_REPS (3, best-of), BENCH_CORES (runtime mode worker
+threads, default 1: eager completion makes one thread the fastest driver
+on a single-CPU-core host). Don't raise BENCH_N casually: the untimed
+staging/verify transfers are tunnel-bound (BASELINE.md notes the link can
+be as slow as ~7-27 MB/s).
 """
 import json
 import os
@@ -33,37 +38,90 @@ import numpy as np  # noqa: E402
 BASELINE_GFLOPS = 9300.0
 
 
-def main() -> None:
+def make_input(n, dtype):
+    # O(N^2) SPD construction (symmetric + strictly diagonally dominant);
+    # a Gram-matrix form would be O(N^3) on the host and dominate wall time
+    rng0 = np.random.RandomState(0)
+    B = rng0.rand(n, n) - 0.5
+    return ((B + B.T) / 2 + n * np.eye(n)).astype(dtype)
+
+
+def check_numerics(L_np, M, n):
+    # O(N^2) residual ||L(L^T x) - M x|| / ||M x|| on random vectors so
+    # verification does not dwarf the timed region at large N
+    L = np.tril(L_np).astype(np.float64)
+    rng = np.random.RandomState(0)
+    X = rng.rand(n, 4)
+    ref = M.astype(np.float64) @ X
+    return float(np.abs(L @ (L.T @ X) - ref).max() / np.abs(ref).max())
+
+
+def emit(n, nb, dtype, mode, best, err):
+    if err > 5e-2:
+        print(json.dumps({"metric": "dpotrf_gflops", "value": 0.0,
+                          "unit": "GFLOP/s", "vs_baseline": 0.0,
+                          "error": f"numerics failed: {err}"}))
+        return
+    flops = n ** 3 / 3.0 + n ** 2 / 2.0
+    gflops = flops / best / 1e9
+    print(json.dumps({
+        "metric": f"dpotrf_gflops(N={n},NB={nb},{dtype.name},1chip,{mode})",
+        "value": round(gflops, 2),
+        "unit": "GFLOP/s",
+        "vs_baseline": round(gflops / BASELINE_GFLOPS, 4),
+    }))
+
+
+def bench_capture(n, nb, reps, dtype):
+    """Whole-DAG XLA execution: one captured executable per shape."""
+    import jax
+    from parsec_tpu.collections import TwoDimBlockCyclic
+    from parsec_tpu.dsl import ptg
+    from parsec_tpu.ops import dpotrf_taskpool
+
+    M = make_input(n, dtype)
+    A = TwoDimBlockCyclic(n, n, nb, nb, dtype=dtype).from_numpy(M)
+    cg = ptg.capture(dpotrf_taskpool(A))
+    dev = jax.devices()[0]
+    tiles = {"descA": {c: jax.device_put(A.tile(*c), dev)
+                       for c in A.tiles()}}
+    jax.block_until_ready(tiles)
+    out = cg.fn(tiles)            # compile (untimed, one-time per shape)
+    jax.block_until_ready(out)
+    best = None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = cg.fn(tiles)
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    Lh = np.zeros((n, n), dtype)
+    for (m, k), arr in out["descA"].items():
+        if m >= k:  # lower tiles only: skip untouched upper-tile pulls
+            Lh[m * nb:(m + 1) * nb, k * nb:(k + 1) * nb] = np.asarray(arr)
+    return best, check_numerics(Lh, M, n)
+
+
+def bench_runtime(n, nb, reps, cores, dtype):
+    """Per-task dispatch through the scheduler + TPU device module."""
     import parsec_tpu
     from parsec_tpu.collections import TwoDimBlockCyclic
     from parsec_tpu.ops import dpotrf_taskpool, make_spd
 
-    n = int(os.environ.get("BENCH_N", "8192"))
-    nb = int(os.environ.get("BENCH_NB", "2048"))
-    reps = int(os.environ.get("BENCH_REPS", "3"))
-    cores = int(os.environ.get("BENCH_CORES", "1"))
-    dtype = np.dtype(os.environ.get("BENCH_DTYPE", "float32"))
-
+    M = make_input(n, dtype)
     ctx = parsec_tpu.init(nb_cores=cores)
     try:
-        # warmup: small factorization compiles every kernel shape used
-        # below — 3x3 tiles so POTRF/TRSM/SYRK *and* GEMM all compile
-        # (a 2x2 grid has no GEMM task and would leak its ~30s XLA
-        # compile into the first timed rep)
+        # warmup: 3x3 tiles so POTRF/TRSM/SYRK *and* GEMM kernels compile
+        # (a 2x2 grid has no GEMM task and would leak its XLA compile
+        # into the first timed rep)
         wm = make_spd(3 * nb, dtype=dtype)
         Aw = TwoDimBlockCyclic(3 * nb, 3 * nb, nb, nb, dtype=dtype).from_numpy(wm)
-        tp = dpotrf_taskpool(Aw)
-        ctx.add_taskpool(tp)
+        ctx.add_taskpool(dpotrf_taskpool(Aw))
         ctx.wait()
 
-        # O(N^2) SPD construction (symmetric + strictly diagonally
-        # dominant); make_spd's Gram-matrix form is O(N^3) on the host
-        # and would dominate wall time at large N
-        rng0 = np.random.RandomState(0)
-        B = rng0.rand(n, n) - 0.5
-        M = ((B + B.T) / 2 + n * np.eye(n)).astype(dtype)
         tpu_devs = [d for d in ctx.devices if d.device_type == "tpu"]
         best = None
+        A = None
         for _ in range(reps):
             A = TwoDimBlockCyclic(n, n, nb, nb, dtype=dtype).from_numpy(M)
             # prestage tiles into HBM (steady-state model: data lives on
@@ -76,11 +134,11 @@ def main() -> None:
                     A.data_of(tm, tn).get_copy(tpu_devs[0].device_index).payload
                     for (tm, tn) in A.tiles()])
             t0 = time.perf_counter()
-            tp = dpotrf_taskpool(A)
-            ctx.add_taskpool(tp)
+            ctx.add_taskpool(dpotrf_taskpool(A))
             ctx.wait()
-            # the DAG is done when every output tile's device result exists;
-            # block on the newest copies so async dispatch is fully timed
+            # the DAG is done when every output tile's device result
+            # exists; block on the newest copies so async dispatch is
+            # fully timed
             import jax
             pend = []
             for (tm, tn) in A.tiles():
@@ -90,30 +148,24 @@ def main() -> None:
             jax.block_until_ready(pend)
             dt = time.perf_counter() - t0
             best = dt if best is None else min(best, dt)
-        # correctness gate (the watchdog pattern of dtd_test_simple_gemm);
-        # O(N^2) residual check ||L(L^T x) - M x|| / ||M x|| on random
-        # vectors so verification does not dwarf the timed region at
-        # large N (full L L^T reconstruction is O(N^3) on the host)
-        L = np.tril(A.to_numpy()).astype(np.float64)
-        rng = np.random.RandomState(0)
-        X = rng.rand(n, 4)
-        ref = M.astype(np.float64) @ X
-        err = float(np.abs(L @ (L.T @ X) - ref).max() / np.abs(ref).max())
-        if err > 5e-2:
-            print(json.dumps({"metric": "dpotrf_gflops", "value": 0.0,
-                              "unit": "GFLOP/s", "vs_baseline": 0.0,
-                              "error": f"numerics failed: {err}"}))
-            return
-        flops = n ** 3 / 3.0 + n ** 2 / 2.0
-        gflops = flops / best / 1e9
-        print(json.dumps({
-            "metric": f"dpotrf_gflops(N={n},NB={nb},{dtype.name},1chip)",
-            "value": round(gflops, 2),
-            "unit": "GFLOP/s",
-            "vs_baseline": round(gflops / BASELINE_GFLOPS, 4),
-        }))
+        return best, check_numerics(A.to_numpy(), M, n)
     finally:
         ctx.fini()
+
+
+def main() -> None:
+    n = int(os.environ.get("BENCH_N", "8192"))
+    nb = int(os.environ.get("BENCH_NB", "2048"))
+    reps = int(os.environ.get("BENCH_REPS", "3"))
+    cores = int(os.environ.get("BENCH_CORES", "1"))
+    mode = os.environ.get("BENCH_MODE", "capture")
+    dtype = np.dtype(os.environ.get("BENCH_DTYPE", "float32"))
+
+    if mode == "capture":
+        best, err = bench_capture(n, nb, reps, dtype)
+    else:
+        best, err = bench_runtime(n, nb, reps, cores, dtype)
+    emit(n, nb, dtype, mode, best, err)
 
 
 if __name__ == "__main__":

@@ -16,6 +16,7 @@ import os
 from typing import Any, Dict, Optional
 
 from .ast import JDFFile
+from .capture import CaptureError, CapturedTaskpool, capture
 from .parser import JDFParseError, parse_jdf
 from .runtime import PTGTaskClass, PTGTaskpool
 
@@ -46,4 +47,5 @@ def compile_jdf_file(path: str) -> JDFFactory:
 
 
 __all__ = ["compile_jdf", "compile_jdf_file", "JDFFactory", "JDFParseError",
-           "PTGTaskpool", "PTGTaskClass"]
+           "PTGTaskpool", "PTGTaskClass",
+           "capture", "CapturedTaskpool", "CaptureError"]

@@ -1,0 +1,257 @@
+"""Graph capture: compile a whole single-rank PTG taskpool into ONE
+jitted XLA executable.
+
+Why this exists (TPU-first design, no reference analog): the reference
+amortizes per-task overhead with a C progress engine (~us dispatch,
+parsec/scheduling.c:586-625); a Python host loop pays ~0.3 ms per task,
+which bounds small-DAG throughput regardless of chip speed. On TPU the
+idiomatic fix is not a faster host loop but *no* host loop: PTG control
+flow is affine and problem-size-static, so the full DAG is known at
+capture time and every guard/range folds to a constant — exactly what
+XLA wants. We walk the taskpool's task classes (ast.py), resolve every
+dependency edge symbolically, topologically order the instances, and
+execute each body ONCE with jax tracers as flow payloads inside a
+``jax.jit`` trace. XLA then fuses/schedules the tile kernels (SURVEY.md
+§7.3 hard-part 7: "fusing TRSM/GEMM tile ops into large-enough XLA
+executables"). The captured executable is the whole factorization: one
+dispatch, MXU-bound, donation-friendly.
+
+Scope: single rank (nb_ranks == 1 — multi-rank dataflow goes through
+the runtime + comm engine); data/memory flows only ("new" needs a
+``shape`` dep property); bodies must be functional (the ``[type=tpu]``
+device-body form: assignments to flow names, no in-place numpy
+mutation). Priorities are ignored — XLA owns scheduling inside the
+compiled program.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .ast import RangeExpr
+from .runtime import PTGTaskpool, _expand_args
+
+
+class CaptureError(RuntimeError):
+    pass
+
+
+def _pick_body(tc_ast):
+    """Prefer the accelerator body (functional form); else first body."""
+    for b in tc_ast.bodies:
+        if b.device_type not in ("cpu", "recursive"):
+            return b
+    return tc_ast.bodies[0]
+
+
+class _Instance:
+    __slots__ = ("tc", "locals", "env", "preds", "key")
+
+    def __init__(self, tc, locals_, env):
+        self.tc = tc
+        self.locals = locals_
+        self.env = env
+        self.preds: List[Tuple[str, Tuple]] = []
+        self.key = (tc.ast.name, locals_)
+
+
+class CapturedTaskpool:
+    """The capture plan + jitted executable for one PTG taskpool shape.
+
+    Call :meth:`run` with the taskpool's bound collections to execute;
+    or use :attr:`fn` directly with ``{coll_name: {coords: array}}``.
+    """
+
+    def __init__(self, tp: PTGTaskpool, donate: bool = False) -> None:
+        if tp.nb_ranks != 1:
+            raise CaptureError(
+                "graph capture is single-rank; multi-rank taskpools "
+                "execute through the runtime + comm engine")
+        self.tp = tp
+        self.donate = donate
+        from ...collections.collection import DataCollection
+        self.collections: Dict[str, Any] = {
+            name: c for name, c in tp.global_env.items()
+            if isinstance(c, DataCollection)}
+        if not self.collections:
+            raise CaptureError("taskpool binds no data collections")
+        self._order = self._plan()
+        self._codes = {
+            tc.ast.name: compile(_pick_body(tc.ast).code,
+                                 f"<jdf:{tc.ast.name}:BODY[captured]>", "exec")
+            for tc in tp.task_classes}
+        self._jitted = None
+
+    # ------------------------------------------------------------------ #
+    # planning: enumerate instances, resolve edges, topo-sort            #
+    # ------------------------------------------------------------------ #
+    def _instances(self) -> Dict[Tuple, _Instance]:
+        out: Dict[Tuple, _Instance] = {}
+        for tc in self.tp.task_classes:
+            for locals_ in tc.iter_space():
+                inst = _Instance(tc, locals_, tc.env_of(locals_))
+                out[inst.key] = inst
+        return out
+
+    def _plan(self) -> List[_Instance]:
+        insts = self._instances()
+        for inst in insts.values():
+            for f in inst.tc.ast.flows:
+                for d in f.deps_in():
+                    t = d.resolve(inst.env)
+                    if t is None or t.kind != "task":
+                        continue
+                    for args in _expand_args(t.args, inst.env):
+                        pkey = (t.task_class, args)
+                        if pkey not in insts:
+                            raise CaptureError(
+                                f"{inst.tc.ast.name}{inst.locals}.{f.name}: "
+                                f"predecessor {t.task_class}{args} outside "
+                                f"its iteration space")
+                        inst.preds.append(pkey)
+        # Kahn
+        indeg = {k: len(i.preds) for k, i in insts.items()}
+        succs: Dict[Tuple, List[Tuple]] = {k: [] for k in insts}
+        for k, i in insts.items():
+            for p in i.preds:
+                succs[p].append(k)
+        ready = [k for k, n in indeg.items() if n == 0]
+        order: List[_Instance] = []
+        while ready:
+            k = ready.pop()
+            order.append(insts[k])
+            for s in succs[k]:
+                indeg[s] -= 1
+                if indeg[s] == 0:
+                    ready.append(s)
+        if len(order) != len(insts):
+            stuck = [k for k, n in indeg.items() if n > 0][:5]
+            raise CaptureError(f"dependency cycle in task graph near {stuck}")
+        return order
+
+    @property
+    def nb_tasks(self) -> int:
+        return len(self._order)
+
+    # ------------------------------------------------------------------ #
+    # tracing                                                            #
+    # ------------------------------------------------------------------ #
+    def _execute(self, tiles: Dict[str, Dict[Tuple, Any]]
+                 ) -> Dict[str, Dict[Tuple, Any]]:
+        """Run the plan with whatever payloads ``tiles`` holds (tracers
+        under jit, concrete arrays in eager debugging)."""
+        import jax.numpy as jnp
+        tile_store = {name: dict(d) for name, d in tiles.items()}
+        out_store: Dict[Tuple, Any] = {}  # (class, locals, flow) -> value
+
+        for inst in self._order:
+            tc_ast = inst.tc.ast
+            env = dict(inst.env)
+            payloads: Dict[str, Any] = {}
+            for f in tc_ast.flows:
+                if f.is_ctl:
+                    continue
+                val = None
+                for d in f.deps_in():
+                    t = d.resolve(inst.env)
+                    if t is None:
+                        continue
+                    if t.kind == "task":
+                        args = tuple(a(inst.env) for a in t.args)
+                        val = out_store[(t.task_class, args, t.flow)]
+                    elif t.kind == "memory":
+                        coords = tuple(int(a(inst.env)) for a in t.args)
+                        val = tile_store[t.collection][coords]
+                    elif t.kind == "new":
+                        shape_src = d.properties.get("shape")
+                        if shape_src is None:
+                            raise CaptureError(
+                                f"{tc_ast.name}.{f.name}: NEW without a "
+                                f"shape property cannot be captured")
+                        from .ast import Expr
+                        shape = Expr(shape_src)(inst.env)
+                        if isinstance(shape, (int, np.integer)):
+                            shape = (int(shape),)
+                        dt = d.properties.get("dtype", "float32")
+                        val = jnp.zeros(tuple(int(s) for s in shape), dt)
+                    break  # first applicable dep wins (runtime semantics)
+                payloads[f.name] = val
+            env.update(payloads)
+            env["np"] = np
+            env["jnp"] = jnp
+            env["es_rank"] = 0
+            env["this_task"] = None
+            exec(self._codes[tc_ast.name], env)
+            for f in tc_ast.flows:
+                if f.is_ctl:
+                    continue
+                # store the post-body binding (written flows: the new
+                # value; READ flows: the forwarded input) for successors
+                out_store[(tc_ast.name, inst.locals, f.name)] = env.get(f.name)
+                if f.access in ("RW", "WRITE"):
+                    for d in f.deps_out():
+                        t = d.resolve(inst.env)
+                        if t is None or t.kind != "memory":
+                            continue
+                        coords = tuple(int(a(inst.env)) for a in t.args)
+                        tile_store[t.collection][coords] = env.get(f.name)
+        return tile_store
+
+    def _tiles_template(self) -> Dict[str, List[Tuple]]:
+        return {name: sorted(coll.tiles())
+                for name, coll in self.collections.items()}
+
+    @property
+    def fn(self):
+        """The jitted executable: dict-of-dicts of tile arrays in, same
+        structure out (jax pytree)."""
+        if self._jitted is None:
+            import jax
+            kw = {"donate_argnums": 0} if self.donate else {}
+            self._jitted = jax.jit(self._execute, **kw)
+        return self._jitted
+
+    # ------------------------------------------------------------------ #
+    # convenience: run against the bound collections                     #
+    # ------------------------------------------------------------------ #
+    def run(self, device=None) -> None:
+        """Execute the captured graph on the taskpool's collections and
+        store results back into their tile copies (device-resident when a
+        device module is given: results stay in HBM, no host sync)."""
+        import jax
+        tiles: Dict[str, Dict[Tuple, Any]] = {}
+        for name, coll in self.collections.items():
+            per = {}
+            for coords in coll.tiles():
+                data = coll.data_of(*coords)
+                if device is not None:
+                    dc = data.get_copy(device.device_index)
+                    if dc is not None and dc.payload is not None \
+                            and dc.version >= data.newest_copy().version:
+                        per[coords] = dc.payload
+                        continue
+                per[coords] = data.sync_to_host().payload
+            tiles[name] = per
+        out = self.fn(tiles)
+        for name, coll in self.collections.items():
+            for coords, arr in out[name].items():
+                data = coll.data_of(*coords)
+                if device is not None:
+                    dc = data.get_copy(device.device_index)
+                    if dc is None:
+                        from ...data.data import DataCopy
+                        dc = DataCopy(data, device.device_index, payload=arr)
+                        data.attach_copy(dc)
+                    else:
+                        dc.payload = arr
+                    data.version_bump(device.device_index)
+                else:
+                    host = data.host_copy()
+                    host.payload = arr
+                    data.version_bump(0)
+
+
+def capture(tp: PTGTaskpool, donate: bool = False) -> CapturedTaskpool:
+    """Capture a PTG taskpool's full DAG into one XLA executable."""
+    return CapturedTaskpool(tp, donate=donate)

@@ -43,7 +43,8 @@ class _Handler(socketserver.StreamRequestHandler):
                 msg = json.loads(line.decode())
             except ValueError:
                 continue
-            server._ingest(msg)
+            if isinstance(msg, dict):  # well-formed non-object JSON: drop
+                server._ingest(msg)
 
 
 class _TCPServer(socketserver.ThreadingTCPServer):
@@ -115,8 +116,11 @@ class AggregatorServer:
                 lasts = [cell["last"] for cell in per_rank.values()]
                 out[name] = {
                     "ranks": ranks,
+                    # min/max span every sample seen (matching the offline
+                    # counter_aggregate table), not just the latest values
                     "fleet": {"nb_ranks": len(per_rank),
-                              "min": min(lasts), "max": max(lasts),
+                              "min": min(c["min"] for c in per_rank.values()),
+                              "max": max(c["max"] for c in per_rank.values()),
                               "sum_of_last": sum(lasts)},
                 }
             return {"counters": out, "nb_pushes": self.nb_pushes}
@@ -128,7 +132,10 @@ class SDEPusher:
 
     def __init__(self, sde, addr: str, rank: int = 0,
                  interval: float = 1.0) -> None:
-        host, _, port = addr.rpartition(":")
+        host, sep, port = addr.rpartition(":")
+        if not sep or not port.isdigit():
+            raise ValueError(
+                f"sde_push address {addr!r} is not host:port")
         self._addr = (host or "127.0.0.1", int(port))
         self._sde = sde
         self.rank = rank

@@ -115,10 +115,15 @@ class Context:
         push_addr = params.get("sde_push")
         if push_addr:
             from ..profiling.aggregator import SDEPusher
-            self._sde_pusher = SDEPusher(
-                self.sde, push_addr, rank=self.rank,
-                interval=max(0.05, params.get("sde_push_interval_ms") / 1000.0),
-            ).start()
+            try:
+                self._sde_pusher = SDEPusher(
+                    self.sde, push_addr, rank=self.rank,
+                    interval=max(0.05,
+                                 params.get("sde_push_interval_ms") / 1000.0),
+                ).start()
+            except ValueError as e:
+                # telemetry must never take down the run
+                plog.warning("sde_push disabled: %s", e)
         plog.debug.verbose(3, "context: %d threads, %d vps, %d devices, sched=%s",
                            self.nb_cores, len(self.vps), len(self.devices), name)
 

@@ -15,7 +15,13 @@ from parsec_tpu import dtd
 from parsec_tpu.dsl.dtd import INOUT, VALUE, unpack_args
 from parsec_tpu.profiling.aggregator import AggregatorServer, SDEPusher
 from parsec_tpu.profiling.binfmt import write_profile
-from parsec_tpu.profiling.otf2 import read_otf2, write_otf2
+from parsec_tpu.profiling.otf2 import _have_real_otf2, read_otf2, write_otf2
+
+# with the real otf2 bindings installed the writer produces genuine OTF2
+# archives (different layout, markers as zero-length enter/leave); the
+# exact-fidelity assertions below only hold for the fallback format
+fallback_only = pytest.mark.skipif(
+    _have_real_otf2(), reason="real otf2 bindings write genuine archives")
 from parsec_tpu.profiling.sde import SDERegistry
 from parsec_tpu.profiling.trace import Profile
 from parsec_tpu.utils.params import params
@@ -45,6 +51,7 @@ def _sample_profile(rank=3):
 # OTF2                                                                  #
 # --------------------------------------------------------------------- #
 
+@fallback_only
 def test_otf2_roundtrip(tmp_path):
     prof = _sample_profile()
     anchor = write_otf2(prof, str(tmp_path / "arch"))
@@ -64,6 +71,7 @@ def test_otf2_roundtrip(tmp_path):
     assert cv and cv[0][3] == 1.0
 
 
+@fallback_only
 def test_otf2_preserves_noncontiguous_stream_ids(tmp_path):
     prof = Profile(rank=0)
     prof._t0 = 0
@@ -80,10 +88,14 @@ def test_paje_globally_time_ordered(tmp_path):
     out = str(tmp_path / "run.paje")
     assert ptt2paje.main([p, "-o", out]) == 0
     times = [float(line.split()[1]) for line in open(out)
-             if line[0] in "456" and line[1] == " "]
+             if line[0] in "4568" and line[1] == " "]
     assert times == sorted(times)
+    # punctual markers survive as PajeNewEvent lines
+    assert any(line.startswith('8 ') and '"mark"' in line
+               for line in open(out))
 
 
+@fallback_only
 def test_otf2_archive_structure(tmp_path):
     """Anchor + traces/global.def + one .evt per location — the OTF2
     archive layout."""
@@ -95,6 +107,7 @@ def test_otf2_archive_structure(tmp_path):
     assert os.path.exists(os.path.join(root, "traces", "1.evt"))
 
 
+@fallback_only
 def test_otf2_rejects_garbage(tmp_path):
     p = tmp_path / "arch"
     os.makedirs(p)
@@ -103,6 +116,7 @@ def test_otf2_rejects_garbage(tmp_path):
         read_otf2(str(p))
 
 
+@fallback_only
 def test_ptt2otf2_cli(tmp_path, capsys):
     ptt = str(tmp_path / "t.rank0.ptt")
     write_profile(_sample_profile(rank=0), ptt)
@@ -190,6 +204,53 @@ def test_pusher_survives_dead_server():
     sde.inc("X", 1)
     p = SDEPusher(sde, "127.0.0.1:1", rank=0, interval=60)  # port 1: refused
     assert p.push_once() is False  # best-effort, no raise
+
+
+def test_fleet_minmax_span_all_samples():
+    """Fleet min/max cover every sample seen, not just the last values
+    (matching the offline counter_aggregate table)."""
+    srv = AggregatorServer().start()
+    try:
+        sde = SDERegistry()
+        p = SDEPusher(sde, srv.address, rank=0, interval=60)
+        sde.inc("X", 100)   # spike
+        assert p.push_once()
+        sde.inc("X", -95)   # settles at 5
+        assert p.push_once()
+        deadline = time.time() + 5
+        while srv.nb_pushes < 2 and time.time() < deadline:
+            time.sleep(0.01)
+        agg = srv.fleet()["counters"]["X"]["fleet"]
+        assert agg["max"] == 100 and agg["min"] == 5
+        assert agg["sum_of_last"] == 5
+    finally:
+        srv.stop()
+
+
+def test_aggregator_ignores_nonobject_json():
+    srv = AggregatorServer().start()
+    try:
+        with socket.create_connection((srv.host, srv.port), timeout=5) as s:
+            s.sendall(b"5\n[]\n")  # valid JSON, not objects: dropped
+            s.sendall(json.dumps({"rank": 0, "counters": {"X": 1}}).encode()
+                      + b"\n")
+            deadline = time.time() + 5
+            while srv.nb_pushes < 1 and time.time() < deadline:
+                time.sleep(0.01)
+        assert srv.fleet()["counters"]["X"]["fleet"]["sum_of_last"] == 1
+    finally:
+        srv.stop()
+
+
+def test_bad_push_address_does_not_kill_context():
+    """telemetry misconfig degrades to a warning, never a startup crash."""
+    params.set_cmdline("sde_push", "myhost")  # missing :port
+    try:
+        ctx = parsec_tpu.Context(nb_cores=1, enable_tpu=False)
+        assert ctx._sde_pusher is None
+        ctx.fini()
+    finally:
+        params.reset()
 
 
 def test_context_sde_push_param():

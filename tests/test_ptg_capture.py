@@ -1,0 +1,125 @@
+"""Graph capture: whole-PTG-taskpool compilation into one XLA
+executable (dsl/ptg/capture.py — TPU-first feature, no reference analog;
+the fused-executable answer to SURVEY.md §7.3 hard-part 7)."""
+import numpy as np
+import pytest
+
+from parsec_tpu.collections import TwoDimBlockCyclic
+from parsec_tpu.dsl import ptg
+from parsec_tpu.ops import (dgetrf_nopiv_taskpool, dgeqrf_taskpool,
+                            dpotrf_taskpool, make_spd, pdgemm_taskpool)
+from parsec_tpu.ops.dgetrf import make_diag_dominant
+
+
+def _spd_collection(n, nb, seed=0):
+    M = make_spd(n, seed=seed)
+    return M, TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float32).from_numpy(M)
+
+
+def test_capture_plan_matches_runtime_task_count():
+    _, A = _spd_collection(256, 64)
+    cg = ptg.capture(dpotrf_taskpool(A))
+    nt = A.nt
+    expect = (nt                                # POTRF
+              + nt * (nt - 1) // 2              # TRSM
+              + nt * (nt - 1) // 2              # SYRK
+              + nt * (nt - 1) * (nt - 2) // 6)  # GEMM
+    assert cg.nb_tasks == expect
+
+
+def test_captured_dpotrf_matches_cholesky():
+    M, A = _spd_collection(256, 64)
+    cg = ptg.capture(dpotrf_taskpool(A))
+    cg.run()
+    L = np.tril(A.to_numpy())
+    assert np.linalg.norm(L @ L.T - M) / np.linalg.norm(M) < 1e-5
+
+
+def test_captured_matches_runtime_execution():
+    """Same taskpool, both execution paths, same answer."""
+    import parsec_tpu
+    M, A1 = _spd_collection(192, 64, seed=3)
+    ptg.capture(dpotrf_taskpool(A1)).run()
+    _, A2 = _spd_collection(192, 64, seed=3)
+    ctx = parsec_tpu.Context(nb_cores=2, enable_tpu=False)
+    try:
+        ctx.add_taskpool(dpotrf_taskpool(A2))
+        ctx.wait()
+    finally:
+        ctx.fini()
+    np.testing.assert_allclose(np.tril(A1.to_numpy()),
+                               np.tril(A2.to_numpy()), rtol=2e-4, atol=2e-4)
+
+
+def test_captured_dgetrf_nopiv():
+    n, nb = 192, 64
+    M = make_diag_dominant(n, dtype=np.float32)
+    A = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float32).from_numpy(M)
+    ptg.capture(dgetrf_nopiv_taskpool(A)).run()
+    LU = A.to_numpy()
+    L = np.tril(LU, -1) + np.eye(n, dtype=np.float32)
+    U = np.triu(LU)
+    assert np.linalg.norm(L @ U - M) / np.linalg.norm(M) < 1e-4
+
+
+def test_captured_dgeqrf():
+    n, nb = 192, 64
+    rng = np.random.RandomState(5)
+    M = rng.rand(n, n).astype(np.float32)
+    A = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float32).from_numpy(M)
+    tp = dgeqrf_taskpool(A)
+    try:
+        cg = ptg.capture(tp)
+    except ptg.CaptureError as e:
+        pytest.skip(f"dgeqrf not capturable: {e}")
+    cg.run()
+    R = np.triu(A.to_numpy())
+    # R from a QR factorization satisfies ||R^T R - M^T M|| ~ 0
+    assert np.linalg.norm(R.T @ R - M.T @ M) / np.linalg.norm(M.T @ M) < 1e-3
+
+
+def test_captured_pdgemm_two_collections():
+    n, nb = 128, 64
+    rng = np.random.RandomState(7)
+    An, Bn = rng.rand(n, n).astype(np.float32), rng.rand(n, n).astype(np.float32)
+    A = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float32).from_numpy(An)
+    B = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float32).from_numpy(Bn)
+    C = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float32).from_numpy(
+        np.zeros((n, n), np.float32))
+    tp = pdgemm_taskpool(A, B, C, alpha=1.0, beta=0.0)
+    cg = ptg.capture(tp)
+    cg.run()
+    np.testing.assert_allclose(C.to_numpy(), An @ Bn, rtol=1e-3, atol=1e-3)
+
+
+def test_capture_rejects_multirank():
+    _, A = _spd_collection(128, 64)
+    tp = dpotrf_taskpool(A, rank=0, nb_ranks=4)
+    with pytest.raises(ptg.CaptureError, match="single-rank"):
+        ptg.capture(tp)
+
+
+def test_capture_run_keeps_results_on_device():
+    """run(device=...) stores result tiles as device copies — no host
+    round-trip of intermediate or output tiles."""
+    import jax
+    import parsec_tpu
+    M, A = _spd_collection(256, 64, seed=1)
+    ctx = parsec_tpu.init(nb_cores=1)
+    try:
+        devs = [d for d in ctx.devices if d.device_type == "tpu"]
+        if not devs:
+            pytest.skip("no accelerator device module")
+        dev = devs[0]
+        cg = ptg.capture(dpotrf_taskpool(A))
+        cg.run(device=dev)
+        # every lower tile's newest copy lives on the device
+        for (m, k) in A.tiles():
+            if m >= k:
+                data = A.data_of(m, k)
+                assert data.newest_copy().device_id == dev.device_index
+        # and the host gather (one sync) is still correct
+        L = np.tril(A.to_numpy())
+        assert np.linalg.norm(L @ L.T - M) / np.linalg.norm(M) < 1e-5
+    finally:
+        ctx.fini()

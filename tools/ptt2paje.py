@@ -57,6 +57,17 @@ HEADER = """\
 %  Container string
 %  Value double
 %EndEventDef
+%EventDef PajeDefineEventType 7
+%  Alias string
+%  Type string
+%  Name string
+%EndEventDef
+%EventDef PajeNewEvent 8
+%  Time date
+%  Type string
+%  Container string
+%  Value string
+%EndEventDef
 """
 
 
@@ -66,6 +77,7 @@ def convert(paths, out):
     out.write('0 CT_Rank 0 "Rank"\n')
     out.write('0 CT_Thread CT_Rank "Thread"\n')
     out.write('1 ST_Task CT_Thread "Task"\n')
+    out.write('7 ET_Mark CT_Thread "Marker"\n')
     # one Paje variable type per distinct counter name
     counters = sorted({key
                        for prof in profs
@@ -96,6 +108,8 @@ def convert(paths, out):
             out.write(f"5 {t:.9f} ST_Task {tc}\n")
         elif ph == "C":
             out.write(f"6 {t:.9f} {var_alias[key]} {tc} {float(info)}\n")
+        else:  # punctual marker events (stream.trace)
+            out.write(f'8 {t:.9f} ET_Mark {tc} "{key}"\n')
     return sum(p.nb_events() for p in profs)
 
 
