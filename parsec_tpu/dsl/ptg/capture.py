@@ -29,7 +29,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from .ast import RangeExpr
+from .ast import Expr
 from .runtime import PTGTaskpool, _expand_args
 
 
@@ -82,6 +82,7 @@ class CapturedTaskpool:
                                  f"<jdf:{tc.ast.name}:BODY[captured]>", "exec")
             for tc in tp.task_classes}
         self._jitted = None
+        self._sharded: Dict[Any, Any] = {}
 
     # ------------------------------------------------------------------ #
     # planning: enumerate instances, resolve edges, topo-sort            #
@@ -169,7 +170,6 @@ class CapturedTaskpool:
                             raise CaptureError(
                                 f"{tc_ast.name}.{f.name}: NEW without a "
                                 f"shape property cannot be captured")
-                        from .ast import Expr
                         shape = Expr(shape_src)(inst.env)
                         if isinstance(shape, (int, np.integer)):
                             shape = (int(shape),)
@@ -211,6 +211,26 @@ class CapturedTaskpool:
             kw = {"donate_argnums": 0} if self.donate else {}
             self._jitted = jax.jit(self._execute, **kw)
         return self._jitted
+
+    def sharded_fn(self, sharding):
+        """The multi-chip executable: jit with every tile pinned to
+        ``sharding`` (a ``jax.sharding.Sharding``) on input AND output,
+        so the whole captured DAG runs SPMD over the sharding's mesh
+        with XLA-inserted collectives (the scaling-book recipe: annotate,
+        let GSPMD partition, profile). Tile kernels partition across the
+        mesh — right for large NB where one tile's FLOPs saturate
+        several chips; tile-per-chip layouts go through the runtime +
+        comm engine instead. The executable is cached per sharding."""
+        import jax
+        fn = self._sharded.get(sharding)
+        if fn is None:
+            tmpl = {name: {c: sharding for c in coll.tiles()}
+                    for name, coll in self.collections.items()}
+            kw = {"donate_argnums": 0} if self.donate else {}
+            fn = jax.jit(self._execute, in_shardings=(tmpl,),
+                         out_shardings=tmpl, **kw)
+            self._sharded[sharding] = fn
+        return fn
 
     # ------------------------------------------------------------------ #
     # convenience: run against the bound collections                     #

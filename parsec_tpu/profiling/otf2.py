@@ -104,9 +104,10 @@ def _have_real_otf2() -> bool:
 
 def write_otf2(profile, path: str) -> str:
     """Write ``profile`` as an OTF2 archive rooted at ``path`` (a
-    directory name; the anchor is ``<path>/anchor.otf2``). Returns the
-    anchor path. Uses the real otf2 bindings when importable, else the
-    structural fallback format documented above."""
+    directory name). Returns the anchor path — ``<path>/anchor.otf2``
+    for the structural fallback format, ``<path>/traces.otf2`` when the
+    real otf2 bindings are importable; either return value feeds
+    straight back into :func:`read_otf2`."""
     if _have_real_otf2():  # pragma: no cover - bindings absent in CI image
         return _write_real_otf2(profile, path)
     os.makedirs(os.path.join(path, "traces"), exist_ok=True)
@@ -269,10 +270,10 @@ def read_otf2(path: str):
 
     anchor = path if path.endswith(".otf2") else os.path.join(path, "anchor.otf2")
     root = os.path.dirname(anchor)
-    if not os.path.exists(anchor) and \
-            os.path.exists(os.path.join(root, "traces.otf2")):
-        # a real OTF2 archive (bindings were installed at write time):
-        # read it back through the bindings too
+    if (not os.path.exists(anchor) or not anchor.endswith("anchor.otf2")) \
+            and os.path.exists(os.path.join(root, "traces.otf2")):
+        # a real OTF2 archive (bindings were installed at write time,
+        # anchor is traces.otf2): read it back through the bindings too
         return _read_real_otf2(root)  # pragma: no cover
     with open(anchor, "rb") as fh:
         if fh.read(len(ANCHOR_MAGIC)) != ANCHOR_MAGIC:

@@ -276,8 +276,13 @@ def test_context_sde_push_param():
             tp.wait()
         finally:
             ctx.fini()
-        fleet = srv.fleet()["counters"]
-        retired = fleet.get("PARSEC::TASKS_RETIRED")
+        # the final at-fini push races the server's ingest thread: poll
+        retired = None
+        deadline = time.time() + 5
+        while retired is None and time.time() < deadline:
+            retired = srv.fleet()["counters"].get("PARSEC::TASKS_RETIRED")
+            if retired is None:
+                time.sleep(0.01)
         assert retired is not None
         assert retired["fleet"]["sum_of_last"] >= 5
     finally:
