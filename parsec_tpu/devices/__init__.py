@@ -43,4 +43,7 @@ def build_devices(context, enable_tpu: bool = True) -> List[Device]:
     return devices
 
 
-__all__ = ["Device", "CPUDevice", "build_devices", "get_best_device"]
+from .template import TemplateDevice, template_chore_hook  # noqa: E402
+
+__all__ = ["Device", "CPUDevice", "build_devices", "get_best_device",
+           "TemplateDevice", "template_chore_hook"]

@@ -1,0 +1,186 @@
+"""DTD graph capture: record an insert-task sequence, compile it into
+ONE jitted XLA executable.
+
+Counterpart of dsl/ptg/capture.py for the dynamic front end. DTD's
+correctness model is sequential consistency: the inserted order is by
+definition a valid serialization of the discovered DAG (ref: the
+insert-loop semantics of parsec_dtd_insert_task, insert_function.h:284 —
+deps are derived from tile access order). So capture needs no dependency
+analysis at all: replay the recorded tasks in insertion order with jax
+tracers as tile payloads and let XLA re-discover the real parallelism
+from data flow — the compiler sees exactly the DAG the runtime would
+have scheduled, minus the per-task host dispatch.
+
+Scope: task bodies must be the *functional* chore form (the
+``add_chore`` convention: one positional arg per inserted param — arrays
+for tiles, raw values for VALUE — returning arrays for written flows in
+order). Host bodies that mutate numpy arrays in place go through the
+runtime instead. Single rank, like PTG capture.
+
+    g = dtd_capture()
+    a = g.tile_of_array(np.ones((n, n), np.float32))
+    g.insert_task(lambda x, s: x * s, (a, INOUT), (2.0, VALUE))
+    # one positional arg per param, OUTPUT tiles included (their
+    # incoming array may be None when the tile starts write-only)
+    g.insert_task(lambda x, y, _c: x @ y, (a, INPUT), (b, INPUT), (c, OUTPUT))
+    g.run()                      # one XLA dispatch for the whole graph
+    result = g.value(c)
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import INPUT, OUTPUT, VALUE, AccessMode
+
+__all__ = ["CapturedDTDGraph", "CaptureTile", "dtd_capture"]
+
+
+class CaptureTile:
+    """Handle for one logical tile in a captured graph (the
+    parsec_dtd_tile_of analog; identity is the user key; ``idx`` is the
+    uniform internal state key — user keys may mix types, which jax's
+    pytree key sorting cannot order)."""
+
+    __slots__ = ("key", "idx", "initial")
+    _fresh = itertools.count()
+
+    def __init__(self, key: Any, idx: int, initial: Optional[Any]) -> None:
+        self.key = key
+        self.idx = idx
+        self.initial = initial
+
+
+class CapturedDTDGraph:
+    def __init__(self) -> None:
+        self._tiles: Dict[Any, CaptureTile] = {}
+        # (fn, [(kind, payload)]) where kind in {"tile","value"} and for
+        # tiles payload = (tile, written?)
+        self._tasks: List[Tuple[Callable, List[Tuple[str, Any]]]] = []
+        self._jitted = None
+        self._result: Optional[Dict[Any, Any]] = None
+
+    # ------------------------------------------------------------------ #
+    # recording (the insert-task surface)                                #
+    # ------------------------------------------------------------------ #
+    def tile_of_array(self, array: Any, key: Any = None) -> CaptureTile:
+        if key is None:
+            key = ("anon", next(CaptureTile._fresh))
+        t = self._tiles.get(key)
+        if t is None:
+            t = CaptureTile(key, len(self._tiles), array)
+            self._tiles[key] = t
+        return t
+
+    def tile(self, key: Any, shape=None, dtype=np.float32) -> CaptureTile:
+        """NEW-tile analog: zeros when a shape is given; with no shape
+        the tile's first access must be write-only (OUTPUT)."""
+        t = self._tiles.get(key)
+        if t is None:
+            init = None if shape is None else np.zeros(shape, dtype)
+            t = CaptureTile(key, len(self._tiles), init)
+            self._tiles[key] = t
+        return t
+
+    def insert_task(self, fn: Callable, *args) -> None:
+        """``fn`` is the functional chore; ``args`` follow the DTD
+        convention: (tile, INPUT|INOUT|OUTPUT) or (value, VALUE) pairs,
+        bare values implying VALUE. The capture is invalidated (will be
+        re-traced) by any insert after a run."""
+        parsed: List[Tuple[str, Any]] = []
+        for a in args:
+            if isinstance(a, tuple) and len(a) == 2 \
+                    and isinstance(a[1], AccessMode):
+                val, mode = a
+            else:
+                val, mode = a, VALUE
+            if mode & VALUE:
+                parsed.append(("value", val))
+                continue
+            if not isinstance(val, CaptureTile):
+                raise TypeError(
+                    f"tracked argument must be a CaptureTile, got {type(val)}")
+            parsed.append(("tile", (val, bool(mode & OUTPUT),
+                                    bool(mode & INPUT))))
+        self._tasks.append((fn, parsed))
+        self._jitted = None
+        self._result = None
+
+    @property
+    def nb_tasks(self) -> int:
+        return len(self._tasks)
+
+    # ------------------------------------------------------------------ #
+    # execution                                                          #
+    # ------------------------------------------------------------------ #
+    def _execute(self, state: Dict[Any, Any]) -> Dict[Any, Any]:
+        state = dict(state)
+        for fn, parsed in self._tasks:
+            call_args = []
+            written: List[CaptureTile] = []
+            for kind, payload in parsed:
+                if kind == "value":
+                    call_args.append(payload)
+                else:
+                    tile, writes, _reads = payload
+                    call_args.append(state[tile.idx])
+                    if writes:
+                        written.append(tile)
+            outs = fn(*call_args)
+            if written:
+                if not isinstance(outs, (tuple, list)):
+                    outs = (outs,)
+                if len(outs) != len(written):
+                    raise ValueError(
+                        f"{getattr(fn, '__name__', fn)}: returned "
+                        f"{len(outs)} outputs for {len(written)} written "
+                        f"flows")
+                for tile, out in zip(written, outs):
+                    state[tile.idx] = out
+        return state
+
+    def _initial_state(self) -> Dict[int, Any]:
+        # a tile with no initial array is fine iff its first access is
+        # write-only (pure OUTPUT): the incoming value is never read, so
+        # its placeholder None only ever reaches the body as the
+        # conventionally-ignored positional arg
+        first_read: Dict[int, bool] = {}
+        for _fn, parsed in self._tasks:
+            for kind, payload in parsed:
+                if kind != "tile":
+                    continue
+                tile, _writes, reads = payload
+                if tile.idx not in first_read:
+                    first_read[tile.idx] = reads
+        missing = [t.key for t in self._tiles.values()
+                   if t.initial is None and first_read.get(t.idx, False)]
+        if missing:
+            raise ValueError(f"tiles {missing!r} have no initial array")
+        return {t.idx: t.initial for t in self._tiles.values()}
+
+    @property
+    def fn(self):
+        """The jitted executable: {tile_idx: array} in, same out
+        (indices are uniform ints so jax can sort the pytree keys)."""
+        if self._jitted is None:
+            import jax
+            self._jitted = jax.jit(self._execute)
+        return self._jitted
+
+    def run(self, state: Optional[Dict[Any, Any]] = None) -> Dict[Any, Any]:
+        """Execute the captured graph (one XLA dispatch); results are
+        readable per tile via :meth:`value`."""
+        self._result = self.fn(state or self._initial_state())
+        return self._result
+
+    def value(self, tile: CaptureTile) -> Any:
+        """The tile's array after the last run (the data_flush analog)."""
+        if self._result is None:
+            raise RuntimeError("run() the captured graph first")
+        return self._result[tile.idx]
+
+
+def dtd_capture() -> CapturedDTDGraph:
+    return CapturedDTDGraph()

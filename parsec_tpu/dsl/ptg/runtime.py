@@ -355,9 +355,17 @@ class PTGTaskClass(TaskClass):
             if b.device_type in ("cpu", "recursive"):
                 code = compile(b.code, f"<jdf:{self.name}:BODY>", "exec")
                 chores.append(Chore("cpu", self._cpu_hook_factory(code)))
-            else:
+            elif b.device_type == "tpu":
                 from ...devices.tpu import tpu_chore_hook
                 chores.append(Chore(b.device_type, tpu_chore_hook(),
+                                    dyld_fn=self._device_fn_factory(b)))
+            else:
+                # any other accelerator type routes to its attached
+                # device module (ref: per-device-type chore lists,
+                # parsec_internal.h:380-437; see devices/template.py)
+                from ...devices.template import template_chore_hook
+                chores.append(Chore(b.device_type,
+                                    template_chore_hook(b.device_type),
                                     dyld_fn=self._device_fn_factory(b)))
         if not any(c.device_type == "cpu" for c in chores):
             # always provide a host fallback interpreting the first body

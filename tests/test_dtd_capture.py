@@ -1,0 +1,160 @@
+"""DTD graph capture (dsl/dtd/capture.py): record an insert sequence,
+execute it as one jitted XLA program; insertion order is the
+serialization DTD semantics already guarantee."""
+import numpy as np
+import pytest
+
+from parsec_tpu.dsl.dtd import INOUT, INPUT, OUTPUT, VALUE
+from parsec_tpu.dsl.dtd.capture import dtd_capture
+
+
+def test_chain_scales_once_dispatch():
+    g = dtd_capture()
+    a = g.tile_of_array(np.ones((8, 8), np.float32))
+    for _ in range(10):
+        g.insert_task(lambda x, s: x * s, (a, INOUT), (2.0, VALUE))
+    assert g.nb_tasks == 10
+    g.run()
+    np.testing.assert_allclose(np.asarray(g.value(a)), 1024.0)
+
+
+def test_gemm_accumulate_graph():
+    import jax.numpy as jnp
+    n = 16
+    rng = np.random.RandomState(0)
+    An = rng.rand(n, n).astype(np.float32)
+    Bn = rng.rand(n, n).astype(np.float32)
+    g = dtd_capture()
+    A = g.tile_of_array(An)
+    B = g.tile_of_array(Bn)
+    C = g.tile(("C",), shape=(n, n))
+
+    def gemm(a, b, c):
+        return c + jnp.matmul(a, b)
+
+    for _ in range(3):
+        g.insert_task(gemm, (A, INPUT), (B, INPUT), (C, INOUT))
+    g.run()
+    np.testing.assert_allclose(np.asarray(g.value(C)), 3 * (An @ Bn),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_multiple_written_flows():
+    g = dtd_capture()
+    x = g.tile_of_array(np.full((4,), 3.0, np.float32))
+    y = g.tile_of_array(np.full((4,), 4.0, np.float32))
+
+    def swap_scale(a, b, s):
+        return b * s, a * s
+
+    g.insert_task(swap_scale, (x, INOUT), (y, INOUT), (10.0, VALUE))
+    g.run()
+    np.testing.assert_allclose(np.asarray(g.value(x)), 40.0)
+    np.testing.assert_allclose(np.asarray(g.value(y)), 30.0)
+
+
+def test_output_only_flow_and_war():
+    """WAR over a tile: a read inserted before an overwrite sees the old
+    value — insertion order is the serialization."""
+    g = dtd_capture()
+    src = g.tile_of_array(np.full((4,), 7.0, np.float32))
+    cpy = g.tile(("copy",), shape=(4,))
+    # chore convention: one positional arg per param, OUTPUT tiles
+    # included (their incoming array is ignored)
+    g.insert_task(lambda s, _c: s + 0, (src, INPUT), (cpy, OUTPUT))
+    g.insert_task(lambda s: s * 0, (src, INOUT))  # overwrite after the read
+    g.run()
+    np.testing.assert_allclose(np.asarray(g.value(cpy)), 7.0)
+    np.testing.assert_allclose(np.asarray(g.value(src)), 0.0)
+
+
+def test_matches_runtime_dtd_execution():
+    """Captured replay == the live DTD runtime on the same program."""
+    import parsec_tpu
+    from parsec_tpu import dtd
+    from parsec_tpu.dsl.dtd import unpack_args
+
+    steps = [1.5, 2.0, 0.5, 3.0]
+
+    # runtime execution
+    ctx = parsec_tpu.Context(nb_cores=2, enable_tpu=False)
+    try:
+        tp = dtd.taskpool_new()
+        ctx.add_taskpool(tp)
+        tile = tp.tile_of_array(np.full((4, 4), 2.0, np.float32))
+
+        def scale(es, task):
+            x, s = unpack_args(task)
+            x *= s
+
+        for s in steps:
+            tp.insert_task(scale, (tile, INOUT), (s, VALUE))
+        tp.data_flush_all()
+        tp.wait()
+        runtime_out = np.array(tile.data.get_copy(0).payload)
+    finally:
+        ctx.fini()
+
+    # captured execution
+    g = dtd_capture()
+    t = g.tile_of_array(np.full((4, 4), 2.0, np.float32))
+    for s in steps:
+        g.insert_task(lambda x, s: x * s, (t, INOUT), (s, VALUE))
+    g.run()
+    np.testing.assert_allclose(np.asarray(g.value(t)), runtime_out,
+                               rtol=1e-6)
+
+
+def test_mixed_anon_and_named_tile_keys():
+    """anon tuple keys + user string keys in one graph (jit pytree keys
+    are uniform internal indices, so mixed user key types are fine)."""
+    g = dtd_capture()
+    a = g.tile_of_array(np.full((4,), 2.0, np.float32))       # anon key
+    c = g.tile("named", shape=(4,))                            # str key
+    g.insert_task(lambda x, _c: x * 5, (a, INPUT), (c, OUTPUT))
+    g.run()
+    np.testing.assert_allclose(np.asarray(g.value(c)), 10.0)
+
+
+def test_output_first_tile_needs_no_initial():
+    """A tile whose first access is pure OUTPUT needs no shape/initial;
+    its placeholder is the conventionally-ignored positional arg."""
+    g = dtd_capture()
+    src = g.tile_of_array(np.full((4,), 2.0, np.float32))
+    dst = g.tile("dst")  # no shape, no initial
+    g.insert_task(lambda s, _d: s + 1, (src, INPUT), (dst, OUTPUT))
+    g.insert_task(lambda d: d * 2, (dst, INOUT))  # read after the write
+    g.run()
+    np.testing.assert_allclose(np.asarray(g.value(dst)), 6.0)
+
+
+def test_insert_after_run_retraces():
+    g = dtd_capture()
+    a = g.tile_of_array(np.ones((4,), np.float32))
+    g.insert_task(lambda x: x + 1, (a, INOUT))
+    g.run()
+    np.testing.assert_allclose(np.asarray(g.value(a)), 2.0)
+    g.insert_task(lambda x: x * 10, (a, INOUT))
+    g.run()
+    np.testing.assert_allclose(np.asarray(g.value(a)), 20.0)
+
+
+def test_errors():
+    g = dtd_capture()
+    a = g.tile(("uninit",))
+    g.insert_task(lambda x: x, (a, INOUT))
+    with pytest.raises(ValueError, match="no initial array"):
+        g.run()
+
+    g2 = dtd_capture()
+    with pytest.raises(TypeError, match="CaptureTile"):
+        g2.insert_task(lambda x: x, (np.ones(3), INOUT))
+
+    g3 = dtd_capture()
+    b = g3.tile_of_array(np.ones((2,), np.float32))
+    c = g3.tile_of_array(np.ones((2,), np.float32))
+    g3.insert_task(lambda x, y: x, (b, INOUT), (c, INOUT))  # 1 out, 2 written
+    with pytest.raises(ValueError, match="written"):
+        g3.run()
+    with pytest.raises(RuntimeError, match="run"):
+        g3.value(b)

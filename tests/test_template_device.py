@@ -1,0 +1,90 @@
+"""Template device module (ref: parsec/mca/device/template — the
+skeleton cloned to bring up a new device type) and PTG routing of
+non-tpu BODY types to their device modules."""
+import numpy as np
+import pytest
+
+import parsec_tpu
+from parsec_tpu.devices import TemplateDevice
+
+JDF = """
+descA [ type="collection" ]
+NB [ type="int" ]
+
+Scale(k)
+
+k = 0 .. NB-1
+
+: descA( k )
+
+RW A <- descA( k )
+     -> descA( k )
+
+BODY [type=template]
+{
+    A = A * 3.0
+}
+END
+"""
+
+
+def _run(attach_template):
+    from parsec_tpu.dsl import ptg
+    from parsec_tpu.collections.collection import LocalArrayCollection
+
+    ctx = parsec_tpu.Context(nb_cores=2, enable_tpu=False)
+    try:
+        dev = None
+        if attach_template:
+            dev = TemplateDevice(len(ctx.devices))
+            ctx.devices.append(dev)
+        base = np.concatenate(
+            [np.full((4, 4), float(i + 1), np.float32) for i in range(5)])
+        coll = LocalArrayCollection(base, nb_chunks=5)
+        coll.name = "descA"
+        tp = ptg.compile_jdf(JDF, name="scale").new(descA=coll, NB=5)
+        ctx.add_taskpool(tp)
+        ctx.wait()
+        vals = [float(np.asarray(coll.data_of(i).newest_copy().payload)[0, 0])
+                for i in range(5)]
+        return vals, dev
+    finally:
+        ctx.fini()
+
+
+def test_template_device_executes_chores():
+    vals, dev = _run(attach_template=True)
+    assert vals == [3.0 * (i + 1) for i in range(5)]
+    assert dev.stats["tasks"] == 5
+    assert dev.executed_tasks == 5
+
+
+def test_template_body_falls_through_without_device():
+    """No device of that type attached: HookReturn.NEXT falls through to
+    the interpreted host chore (the reference's chore_mask walk)."""
+    vals, _ = _run(attach_template=False)
+    assert vals == [3.0 * (i + 1) for i in range(5)]
+
+
+def test_custom_executor_is_used():
+    calls = []
+
+    def executor(fn, task, arrays):
+        calls.append(task.task_class.name)
+        return fn(task, arrays)
+
+    from parsec_tpu.dsl import ptg
+    from parsec_tpu.collections.collection import LocalArrayCollection
+
+    ctx = parsec_tpu.Context(nb_cores=1, enable_tpu=False)
+    try:
+        ctx.devices.append(TemplateDevice(len(ctx.devices),
+                                          executor=executor))
+        coll = LocalArrayCollection(np.ones((2, 2), np.float32), nb_chunks=1)
+        coll.name = "descA"
+        tp = ptg.compile_jdf(JDF, name="scale").new(descA=coll, NB=1)
+        ctx.add_taskpool(tp)
+        ctx.wait()
+    finally:
+        ctx.fini()
+    assert calls == ["Scale"]
