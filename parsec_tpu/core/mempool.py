@@ -1,0 +1,96 @@
+"""Mempool — per-thread freelists for frequently recycled objects.
+
+Reference behavior: ``parsec_mempool_t`` / ``parsec_thread_mempool_t``
+give each execution stream a private freelist of fixed-size elements
+(task structs, remote-dep structs); allocation pops locally without
+contention and elements return to the thread that owns them
+(ref: parsec/mempool.c/.h, parsec/private_mempool.c — SURVEY.md §2.1).
+
+TPU-native re-design: Python task objects are interpreter-managed, so
+the pool's job here is recycling *expensive payloads* — host scratch
+buffers (DTD SCRATCH params), pinned staging arrays, reusable tile
+temporaries. Same structure as the reference: a ``Mempool`` owns one
+``ThreadMempool`` per thread (created on first touch, like
+parsec_mempool_construct's per-ES array); ``allocate`` pops the calling
+thread's freelist or constructs; ``free`` pushes back to the *owning*
+thread's list (elements remember their owner, the
+``parsec_thread_mempool_t *owner`` back-pointer)."""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = ["Mempool", "ThreadMempool"]
+
+
+class ThreadMempool:
+    """One thread's freelist (ref: parsec_thread_mempool_t)."""
+
+    def __init__(self, pool: "Mempool", thread_id: int) -> None:
+        self.pool = pool
+        self.thread_id = thread_id
+        self._free: List[Any] = []
+        self._lock = threading.Lock()  # frees may come from other threads
+        self.nb_elt = 0                # total constructed by this thread
+
+    def allocate(self) -> Any:
+        with self._lock:
+            if self._free:
+                return self._free.pop()
+        self.nb_elt += 1
+        elt = self.pool.constructor()
+        self.pool.owner_of[id(elt)] = self
+        return elt
+
+    def push(self, elt: Any) -> None:
+        with self._lock:
+            if self.pool.max_cached < 0 or len(self._free) < self.pool.max_cached:
+                self._free.append(elt)
+            else:
+                self.pool.owner_of.pop(id(elt), None)  # let GC take it
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+
+class Mempool:
+    """ref: parsec_mempool_t — a set of per-thread freelists sharing one
+    constructor. ``max_cached`` bounds each thread's retained elements
+    (-1 = unbounded, the reference default)."""
+
+    def __init__(self, constructor: Callable[[], Any],
+                 max_cached: int = -1) -> None:
+        self.constructor = constructor
+        self.max_cached = max_cached
+        self.owner_of: Dict[int, ThreadMempool] = {}
+        self._threads: Dict[int, ThreadMempool] = {}
+        self._lock = threading.Lock()
+
+    def thread_mempool(self, thread_id: Optional[int] = None) -> ThreadMempool:
+        tid = thread_id if thread_id is not None else threading.get_ident()
+        tm = self._threads.get(tid)
+        if tm is None:
+            with self._lock:
+                tm = self._threads.setdefault(tid, ThreadMempool(self, tid))
+        return tm
+
+    def allocate(self) -> Any:
+        return self.thread_mempool().allocate()
+
+    def free(self, elt: Any) -> None:
+        """Return ``elt`` to its owning thread's freelist (the reference's
+        elements carry an owner back-pointer; cross-thread frees land in
+        the owner's list, not the caller's)."""
+        owner = self.owner_of.get(id(elt))
+        if owner is not None:
+            owner.push(elt)
+        # unknown element: not pool-constructed; drop it (GC)
+
+    def nb_cached(self) -> int:
+        with self._lock:
+            return sum(len(tm) for tm in self._threads.values())
+
+    def nb_constructed(self) -> int:
+        with self._lock:
+            return sum(tm.nb_elt for tm in self._threads.values())

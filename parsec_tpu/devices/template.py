@@ -38,7 +38,7 @@ class TemplateDevice(Device):
     def __init__(self, device_index: int,
                  executor: Optional[Callable[..., Any]] = None,
                  device_type: str = "template") -> None:
-        super().__init__(device_type, device_index, name=f"{device_type}:0")
+        super().__init__(device_type, device_index)
         # accelerators advertise a lower cost weight than the CPU so the
         # load balancer prefers them for tasks that have a chore here
         self.time_estimate_default = 1.0
@@ -68,14 +68,17 @@ class TemplateDevice(Device):
                 ref.data_in = copy
             arrays.append(copy.payload)
         outs = self._executor(chore.dyld_fn, task, arrays)
-        it = iter(outs if isinstance(outs, (tuple, list)) else (outs,))
-        for flow in task.task_class.flows:
-            if flow.ctl or not (task.access_of(flow) & FlowAccess.WRITE):
-                continue
+        outs = list(outs) if isinstance(outs, (tuple, list)) else [outs]
+        written = [f for f in task.task_class.flows
+                   if not f.ctl and (task.access_of(f) & FlowAccess.WRITE)
+                   and task.data[f.flow_index].data_in is not None]
+        if len(outs) != len(written):
+            raise ValueError(
+                f"{task.snprintf()}: chore returned {len(outs)} outputs "
+                f"for {len(written)} written flows")
+        for flow, out in zip(written, outs):
             ref = task.data[flow.flow_index]
-            if ref.data_in is None:
-                continue
-            ref.data_in.payload = next(it)
+            ref.data_in.payload = out
             if ref.data_in.data is not None:
                 ref.data_in.data.version_bump(ref.data_in.device_id)
         self.executed_tasks += 1
@@ -83,10 +86,13 @@ class TemplateDevice(Device):
         return HookReturn.DONE
 
 
-def template_chore_hook(device_type: str = "template"):
-    """The hook to put in a task class's incarnation list for this device
+def template_chore_hook(device_type: str = "template",
+                        device_selector: Optional[Callable] = None):
+    """The hook to put in a task class's incarnation list for a device
     type (the generated-CUDA-hook slot, jdf2c.c:6557): find an attached
-    device of that type, else fall through to the next incarnation."""
+    device of that type, else fall through to the next incarnation.
+    This is the one dispatch path for every accelerator type —
+    devices/tpu.tpu_chore_hook delegates here with device_type='tpu'."""
     from ..runtime.taskpool import HookReturn
 
     def hook(es, task):
@@ -94,7 +100,10 @@ def template_chore_hook(device_type: str = "template"):
                 if d.device_type == device_type]
         if not devs:
             return HookReturn.NEXT
-        from .device import get_best_device
-        dev = get_best_device(task, devs, eligible_types={device_type})
+        if device_selector is not None:
+            dev = device_selector(task, devs)
+        else:
+            from .device import get_best_device
+            dev = get_best_device(task, devs, eligible_types={device_type})
         return dev.kernel_scheduler(es, task)
     return hook

@@ -385,20 +385,8 @@ class JaxDevice(Device):
 
 
 def tpu_chore_hook(device_selector=None):
-    """Build the generic accelerator chore hook: pick a device, hand off.
-
-    ref: the generated CUDA hook (jdf2c.c:6557-6904) builds a gpu_task and
-    calls the kernel scheduler.
-    """
-    def hook(es, task: Task) -> HookReturn:
-        ctx = es.context
-        tpus = [d for d in ctx.devices if d.device_type == "tpu"]
-        if not tpus:
-            return HookReturn.NEXT  # fall through to the CPU incarnation
-        if device_selector is not None:
-            dev = device_selector(task, tpus)
-        else:
-            from .device import get_best_device
-            dev = get_best_device(task, tpus, eligible_types={"tpu"})
-        return dev.kernel_scheduler(es, task)
-    return hook
+    """The TPU chore hook: pick an attached tpu device, hand off
+    (ref: the generated CUDA hook, jdf2c.c:6557-6904). One dispatch path
+    for all accelerator types — see devices/template.template_chore_hook."""
+    from .template import template_chore_hook
+    return template_chore_hook("tpu", device_selector=device_selector)

@@ -45,6 +45,7 @@ def opt_state_specs(pspecs: Any) -> Dict[str, Any]:
 def make_train_step(cfg: TransformerConfig, mesh, lr: float = 1e-3):
     """Returns train_step(params, opt_state, tokens, labels) ->
     (params, opt_state, loss), jit-compiled over the mesh."""
+    _check_attention_mesh(cfg, mesh)
     pspecs = param_specs(cfg)
     ospecs = opt_state_specs(pspecs)
     data_spec = P("dp", "sp")
@@ -64,9 +65,22 @@ def make_train_step(cfg: TransformerConfig, mesh, lr: float = 1e-3):
     return jax.jit(smapped)
 
 
+def _check_attention_mesh(cfg: TransformerConfig, mesh) -> None:
+    """flash attention is shard-local: over sp>1 it would silently compute
+    block-diagonal attention instead of global causal — reject loudly
+    (use attention='ring' for sequence parallelism)."""
+    sp = dict(zip(mesh.axis_names, mesh.devices.shape)).get("sp", 1)
+    if cfg.attention == "flash" and sp > 1:
+        raise ValueError(
+            "attention='flash' is single-shard in the sequence dimension; "
+            f"mesh has sp={sp} — use attention='ring' (or 'ulysses') for "
+            "sequence-parallel meshes")
+
+
 def make_forward(cfg: TransformerConfig, mesh):
     """Jittable forward: (params, tokens) -> logits (for inference/entry)."""
     from .transformer import forward_shard
+    _check_attention_mesh(cfg, mesh)
     pspecs = param_specs(cfg)
 
     def fwd_shard(params, tokens):

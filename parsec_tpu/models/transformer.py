@@ -44,7 +44,9 @@ class TransformerConfig:
     seq_len: int = 32         # divisible by sp
     batch: int = 8            # divisible by dp; batch/dp divisible by n_micro
     n_micro: int = 1          # pipeline microbatches per shard
-    attention: str = "ring"   # "ring" | "ulysses" | "local"
+    attention: str = "ring"   # "ring" | "ulysses" | "flash" | "local"
+    remat: bool = False       # jax.checkpoint each layer: trade FLOPs
+    # for activation memory (SURVEY.md HBM guidance)
     dtype: Any = jnp.float32
     aux_loss_weight: float = 0.01
 
@@ -120,6 +122,11 @@ def _attention(cfg: TransformerConfig, q, k, v) -> Any:
         return ring_attention(q, k, v, "sp", causal=True)
     if cfg.attention == "ulysses":
         return ulysses_attention(q, k, v, "sp", causal=True)
+    if cfg.attention == "flash":
+        # Pallas kernel: O(T) memory — no materialized [T, T] scores
+        # (single-shard sequence; combine with sp via ring for multi-chip)
+        from ..ops.pallas_kernels import flash_attention
+        return flash_attention(q, k, v, causal=True)
     from ..parallel.ring_attention import local_attention
     return local_attention(q, k, v, causal=True)
 
@@ -183,9 +190,13 @@ def forward_shard(cfg: TransformerConfig, params: Dict[str, Any],
     with_aux = bool(cfg.n_experts)
 
     def stage_fn(sparams, xm):
+        layer_fn = _layer
+        if cfg.remat:
+            layer_fn = jax.checkpoint(_layer, static_argnums=(0,))
+
         def body(carry, lp):
             y, aux = carry
-            y, aux = _layer(cfg, lp, y, aux)
+            y, aux = layer_fn(cfg, lp, y, aux)
             return (y, aux), None
         from ..parallel.mesh import vary_on
         aux0 = vary_on(jnp.zeros((), jnp.float32), ("pp",), like=xm)

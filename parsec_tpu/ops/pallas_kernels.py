@@ -131,7 +131,7 @@ def _flash_fwd(q3: Any, k3: Any, v3: Any, causal: bool, scale: float,
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((BH, T, D), q3.dtype),
+        out_shape=_out_struct((BH, T, D), q3),
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -141,6 +141,17 @@ def _flash_fwd(q3: Any, k3: Any, v3: Any, causal: bool, scale: float,
             dimension_semantics=("parallel", "arbitrary", "arbitrary")),
         interpret=_interpret(),
     )(q3, k3, v3)
+
+
+def _out_struct(shape, like):
+    """Output ShapeDtypeStruct matching ``like``'s dtype and — inside a
+    VMA-checked shard_map — its varying-mesh-axes set (pallas_call cannot
+    infer vma itself; without it check_vma=True rejects the call)."""
+    from ..parallel.mesh import _vma_of
+    vma = _vma_of(like)  # None on jax versions without VMA tracking
+    if vma:
+        return jax.ShapeDtypeStruct(shape, like.dtype, vma=frozenset(vma))
+    return jax.ShapeDtypeStruct(shape, like.dtype)
 
 
 def _pick_block(t: int, pref: int) -> int:
@@ -186,10 +197,16 @@ def _flash_vjp_bwd(causal, scale, block_q, block_k, res, g):
         l = l * jnp.exp(m - m_new) + jnp.exp(s - m_new[..., None]).sum(-1)
         return (m_new, l), None
 
+    def _like_q(x):
+        # scan carries must share the inputs' varying-axes set under a
+        # VMA-checked shard_map (match_vma exists for exactly this)
+        from ..parallel.mesh import match_vma
+        return match_vma(x, qf)
+
     (m, l), _ = jax.lax.scan(
         stats_step,
-        (jnp.full((BH, T), _NEG_INF, jnp.float32),
-         jnp.zeros((BH, T), jnp.float32)),
+        (_like_q(jnp.full((BH, T), _NEG_INF, jnp.float32)),
+         _like_q(jnp.zeros((BH, T), jnp.float32))),
         (kf.transpose(1, 0, 2, 3), jnp.arange(nk)))
     l = jnp.where(l == 0.0, 1.0, l)
 
@@ -218,7 +235,7 @@ def _flash_vjp_bwd(causal, scale, block_q, block_k, res, g):
     kfT = kf.transpose(1, 0, 2, 3)
     vfT = vf.transpose(1, 0, 2, 3)
     delta, dvs = jax.lax.scan(
-        delta_step, jnp.zeros((BH, T), jnp.float32),
+        delta_step, _like_q(jnp.zeros((BH, T), jnp.float32)),
         (kfT, vfT, jnp.arange(nk)))
 
     # pass 3: recompute p/dp per block for dq/dk
@@ -231,7 +248,7 @@ def _flash_vjp_bwd(causal, scale, block_q, block_k, res, g):
         return dq, jnp.einsum("bqk,bqd->bkd", ds, qf,
                               preferred_element_type=jnp.float32)
 
-    dq, dks = jax.lax.scan(dq_step, jnp.zeros_like(qf),
+    dq, dks = jax.lax.scan(dq_step, _like_q(jnp.zeros_like(qf)),
                            (kfT, vfT, jnp.arange(nk)))
     dk = dks.transpose(1, 0, 2, 3).reshape(BH, Tk, D)
     dv = dvs.transpose(1, 0, 2, 3).reshape(BH, Tk, D)

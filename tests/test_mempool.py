@@ -1,0 +1,77 @@
+"""Mempool: per-thread freelists with owner-returning frees
+(ref: parsec/mempool.c, private_mempool.c)."""
+import threading
+
+import numpy as np
+
+from parsec_tpu.core.mempool import Mempool
+
+
+def test_allocate_recycles():
+    made = []
+
+    def ctor():
+        b = np.empty((64,), np.float32)
+        made.append(b)
+        return b
+
+    pool = Mempool(ctor)
+    a = pool.allocate()
+    pool.free(a)
+    b = pool.allocate()
+    assert b is a                   # recycled, not re-constructed
+    assert pool.nb_constructed() == 1
+    pool.free(b)
+    assert pool.nb_cached() == 1
+
+
+def test_cross_thread_free_returns_to_owner():
+    pool = Mempool(lambda: np.empty((8,), np.float32))
+    elt = pool.allocate()           # owned by the main thread's freelist
+    owner = pool.thread_mempool()
+
+    def worker():
+        pool.free(elt)              # freed from another thread
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    assert len(owner) == 1          # landed in the OWNER's list
+    assert pool.allocate() is elt   # main thread gets it back
+
+
+def test_max_cached_bounds_retention():
+    pool = Mempool(lambda: object(), max_cached=2)
+    elts = [pool.allocate() for _ in range(4)]
+    for e in elts:
+        pool.free(e)
+    assert pool.nb_cached() == 2    # the rest went to GC
+
+
+def test_foreign_element_free_is_noop():
+    pool = Mempool(lambda: object())
+    pool.free(object())             # not pool-constructed: dropped quietly
+    assert pool.nb_cached() == 0
+
+
+def test_per_thread_freelists_are_private():
+    pool = Mempool(lambda: object())
+    got = {}
+    barrier = threading.Barrier(3)  # overlap: thread idents are reused
+    # after join, which would alias freelists
+
+    def worker(name):
+        barrier.wait()
+        e = pool.allocate()
+        pool.free(e)
+        got[name] = pool.thread_mempool()
+        barrier.wait()
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    lists = set(id(tm) for tm in got.values())
+    assert len(lists) == 3          # one freelist per thread
+    assert pool.nb_cached() == 3
