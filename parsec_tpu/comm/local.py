@@ -71,6 +71,7 @@ class LocalCommEngine(CommEngine):
         super().__init__(rank, fabric.nb_ranks)
         self.fabric = fabric
         self._get_cbs: Dict[int, Callable] = {}
+        self._get_srcs: Dict[int, int] = {}  # token -> peer rank owing data
         self._get_iter = 0
         self._lock = threading.Lock()
         self.tag_register(TAG_GET_REQ, self._on_get_req)
@@ -103,6 +104,7 @@ class LocalCommEngine(CommEngine):
             self._get_iter += 1
             token = self._get_iter
             self._get_cbs[token] = on_complete
+            self._get_srcs[token] = src_rank
         self.send_am(src_rank, TAG_GET_REQ,
                      {"handle": remote_handle_id, "token": token,
                       "requester": self.rank})
@@ -119,6 +121,7 @@ class LocalCommEngine(CommEngine):
     def _on_get_data(self, src: int, payload: Any) -> None:
         with self._lock:
             cb = self._get_cbs.pop(payload["token"])
+            self._get_srcs.pop(payload["token"], None)
         cb(payload["data"])
 
     def put(self, dst_rank: int, remote_handle_id: int, array: Any,

@@ -101,6 +101,13 @@ class RemoteDepEngine:
     def attach(self, context) -> None:
         self.context = context
         context.comm = self
+        # failure detection: a transport that notices dead peers aborts
+        # this rank's DAG cleanly instead of hanging in termdet forever
+        if hasattr(self.ce, "on_peer_failure"):
+            def _on_failure(peer: int, reason: str) -> None:
+                from .tcp import RankFailedError
+                context.record_task_error(RankFailedError(peer, reason))
+            self.ce.on_peer_failure = _on_failure
 
     def taskpool_register(self, tp) -> None:
         """Wire ids are assigned by registration order — SPMD ranks register

@@ -33,6 +33,21 @@ from ..utils import logging as plog
 from .local import LocalCommEngine, _wire_copy
 
 TAG_BARRIER = TAG_USER_BASE - 1  # reserved by the transport for sync()
+GOODBYE = (1 << 64) - 1  # frame-size sentinel: clean shutdown, not a crash
+
+
+class RankFailedError(RuntimeError):
+    """A peer rank's connection died mid-run (process crash / kill).
+
+    Failure *detection* is the explicit extension beyond the reference
+    (SURVEY.md §5.3: PaRSEC has none — a dead MPI rank hangs the job):
+    a torn connection while the engine is live marks the peer dead and
+    aborts this rank's DAG instead of hanging in termdet forever.
+    Recovery stays app-level: checkpoint/restore_collection (ex08)."""
+
+    def __init__(self, rank: int, reason: str = "connection lost") -> None:
+        super().__init__(f"rank {rank} failed: {reason}")
+        self.rank = rank
 
 
 def free_ports(n: int) -> List[int]:
@@ -65,6 +80,11 @@ class TCPCommEngine(LocalCommEngine):
         self._send_locks: Dict[int, threading.Lock] = {}
         self._recv_threads: List[threading.Thread] = []
         self._closing = False
+        self.dead_peers: set = set()
+        self.finished_peers: set = set()  # clean GOODBYE received
+        #: set by RemoteDepEngine.attach: called (peer, reason) from the
+        #: receiver thread when a live connection tears
+        self.on_peer_failure = None
         self._barrier_seen = 0
         self._barrier_release = 0
         self._barrier_lock = threading.Lock()
@@ -167,20 +187,61 @@ class TCPCommEngine(LocalCommEngine):
             while True:
                 hdr = self._recv_exact(sock, 8)
                 if hdr is None:
-                    return  # peer closed
+                    self._peer_died(peer, "peer closed the connection")
+                    return
                 (size,) = struct.unpack("<Q", hdr)
+                if size == GOODBYE:
+                    with self._lock:
+                        owes_us = peer in self._get_srcs.values()
+                    if owes_us:
+                        # "clean" exit while owing rendezvous data is a
+                        # protocol violation — treat as a failure
+                        self._peer_died(
+                            peer, "shut down owing rendezvous data")
+                        return
+                    # orderly shutdown: the peer fini'd after completing
+                    # its work — not a failure, no scary warnings
+                    self.finished_peers.add(peer)
+                    return
                 frame = self._recv_exact(sock, size)
                 if frame is None:
+                    self._peer_died(peer, "connection truncated mid-frame")
                     return
                 src, tag, payload = pickle.loads(frame)
                 self._inbox.push((src, tag, payload))
-        except OSError:
-            return  # torn down under us (peer fini'd first)
+        except OSError as exc:
+            self._peer_died(peer, f"socket error: {exc}")
+            return
         except Exception as exc:  # frame desync / unpickle failure: a
             # silent receiver death would hang both ranks — make it loud
-            plog.warning("tcp rank %d: receiver for peer %d died: %r",
-                         self.rank, peer, exc)
+            self._peer_died(peer, f"receiver died: {exc!r}")
             return
+
+    def _peer_died(self, peer: int, reason: str) -> None:
+        """Failure detector: a torn connection while we're live marks the
+        peer dead (SURVEY.md §5.3 — the reference has nothing; a dead MPI
+        rank hangs the job). Reporting policy:
+
+        - any later SEND to the peer raises RankFailedError (always);
+        - the death is reported to the runtime immediately when the peer
+          provably owes us data (a pending rendezvous GET), or always
+          under ``comm_failure_strict`` — strict is off by default
+          because with local termination detection a peer may
+          legitimately fini before our local tail work finishes."""
+        if self._closing or peer in self.dead_peers \
+                or peer in self.finished_peers:
+            return  # clean teardown (ours or theirs), or already reported
+        self.dead_peers.add(peer)
+        plog.warning("tcp rank %d: peer %d presumed FAILED (%s)",
+                     self.rank, peer, reason)
+        cb = self.on_peer_failure
+        if cb is None:
+            return
+        from ..utils.params import params
+        with self._lock:
+            owes_us = peer in self._get_srcs.values()
+        if owes_us or params.get("comm_failure_strict"):
+            cb(peer, reason)
 
     # -- the LocalCommEngine transport extension points -----------------
     def send_am(self, dst: int, tag: int, payload: Any) -> None:
@@ -191,6 +252,10 @@ class TCPCommEngine(LocalCommEngine):
         self._transport_post(dst, self.rank, tag, payload)
 
     def _transport_post(self, dst: int, src: int, tag: int, payload: Any) -> None:
+        if dst in self.dead_peers:
+            raise RankFailedError(dst, "send to failed rank")
+        if dst in self.finished_peers:
+            raise RankFailedError(dst, "send to peer after its clean shutdown")
         if dst == self.rank:
             with self._stat_lock:
                 self.fabric.msg_count += 1
@@ -201,8 +266,14 @@ class TCPCommEngine(LocalCommEngine):
             self.fabric.msg_count += 1
             self.fabric.bytes_count += len(frame)
         sock = self._conn_to(dst)
-        with self._send_locks[dst]:
-            sock.sendall(struct.pack("<Q", len(frame)) + frame)
+        try:
+            with self._send_locks[dst]:
+                sock.sendall(struct.pack("<Q", len(frame)) + frame)
+        except OSError as exc:
+            # the send side can see the crash before the receiver thread
+            # does — the RankFailedError contract holds either way
+            self._peer_died(dst, f"send failed: {exc}")
+            raise RankFailedError(dst, f"send failed: {exc}") from exc
 
     def _transport_drain(self):
         while True:
@@ -221,6 +292,13 @@ class TCPCommEngine(LocalCommEngine):
             else:
                 self._barrier_release += 1
 
+    def _check_barrier_peers(self) -> None:
+        # a barrier can never complete once a participant died: raise
+        # instead of spinning until an external timeout
+        if self.dead_peers:
+            raise RankFailedError(min(self.dead_peers),
+                                  "rank failed during barrier")
+
     def sync(self) -> None:
         if self.nb_ranks == 1:
             return
@@ -231,6 +309,7 @@ class TCPCommEngine(LocalCommEngine):
                     if self._barrier_seen >= want:
                         self._barrier_seen -= want
                         break
+                self._check_barrier_peers()
                 self.progress()
                 time.sleep(0.001)
             for peer in range(1, self.nb_ranks):
@@ -242,11 +321,21 @@ class TCPCommEngine(LocalCommEngine):
                     if self._barrier_release >= 1:
                         self._barrier_release -= 1
                         break
+                self._check_barrier_peers()
                 self.progress()
                 time.sleep(0.001)
 
     def fini(self) -> None:
         self._closing = True
+        # clean goodbye so live peers see an orderly shutdown, not a crash
+        for peer, sock in list(self._conns.items()):
+            if peer in self.dead_peers or peer in self.finished_peers:
+                continue
+            try:
+                with self._send_locks[peer]:
+                    sock.sendall(struct.pack("<Q", GOODBYE))
+            except OSError:
+                pass
         try:
             self._listener.close()
         except OSError:

@@ -230,6 +230,9 @@ def register_core_params() -> None:
                       "snapshots to (ref: tools/aggregator_visu)")
     params.reg_int("sde_push_interval_ms", 1000,
                    "milliseconds between SDE pushes")
+    params.reg_bool("comm_failure_strict", False,
+                    "treat ANY torn peer connection as a rank failure "
+                    "(default: only when the peer owes data or is sent to)")
 
 
 register_core_params()

@@ -3,8 +3,10 @@ subprocess by test_comm_tcp.py — real process isolation, the reference's
 mpiexec analog with an actual wire between ranks).
 
 Usage: python tcp_rank_main.py <rank> <nb_ranks> <port0,...> <hops> [mode]
-mode: "ptg" (default — chain JDF), "dtd" (insert-task chain), or
-"dposv" (distributed Cholesky solve: 3 sequential taskpools).
+mode: "ptg" (default — chain JDF), "dtd" (insert-task chain),
+"dposv" (distributed Cholesky solve: 3 sequential taskpools), or
+"fail" (rank 1 hard-exits mid-chain; rank 0 must DETECT the failure and
+abort its DAG instead of hanging — the §5.3 failure detector).
 Prints one JSON line with this rank's observations.
 """
 import json
@@ -112,6 +114,41 @@ def run_dposv(ctx, eng, rank, nb_ranks, n=96, nb=32, nrhs=16):
     return err
 
 
+FAIL_JDF = CHAIN_JDF.replace("X[0, 0] = X[0, 0] + 1.0", "X = hook(X, k)")
+
+
+def run_fail(ctx, eng, rank, nb_ranks, hops):
+    """Rank 1 kills itself mid-chain; rank 0's wait() must raise."""
+    from parsec_tpu.comm.tcp import RankFailedError
+
+    mb = 16
+    coll = TwoDimBlockCyclic((hops + 1) * mb, mb, mb, mb, P=nb_ranks,
+                             Q=1, nodes=nb_ranks, rank=rank,
+                             dtype=np.float32)
+    coll.name = "descA"
+
+    # kill on a mid-chain task that rank 1 owns (block-cyclic: odd k)
+    kill_k = hops // 2 + (1 - (hops // 2) % 2)
+
+    def hook(X, k):
+        if rank == 1 and k == kill_k:
+            os._exit(3)  # simulated crash: no teardown, no goodbye
+        X[0, 0] = X[0, 0] + 1.0
+        return X
+
+    tp = ptg.compile_jdf(FAIL_JDF, name="failchain").new(
+        descA=coll, NB=hops, rank=rank, nb_ranks=nb_ranks)
+    tp.global_env["hook"] = hook
+    ctx.add_taskpool(tp)
+    try:
+        ctx.wait()
+    except RuntimeError as exc:
+        detected = isinstance(exc.__cause__, RankFailedError)
+        return {"rank": rank, "detected": detected,
+                "failed_rank": getattr(exc.__cause__, "rank", None)}
+    return {"rank": rank, "detected": False}
+
+
 def main() -> int:
     rank = int(sys.argv[1])
     nb_ranks = int(sys.argv[2])
@@ -120,11 +157,19 @@ def main() -> int:
     mode = sys.argv[5] if len(sys.argv) > 5 else "ptg"
     # payloads above the short limit must take the GET rendezvous over TCP
     parsec_tpu.params.set_cmdline("runtime_comm_short_limit", "64")
+    if mode == "fail":
+        # a crashed peer may owe only an activation (no pending GET):
+        # strict mode treats any live-context connection tear as failure
+        parsec_tpu.params.set_cmdline("comm_failure_strict", "1")
 
     eng = TCPCommEngine(rank, [("127.0.0.1", p) for p in ports])
     rdep = RemoteDepEngine(eng)
     ctx = parsec_tpu.Context(nb_cores=2, comm=rdep, enable_tpu=False)
     try:
+        if mode == "fail":
+            out = run_fail(ctx, eng, rank, nb_ranks, hops)
+            print(json.dumps(out), flush=True)
+            return 0 if out.get("detected") else 7
         if mode == "dposv":
             err = run_dposv(ctx, eng, rank, nb_ranks)
             eng.sync()
