@@ -85,7 +85,7 @@ class TCPCommEngine(LocalCommEngine):
         #: set by RemoteDepEngine.attach: called (peer, reason) from the
         #: receiver thread when a live connection tears
         self.on_peer_failure = None
-        self._barrier_seen = 0
+        self._barrier_arrived: set = set()
         self._barrier_release = 0
         self._barrier_lock = threading.Lock()
         self._stat_lock = threading.Lock()
@@ -284,46 +284,72 @@ class TCPCommEngine(LocalCommEngine):
 
     # -- barrier over AMs (ref: ce.sync) --------------------------------
     def _on_barrier(self, src: int, payload: Any) -> None:
-        # progress() runs on every scheduler thread: counter updates must
-        # be atomic or arrivals are lost and sync() deadlocks
+        # progress() runs on every scheduler thread: updates must be
+        # atomic or arrivals are lost and sync() deadlocks
         with self._barrier_lock:
             if payload == "arrive":
-                self._barrier_seen += 1
+                self._barrier_arrived.add(src)
             else:
                 self._barrier_release += 1
 
-    def _check_barrier_peers(self) -> None:
-        # a barrier can never complete once a participant died: raise
-        # instead of spinning until an external timeout
-        if self.dead_peers:
-            raise RankFailedError(min(self.dead_peers),
-                                  "rank failed during barrier")
+    def _barrier_wait(self, check_and_consume, required_fn) -> None:
+        """Spin on progress() until ``check_and_consume`` succeeds; raise
+        RankFailedError when a still-required participant is gone
+        (crashed OR cleanly fini'd without arriving) — a barrier can
+        never complete then, and spinning until an external timeout is
+        the hang this detector exists to eliminate. A peer that already
+        arrived may fini freely; its flag is set by the recv thread only
+        AFTER every preceding frame was queued, so one extra drain before
+        raising rules out a queued-but-unprocessed barrier message."""
+        while True:
+            if check_and_consume():
+                return
+            if self.progress():
+                continue
+            gone = [p for p in required_fn()
+                    if p in self.dead_peers or p in self.finished_peers]
+            if gone:
+                self.progress()  # final drain (see docstring)
+                if check_and_consume():
+                    return
+                peer = gone[0]
+                reason = ("rank failed during barrier"
+                          if peer in self.dead_peers else
+                          "rank shut down without joining the barrier")
+                raise RankFailedError(peer, reason)
+            time.sleep(0.001)
 
     def sync(self) -> None:
         if self.nb_ranks == 1:
             return
         if self.rank == 0:
-            want = self.nb_ranks - 1
-            while True:
+            everyone = set(range(1, self.nb_ranks))
+
+            def got_all_arrivals() -> bool:
                 with self._barrier_lock:
-                    if self._barrier_seen >= want:
-                        self._barrier_seen -= want
-                        break
-                self._check_barrier_peers()
-                self.progress()
-                time.sleep(0.001)
+                    if self._barrier_arrived >= everyone:
+                        self._barrier_arrived -= everyone
+                        return True
+                    return False
+
+            def still_missing():
+                with self._barrier_lock:
+                    return everyone - self._barrier_arrived
+
+            self._barrier_wait(got_all_arrivals, still_missing)
             for peer in range(1, self.nb_ranks):
                 self.send_am(peer, TAG_BARRIER, "release")
         else:
             self.send_am(0, TAG_BARRIER, "arrive")
-            while True:
+
+            def got_release() -> bool:
                 with self._barrier_lock:
                     if self._barrier_release >= 1:
                         self._barrier_release -= 1
-                        break
-                self._check_barrier_peers()
-                self.progress()
-                time.sleep(0.001)
+                        return True
+                    return False
+
+            self._barrier_wait(got_release, lambda: (0,))
 
     def fini(self) -> None:
         self._closing = True

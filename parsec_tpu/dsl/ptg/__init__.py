@@ -16,7 +16,8 @@ import os
 from typing import Any, Dict, Optional
 
 from .ast import JDFFile
-from .capture import CaptureError, CapturedTaskpool, capture
+from .capture import (CaptureError, CapturedSequence, CapturedTaskpool,
+                      capture, capture_sequence)
 from .parser import JDFParseError, parse_jdf
 from .runtime import PTGTaskClass, PTGTaskpool
 
@@ -48,4 +49,5 @@ def compile_jdf_file(path: str) -> JDFFactory:
 
 __all__ = ["compile_jdf", "compile_jdf_file", "JDFFactory", "JDFParseError",
            "PTGTaskpool", "PTGTaskClass",
-           "capture", "CapturedTaskpool", "CaptureError"]
+           "capture", "capture_sequence", "CapturedTaskpool",
+           "CapturedSequence", "CaptureError"]

@@ -196,6 +196,25 @@ class TaskClassAST:
     priority: Optional[Expr] = None
     bodies: List[BodyAST] = field(default_factory=list)
 
+    def locals_from_param_args(self, arg_values) -> tuple:
+        """Translate positional dep-target args (which follow this class's
+        PARAM list, e.g. ``P RPANEL( m, k )``) into the locals tuple
+        (range definitions in declaration order). The two orders can
+        differ; producer-driven activation never notices, but any
+        consumer-side instance lookup must translate."""
+        arg_values = tuple(arg_values)
+        if len(self.params) != len(arg_values):
+            return arg_values
+        by_name = dict(zip(self.params, arg_values))
+        out = []
+        for ld in self.locals:
+            if ld.range is None:
+                continue
+            if ld.name not in by_name:
+                return arg_values  # non-param range local: keep positional
+            out.append(by_name[ld.name])
+        return tuple(out)
+
     def flow_by_name(self, name: str) -> FlowAST:
         for f in self.flows:
             if f.name == name:

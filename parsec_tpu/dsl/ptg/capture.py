@@ -95,8 +95,18 @@ class CapturedTaskpool:
                 out[inst.key] = inst
         return out
 
+    def _producer_locals(self, class_name: str, arg_values: Tuple) -> Tuple:
+        """Consumer-side instance lookup: translate dep-target args from
+        the producer's param order to its locals order (ast.py)."""
+        past = self._class_ast.get(class_name)
+        if past is None:
+            return tuple(arg_values)
+        return past.locals_from_param_args(arg_values)
+
     def _plan(self) -> List[_Instance]:
+        self._class_ast = {tc.ast.name: tc.ast for tc in self.tp.task_classes}
         insts = self._instances()
+        self._valid_keys = set(insts)
         for inst in insts.values():
             for f in inst.tc.ast.flows:
                 for d in f.deps_in():
@@ -104,12 +114,15 @@ class CapturedTaskpool:
                     if t is None or t.kind != "task":
                         continue
                     for args in _expand_args(t.args, inst.env):
-                        pkey = (t.task_class, args)
+                        pkey = (t.task_class,
+                                self._producer_locals(t.task_class, args))
                         if pkey not in insts:
-                            raise CaptureError(
-                                f"{inst.tc.ast.name}{inst.locals}.{f.name}: "
-                                f"predecessor {t.task_class}{args} outside "
-                                f"its iteration space")
+                            # a dep line resolving to an out-of-space
+                            # instance is inapplicable, not an error:
+                            # activations are producer-driven, so a
+                            # nonexistent producer simply never fires
+                            # (another dep supplies this input)
+                            continue
                         inst.preds.append(pkey)
         # Kahn
         indeg = {k: len(i.preds) for k, i in insts.items()}
@@ -154,12 +167,20 @@ class CapturedTaskpool:
                 if f.is_ctl:
                     continue
                 val = None
+                dangling = None
                 for d in f.deps_in():
                     t = d.resolve(inst.env)
                     if t is None:
                         continue
                     if t.kind == "task":
-                        args = tuple(a(inst.env) for a in t.args)
+                        args = self._producer_locals(
+                            t.task_class,
+                            tuple(a(inst.env) for a in t.args))
+                        if (t.task_class, args) not in self._valid_keys:
+                            # inapplicable: producer out of space — legal
+                            # only if another dep supplies the input
+                            dangling = f"{t.task_class}{args}"
+                            continue
                         val = out_store[(t.task_class, args, t.flow)]
                     elif t.kind == "memory":
                         coords = tuple(int(a(inst.env)) for a in t.args)
@@ -176,6 +197,13 @@ class CapturedTaskpool:
                         dt = d.properties.get("dtype", "float32")
                         val = jnp.zeros(tuple(int(s) for s in shape), dt)
                     break  # first applicable dep wins (runtime semantics)
+                if val is None and dangling is not None:
+                    # no dep bound a value AND one pointed out-of-space:
+                    # that's a mis-written dep target, not a NULL flow
+                    raise CaptureError(
+                        f"{tc_ast.name}{inst.locals}.{f.name}: input dep "
+                        f"resolves to {dangling}, outside its iteration "
+                        f"space, and no other dep supplies the flow")
                 payloads[f.name] = val
             env.update(payloads)
             env["np"] = np
@@ -239,39 +267,111 @@ class CapturedTaskpool:
         """Execute the captured graph on the taskpool's collections and
         store results back into their tile copies (device-resident when a
         device module is given: results stay in HBM, no host sync)."""
-        import jax
-        tiles: Dict[str, Dict[Tuple, Any]] = {}
-        for name, coll in self.collections.items():
-            per = {}
-            for coords in coll.tiles():
-                data = coll.data_of(*coords)
-                if device is not None:
-                    dc = data.get_copy(device.device_index)
-                    if dc is not None and dc.payload is not None \
-                            and dc.version >= data.newest_copy().version:
-                        per[coords] = dc.payload
-                        continue
-                per[coords] = data.sync_to_host().payload
-            tiles[name] = per
-        out = self.fn(tiles)
-        for name, coll in self.collections.items():
-            for coords, arr in out[name].items():
-                data = coll.data_of(*coords)
-                if device is not None:
-                    dc = data.get_copy(device.device_index)
-                    if dc is None:
-                        from ...data.data import DataCopy
-                        dc = DataCopy(data, device.device_index, payload=arr)
-                        data.attach_copy(dc)
-                    else:
-                        dc.payload = arr
-                    data.version_bump(device.device_index)
+        _run_on_collections(self.collections, self.fn, device)
+
+
+def _run_on_collections(collections, fn, device=None) -> None:
+    """Gather tile payloads (device copies when fresh, else host), call
+    the captured executable, scatter results back as the newest copies."""
+    tiles: Dict[str, Dict[Tuple, Any]] = {}
+    for name, coll in collections.items():
+        per = {}
+        for coords in coll.tiles():
+            data = coll.data_of(*coords)
+            if device is not None:
+                dc = data.get_copy(device.device_index)
+                if dc is not None and dc.payload is not None \
+                        and dc.version >= data.newest_copy().version:
+                    per[coords] = dc.payload
+                    continue
+            per[coords] = data.sync_to_host().payload
+        tiles[name] = per
+    out = fn(tiles)
+    for name, coll in collections.items():
+        for coords, arr in out[name].items():
+            data = coll.data_of(*coords)
+            if device is not None:
+                dc = data.get_copy(device.device_index)
+                if dc is None:
+                    from ...data.data import DataCopy
+                    dc = DataCopy(data, device.device_index, payload=arr)
+                    data.attach_copy(dc)
                 else:
-                    host = data.host_copy()
-                    host.payload = arr
-                    data.version_bump(0)
+                    dc.payload = arr
+                data.version_bump(device.device_index)
+            else:
+                host = data.host_copy()
+                host.payload = arr
+                data.version_bump(0)
 
 
 def capture(tp: PTGTaskpool, donate: bool = False) -> CapturedTaskpool:
     """Capture a PTG taskpool's full DAG into one XLA executable."""
     return CapturedTaskpool(tp, donate=donate)
+
+
+class CapturedSequence:
+    """Several taskpools executed in order as ONE XLA program — the
+    captured analog of sequential add_taskpool/wait composition
+    (parsec_compose, compound.c): later pools see earlier pools' tile
+    writes through the shared collections. e.g. dposv = dpotrf ;
+    trsm_lower ; trsm_lower_trans fused into a single dispatch."""
+
+    def __init__(self, tps: List[PTGTaskpool], donate: bool = False) -> None:
+        if not tps:
+            raise CaptureError("empty taskpool sequence")
+        self.stages = [CapturedTaskpool(tp, donate=False) for tp in tps]
+        self.donate = donate
+        # shared state is keyed by collection OBJECT: stages may bind the
+        # same collection under different global names (dpotrf's descA is
+        # dtrsm's descL) and must still see each other's writes. A name
+        # reused for a DIFFERENT object would silently fork state — error.
+        self._canon_name: Dict[int, str] = {}   # id(coll) -> external name
+        self.collections: Dict[str, Any] = {}   # external name -> coll
+        seen_names: Dict[str, int] = {}
+        for cg in self.stages:
+            for name, coll in cg.collections.items():
+                cid = id(coll)
+                if name in seen_names and seen_names[name] != cid:
+                    raise CaptureError(
+                        f"collection name {name!r} bound to different "
+                        f"objects across the sequence")
+                seen_names[name] = cid
+                if cid not in self._canon_name:
+                    self._canon_name[cid] = name
+                    self.collections[name] = coll
+        self._jitted = None
+
+    @property
+    def nb_tasks(self) -> int:
+        return sum(cg.nb_tasks for cg in self.stages)
+
+    def _execute(self, tiles: Dict[str, Dict[Tuple, Any]]
+                 ) -> Dict[str, Dict[Tuple, Any]]:
+        # object-keyed store; stages view it under their own local names
+        store = {cid: dict(tiles[name])
+                 for cid, name in self._canon_name.items()}
+        for cg in self.stages:
+            sub_in = {name: store[id(coll)]
+                      for name, coll in cg.collections.items()}
+            sub_out = cg._execute(sub_in)
+            for name, coll in cg.collections.items():
+                store[id(coll)] = sub_out[name]
+        return {name: store[cid] for cid, name in self._canon_name.items()}
+
+    @property
+    def fn(self):
+        if self._jitted is None:
+            import jax
+            kw = {"donate_argnums": 0} if self.donate else {}
+            self._jitted = jax.jit(self._execute, **kw)
+        return self._jitted
+
+    def run(self, device=None) -> None:
+        _run_on_collections(self.collections, self.fn, device)
+
+
+def capture_sequence(tps: List[PTGTaskpool],
+                     donate: bool = False) -> CapturedSequence:
+    """Capture a sequential taskpool composition into one executable."""
+    return CapturedSequence(tps, donate=donate)

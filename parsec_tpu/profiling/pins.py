@@ -196,7 +196,10 @@ class IteratorsCheckerModule(PinsModule):
                 if t is None or t.kind != "task":
                     continue
                 if t.task_class == tc.name:
-                    args = tuple(a(env) for a in t.args)
+                    # dep-target args follow the producer's PARAM order;
+                    # task.locals is declaration order — translate
+                    args = tc.ast.locals_from_param_args(
+                        tuple(a(env) for a in t.args))
                     if args == tuple(task.locals):
                         return
             self.errors.append(

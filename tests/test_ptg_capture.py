@@ -119,6 +119,38 @@ def test_captured_dpotrf_sharded_over_mesh():
     assert np.linalg.norm(L @ L.T - M) / np.linalg.norm(M) < 1e-5
 
 
+def test_captured_sequence_dposv():
+    """dposv = dpotrf ; trsm_lower ; trsm_lower^T fused into ONE XLA
+    program via capture_sequence; result matches numpy solve."""
+    from parsec_tpu.ops.dtrsm import (dtrsm_lower_taskpool,
+                                      dtrsm_lower_trans_taskpool)
+    n, nb, nrhs = 192, 64, 64
+    M = make_spd(n, seed=9)
+    rng = np.random.RandomState(9)
+    Bn = rng.rand(n, nrhs).astype(np.float32)
+    A = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float32).from_numpy(M)
+    B = TwoDimBlockCyclic(n, nrhs, nb, nb, dtype=np.float32).from_numpy(Bn)
+    A.name, B.name = "descA", "descB"
+    seq = ptg.capture_sequence([
+        dpotrf_taskpool(A),
+        dtrsm_lower_taskpool(A, B),
+        dtrsm_lower_trans_taskpool(A, B),
+    ])
+    assert seq.nb_tasks > 0
+    seq.run()
+    X = B.to_numpy()
+    ref = np.linalg.solve(M.astype(np.float64), Bn.astype(np.float64))
+    assert np.abs(X - ref).max() < 5e-2
+
+
+def test_captured_sequence_rejects_conflicting_names():
+    _, A1 = _spd_collection(128, 64)
+    _, A2 = _spd_collection(128, 64)
+    A1.name = A2.name = "descA"
+    with pytest.raises(ptg.CaptureError, match="different"):
+        ptg.capture_sequence([dpotrf_taskpool(A1), dpotrf_taskpool(A2)])
+
+
 def test_capture_rejects_multirank():
     _, A = _spd_collection(128, 64)
     tp = dpotrf_taskpool(A, rank=0, nb_ranks=4)
