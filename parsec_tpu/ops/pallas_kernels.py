@@ -112,6 +112,117 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
         o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
 
 
+def _flash_fwd_stats_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
+                            m_scr, l_scr, acc_scr, *, causal: bool,
+                            scale: float, block_q: int, block_k: int,
+                            num_k: int):
+    """The fwd kernel, additionally exporting each row's softmax stats
+    (running max m, denominator l) so callers can MERGE partial-attention
+    results across key blocks held elsewhere — the building block of
+    sequence-parallel flash (ring attention's per-step local compute)."""
+    _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+                      causal=causal, scale=scale, block_q=block_q,
+                      block_k=block_k, num_k=num_k)
+
+    @pl.when(pl.program_id(2) == num_k - 1)
+    def _export():
+        # raw stats (l may be 0 / m may be _NEG_INF for fully-masked
+        # rows — the merge ignores them; only o is safe-normalized).
+        # Outputs are lane-replicated [bq, 128] (the scratch layout):
+        # Mosaic requires 8x128-tileable output blocks, so a (1, bq)
+        # row-vector block cannot lower; callers slice lane 0.
+        m_ref[0] = m_scr[:]
+        l_ref[0] = l_scr[:]
+
+
+def flash_attention_stats(q: Any, k: Any, v: Any, causal: bool = False,
+                          scale: float | None = None, block_q: int = 512,
+                          block_k: int = 512):
+    """Flash attention over one key block-set, returning
+    ``(o, m, l)``: o = softmax(qk^T)v normalized within THIS k/v set,
+    m/l = per-row running max / denominator ([B, H, T] f32). Merge rule
+    for combining two sets a, b:
+
+        m = max(m_a, m_b);  w_x = exp(m_x - m) * l_x
+        o = (o_a w_a + o_b w_b) / (w_a + w_b);  l = w_a + w_b
+    """
+    B, H, T, D = q.shape
+    Tk = k.shape[2]
+    if scale is None:
+        scale = D ** -0.5
+    if _interpret():
+        from ..parallel.mesh import _vma_of
+        if _vma_of(q):
+            # interpret-mode pallas inside a VMA-checked shard_map trips
+            # jax's varying-axes checks on the emulation's slice ops; the
+            # CPU-mesh tests take the identical-math jnp path instead
+            # (the kernel itself is covered by the non-shard_map tests)
+            return _flash_stats_reference(q, k, v, causal, float(scale))
+    bq = _pick_block(T, block_q)
+    bk = _pick_block(Tk, block_k)
+    BH = B * H
+    q3 = q.reshape(BH, T, D)
+    k3 = k.reshape(BH, Tk, D)
+    v3 = v.reshape(BH, Tk, D)
+    num_q = pl.cdiv(T, bq)
+    num_k = pl.cdiv(Tk, bk)
+    kernel = functools.partial(
+        _flash_fwd_stats_kernel, causal=causal, scale=float(scale),
+        block_q=bq, block_k=bk, num_k=num_k)
+    o3, m3, l3 = pl.pallas_call(
+        kernel,
+        grid=(BH, num_q, num_k),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            _out_struct((BH, T, D), q3),
+            _out_struct_f32((BH, T, 128), q3),
+            _out_struct_f32((BH, T, 128), q3),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+        interpret=_interpret(),
+    )(q3, k3, v3)
+    return (o3.reshape(B, H, T, D), m3[..., 0].reshape(B, H, T),
+            l3[..., 0].reshape(B, H, T))
+
+
+def _flash_stats_reference(q, k, v, causal: bool, scale: float):
+    """jnp twin of the stats kernel (same m/l conventions: local-index
+    causal mask, raw l=0 / m=_NEG_INF on fully-masked rows, o safe-
+    normalized)."""
+    T, Tk = q.shape[2], k.shape[2]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = jnp.arange(T)[:, None] >= jnp.arange(Tk)[None, :]
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    m = s.max(axis=-1)
+    p = jnp.exp(s - m[..., None])
+    # fully-masked rows: kernel leaves m=_NEG_INF, l=0 (exp(_NEG_INF -
+    # _NEG_INF)=1 would otherwise pollute l)
+    dead = m <= _NEG_INF
+    l = jnp.where(dead, 0.0, p.sum(axis=-1))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v,
+                   preferred_element_type=jnp.float32) / l_safe[..., None]
+    o = jnp.where(dead[..., None], 0.0, o).astype(q.dtype)
+    return o, m, l
+
+
 def _flash_fwd(q3: Any, k3: Any, v3: Any, causal: bool, scale: float,
                block_q: int, block_k: int) -> Any:
     BH, T, D = q3.shape
@@ -152,6 +263,15 @@ def _out_struct(shape, like):
     if vma:
         return jax.ShapeDtypeStruct(shape, like.dtype, vma=frozenset(vma))
     return jax.ShapeDtypeStruct(shape, like.dtype)
+
+
+def _out_struct_f32(shape, like):
+    """f32 output struct carrying ``like``'s vma (stats outputs)."""
+    from ..parallel.mesh import _vma_of
+    vma = _vma_of(like)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, jnp.float32, vma=frozenset(vma))
+    return jax.ShapeDtypeStruct(shape, jnp.float32)
 
 
 def _pick_block(t: int, pref: int) -> int:

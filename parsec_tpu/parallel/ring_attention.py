@@ -12,6 +12,7 @@ the TPU-native way.
 """
 from __future__ import annotations
 
+import functools
 from typing import Any
 
 import jax
@@ -20,9 +21,31 @@ from jax import lax
 
 
 def ring_attention(q: Any, k: Any, v: Any, axis_name: str = "sp",
-                   causal: bool = True, scale: float | None = None) -> Any:
+                   causal: bool = True, scale: float | None = None,
+                   use_pallas: bool | None = None) -> Any:
     """q, k, v: [B, H, T_local, Dh] per-shard chunks (inside shard_map over
-    ``axis_name``). Returns [B, H, T_local, Dh]."""
+    ``axis_name``). Returns [B, H, T_local, Dh].
+
+    ``use_pallas`` selects the per-step local compute: the Pallas flash
+    kernel with exported softmax stats (no [T_local, T_local] score
+    materialization — O(T_local) memory in the forward) vs the jnp
+    online-softmax path. None = auto (flash on TPU for 128-lane-aligned
+    shapes). The flash path's backward recomputes through the jnp ring
+    (same activation cost as the jnp path's AD; the win is the forward)."""
+    B, H, Tl, Dh = q.shape
+    if use_pallas is None:  # auto: aligned shapes + the pallas policy knob
+        from ..ops import pallas_kernels as _pk
+        use_pallas = (Tl % 128 == 0 and Dh % 8 == 0
+                      and _pk is not None and _pk.use_pallas())
+    if use_pallas:  # explicit True runs the kernel even off-TPU (interpret)
+        if scale is None:
+            scale = Dh ** -0.5
+        return _ring_flash(q, k, v, axis_name, causal, float(scale))
+    return _ring_jnp(q, k, v, axis_name, causal, scale)
+
+
+def _ring_jnp(q: Any, k: Any, v: Any, axis_name: str,
+              causal: bool, scale: float | None) -> Any:
     sp = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     B, H, Tl, Dh = q.shape
@@ -60,6 +83,92 @@ def ring_attention(q: Any, k: Any, v: Any, axis_name: str = "sp",
         step, (k, v, m0, l0, acc0), jnp.arange(sp))
     out = acc / l[..., None]
     return out.astype(q.dtype)
+
+
+# -- flash ring: Pallas local blocks + cross-shard stats merge -------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_flash(q, k, v, axis_name: str, causal: bool, scale: float):
+    return _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale)
+
+
+def _ring_flash_fwd_impl(q, k, v, axis_name: str, causal: bool,
+                         scale: float):
+    from ..ops.pallas_kernels import _NEG_INF, flash_attention_stats
+    from .mesh import match_vma
+
+    sp = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    B, H, Tl, Dh = q.shape
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    def _norm(o, m, l):
+        # one output type for every switch branch: f32 o, q's vma on all
+        return (match_vma(o.astype(jnp.float32), q),
+                match_vma(m, q), match_vma(l, q))
+
+    def full_blk(kv):
+        kb, vb = kv
+        return _norm(*flash_attention_stats(q, kb, vb, causal=False,
+                                            scale=scale))
+
+    def diag_blk(kv):
+        kb, vb = kv
+        return _norm(*flash_attention_stats(q, kb, vb, causal=causal,
+                                            scale=scale))
+
+    def skip_blk(kv):
+        return _norm(jnp.zeros((B, H, Tl, Dh), jnp.float32),
+                     jnp.full((B, H, Tl), _NEG_INF, jnp.float32),
+                     jnp.zeros((B, H, Tl), jnp.float32))
+
+    def step(carry, t):
+        k_blk, v_blk, m, l, acc = carry
+        src = (idx - t) % sp
+        # block relation to the diagonal decides masking: past shards
+        # attend fully, own shard causally, future shards not at all
+        if causal:
+            sel = jnp.where(src == idx, 1, jnp.where(src > idx, 2, 0))
+            o_b, m_b, l_b = lax.switch(sel, [full_blk, diag_blk, skip_blk],
+                                       (k_blk, v_blk))
+        else:  # static: every block attends fully — no dead branches
+            o_b, m_b, l_b = full_blk((k_blk, v_blk))
+        # merge this block's normalized partial into the running state
+        m_new = jnp.maximum(m, m_b)
+        c_run = jnp.exp(m - m_new) * l
+        c_blk = jnp.exp(m_b - m_new) * l_b
+        acc_new = acc * jnp.exp(m - m_new)[..., None] \
+            + o_b * c_blk[..., None]
+        l_new = c_run + c_blk
+        k_nxt = lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = lax.ppermute(v_blk, axis_name, perm)
+        return (k_nxt, v_nxt, m_new, l_new, acc_new), None
+
+    m0 = match_vma(jnp.full((B, H, Tl), _NEG_INF, jnp.float32), q)
+    l0 = match_vma(jnp.zeros((B, H, Tl), jnp.float32), q)
+    acc0 = match_vma(jnp.zeros((B, H, Tl, Dh), jnp.float32), q)
+    (k_f, v_f, m, l, acc), _ = lax.scan(
+        step, (k, v, m0, l0, acc0), jnp.arange(sp))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    return (acc / l_safe[..., None]).astype(q.dtype)
+
+
+def _ring_flash_vjp_fwd(q, k, v, axis_name, causal, scale):
+    return _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale), (q, k, v)
+
+
+def _ring_flash_vjp_bwd(axis_name, causal, scale, res, g):
+    # backward recomputes through the differentiable jnp ring — identical
+    # math, so gradients are exact; activation memory matches the jnp
+    # path's AD (the flash win is the forward's O(T_local) footprint)
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _ring_jnp(q_, k_, v_, axis_name, causal, scale),
+        q, k, v)
+    return vjp(g)
+
+
+_ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
 
 
 def local_attention(q: Any, k: Any, v: Any, causal: bool = True,

@@ -81,3 +81,32 @@ def test_matmul_jit_and_grad():
                                rtol=1e-4, atol=1e-4)
     np.testing.assert_allclose(np.asarray(gb), np.asarray(a).T @ g,
                                rtol=1e-4, atol=1e-4)
+
+
+def test_flash_stats_merge_across_blocks():
+    """flash_attention_stats + the documented merge rule == attention
+    over the concatenated key/value sets (the ring-attention building
+    block), including causal stats conventions."""
+    import jax
+    import jax.numpy as jnp
+    B, H, T, D = 2, 2, 64, 16
+    rng = np.random.RandomState(3)
+    mk = lambda: jnp.asarray(rng.rand(B, H, T, D), dtype=jnp.float32)
+    q, k1, v1, k2, v2 = mk(), mk(), mk(), mk(), mk()
+    o1, m1, l1 = pk.flash_attention_stats(q, k1, v1, block_q=32, block_k=32)
+    o2, m2, l2 = pk.flash_attention_stats(q, k2, v2, block_q=32, block_k=32)
+    m = jnp.maximum(m1, m2)
+    w1 = jnp.exp(m1 - m) * l1
+    w2 = jnp.exp(m2 - m) * l2
+    o = (o1 * w1[..., None] + o2 * w2[..., None]) / (w1 + w2)[..., None]
+    kf = jnp.concatenate([k1, k2], 2)
+    vf = jnp.concatenate([v1, v2], 2)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, kf) * (D ** -0.5)
+    ref = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), vf)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+    # causal stats: row 0 attends 1 key -> l == 1, fully-unmasked rows
+    # accumulate T keys' worth of mass
+    oc, mc, lc = pk.flash_attention_stats(q, k1, v1, causal=True,
+                                          block_q=32, block_k=32)
+    assert np.allclose(np.asarray(lc)[..., 0], 1.0, atol=1e-5)
