@@ -8,7 +8,7 @@ from .linalg import (axpy, gemm, gemm_nn, gemm_nn_sub, gemm_nt,
 from . import dpotrf as dpotrf_module
 from .dpotrf import dpotrf, dpotrf_factory, dpotrf_taskpool, make_spd
 from .dgeqrf import dgeqrf, dgeqrf_factory, dgeqrf_taskpool
-from .dgetrf import (dgetrf_factory, dgetrf_nopiv, dgetrf_nopiv_taskpool,
+from .dgetrf import (dgetrf, dgetrf_factory, dgetrf_nopiv, dgetrf_nopiv_taskpool,
                      make_diag_dominant)
 from .pdgemm import pdgemm, pdgemm_factory, pdgemm_taskpool
 from .dtrsm import (dposv, dtrsm_lower_taskpool, dtrsm_lower_trans_taskpool)
@@ -26,7 +26,7 @@ __all__ = ["potrf", "trsm_panel", "syrk_ln", "gemm_nt", "gemm_nn",
            "getrf_nopiv", "trsm_lower_unit", "trsm_upper_right",
            "dpotrf", "dpotrf_factory", "dpotrf_taskpool", "make_spd",
            "dgeqrf", "dgeqrf_factory", "dgeqrf_taskpool",
-           "dgetrf_nopiv", "dgetrf_nopiv_taskpool", "dgetrf_factory",
+           "dgetrf", "dgetrf_nopiv", "dgetrf_nopiv_taskpool", "dgetrf_factory",
            "make_diag_dominant",
            "pdgemm", "pdgemm_factory", "pdgemm_taskpool",
            "dposv", "dtrsm_lower_taskpool", "dtrsm_lower_trans_taskpool",

@@ -149,6 +149,81 @@ def dgetrf_nopiv(context, A: TiledMatrix, rank: int = 0,
     context.wait()
 
 
+def dgetrf(A: np.ndarray, nb: int = 256):
+    """Blocked LU with partial pivoting: ``A = P L U`` (general matrices,
+    no diagonal-dominance requirement — the DPLASMA dgetrf-parity op the
+    nopiv PTG variant cannot cover).
+
+    TPU-native design: pivoting's data-dependent row swaps do not fit an
+    affine PTG, so this is a single jitted XLA program — LAPACK-grade
+    panel factorization via ``lax.linalg.lu`` (XLA's pivoted LU custom
+    call), triangular solves for the block row, and one large MXU GEMM
+    per trailing update; the panel loop is unrolled at trace time
+    (problem-size-static, like a captured taskpool).
+
+    Returns ``(LU, piv)``: packed factors (unit-lower L strictly below
+    the diagonal, U on/above) and the pivot ROW PERMUTATION vector —
+    ``A[piv] == L @ U``.
+    """
+    LU, perm = _dgetrf_jit(A.shape, nb, np.dtype(A.dtype).name)(A)
+    return LU, perm
+
+
+import functools as _functools  # noqa: E402
+
+
+@_functools.lru_cache(maxsize=64)
+def _dgetrf_jit(shape, nb: int, dtype_name: str):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n_rows, n_cols = shape
+    kt = (min(n_rows, n_cols) + nb - 1) // nb
+
+    def fac(M):
+        LU = M
+        perm = jnp.arange(n_rows)
+        for k in range(kt):
+            k0 = k * nb
+            # panel columns stop at the diagonal extent: for wide
+            # matrices (n_rows < n_cols) the columns beyond row count
+            # belong to the block row, not the factored panel
+            k1 = min(k0 + nb, n_rows, n_cols)
+            # panel: all rows below k0, this block column
+            panel = LU[k0:, k0:k1]
+            p_lu, p_piv, p_perm = lax.linalg.lu(panel)
+            # apply the panel's row permutation to the whole trailing
+            # rows (left factors + trailing columns) and the perm vector
+            rows = LU[k0:]
+            rows = rows.at[:, k0:k1].set(p_lu)
+            rows = rows.at[:, :k0].set(rows[:, :k0][p_perm])
+            rows = rows.at[:, k1:].set(rows[:, k1:][p_perm])
+            LU = LU.at[k0:].set(rows)
+            perm = perm.at[k0:].set(perm[k0:][p_perm])
+            if k1 < n_cols:
+                L11 = jnp.tril(LU[k0:k1, k0:k1], -1) + jnp.eye(
+                    k1 - k0, dtype=M.dtype)
+                U12 = lax.linalg.triangular_solve(
+                    L11, LU[k0:k1, k1:], left_side=True, lower=True,
+                    unit_diagonal=True)
+                LU = LU.at[k0:k1, k1:].set(U12)
+                if k1 < n_rows:
+                    L21 = LU[k1:, k0:k1]
+                    # true-f32 inputs (HIGHEST): unlike a lone GEMM,
+                    # LU feeds each update into the next panel, so the
+                    # MXU's default bf16-input pass compounds to ~1e-1
+                    # relative error at n=4096 (measured)
+                    LU = LU.at[k1:, k1:].add(
+                        -jnp.matmul(L21, U12,
+                                    precision=lax.Precision.HIGHEST,
+                                    preferred_element_type=jnp.float32)
+                        .astype(M.dtype))
+        return LU, perm
+
+    return jax.jit(fac)
+
+
 def make_diag_dominant(m: int, n: int = None, dtype=np.float32,
                        seed: int = 0) -> np.ndarray:
     """A diagonally-dominant matrix — LU-stable without pivoting."""

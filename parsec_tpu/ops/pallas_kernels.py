@@ -184,8 +184,8 @@ def flash_attention_stats(q: Any, k: Any, v: Any, causal: bool = False,
         ],
         out_shape=[
             _out_struct((BH, T, D), q3),
-            _out_struct_f32((BH, T, 128), q3),
-            _out_struct_f32((BH, T, 128), q3),
+            _out_struct((BH, T, 128), q3, jnp.float32),
+            _out_struct((BH, T, 128), q3, jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, 128), jnp.float32),
@@ -254,24 +254,17 @@ def _flash_fwd(q3: Any, k3: Any, v3: Any, causal: bool, scale: float,
     )(q3, k3, v3)
 
 
-def _out_struct(shape, like):
-    """Output ShapeDtypeStruct matching ``like``'s dtype and — inside a
-    VMA-checked shard_map — its varying-mesh-axes set (pallas_call cannot
-    infer vma itself; without it check_vma=True rejects the call)."""
+def _out_struct(shape, like, dtype=None):
+    """Output ShapeDtypeStruct in ``dtype`` (default: ``like``'s) carrying
+    — inside a VMA-checked shard_map — ``like``'s varying-mesh-axes set
+    (pallas_call cannot infer vma itself; without it check_vma=True
+    rejects the call)."""
     from ..parallel.mesh import _vma_of
+    dtype = like.dtype if dtype is None else dtype
     vma = _vma_of(like)  # None on jax versions without VMA tracking
     if vma:
-        return jax.ShapeDtypeStruct(shape, like.dtype, vma=frozenset(vma))
-    return jax.ShapeDtypeStruct(shape, like.dtype)
-
-
-def _out_struct_f32(shape, like):
-    """f32 output struct carrying ``like``'s vma (stats outputs)."""
-    from ..parallel.mesh import _vma_of
-    vma = _vma_of(like)
-    if vma:
-        return jax.ShapeDtypeStruct(shape, jnp.float32, vma=frozenset(vma))
-    return jax.ShapeDtypeStruct(shape, jnp.float32)
+        return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
+    return jax.ShapeDtypeStruct(shape, dtype)
 
 
 def _pick_block(t: int, pref: int) -> int:

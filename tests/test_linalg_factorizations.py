@@ -387,3 +387,50 @@ def test_dgetrf_multirank_distributed():
     L = np.tril(got, -1) + np.eye(n)
     U = np.triu(got)
     np.testing.assert_allclose(L @ U, M.astype(np.float64), atol=5e-3)
+
+
+def test_dgetrf_partial_pivoting():
+    """Pivoted blocked LU (ops.dgetrf): A[piv] == L U for a general
+    (non-diagonally-dominant) matrix the nopiv variant cannot factor
+    stably."""
+    from parsec_tpu.ops import dgetrf
+
+    n, nb = 192, 64
+    rng = np.random.RandomState(11)
+    A = (rng.rand(n, n) - 0.5).astype(np.float32)  # no dominance
+    LU, piv = dgetrf(A, nb=nb)
+    LU = np.asarray(LU)
+    L = np.tril(LU, -1) + np.eye(n, dtype=np.float32)
+    U = np.triu(LU)
+    assert np.linalg.norm(A[np.asarray(piv)] - L @ U) \
+        / np.linalg.norm(A) < 1e-5
+    # pivoting actually happened (a random matrix always needs swaps)
+    assert not np.array_equal(np.asarray(piv), np.arange(n))
+
+
+def test_dgetrf_rectangular():
+    from parsec_tpu.ops import dgetrf
+
+    m, n, nb = 160, 96, 64
+    rng = np.random.RandomState(12)
+    A = (rng.rand(m, n) - 0.5).astype(np.float32)
+    LU, piv = dgetrf(A, nb=nb)
+    LU = np.asarray(LU)
+    L = np.tril(LU, -1)[:, :n] + np.eye(m, n, dtype=np.float32)
+    U = np.triu(LU)[:n]
+    assert np.linalg.norm(A[np.asarray(piv)] - L @ U) \
+        / np.linalg.norm(A) < 1e-5
+
+
+def test_dgetrf_wide():
+    from parsec_tpu.ops import dgetrf
+
+    m, n, nb = 96, 160, 64
+    rng = np.random.RandomState(13)
+    A = (rng.rand(m, n) - 0.5).astype(np.float32)
+    LU, piv = dgetrf(A, nb=nb)
+    LU = np.asarray(LU)
+    L = np.tril(LU, -1)[:, :m] + np.eye(m, dtype=np.float32)
+    U = np.triu(LU)
+    assert np.linalg.norm(A[np.asarray(piv)] - L @ U) \
+        / np.linalg.norm(A) < 1e-5
