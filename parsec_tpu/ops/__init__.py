@@ -8,6 +8,7 @@ from .linalg import (axpy, gemm, gemm_nn, gemm_nn_sub, gemm_nt,
 from . import dpotrf as dpotrf_module
 from .dpotrf import dpotrf, dpotrf_factory, dpotrf_taskpool, make_spd
 from .dgeqrf import dgeqrf, dgeqrf_factory, dgeqrf_taskpool
+from .inverse import dgesv, dgetrs, dlauum, dpotri, dtrtri
 from .dgetrf import (dgetrf, dgetrf_factory, dgetrf_nopiv, dgetrf_nopiv_taskpool,
                      make_diag_dominant)
 from .pdgemm import pdgemm, pdgemm_factory, pdgemm_taskpool
@@ -27,6 +28,7 @@ __all__ = ["potrf", "trsm_panel", "syrk_ln", "gemm_nt", "gemm_nn",
            "dpotrf", "dpotrf_factory", "dpotrf_taskpool", "make_spd",
            "dgeqrf", "dgeqrf_factory", "dgeqrf_taskpool",
            "dgetrf", "dgetrf_nopiv", "dgetrf_nopiv_taskpool", "dgetrf_factory",
+           "dtrtri", "dlauum", "dpotri", "dgetrs", "dgesv",
            "make_diag_dominant",
            "pdgemm", "pdgemm_factory", "pdgemm_taskpool",
            "dposv", "dtrsm_lower_taskpool", "dtrsm_lower_trans_taskpool",

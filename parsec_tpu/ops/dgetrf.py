@@ -17,6 +17,8 @@ and above: A = L U (verify by reconstruction).
 """
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 from ..collections.matrix import TiledMatrix
@@ -169,10 +171,7 @@ def dgetrf(A: np.ndarray, nb: int = 256):
     return LU, perm
 
 
-import functools as _functools  # noqa: E402
-
-
-@_functools.lru_cache(maxsize=64)
+@functools.lru_cache(maxsize=64)
 def _dgetrf_jit(shape, nb: int, dtype_name: str):
     import jax
     import jax.numpy as jnp
@@ -214,10 +213,11 @@ def _dgetrf_jit(shape, nb: int, dtype_name: str):
                     # LU feeds each update into the next panel, so the
                     # MXU's default bf16-input pass compounds to ~1e-1
                     # relative error at n=4096 (measured)
+                    acc = jnp.promote_types(M.dtype, jnp.float32)
                     LU = LU.at[k1:, k1:].add(
                         -jnp.matmul(L21, U12,
                                     precision=lax.Precision.HIGHEST,
-                                    preferred_element_type=jnp.float32)
+                                    preferred_element_type=acc)
                         .astype(M.dtype))
         return LU, perm
 

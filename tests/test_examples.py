@@ -16,6 +16,7 @@ import pytest
     "examples.ex06_raw",
     "examples.ex07_raw_ctl",
     "examples.ex08_dposv_checkpoint",
+    "examples.ex09_capture",
 ])
 def test_example_runs(mod):
     m = importlib.import_module(mod)

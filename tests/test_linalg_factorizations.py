@@ -434,3 +434,45 @@ def test_dgetrf_wide():
     U = np.triu(LU)
     assert np.linalg.norm(A[np.asarray(piv)] - L @ U) \
         / np.linalg.norm(A) < 1e-5
+
+
+# --------------------------------------------------------------------- #
+# inverses / solves (the potri family + gesv)                           #
+# --------------------------------------------------------------------- #
+def test_dtrtri_inverse():
+    from parsec_tpu.ops import dtrtri
+
+    n = 96
+    rng = np.random.RandomState(21)
+    L = np.tril(rng.rand(n, n).astype(np.float32)) + 2 * np.eye(
+        n, dtype=np.float32)
+    Linv = np.asarray(dtrtri(L, lower=True))
+    np.testing.assert_allclose(Linv @ L, np.eye(n), atol=2e-4)
+    U = L.T.copy()
+    Uinv = np.asarray(dtrtri(U, lower=False))
+    np.testing.assert_allclose(U @ Uinv, np.eye(n), atol=2e-4)
+
+
+def test_dpotri_spd_inverse_from_cholesky(ctx):
+    """potrf (PTG) then potri: the full DPLASMA zpotri pipeline."""
+    from parsec_tpu.ops import dpotri, dpotrf_taskpool, make_spd
+
+    n, nb = 128, 64
+    M = make_spd(n, seed=22)
+    A = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float32).from_numpy(M)
+    _run(ctx, dpotrf_taskpool(A))
+    L = np.tril(A.to_numpy()).astype(np.float32)
+    Ainv = np.asarray(dpotri(L))
+    np.testing.assert_allclose(Ainv @ M, np.eye(n), atol=5e-3)
+
+
+def test_dgesv_general_solve():
+    from parsec_tpu.ops import dgesv
+
+    n, nrhs = 160, 8
+    rng = np.random.RandomState(23)
+    A = (rng.rand(n, n) - 0.5).astype(np.float32)
+    B = rng.rand(n, nrhs).astype(np.float32)
+    X = np.asarray(dgesv(A, B, nb=64))
+    ref = np.linalg.solve(A.astype(np.float64), B.astype(np.float64))
+    assert np.abs(X - ref).max() < 5e-2
