@@ -156,6 +156,18 @@ class Context:
 
         self.keep_highest_priority_task = params.get("runtime_keep_highest_priority_task")
 
+        # optional dedicated funnelled comm-progress thread (ref: the
+        # comm thread remote_dep_mpi.c:478, bound via -C): useful when
+        # every worker is busy in long device kernels and nobody drains
+        # the engine; default off — workers drain during idle cycles
+        self._comm_thread = None
+        self._comm_thread_stop = threading.Event()
+        if self.comm is not None and params.get("comm_thread"):
+            self._comm_thread = threading.Thread(
+                target=self._comm_thread_main, name="parsec-comm",
+                daemon=True)
+            self._comm_thread.start()
+
     # ------------------------------------------------------------------ #
     # taskpool lifecycle                                                 #
     # ------------------------------------------------------------------ #
@@ -309,6 +321,25 @@ class Context:
     # ------------------------------------------------------------------ #
     # idle-loop helpers                                                  #
     # ------------------------------------------------------------------ #
+    def _comm_thread_main(self) -> None:
+        from .vpmap import bind_current_thread
+        core = params.get("comm_thread_bind")
+        if core >= 0:
+            bind_current_thread(core)
+        es0 = self.execution_streams[0]
+        idle = 0
+        while not self._comm_thread_stop.is_set():
+            try:
+                n = self.comm.progress(es0)
+            except BaseException as exc:
+                self.record_task_error(exc)
+                n = 0
+            if n:
+                idle = 0
+            else:
+                idle = min(idle + 1, 10)
+                self._comm_thread_stop.wait(1e-5 * (1 << idle))
+
     def wake_workers(self, n: int = 1) -> None:
         with self._work_cond:
             self._work_cond.notify_all()
@@ -334,7 +365,9 @@ class Context:
             n += 1
         for dev in self.devices:
             n += dev.progress(es)
-        if self.comm is not None:
+        if self.comm is not None and self._comm_thread is None:
+            # funnelled mode: ONLY the dedicated thread touches the
+            # engine (ref: remote_dep_dequeue_main owns all MPI calls)
             n += self.comm.progress(es)
         return n
 
@@ -362,6 +395,11 @@ class Context:
             t.join(timeout=2.0)
         for dev in self.devices:
             dev.fini()
+        if self._comm_thread is not None:
+            # stop the funnelled progress thread BEFORE tearing the
+            # engine down under it
+            self._comm_thread_stop.set()
+            self._comm_thread.join(timeout=5)
         if self.comm is not None:
             self.comm.fini()
         if self._sde_pusher is not None:

@@ -230,6 +230,12 @@ def register_core_params() -> None:
                       "snapshots to (ref: tools/aggregator_visu)")
     params.reg_int("sde_push_interval_ms", 1000,
                    "milliseconds between SDE pushes")
+    params.reg_bool("comm_thread", False,
+                    "dedicated funnelled comm-progress thread (ref: the "
+                    "remote_dep_dequeue_main thread); default: workers "
+                    "drain comm during idle cycles")
+    params.reg_int("comm_thread_bind", -1,
+                   "core to pin the comm thread to (ref: -C; -1 = unbound)")
     params.reg_bool("comm_failure_strict", False,
                     "treat ANY torn peer connection as a rank failure "
                     "(default: only when the peer owes data or is sent to)")

@@ -264,3 +264,54 @@ def test_dtd_cross_rank_chain():
     assert hist1 == [1, 3, 5]
     assert final0 == float(N)  # flushed back home
     assert fabric.msg_count > 0
+
+
+def test_dedicated_comm_thread_drains_progress():
+    """--mca comm_thread 1: the funnelled progress thread (ref: the
+    remote_dep comm thread, optionally bound via -C) drives the dataflow
+    even though every worker is parked; the run completes and the thread
+    is joined at fini."""
+    import parsec_tpu
+    from conftest import spmd
+    from parsec_tpu.comm import RemoteDepEngine
+    from parsec_tpu.collections import DictCollection
+    from parsec_tpu import dtd
+    from parsec_tpu.dsl.dtd import AFFINITY, INOUT, VALUE, unpack_args
+
+    parsec_tpu.params.set_cmdline("comm_thread", "1")
+    try:
+        def rank_fn(rank, fabric):
+            eng = RemoteDepEngine(fabric.engine(rank))
+            c = parsec_tpu.Context(nb_cores=1, comm=eng, enable_tpu=False)
+            try:
+                assert c._comm_thread is not None
+                assert c._comm_thread.is_alive()
+                coll = DictCollection(nodes=2, rank=rank)
+                coll.name = "C"
+                coll.add("x", 0, np.full((64,), 1.0, np.float32)
+                         if rank == 0 else None)
+                tp = dtd.taskpool_new()
+                c.add_taskpool(tp)
+                tile = tp.tile_of(coll, "x")
+
+                def bump(es, task):
+                    x, a = unpack_args(task)
+                    x += a
+
+                for _ in range(6):
+                    tp.insert_task(bump, (tile, INOUT), (1.0, VALUE))
+                tp.data_flush_all()
+                tp.wait()
+                thread = c._comm_thread
+            finally:
+                c.fini()
+            assert not thread.is_alive()  # joined at fini
+            if rank == 0:
+                return float(np.asarray(
+                    coll.data_of("x").newest_copy().payload)[0])
+            return None
+
+        results, _ = spmd(2, rank_fn)
+        assert 7.0 in results  # 1 + 6 bumps
+    finally:
+        parsec_tpu.params.reset()
