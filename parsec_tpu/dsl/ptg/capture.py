@@ -270,6 +270,15 @@ class CapturedTaskpool:
         _run_on_collections(self.collections, self.fn, device)
 
 
+def plan(tp: PTGTaskpool) -> List[_Instance]:
+    """Symbolically enumerate ``tp``'s task instances in topological
+    order with resolved predecessor lists — the planning half of capture,
+    usable standalone (tools/dagenum.py) without compiling bodies."""
+    cg = CapturedTaskpool.__new__(CapturedTaskpool)
+    cg.tp = tp
+    return cg._plan()
+
+
 def _run_on_collections(collections, fn, device=None) -> None:
     """Gather tile payloads (device copies when fresh, else host), call
     the captured executable, scatter results back as the newest copies."""

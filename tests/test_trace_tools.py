@@ -249,3 +249,18 @@ def test_counter_aggregate_watch_mode(trace_files, capsys):
         ["--watch", "0.05", "--watch-rounds", "2"] + paths) == 0
     out = capsys.readouterr().out
     assert out.count("rank files") == 2  # two refreshes printed
+
+
+def test_dagenum_enumerates_without_executing(tmp_path, capsys):
+    """tools/dagenum.py: symbolic DAG enumeration (dagenum.c analog) —
+    counts, edges, critical path, DOT — with no task ever executed."""
+    import dagenum
+    from parsec_tpu.ops.dpotrf import DPOTRF_L_JDF
+
+    jdf = tmp_path / "dpotrf.jdf"
+    jdf.write_text(DPOTRF_L_JDF)
+    dot = tmp_path / "dag.dot"
+    assert dagenum.main([str(jdf), "-g", "NT=4", "--dot", str(dot)]) == 0
+    out = capsys.readouterr().out
+    assert "20 tasks, 30 dependence edges, critical path 10" in out
+    assert dot.read_text().count("->") == 30

@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Enumerate a compiled JDF's task DAG without executing it
+(ref: tools/dagenum.c + tools/grapher.c — offline DAG enumeration and
+rendering; here built on the capture planner's symbolic dep resolution).
+
+    python tools/dagenum.py graph.jdf -g NB=4 -g N=16
+    python tools/dagenum.py graph.jdf -g NB=4 --dot dag.dot
+
+Globals of collection type are synthesized as dummy tile holders sized
+from --tiles MTxNT (default 4x4). Prints per-class instance counts, edge
+count, and the critical-path length (depth of the DAG); --dot writes a
+Graphviz rendering of the full instance graph.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from parsec_tpu.collections.collection import DataCollection  # noqa: E402
+
+
+class _DummyCollection(DataCollection):
+    """Stands in for any collection global: data_of is never touched by
+    planning (only rank_of via affinity, and tiles() for I/O shapes)."""
+
+    def __init__(self, mt: int, nt: int) -> None:
+        super().__init__(1, 0)
+        self.mt, self.nt = mt, nt
+
+    def rank_of(self, *a) -> int:
+        return 0
+
+    def tiles(self):
+        return [(i, j) for i in range(self.mt) for j in range(self.nt)]
+
+    def data_of(self, *a):
+        raise RuntimeError("dagenum never materializes data")
+
+
+def enumerate_dag(jdf_path: str, globals_kv, mt: int, nt: int):
+    from parsec_tpu.dsl import ptg
+
+    factory = ptg.compile_jdf_file(jdf_path)
+    env = {}
+    for name, val in globals_kv:
+        try:
+            env[name] = int(val)
+        except ValueError:
+            env[name] = val
+    # bind every declared collection global to a dummy
+    for g in factory.jdf.globals:
+        if g.properties.get("type") == "collection" and g.name not in env:
+            env[g.name] = _DummyCollection(mt, nt)
+    tp = factory.new(**env)
+    from parsec_tpu.dsl.ptg.capture import plan
+    return tp, plan(tp)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("jdf", help="JDF source file")
+    ap.add_argument("-g", "--globals", action="append", default=[],
+                    metavar="NAME=VALUE", help="bind a JDF global")
+    ap.add_argument("--tiles", default="4x4",
+                    help="MTxNT of synthesized collections (default 4x4)")
+    ap.add_argument("--dot", default=None, help="write a Graphviz file")
+    args = ap.parse_args(argv)
+    mt, nt = (int(x) for x in args.tiles.split("x"))
+    kv = []
+    for g in args.globals:
+        if "=" not in g:
+            ap.error(f"-g {g!r}: expected NAME=VALUE")
+        kv.append(tuple(g.split("=", 1)))
+    tp, order = enumerate_dag(args.jdf, kv, mt, nt)
+
+    counts = {}
+    for inst in order:
+        counts[inst.tc.ast.name] = counts.get(inst.tc.ast.name, 0) + 1
+    edges = sum(len(i.preds) for i in order)
+    # critical path (depth): longest pred chain
+    depth = {}
+    for inst in order:  # topo order: preds resolved first
+        depth[inst.key] = 1 + max((depth[p] for p in inst.preds), default=0)
+    print(f"{tp.name}: {len(order)} tasks, {edges} dependence edges, "
+          f"critical path {max(depth.values(), default=0)}")
+    for name in sorted(counts):
+        print(f"  {name:<12} {counts[name]:>6}")
+
+    if args.dot:
+        with open(args.dot, "w") as fh:
+            fh.write(f'digraph "{tp.name}" {{\n')
+            for inst in order:
+                label = f"{inst.tc.ast.name}{inst.locals}"
+                fh.write(f'  "{label}";\n')
+                for p in inst.preds:
+                    fh.write(f'  "{p[0]}{p[1]}" -> "{label}";\n')
+            fh.write("}\n")
+        print(f"DOT written to {args.dot}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
