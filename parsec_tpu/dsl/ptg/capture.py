@@ -87,14 +87,6 @@ class CapturedTaskpool:
     # ------------------------------------------------------------------ #
     # planning: enumerate instances, resolve edges, topo-sort            #
     # ------------------------------------------------------------------ #
-    def _instances(self) -> Dict[Tuple, _Instance]:
-        out: Dict[Tuple, _Instance] = {}
-        for tc in self.tp.task_classes:
-            for locals_ in tc.iter_space():
-                inst = _Instance(tc, locals_, tc.env_of(locals_))
-                out[inst.key] = inst
-        return out
-
     def _producer_locals(self, class_name: str, arg_values: Tuple) -> Tuple:
         """Consumer-side instance lookup: translate dep-target args from
         the producer's param order to its locals order (ast.py)."""
@@ -104,44 +96,7 @@ class CapturedTaskpool:
         return past.locals_from_param_args(arg_values)
 
     def _plan(self) -> List[_Instance]:
-        self._class_ast = {tc.ast.name: tc.ast for tc in self.tp.task_classes}
-        insts = self._instances()
-        self._valid_keys = set(insts)
-        for inst in insts.values():
-            for f in inst.tc.ast.flows:
-                for d in f.deps_in():
-                    t = d.resolve(inst.env)
-                    if t is None or t.kind != "task":
-                        continue
-                    for args in _expand_args(t.args, inst.env):
-                        pkey = (t.task_class,
-                                self._producer_locals(t.task_class, args))
-                        if pkey not in insts:
-                            # a dep line resolving to an out-of-space
-                            # instance is inapplicable, not an error:
-                            # activations are producer-driven, so a
-                            # nonexistent producer simply never fires
-                            # (another dep supplies this input)
-                            continue
-                        inst.preds.append(pkey)
-        # Kahn
-        indeg = {k: len(i.preds) for k, i in insts.items()}
-        succs: Dict[Tuple, List[Tuple]] = {k: [] for k in insts}
-        for k, i in insts.items():
-            for p in i.preds:
-                succs[p].append(k)
-        ready = [k for k, n in indeg.items() if n == 0]
-        order: List[_Instance] = []
-        while ready:
-            k = ready.pop()
-            order.append(insts[k])
-            for s in succs[k]:
-                indeg[s] -= 1
-                if indeg[s] == 0:
-                    ready.append(s)
-        if len(order) != len(insts):
-            stuck = [k for k, n in indeg.items() if n > 0][:5]
-            raise CaptureError(f"dependency cycle in task graph near {stuck}")
+        order, self._class_ast, self._valid_keys = _plan_taskpool(self.tp)
         return order
 
     @property
@@ -270,13 +225,66 @@ class CapturedTaskpool:
         _run_on_collections(self.collections, self.fn, device)
 
 
+def _plan_taskpool(tp: PTGTaskpool):
+    """Planning as a pure function of the taskpool: enumerate instances,
+    resolve dependence edges, topo-sort. Returns
+    ``(order, class_ast_by_name, valid_instance_keys)``."""
+    class_ast = {tc.ast.name: tc.ast for tc in tp.task_classes}
+
+    def producer_locals(class_name, arg_values):
+        past = class_ast.get(class_name)
+        if past is None:
+            return tuple(arg_values)
+        return past.locals_from_param_args(arg_values)
+
+    insts: Dict[Tuple, _Instance] = {}
+    for tc in tp.task_classes:
+        for locals_ in tc.iter_space():
+            inst = _Instance(tc, locals_, tc.env_of(locals_))
+            insts[inst.key] = inst
+    for inst in insts.values():
+        for f in inst.tc.ast.flows:
+            for d in f.deps_in():
+                t = d.resolve(inst.env)
+                if t is None or t.kind != "task":
+                    continue
+                for args in _expand_args(t.args, inst.env):
+                    pkey = (t.task_class, producer_locals(t.task_class, args))
+                    if pkey not in insts:
+                        # a dep line resolving to an out-of-space
+                        # instance is inapplicable, not an error:
+                        # activations are producer-driven, so a
+                        # nonexistent producer simply never fires
+                        # (another dep supplies this input)
+                        continue
+                    inst.preds.append(pkey)
+    # Kahn
+    indeg = {k: len(i.preds) for k, i in insts.items()}
+    succs: Dict[Tuple, List[Tuple]] = {k: [] for k in insts}
+    for k, i in insts.items():
+        for p in i.preds:
+            succs[p].append(k)
+    ready = [k for k, n in indeg.items() if n == 0]
+    order: List[_Instance] = []
+    while ready:
+        k = ready.pop()
+        order.append(insts[k])
+        for s in succs[k]:
+            indeg[s] -= 1
+            if indeg[s] == 0:
+                ready.append(s)
+    if len(order) != len(insts):
+        stuck = [k for k, n in indeg.items() if n > 0][:5]
+        raise CaptureError(f"dependency cycle in task graph near {stuck}")
+    return order, class_ast, set(insts)
+
+
 def plan(tp: PTGTaskpool) -> List[_Instance]:
     """Symbolically enumerate ``tp``'s task instances in topological
     order with resolved predecessor lists — the planning half of capture,
     usable standalone (tools/dagenum.py) without compiling bodies."""
-    cg = CapturedTaskpool.__new__(CapturedTaskpool)
-    cg.tp = tp
-    return cg._plan()
+    order, _class_ast, _keys = _plan_taskpool(tp)
+    return order
 
 
 def _run_on_collections(collections, fn, device=None) -> None:

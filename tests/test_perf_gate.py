@@ -70,3 +70,41 @@ def test_dtd_simple_gemm_rate(ctx4):
     if floor:
         assert gflops >= float(floor), \
             f"sustained {gflops:.2f} gflops below the {floor} floor"
+
+
+def test_captured_dpotrf_rate():
+    """Graph-capture rate gate (same watchdog pattern, capture path).
+
+    Opt-in via PARSEC_TEST_MIN_GFLOPS_CAPTURE (e.g. "100000" on a TPU
+    chip where the captured DAG sustains several hundred TF/s); default
+    checks correctness only and prints the measured rate."""
+    import jax
+
+    from parsec_tpu.collections import TwoDimBlockCyclic
+    from parsec_tpu.dsl import ptg
+    from parsec_tpu.ops import dpotrf_taskpool, make_spd
+
+    n, nb = 512, 128
+    M = make_spd(n)
+    A = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float32).from_numpy(M)
+    cg = ptg.capture(dpotrf_taskpool(A))
+    tiles = {"descA": {c: A.tile(*c) for c in A.tiles()}}
+    out = cg.fn(tiles)           # compile (untimed)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    reps = 3
+    for _ in range(reps):
+        out = cg.fn(tiles)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / reps
+    gflops = (n ** 3 / 3.0) / dt / 1e9
+    print(f"CAPTURED_DPOTRF n={n} nb={nb}: {gflops:.1f} gflops")
+    Lf = np.zeros((n, n), np.float32)
+    for (m, k), arr in out["descA"].items():
+        Lf[m * nb:(m + 1) * nb, k * nb:(k + 1) * nb] = np.asarray(arr)
+    L = np.tril(Lf)
+    assert np.linalg.norm(L @ L.T - M) / np.linalg.norm(M) < 1e-5
+    floor = float(os.environ.get("PARSEC_TEST_MIN_GFLOPS_CAPTURE", "0"))
+    if floor > 0:
+        assert gflops >= floor, \
+            f"captured dpotrf sustained {gflops:.1f} < floor {floor}"

@@ -66,7 +66,10 @@ def main(argv=None) -> int:
                     help="MTxNT of synthesized collections (default 4x4)")
     ap.add_argument("--dot", default=None, help="write a Graphviz file")
     args = ap.parse_args(argv)
-    mt, nt = (int(x) for x in args.tiles.split("x"))
+    parts = args.tiles.lower().split("x")
+    if len(parts) != 2 or not all(p.isdigit() for p in parts):
+        ap.error(f"--tiles {args.tiles!r}: expected MTxNT (e.g. 4x4)")
+    mt, nt = int(parts[0]), int(parts[1])
     kv = []
     for g in args.globals:
         if "=" not in g:
