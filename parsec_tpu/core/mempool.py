@@ -18,9 +18,24 @@ thread's list (elements remember their owner, the
 from __future__ import annotations
 
 import threading
+import weakref
 from typing import Any, Callable, Dict, List, Optional
 
 __all__ = ["Mempool", "ThreadMempool"]
+
+# intrusive owner back-pointer (the reference's parsec_thread_mempool_t
+# *owner field); set on the element itself so dropped elements carry no
+# pool-side state
+_OWNER_ATTR = "_parsec_mempool_owner"
+
+
+def _purge_owner(pool_ref: "weakref.ref", key: int) -> None:
+    """weakref.finalize callback: drop a dead element's id entry without
+    retaining the pool (a bound-method callback would keep the whole pool
+    and its cached buffers alive for as long as any escaped element is)."""
+    pool = pool_ref()
+    if pool is not None:
+        pool.owner_of.pop(key, None)
 
 
 class ThreadMempool:
@@ -37,9 +52,9 @@ class ThreadMempool:
         with self._lock:
             if self._free:
                 return self._free.pop()
-        self.nb_elt += 1
+            self.nb_elt += 1  # under the lock: free() races from other threads
         elt = self.pool.constructor()
-        self.pool.owner_of[id(elt)] = self
+        self.pool._set_owner(elt, self)
         return elt
 
     def push(self, elt: Any) -> None:
@@ -47,7 +62,8 @@ class ThreadMempool:
             if self.pool.max_cached < 0 or len(self._free) < self.pool.max_cached:
                 self._free.append(elt)
             else:
-                self.pool.owner_of.pop(id(elt), None)  # let GC take it
+                self.pool._disown(elt)  # dropped to GC: a stray later
+                # free() must not re-insert it
 
     def __len__(self) -> int:
         with self._lock:
@@ -78,11 +94,48 @@ class Mempool:
     def allocate(self) -> Any:
         return self.thread_mempool().allocate()
 
+    def _set_owner(self, elt: Any, tm: ThreadMempool) -> None:
+        """Record which thread-pool constructed ``elt``.
+
+        Preferred: an attribute on the element itself (the reference's
+        intrusive owner back-pointer). Objects that reject attributes
+        (numpy arrays, slotted classes) fall back to an id-keyed map whose
+        entry a weakref finalizer purges when the element dies — so ids
+        reused after GC can't alias a foreign object into the pool.
+        """
+        try:
+            setattr(elt, _OWNER_ATTR, tm)
+            return
+        except (AttributeError, TypeError):
+            pass
+        key = id(elt)
+        self.owner_of[key] = tm
+        try:
+            weakref.finalize(elt, _purge_owner, weakref.ref(self), key)
+        except TypeError:
+            # supports neither attributes nor weakrefs (object(), tuples):
+            # the entry is purged when push() drops the element, but an
+            # element the USER drops without free() leaves a stale id that
+            # a later id-reuse could alias — use an attr- or
+            # weakref-capable element type if elements may leak
+            pass
+
+    def _disown(self, elt: Any) -> None:
+        """Sever ownership of a dropped element (both carrier forms)."""
+        try:
+            delattr(elt, _OWNER_ATTR)
+            return
+        except AttributeError:
+            pass
+        self.owner_of.pop(id(elt), None)
+
     def free(self, elt: Any) -> None:
         """Return ``elt`` to its owning thread's freelist (the reference's
         elements carry an owner back-pointer; cross-thread frees land in
         the owner's list, not the caller's)."""
-        owner = self.owner_of.get(id(elt))
+        owner = getattr(elt, _OWNER_ATTR, None)
+        if owner is None:
+            owner = self.owner_of.get(id(elt))
         if owner is not None:
             owner.push(elt)
         # unknown element: not pool-constructed; drop it (GC)

@@ -72,16 +72,36 @@ class CapturedDTDGraph:
         if t is None:
             t = CaptureTile(key, len(self._tiles), array)
             self._tiles[key] = t
+        elif t.initial is not array:
+            # re-binding an existing key keeps the FIRST initial; a caller
+            # expecting fresh contents would silently compute on stale data
+            raise ValueError(
+                f"tile key {key!r} already registered with a different "
+                f"initial array; captured tiles bind their initial once")
         return t
 
-    def tile(self, key: Any, shape=None, dtype=np.float32) -> CaptureTile:
+    def tile(self, key: Any, shape=None, dtype=None) -> CaptureTile:
         """NEW-tile analog: zeros when a shape is given; with no shape
-        the tile's first access must be write-only (OUTPUT)."""
+        the tile's first access must be write-only (OUTPUT). A shapeless
+        tile may later be re-declared WITH a shape (binds zeros then);
+        conflicting shape/dtype re-declarations raise."""
         t = self._tiles.get(key)
         if t is None:
-            init = None if shape is None else np.zeros(shape, dtype)
+            init = None if shape is None else np.zeros(
+                shape, dtype if dtype is not None else np.float32)
             t = CaptureTile(key, len(self._tiles), init)
             self._tiles[key] = t
+        elif shape is not None:
+            if t.initial is None:
+                t.initial = np.zeros(
+                    shape, dtype if dtype is not None else np.float32)
+            elif (tuple(t.initial.shape) != tuple(shape)
+                    or (dtype is not None
+                        and t.initial.dtype != np.dtype(dtype))):
+                raise ValueError(
+                    f"tile key {key!r} already registered with "
+                    f"shape={t.initial.shape} dtype={t.initial.dtype}; "
+                    f"got shape={tuple(shape)} dtype={dtype}")
         return t
 
     def insert_task(self, fn: Callable, *args) -> None:

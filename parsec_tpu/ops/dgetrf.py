@@ -166,6 +166,13 @@ def dgetrf(A: np.ndarray, nb: int = 256):
     Returns ``(LU, piv)``: packed factors (unit-lower L strictly below
     the diagonal, U on/above) and the pivot ROW PERMUTATION vector —
     ``A[piv] == L @ U``.
+
+    Compile-time caveat: the panel loop is unrolled at trace time, so
+    trace+compile cost and program size grow linearly with
+    ``kt = ceil(min(m, n)/nb)`` (each step carries O(N^2) gather/scatter
+    updates). Keep kt modest (tens, not hundreds) — e.g. raise ``nb``
+    with N; ``_dgetrf_jit``'s lru_cache only hides *repeat* costs per
+    distinct (shape, nb, dtype).
     """
     LU, perm = _dgetrf_jit(A.shape, nb, np.dtype(A.dtype).name)(A)
     return LU, perm

@@ -158,3 +158,32 @@ def test_errors():
         g3.run()
     with pytest.raises(RuntimeError, match="run"):
         g3.value(b)
+
+
+def test_rebinding_tile_key_raises():
+    import pytest
+    from parsec_tpu.dsl.dtd.capture import CapturedDTDGraph
+
+    g = CapturedDTDGraph()
+    a = np.ones((4,), np.float32)
+    t = g.tile_of_array(a, key="x")
+    assert g.tile_of_array(a, key="x") is t          # same binding: fine
+    with pytest.raises(ValueError):
+        g.tile_of_array(np.zeros((4,), np.float32), key="x")
+    g.tile("z", shape=(2, 2))
+    with pytest.raises(ValueError):
+        g.tile("z", shape=(3, 3))
+
+
+def test_shapeless_tile_binds_shape_on_redeclare():
+    from parsec_tpu.dsl.dtd.capture import CapturedDTDGraph
+
+    g = CapturedDTDGraph()
+    t = g.tile("w")                                  # OUTPUT-first intent
+    t2 = g.tile("w", shape=(2, 3))                   # late shape binding
+    assert t2 is t and t.initial.shape == (2, 3)
+    # repeating the shape with the default dtype stays idempotent even
+    # for non-default-dtype tiles
+    g2 = CapturedDTDGraph()
+    g2.tile("k", shape=(4,), dtype=np.float64)
+    assert g2.tile("k", shape=(4,)).initial.dtype == np.float64

@@ -75,3 +75,50 @@ def test_per_thread_freelists_are_private():
     lists = set(id(tm) for tm in got.values())
     assert len(lists) == 3          # one freelist per thread
     assert pool.nb_cached() == 3
+
+
+def test_dropped_numpy_element_purges_id_tracking():
+    # numpy arrays reject attributes but support weakrefs: dropping one
+    # without free() must purge its id entry (no unbounded growth, no
+    # id-reuse aliasing a foreign array into the freelist)
+    import gc
+    pool = Mempool(lambda: np.empty((8,), np.float32))
+    pool.allocate()                 # dropped immediately, never freed
+    gc.collect()
+    assert len(pool.owner_of) == 0
+
+
+def test_attr_capable_elements_carry_owner_intrusively():
+    class Elt:
+        pass
+
+    pool = Mempool(Elt)
+    e = pool.allocate()
+    assert len(pool.owner_of) == 0  # no id-keyed side table at all
+    pool.free(e)
+    assert pool.allocate() is e
+
+
+def test_overflow_dropped_element_is_disowned():
+    class Elt:
+        pass
+
+    pool = Mempool(Elt, max_cached=1)
+    e1, e2 = pool.allocate(), pool.allocate()
+    pool.free(e1)
+    pool.free(e2)                   # over cap: dropped + disowned
+    pool.free(e2)                   # stray double-free of the dropped one
+    assert pool.nb_cached() == 1    # must NOT re-enter the pool
+    assert pool.allocate() is e1
+
+
+def test_finalizer_does_not_retain_pool():
+    import gc
+    import weakref as wr
+    pool = Mempool(lambda: np.empty((8,), np.float32))
+    escaped = pool.allocate()       # held by user, never freed
+    ref = wr.ref(pool)
+    del pool
+    gc.collect()
+    assert ref() is None            # escaped element must not pin the pool
+    del escaped
