@@ -18,8 +18,10 @@ from typing import Any, Dict, Optional
 from .ast import JDFFile
 from .capture import (CaptureError, CapturedSequence, CapturedTaskpool,
                       capture, capture_sequence)
+from .lower import LoweredDAG, lower
 from .parser import JDFParseError, parse_jdf
 from .runtime import PTGTaskClass, PTGTaskpool
+from .wave import WaveError, WaveRunner, wave
 
 
 class JDFFactory:
@@ -50,4 +52,5 @@ def compile_jdf_file(path: str) -> JDFFactory:
 __all__ = ["compile_jdf", "compile_jdf_file", "JDFFactory", "JDFParseError",
            "PTGTaskpool", "PTGTaskClass",
            "capture", "capture_sequence", "CapturedTaskpool",
-           "CapturedSequence", "CaptureError"]
+           "CapturedSequence", "CaptureError",
+           "lower", "LoweredDAG", "wave", "WaveRunner", "WaveError"]

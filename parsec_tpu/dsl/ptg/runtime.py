@@ -285,7 +285,19 @@ class PTGTaskClass(TaskClass):
     def _release_deps(self, es, task: Task, action_mask: int) -> List[Task]:
         """Local successors activate in place; remote ones accumulate into a
         per-rank batch handed to the comm engine as one activation per output
-        flow (ref: parsec_remote_deps_t accumulation, remote_dep.h:143-160)."""
+        flow (ref: parsec_remote_deps_t accumulation, remote_dep.h:143-160).
+
+        With static dep management active the whole walk is ONE native
+        call: the lowered CSR edges route copies and decrement dense
+        counters in C (ref: --dep-management=index-array)."""
+        if self.tp._engine is not None:
+            copies = tuple(
+                None if f.is_ctl
+                else (task.data[i].data_out or task.data[i].data_in)
+                for i, f in enumerate(self.ast.flows))
+            tid = self.tp._dag.id_of[(self.ast.name, task.locals)]
+            return [self.tp._make_task_static(r)
+                    for r in self.tp._engine.complete(tid, copies)]
         ready: List[Task] = []
         remote_edges: Dict[int, List[Tuple]] = {}
         flow_payloads: Dict[int, Any] = {}
@@ -496,6 +508,8 @@ class PTGTaskpool(Taskpool):
         self.startup_hook = self._startup
         self.nb_local_tasks = 0
         self.comm = None  # remote-dep driver, attached by the comm engine
+        self._dag = None      # LoweredDAG when static dep management is on
+        self._engine = None   # NativeDAG / PyDAG ready-tracking engine
 
     def class_by_name(self, name: str) -> PTGTaskClass:
         return self._classes[name]
@@ -504,6 +518,9 @@ class PTGTaskpool(Taskpool):
     # startup (ref: generated startup enumerator jdf2c.c:2975-3385)       #
     # ------------------------------------------------------------------ #
     def _startup(self, context, tp) -> List[Task]:
+        if (params.get("ptg_dep_management") == "static"
+                and self.nb_ranks == 1 and not grapher.enabled):
+            return self._startup_static()
         total = 0
         startup: List[Task] = []
         count_foreign = self.nb_ranks > 1 and self.comm is not None
@@ -531,6 +548,37 @@ class PTGTaskpool(Taskpool):
         plog.debug.verbose(4, "ptg %s: %d local tasks, %d startup",
                            self.name, total, len(startup))
         return startup
+
+    def _startup_static(self) -> List[Task]:
+        """Static dep management (ref: --dep-management=index-array):
+        lower the task space once into flat arrays + a native counter
+        engine; startup = the zero-indegree set. Single-rank only —
+        multi-rank and DOT capture stay on the dynamic hash path."""
+        from .lower import lower, make_engine
+        self._dag = lower(self)
+        self._engine = make_engine(self._dag)
+        self.nb_local_tasks = self._dag.n_tasks
+        self.set_nb_tasks(self._dag.n_tasks)
+        startup = [self._make_task_static(t) for t in self._engine.start()]
+        plog.debug.verbose(4, "ptg %s (static): %d tasks, %d edges, "
+                           "%d startup", self.name, self._dag.n_tasks,
+                           self._dag.n_edges, len(startup))
+        return startup
+
+    def _make_task_static(self, tid: int) -> Task:
+        """Spawn a lowered task: class/locals/priority from the flat
+        arrays; inputs routed by the engine land in flow order."""
+        dag = self._dag
+        tc = self.task_classes[int(dag.class_of[tid])]
+        task = Task(self, tc, dag.locals_of[tid],
+                    priority=int(dag.priority[tid]))
+        bindings = self._engine.take_bindings(tid)
+        for i in range(len(tc.ast.flows)):
+            copy = bindings[i]
+            if copy is not None:
+                task.data[i].data_in = copy
+                task.data[i].fulfilled = True
+        return task
 
     # ------------------------------------------------------------------ #
     # data helpers                                                       #

@@ -1,0 +1,483 @@
+"""Wave execution: run a lowered PTG taskpool as batched XLA calls.
+
+The per-task runtime pays one Python/jax dispatch per task (~0.3 ms),
+which bounds throughput at small tile sizes no matter how fast the chip
+is; whole-DAG capture (capture.py) removes the host loop entirely but
+unrolls every instance into one trace, which stops scaling around 10^4
+tasks. Wave execution is the TPU-native midpoint, with no direct
+reference analog (the reference amortizes dispatch with a ~us C loop,
+parsec/scheduling.c:586-625; on TPU the idiomatic fix is batching onto
+the MXU, not a faster scalar loop):
+
+- the lowered DAG (lower.py) tracks readiness in dense native counters;
+- every collection lives on device as ONE stacked tile pool
+  ``[n_tiles, mb, nb]``;
+- each ready antichain ("wave") is grouped by task class and executed as
+  a few fixed-size chunked calls of a jitted, vmapped body kernel that
+  gathers input tiles from the pools by index, runs the batched tile op
+  on the MXU, and scatters written tiles back in place (donated buffers
+  — no pool copies);
+- dispatch cost is per *chunk* (~bounded by classes x log2(wave size)),
+  not per task, and compiled programs are reused across waves and runs
+  (at most ``1 + log2(max_chunk)`` sizes per class).
+
+Semantics notes:
+- priorities are ignored: execution is breadth-first by dependence
+  level, which is exactly the dataflow order XLA would want anyway;
+- a wave may contain a reader of a tile and the (dataflow-independent)
+  writer of the same tile (WAR); readers are split into an earlier
+  sub-wave in that case, so in-place scatters never clobber a
+  same-wave read;
+- supported flows are those whose values live in collection tiles
+  (memory-sourced or forwarded from task to task). NEW scratch flows or
+  writebacks to a different tile than the flow's slot raise WaveError —
+  those run through the per-task runtime instead.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...utils import logging as plog
+from .ast import Expr
+from .lower import LoweredDAG, lower, make_engine
+from .runtime import PTGTaskpool, _expand_args
+
+__all__ = ["WaveError", "WaveRunner", "wave"]
+
+
+class WaveError(RuntimeError):
+    pass
+
+
+def _pick_body(tc_ast):
+    for b in tc_ast.bodies:
+        if b.device_type not in ("cpu", "recursive"):
+            return b
+    return tc_ast.bodies[0]
+
+
+class _ClassPlan:
+    """Per-task-class kernel metadata: which flows carry data, where
+    their slots live, and the compiled chunked kernels."""
+
+    __slots__ = ("tc", "ast", "flow_idx", "flow_names", "flow_coll",
+                 "written", "range_locals", "body_locals", "code", "kernels")
+
+    def __init__(self, tc) -> None:
+        self.tc = tc
+        self.ast = tc.ast
+        self.flow_idx = [i for i, f in enumerate(tc.ast.flows)
+                         if not f.is_ctl]
+        self.flow_names = [tc.ast.flows[i].name for i in self.flow_idx]
+        from ...data.data import FlowAccess
+        self.flow_coll: List[int] = [-1] * len(self.flow_idx)
+        self.written = [bool(tc.flows[i].access & FlowAccess.WRITE)
+                        for i in self.flow_idx]
+        self.range_locals = [ld.name for ld in tc.ast.locals
+                             if ld.range is not None]
+        self.code = compile(_pick_body(tc.ast).code,
+                            f"<jdf:{tc.ast.name}:BODY[wave]>", "exec")
+        # range locals the body references (co_names: exec reads them as
+        # globals): bodies may branch on them in Python (`BETA if k == 0
+        # else 1.0`), which a batch tracer cannot do — such locals are
+        # made STATIC by sub-chunking the wave on their values
+        names = set(self.code.co_names)
+        self.body_locals = [i for i, nm in enumerate(self.range_locals)
+                            if nm in names]
+        self.kernels: Dict[Tuple, Any] = {}
+
+
+class WaveRunner:
+    """Executor for one single-rank PTG taskpool in wave mode."""
+
+    def __init__(self, tp: PTGTaskpool, max_chunk: int = 256) -> None:
+        if tp.nb_ranks != 1:
+            raise WaveError("wave execution is single-rank")
+        self.tp = tp
+        self.max_chunk = max(1, int(max_chunk))
+        self.dag: LoweredDAG = lower(tp)
+        from ...collections.collection import DataCollection
+        self.collections: Dict[str, Any] = {
+            name: c for name, c in tp.global_env.items()
+            if isinstance(c, DataCollection)}
+        if not self.collections:
+            raise WaveError("taskpool binds no data collections")
+        self.coll_names = sorted(self.collections)
+        self._coll_id = {n: i for i, n in enumerate(self.coll_names)}
+        self._tile_index: List[Dict[Tuple, int]] = []
+        for n in self.coll_names:
+            coll = self.collections[n]
+            coords = sorted(coll.tiles())
+            self._tile_index.append({c: i for i, c in enumerate(coords)})
+            # shape uniformity (pools are stacked arrays) is enforced by
+            # np.stack in build_pools; ragged tilings raise there
+        self.plans = [_ClassPlan(tc) for tc in tp.task_classes]
+        # slot tables: per task, per (non-ctl) flow position in the
+        # class's flow_idx list -> flat tile index (collection fixed per
+        # class/flow, validated during assignment)
+        self._assign_slots()
+
+    # ------------------------------------------------------------------ #
+    # slot assignment                                                    #
+    # ------------------------------------------------------------------ #
+    def _assign_slots(self) -> None:
+        dag = self.dag
+        n = dag.n_tasks
+        max_df = max((len(p.flow_idx) for p in self.plans), default=0)
+        slot = np.full((n, max_df), -1, np.int32)
+        # topo order via Kahn over the lowered CSR
+        indeg = dag.indegree.copy()
+        head = 0
+        order = [int(t) for t in np.nonzero(indeg == 0)[0]]
+        while head < len(order):
+            t = order[head]
+            head += 1
+            for e in range(int(dag.indptr[t]), int(dag.indptr[t + 1])):
+                s = int(dag.succ[e])
+                indeg[s] -= 1
+                if indeg[s] == 0:
+                    order.append(s)
+        if len(order) != n:
+            raise WaveError("cycle in lowered DAG")
+
+        flow_pos = []  # per class: ast flow index -> dense position
+        for p in self.plans:
+            pos = {fi: k for k, fi in enumerate(p.flow_idx)}
+            flow_pos.append(pos)
+
+        for t in order:
+            ci = int(dag.class_of[t])
+            p = self.plans[ci]
+            tc = p.tc
+            env = tc.env_of(dag.locals_of[t])
+            for k, fi in enumerate(p.flow_idx):
+                f = tc.ast.flows[fi]
+                s = self._slot_of_flow(t, f, env, flow_pos, slot)
+                if s is None:
+                    raise WaveError(
+                        f"{p.ast.name}{dag.locals_of[t]}.{f.name}: flow "
+                        f"does not resolve to a collection tile (NEW/NULL "
+                        f"flows need the per-task runtime)")
+                coll_id, idx = s
+                if p.flow_coll[k] == -1:
+                    p.flow_coll[k] = coll_id
+                elif p.flow_coll[k] != coll_id:
+                    raise WaveError(
+                        f"{p.ast.name}.{f.name}: instances bind tiles from "
+                        f"different collections; wave batching needs one")
+                slot[t, k] = idx
+                if p.written[k]:
+                    self._check_writeback(p, f, env, coll_id, idx)
+        self._slot = slot
+
+    def _slot_of_flow(self, tid, f, env, flow_pos, slot):
+        deps_in = f.deps_in()
+        for d in deps_in:
+            t = d.resolve(env)
+            if t is None:
+                continue
+            if t.kind == "memory":
+                coll_id = self._coll_id.get(t.collection)
+                if coll_id is None:
+                    return None
+                coords = tuple(int(a(env)) for a in t.args)
+                return coll_id, self._tile_lookup(coll_id, coords)
+            if t.kind == "task":
+                for args in _expand_args(t.args, env):
+                    past = self.tp.jdf.task_class_by_name(t.task_class)
+                    pkey = (t.task_class, past.locals_from_param_args(args))
+                    pid = self.dag.id_of.get(pkey)
+                    if pid is None:
+                        continue  # out-of-space producer: inapplicable
+                    pci = int(self.dag.class_of[pid])
+                    pplan = self.plans[pci]
+                    pfi = next(i for i, pf in enumerate(pplan.ast.flows)
+                               if pf.name == t.flow)
+                    k = flow_pos[pci].get(pfi)
+                    if k is None:
+                        return None
+                    idx = int(slot[pid, k])
+                    if idx < 0:
+                        return None
+                    return pplan.flow_coll[k], idx
+                continue
+            return None  # new / null
+        if not deps_in:
+            # WRITE-only flow: bind to its memory out-target
+            for d in f.deps_out():
+                t = d.resolve(env)
+                if t is not None and t.kind == "memory":
+                    coll_id = self._coll_id.get(t.collection)
+                    if coll_id is None:
+                        return None
+                    coords = tuple(int(a(env)) for a in t.args)
+                    return coll_id, self._tile_lookup(coll_id, coords)
+        return None
+
+    def _tile_lookup(self, coll_id: int, coords: Tuple[int, ...]) -> int:
+        """Map dep-target args to the flat tile index; vector-style
+        1-arg targets pad a trailing 0 (data_of(m) == data_of(m, 0))."""
+        idx = self._tile_index[coll_id]
+        hit = idx.get(coords)
+        while hit is None and len(coords) < 2:
+            coords = coords + (0,)
+            hit = idx.get(coords)
+        if hit is None:
+            raise WaveError(f"no tile {coords} in collection "
+                            f"{self.coll_names[coll_id]}")
+        return hit
+
+    def _check_writeback(self, p, f, env, coll_id, idx) -> None:
+        for d in f.deps_out():
+            t = d.resolve(env)
+            if t is None or t.kind != "memory":
+                continue
+            tc_id = self._coll_id.get(t.collection)
+            if tc_id is None:
+                raise WaveError(
+                    f"{p.ast.name}.{f.name}: writes back to unbound "
+                    f"collection {t.collection!r}")
+            coords = tuple(int(a(env)) for a in t.args)
+            if tc_id != coll_id or self._tile_lookup(tc_id, coords) != idx:
+                raise WaveError(
+                    f"{p.ast.name}.{f.name}: writes back to a different "
+                    f"tile than its slot; unsupported in wave mode")
+
+    # ------------------------------------------------------------------ #
+    # kernels                                                            #
+    # ------------------------------------------------------------------ #
+    def _kernel(self, ci: int, k: int, statics: Tuple = ()):
+        """The jitted chunk kernel for class ``ci``, chunk size ``k`` and
+        static body-local values ``statics``:
+        fn(pools_tuple, locals_i32[k, n_locals], idx_i32[n_flows, k])
+        -> pools_tuple with written slots scattered in place."""
+        p = self.plans[ci]
+        kern = p.kernels.get((k, statics))
+        if kern is not None:
+            return kern
+        import jax
+        import jax.numpy as jnp
+
+        global_env = self.tp.global_env
+        flow_names = p.flow_names
+        written = p.written
+        flow_coll = p.flow_coll
+        range_locals = p.range_locals
+        derived = [(ld.name, ld.expr) for ld in p.ast.locals
+                   if ld.range is None]
+        code = p.code
+
+        static_pairs = [(range_locals[i], v)
+                        for i, v in zip(p.body_locals, statics)]
+
+        def one(loc_row, *flow_vals):
+            env = dict(global_env)
+            for nm, v in zip(range_locals, loc_row):
+                env[nm] = v
+            for nm, v in static_pairs:  # concrete: bodies may branch
+                env[nm] = v
+            for nm, ex in derived:
+                env[nm] = ex(env)
+            for nm, v in zip(flow_names, flow_vals):
+                env[nm] = v
+            env["np"] = np
+            env["jnp"] = jnp
+            env["es_rank"] = 0
+            env["this_task"] = None
+            exec(code, env)
+            return tuple(env[nm] for nm, w in zip(flow_names, written) if w)
+
+        def chunk_fn(pools, locs, idx):
+            gathered = [pools[flow_coll[j]][idx[j]]
+                        for j in range(len(flow_names))]
+            outs = jax.vmap(one)(locs, *gathered)
+            pools = list(pools)
+            oi = 0
+            for j, w in enumerate(written):
+                if not w:
+                    continue
+                cid = flow_coll[j]
+                pools[cid] = pools[cid].at[idx[j]].set(outs[oi])
+                oi += 1
+            return tuple(pools)
+
+        kern = jax.jit(chunk_fn, donate_argnums=(0,))
+        p.kernels[(k, statics)] = kern
+        return kern
+
+    @staticmethod
+    def _chunks(k: int, max_chunk: int) -> List[int]:
+        """Binary decomposition of k bounded by max_chunk: exact sizes
+        from a fixed set, so compiled programs are reused."""
+        out = []
+        while k >= max_chunk:
+            out.append(max_chunk)
+            k -= max_chunk
+        b = 1
+        while k:
+            if k & 1:
+                out.append(b)
+            k >>= 1
+            b <<= 1
+        return out
+
+    # ------------------------------------------------------------------ #
+    # execution                                                          #
+    # ------------------------------------------------------------------ #
+    def execute(self, pools: Tuple) -> Tuple:
+        """Run the DAG over device tile pools (one stacked array per
+        collection, ordered by self.coll_names); returns final pools."""
+        dag = self.dag
+        eng = make_engine(dag)
+        ready = np.asarray(eng.start(), np.int32)
+        slot = self._slot
+        n_waves = n_calls = 0
+        while ready.size:
+            n_waves += 1
+            classes = dag.class_of[ready]
+            for sub in self._split_war(ready, classes):
+                ids, cls = sub
+                for ci in np.unique(cls):
+                    members = ids[cls == ci]
+                    p = self.plans[int(ci)]
+                    nf = len(p.flow_idx)
+                    prio = dag.priority[members]
+                    members = members[np.argsort(-prio, kind="stable")]
+                    # body-referenced locals become static kernel args:
+                    # group members by their values (uniform per wave in
+                    # the common panel-structured DAGs)
+                    groups: Dict[Tuple, List[int]] = {}
+                    for t in members:
+                        sv = tuple(int(dag.locals_of[t][i])
+                                   for i in p.body_locals)
+                        groups.setdefault(sv, []).append(int(t))
+                    for statics, g in groups.items():
+                        garr = np.asarray(g, np.int64)
+                        off = 0
+                        for k in self._chunks(len(garr), self.max_chunk):
+                            chunk = garr[off:off + k]
+                            off += k
+                            lrows = [dag.locals_of[t] for t in chunk]
+                            nl = len(lrows[0])
+                            locs = (np.asarray(lrows, np.int32)
+                                    .reshape(k, nl)
+                                    if nl else np.zeros((k, 0), np.int32))
+                            idx = slot[chunk, :nf].T.copy()  # [n_flows, k]
+                            pools = self._kernel(int(ci), k, statics)(
+                                pools, locs, idx)
+                            n_calls += 1
+            ready = np.asarray(eng.complete_batch(ready), np.int32)
+        done = eng.completed() if hasattr(eng, "completed") else dag.n_tasks
+        if int(done) != dag.n_tasks:
+            raise WaveError(
+                f"wave execution stalled: {done}/{dag.n_tasks} tasks ran")
+        plog.debug.verbose(3, "wave %s: %d tasks in %d waves, %d kernel "
+                           "calls", self.tp.name, dag.n_tasks, n_waves,
+                           n_calls)
+        return pools
+
+    def _split_war(self, ids: np.ndarray, classes: np.ndarray):
+        """Split a frontier so no in-place scatter clobbers a same-wave
+        read. Anti-dependence edges (reader R of a tile that a different
+        frontier task W writes: R must run before W) are layered with
+        Kahn's algorithm; each layer is anti-dep-free and executes as one
+        batched sub-wave. A cyclic frontier (two tasks each reading the
+        tile the other writes — legal dataflow, but unservable by
+        in-place scatters) raises WaveError: run it through the per-task
+        runtime, whose copies rename WAR hazards away."""
+        slot = self._slot
+        reads: Dict[Tuple[int, int], List[int]] = {}
+        writes: Dict[Tuple[int, int], int] = {}
+        for pos, t in enumerate(ids):
+            p = self.plans[int(classes[pos])]
+            for k in range(len(p.flow_idx)):
+                key = (p.flow_coll[k], int(slot[t, k]))
+                if p.written[k]:
+                    writes[key] = int(t)
+                else:
+                    reads.setdefault(key, []).append(int(t))
+        out_edges: Dict[int, List[int]] = {}
+        indeg: Dict[int, int] = {int(t): 0 for t in ids}
+        n_conf = 0
+        for key, ts in reads.items():
+            w = writes.get(key)
+            if w is None:
+                continue
+            for r in ts:
+                if r == w:
+                    continue
+                out_edges.setdefault(r, []).append(w)
+                indeg[w] += 1
+                n_conf += 1
+        if n_conf == 0:
+            return [(ids, classes)]
+        cls_of = {int(t): int(c) for t, c in zip(ids, classes)}
+        layer = [t for t in indeg if indeg[t] == 0]
+        done = 0
+        layers = []
+        while layer:
+            layers.append(layer)
+            done += len(layer)
+            nxt: List[int] = []
+            for t in layer:
+                for w in out_edges.get(t, ()):
+                    indeg[w] -= 1
+                    if indeg[w] == 0:
+                        nxt.append(w)
+            layer = nxt
+        if done != len(ids):
+            raise WaveError(
+                "frontier has cyclic write-after-read conflicts; this DAG "
+                "needs the per-task runtime (copies rename WAR hazards)")
+        return [(np.asarray(ls, np.int64),
+                 np.asarray([cls_of[t] for t in ls], np.int32))
+                for ls in layers]
+
+    # ------------------------------------------------------------------ #
+    # convenience: run against the bound collections                     #
+    # ------------------------------------------------------------------ #
+    def build_pools(self, device=None) -> Tuple:
+        import jax
+        import jax.numpy as jnp
+        pools = []
+        for cid, name in enumerate(self.coll_names):
+            coll = self.collections[name]
+            coords = sorted(coll.tiles())
+            tiles = []
+            for c in coords:
+                data = coll.data_of(*c)
+                tiles.append(np.asarray(data.sync_to_host().payload))
+            arr = jnp.asarray(np.stack(tiles))
+            if device is not None:
+                arr = jax.device_put(arr, device)
+            pools.append(arr)
+        return tuple(pools)
+
+    def scatter_pools(self, pools: Tuple) -> None:
+        for cid, name in enumerate(self.coll_names):
+            coll = self.collections[name]
+            coords = sorted(coll.tiles())
+            host = np.asarray(pools[cid])
+            for i, c in enumerate(coords):
+                data = coll.data_of(*c)
+                hc = data.host_copy()
+                if hc.payload is None:
+                    hc.payload = host[i].copy()
+                else:
+                    np.copyto(hc.payload, host[i])
+                data.version_bump(0)
+
+    def run(self, device=None) -> None:
+        pools = self.execute(self.build_pools(device))
+        self.scatter_pools(pools)
+
+    @property
+    def nb_tasks(self) -> int:
+        return self.dag.n_tasks
+
+
+def wave(tp: PTGTaskpool, max_chunk: int = 256) -> WaveRunner:
+    """Build a wave-mode executor for a single-rank PTG taskpool."""
+    return WaveRunner(tp, max_chunk=max_chunk)

@@ -1088,6 +1088,333 @@ static PyTypeObject MaxHeapType = []{
 }();
 
 /* ================================================================== */
+/* NativeDAG — static dependence engine for lowered PTG taskpools      */
+/*                                                                     */
+/* The reference's static ("index-array") dependency-tracking mode     */
+/* keeps dense per-class dependence counters and releases deps with    */
+/* O(1) decrements in generated C (ref: ptg-compiler/main.c:37,        */
+/* parsec_internal.h:173-196, jdf2c.c release_deps).  Here the lowered */
+/* DAG (dsl/ptg/lower.py) hands us flat CSR successor arrays; complete */
+/* walks a task's out-edges in C: route the produced DataCopy binding  */
+/* to the consumer's flow slot, atomically decrement its indegree, and */
+/* report freshly-ready ids.  Python touches a task exactly twice      */
+/* (make_task + body), never per-edge.                                 */
+/* ================================================================== */
+
+template <typename T>
+static bool dag_copy_buffer(PyObject* obj, std::vector<T>& out,
+                            const char* name) {
+  Py_buffer view;
+  if (PyObject_GetBuffer(obj, &view, PyBUF_CONTIG_RO) != 0) return false;
+  if (view.itemsize != (Py_ssize_t)sizeof(T)) {
+    PyBuffer_Release(&view);
+    PyErr_Format(PyExc_TypeError, "%s: expected itemsize %zu, got %zd", name,
+                 sizeof(T), view.itemsize);
+    return false;
+  }
+  size_t n = (size_t)view.len / sizeof(T);
+  out.assign((const T*)view.buf, (const T*)view.buf + n);
+  PyBuffer_Release(&view);
+  return true;
+}
+
+constexpr int kDagLockStripes = 64;
+
+struct DagObject {
+  PyObject_HEAD
+  int32_t n_tasks;
+  int32_t max_flows;
+  std::vector<int32_t>* indptr;
+  std::vector<int32_t>* succ;
+  std::vector<int8_t>* succ_flow;
+  std::vector<int8_t>* out_flow;
+  std::atomic<int32_t>* indeg;  /* length n_tasks */
+  PyObject** bindings;          /* n_tasks * max_flows owned refs (or null) */
+  SpinLock* locks;              /* striped by successor id */
+  std::atomic<int64_t> completed;
+};
+
+static PyObject* Dag_new(PyTypeObject* type, PyObject* args, PyObject*) {
+  PyObject *o_indptr, *o_succ, *o_sflow, *o_oflow, *o_indeg;
+  int max_flows;
+  if (!PyArg_ParseTuple(args, "OOOOOi", &o_indptr, &o_succ, &o_sflow,
+                        &o_oflow, &o_indeg, &max_flows))
+    return nullptr;
+  if (max_flows < 0) {
+    PyErr_SetString(PyExc_ValueError, "max_flows must be >= 0");
+    return nullptr;
+  }
+  DagObject* self = (DagObject*)type->tp_alloc(type, 0);
+  if (!self) return nullptr;
+  self->indptr = new (std::nothrow) std::vector<int32_t>();
+  self->succ = new (std::nothrow) std::vector<int32_t>();
+  self->succ_flow = new (std::nothrow) std::vector<int8_t>();
+  self->out_flow = new (std::nothrow) std::vector<int8_t>();
+  self->indeg = nullptr;
+  self->bindings = nullptr;
+  self->locks = new (std::nothrow) SpinLock[kDagLockStripes];
+  self->completed.store(0);
+  std::vector<int32_t> indeg_in;
+  if (!self->indptr || !self->succ || !self->succ_flow || !self->out_flow ||
+      !self->locks ||
+      !dag_copy_buffer(o_indptr, *self->indptr, "indptr") ||
+      !dag_copy_buffer(o_succ, *self->succ, "succ") ||
+      !dag_copy_buffer(o_sflow, *self->succ_flow, "succ_flow") ||
+      !dag_copy_buffer(o_oflow, *self->out_flow, "out_flow") ||
+      !dag_copy_buffer(o_indeg, indeg_in, "indegree")) {
+    Py_DECREF(self);
+    return nullptr;
+  }
+  size_t n = indeg_in.size();
+  if (self->indptr->size() != n + 1 ||
+      self->succ->size() != self->succ_flow->size() ||
+      self->succ->size() != self->out_flow->size() ||
+      (size_t)self->indptr->back() != self->succ->size()) {
+    PyErr_SetString(PyExc_ValueError, "inconsistent DAG array sizes");
+    Py_DECREF(self);
+    return nullptr;
+  }
+  for (int32_t s : *self->succ)
+    if (s < 0 || (size_t)s >= n) {
+      PyErr_SetString(PyExc_ValueError, "successor id out of range");
+      Py_DECREF(self);
+      return nullptr;
+    }
+  self->n_tasks = (int32_t)n;
+  self->max_flows = max_flows;
+  self->indeg = new (std::nothrow) std::atomic<int32_t>[n];
+  self->bindings =
+      (PyObject**)PyMem_Calloc(n * (size_t)max_flows + 1, sizeof(PyObject*));
+  if (!self->indeg || !self->bindings) {
+    PyErr_NoMemory();
+    Py_DECREF(self);
+    return nullptr;
+  }
+  for (size_t i = 0; i < n; i++) self->indeg[i].store(indeg_in[i]);
+  return (PyObject*)self;
+}
+
+static void Dag_dealloc(DagObject* self) {
+  if (self->bindings) {
+    for (size_t i = 0; i < (size_t)self->n_tasks * self->max_flows; i++)
+      Py_XDECREF(self->bindings[i]);
+    PyMem_Free(self->bindings);
+  }
+  delete self->indptr;
+  delete self->succ;
+  delete self->succ_flow;
+  delete self->out_flow;
+  delete[] self->indeg;
+  delete[] self->locks;
+  Py_TYPE(self)->tp_free((PyObject*)self);
+}
+
+static PyObject* Dag_start(DagObject* self, PyObject*) {
+  PyObject* out = PyList_New(0);
+  if (!out) return nullptr;
+  for (int32_t t = 0; t < self->n_tasks; t++)
+    if (self->indeg[t].load(std::memory_order_relaxed) == 0) {
+      PyObject* v = PyLong_FromLong(t);
+      if (!v || PyList_Append(out, v) < 0) {
+        Py_XDECREF(v);
+        Py_DECREF(out);
+        return nullptr;
+      }
+      Py_DECREF(v);
+    }
+  return out;
+}
+
+/* core edge walk shared by complete / complete_batch; copies==nullptr
+ * skips binding routing.  Appends newly-ready ids to `ready`. */
+static int dag_release_edges(DagObject* self, int32_t tid, PyObject* copies,
+                             std::vector<int32_t>& ready) {
+  if (tid < 0 || tid >= self->n_tasks) {
+    PyErr_Format(PyExc_IndexError, "task id %d out of range", (int)tid);
+    return -1;
+  }
+  int32_t lo = (*self->indptr)[tid], hi = (*self->indptr)[tid + 1];
+  for (int32_t e = lo; e < hi; e++) {
+    int32_t sid = (*self->succ)[e];
+    if (copies) {
+      int of = (*self->out_flow)[e];
+      if (of < 0 || of >= (int)PyTuple_GET_SIZE(copies)) {
+        PyErr_Format(PyExc_IndexError, "out flow %d outside copies tuple",
+                     of);
+        return -1;
+      }
+      PyObject* cp = PyTuple_GET_ITEM(copies, of);
+      if (cp != Py_None) {
+        int sf = (*self->succ_flow)[e];
+        if (sf < 0 || sf >= self->max_flows) {
+          PyErr_Format(PyExc_IndexError, "succ flow %d out of range", sf);
+          return -1;
+        }
+        PyObject** slot = &self->bindings[(size_t)sid * self->max_flows + sf];
+        Py_INCREF(cp);
+        PyObject* old;
+        {
+          SpinGuard g(self->locks[sid % kDagLockStripes]);
+          old = *slot;
+          *slot = cp;
+        }
+        Py_XDECREF(old);
+      }
+    }
+    int32_t left =
+        self->indeg[sid].fetch_sub(1, std::memory_order_acq_rel) - 1;
+    if (left == 0) ready.push_back(sid);
+    if (left < 0) {
+      PyErr_Format(PyExc_RuntimeError,
+                   "task %d released more times than its indegree",
+                   (int)sid);
+      return -1;
+    }
+  }
+  self->completed.fetch_add(1, std::memory_order_relaxed);
+  return 0;
+}
+
+static PyObject* dag_ready_list(const std::vector<int32_t>& ready) {
+  PyObject* out = PyList_New((Py_ssize_t)ready.size());
+  if (!out) return nullptr;
+  for (size_t i = 0; i < ready.size(); i++) {
+    PyObject* v = PyLong_FromLong(ready[i]);
+    if (!v) {
+      Py_DECREF(out);
+      return nullptr;
+    }
+    PyList_SET_ITEM(out, (Py_ssize_t)i, v);
+  }
+  return out;
+}
+
+static PyObject* Dag_complete(DagObject* self, PyObject* args) {
+  int tid;
+  PyObject* copies = Py_None;
+  if (!PyArg_ParseTuple(args, "i|O", &tid, &copies)) return nullptr;
+  if (copies != Py_None && !PyTuple_Check(copies)) {
+    PyErr_SetString(PyExc_TypeError, "copies must be a tuple or None");
+    return nullptr;
+  }
+  std::vector<int32_t> ready;
+  if (dag_release_edges(self, tid, copies == Py_None ? nullptr : copies,
+                        ready) < 0)
+    return nullptr;
+  return dag_ready_list(ready);
+}
+
+static PyObject* Dag_complete_batch(DagObject* self, PyObject* args) {
+  PyObject* ids;
+  if (!PyArg_ParseTuple(args, "O", &ids)) return nullptr;
+  std::vector<int32_t> tids;
+  Py_buffer view;
+  if (PyObject_GetBuffer(ids, &view, PyBUF_CONTIG_RO) == 0) {
+    if (view.itemsize != 4) {
+      PyBuffer_Release(&view);
+      PyErr_SetString(PyExc_TypeError, "ids buffer must be int32");
+      return nullptr;
+    }
+    tids.assign((const int32_t*)view.buf,
+                (const int32_t*)view.buf + view.len / 4);
+    PyBuffer_Release(&view);
+  } else {
+    PyErr_Clear();
+    PyObject* seq = PySequence_Fast(ids, "ids must be a buffer or sequence");
+    if (!seq) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    tids.reserve((size_t)n);
+    for (Py_ssize_t i = 0; i < n; i++) {
+      long v = PyLong_AsLong(PySequence_Fast_GET_ITEM(seq, i));
+      if (v == -1 && PyErr_Occurred()) {
+        Py_DECREF(seq);
+        return nullptr;
+      }
+      tids.push_back((int32_t)v);
+    }
+    Py_DECREF(seq);
+  }
+  std::vector<int32_t> ready;
+  for (int32_t t : tids)
+    if (dag_release_edges(self, t, nullptr, ready) < 0) return nullptr;
+  return dag_ready_list(ready);
+}
+
+static PyObject* Dag_take_bindings(DagObject* self, PyObject* args) {
+  int tid;
+  if (!PyArg_ParseTuple(args, "i", &tid)) return nullptr;
+  if (tid < 0 || tid >= self->n_tasks) {
+    PyErr_Format(PyExc_IndexError, "task id %d out of range", tid);
+    return nullptr;
+  }
+  PyObject* out = PyTuple_New(self->max_flows);
+  if (!out) return nullptr;
+  PyObject** base = &self->bindings[(size_t)tid * self->max_flows];
+  for (int f = 0; f < self->max_flows; f++) {
+    PyObject* v;
+    {
+      SpinGuard g(self->locks[tid % kDagLockStripes]);
+      v = base[f];
+      base[f] = nullptr;
+    }
+    if (!v) {
+      Py_INCREF(Py_None);
+      v = Py_None;
+    }
+    PyTuple_SET_ITEM(out, f, v); /* ref transferred */
+  }
+  return out;
+}
+
+static PyObject* Dag_indegree_of(DagObject* self, PyObject* args) {
+  int tid;
+  if (!PyArg_ParseTuple(args, "i", &tid)) return nullptr;
+  if (tid < 0 || tid >= self->n_tasks) {
+    PyErr_Format(PyExc_IndexError, "task id %d out of range", tid);
+    return nullptr;
+  }
+  return PyLong_FromLong(self->indeg[tid].load(std::memory_order_relaxed));
+}
+
+static PyObject* Dag_completed(DagObject* self, PyObject*) {
+  return PyLong_FromLongLong(self->completed.load(std::memory_order_relaxed));
+}
+
+static Py_ssize_t Dag_len(PyObject* o) {
+  return (Py_ssize_t)((DagObject*)o)->n_tasks;
+}
+
+static PyMethodDef Dag_methods[] = {
+    {"start", (PyCFunction)Dag_start, METH_NOARGS,
+     "ids with indegree 0 (the startup set)"},
+    {"complete", (PyCFunction)Dag_complete, METH_VARARGS,
+     "complete(tid, copies_tuple=None) -> newly ready ids; routes each "
+     "non-None copies[out_flow] into the successor's flow slot"},
+    {"complete_batch", (PyCFunction)Dag_complete_batch, METH_VARARGS,
+     "complete_batch(int32 ids) -> newly ready ids (no binding routing)"},
+    {"take_bindings", (PyCFunction)Dag_take_bindings, METH_VARARGS,
+     "take_bindings(tid) -> tuple of max_flows entries (refs transferred)"},
+    {"indegree_of", (PyCFunction)Dag_indegree_of, METH_VARARGS, ""},
+    {"completed", (PyCFunction)Dag_completed, METH_NOARGS,
+     "number of complete()/complete_batch() task releases so far"},
+    {nullptr, nullptr, 0, nullptr}};
+
+static PySequenceMethods Dag_as_seq = {Dag_len};
+
+static PyTypeObject DagType = []{
+  PyTypeObject t = {PyVarObject_HEAD_INIT(nullptr, 0)};
+  t.tp_name = "_parsec_native.NativeDAG";
+  t.tp_basicsize = sizeof(DagObject);
+  t.tp_flags = Py_TPFLAGS_DEFAULT;
+  t.tp_doc = "Static dependence engine over a lowered PTG DAG.";
+  t.tp_new = Dag_new;
+  t.tp_dealloc = (destructor)Dag_dealloc;
+  t.tp_methods = Dag_methods;
+  t.tp_as_sequence = &Dag_as_seq;
+  return t;
+}();
+
+/* ================================================================== */
 /* module                                                              */
 /* ================================================================== */
 static PyModuleDef native_module = {
@@ -1107,6 +1434,7 @@ PyMODINIT_FUNC PyInit__parsec_native(void) {
       {"Dequeue", &DequeueType}, {"OrderedList", &OrderedType},
       {"HashTable64", &HT64Type}, {"ZoneMalloc", &ZoneType},
       {"HBBuffer", &HBBufferType}, {"MaxHeap", &MaxHeapType},
+      {"NativeDAG", &DagType},
   };
   for (auto& t : types) {
     if (PyType_Ready(t.type) < 0) return nullptr;

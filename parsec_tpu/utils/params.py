@@ -194,6 +194,10 @@ def register_core_params() -> None:
                       "worker core binding: \"rr\" or a core list \"0,2,4\" (ref --parsec_bind)")
     params.reg_bool("ptg_codegen", True,
                     "generate per-task-class successor/goal code (jdf2c analog)")
+    params.reg_string("ptg_dep_management", "hash",
+                      "PTG dependency tracking: hash (dynamic table) | "
+                      "static (lowered dense counters + native engine; "
+                      "single-rank, ref --dep-management=index-array)")
     params.reg_sizet("debug_history_size", 0,
                      "debug history ring entries (0=off, ref PARSEC_DEBUG_HISTORY)")
     params.reg_int("dtd_window_size", 8000, "DTD sliding window size")

@@ -1,0 +1,264 @@
+"""Wave execution: lowered PTG DAGs as batched per-class XLA calls
+(dsl/ptg/wave.py). Correctness vs numpy references, WAR frontier
+splitting, static body-local sub-chunking, and the structural dispatch
+gate (kernel calls must scale with waves, not tasks)."""
+import numpy as np
+import pytest
+
+from parsec_tpu.collections import TwoDimBlockCyclic
+from parsec_tpu.dsl import ptg
+from parsec_tpu.dsl.ptg.wave import WaveError, WaveRunner, wave
+from parsec_tpu.ops import (dgetrf_nopiv_taskpool, dpotrf_taskpool,
+                            pdgemm_taskpool, make_spd)
+
+
+def _spd_coll(n, nb):
+    M = make_spd(n, dtype=np.float32)
+    A = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float32).from_numpy(M)
+    return A, M
+
+
+def test_wave_dpotrf_matches_numpy():
+    A, M = _spd_coll(1024, 128)
+    w = wave(dpotrf_taskpool(A), max_chunk=64)
+    w.run()
+    L = np.tril(A.to_numpy()).astype(np.float64)
+    assert np.allclose(L, np.linalg.cholesky(M.astype(np.float64)),
+                       atol=1e-3)
+
+
+def test_wave_dgetrf_matches_numpy():
+    A, M = _spd_coll(768, 128)
+    wave(dgetrf_nopiv_taskpool(A), max_chunk=32).run()
+    LU = A.to_numpy().astype(np.float64)
+    L = np.tril(LU, -1) + np.eye(768)
+    U = np.triu(LU)
+    assert np.abs(L @ U - M).max() / np.abs(M).max() < 1e-5
+
+
+def test_wave_pdgemm_static_body_locals():
+    """pdgemm's GEMM body branches on local k in Python (`BETA if k == 0
+    else 1.0`): wave mode must sub-chunk on it, not trace it."""
+    n, nb = 512, 128
+    rng = np.random.RandomState(2)
+    Am, Bm = rng.rand(n, n).astype(np.float32), rng.rand(n, n).astype(np.float32)
+    A = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float32).from_numpy(Am)
+    B = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float32).from_numpy(Bm)
+    C = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float32).from_numpy(
+        np.zeros((n, n), np.float32))
+    w = wave(pdgemm_taskpool(A, B, C), max_chunk=16)
+    gemm_plan = next(p for p in w.plans if p.ast.name == "GEMM")
+    assert gemm_plan.body_locals, "k should be detected as a body local"
+    w.run()
+    ref = Am.astype(np.float64) @ Bm.astype(np.float64)
+    assert np.abs(C.to_numpy().astype(np.float64) - ref).max() / n < 1e-6
+
+
+def test_wave_dispatch_scales_with_waves_not_tasks():
+    """The point of wave mode: kernel-call count must be far below task
+    count (per-task dispatch is what it eliminates)."""
+    A, _ = _spd_coll(2048, 128)   # NT=16: 816 tasks
+    w = wave(dpotrf_taskpool(A), max_chunk=256)
+    calls = 0
+    orig = w._kernel
+
+    def counting(ci, k, statics=()):
+        fn = orig(ci, k, statics)
+
+        def wrapped(*a):
+            nonlocal calls
+            calls += 1
+            return fn(*a)
+        return wrapped
+
+    w._kernel = counting
+    w.run()
+    assert w.nb_tasks == 816
+    assert calls < w.nb_tasks / 3, f"{calls} kernel calls for 816 tasks"
+
+
+def test_wave_war_frontier_split():
+    """A frontier holding a reader of a tile and an independent writer of
+    the same tile must not let the in-place scatter clobber the read."""
+    jdf = """
+descA [ type="collection" ]
+descB [ type="collection" ]
+NT [ type="int" ]
+
+READER(k)
+
+k = 0 .. NT-1
+
+: descB( k, 0 )
+
+READ  X <- descA( 0, 0 )
+RW    Y <- descB( k, 0 )
+      -> descB( k, 0 )
+
+BODY
+{
+    Y = X + Y
+}
+END
+
+WRITER(j)
+
+j = 0 .. 0
+
+: descA( 0, 0 )
+
+RW    Z <- descA( 0, 0 )
+      -> descA( 0, 0 )
+
+BODY
+{
+    Z = Z * 0.0
+}
+END
+"""
+    fac = ptg.compile_jdf(jdf, name="war")
+    nt = 4
+    descA = TwoDimBlockCyclic(4, 4, 4, 4, dtype=np.float32).from_numpy(
+        np.full((4, 4), 7.0, np.float32))
+    descB = TwoDimBlockCyclic(4 * nt, 4, 4, 4, dtype=np.float32).from_numpy(
+        np.zeros((4 * nt, 4), np.float32))
+    tp = fac.new(NT=nt, descA=descA, descB=descB)
+    w = wave(tp)
+    # all instances are startup tasks: one frontier with readers of
+    # descA(0) and its writer
+    w.run()
+    out = descB.to_numpy()
+    assert np.allclose(out, 7.0), f"reader saw the clobbered tile: {out}"
+    assert np.allclose(descA.to_numpy(), 0.0)
+
+
+def test_wave_rejects_new_flows():
+    """Flows with NEW scratch sources can't live in collection pools."""
+    jdf = """
+descA [ type="collection" ]
+NT [ type="int" ]
+
+T(k)
+
+k = 0 .. NT-1
+
+: descA( k, 0 )
+
+RW   A <- descA( k, 0 )
+     -> descA( k, 0 )
+READ S <- NEW  [shape=4 dtype=float32]
+
+BODY
+{
+    A = A + S
+}
+END
+"""
+    fac = ptg.compile_jdf(jdf, name="newflow")
+    descA = TwoDimBlockCyclic(8, 4, 4, 4, dtype=np.float32).from_numpy(
+        np.zeros((8, 4), np.float32))
+    with pytest.raises(WaveError):
+        WaveRunner(fac.new(NT=2, descA=descA))
+
+
+def test_chunk_decomposition():
+    from parsec_tpu.dsl.ptg.wave import WaveRunner as W
+    assert W._chunks(0, 256) == []
+    assert W._chunks(1, 256) == [1]
+    assert W._chunks(7, 256) == [1, 2, 4]
+    assert W._chunks(300, 256) == [256, 4, 8, 32]
+    assert sum(W._chunks(300, 256)) == 300
+    assert sum(W._chunks(1023, 64)) == 1023
+
+
+def test_wave_cyclic_war_raises():
+    """Two co-ready tasks each reading the tile the other writes: legal
+    dataflow, but unservable by in-place scatters — must raise, not
+    corrupt."""
+    jdf = """
+descA [ type="collection" ]
+NT [ type="int" ]
+
+SWAPA(j)
+
+j = 0 .. 0
+
+: descA( 0, 0 )
+
+READ  X <- descA( 1, 0 )
+RW    Z <- descA( 0, 0 )
+      -> descA( 0, 0 )
+
+BODY
+{
+    Z = X
+}
+END
+
+SWAPB(j)
+
+j = 0 .. 0
+
+: descA( 1, 0 )
+
+READ  X <- descA( 0, 0 )
+RW    Z <- descA( 1, 0 )
+      -> descA( 1, 0 )
+
+BODY
+{
+    Z = X
+}
+END
+"""
+    fac = ptg.compile_jdf(jdf, name="swap")
+    descA = TwoDimBlockCyclic(8, 4, 4, 4, dtype=np.float32).from_numpy(
+        np.arange(32, dtype=np.float32).reshape(8, 4))
+    w = wave(fac.new(NT=1, descA=descA))
+    with pytest.raises(WaveError, match="cyclic"):
+        w.run()
+
+
+def test_lowering_cache_evicts_with_jdf():
+    """The lowering cache is scoped to the JDF's lifetime: a dead JDF's
+    entries are purged (no id-reuse aliasing, no unbounded growth)."""
+    import gc
+    import importlib
+    lower_mod = importlib.import_module("parsec_tpu.dsl.ptg.lower")
+
+    A, _ = _spd_coll(256, 128)
+    tp = dpotrf_taskpool(A)
+    dag = lower_mod.lower(tp)
+    jid = id(tp.jdf)
+    assert any(k[0] == jid for k in lower_mod._cache)
+    del tp, dag
+    # the taskpool holds the only strong ref to this factory's jdf? No —
+    # the factory is module-cached; force a fresh one to test eviction
+    fac = ptg.compile_jdf("""
+descA [ type="collection" ]
+NT [ type="int" ]
+
+T(k)
+
+k = 0 .. NT-1
+
+: descA( k, 0 )
+
+RW   A <- descA( k, 0 )
+     -> descA( k, 0 )
+
+BODY
+{
+    A = A * 2.0
+}
+END
+""", name="evict")
+    descA = TwoDimBlockCyclic(8, 4, 4, 4, dtype=np.float32).from_numpy(
+        np.ones((8, 4), np.float32))
+    tp2 = fac.new(NT=2, descA=descA)
+    lower_mod.lower(tp2)
+    jid2 = id(fac.jdf)
+    assert any(k[0] == jid2 for k in lower_mod._cache)
+    del tp2, fac
+    gc.collect()
+    assert not any(k[0] == jid2 for k in lower_mod._cache)
