@@ -3,7 +3,7 @@ from .collection import DataCollection, DictCollection, LocalArrayCollection
 from .matrix import (SymTwoDimBlockCyclic, SymTwoDimBlockCyclicBand,
                      TiledMatrix, TwoDimBlockCyclic, TwoDimBlockCyclicBand,
                      TwoDimTabular, VectorTwoDimCyclic)
-from .redistribute import redistribute, reshard_array
+from .redistribute import redistribute, redistribute_ptg, reshard_array
 from .subtile import SubtileView
 from . import ops
 
@@ -11,6 +11,6 @@ __all__ = [
     "DataCollection", "DictCollection", "LocalArrayCollection", "TiledMatrix",
     "TwoDimBlockCyclic", "SymTwoDimBlockCyclic", "TwoDimBlockCyclicBand",
     "SymTwoDimBlockCyclicBand",
-    "TwoDimTabular", "VectorTwoDimCyclic", "redistribute", "reshard_array",
+    "TwoDimTabular", "VectorTwoDimCyclic", "redistribute", "redistribute_ptg", "reshard_array",
     "ops", "SubtileView",
 ]

@@ -134,13 +134,17 @@ class RemoteDepEngine:
     # PTG activation protocol                                            #
     # ------------------------------------------------------------------ #
     def activate_batch(self, tp, task, flow_payloads: Dict[int, Any],
-                       remote_edges: Dict[int, List[Tuple]]) -> None:
+                       remote_edges: Dict[int, List[Tuple]],
+                       flow_dtts: Optional[Dict[int, Any]] = None) -> None:
         """Send activations for one completed task.
 
         remote_edges: dst_rank -> [(succ_tc_id, succ_locals, flow_name,
-        out_flow_idx), ...]; flow_payloads: out_flow_idx -> host ndarray.
-        One message per output flow per broadcast tree (the reference
-        aggregates by remote_deps struct, remote_dep.h:143-160).
+        out_flow_idx), ...]; flow_payloads: out_flow_idx -> host ndarray;
+        flow_dtts: out_flow_idx -> the copy's Datatype, carried on the
+        wire so a consumer whose declared type already matches does NOT
+        reconvert (ref: remote_no_re_reshape.jdf). One message per output
+        flow per broadcast tree (the reference aggregates by remote_deps
+        struct, remote_dep.h:143-160).
         """
         by_flow: Dict[int, Dict[int, List[Tuple]]] = {}
         for dst, edges in remote_edges.items():
@@ -155,6 +159,7 @@ class RemoteDepEngine:
                 "ranks": ranks,                      # bcast participants
                 "edges": {r: dsts[r] for r in ranks},
                 "src_task": getattr(task, "locals", None),
+                "dtt": (flow_dtts or {}).get(out_idx),
             }
             inline = payload_arr is None or payload_arr.nbytes <= self.short_limit
             if inline:
@@ -197,20 +202,22 @@ class RemoteDepEngine:
         if not my_edges:
             return
         if "data" in msg or msg.get("handle") is None:
-            self._deliver_activation(tp, my_edges, msg.get("data"))
+            self._deliver_activation(tp, my_edges, msg.get("data"),
+                                     msg.get("dtt"))
         else:
             # rendezvous: GET the payload from the data holder
             def on_data(arr):
-                self._deliver_activation(tp, my_edges, arr)
+                self._deliver_activation(tp, my_edges, arr, msg.get("dtt"))
             self.ce.get(msg["data_rank"], msg["handle"], on_data)
 
-    def _deliver_activation(self, tp, edges: List[Tuple], arr) -> None:
+    def _deliver_activation(self, tp, edges: List[Tuple], arr,
+                            dtt=None) -> None:
         """Incoming data releases local successors
         (ref: remote_dep_release_incoming, remote_dep_mpi.c:997)."""
         copy = None
         if arr is not None:
             d = Data(nb_elts=arr.size)
-            copy = DataCopy(d, 0, payload=np.asarray(arr))
+            copy = DataCopy(d, 0, payload=np.asarray(arr), dtt=dtt)
             copy.version = 1
             copy.coherency = Coherency.OWNED
             d.attach_copy(copy)

@@ -145,15 +145,20 @@ def _emit_goal_target(t, out: List[str], indent: str) -> None:
         out.append(f"{indent}__ptg_g += 1")
 
 
-def _emit_succ_target(t, flow_idx: int, out: List[str], indent: str) -> None:
+def _emit_succ_target(t, flow_idx: int, out: List[str], indent: str,
+                      props=None) -> None:
     if t is None or t.kind != "task":
         return
     elems, loops = _arg_dims(t.args)
     for lp in loops:
         out.append(indent + lp)
         indent += "    "
+    # the [type=...] local-reshape name rides the callback so
+    # release_deps can convert the copy producer-side; type_remote is
+    # consumer-resolved (_input_dtt) and does not travel here
+    lt = props.get("type") if props else None
     out.append(f"{indent}__ptg_cb({t.task_class!r}, {_tuple_src(elems)}, "
-               f"{t.flow!r}, __ptg_c{flow_idx}, {flow_idx})")
+               f"{t.flow!r}, __ptg_c{flow_idx}, {flow_idx}, {lt!r})")
 
 
 def generate_source(tc: TaskClassAST) -> str:
@@ -192,12 +197,13 @@ def generate_source(tc: TaskClassAST) -> str:
                    else f"    __ptg_c{i} = __ptg_copies[{i}]")
         for d in f.deps_out():
             if d.guard is None:
-                _emit_succ_target(d.target, i, src, "    ")
+                _emit_succ_target(d.target, i, src, "    ", d.properties)
             else:
                 body = []
-                _emit_succ_target(d.target, i, body, "        ")
+                _emit_succ_target(d.target, i, body, "        ", d.properties)
                 alt = []
-                _emit_succ_target(d.alt_target, i, alt, "        ")
+                _emit_succ_target(d.alt_target, i, alt, "        ",
+                                  d.properties)
                 if body or alt:
                     src.append(f"    if ({d.guard.src}):")
                     src.extend(body or ["        pass"])

@@ -232,7 +232,7 @@ def _iterate_successors_symbolic(tc, locals_: Tuple, cb) -> None:
         # generated cbs pass dep-target args in the consumer's PARAM
         # order; lowered ids are keyed by ranged-locals order — translate
         tc._gen_succ(locals_, copies,
-                     lambda name, loc, fl, cp, idx: cb(
+                     lambda name, loc, fl, cp, idx, tys=None: cb(
                          name, by_name(name).locals_from_param_args(loc),
                          fl, cp, idx))
         return

@@ -27,7 +27,7 @@ from ...core.hashtable import HashTable
 from ...profiling.grapher import grapher
 from ...data.data import Coherency, Data, DataCopy, FlowAccess
 from ...data.datatype import Datatype, dtt_of_array
-from ...data.reshape import ReshapeRepo
+from ...data.reshape import ReshapeRepo, reshape_array as reshape_to
 from ...runtime.scheduling import schedule_keep_best
 from ...runtime.taskpool import (Chore, Flow, HookReturn, Task, TaskClass,
                                  Taskpool)
@@ -191,7 +191,13 @@ class PTGTaskClass(TaskClass):
                     coll = self.tp.global_env[t.collection]
                     args = [a(env) for a in t.args]
                     data = coll.data_of(*args)
-                    ref.data_in = self.tp.host_copy_of(es, data)
+                    hc = self.tp.host_copy_of(es, data)
+                    if self._flow_masked_writeback(f, env):
+                        # a region-masked [type_data] writeback must see
+                        # the destination's OLD out-of-region values —
+                        # the body may not mutate the home buffer
+                        hc = _detached_clone(hc)
+                    ref.data_in = hc
                     ref.fulfilled = True
                 elif t.kind == "new":
                     ref.data_in = self.tp.new_scratch_copy(f, env)
@@ -228,23 +234,77 @@ class PTGTaskClass(TaskClass):
 
         The first in-dep applicable under ``env`` is the edge that bound
         the input (same rule as the binding loop — SPMD-consistent on
-        both ends of a remote edge)."""
+        both ends of a remote edge). Property semantics mirror the
+        reference (parsec_reshape.c; tests/collections/reshape/):
+        - ``type``        — LOCAL reshape: consumers get a converted copy
+                            regardless of where the data came from;
+        - ``type_remote`` — wire datatype only: applied when the
+                            producer lives on ANOTHER rank, ignored for
+                            local edges (local_no_reshape /
+                            avoidable_reshape semantics);
+        - ``type_data``   — datatype when reading from the matrix
+                            (memory-sourced edges)."""
         for d in f.deps_in():
-            if d.resolve(env) is None:
+            t = d.resolve(env)
+            if t is None:
                 continue
-            tname = d.properties.get("type")
+            props = d.properties
+            if t.kind == "memory":
+                tname = props.get("type_data") or props.get("type")
+            elif t.kind == "task":
+                tname = props.get("type")
+                if tname is None:
+                    rname = props.get("type_remote")
+                    if rname is not None and self._edge_is_remote(t, env):
+                        tname = rname
+            else:
+                tname = props.get("type")
             if tname is None:
                 return None
-            val = self.tp.global_env.get(tname)
-            if isinstance(val, Datatype):
-                return val
-            if tname in ("lower", "upper", "full"):
-                base = copy.dtt or dtt_of_array(copy.payload)
-                return dataclasses.replace(base, region=tname)
-            raise TypeError(
-                f"{self.name}.{f.name}: [type={tname}] is neither a "
-                f"Datatype global nor a region shorthand")
+            return self.resolve_dtt_name(tname, copy, f.name)
         return None
+
+    def _edge_is_remote(self, t, env: Dict[str, Any]) -> bool:
+        """Does this task-sourced in-dep cross ranks? (Both ends evaluate
+        the same dep — SPMD-consistent, like the reference's
+        remote_dep_mpi_retrieve_datatype both-ends lookup.)"""
+        if self.tp.nb_ranks == 1:
+            return False
+        try:
+            ptc = self.tp.class_by_name(t.task_class)
+            args = next(iter(_expand_args(t.args, env)))
+            penv = ptc.env_of(ptc.ast.locals_from_param_args(args))
+            return ptc.rank_of_instance(penv) != self.tp.rank
+        except (KeyError, StopIteration):
+            return False
+
+    def resolve_dtt_name(self, tname: str, copy, flow_name: str) -> Datatype:
+        """A [type*=NAME] property: a Datatype global, or one of the
+        region shorthands applied to the copy's base type."""
+        val = self.tp.global_env.get(tname)
+        if isinstance(val, Datatype):
+            return val
+        if tname in ("lower", "upper", "full"):
+            base = (copy.dtt if copy is not None and copy.dtt is not None
+                    else dtt_of_array(copy.payload))
+            return dataclasses.replace(base, region=tname)
+        raise TypeError(
+            f"{self.name}.{flow_name}: [type={tname}] is neither a "
+            f"Datatype global nor a region shorthand")
+
+    def _flow_masked_writeback(self, f: FlowAST, env: Dict[str, Any]) -> bool:
+        """Does any memory out-dep of this flow declare a (possibly
+        region-masked) writeback type? Those flows bind detached clones
+        so the body cannot clobber the destination's out-of-region
+        values before the masked writeback runs."""
+        for d in f.deps_out():
+            t = d.resolve(env)
+            if t is None or t.kind != "memory":
+                continue
+            nm = d.properties.get("type_data") or d.properties.get("type")
+            if nm is not None and nm != "full":
+                return True
+        return False
 
     def _output_binding(self, f: FlowAST, env: Dict[str, Any]):
         """WRITE-only flow: bind to its memory out-target or a NEW buffer."""
@@ -253,7 +313,10 @@ class PTGTaskClass(TaskClass):
             if t is not None and t.kind == "memory":
                 coll = self.tp.global_env[t.collection]
                 args = [a(env) for a in t.args]
-                return self.tp.host_copy_of(None, coll.data_of(*args))
+                hc = self.tp.host_copy_of(None, coll.data_of(*args))
+                if self._flow_masked_writeback(f, env):
+                    hc = _detached_clone(hc)
+                return hc
         return self.tp.new_scratch_copy(f, env)
 
     def _iterate_successors(self, es, task: Task, cb: Callable) -> None:
@@ -266,8 +329,8 @@ class PTGTaskClass(TaskClass):
             resolve = self.tp.class_by_name
             self._gen_succ(
                 task.locals, copies,
-                lambda name, loc, fl, cp, idx: cb(resolve(name), loc, fl,
-                                                  cp, idx))
+                lambda name, loc, fl, cp, idx, tys=None: cb(
+                    resolve(name), loc, fl, cp, idx, tys))
             return
         env = self.env_of(task.locals)
         for i, f in enumerate(self.ast.flows):
@@ -278,9 +341,10 @@ class PTGTaskClass(TaskClass):
                     continue
                 if t.kind == "memory":
                     continue  # handled in prepare_output (writeback)
+                lt = d.properties.get("type")
                 succ_tc = self.tp.class_by_name(t.task_class)
                 for succ_locals in _expand_args(t.args, env):
-                    cb(succ_tc, succ_locals, t.flow, copy, i)
+                    cb(succ_tc, succ_locals, t.flow, copy, i, lt)
 
     def _release_deps(self, es, task: Task, action_mask: int) -> List[Task]:
         """Local successors activate in place; remote ones accumulate into a
@@ -301,9 +365,11 @@ class PTGTaskClass(TaskClass):
         ready: List[Task] = []
         remote_edges: Dict[int, List[Tuple]] = {}
         flow_payloads: Dict[int, Any] = {}
+        flow_dtts: Dict[int, Any] = {}
 
         def activate(succ_tc: "PTGTaskClass", succ_locals: Tuple,
-                     flow_name: str, copy, out_idx: int) -> None:
+                     flow_name: str, copy, out_idx: int,
+                     edge_type=None) -> None:
             if grapher.enabled:
                 # must match Task.snprintf() so DOT edges hit real nodes
                 grapher.dep(task, f"{succ_tc.name}"
@@ -311,6 +377,12 @@ class PTGTaskClass(TaskClass):
             env = succ_tc.env_of(succ_locals)
             dst = succ_tc.rank_of_instance(env)
             if dst == self.tp.rank:
+                if edge_type is not None and copy is not None:
+                    # [type=...] on the OUT dep: producer-side local
+                    # reshape — successors receive the converted copy
+                    # (local_output_reshape semantics)
+                    dtt = self.resolve_dtt_name(edge_type, copy, flow_name)
+                    copy = self.tp.reshape_repo.reshaped_copy(copy, dtt, es)
                 t = succ_tc.activate(succ_locals, flow_name, copy)
                 if t is not None:
                     ready.append(t)
@@ -328,11 +400,13 @@ class PTGTaskClass(TaskClass):
                     flow_payloads[out_idx] = np.asarray(host.payload)
                 else:
                     flow_payloads[out_idx] = np.asarray(copy.payload)
+                flow_dtts[out_idx] = copy.dtt  # rides the wire: a
+                # matching consumer type must not reconvert
 
         self._iterate_successors(es, task, activate)
         if remote_edges:
             self.tp.comm.activate_batch(self.tp, task, flow_payloads,
-                                        remote_edges)
+                                        remote_edges, flow_dtts)
         return ready
 
     def activate(self, locals_: Tuple, flow_name: str, copy) -> Optional[Task]:
@@ -458,6 +532,20 @@ class PTGTaskClass(TaskClass):
         return fn
 
 
+def _detached_clone(copy: DataCopy) -> DataCopy:
+    """A private host copy of ``copy``'s payload, detached from its Data
+    (body mutations stay private until the writeback applies them)."""
+    payload = (None if copy is None or copy.payload is None
+               else np.array(np.asarray(copy.payload)))
+    d = Data(nb_elts=0 if payload is None else payload.size)
+    c = DataCopy(d, 0, payload=payload,
+                 dtt=None if copy is None else copy.dtt)
+    c.version = 1
+    c.coherency = Coherency.OWNED
+    d.attach_copy(c)
+    return c
+
+
 def _expand_args(args: List[Any], env: Dict[str, Any]) -> Iterator[Tuple]:
     """Expand Expr/RangeExpr argument lists into concrete locals tuples
     (a range arg == broadcast edge, ref Ex05 ``TaskRecv(k, 0 .. NB .. 2)``)."""
@@ -519,7 +607,8 @@ class PTGTaskpool(Taskpool):
     # ------------------------------------------------------------------ #
     def _startup(self, context, tp) -> List[Task]:
         if (params.get("ptg_dep_management") == "static"
-                and self.nb_ranks == 1 and not grapher.enabled):
+                and self.nb_ranks == 1 and not grapher.enabled
+                and not self._has_out_edge_types()):
             return self._startup_static()
         total = 0
         startup: List[Task] = []
@@ -564,6 +653,18 @@ class PTGTaskpool(Taskpool):
                            "%d startup", self.name, self._dag.n_tasks,
                            self._dag.n_edges, len(startup))
         return startup
+
+    def _has_out_edge_types(self) -> bool:
+        """[type=...] on OUT deps reshapes copies during release — the
+        static engine routes copies in C without property handling, so
+        such taskpools stay on the dynamic path. (type_remote is
+        consumer-resolved and does not affect the release walk.)"""
+        for tc in self.task_classes:
+            for f in tc.ast.flows:
+                for d in f.deps_out():
+                    if "type" in d.properties:
+                        return True
+        return False
 
     def _make_task_static(self, tid: int) -> Task:
         """Spawn a lowered task: class/locals/priority from the flat
@@ -691,8 +792,29 @@ class PTGTaskpool(Taskpool):
                     continue
                 if copy is None:
                     continue
+                # [type_data=...] / [type=...] on a memory OUT dep: only
+                # the declared region's elements land in memory, the rest
+                # of the destination tile keeps its old values (ref:
+                # local_input_reshape.jdf WRITE_A -> descA [type=LOWER])
+                wb_name = (d.properties.get("type_data")
+                           or d.properties.get("type"))
+                if wb_name is not None and copy is not None:
+                    # a no-op annotation ([type=full] / a full-region
+                    # Datatype with the copy's own dtype) must NOT
+                    # defeat the lazy already-home path below — that
+                    # would force a per-task D2H pull (fatal at tunnel
+                    # rates)
+                    if wb_name == "full":
+                        wb_name = None
+                    else:
+                        val = self.global_env.get(wb_name)
+                        pdt = getattr(copy.payload, "dtype", None)
+                        if (isinstance(val, Datatype)
+                                and val.region == "full" and pdt is not None
+                                and np.dtype(val.dtype) == np.dtype(pdt)):
+                            wb_name = None
                 dest = coll.data_of(*args)
-                if copy.data is dest:
+                if copy.data is dest and wb_name is None:
                     # already home: the Data owns the newest (device) copy;
                     # do NOT force a device->host transfer here — readers
                     # sync lazily (a per-task d2h pull would serialize the
@@ -704,10 +826,18 @@ class PTGTaskpool(Taskpool):
                         f"{task.snprintf()}: memory writeback of flow "
                         f"{f.name} from a detached device copy")
                 dh = self.host_copy_of(es, dest)
+                src_arr = np.asarray(sh.payload)
+                mask = None
+                if wb_name is not None:
+                    dtt = tc.resolve_dtt_name(wb_name, sh, f.name)
+                    src_arr = np.asarray(reshape_to(src_arr, dtt))
+                    mask = dtt.mask()
                 if dh.payload is None:
-                    dh.payload = np.array(np.asarray(sh.payload))
+                    dh.payload = np.array(src_arr)
+                elif mask is None:
+                    np.copyto(dh.payload, src_arr)
                 else:
-                    np.copyto(dh.payload, np.asarray(sh.payload))
+                    np.copyto(dh.payload, src_arr, where=mask)
                 dest.version_bump(0)
 
 

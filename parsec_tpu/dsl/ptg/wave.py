@@ -114,6 +114,21 @@ class WaveRunner:
             # shape uniformity (pools are stacked arrays) is enforced by
             # np.stack in build_pools; ragged tilings raise there
         self.plans = [_ClassPlan(tc) for tc in tp.task_classes]
+        # reshape property semantics ([type]/[type_data] conversions,
+        # region-masked writeback) live in the per-task runtime; pools
+        # scatter whole tiles, so accepting such JDFs would silently
+        # clobber out-of-region values. type_remote alone is fine: wave
+        # is single-rank and type_remote is wire-only (a no-op here).
+        for tc in tp.task_classes:
+            for f in tc.ast.flows:
+                for d in f.deps:
+                    for key in ("type", "type_data"):
+                        nm = d.properties.get(key)
+                        if nm is not None and nm != "full":
+                            raise WaveError(
+                                f"{tc.ast.name}.{f.name}: [{key}={nm}] "
+                                f"reshape semantics need the per-task "
+                                f"runtime; wave pools scatter whole tiles")
         # slot tables: per task, per (non-ctl) flow position in the
         # class's flow_idx list -> flat tile index (collection fixed per
         # class/flow, validated during assignment)
@@ -171,6 +186,14 @@ class WaveRunner:
                 if p.written[k]:
                     self._check_writeback(p, f, env, coll_id, idx)
         self._slot = slot
+        # only collections the DAG actually touches are staged; only
+        # written ones are scattered back (D2H can be ~4 MB/s — a full
+        # gather of an untouched pool costs minutes)
+        self._used_colls = {cid for p in self.plans
+                            for cid in p.flow_coll if cid >= 0}
+        self._written_colls = {p.flow_coll[k] for p in self.plans
+                               for k in range(len(p.flow_idx))
+                               if p.written[k] and p.flow_coll[k] >= 0}
 
     def _slot_of_flow(self, tid, f, env, flow_pos, slot):
         deps_in = f.deps_in()
@@ -343,8 +366,9 @@ class WaveRunner:
                     members = ids[cls == ci]
                     p = self.plans[int(ci)]
                     nf = len(p.flow_idx)
-                    prio = dag.priority[members]
-                    members = members[np.argsort(-prio, kind="stable")]
+                    # (no priority ordering: a wave is an antichain and
+                    # every member executes before the next readiness
+                    # update — order has no observable effect)
                     # body-referenced locals become static kernel args:
                     # group members by their values (uniform per wave in
                     # the common panel-structured DAGs)
@@ -365,8 +389,20 @@ class WaveRunner:
                                     .reshape(k, nl)
                                     if nl else np.zeros((k, 0), np.int32))
                             idx = slot[chunk, :nf].T.copy()  # [n_flows, k]
-                            pools = self._kernel(int(ci), k, statics)(
-                                pools, locs, idx)
+                            try:
+                                pools = self._kernel(int(ci), k, statics)(
+                                    pools, locs, idx)
+                            except Exception as exc:
+                                if "Tracer" in type(exc).__name__ or \
+                                        "Concretization" in type(exc).__name__:
+                                    raise WaveError(
+                                        f"{p.ast.name}: body cannot be "
+                                        f"batch-traced (it branches on a "
+                                        f"derived local or data value in "
+                                        f"Python); run this taskpool "
+                                        f"through the per-task runtime"
+                                    ) from exc
+                                raise
                             n_calls += 1
             ready = np.asarray(eng.complete_batch(ready), np.int32)
         done = eng.completed() if hasattr(eng, "completed") else dag.n_tasks
@@ -395,6 +431,13 @@ class WaveRunner:
             for k in range(len(p.flow_idx)):
                 key = (p.flow_coll[k], int(slot[t, k]))
                 if p.written[k]:
+                    prev = writes.get(key)
+                    if prev is not None and prev != int(t):
+                        raise WaveError(
+                            f"frontier holds two writers of the same "
+                            f"tile (tasks {prev} and {int(t)}): the DAG "
+                            f"races — in-place scatters would keep an "
+                            f"arbitrary one")
                     writes[key] = int(t)
                 else:
                     reads.setdefault(key, []).append(int(t))
@@ -438,25 +481,40 @@ class WaveRunner:
     # ------------------------------------------------------------------ #
     # convenience: run against the bound collections                     #
     # ------------------------------------------------------------------ #
-    def build_pools(self, device=None) -> Tuple:
+    def build_pools(self, device=None, sharding=None) -> Tuple:
+        """Stage each collection as one stacked [n_tiles, mb, nb] device
+        array. ``sharding`` (a jax.sharding.Sharding over the tile dims,
+        e.g. NamedSharding(mesh, P(None, "tp", "sp"))) runs every wave
+        kernel SPMD over the mesh — GSPMD partitions the batched tile
+        ops and inserts the collectives (the scaling-book recipe); right
+        for large NB where one tile's FLOPs span several chips."""
         import jax
         import jax.numpy as jnp
         pools = []
         for cid, name in enumerate(self.coll_names):
+            if cid not in self._used_colls:
+                pools.append(jnp.zeros((0,), np.float32))  # placeholder
+                continue
             coll = self.collections[name]
             coords = sorted(coll.tiles())
             tiles = []
             for c in coords:
                 data = coll.data_of(*c)
                 tiles.append(np.asarray(data.sync_to_host().payload))
-            arr = jnp.asarray(np.stack(tiles))
-            if device is not None:
-                arr = jax.device_put(arr, device)
+            stacked = np.stack(tiles)
+            if sharding is not None:
+                arr = jax.device_put(stacked, sharding)
+            elif device is not None:
+                arr = jax.device_put(stacked, device)
+            else:
+                arr = jnp.asarray(stacked)
             pools.append(arr)
         return tuple(pools)
 
     def scatter_pools(self, pools: Tuple) -> None:
         for cid, name in enumerate(self.coll_names):
+            if cid not in self._written_colls:
+                continue  # no task wrote this pool: home copies stand
             coll = self.collections[name]
             coords = sorted(coll.tiles())
             host = np.asarray(pools[cid])

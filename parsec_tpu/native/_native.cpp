@@ -1180,6 +1180,13 @@ static PyObject* Dag_new(PyTypeObject* type, PyObject* args, PyObject*) {
       Py_DECREF(self);
       return nullptr;
     }
+  for (size_t i = 0; i + 1 < self->indptr->size(); i++)
+    if ((*self->indptr)[i] < 0 || (*self->indptr)[i] > (*self->indptr)[i + 1]) {
+      PyErr_SetString(PyExc_ValueError, "indptr must be non-negative and "
+                                        "monotonically non-decreasing");
+      Py_DECREF(self);
+      return nullptr;
+    }
   self->n_tasks = (int32_t)n;
   self->max_flows = max_flows;
   self->indeg = new (std::nothrow) std::atomic<int32_t>[n];

@@ -175,7 +175,8 @@ class IteratorsCheckerModule(PinsModule):
             return  # PTG-only checker, like the reference
         self.checked += 1
 
-        def check(succ_tc, succ_locals, flow_name, copy, out_idx):
+        def check(succ_tc, succ_locals, flow_name, copy, out_idx,
+                  edge_types=None):
             # (a) successor locals within its iteration-space ranges
             env = dict(succ_tc.tp.global_env)
             it = iter(succ_locals)

@@ -31,7 +31,7 @@ def _edges_generated(tc, locals_):
     copies = [None] * len(tc.ast.flows)
     out = []
     tc._gen_succ(locals_, copies,
-                 lambda name, loc, fl, cp, idx: out.append(
+                 lambda name, loc, fl, cp, idx, tys=None: out.append(
                      (name, loc, fl, idx)))
     return out
 

@@ -262,3 +262,97 @@ END
     del tp2, fac
     gc.collect()
     assert not any(k[0] == jid2 for k in lower_mod._cache)
+
+
+def test_wave_sharded_over_mesh():
+    """Wave kernels run SPMD when pools carry a NamedSharding: GSPMD
+    partitions each batched tile op over the mesh (tp x sp here) and the
+    result matches the single-device run."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from parsec_tpu.parallel import make_mesh
+
+    A, M = _spd_coll(512, 128)
+    w = wave(dpotrf_taskpool(A), max_chunk=32)
+    mesh = make_mesh(sizes={"tp": 2, "sp": 2}, devices=jax.devices("cpu")[:4])
+    sh = NamedSharding(mesh, P(None, "tp", "sp"))
+    pools = w.build_pools(sharding=sh)
+    assert pools[0].sharding.is_equivalent_to(sh, pools[0].ndim)
+    out = w.execute(pools)
+    jax.block_until_ready(out)
+    w.scatter_pools(out)
+    L = np.tril(A.to_numpy()).astype(np.float64)
+    assert np.allclose(L, np.linalg.cholesky(M.astype(np.float64)),
+                       atol=1e-3)
+
+
+def test_wave_rejects_reshape_properties():
+    """[type]/[type_data] reshape semantics live in the per-task
+    runtime; wave pools scatter whole tiles and must refuse."""
+    jdf = """
+descA [ type="collection" ]
+
+T(k)
+
+k = 0 .. 0
+
+: descA( 0, 0 )
+
+RW   A <- descA( 0, 0 )    [type_data=lower]
+     -> descA( 0, 0 )
+
+BODY
+{
+    A = A * 2.0
+}
+END
+"""
+    fac = ptg.compile_jdf(jdf, name="reshapey")
+    descA = TwoDimBlockCyclic(4, 4, 4, 4, dtype=np.float32).from_numpy(
+        np.ones((4, 4), np.float32))
+    with pytest.raises(WaveError, match="per-task runtime"):
+        WaveRunner(fac.new(descA=descA))
+
+
+def test_wave_rejects_waw_frontier():
+    """Two co-ready writers of one tile (a racy DAG) must raise, not
+    keep an arbitrary winner."""
+    jdf = """
+descA [ type="collection" ]
+
+W1(k)
+
+k = 0 .. 0
+
+: descA( 0, 0 )
+
+RW   A <- descA( 0, 0 )
+     -> descA( 0, 0 )
+
+BODY
+{
+    A = A + 1.0
+}
+END
+
+W2(k)
+
+k = 0 .. 0
+
+: descA( 0, 0 )
+
+RW   A <- descA( 0, 0 )
+     -> descA( 0, 0 )
+
+BODY
+{
+    A = A + 2.0
+}
+END
+"""
+    fac = ptg.compile_jdf(jdf, name="waw")
+    descA = TwoDimBlockCyclic(4, 4, 4, 4, dtype=np.float32).from_numpy(
+        np.zeros((4, 4), np.float32))
+    w = wave(fac.new(descA=descA))
+    with pytest.raises(WaveError, match="two writers"):
+        w.run()

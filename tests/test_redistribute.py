@@ -129,3 +129,117 @@ def test_reshard_array_roundtrip():
     b = reshard_array(a, mesh, P("tp", "dp"))
     c = reshard_array(b, mesh, P())
     np.testing.assert_array_equal(np.asarray(c), x)
+
+
+# --------------------------------------------------------------------- #
+# optimized reshuffle path (ref: the dedicated reshuffle JDF selected   #
+# by redistribute_wrapper.c:185 when grids align)                       #
+# --------------------------------------------------------------------- #
+def test_reshuffle_fast_path_equivalence(ctx):
+    """Aligned same-grid case: the reshuffle path (1 whole-tile task per
+    tile) must produce exactly what the general fragment path does, with
+    fewer tasks."""
+    rng = np.random.RandomState(3)
+    lm, nb = 48, 8
+    src_np = rng.rand(lm, lm)
+    Y = TwoDimBlockCyclic(lm, lm, nb, nb, dtype=np.float64).from_numpy(src_np)
+    Y.name = "rsY"
+    T1 = TwoDimBlockCyclic(lm, lm, nb, nb, dtype=np.float64).from_numpy(
+        np.zeros((lm, lm)))
+    T1.name = "rsT1"
+    T2 = TwoDimBlockCyclic(lm, lm, nb, nb, dtype=np.float64).from_numpy(
+        np.zeros((lm, lm)))
+    T2.name = "rsT2"
+    M = N = 32
+    tp1 = redistribute(Y, T1, M, N, disi_Y=8, disj_Y=0, disi_T=16,
+                       disj_T=8, context=ctx)                 # reshuffle
+    tp2 = redistribute(Y, T2, M, N, disi_Y=8, disj_Y=0, disi_T=16,
+                       disj_T=8, context=ctx, allow_reshuffle=False)
+    expect = np.zeros((lm, lm))
+    expect[16:16 + M, 8:8 + N] = src_np[8:8 + M, 0:N]
+    np.testing.assert_array_equal(T1.to_numpy(), expect)
+    np.testing.assert_array_equal(T2.to_numpy(), expect)
+    # the aligned case degenerates to one whole-tile task per target
+    # tile on BOTH paths (the general enumerator already collapses);
+    # equal task counts, identical results — the reshuffle path's value
+    # is the guaranteed 1:1 permutation structure the PTG variant builds
+    # on (see redistribute.py docstring for the measured comparison)
+    assert tp1._inserted == tp2._inserted
+
+
+def test_reshuffle_not_applied_when_unaligned(ctx):
+    rng = np.random.RandomState(4)
+    lm, nb = 32, 8
+    src_np = rng.rand(lm, lm)
+    Y = TwoDimBlockCyclic(lm, lm, nb, nb, dtype=np.float64).from_numpy(src_np)
+    Y.name = "ruY"
+    T = TwoDimBlockCyclic(lm, lm, nb, nb, dtype=np.float64).from_numpy(
+        np.zeros((lm, lm)))
+    T.name = "ruT"
+    redistribute(Y, T, 16, 16, disi_Y=3, disj_Y=5, disi_T=1, disj_T=2,
+                 context=ctx)   # unaligned: general fragment path
+    expect = np.zeros((lm, lm))
+    expect[1:17, 2:18] = src_np[3:19, 5:21]
+    np.testing.assert_array_equal(T.to_numpy(), expect)
+
+
+def test_redistribute_ptg_single_rank(ctx):
+    from parsec_tpu.collections import redistribute_ptg
+    rng = np.random.RandomState(5)
+    lm, nb = 40, 8
+    src_np = rng.rand(lm, lm)
+    Y = TwoDimBlockCyclic(lm, lm, nb, nb, dtype=np.float64).from_numpy(src_np)
+    T = TwoDimBlockCyclic(lm, lm, nb, nb, dtype=np.float64).from_numpy(
+        np.zeros((lm, lm)))
+    tp = redistribute_ptg(Y, T, 24, 24, disi_Y=8, disj_Y=8,
+                          disi_T=16, disj_T=0)
+    ctx.add_taskpool(tp)
+    ctx.wait()
+    expect = np.zeros((lm, lm))
+    expect[16:40, 0:24] = src_np[8:32, 8:32]
+    np.testing.assert_array_equal(T.to_numpy(), expect)
+
+
+@pytest.mark.parametrize("nb_ranks", [2])
+def test_redistribute_ptg_multirank(nb_ranks):
+    from parsec_tpu.collections import redistribute_ptg
+    rng = np.random.RandomState(6)
+    lm, nb = 32, 8
+    src_np = rng.rand(lm, lm)
+    results = [None] * nb_ranks
+
+    def rank_fn(rank, fabric):
+        eng = RemoteDepEngine(fabric.engine(rank))
+        ctx2 = parsec_tpu.Context(nb_cores=1, comm=eng, enable_tpu=False)
+        try:
+            Y = TwoDimBlockCyclic(lm, lm, nb, nb, P=nb_ranks, Q=1,
+                                  nodes=nb_ranks, rank=rank,
+                                  dtype=np.float64).from_numpy(src_np)
+            Y.name = "pY"
+            T = TwoDimBlockCyclic(lm, lm, nb, nb, P=1, Q=nb_ranks,
+                                  nodes=nb_ranks, rank=rank,
+                                  dtype=np.float64).from_numpy(
+                np.zeros((lm, lm)))
+            T.name = "pT"
+            tp = redistribute_ptg(Y, T, 16, 16, disi_Y=0, disj_Y=8,
+                                  disi_T=8, disj_T=0,
+                                  rank=rank, nb_ranks=nb_ranks)
+            ctx2.add_taskpool(tp)
+            ctx2.wait()
+            results[rank] = {c: np.array(
+                T.data_of(*c).host_copy().payload)
+                for c in T.tiles() if T.rank_of(*c) == rank}
+        finally:
+            ctx2.fini()
+
+    spmd(nb_ranks, rank_fn)
+    expect = np.zeros((lm, lm))
+    expect[8:24, 0:16] = src_np[0:16, 8:24]
+    nt = lm // nb
+    for m in range(nt):
+        for n in range(nt):
+            owner = n % nb_ranks   # P=1, Q=nb_ranks target
+            got = results[owner][(m, n)]
+            np.testing.assert_array_equal(
+                got, expect[m * nb:(m + 1) * nb, n * nb:(n + 1) * nb],
+                err_msg=f"tile ({m},{n})")

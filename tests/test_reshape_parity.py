@@ -1,0 +1,565 @@
+"""Reshape scenario parity with the reference's dedicated suite.
+
+Scenario <-> test map (ref: /root/reference/tests/collections/reshape/):
+
+| reference scenario file                              | test here                                   |
+|------------------------------------------------------|---------------------------------------------|
+| local_no_reshape.jdf                                 | test_local_no_reshape_type_remote_ignored   |
+| avoidable_reshape.jdf                                | test_avoidable_reshape_no_spurious_copy     |
+| local_input_reshape.jdf                              | test_local_input_reshape_masked_writeback   |
+| local_output_reshape.jdf                             | test_local_output_reshape_on_out_dep        |
+| local_read_reshape.jdf                               | test_local_read_reshape_from_memory         |
+| local_input_LU_LL.jdf                                | test_local_input_LU_LL_chained_reshapes     |
+| input_dep_single_copy_reshape.jdf                    | test_input_dep_single_copy_shared           |
+| remote_read_reshape.jdf                              | test_remote_read_reshape                    |
+| remote_no_re_reshape.jdf                             | test_remote_no_re_reshape                   |
+| remote_multiple_outs_same_pred_flow.jdf              | test_remote_multiple_outs_same_pred_flow    |
+| remote_multiple_outs_same_pred_flow_multiple_deps.jdf| test_remote_multiple_outs_multiple_deps     |
+
+Property semantics under test (parsec_reshape.c; dsl/ptg/runtime.py
+_input_dtt):
+- ``[type=T]``        local reshape: consumers get a converted copy;
+- ``[type_remote=T]`` wire type only: reshapes cross-rank edges, is
+                      IGNORED on local edges (pointer semantics);
+- ``[type_data=T]``   datatype reading from / writing back to the matrix
+                      (masked writeback: elements outside the region keep
+                      their old values).
+"""
+import numpy as np
+import pytest
+
+import parsec_tpu
+from parsec_tpu.comm import RemoteDepEngine
+from parsec_tpu.collections import TwoDimBlockCyclic
+from parsec_tpu.dsl import ptg
+
+from test_comm_multirank import spmd
+
+N = 4
+
+
+def _base():
+    return (np.arange(N * N, dtype=np.float64).reshape(N, N) + 1.0)
+
+
+def _run_local(jdf_text, name, base=None, extra=None):
+    ctx = parsec_tpu.init(nb_cores=1)
+    try:
+        coll = TwoDimBlockCyclic(N, N, N, N, dtype=np.float64)
+        coll.name = "descA"
+        base = _base() if base is None else base
+        coll.from_numpy(base.copy())
+        out = {}
+        env = {"descA": coll, "out": out}
+        if extra:
+            env.update(extra)
+        tp = ptg.compile_jdf(jdf_text, name=name).new(**env)
+        ctx.add_taskpool(tp)
+        ctx.wait()
+        return coll.data_of(0, 0).host_copy().payload, out, tp
+    finally:
+        ctx.fini()
+
+
+# --------------------------------------------------------------------- #
+# local_no_reshape.jdf: only type_remote on the edges -> the ORIGINAL   #
+# copy is passed (no conversion); zeroing it zeroes the full tile       #
+# --------------------------------------------------------------------- #
+LOCAL_NO_RESHAPE = """
+descA [ type="collection" ]
+out [ type="object" ]
+
+READ_A(k)
+k = 0 .. 0
+: descA( 0, 0 )
+RW A <- descA( 0, 0 )
+     -> A SET_ZEROS( 0 )   [type_remote=lower]
+BODY
+{
+}
+END
+
+SET_ZEROS(k)
+k = 0 .. 0
+: descA( 0, 0 )
+RW A <- A READ_A( 0 )      [type_remote=lower]
+     -> descA( 0, 0 )
+BODY
+{
+    A[:] = 0.0
+}
+END
+"""
+
+
+def test_local_no_reshape_type_remote_ignored():
+    tile, _, tp = _run_local(LOCAL_NO_RESHAPE, "local_no_reshape")
+    np.testing.assert_array_equal(tile, np.zeros((N, N)))
+    assert tp.reshape_repo.stats["conversions"] == 0
+
+
+# --------------------------------------------------------------------- #
+# avoidable_reshape.jdf: DEFAULT type everywhere -> no spurious copies  #
+# --------------------------------------------------------------------- #
+AVOIDABLE = """
+descA [ type="collection" ]
+out [ type="object" ]
+
+READ_A(k)
+k = 0 .. 0
+: descA( 0, 0 )
+RW A <- descA( 0, 0 )      [type_data=full]
+     -> A WRITE_A( 0 )
+BODY
+{
+}
+END
+
+WRITE_A(k)
+k = 0 .. 0
+: descA( 0, 0 )
+RW A <- A READ_A( 0 )
+     -> descA( 0, 0 )      [type=full type_data=full]
+BODY
+{
+    A[:] = 0.0
+}
+END
+"""
+
+
+def test_avoidable_reshape_no_spurious_copy():
+    tile, _, tp = _run_local(AVOIDABLE, "avoidable")
+    np.testing.assert_array_equal(tile, np.zeros((N, N)))
+    assert tp.reshape_repo.stats["conversions"] == 0
+
+
+# --------------------------------------------------------------------- #
+# local_input_reshape.jdf: [type] on an input dep -> converted copy to  #
+# successors; masked [type_data] writeback leaves the upper part intact #
+# --------------------------------------------------------------------- #
+LOCAL_INPUT_RESHAPE = """
+descA [ type="collection" ]
+out [ type="object" ]
+
+READ_A(k)
+k = 0 .. 0
+: descA( 0, 0 )
+RW A <- descA( 0, 0 )
+     -> A SET_ZEROS( 0 )
+BODY
+{
+}
+END
+
+SET_ZEROS(k)
+k = 0 .. 0
+: descA( 0, 0 )
+RW A <- A READ_A( 0 )      [type=lower]
+     -> A WRITE_A( 0 )
+BODY
+{
+    out['seen_by_zeros'] = np.array(A)
+    A[:] = 0.0
+}
+END
+
+WRITE_A(k)
+k = 0 .. 0
+: descA( 0, 0 )
+RW A <- A SET_ZEROS( 0 )
+     -> descA( 0, 0 )      [type=lower type_data=lower]
+BODY
+{
+}
+END
+"""
+
+
+def test_local_input_reshape_masked_writeback():
+    base = _base()
+    tile, out, tp = _run_local(LOCAL_INPUT_RESHAPE, "local_input_reshape")
+    # the consumer saw the lower-masked conversion...
+    np.testing.assert_array_equal(out["seen_by_zeros"], np.tril(base))
+    # ...and the masked writeback zeroed ONLY the lower region
+    expect = np.triu(base, 1)
+    np.testing.assert_array_equal(tile, expect)
+    assert tp.reshape_repo.stats["conversions"] == 1
+
+
+# --------------------------------------------------------------------- #
+# local_output_reshape.jdf: [type] on the producer's OUT dep            #
+# --------------------------------------------------------------------- #
+LOCAL_OUTPUT_RESHAPE = """
+descA [ type="collection" ]
+out [ type="object" ]
+
+READ_A(k)
+k = 0 .. 0
+: descA( 0, 0 )
+RW A <- descA( 0, 0 )
+     -> A SET_ZEROS( 0 )   [type=lower]
+BODY
+{
+}
+END
+
+SET_ZEROS(k)
+k = 0 .. 0
+: descA( 0, 0 )
+RW A <- A READ_A( 0 )
+     -> descA( 0, 0 )      [type=lower type_data=lower]
+BODY
+{
+    out['seen_by_zeros'] = np.array(A)
+    A[:] = 0.0
+}
+END
+"""
+
+
+def test_local_output_reshape_on_out_dep():
+    base = _base()
+    tile, out, tp = _run_local(LOCAL_OUTPUT_RESHAPE, "local_output_reshape")
+    np.testing.assert_array_equal(out["seen_by_zeros"], np.tril(base))
+    np.testing.assert_array_equal(tile, np.triu(base, 1))
+    assert tp.reshape_repo.stats["conversions"] == 1
+
+
+# --------------------------------------------------------------------- #
+# local_read_reshape.jdf: [type_data] reading from the matrix           #
+# --------------------------------------------------------------------- #
+LOCAL_READ_RESHAPE = """
+descA [ type="collection" ]
+out [ type="object" ]
+
+READ_A(k)
+k = 0 .. 0
+: descA( 0, 0 )
+RW A <- descA( 0, 0 )      [type_data=lower]
+     -> descA( 0, 0 )      [type=lower type_data=lower]
+BODY
+{
+    out['seen'] = np.array(A)
+    A[:] = 0.0
+}
+END
+"""
+
+
+def test_local_read_reshape_from_memory():
+    base = _base()
+    tile, out, tp = _run_local(LOCAL_READ_RESHAPE, "local_read_reshape")
+    np.testing.assert_array_equal(out["seen"], np.tril(base))
+    np.testing.assert_array_equal(tile, np.triu(base, 1))
+    # the home tile never got mutated by the read-side conversion
+    assert tp.reshape_repo.stats["conversions"] == 1
+
+
+# --------------------------------------------------------------------- #
+# local_input_LU_LL.jdf: chained different reshapes of the same flow    #
+# --------------------------------------------------------------------- #
+LU_LL = """
+descA [ type="collection" ]
+out [ type="object" ]
+
+READ_A(k)
+k = 0 .. 0
+: descA( 0, 0 )
+RW A <- descA( 0, 0 )
+     -> A UP( 0 )
+     -> A LO( 0 )
+BODY
+{
+}
+END
+
+UP(k)
+k = 0 .. 0
+: descA( 0, 0 )
+READ A <- A READ_A( 0 )    [type=upper]
+BODY
+{
+    out['upper'] = np.array(A)
+}
+END
+
+LO(k)
+k = 0 .. 0
+: descA( 0, 0 )
+READ A <- A READ_A( 0 )    [type=lower]
+BODY
+{
+    out['lower'] = np.array(A)
+}
+END
+"""
+
+
+def test_local_input_LU_LL_chained_reshapes():
+    base = _base()
+    _, out, tp = _run_local(LU_LL, "lu_ll")
+    np.testing.assert_array_equal(out["upper"], np.triu(base))
+    np.testing.assert_array_equal(out["lower"], np.tril(base))
+    # two DIFFERENT types of the same copy: two conversions
+    assert tp.reshape_repo.stats["conversions"] == 2
+
+
+# --------------------------------------------------------------------- #
+# input_dep_single_copy_reshape.jdf: N consumers, one shared conversion #
+# --------------------------------------------------------------------- #
+SINGLE_COPY = """
+descA [ type="collection" ]
+out [ type="object" ]
+
+READ_A(k)
+k = 0 .. 0
+: descA( 0, 0 )
+RW A <- descA( 0, 0 )
+     -> A CONS( 0 .. 3 )
+BODY
+{
+}
+END
+
+CONS(k)
+k = 0 .. 3
+: descA( 0, 0 )
+READ A <- A READ_A( 0 )    [type=lower]
+BODY
+{
+    out[('seen', k)] = np.array(A)
+}
+END
+"""
+
+
+def test_input_dep_single_copy_shared():
+    base = _base()
+    _, out, tp = _run_local(SINGLE_COPY, "single_copy")
+    for k in range(4):
+        np.testing.assert_array_equal(out[("seen", k)], np.tril(base))
+    # all four consumers shared ONE converted copy
+    assert tp.reshape_repo.stats["conversions"] == 1
+    assert tp.reshape_repo.stats["hits"] >= 3
+
+
+# --------------------------------------------------------------------- #
+# remote scenarios: 2 ranks over the in-process fabric                  #
+# --------------------------------------------------------------------- #
+def _run_remote(jdf_text, name, base=None):
+    outs = [dict() for _ in range(2)]
+    tiles = [None, None]
+
+    def rank_fn(rank, fabric):
+        eng = RemoteDepEngine(fabric.engine(rank))
+        ctx = parsec_tpu.Context(nb_cores=1, comm=eng, enable_tpu=False)
+        try:
+            coll = TwoDimBlockCyclic(2 * N, N, N, N, P=2, Q=1, nodes=2,
+                                     rank=rank, dtype=np.float64)
+            coll.name = "descA"
+            b = _base() if base is None else base
+            coll.from_numpy(np.vstack([b, np.zeros((N, N))]))
+            tp = ptg.compile_jdf(jdf_text, name=name).new(
+                descA=coll, out=outs[rank], rank=rank, nb_ranks=2)
+            ctx.add_taskpool(tp)
+            ctx.wait()
+            if coll.rank_of(1, 0) == rank:
+                tiles[1] = np.array(coll.data_of(1, 0).host_copy().payload)
+            return tp.reshape_repo.stats.copy()
+        finally:
+            ctx.fini()
+
+    results, _ = spmd(2, rank_fn)
+    return outs, tiles, results
+
+
+REMOTE_READ = """
+descA [ type="collection" ]
+out [ type="object" ]
+
+Prod(k)
+k = 0 .. 0
+: descA( 0, 0 )
+RW A <- descA( 0, 0 )
+     -> A Cons( 0 )
+BODY
+{
+    A += 1.0
+}
+END
+
+Cons(k)
+k = 0 .. 0
+: descA( 1, 0 )
+READ A <- A Prod( 0 )      [type_remote=lower]
+BODY
+{
+    out['seen'] = np.array(A)
+}
+END
+"""
+
+
+def test_remote_read_reshape():
+    base = _base()
+    outs, _, results = _run_remote(REMOTE_READ, "remote_read")
+    np.testing.assert_array_equal(outs[1]["seen"], np.tril(base + 1.0))
+    assert "seen" not in outs[0]
+    # conversion happened exactly once, on the wire path
+    assert results[0]["conversions"] + results[1]["conversions"] == 1
+
+
+REMOTE_NO_RE_RESHAPE = """
+descA [ type="collection" ]
+out [ type="object" ]
+
+Prod(k)
+k = 0 .. 0
+: descA( 0, 0 )
+RW A <- descA( 0, 0 )      [type_data=lower]
+     -> A Cons( 0 )        [type=lower]
+BODY
+{
+}
+END
+
+Cons(k)
+k = 0 .. 0
+: descA( 1, 0 )
+READ A <- A Prod( 0 )      [type_remote=lower]
+BODY
+{
+    out['seen'] = np.array(A)
+}
+END
+"""
+
+
+def test_remote_no_re_reshape():
+    """The producer's copy is already lower-typed; the matching
+    type_remote on the consumer edge must NOT reconvert."""
+    base = _base()
+    outs, _, results = _run_remote(REMOTE_NO_RE_RESHAPE, "no_re_reshape")
+    np.testing.assert_array_equal(outs[1]["seen"], np.tril(base))
+    # exactly one conversion total (producer side); the consumer's
+    # type_remote found a compatible copy
+    assert results[0]["conversions"] + results[1]["conversions"] == 1
+
+
+REMOTE_MULTI_OUTS = """
+descA [ type="collection" ]
+out [ type="object" ]
+
+READ_A(k)
+k = 0 .. 0
+: descA( 0, 0 )
+RW A <- descA( 0, 0 )
+     -> A TASK_A( 0 )      [type_remote=upper]
+     -> B TASK_A( 0 )      [type_remote=lower]
+BODY
+{
+}
+END
+
+TASK_A(k)
+k = 0 .. 0
+: descA( 1, 0 )
+RW A <- A READ_A( 0 )      [type_remote=upper]
+     -> descA( 1, 0 )
+READ B <- A READ_A( 0 )    [type_remote=lower]
+BODY
+{
+    out['A'] = np.array(A)
+    out['B'] = np.array(B)
+    A[:] = np.triu(A) + np.tril(B, -1)
+}
+END
+"""
+
+
+def test_remote_multiple_outs_same_pred_flow():
+    """One producer flow shipped under TWO wire types to two flows of the
+    same consumer (the reference's upper+lower merge)."""
+    base = _base()
+    outs, tiles, _ = _run_remote(REMOTE_MULTI_OUTS, "multi_outs")
+    np.testing.assert_array_equal(outs[1]["A"], np.triu(base))
+    np.testing.assert_array_equal(outs[1]["B"], np.tril(base))
+    np.testing.assert_array_equal(tiles[1],
+                                  np.triu(base) + np.tril(base, -1))
+
+
+REMOTE_MULTI_DEPS = """
+descA [ type="collection" ]
+out [ type="object" ]
+
+READ_A(k)
+k = 0 .. 0
+: descA( 0, 0 )
+RW A <- descA( 0, 0 )
+     -> A UP( 0 )          [type_remote=upper]
+     -> A LO( 0 )          [type_remote=lower]
+BODY
+{
+}
+END
+
+UP(k)
+k = 0 .. 0
+: descA( 1, 0 )
+READ A <- A READ_A( 0 )    [type_remote=upper]
+BODY
+{
+    out['upper'] = np.array(A)
+}
+END
+
+LO(k)
+k = 0 .. 0
+: descA( 1, 0 )
+READ A <- A READ_A( 0 )    [type_remote=lower]
+BODY
+{
+    out['lower'] = np.array(A)
+}
+END
+"""
+
+
+def test_remote_multiple_outs_multiple_deps():
+    """Same producer flow feeding DIFFERENT consumer classes under
+    different wire types."""
+    base = _base()
+    outs, _, _ = _run_remote(REMOTE_MULTI_DEPS, "multi_deps")
+    np.testing.assert_array_equal(outs[1]["upper"], np.triu(base))
+    np.testing.assert_array_equal(outs[1]["lower"], np.tril(base))
+
+
+# --------------------------------------------------------------------- #
+# masked writeback must survive IN-PLACE mutation of a home-bound flow  #
+# (no conversion on the input side: the body would otherwise clobber    #
+# the destination's out-of-region values before the mask applies)       #
+# --------------------------------------------------------------------- #
+HOME_MASKED_WB = """
+descA [ type="collection" ]
+out [ type="object" ]
+
+ZERO_LOWER(k)
+k = 0 .. 0
+: descA( 0, 0 )
+RW A <- descA( 0, 0 )
+     -> descA( 0, 0 )      [type_data=lower]
+BODY
+{
+    A[:] = 0.0
+}
+END
+"""
+
+
+def test_masked_writeback_of_home_bound_flow():
+    base = _base()
+    tile, _, _ = _run_local(HOME_MASKED_WB, "home_masked")
+    # body zeroed its (detached) view in place; only the lower region
+    # lands in memory — the upper part keeps the ORIGINAL values
+    np.testing.assert_array_equal(tile, np.triu(base, 1))

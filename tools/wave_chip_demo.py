@@ -1,0 +1,97 @@
+"""North-star-scale wave demo on one chip: dpotrf NT>=64 at NB=512.
+
+Times each stage so tunnel/host costs are attributable; verification is
+device-side (the D2H link can be ~4 MB/s — a full gather would take
+tens of minutes). Usage: python tools/wave_chip_demo.py [N] [NB].
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def log(msg):
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from parsec_tpu.collections import TwoDimBlockCyclic
+    from parsec_tpu.dsl.ptg.wave import wave
+    from parsec_tpu.ops import dpotrf_taskpool
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 32768
+    nb = int(sys.argv[2]) if len(sys.argv) > 2 else 512
+    nt = n // nb
+    rng = np.random.RandomState(0)
+    t0 = time.perf_counter()
+    B = rng.rand(n, n).astype(np.float32)
+    M = (B + B.T) / 2
+    del B
+    M[np.arange(n), np.arange(n)] += n
+    log(f"input built ({time.perf_counter()-t0:.1f}s)")
+
+    t0 = time.perf_counter()
+    A = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float32).from_numpy(M)
+    tp = dpotrf_taskpool(A)
+    w = wave(tp, max_chunk=256)
+    log(f"NT={nt}: {w.nb_tasks} tasks; collection+lower+slots "
+        f"({time.perf_counter()-t0:.1f}s)")
+
+    dev = jax.devices()[0]
+    t0 = time.perf_counter()
+    pools = w.build_pools(device=dev)
+    jax.block_until_ready(pools)
+    log(f"pools staged to {dev} ({time.perf_counter()-t0:.1f}s)")
+
+    t0 = time.perf_counter()
+    out = w.execute(pools)
+    jax.block_until_ready(out)
+    warm = time.perf_counter() - t0
+    log(f"first run incl compiles ({warm:.1f}s)")
+
+    t0 = time.perf_counter()
+    pools = w.build_pools(device=dev)
+    jax.block_until_ready(pools)
+    log(f"pools re-staged ({time.perf_counter()-t0:.1f}s)")
+    t0 = time.perf_counter()
+    out = w.execute(pools)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    log(f"steady run {dt:.2f}s = {n**3/3/dt/1e12:.2f} TF/s")
+
+    if os.environ.get("WAVE_DEMO_CHECK", "1") == "0":
+        print(f"RESULT NT={nt} NB={nb} tasks={w.nb_tasks} "
+              f"steady_s={dt:.3f} tflops={n**3/3/dt/1e12:.2f} "
+              f"tile_err=skipped")
+        return
+    # Spot-check: full residuals need either a D2H gather (link can run
+    # ~4 MB/s -> tens of minutes, and has been observed to WEDGE
+    # entirely after large runs) or full-matrix device temps (the pool
+    # is already ~1/4 of HBM). Pull two tiles (~2 MB) and verify them
+    # against closed forms that need no full host factorization:
+    #   L(0,0)  = chol(M(0,0))
+    #   L(nt-1,0) = M(nt-1,0) @ inv(L00)^T      (panel-0 TRSM)
+    # Algorithmic correctness of the same code path is separately gated
+    # at N=8192 (bench numerics) and NT=128 on CPU (full residual).
+    t0 = time.perf_counter()
+    tiles = np.asarray(out[0][np.array([0, (nt - 1) * nt])])
+    log(f"pulled 2 tiles D2H ({time.perf_counter()-t0:.1f}s)")
+    L00 = np.linalg.cholesky(M[:nb, :nb].astype(np.float64))
+    e0 = np.abs(np.tril(tiles[0]) - L00).max() / np.abs(L00).max()
+    ref_t = M[(nt - 1) * nb:, :nb].astype(np.float64) @ \
+        np.linalg.inv(L00).T
+    e1 = np.abs(tiles[1] - ref_t).max() / np.abs(ref_t).max()
+    log(f"tile checks: |L00 err|={e0:.3e} |L(nt-1,0) err|={e1:.3e}")
+    assert e0 < 1e-4 and e1 < 1e-3, "tile spot-check failed"
+    print(f"RESULT NT={nt} NB={nb} tasks={w.nb_tasks} "
+          f"steady_s={dt:.3f} tflops={n**3/3/dt/1e12:.2f} "
+          f"tile_err=({e0:.2e},{e1:.2e})")
+
+
+if __name__ == "__main__":
+    main()
