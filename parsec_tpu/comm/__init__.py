@@ -6,9 +6,10 @@ from .local import LocalCommEngine, LocalFabric
 from .mesh import MeshCommEngine, MeshFabric
 from .tcp import TCPCommEngine, free_ports
 from .remote_dep import RemoteDepEngine, bcast_children
+from .xfer import DeviceDataPlane
 
 __all__ = ["CommEngine", "MemHandle", "LocalFabric", "LocalCommEngine",
            "MeshFabric", "MeshCommEngine", "TCPCommEngine", "free_ports",
-           "RemoteDepEngine", "bcast_children", "TAG_ACTIVATE",
+           "RemoteDepEngine", "bcast_children", "DeviceDataPlane", "TAG_ACTIVATE",
            "TAG_DTD_DATA", "TAG_GET_DATA", "TAG_GET_REQ", "TAG_TERMDET",
            "TAG_USER_BASE"]

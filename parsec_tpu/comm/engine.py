@@ -53,6 +53,14 @@ class CommEngine:
         self._tag_cbs: Dict[int, Callable] = {}
         self._mem: Dict[int, MemHandle] = {}
         self.on_get_served: Optional[Callable[[int], None]] = None
+        # transports invoke this when a message lands in the inbox so a
+        # parked worker wakes instead of finishing its backoff sleep
+        self.on_arrival: Optional[Callable[[], None]] = None
+
+    def _notify_arrival(self) -> None:
+        cb = self.on_arrival
+        if cb is not None:
+            cb()
 
     # -- active messages ----------------------------------------------------
     def tag_register(self, tag: int, cb: Callable[[int, Any], None]) -> None:

@@ -41,6 +41,9 @@ class LocalFabric:
             self.msg_count += 1
             self.bytes_count += _payload_bytes(payload)
         self.inboxes[dst].push((src, tag, payload))
+        eng = self.engines[dst]
+        if eng is not None:
+            eng._notify_arrival()  # wake a parked worker on the dst rank
 
 
 def _payload_bytes(payload: Any) -> int:

@@ -30,6 +30,7 @@ from ..utils import logging as plog
 from ..utils.params import params
 from .engine import (CommEngine, TAG_ACTIVATE, TAG_DTD_DATA, TAG_GET_DATA,
                      TAG_MEM_PUT, TAG_TERMDET)
+from .xfer import TAG_XFER_ACK, _is_device_array
 
 _log = plog.comm_stream
 
@@ -75,6 +76,7 @@ class RemoteDepEngine:
         self._dtd_expect: Dict[Tuple, Callable] = {}
         # rendezvous bookkeeping: handle_id -> (taskpool, remaining, handle)
         self._pending_handles: Dict[int, Tuple] = {}
+        self._pending_xfers: Dict[int, Any] = {}  # uuid -> taskpool
         # memory writebacks buffered until the taskpool's startup has
         # credited the expected arrivals as pending actions (delivering
         # sooner would drive runtime_actions negative):
@@ -90,6 +92,7 @@ class RemoteDepEngine:
         ce.tag_register(TAG_DTD_DATA, self._on_dtd_data)
         ce.tag_register(TAG_MEM_PUT, self._on_mem_put)
         ce.tag_register(TAG_TERMDET, self._on_termdet)
+        ce.tag_register(TAG_XFER_ACK, self._on_xfer_ack)
         ce.on_get_served = self.note_get_served
         self.stats = {"activates_sent": 0, "activates_recv": 0,
                       "dtd_sends": 0, "dtd_recvs": 0, "forwards": 0,
@@ -101,6 +104,13 @@ class RemoteDepEngine:
     def attach(self, context) -> None:
         self.context = context
         context.comm = self
+        # message-arrival wakeup: an idle worker may be parked in its
+        # exponential backoff (up to 2 ms) when an activation lands —
+        # polling cadence, not the wire, dominated small-message latency
+        # (rtt ~460 us before this hook). Transports call on_arrival
+        # from the delivering thread; waking one worker drains the
+        # inbox immediately.
+        self.ce.on_arrival = lambda: context.wake_workers(1)
         # failure detection: a transport that notices dead peers aborts
         # this rank's DAG cleanly instead of hanging in termdet forever
         if hasattr(self.ce, "on_peer_failure"):
@@ -161,8 +171,26 @@ class RemoteDepEngine:
                 "src_task": getattr(task, "locals", None),
                 "dtt": (flow_dtts or {}).get(out_idx),
             }
+            plane = getattr(self.ce, "device_plane", None)
             inline = payload_arr is None or payload_arr.nbytes <= self.short_limit
-            if inline:
+            if (plane is not None and not inline
+                    and _is_device_array(payload_arr)):
+                # device data plane: park the DEVICE buffer, consumers
+                # pull it device-to-device (no host pickling); one uuid
+                # per receiving rank, ACK-released (comm/xfer.py)
+                uuids = {}
+                shape = dtype = None
+                for r in ranks:
+                    u, shape, dtype = plane.register(payload_arr)
+                    uuids[r] = u
+                    with self._lock:
+                        self._pending_xfers[u] = tp
+                tp.add_pending_action(len(ranks))
+                msg["xfer"] = {"uuids": uuids, "shape": shape,
+                               "dtype": dtype, "src": self.rank}
+            elif inline:
+                if payload_arr is not None and _is_device_array(payload_arr):
+                    payload_arr = np.asarray(payload_arr)
                 msg["data"] = payload_arr
             else:
                 # SNAPSHOT the payload: a local successor released by the
@@ -201,6 +229,25 @@ class RemoteDepEngine:
         my_edges = msg["edges"].get(self.rank, [])
         if not my_edges:
             return
+        xf = msg.get("xfer")
+        if xf is not None:
+            plane = getattr(self.ce, "device_plane", None)
+            if plane is None:  # not assert: must survive python -O
+                raise RuntimeError(
+                    "producer used the device data plane but this rank "
+                    "has none attached (attach a DeviceDataPlane on "
+                    "every rank)")
+            uuid = xf["uuids"][self.rank]
+            arr = plane.pull(xf["src"], uuid, tuple(xf["shape"]),
+                             xf["dtype"])
+            # the pull materializes ASYNCHRONOUSLY; the ACK releases the
+            # producer's parked buffer and lets its taskpool retire, so
+            # it must not fire until the bytes actually landed
+            import jax
+            jax.block_until_ready(arr)
+            self.ce.send_am(xf["src"], TAG_XFER_ACK, {"uuid": uuid})
+            self._deliver_activation(tp, my_edges, arr, msg.get("dtt"))
+            return
         if "data" in msg or msg.get("handle") is None:
             self._deliver_activation(tp, my_edges, msg.get("data"),
                                      msg.get("dtt"))
@@ -217,7 +264,10 @@ class RemoteDepEngine:
         copy = None
         if arr is not None:
             d = Data(nb_elts=arr.size)
-            copy = DataCopy(d, 0, payload=np.asarray(arr), dtt=dtt)
+            # device-plane arrivals stay device arrays (host bytes only
+            # materialize if a host body asks); wire arrivals are ndarrays
+            payload = arr if _is_device_array(arr) else np.asarray(arr)
+            copy = DataCopy(d, 0, payload=payload, dtt=dtt)
             copy.version = 1
             copy.coherency = Coherency.OWNED
             d.attach_copy(copy)
@@ -233,6 +283,18 @@ class RemoteDepEngine:
 
     # GET service accounting: the local fabric serves GETs inside
     # ce.progress; pending handles release when everyone fetched
+    def _on_xfer_ack(self, src: int, payload: Dict) -> None:
+        """A consumer's device-to-device pull completed: drop the parked
+        producer buffer and retire the pending action."""
+        uuid = payload["uuid"]
+        with self._lock:
+            tp = self._pending_xfers.pop(uuid, None)
+        plane = getattr(self.ce, "device_plane", None)
+        if plane is not None:
+            plane.release(uuid)
+        if tp is not None:
+            tp.pending_action_done(1)
+
     def note_get_served(self, handle_id: int) -> None:
         # progress() fans out to every idle worker: the decrement must be
         # atomic or concurrent GET-serves lose counts and wait() hangs

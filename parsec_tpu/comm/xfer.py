@@ -1,0 +1,143 @@
+"""Device data plane: cross-process device-to-device tile transfers.
+
+Reference behavior being replaced: on multi-node runs the reference
+moves tile payloads over MPI alongside the control traffic
+(parsec/parsec_mpi_funnelled.c:245-365 — GET emulation over two-sided
+sends through HOST buffers). On TPU pods the idiomatic data plane is the
+interconnect fabric itself: this module wires jax's transfer server
+(``jax.experimental.transfer`` — the DCN/ICI point-to-point pull API)
+into the comm-engine as a side channel, so a cross-rank dataflow edge
+whose payload already lives in device memory is pulled device-to-device
+by the consumer, never round-tripping through host pickling.
+
+Division of labor (SURVEY.md §5.8): the CommEngine (TCP across
+processes) stays the CONTROL plane — activations, GET requests, termdet;
+bulk tile payloads ride this plane whenever both ends have one. Host
+payloads keep using the classic CE rendezvous.
+
+Address exchange is SPMD: every rank broadcasts its transfer-server
+address over a reserved AM tag at attach time; `exchange()` progresses
+the CE until all peers are known.
+
+CROSS-PROCESS ONLY: two transfer servers in one OS process trip the
+runtime's local-bulk-transport CHECK (observed: abseil fatal in
+streaming.cc). In-process rank fabrics (LocalFabric/MeshFabric) already
+share an address space — they don't need this plane.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..utils import logging as plog
+from .engine import TAG_USER_BASE
+
+TAG_XFER_ADDR = TAG_USER_BASE - 2  # reserved (transport sync uses -1)
+TAG_XFER_ACK = TAG_USER_BASE - 3   # consumer pulled: release the park
+
+
+# re-export (transport modules and the PTG runtime both test payloads)
+from ..data.data import is_device_array as _is_device_array  # noqa: E402,F401
+
+
+class DeviceDataPlane:
+    """One per rank: a transfer server + connections to the peers.
+
+    uuids are partitioned by rank (rank in the high bits) so producers
+    never collide. ``register`` parks a device array for one remote pull;
+    ``pull`` fetches a peer's parked array straight into local device
+    memory (async — jax arrays materialize when the transfer lands).
+    """
+
+    def __init__(self, ce, device=None, host: str = "127.0.0.1") -> None:
+        import jax
+        from jax.experimental import transfer
+
+        self.ce = ce
+        self.device = device if device is not None else jax.devices()[0]
+        # separate bulk-transport sockets are REQUIRED: without explicit
+        # transport addresses the cross-process pull dies with a torn
+        # connection (errno 107) or an aborted local-transport check
+        self.server = transfer.start_transfer_server(
+            self.device.client, f"{host}:0", [f"{host}:0"])
+        self.addresses: Dict[int, str] = {ce.rank: self.server.address()}
+        self._conns: Dict[int, Any] = {}
+        self._uuid_next = 1
+        self._parked: Dict[int, Any] = {}   # uuid -> array (keep-alive)
+        self._lock = threading.Lock()
+        self.stats = {"pulls": 0, "serves": 0, "bytes_pulled": 0}
+        ce.tag_register(TAG_XFER_ADDR, self._on_addr)
+        for r in range(ce.nb_ranks):
+            if r != ce.rank:
+                ce.send_am(r, TAG_XFER_ADDR,
+                           {"rank": ce.rank, "addr": self.server.address()})
+        ce.device_plane = self
+
+    def _on_addr(self, src: int, payload: Dict) -> None:
+        self.addresses[payload["rank"]] = payload["addr"]
+
+    def exchange(self, timeout: float = 30.0) -> None:
+        """Progress the CE until every peer's address arrived."""
+        import time
+        t0 = time.monotonic()
+        while len(self.addresses) < self.ce.nb_ranks:
+            self.ce.progress()
+            if time.monotonic() - t0 > timeout:
+                missing = [r for r in range(self.ce.nb_ranks)
+                           if r not in self.addresses]
+                raise TimeoutError(
+                    f"no transfer address from ranks {missing}")
+            time.sleep(0.001)
+
+    # ------------------------------------------------------------------ #
+    def register(self, arr: Any) -> Tuple[int, Tuple, str]:
+        """Park a device array for one remote pull; returns the wire
+        descriptor (uuid, shape, dtype_name)."""
+        with self._lock:
+            uuid = (self.ce.rank << 40) | self._uuid_next
+            self._uuid_next += 1
+            self._parked[uuid] = arr
+            self.stats["serves"] += 1
+        self.server.await_pull(uuid, [arr])
+        return uuid, tuple(arr.shape), str(arr.dtype)
+
+    def release(self, uuid: int) -> None:
+        """Drop the keep-alive once the consumer confirmed the pull."""
+        with self._lock:
+            self._parked.pop(uuid, None)
+
+    def pull(self, src_rank: int, uuid: int, shape: Tuple,
+             dtype: str) -> Any:
+        """Fetch a parked array from ``src_rank`` device-to-device;
+        returns a local device array (materializes asynchronously)."""
+        import jax
+        from jax.sharding import SingleDeviceSharding
+
+        with self._lock:  # pull runs from any worker draining activations
+            conn = self._conns.get(src_rank)
+            if conn is None:
+                addr = self.addresses.get(src_rank)
+                if addr is None:
+                    raise RuntimeError(
+                        f"no transfer address for rank {src_rank} "
+                        f"(exchange() not run?)")
+                conn = self.server.connect(addr)
+                self._conns[src_rank] = conn
+        spec = jax.ShapeDtypeStruct(
+            shape, np.dtype(dtype),
+            sharding=SingleDeviceSharding(self.device))
+        out = conn.pull(uuid, [spec])[0]
+        with self._lock:
+            self.stats["pulls"] += 1
+            self.stats["bytes_pulled"] += (int(np.prod(shape))
+                                           * np.dtype(dtype).itemsize)
+        return out
+
+    def fini(self) -> None:
+        with self._lock:
+            self._parked.clear()
+        self._conns.clear()
+        plog.debug.verbose(3, "device plane rank %d: %s", self.ce.rank,
+                           self.stats)

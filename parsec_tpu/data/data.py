@@ -22,6 +22,16 @@ from typing import Any, Dict, List, Optional
 from ..core.object import Obj
 
 
+def is_device_array(x: Any) -> bool:
+    """A jax array (device-resident payload): stays on device through
+    transports/stage-in; numpy arrays and scalars take host paths."""
+    try:
+        import jax
+        return isinstance(x, jax.Array)
+    except Exception:  # pragma: no cover - jax always present in-tree
+        return False
+
+
 class Coherency(IntEnum):
     INVALID = 0
     OWNED = 1       # only valid version; other copies may be stale

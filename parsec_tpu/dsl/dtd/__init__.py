@@ -688,7 +688,13 @@ def unpack_args(task: Task) -> List[Any]:
     for p in task.user:
         if p.tile is not None:
             host = p.tile.data.get_copy(0)
-            out.append(host.payload if host is not None else None)
+            if host is None:
+                out.append(None)
+            else:
+                # bodies mutate in place; wire arrivals may be read-only
+                # zero-copy views — materialize copies on first write
+                from ...data.data import Data
+                out.append(Data.materialize_host(host))
         else:
             out.append(p.value)
     return out

@@ -27,6 +27,7 @@ from ...core.hashtable import HashTable
 from ...profiling.grapher import grapher
 from ...data.data import Coherency, Data, DataCopy, FlowAccess
 from ...data.datatype import Datatype, dtt_of_array
+from ...data.data import is_device_array as _is_dev_arr
 from ...data.reshape import ReshapeRepo, reshape_array as reshape_to
 from ...runtime.scheduling import schedule_keep_best
 from ...runtime.taskpool import (Chore, Flow, HookReturn, Task, TaskClass,
@@ -395,13 +396,26 @@ class PTGTaskClass(TaskClass):
             remote_edges.setdefault(dst, []).append(
                 (succ_tc.task_class_id, succ_locals, flow_name, out_idx))
             if out_idx not in flow_payloads and copy is not None:
-                if copy.data is not None:
+                plane = getattr(getattr(self.tp.comm, "ce", None),
+                                "device_plane", None)
+                newest = (copy.data.newest_copy()
+                          if copy.data is not None else copy)
+                if plane is not None and newest is not None \
+                        and newest.payload is not None \
+                        and _is_dev_arr(newest.payload):
+                    # device data plane attached and the newest version
+                    # lives on device: ship the device buffer itself —
+                    # the consumer pulls it device-to-device, no D2H
+                    flow_payloads[out_idx] = newest.payload
+                    flow_dtts[out_idx] = newest.dtt
+                elif copy.data is not None:
                     host = copy.data.sync_to_host(es.context.devices)
                     flow_payloads[out_idx] = np.asarray(host.payload)
+                    flow_dtts[out_idx] = host.dtt
                 else:
                     flow_payloads[out_idx] = np.asarray(copy.payload)
-                flow_dtts[out_idx] = copy.dtt  # rides the wire: a
-                # matching consumer type must not reconvert
+                    flow_dtts[out_idx] = copy.dtt  # rides the wire: a
+                    # matching consumer type must not reconvert
 
         self._iterate_successors(es, task, activate)
         if remote_edges:

@@ -85,8 +85,12 @@ def run_dtd(ctx, eng, rank, nb_ranks, hops):
     return None
 
 
-def run_dposv(ctx, eng, rank, nb_ranks, n=96, nb=32, nrhs=16):
-    """Distributed Cholesky solve across real processes."""
+def run_dposv(ctx, eng, rank, nb_ranks, n=96, nb=32, nrhs=16,
+              device=False):
+    """Distributed Cholesky solve across real processes. With
+    ``device`` the accelerator chores run (jax device arrays as tile
+    payloads), so cross-rank edges take the device-to-device transfer
+    plane when one is attached — read results via sync_to_host."""
     from parsec_tpu.ops import dposv, make_spd
 
     M = make_spd(n)
@@ -108,9 +112,14 @@ def run_dposv(ctx, eng, rank, nb_ranks, n=96, nb=32, nrhs=16):
     ref = np.linalg.solve(M.astype(np.float64), Bm.astype(np.float64))
     err = 0.0
     for (i, j) in B.local_tiles():
+        if device:
+            tile = np.asarray(
+                B.data_of(i, j).sync_to_host(ctx.devices).payload)
+        else:
+            tile = B.tile(i, j)
         err = max(err, float(np.abs(
-            B.tile(i, j) - ref[i * nb:(i + 1) * nb,
-                               j * nb:(j + 1) * nb]).max()))
+            tile - ref[i * nb:(i + 1) * nb,
+                       j * nb:(j + 1) * nb]).max()))
     return err
 
 
@@ -163,18 +172,30 @@ def main() -> int:
         parsec_tpu.params.set_cmdline("comm_failure_strict", "1")
 
     eng = TCPCommEngine(rank, [("127.0.0.1", p) for p in ports])
+    plane = None
+    if mode == "dposv_xfer":
+        # device data plane: TCP stays control, tile payloads move
+        # device-to-device through the transfer server (comm/xfer.py)
+        from parsec_tpu.comm import DeviceDataPlane
+        plane = DeviceDataPlane(eng)
+        plane.exchange()
     rdep = RemoteDepEngine(eng)
-    ctx = parsec_tpu.Context(nb_cores=2, comm=rdep, enable_tpu=False)
+    ctx = parsec_tpu.Context(nb_cores=2, comm=rdep,
+                             enable_tpu=(mode == "dposv_xfer"))
     try:
         if mode == "fail":
             out = run_fail(ctx, eng, rank, nb_ranks, hops)
             print(json.dumps(out), flush=True)
             return 0 if out.get("detected") else 7
-        if mode == "dposv":
-            err = run_dposv(ctx, eng, rank, nb_ranks)
+        if mode in ("dposv", "dposv_xfer"):
+            err = run_dposv(ctx, eng, rank, nb_ranks,
+                            device=(mode == "dposv_xfer"))
             eng.sync()
-            print(json.dumps({"rank": rank, "max_err": err,
-                              "msgs": eng.fabric.msg_count}), flush=True)
+            out = {"rank": rank, "max_err": err,
+                   "msgs": eng.fabric.msg_count}
+            if plane is not None:
+                out["xfer"] = plane.stats
+            print(json.dumps(out), flush=True)
             return 0
         if mode == "dtd":
             final = run_dtd(ctx, eng, rank, nb_ranks, hops)
