@@ -693,7 +693,6 @@ def unpack_args(task: Task) -> List[Any]:
             else:
                 # bodies mutate in place; wire arrivals may be read-only
                 # zero-copy views — materialize copies on first write
-                from ...data.data import Data
                 out.append(Data.materialize_host(host))
         else:
             out.append(p.value)

@@ -1,0 +1,118 @@
+"""Hardware performance counters via perf_event_open — the PAPI analog.
+
+Reference behavior: parsec/mca/pins/papi/ attaches PAPI event sets per
+execution stream and samples them at task begin/end into the trace.
+PAPI isn't available here; Linux's ``perf_event_open(2)`` gives the same
+PMU access with no dependency: one fd per (thread, event), counting
+user-space cycles/instructions/cache-misses, read as 8-byte values.
+
+Availability is environment-dependent (``kernel.perf_event_paranoid``,
+seccomp in containers, PMU virtualization): ``PerfCounterSet.open``
+raises OSError when the kernel refuses, and the PINS module disables
+itself gracefully — exactly like the reference builds without PAPI.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import threading
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["PERF_EVENTS", "PerfCounterSet", "perf_available"]
+
+_NR_PERF_EVENT_OPEN = {"x86_64": 298, "aarch64": 241}.get(os.uname().machine)
+
+PERF_TYPE_HARDWARE = 0
+# config values for PERF_TYPE_HARDWARE (linux/perf_event.h)
+PERF_EVENTS: Dict[str, int] = {
+    "cycles": 0,
+    "instructions": 1,
+    "cache_references": 2,
+    "cache_misses": 3,
+    "branches": 4,
+    "branch_misses": 5,
+}
+
+# perf_event_attr flag bits (low qword after read_format)
+_FLAG_DISABLED = 1 << 0
+_FLAG_EXCLUDE_KERNEL = 1 << 5
+_FLAG_EXCLUDE_HV = 1 << 6
+
+_ATTR_SIZE = 128  # PERF_ATTR_SIZE_VER ≥ 5; kernel accepts zero-padded
+
+
+def _attr_bytes(config: int) -> bytes:
+    """A minimal perf_event_attr: hardware event, counting mode,
+    user-space only, starts enabled."""
+    buf = bytearray(_ATTR_SIZE)
+    struct.pack_into("<IIQ", buf, 0, PERF_TYPE_HARDWARE, _ATTR_SIZE,
+                     config)
+    # offset 16: sample_period(8) sample_type(8) read_format(8) flags(8)
+    struct.pack_into("<Q", buf, 40, _FLAG_EXCLUDE_KERNEL | _FLAG_EXCLUDE_HV)
+    return bytes(buf)
+
+
+_libc = ctypes.CDLL(None, use_errno=True)
+
+
+def _perf_event_open(attr: bytes, pid: int, cpu: int, group_fd: int,
+                     flags: int) -> int:
+    if _NR_PERF_EVENT_OPEN is None:
+        raise OSError("perf_event_open: unsupported architecture")
+    buf = ctypes.create_string_buffer(attr, len(attr))
+    fd = _libc.syscall(_NR_PERF_EVENT_OPEN, buf, pid, cpu, group_fd,
+                       flags)
+    if fd < 0:
+        err = ctypes.get_errno()
+        raise OSError(err, f"perf_event_open failed: {os.strerror(err)}")
+    return fd
+
+
+class PerfCounterSet:
+    """Counters for the CALLING thread (pid=0/tid semantics: counts this
+    thread wherever it runs). read() returns current values; deltas are
+    the caller's business."""
+
+    def __init__(self, fds: List[int], names: List[str]) -> None:
+        self._fds = fds
+        self.names = names
+
+    @classmethod
+    def open(cls, events: List[str]) -> "PerfCounterSet":
+        fds: List[int] = []
+        try:
+            for name in events:
+                fds.append(_perf_event_open(
+                    _attr_bytes(PERF_EVENTS[name]), 0, -1, -1, 0))
+        except OSError:
+            for fd in fds:
+                os.close(fd)
+            raise
+        return cls(fds, list(events))
+
+    def read(self) -> Tuple[int, ...]:
+        return tuple(struct.unpack("<Q", os.read(fd, 8))[0]
+                     for fd in self._fds)
+
+    def close(self) -> None:
+        for fd in self._fds:
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+        self._fds = []
+
+    def __del__(self) -> None:  # fd hygiene for dropped sets
+        self.close()
+
+
+def perf_available(events: Optional[List[str]] = None) -> bool:
+    """Can this environment open the given (default: instructions)
+    hardware counters?"""
+    try:
+        s = PerfCounterSet.open(events or ["instructions"])
+    except OSError:
+        return False
+    s.close()
+    return True

@@ -243,3 +243,69 @@ class TaskTimeModule(PinsModule):
             self.wall_ns[name] = self.wall_ns.get(name, 0) + dw
             self.cpu_ns[name] = self.cpu_ns.get(name, 0) + dc
             self.count[name] = self.count.get(name, 0) + 1
+
+
+class HWCountersModule(PinsModule):
+    """Hardware counters per task via perf_event_open — the pins/papi
+    analog (ref: parsec/mca/pins/papi/). One counter set per worker
+    thread (opened lazily on that thread, like PAPI's per-ES event
+    sets); EXEC begin/end deltas accumulate per task class.
+
+    ``available`` is False when the kernel refuses PMU access
+    (perf_event_paranoid, container seccomp) — enable() then no-ops,
+    matching a reference build without PAPI."""
+
+    name = "hw_counters"
+    events = [PinsEvent.EXEC_BEGIN, PinsEvent.EXEC_END]
+    DEFAULT_EVENTS = ["instructions", "cycles", "cache_misses"]
+
+    def __init__(self, counter_names: Any = None) -> None:
+        from .perfctr import perf_available
+        self.counter_names = list(counter_names or self.DEFAULT_EVENTS)
+        self.available = perf_available(self.counter_names)
+        self._tls = threading.local()
+        self.totals: Dict[str, Dict[str, int]] = {}
+        self.count: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def enable(self) -> None:
+        if not self.available:
+            from ..utils import logging as _plog
+            _plog.debug.verbose(
+                1, "hw_counters: perf_event_open unavailable; disabled")
+            return
+        super().enable()
+
+    def _set(self):
+        s = getattr(self._tls, "set", None)
+        if s is None:
+            from .perfctr import PerfCounterSet
+            s = self._tls.set = PerfCounterSet.open(self.counter_names)
+        return s
+
+    def callback(self, es: Any, event: PinsEvent, payload: Any) -> None:
+        s = self._set()
+        if event == PinsEvent.EXEC_BEGIN:
+            self._tls.begin = s.read()
+            return
+        begin = getattr(self._tls, "begin", None)
+        if begin is None or payload is None:
+            return
+        self._tls.begin = None
+        end = s.read()
+        name = payload.task_class.name
+        with self._lock:
+            tot = self.totals.setdefault(
+                name, {k: 0 for k in self.counter_names})
+            for k, b, e in zip(self.counter_names, begin, end):
+                tot[k] += e - b
+            self.count[name] = self.count.get(name, 0) + 1
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        """Per-class mean counter values (e.g. instructions/task)."""
+        out: Dict[str, Dict[str, float]] = {}
+        with self._lock:
+            for name, tot in self.totals.items():
+                n = max(1, self.count.get(name, 0))
+                out[name] = {k: v / n for k, v in tot.items()}
+        return out

@@ -1,0 +1,161 @@
+"""Host topology discovery — the hwloc analog over Linux sysfs.
+
+Reference behavior: parsec_hwloc.c builds an hwloc tree (machine >
+package > NUMA > L3 > L2 > core > PU) that the schedulers consult for
+locality-aware stealing (lfq's NUMA-neighbor steal chain,
+parsec/mca/sched/lfq/sched_lfq_module.c:59-199; lhq's hwloc-level
+hierarchy). This module reads the same facts from
+``/sys/devices/system/cpu`` and ``/sys/devices/system/node`` without an
+hwloc dependency: SMT siblings, L2/L3 sharing domains, NUMA nodes and
+packages, reduced to an integer distance and a locality-sorted steal
+order.
+
+Distances (smaller = closer):
+  0 same PU | 1 SMT sibling (same core) | 2 shares L2 | 3 shares L3 |
+  4 same NUMA node | 5 same package | 6 same machine
+"""
+from __future__ import annotations
+
+import glob
+import os
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional
+
+__all__ = ["CPUInfo", "HostTopology", "parse_cpulist"]
+
+
+def parse_cpulist(text: str) -> List[int]:
+    """'0-3,8,10-11' -> [0,1,2,3,8,10,11] (sysfs cpulist format)."""
+    out: List[int] = []
+    for part in text.strip().split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "-" in part:
+            lo, hi = part.split("-", 1)
+            out.extend(range(int(lo), int(hi) + 1))
+        else:
+            out.append(int(part))
+    return out
+
+
+def _read(path: str) -> Optional[str]:
+    try:
+        with open(path) as fh:
+            return fh.read().strip()
+    except OSError:
+        return None
+
+
+@dataclass(frozen=True)
+class CPUInfo:
+    """One logical PU. Group ids are arbitrary but equal iff shared;
+    -1 = unknown (treated as its own singleton group)."""
+    cpu: int
+    core: int = -1        # (package, core_id) collapsed to a group id
+    l2: int = -1
+    l3: int = -1
+    numa: int = -1
+    package: int = -1
+
+
+class HostTopology:
+    """Locality oracle over a set of CPUInfo records."""
+
+    def __init__(self, cpus: Dict[int, CPUInfo]) -> None:
+        self.cpus = dict(cpus)
+
+    # ------------------------------------------------------------------ #
+    @classmethod
+    def discover(cls, cpu_root: str = "/sys/devices/system/cpu",
+                 node_root: str = "/sys/devices/system/node"
+                 ) -> "HostTopology":
+        cpus: Dict[int, CPUInfo] = {}
+        numa_of: Dict[int, int] = {}
+        for npath in sorted(glob.glob(os.path.join(node_root, "node[0-9]*"))):
+            nid = int(os.path.basename(npath)[4:])
+            lst = _read(os.path.join(npath, "cpulist"))
+            if lst:
+                for c in parse_cpulist(lst):
+                    numa_of[c] = nid
+        for cpath in sorted(glob.glob(os.path.join(cpu_root, "cpu[0-9]*"))):
+            try:
+                cpu = int(os.path.basename(cpath)[3:])
+            except ValueError:
+                continue
+            topo = os.path.join(cpath, "topology")
+            pkg = _read(os.path.join(topo, "physical_package_id"))
+            core_id = _read(os.path.join(topo, "core_id"))
+            package = int(pkg) if pkg is not None else -1
+            # core group: same (package, core_id) == SMT siblings
+            core = (package << 16) | int(core_id) \
+                if core_id is not None and package >= 0 else -1
+            l2 = l3 = -1
+            for idx in sorted(glob.glob(os.path.join(cpath, "cache",
+                                                     "index[0-9]*"))):
+                lvl = _read(os.path.join(idx, "level"))
+                typ = _read(os.path.join(idx, "type")) or ""
+                shared = _read(os.path.join(idx, "shared_cpu_list"))
+                if lvl is None or shared is None or typ == "Instruction":
+                    continue
+                group = min(parse_cpulist(shared), default=-1)
+                if lvl == "2":
+                    l2 = group
+                elif lvl == "3":
+                    l3 = group
+            cpus[cpu] = CPUInfo(cpu=cpu, core=core, l2=l2, l3=l3,
+                                numa=numa_of.get(cpu, -1), package=package)
+        if not cpus:  # sysfs unavailable: flat machine
+            n = os.cpu_count() or 1
+            cpus = {c: CPUInfo(cpu=c) for c in range(n)}
+        return cls(cpus)
+
+    # ------------------------------------------------------------------ #
+    def distance(self, a: int, b: int) -> int:
+        if a == b:
+            return 0
+        ia = self.cpus.get(a)
+        ib = self.cpus.get(b)
+        if ia is None or ib is None:
+            return 6
+        if ia.core != -1 and ia.core == ib.core:
+            return 1
+        if ia.l2 != -1 and ia.l2 == ib.l2:
+            return 2
+        if ia.l3 != -1 and ia.l3 == ib.l3:
+            return 3
+        if ia.numa != -1 and ia.numa == ib.numa:
+            return 4
+        if ia.package != -1 and ia.package == ib.package:
+            return 5
+        return 6
+
+    def steal_order(self, cpu: int,
+                    candidates: Iterable[int]) -> List[int]:
+        """Candidates sorted nearest-first (stable by id within a
+        distance level) — lfq's NUMA-neighbor chain generalized."""
+        return sorted((c for c in candidates if c != cpu),
+                      key=lambda c: (self.distance(cpu, c), c))
+
+    def group_of(self, cpu: int, level: str = "l3") -> int:
+        """The sharing-domain id of ``cpu`` at ``level`` (l2|l3|numa|
+        package); unknown -> the cpu's own id (singleton group)."""
+        info = self.cpus.get(cpu)
+        if info is None:
+            return cpu
+        val = getattr(info, level, -1)
+        return val if val != -1 else cpu
+
+    def levels_of(self, cpu: int) -> Dict[str, int]:
+        return {lvl: self.group_of(cpu, lvl)
+                for lvl in ("core", "l2", "l3", "numa", "package")}
+
+
+_cached: Optional[HostTopology] = None
+
+
+def host_topology() -> HostTopology:
+    global _cached
+    if _cached is None:
+        _cached = HostTopology.discover()
+    return _cached

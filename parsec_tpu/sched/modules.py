@@ -21,7 +21,7 @@ policies actually encode.
 """
 from __future__ import annotations
 
-from typing import Any, List, Optional
+from typing import Any, Dict, List, Optional
 
 from ..core.hbbuffer import HBBuffer
 from ..core.lists import Dequeue, Lifo, OrderedList
@@ -30,6 +30,40 @@ from .base import SchedulerModule
 
 def _prio(t) -> int:
     return t.priority
+
+
+def _es_core(es) -> Optional[int]:
+    """The core this ES is (deterministically) bound to, or None when
+    thread binding is off — locality-aware policies then fall back to
+    their id-order behavior."""
+    override = getattr(es.context, "_topo_binding_override", None)
+    if override is not None:
+        return override.get(es.th_id)
+    from ..runtime.vpmap import binding_for
+    return binding_for(es.th_id, es.context.nb_cores)
+
+
+def _es_topology(es):
+    override = getattr(es.context, "_topology_override", None)
+    if override is not None:
+        return override
+    from ..runtime.topology import host_topology
+    return host_topology()
+
+
+def _locality_steal_order(self_es, peers: List) -> List:
+    """Peers sorted nearest-first by the host topology (the lfq
+    NUMA-neighbor chain, sched_lfq_module.c:59-199); falls back to the
+    id ring when threads are unbound."""
+    my_core = _es_core(self_es)
+    if my_core is None:
+        return peers
+    cores = {p: _es_core(p) for p in peers}
+    if any(c is None for c in cores.values()):
+        return peers
+    topo = _es_topology(self_es)
+    return sorted(peers, key=lambda p: (topo.distance(my_core, cores[p]),
+                                        p.th_id))
 
 
 class LFQScheduler(SchedulerModule):
@@ -53,15 +87,25 @@ class LFQScheduler(SchedulerModule):
         else:
             es.sched_obj.push_all(tasks, distance)
 
+    def steal_chain(self, es) -> List:
+        """Per-ES steal order: locality-sorted when threads are bound
+        (the NUMA-neighbor chain), else the vp-local ring. Cached."""
+        chain = getattr(es, "_steal_chain", None)
+        if chain is None:
+            vp = es.virtual_process
+            n = len(vp.execution_streams)
+            ring = [vp.execution_streams[(es.vp_local_id + k) % n]
+                    for k in range(1, n)]
+            chain = es._steal_chain = _locality_steal_order(es, ring)
+        return chain
+
     def select(self, es) -> Optional[Any]:
         t = es.sched_obj.pop_best()
         if t is not None:
             return t
-        # steal ring within the VP, then the system queue
-        vp = es.virtual_process
-        n = len(vp.execution_streams)
-        for k in range(1, n):
-            peer = vp.execution_streams[(es.vp_local_id + k) % n]
+        # steal chain within the VP (locality-ordered when bound), then
+        # the system queue
+        for peer in self.steal_chain(es):
             if peer.sched_obj is not None:
                 t = peer.sched_obj.pop_best()
                 if t is not None:
@@ -77,59 +121,114 @@ class LFQScheduler(SchedulerModule):
 
 
 class LHQScheduler(LFQScheduler):
-    """Local hierarchical queues: thread buffer → VP buffer → system."""
+    """Local hierarchical queues: thread buffer → locality-domain queue
+    → system. With bound threads the middle level is the host topology's
+    L3 sharing domain (the reference's hwloc-level hierarchy,
+    sched_lhq_module); unbound threads group by VP (the portable
+    fallback)."""
 
     name = "lhq"
+    GROUP_LEVEL = "l3"
 
     def install(self, context) -> None:
         super().install(context)
-        self._vp_queues = {vp.vp_id: Dequeue() for vp in context.vps}
+        self._group_queues: Dict[Any, Dequeue] = {}
+        self._group_core: Dict[Any, int] = {}  # representative core
+
+    def _group_id(self, es):
+        core = _es_core(es)
+        if core is None:
+            return ("vp", es.vp_id)
+        topo = _es_topology(es)
+        gid = ("topo", topo.group_of(core, self.GROUP_LEVEL))
+        self._group_core.setdefault(gid, core)
+        return gid
 
     def flow_init(self, es) -> None:
-        vpq = self._vp_queues[es.vp_id]
+        gid = self._group_id(es)
+        q = self._group_queues.setdefault(gid, Dequeue())
+        es._lhq_gid = gid
 
         def spill(items, distance):
             if distance <= 1:
-                vpq.push_back_chain(items)
+                q.push_back_chain(items)
             else:
                 self.system_queue.push_back_chain(items)
         es.sched_obj = HBBuffer(self.BUFSIZE, spill)
 
+    def _foreign_group_order(self, es) -> List:
+        """Other domains' queues, nearest domain first when bound."""
+        order = getattr(es, "_lhq_order", None)
+        if order is None:
+            mine = es._lhq_gid
+            others = [g for g in self._group_queues if g != mine]
+            core = _es_core(es)
+            if core is not None and all(g in self._group_core
+                                        for g in others):
+                topo = _es_topology(es)
+                others.sort(key=lambda g: (
+                    topo.distance(core, self._group_core[g]), str(g)))
+            order = es._lhq_order = [self._group_queues[g] for g in others]
+        return order
+
     def select(self, es) -> Optional[Any]:
         t = es.sched_obj.pop_best()
         if t is not None:
             return t
-        t = self._vp_queues[es.vp_id].pop_front()
+        t = self._group_queues[es._lhq_gid].pop_front()
         if t is not None:
             return t
-        for vp_id, q in self._vp_queues.items():
-            if vp_id != es.vp_id:
-                t = q.pop_front()
-                if t is not None:
-                    return t
+        for q in self._foreign_group_order(es):
+            t = q.pop_front()
+            if t is not None:
+                return t
         return self.system_queue.pop_front()
 
 
 class LTQScheduler(LFQScheduler):
-    """Local tree queues: steal order follows a binary tree of thread ids."""
+    """Local tree queues: steal order follows a binary tree walk. With
+    bound threads the tree is laid over the LOCALITY-sorted peer list
+    (the reference builds it from the hwloc tree), so children are the
+    nearest peers; unbound, it is the thread-id tree."""
 
     name = "ltq"
+
+    def _tree_order(self, es) -> List:
+        order = getattr(es, "_ltq_order", None)
+        if order is not None:
+            return order
+        vp = es.virtual_process
+        n = len(vp.execution_streams)
+        if _es_core(es) is None:
+            # unbound: binary tree of thread ids — children (2i+1, 2i+2),
+            # then parent, then the rest
+            base = es.vp_local_id
+            ids = []
+            for c in (2 * base + 1, 2 * base + 2,
+                      (base - 1) // 2 if base else None):
+                if c is not None and 0 <= c < n and c != base:
+                    ids.append(c)
+            ids += [k for k in range(n) if k != base and k not in ids]
+            out = [vp.execution_streams[k] for k in ids]
+        else:
+            peers = [vp.execution_streams[k] for k in range(n)
+                     if k != es.vp_local_id]
+            ranked = _locality_steal_order(es, peers)
+            # tree laid over [self] + ranked: children (positions 1, 2)
+            # are the nearest peers, then the remaining nearest-first
+            out = []
+            for c in (1, 2):
+                if c <= len(ranked):
+                    out.append(ranked[c - 1])
+            out += [p for p in ranked if p not in out]
+        es._ltq_order = out
+        return out
 
     def select(self, es) -> Optional[Any]:
         t = es.sched_obj.pop_best()
         if t is not None:
             return t
-        vp = es.virtual_process
-        n = len(vp.execution_streams)
-        order = []
-        # walk: children first (2i+1, 2i+2), then parent, then the rest
-        base = es.vp_local_id
-        for c in (2 * base + 1, 2 * base + 2, (base - 1) // 2 if base else None):
-            if c is not None and 0 <= c < n and c != base:
-                order.append(c)
-        order += [k for k in range(n) if k != base and k not in order]
-        for k in order:
-            peer = vp.execution_streams[k]
+        for peer in self._tree_order(es):
             if peer.sched_obj is not None:
                 t = peer.sched_obj.pop_best()
                 if t is not None:
