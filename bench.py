@@ -11,10 +11,11 @@ sustains ~80% of the A100's 19.5 TFLOP/s FP64-TC peak), making the target
 
 Execution modes (BENCH_MODE):
 
-- ``all`` (default): the honest composite — the capture headline number
-  plus extras {chip_gemm microbench, wave@NB=512, runtime@NB=512} in the
-  same json line, so tunnel anomalies are normalizable and the
-  engineering numbers ride along (round-1 VERDICT item 10).
+- ``all`` (default): the honest composite — runs {capture, wave@NB=512,
+  runtime@NB=512, chip_gemm microbench}, emits the headline from the
+  BEST numerics-passing mode, keeps every mode in extras, and flags
+  ``tunnel_degraded`` when the bare-chip GEMM rate and the headline
+  disagree by >10x (round-2 VERDICT item 2).
 - ``capture``: the PTG DAG compiled into ONE XLA executable via graph
   capture (dsl/ptg/capture.py) — single dispatch, zero host loop in the
   timed region, MXU-bound.
@@ -61,14 +62,14 @@ def check_numerics(L_np, M, n):
     return float(np.abs(L @ (L.T @ X) - ref).max() / np.abs(ref).max())
 
 
-def emit(n, nb, dtype, mode, best, err, extras=None):
-    if err > 5e-2:
-        print(json.dumps({"metric": "dpotrf_gflops", "value": 0.0,
-                          "unit": "GFLOP/s", "vs_baseline": 0.0,
-                          "error": f"numerics failed: {err}"}))
-        return
-    flops = n ** 3 / 3.0 + n ** 2 / 2.0
-    gflops = flops / best / 1e9
+NUMERICS_TOL = 5e-2
+
+
+def dpotrf_flops(n):
+    return n ** 3 / 3.0 + n ** 2 / 2.0
+
+
+def emit_line(n, nb, dtype, mode, gflops, extras=None):
     line = {
         "metric": f"dpotrf_gflops(N={n},NB={nb},{dtype.name},1chip,{mode})",
         "value": round(gflops, 2),
@@ -78,6 +79,15 @@ def emit(n, nb, dtype, mode, best, err, extras=None):
     if extras:
         line["extras"] = extras
     print(json.dumps(line))
+
+
+def emit(n, nb, dtype, mode, best, err, extras=None):
+    if err > NUMERICS_TOL:
+        print(json.dumps({"metric": "dpotrf_gflops", "value": 0.0,
+                          "unit": "GFLOP/s", "vs_baseline": 0.0,
+                          "error": f"numerics failed: {err}"}))
+        return
+    emit_line(n, nb, dtype, mode, dpotrf_flops(n) / best / 1e9, extras)
 
 
 def bench_capture(n, nb, reps, dtype):
@@ -209,43 +219,79 @@ def bench_chip_gemm(reps=10, n=2048):
 
 
 def bench_all(n, nb, reps, cores, dtype):
-    """The honest composite: the headline capture number PLUS the
-    engineering numbers the VERDICT asked to carry — wave and per-task
-    runtime at the north-star NB=512, and a bare-chip GEMM microbench —
-    in ONE json line (extras field)."""
+    """The honest composite: run every engineering mode {capture, wave@512,
+    runtime@512} plus the bare-chip GEMM microbench, carry them ALL in
+    extras, and emit the headline from the BEST numerics-passing mode.
+
+    Rationale (round-2 VERDICT item 2): the headline used to be hardwired
+    to capture, and a session where the tunnel's per-call latency was
+    ~1.4 ms sank the small capture graph to 0.26x baseline while the SAME
+    run's wave mode did 2.2x. The gate field must be robust to the
+    environment it is defined to survive, so the best valid mode speaks
+    for the framework and the rest ride along. ``tunnel_degraded`` is set
+    when the bare-chip GEMM rate and the headline disagree by >10x —
+    the signal that the tunnel, not the framework, shaped the number.
+    """
     extras = {}
+    candidates = []   # (mode_label, n_used, nb_used, gflops)
 
     def _try(label, fn):
-        try:
-            return fn()
-        except Exception as exc:  # noqa: BLE001 - carry, don't die
-            extras[label + "_error"] = f"{type(exc).__name__}: {exc}"[:200]
-            return None
+        # one retry: the tunnel relay can transiently ABORT a batch of
+        # calls (observed 2026-07-30: every mode after chip_gemm died
+        # once, the immediate rerun passed end to end) — and the driver
+        # runs this file exactly once per round
+        errors = []
+        for attempt in (1, 2):
+            if attempt > 1:
+                time.sleep(5.0)
+            try:
+                return fn()
+            except Exception as exc:  # noqa: BLE001 - carry, don't die
+                errors.append(
+                    f"attempt{attempt}: {type(exc).__name__}: {exc}"[:200])
+                extras[label + "_error"] = "; ".join(errors)
+        return None
+
+    def _record(mode, n_used, nb_used, r):
+        if r is None:
+            return
+        best, err = r
+        key = f"{mode}_gflops(N={n_used},NB={nb_used})"
+        if err < NUMERICS_TOL:
+            gf = dpotrf_flops(n_used) / best / 1e9
+            extras[key] = round(gf, 2)
+            candidates.append((mode, n_used, nb_used, gf))
+        else:
+            extras[key] = f"numerics failed: {err}"
 
     g = _try("chip_gemm", bench_chip_gemm)
     if g is not None:
         extras["chip_gemm_gflops(2048^3,f32)"] = round(g, 1)
 
-    r = _try("wave512", lambda: bench_wave(n, 512, reps, dtype))
-    if r is not None:
-        best, err = r
-        flops = n ** 3 / 3.0 + n ** 2 / 2.0
-        extras["wave_gflops(NB=512)"] = (
-            round(flops / best / 1e9, 2) if err < 5e-2 else
-            f"numerics failed: {err}")
-
+    _record("wave", n, 512,
+            _try("wave512", lambda: bench_wave(n, 512, reps, dtype)))
     n_rt = int(os.environ.get("BENCH_RUNTIME_N", "4096"))
-    r = _try("runtime512",
-             lambda: bench_runtime(n_rt, 512, max(2, reps), cores, dtype))
-    if r is not None:
-        best, err = r
-        flops = n_rt ** 3 / 3.0 + n_rt ** 2 / 2.0
-        extras[f"runtime_gflops(N={n_rt},NB=512)"] = (
-            round(flops / best / 1e9, 2) if err < 5e-2 else
-            f"numerics failed: {err}")
+    _record("runtime", n_rt, 512,
+            _try("runtime512",
+                 lambda: bench_runtime(n_rt, 512, max(2, reps), cores, dtype)))
+    _record("capture", n, nb,
+            _try("capture", lambda: bench_capture(n, nb, reps, dtype)))
 
-    best, err = bench_capture(n, nb, reps, dtype)
-    emit(n, nb, dtype, "capture", best, err, extras=extras)
+    if not candidates:
+        print(json.dumps({"metric": "dpotrf_gflops", "value": 0.0,
+                          "unit": "GFLOP/s", "vs_baseline": 0.0,
+                          "error": "no mode passed numerics",
+                          "extras": extras}))
+        return
+    mode, n_used, nb_used, gf = max(candidates, key=lambda c: c[3])
+    # tunnel_degraded compares chip_gemm against the XLA-path modes
+    # (capture/wave) only: the per-task runtime mode is Python-dispatch
+    # bound by design, so a >10x gap to bare GEMM is its NORMAL state,
+    # not a tunnel signal
+    xla_gfs = [c[3] for c in candidates if c[0] in ("capture", "wave")]
+    if g is not None and (not xla_gfs or g > 10 * max(xla_gfs)):
+        extras["tunnel_degraded"] = True
+    emit_line(n_used, nb_used, dtype, mode, gf, extras)
 
 
 def main() -> None:
