@@ -238,13 +238,30 @@ class RemoteDepEngine:
                     "has none attached (attach a DeviceDataPlane on "
                     "every rank)")
             uuid = xf["uuids"][self.rank]
-            arr = plane.pull(xf["src"], uuid, tuple(xf["shape"]),
-                             xf["dtype"])
-            # the pull materializes ASYNCHRONOUSLY; the ACK releases the
-            # producer's parked buffer and lets its taskpool retire, so
-            # it must not fire until the bytes actually landed
-            import jax
-            jax.block_until_ready(arr)
+            try:
+                arr = plane.pull(xf["src"], uuid, tuple(xf["shape"]),
+                                 xf["dtype"])
+                # the pull materializes ASYNCHRONOUSLY; the ACK releases
+                # the producer's parked buffer and lets its taskpool
+                # retire, so it must not fire until the bytes landed
+                import jax
+                jax.block_until_ready(arr)
+            except Exception as exc:  # noqa: BLE001
+                # a failed pull must still retire the producer's pending
+                # action (else its wait() hangs with nothing surfaced);
+                # the failure ACK releases the park, then this rank
+                # aborts its own DAG cleanly
+                try:
+                    self.ce.send_am(
+                        xf["src"], TAG_XFER_ACK,
+                        {"uuid": uuid,
+                         "failed": f"{type(exc).__name__}: {exc}"[:300]})
+                except Exception:  # peer already gone: failure path anyway
+                    pass
+                if self.context is not None:
+                    self.context.record_task_error(exc)
+                    return
+                raise
             self.ce.send_am(xf["src"], TAG_XFER_ACK, {"uuid": uuid})
             self._deliver_activation(tp, my_edges, arr, msg.get("dtt"))
             return
@@ -284,9 +301,14 @@ class RemoteDepEngine:
     # GET service accounting: the local fabric serves GETs inside
     # ce.progress; pending handles release when everyone fetched
     def _on_xfer_ack(self, src: int, payload: Dict) -> None:
-        """A consumer's device-to-device pull completed: drop the parked
-        producer buffer and retire the pending action."""
+        """A consumer's device-to-device pull completed (or failed —
+        either way the park is dropped and the pending action retires,
+        so the producer's wait() cannot hang on a sick consumer)."""
         uuid = payload["uuid"]
+        if "failed" in payload:
+            plog.warning("rank %d: device-plane pull of uuid %d failed at "
+                         "consumer rank %d: %s", self.rank, uuid, src,
+                         payload["failed"])
         with self._lock:
             tp = self._pending_xfers.pop(uuid, None)
         plane = getattr(self.ce, "device_plane", None)

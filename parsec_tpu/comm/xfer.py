@@ -115,16 +115,27 @@ class DeviceDataPlane:
         import jax
         from jax.sharding import SingleDeviceSharding
 
-        with self._lock:  # pull runs from any worker draining activations
-            conn = self._conns.get(src_rank)
-            if conn is None:
+        # connect() blocks on the network: holding self._lock across it
+        # would wedge register()/release() — including the ACK path that
+        # frees producer parks — behind a slow or dead peer. Double-checked
+        # insert instead (a raced duplicate connection is dropped).
+        conn = self._conns.get(src_rank)
+        if conn is None:
+            with self._lock:
                 addr = self.addresses.get(src_rank)
-                if addr is None:
-                    raise RuntimeError(
-                        f"no transfer address for rank {src_rank} "
-                        f"(exchange() not run?)")
-                conn = self.server.connect(addr)
-                self._conns[src_rank] = conn
+            if addr is None:
+                raise RuntimeError(
+                    f"no transfer address for rank {src_rank} "
+                    f"(exchange() not run?)")
+            new_conn = self.server.connect(addr)
+            with self._lock:
+                conn = self._conns.setdefault(src_rank, new_conn)
+            if conn is not new_conn:
+                # lost the race: close the duplicate if the transfer API
+                # exposes close (it may not — then the object just drops)
+                closer = getattr(new_conn, "close", None)
+                if callable(closer):
+                    closer()
         spec = jax.ShapeDtypeStruct(
             shape, np.dtype(dtype),
             sharding=SingleDeviceSharding(self.device))

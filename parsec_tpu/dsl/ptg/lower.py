@@ -94,7 +94,15 @@ def _signature(tp) -> Optional[Tuple]:
         if isinstance(v, (int, float, str, bool, np.integer, np.floating)):
             parts.append((g.name, v))
         elif isinstance(v, DataCollection):
-            parts.append((g.name, type(v).__name__,
+            # tile shape/extent/dtype must be part of the key: guard and
+            # priority expressions may read collection attributes beyond
+            # the coordinate set, so two structurally different
+            # collections with the same tile coords must not alias
+            shape_sig = tuple(
+                getattr(v, a, None) for a in ("mb", "nb", "lm", "ln"))
+            dt = getattr(v, "dtype", None)
+            parts.append((g.name, type(v).__name__, shape_sig,
+                          None if dt is None else np.dtype(dt).str,
                           tuple(sorted(v.tiles())) if hasattr(v, "tiles")
                           else id(v)))
         elif v is None:

@@ -180,7 +180,7 @@ class PTGTaskClass(TaskClass):
             deps_in = f.deps_in()
             if not deps_in:
                 # pure-output flow: write-into-memory target or NEW scratch
-                ref.data_in = self._output_binding(f, env)
+                ref.data_in = self._output_binding(f, env, es)
                 ref.fulfilled = True
                 continue
             bound = False
@@ -196,8 +196,13 @@ class PTGTaskClass(TaskClass):
                     if self._flow_masked_writeback(f, env):
                         # a region-masked [type_data] writeback must see
                         # the destination's OLD out-of-region values —
-                        # the body may not mutate the home buffer
-                        hc = _detached_clone(hc)
+                        # the body may not mutate the home buffer. The
+                        # clone detaches from the Data, so the newest
+                        # version must land on host FIRST (the lazy
+                        # already-home path may have left it on a device;
+                        # a stale snapshot here is silent wrong results)
+                        hc = _detached_clone(
+                            self.tp.pull_newest_to_host(es, data))
                     ref.data_in = hc
                     ref.fulfilled = True
                 elif t.kind == "new":
@@ -307,16 +312,20 @@ class PTGTaskClass(TaskClass):
                 return True
         return False
 
-    def _output_binding(self, f: FlowAST, env: Dict[str, Any]):
+    def _output_binding(self, f: FlowAST, env: Dict[str, Any], es=None):
         """WRITE-only flow: bind to its memory out-target or a NEW buffer."""
         for d in f.deps_out():
             t = d.resolve(env)
             if t is not None and t.kind == "memory":
                 coll = self.tp.global_env[t.collection]
                 args = [a(env) for a in t.args]
-                hc = self.tp.host_copy_of(None, coll.data_of(*args))
+                data = coll.data_of(*args)
+                hc = self.tp.host_copy_of(None, data)
                 if self._flow_masked_writeback(f, env):
-                    hc = _detached_clone(hc)
+                    # detached snapshot: sync the newest version home
+                    # first (see _prepare_input's masked-writeback note)
+                    hc = _detached_clone(
+                        self.tp.pull_newest_to_host(es, data))
                 return hc
         return self.tp.new_scratch_copy(f, env)
 
@@ -839,13 +848,19 @@ class PTGTaskpool(Taskpool):
                     raise RuntimeError(
                         f"{task.snprintf()}: memory writeback of flow "
                         f"{f.name} from a detached device copy")
-                dh = self.host_copy_of(es, dest)
                 src_arr = np.asarray(sh.payload)
                 mask = None
                 if wb_name is not None:
                     dtt = tc.resolve_dtt_name(wb_name, sh, f.name)
                     src_arr = np.asarray(reshape_to(src_arr, dtt))
                     mask = dtt.mask()
+                # a masked writeback preserves the destination's
+                # out-of-region values — those must be the NEWEST ones,
+                # which may live on a device (the lazy already-home path);
+                # an unmasked writeback fully overwrites, so the plain
+                # host copy suffices
+                dh = (self.pull_newest_to_host(es, dest) if mask is not None
+                      else self.host_copy_of(es, dest))
                 if dh.payload is None:
                     dh.payload = np.array(src_arr)
                 elif mask is None:

@@ -278,13 +278,30 @@ class HWCountersModule(PinsModule):
 
     def _set(self):
         s = getattr(self._tls, "set", None)
+        if s is False:       # this thread's open already failed: stay off
+            return None
         if s is None:
             from .perfctr import PerfCounterSet
-            s = self._tls.set = PerfCounterSet.open(self.counter_names)
+            try:
+                s = self._tls.set = PerfCounterSet.open(self.counter_names)
+            except OSError as exc:
+                self._tls.set = False
+                # the init-time availability probe can pass and a
+                # per-thread open still fail (fd exhaustion, thread-scoped
+                # PMU refusal): degrade gracefully — instrumentation must
+                # never take down the task execution path
+                self.available = False
+                from ..utils import logging as _plog
+                _plog.debug.verbose(
+                    1, "hw_counters: per-thread open failed (%s); disabled",
+                    exc)
+                return None
         return s
 
     def callback(self, es: Any, event: PinsEvent, payload: Any) -> None:
         s = self._set()
+        if s is None:
+            return
         if event == PinsEvent.EXEC_BEGIN:
             self._tls.begin = s.read()
             return

@@ -563,3 +563,51 @@ def test_masked_writeback_of_home_bound_flow():
     # body zeroed its (detached) view in place; only the lower region
     # lands in memory — the upper part keeps the ORIGINAL values
     np.testing.assert_array_equal(tile, np.triu(base, 1))
+
+
+# --------------------------------------------------------------------- #
+# the detached clone for a masked writeback must hold the NEWEST tile   #
+# version — a prior device chore may have left it on the accelerator    #
+# (the lazy already-home path); a stale host snapshot is silent wrong   #
+# results (round-2 advisor finding, dsl/ptg/runtime.py masked binding)  #
+# --------------------------------------------------------------------- #
+DEVICE_THEN_MASKED_WB = """
+descA [ type="collection" ]
+out [ type="object" ]
+
+Dev(k)
+k = 0 .. 0
+: descA( 0, 0 )
+RW A <- descA( 0, 0 )
+     -> descA( 0, 0 )
+CTL C -> C WB( 0 )
+BODY [type=tpu]
+{
+    A = A * 3.0
+}
+END
+
+WB(k)
+k = 0 .. 0
+: descA( 0, 0 )
+CTL C <- C Dev( 0 )
+RW A <- descA( 0, 0 )
+     -> descA( 0, 0 )      [type_data=lower]
+BODY
+{
+    A = A + 10.0
+}
+END
+"""
+
+
+def test_masked_writeback_sees_device_resident_newest():
+    base = _base()
+    tile, _, _ = _run_local(DEVICE_THEN_MASKED_WB, "dev_masked")
+    # Dev's chore leaves A*3 newest ON DEVICE (already-home lazy path);
+    # WB's masked binding must pull that version before detaching:
+    # lower gets 3*base+10, the preserved upper region must be 3*base
+    # (NOT the stale pre-device values)
+    expect = np.where(np.tril(np.ones((N, N), bool)),
+                      3.0 * base + 10.0, 3.0 * base)
+    np.testing.assert_array_equal(tile, expect)
