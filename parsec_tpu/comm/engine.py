@@ -56,16 +56,64 @@ class CommEngine:
         # transports invoke this when a message lands in the inbox so a
         # parked worker wakes instead of finishing its backoff sleep
         self.on_arrival: Optional[Callable[[], None]] = None
+        # late-bound tags: a message can land before its handler exists
+        # (e.g. a fast peer's wave exchange reaching a rank that has not
+        # built its runner yet — MPI's posted-recv semantics give this
+        # for free); such messages wait here and replay at registration
+        self._deferred: List[Tuple[int, int, Any]] = []
+        self._deferred_lock = threading.Lock()
+        self._deferred_warned: set = set()
 
     def _notify_arrival(self) -> None:
         cb = self.on_arrival
         if cb is not None:
             cb()
 
+    MAX_DEFERRED = 4096
+
     # -- active messages ----------------------------------------------------
     def tag_register(self, tag: int, cb: Callable[[int, Any], None]) -> None:
         """cb(src_rank, payload) runs during progress() on the receiver."""
-        self._tag_cbs[tag] = cb
+        # handler install and deferred drain are one atomic step against
+        # deliver_message's check-then-defer: without the shared lock a
+        # message checked before the install but deferred after the
+        # drain would strand forever
+        with self._deferred_lock:
+            self._tag_cbs[tag] = cb
+            pending = [m for m in self._deferred if m[1] == tag]
+            if pending:
+                self._deferred = [m for m in self._deferred if m[1] != tag]
+        for src, _tag, payload in pending if pending else ():
+            cb(src, payload)
+
+    def deliver_message(self, src: int, tag: int, payload: Any) -> bool:
+        """Route one drained message to its handler, or hold it if the
+        tag is not bound yet (replayed by tag_register — MPI's
+        posted-recv semantics). Returns True when handled now.
+
+        A tag that never gets a handler is a bug: warn once, and fail
+        loudly if the hold queue grows past MAX_DEFERRED instead of
+        leaking quietly."""
+        with self._deferred_lock:
+            cb = self._tag_cbs.get(tag)
+            if cb is None:
+                if len(self._deferred) >= self.MAX_DEFERRED:
+                    raise RuntimeError(
+                        f"rank {self.rank}: {len(self._deferred)} messages "
+                        f"deferred for unregistered tags (first tags: "
+                        f"{sorted({m[1] for m in self._deferred[:50]})}) — "
+                        f"a handler was never registered")
+                self._deferred.append((src, tag, payload))
+        if cb is None:
+            if tag not in self._deferred_warned:
+                self._deferred_warned.add(tag)
+                from ..utils import logging as plog
+                plog.debug.verbose(
+                    1, "rank %d: deferring message(s) for unregistered "
+                    "tag %d", self.rank, tag)
+            return False
+        cb(src, payload)
+        return True
 
     def tag_unregister(self, tag: int) -> None:
         self._tag_cbs.pop(tag, None)

@@ -145,10 +145,8 @@ class LocalCommEngine(CommEngine):
     def progress(self) -> int:
         n = 0
         for src, tag, payload in self._transport_drain():
-            cb = self._tag_cbs.get(tag)
-            assert cb is not None, f"rank {self.rank}: no handler for tag {tag}"
-            cb(src, payload)
-            n += 1
+            if self.deliver_message(src, tag, payload):
+                n += 1
         return n
 
     def sync(self) -> None:

@@ -88,7 +88,10 @@ def _signature(tp) -> Optional[Tuple]:
     global reduced to a structural signature. Returns None (uncacheable)
     when a global's identity can't be summarized structurally."""
     from ...collections.collection import DataCollection
-    parts: List[Any] = [tp.rank, tp.nb_ranks]
+    # rank is deliberately NOT part of the key: lowering enumerates the
+    # full rank-independent DAG, so SPMD ranks sharing a process share
+    # the cache entry
+    parts: List[Any] = [tp.nb_ranks]
     for g in tp.jdf.globals:
         v = tp.global_env.get(g.name)
         if isinstance(v, (int, float, str, bool, np.integer, np.floating)):
@@ -129,13 +132,20 @@ def _purge_jdf(jid: int) -> None:
             del _cache[k]
 
 
-def lower(tp, use_cache: bool = True) -> LoweredDAG:
+def lower(tp, use_cache: bool = True,
+          allow_multirank: bool = False) -> LoweredDAG:
     """Enumerate ``tp``'s task space and dependence edges into a
-    LoweredDAG. Single-rank only (multi-rank static tracking would need
-    per-rank foreign-edge bookkeeping — the dynamic mode covers it)."""
+    LoweredDAG.
+
+    The enumeration is rank-independent (the FULL task space and edge
+    set — SPMD ranks lowering the same JDF get identical DAGs), but the
+    per-task runtime's static engine integration has no foreign-edge
+    bookkeeping, so it only accepts single-rank pools. Distributed wave
+    execution (wave_dist.py) does its own rank partitioning over the
+    full DAG and passes ``allow_multirank=True``."""
     import weakref
 
-    if tp.nb_ranks != 1:
+    if tp.nb_ranks != 1 and not allow_multirank:
         raise ValueError("static lowering is single-rank; use dynamic "
                          "dep management for multi-rank taskpools")
     key = None

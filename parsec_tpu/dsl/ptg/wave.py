@@ -63,7 +63,8 @@ class _ClassPlan:
     their slots live, and the compiled chunked kernels."""
 
     __slots__ = ("tc", "ast", "flow_idx", "flow_names", "flow_coll",
-                 "written", "range_locals", "body_locals", "code", "kernels")
+                 "written", "reads", "range_locals", "body_locals", "code",
+                 "kernels")
 
     def __init__(self, tc) -> None:
         self.tc = tc
@@ -75,6 +76,9 @@ class _ClassPlan:
         self.flow_coll: List[int] = [-1] * len(self.flow_idx)
         self.written = [bool(tc.flows[i].access & FlowAccess.WRITE)
                         for i in self.flow_idx]
+        # a flow with in-deps reads its slot's current value (RW reads
+        # then writes; WRITE-only flows have no in-deps and may clobber)
+        self.reads = [bool(tc.ast.flows[i].deps_in()) for i in self.flow_idx]
         self.range_locals = [ld.name for ld in tc.ast.locals
                              if ld.range is not None]
         self.code = compile(_pick_body(tc.ast).code,
@@ -92,12 +96,15 @@ class _ClassPlan:
 class WaveRunner:
     """Executor for one single-rank PTG taskpool in wave mode."""
 
+    _multirank = False   # DistWaveRunner (wave_dist.py) overrides
+
     def __init__(self, tp: PTGTaskpool, max_chunk: int = 256) -> None:
-        if tp.nb_ranks != 1:
-            raise WaveError("wave execution is single-rank")
+        if tp.nb_ranks != 1 and not self._multirank:
+            raise WaveError("single-rank wave on a multi-rank taskpool; "
+                            "use wave(tp, comm=...) / DistWaveRunner")
         self.tp = tp
         self.max_chunk = max(1, int(max_chunk))
-        self.dag: LoweredDAG = lower(tp)
+        self.dag: LoweredDAG = lower(tp, allow_multirank=self._multirank)
         from ...collections.collection import DataCollection
         self.collections: Dict[str, Any] = {
             name: c for name, c in tp.global_env.items()
@@ -349,61 +356,71 @@ class WaveRunner:
     # ------------------------------------------------------------------ #
     # execution                                                          #
     # ------------------------------------------------------------------ #
+    def _execute_frontier(self, ids: np.ndarray, classes: np.ndarray,
+                          pools: Tuple) -> Tuple[Tuple, int]:
+        """Execute one ready antichain (or the local slice of one) as
+        batched per-class chunk kernels; returns (pools, n_calls)."""
+        dag = self.dag
+        slot = self._slot
+        n_calls = 0
+        for sub in self._split_war(ids, classes):
+            sids, cls = sub
+            for ci in np.unique(cls):
+                members = sids[cls == ci]
+                p = self.plans[int(ci)]
+                nf = len(p.flow_idx)
+                # (no priority ordering: a wave is an antichain and
+                # every member executes before the next readiness
+                # update — order has no observable effect)
+                # body-referenced locals become static kernel args:
+                # group members by their values (uniform per wave in
+                # the common panel-structured DAGs)
+                groups: Dict[Tuple, List[int]] = {}
+                for t in members:
+                    sv = tuple(int(dag.locals_of[t][i])
+                               for i in p.body_locals)
+                    groups.setdefault(sv, []).append(int(t))
+                for statics, g in groups.items():
+                    garr = np.asarray(g, np.int64)
+                    off = 0
+                    for k in self._chunks(len(garr), self.max_chunk):
+                        chunk = garr[off:off + k]
+                        off += k
+                        lrows = [dag.locals_of[t] for t in chunk]
+                        nl = len(lrows[0])
+                        locs = (np.asarray(lrows, np.int32)
+                                .reshape(k, nl)
+                                if nl else np.zeros((k, 0), np.int32))
+                        idx = slot[chunk, :nf].T.copy()  # [n_flows, k]
+                        try:
+                            pools = self._kernel(int(ci), k, statics)(
+                                pools, locs, idx)
+                        except Exception as exc:
+                            if "Tracer" in type(exc).__name__ or \
+                                    "Concretization" in type(exc).__name__:
+                                raise WaveError(
+                                    f"{p.ast.name}: body cannot be "
+                                    f"batch-traced (it branches on a "
+                                    f"derived local or data value in "
+                                    f"Python); run this taskpool "
+                                    f"through the per-task runtime"
+                                ) from exc
+                            raise
+                        n_calls += 1
+        return pools, n_calls
+
     def execute(self, pools: Tuple) -> Tuple:
         """Run the DAG over device tile pools (one stacked array per
         collection, ordered by self.coll_names); returns final pools."""
         dag = self.dag
         eng = make_engine(dag)
         ready = np.asarray(eng.start(), np.int32)
-        slot = self._slot
         n_waves = n_calls = 0
         while ready.size:
             n_waves += 1
-            classes = dag.class_of[ready]
-            for sub in self._split_war(ready, classes):
-                ids, cls = sub
-                for ci in np.unique(cls):
-                    members = ids[cls == ci]
-                    p = self.plans[int(ci)]
-                    nf = len(p.flow_idx)
-                    # (no priority ordering: a wave is an antichain and
-                    # every member executes before the next readiness
-                    # update — order has no observable effect)
-                    # body-referenced locals become static kernel args:
-                    # group members by their values (uniform per wave in
-                    # the common panel-structured DAGs)
-                    groups: Dict[Tuple, List[int]] = {}
-                    for t in members:
-                        sv = tuple(int(dag.locals_of[t][i])
-                                   for i in p.body_locals)
-                        groups.setdefault(sv, []).append(int(t))
-                    for statics, g in groups.items():
-                        garr = np.asarray(g, np.int64)
-                        off = 0
-                        for k in self._chunks(len(garr), self.max_chunk):
-                            chunk = garr[off:off + k]
-                            off += k
-                            lrows = [dag.locals_of[t] for t in chunk]
-                            nl = len(lrows[0])
-                            locs = (np.asarray(lrows, np.int32)
-                                    .reshape(k, nl)
-                                    if nl else np.zeros((k, 0), np.int32))
-                            idx = slot[chunk, :nf].T.copy()  # [n_flows, k]
-                            try:
-                                pools = self._kernel(int(ci), k, statics)(
-                                    pools, locs, idx)
-                            except Exception as exc:
-                                if "Tracer" in type(exc).__name__ or \
-                                        "Concretization" in type(exc).__name__:
-                                    raise WaveError(
-                                        f"{p.ast.name}: body cannot be "
-                                        f"batch-traced (it branches on a "
-                                        f"derived local or data value in "
-                                        f"Python); run this taskpool "
-                                        f"through the per-task runtime"
-                                    ) from exc
-                                raise
-                            n_calls += 1
+            pools, nc = self._execute_frontier(ready, dag.class_of[ready],
+                                               pools)
+            n_calls += nc
             ready = np.asarray(eng.complete_batch(ready), np.int32)
         done = eng.completed() if hasattr(eng, "completed") else dag.n_tasks
         if int(done) != dag.n_tasks:
@@ -536,6 +553,12 @@ class WaveRunner:
         return self.dag.n_tasks
 
 
-def wave(tp: PTGTaskpool, max_chunk: int = 256) -> WaveRunner:
-    """Build a wave-mode executor for a single-rank PTG taskpool."""
+def wave(tp: PTGTaskpool, max_chunk: int = 256, comm=None) -> WaveRunner:
+    """Build a wave-mode executor. Single-rank taskpools get the local
+    WaveRunner; multi-rank taskpools (or an explicit ``comm``) get the
+    distributed runner (wave_dist.py), which partitions the DAG by the
+    data distribution and exchanges tiles between waves."""
+    if tp.nb_ranks != 1 or comm is not None:
+        from .wave_dist import DistWaveRunner
+        return DistWaveRunner(tp, max_chunk=max_chunk, comm=comm)
     return WaveRunner(tp, max_chunk=max_chunk)

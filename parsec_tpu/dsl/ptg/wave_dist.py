@@ -1,0 +1,371 @@
+"""Distributed wave execution: one lowered PTG DAG over many ranks.
+
+The reference dispatches distributed tasks from a ~us C progress loop,
+overlapping per-task sends with compute (parsec/scheduling.c:586-625 +
+remote_dep_mpi.c). A Python per-task loop cannot reach that rate, and on
+TPU the idiomatic answer is different anyway: batch compute onto the MXU
+(wave.py) and batch communication into a few bulk exchanges per wave.
+This module is the multi-rank half of that answer — the two properties
+the round-2 review found living in different engines (wave throughput,
+distribution) in ONE engine:
+
+- every rank lowers the same JDF to the same full DAG (SPMD, like the
+  reference: each rank evaluates the PTG locally, README.rst:23-27) and
+  walks the same wave schedule = dependence levels of the DAG;
+- each rank executes only the tasks its data distribution maps to it
+  (owner-computes over ``rank_of`` affinity), as batched chunk kernels
+  over its local device tile pools;
+- the communication schedule is computed STATICALLY at build time: for
+  every tile interval between two writes, any reader on another rank
+  gets the tile pushed right after the wave that wrote it, deduped per
+  (wave, src, dst); pre-exchange (wave 0) ships home tiles to remote
+  first readers, and final writes ship back to the tile's home rank.
+  Both ends derive the identical schedule from the identical DAG, so no
+  control messages, tags negotiation, or rendezvous are needed at all —
+  the data messages themselves are the entire protocol;
+- cross-rank write-after-read needs no handling: replicated pools mean
+  a remote write only reaches this rank's pool in the post-wave
+  exchange, which runs after local execution — the reader batched in
+  the same wave saw the old value, exactly WAR semantics. (Local
+  same-wave WAR is layered by WaveRunner._split_war as before; two
+  same-wave writers of one tile are rejected statically — racy DAG.)
+
+Memory model: every rank stages full-size pools (replicated). Tiles a
+rank neither owns nor receives hold stale/garbage values that no local
+task reads — the schedule guarantees any read slot is current. This
+trades HBM for simplicity; a sliced-pool variant is the follow-up.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from ...comm.engine import TAG_USER_BASE
+from ...utils import logging as plog
+from .wave import WaveError, WaveRunner
+
+__all__ = ["TAG_WAVE", "DistWaveRunner"]
+
+TAG_WAVE = TAG_USER_BASE - 4
+
+
+def _ensure_wave_inbox(ce):
+    """Per-CE shared inbox for wave-exchange messages. One handler per
+    CE regardless of how many runners/pools exist; keys carry the pool
+    name + run epoch so concurrent or back-to-back runs can't alias.
+    Messages for an epoch older than the pool's current one are dropped
+    on arrival (their run already finished or failed)."""
+    cv = getattr(ce, "_wave_inbox_cv", None)
+    if cv is None:
+        ce._wave_inbox = {}
+        ce._wave_epochs = getattr(ce, "_wave_epochs", {})
+        cv = ce._wave_inbox_cv = threading.Condition()
+
+        def _on_msg(src: int, msg: Dict) -> None:
+            key = (msg["pool"], msg["epoch"], src, msg["wave"])
+            with cv:
+                if msg["epoch"] < ce._wave_epochs.get(msg["pool"], 0):
+                    return   # stale epoch: its run is over
+                ce._wave_inbox[key] = msg
+                cv.notify_all()
+
+        ce.tag_register(TAG_WAVE, _on_msg)
+    return ce._wave_inbox, cv
+
+
+class DistWaveRunner(WaveRunner):
+    """Wave executor for a multi-rank PTG taskpool.
+
+    ``comm`` is a RemoteDepEngine or a raw CommEngine; defaults to the
+    taskpool's attached engine (``tp.comm``). The tile exchange rides
+    the CE's active messages (host bytes); on device meshes the pools
+    themselves live in device memory and only exchanged tiles
+    round-trip through host — the device-plane (comm/xfer.py) hookup is
+    a follow-up that changes the payload hop, not the schedule.
+    """
+
+    _multirank = True
+
+    def __init__(self, tp, max_chunk: int = 256, comm=None,
+                 comm_timeout: float = 120.0) -> None:
+        comm = comm if comm is not None else getattr(tp, "comm", None)
+        if comm is None:
+            raise WaveError(
+                "distributed wave needs a comm engine: pass comm= or "
+                "attach the taskpool to a context with one")
+        self.ce = getattr(comm, "ce", comm)
+        if self.ce.nb_ranks != tp.nb_ranks:
+            raise WaveError(
+                f"comm engine spans {self.ce.nb_ranks} ranks but the "
+                f"taskpool declares {tp.nb_ranks}")
+        self.comm_timeout = comm_timeout
+        super().__init__(tp, max_chunk=max_chunk)
+        self.rank = int(tp.rank)
+        self.nb_ranks = int(tp.nb_ranks)
+        # canonical coords per flat tile index (inverse of _tile_index)
+        self._coords_by_idx: List[List[Tuple]] = []
+        for cid in range(len(self.coll_names)):
+            inv: List[Tuple] = [None] * len(self._tile_index[cid])
+            for c, i in self._tile_index[cid].items():
+                inv[i] = c
+            self._coords_by_idx.append(inv)
+        self._rank_of_task = self._compute_task_ranks()
+        self._levels = self._compute_levels()
+        self._build_comm_schedule()
+        self._scatter_kerns: Dict[int, Any] = {}
+        _ensure_wave_inbox(self.ce)
+
+    # ------------------------------------------------------------------ #
+    # static analysis                                                    #
+    # ------------------------------------------------------------------ #
+    def _compute_task_ranks(self) -> np.ndarray:
+        dag = self.dag
+        out = np.zeros(dag.n_tasks, np.int32)
+        for ci, p in enumerate(self.plans):
+            if p.ast.affinity_collection is None:
+                raise WaveError(
+                    f"{p.ast.name}: no affinity (': desc(...)') — every "
+                    f"class needs one in distributed wave mode (task "
+                    f"ownership IS the affinity)")
+        for t in range(dag.n_tasks):
+            tc = self.plans[int(dag.class_of[t])].tc
+            out[t] = tc.rank_of_instance(tc.env_of(dag.locals_of[t]))
+        return out
+
+    def _compute_levels(self) -> List[np.ndarray]:
+        """Dependence levels of the DAG = the wave schedule (a task's
+        wave is 1 + the max wave of its predecessors; level i executes
+        as wave i+1, wave 0 is the pre-exchange)."""
+        dag = self.dag
+        indeg = dag.indegree.copy()
+        frontier = [int(t) for t in np.nonzero(indeg == 0)[0]]
+        levels: List[np.ndarray] = []
+        seen = 0
+        while frontier:
+            levels.append(np.asarray(sorted(frontier), np.int32))
+            seen += len(frontier)
+            nxt: List[int] = []
+            for t in frontier:
+                for e in range(int(dag.indptr[t]), int(dag.indptr[t + 1])):
+                    s = int(dag.succ[e])
+                    indeg[s] -= 1
+                    if indeg[s] == 0:
+                        nxt.append(s)
+            frontier = nxt
+        if seen != dag.n_tasks:
+            raise WaveError("cycle in lowered DAG")
+        return levels
+
+    def _home_rank(self, cid: int, idx: int) -> int:
+        coll = self.collections[self.coll_names[cid]]
+        return int(coll.rank_of(*self._coords_by_idx[cid][idx]))
+
+    def _build_comm_schedule(self) -> None:
+        """Derive the full exchange schedule from the slot table.
+
+        Timeline semantics (identical to what pool execution does): a
+        read at wave w sees the last write at any wave < w, else the
+        home/staged value. Every (reader rank != value-source rank)
+        pair becomes one pushed tile after the source wave; last writes
+        additionally push home. The schedule is a pure function of the
+        DAG + distribution, so all SPMD ranks compute the same one.
+        """
+        dag = self.dag
+        slot = self._slot
+        wave_of = np.zeros(dag.n_tasks, np.int32)
+        for lv, members in enumerate(self._levels):
+            wave_of[members] = lv + 1
+        self._wave_of = wave_of
+
+        writers: Dict[Tuple[int, int], List[Tuple[int, int, int]]] = {}
+        readers: Dict[Tuple[int, int], List[Tuple[int, int, int]]] = {}
+        for t in range(dag.n_tasks):
+            p = self.plans[int(dag.class_of[t])]
+            w, r = int(wave_of[t]), int(self._rank_of_task[t])
+            for k in range(len(p.flow_idx)):
+                key = (p.flow_coll[k], int(slot[t, k]))
+                if p.written[k]:
+                    writers.setdefault(key, []).append((w, t, r))
+                if p.reads[k]:
+                    readers.setdefault(key, []).append((w, t, r))
+
+        transfers: Set[Tuple[int, int, int, int, int]] = set()
+        ws_sorted: Dict[Tuple[int, int], List[Tuple[int, int, int]]] = {}
+        for key, wl in writers.items():
+            ws = sorted(wl)
+            for a, b in zip(ws, ws[1:]):
+                if a[0] == b[0] and a[1] != b[1]:
+                    cid, idx = key
+                    raise WaveError(
+                        f"two writers of tile {self._coords_by_idx[cid][idx]}"
+                        f" in {self.coll_names[cid]} share wave {a[0]} "
+                        f"(tasks {a[1]}, {b[1]}): the DAG races")
+            ws_sorted[key] = ws
+
+        for key, rl in readers.items():
+            ws = ws_sorted.get(key, ())
+            home = self._home_rank(*key)
+            for (w, _t, r) in rl:
+                src_wave, src_rank = 0, home
+                for (ww, _wt, wr) in ws:
+                    if ww >= w:
+                        break
+                    src_wave, src_rank = ww, wr
+                if src_rank != r:
+                    transfers.add((src_wave, src_rank, r) + key)
+
+        for key, ws in ws_sorted.items():
+            w, _t, r = ws[-1]
+            home = self._home_rank(*key)
+            if r != home:
+                transfers.add((w, r, home) + key)
+
+        # sends[wave][dst][cid] -> sorted idx list (src == me);
+        # recvs[wave] -> sorted src list
+        sends: Dict[int, Dict[int, Dict[int, List[int]]]] = {}
+        recvs: Dict[int, Set[int]] = {}
+        for (w, src, dst, cid, idx) in transfers:
+            if src == self.rank:
+                (sends.setdefault(w, {}).setdefault(dst, {})
+                 .setdefault(cid, [])).append(idx)
+            if dst == self.rank:
+                recvs.setdefault(w, set()).add(src)
+        for by_dst in sends.values():
+            for by_coll in by_dst.values():
+                for lst in by_coll.values():
+                    lst.sort()
+        self._sends = sends
+        self._recvs = {w: sorted(s) for w, s in recvs.items()}
+        self._n_transfers = len(transfers)
+
+    # ------------------------------------------------------------------ #
+    # execution                                                          #
+    # ------------------------------------------------------------------ #
+    def execute(self, pools: Tuple) -> Tuple:
+        ce = self.ce
+        inbox, cv = _ensure_wave_inbox(ce)
+        pool_name = self.tp.name
+        with cv:
+            epoch = ce._wave_epochs[pool_name] = (
+                ce._wave_epochs.get(pool_name, 0) + 1)
+        self._cur = (pool_name, epoch)
+
+        try:
+            pools = self._comm_step(0, pools)
+            n_calls = 0
+            for lv, members in enumerate(self._levels):
+                mine = members[self._rank_of_task[members] == self.rank]
+                if mine.size:
+                    pools, nc = self._execute_frontier(
+                        mine, self.dag.class_of[mine], pools)
+                    n_calls += nc
+                pools = self._comm_step(lv + 1, pools)
+        finally:
+            # drop anything still keyed to this run (abort/timeout paths
+            # must not leak tile payloads on the long-lived CE)
+            with cv:
+                for k in [k for k in inbox
+                          if k[0] == pool_name and k[1] == epoch]:
+                    del inbox[k]
+        plog.debug.verbose(
+            3, "dist wave %s rank %d: %d/%d tasks in %d waves, %d kernel "
+            "calls, %d transfers scheduled", pool_name, self.rank,
+            int((self._rank_of_task == self.rank).sum()), self.dag.n_tasks,
+            len(self._levels), n_calls, self._n_transfers)
+        return pools
+
+    def _comm_step(self, w: int, pools: Tuple) -> Tuple:
+        """Push my wave-w writes to their remote readers, then absorb
+        what wave w wrote elsewhere that I will read."""
+        pool_name, epoch = self._cur
+        for dst in sorted(self._sends.get(w, ())):
+            colls = []
+            for cid in sorted(self._sends[w][dst]):
+                idxs = self._sends[w][dst][cid]
+                arr = np.asarray(pools[cid][np.asarray(idxs, np.int32)])
+                colls.append((cid, idxs, arr))
+            self.ce.send_am(dst, TAG_WAVE,
+                            {"pool": pool_name, "epoch": epoch, "wave": w,
+                             "colls": colls})
+        srcs = self._recvs.get(w)
+        if not srcs:
+            return pools
+        # batch ALL of this wave's incoming tiles per collection and
+        # apply them as ONE donated jitted scatter per pool: an eager
+        # .at[].set() per (src, coll) would copy the whole stacked pool
+        # each time (pools are O(matrix) — tens of copies per run)
+        upd: Dict[int, Tuple[List[int], List[Any]]] = {}
+        for src in srcs:
+            msg = self._await_msg(src, w)
+            for cid, idxs, arr in msg["colls"]:
+                lst = upd.setdefault(cid, ([], []))
+                lst[0].extend(idxs)
+                lst[1].append(np.asarray(arr))
+        plist = list(pools)
+        for cid, (idxs, arrs) in upd.items():
+            vals = np.concatenate(arrs, axis=0)
+            plist[cid] = self._scatter_kernel(len(idxs))(
+                plist[cid], np.asarray(idxs, np.int32), vals)
+        return tuple(plist)
+
+    def _scatter_kernel(self, k: int):
+        """Donated jitted pool scatter for k tiles (cached per count —
+        waves reuse the same few counts, so compiles amortize)."""
+        kern = self._scatter_kerns.get(k)
+        if kern is None:
+            import jax
+
+            kern = jax.jit(lambda pool, idx, vals: pool.at[idx].set(vals),
+                           donate_argnums=(0,))
+            self._scatter_kerns[k] = kern
+        return kern
+
+    def _await_msg(self, src: int, w: int) -> Dict:
+        pool_name, epoch = self._cur
+        key = (pool_name, epoch, src, w)
+        inbox, cv = _ensure_wave_inbox(self.ce)
+        deadline = time.monotonic() + self.comm_timeout
+        while True:
+            with cv:
+                msg = inbox.pop(key, None)
+            if msg is not None:
+                return msg
+            self.ce.progress()
+            with cv:
+                if key in inbox:
+                    continue
+                cv.wait(0.0005)
+            if time.monotonic() > deadline:
+                raise WaveError(
+                    f"rank {self.rank}: no wave-exchange message "
+                    f"{key} within {self.comm_timeout}s (peer dead or "
+                    f"schedules diverged)")
+
+    # ------------------------------------------------------------------ #
+    # pool staging                                                       #
+    # ------------------------------------------------------------------ #
+    def scatter_pools(self, pools: Tuple) -> None:
+        """Write back only the tiles this rank OWNS (their home is
+        here); the final-state transfers brought every last write home
+        first, so owned tiles are current on their owner."""
+        for cid, name in enumerate(self.coll_names):
+            if cid not in self._written_colls:
+                continue
+            coll = self.collections[name]
+            coords = self._coords_by_idx[cid]
+            owned = [i for i, c in enumerate(coords)
+                     if int(coll.rank_of(*c)) == self.rank]
+            if not owned:
+                continue
+            host = np.asarray(pools[cid][np.asarray(owned, np.int32)])
+            for j, i in enumerate(owned):
+                data = coll.data_of(*coords[i])
+                hc = data.host_copy()
+                if hc.payload is None:
+                    hc.payload = host[j].copy()
+                else:
+                    np.copyto(hc.payload, host[j])
+                data.version_bump(0)

@@ -123,6 +123,33 @@ def run_dposv(ctx, eng, rank, nb_ranks, n=96, nb=32, nrhs=16,
     return err
 
 
+def run_wave(eng, rank, nb_ranks, n=256, nb=64):
+    """Distributed WAVE dpotrf across real OS processes: every rank
+    executes its block-cyclic slice as batched kernels, tile exchange
+    rides TAG_WAVE messages over the sockets (dsl/ptg/wave_dist.py)."""
+    from parsec_tpu.ops import dpotrf_taskpool, make_spd
+
+    M = make_spd(n, dtype=np.float64)
+    coll = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float64, P=nb_ranks,
+                             Q=1, nodes=nb_ranks, rank=rank)
+    coll.name = "descA"
+    coll.from_numpy(M.copy())
+    tp = dpotrf_taskpool(coll, rank=rank, nb_ranks=nb_ranks)
+    w = ptg.wave(tp, comm=eng)
+    w.run()
+    ref = np.linalg.cholesky(M)
+    err = 0.0
+    for (i, j) in coll.tiles():
+        if coll.rank_of(i, j) != rank or i < j:
+            continue
+        t = np.asarray(coll.data_of(i, j).host_copy().payload)
+        if i == j:
+            t = np.tril(t)
+        err = max(err, float(np.abs(
+            t - ref[i * nb:(i + 1) * nb, j * nb:(j + 1) * nb]).max()))
+    return err
+
+
 FAIL_JDF = CHAIN_JDF.replace("X[0, 0] = X[0, 0] + 1.0", "X = hook(X, k)")
 
 
@@ -172,6 +199,17 @@ def main() -> int:
         parsec_tpu.params.set_cmdline("comm_failure_strict", "1")
 
     eng = TCPCommEngine(rank, [("127.0.0.1", p) for p in ports])
+    if mode == "wave":
+        # distributed wave execution drives the CE directly (no context)
+        try:
+            err = run_wave(eng, rank, nb_ranks)
+            eng.sync()
+            print(json.dumps({"rank": rank, "max_err": err,
+                              "msgs": eng.fabric.msg_count,
+                              "bytes": eng.fabric.bytes_count}), flush=True)
+            return 0
+        finally:
+            eng.fini()
     plane = None
     if mode == "dposv_xfer":
         # device data plane: TCP stays control, tile payloads move

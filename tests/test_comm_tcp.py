@@ -144,6 +144,17 @@ def test_dtd_chain_across_processes():
     assert finals == [float(hops)]
 
 
+def test_wave_dpotrf_across_processes():
+    """Distributed WAVE dpotrf across 2 real OS processes: each rank
+    runs its block-cyclic slice as batched kernels; the static tile
+    exchange schedule rides the sockets (wave throughput + distribution
+    in one engine — round-2 VERDICT item 3)."""
+    outs = _run_ranks(2, 0, mode="wave", timeout=300)
+    assert all(o["max_err"] < 5e-3 for o in outs), outs
+    assert all(o["msgs"] > 0 for o in outs)
+    assert sum(o["bytes"] for o in outs) > 4 * 64 * 64 * 4  # tiles crossed
+
+
 def test_dposv_across_processes():
     """Distributed Cholesky solve across 4 real OS processes: three
     sequential taskpools, panel broadcasts, cross-rank writebacks and

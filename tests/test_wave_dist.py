@@ -1,0 +1,220 @@
+"""Distributed wave execution over the in-process fabric (SPMD threads,
+one CE per rank — the reference's oversubscribed-mpiexec analog).
+
+Covers the three transfer kinds of the static schedule (wave-0
+pre-exchange of home tiles, post-wave producer->reader pushes, final
+write->home returns) plus the north-star shape: dpotrf over a 2D
+block-cyclic distribution on 2 and 4 ranks, numerics-checked against
+numpy Cholesky.
+"""
+import numpy as np
+import pytest
+
+from parsec_tpu.comm import LocalFabric
+from parsec_tpu.collections import TwoDimBlockCyclic
+from parsec_tpu.dsl import ptg
+from parsec_tpu.dsl.ptg.wave import WaveError
+from parsec_tpu.ops import dpotrf_taskpool, make_spd
+
+from test_comm_multirank import spmd
+
+
+def _gather_owned(coll, rank):
+    out = {}
+    for c in coll.tiles():
+        if coll.rank_of(*c) == rank:
+            out[c] = np.asarray(coll.data_of(*c).host_copy().payload).copy()
+    return out
+
+
+def _dpotrf_rank(rank, fabric, nb_ranks, M, n, nb, P, Q):
+    ce = fabric.engine(rank)
+    coll = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float64,
+                             P=P, Q=Q, nodes=nb_ranks, rank=rank)
+    coll.name = "descA"
+    coll.from_numpy(M.copy())
+    tp = dpotrf_taskpool(coll, rank=rank, nb_ranks=nb_ranks)
+    w = ptg.wave(tp, comm=ce)
+    w.run()
+    return _gather_owned(coll, rank)
+
+
+@pytest.mark.parametrize("nb_ranks,P,Q", [(2, 2, 1), (4, 2, 2)])
+def test_dist_wave_dpotrf(nb_ranks, P, Q):
+    n, nb = 512, 64
+    M = make_spd(n, dtype=np.float64)
+    results, _ = spmd(
+        nb_ranks,
+        lambda r, f: _dpotrf_rank(r, f, nb_ranks, M, n, nb, P, Q),
+        timeout=180)
+    L = np.zeros((n, n))
+    for owned in results:
+        for (m, k), t in owned.items():
+            L[m * nb:(m + 1) * nb, k * nb:(k + 1) * nb] = t
+    L = np.tril(L)
+    ref = np.linalg.cholesky(M)
+    np.testing.assert_allclose(L, ref, rtol=0, atol=1e-8 * n)
+
+
+# --------------------------------------------------------------------- #
+# wave-0 pre-exchange: every rank's task reads a tile whose HOME is the #
+# other rank, before anyone writes it                                   #
+# --------------------------------------------------------------------- #
+PREX_JDF = """
+descA [ type="collection" ]
+descB [ type="collection" ]
+M [ type="int" ]
+
+Sweep(m)
+m = 0 .. M-1
+: descB( m, 0 )
+RW B <- descB( m, 0 )
+     -> descB( m, 0 )
+READ L <- descA( (m+1) % M, 0 )
+BODY
+{
+    B = B + 2.0 * L
+}
+END
+"""
+
+
+def _prex_rank(rank, fabric, nb_ranks, A0, B0, M, nb):
+    ce = fabric.engine(rank)
+    mk = lambda: TwoDimBlockCyclic(M * nb, nb, nb, nb, dtype=np.float64,
+                                   P=nb_ranks, Q=1, nodes=nb_ranks,
+                                   rank=rank)
+    dA, dB = mk(), mk()
+    dA.name, dB.name = "descA", "descB"
+    dA.from_numpy(A0.copy())
+    dB.from_numpy(B0.copy())
+    tp = ptg.compile_jdf(PREX_JDF, name="prex").new(
+        descA=dA, descB=dB, M=M, rank=rank, nb_ranks=nb_ranks)
+    w = ptg.wave(tp, comm=ce)
+    w.run()
+    return _gather_owned(dB, rank)
+
+
+def test_dist_wave_zero_exchange_of_home_tiles():
+    M, nb = 4, 8
+    rng = np.random.RandomState(1)
+    A0 = rng.rand(M * nb, nb)
+    B0 = rng.rand(M * nb, nb)
+    results, _ = spmd(2, lambda r, f: _prex_rank(r, f, 2, A0, B0, M, nb))
+    got = {}
+    for owned in results:
+        got.update(owned)
+    for m in range(M):
+        exp = (B0[m * nb:(m + 1) * nb]
+               + 2.0 * A0[((m + 1) % M) * nb:(((m + 1) % M) + 1) * nb])
+        np.testing.assert_allclose(got[(m, 0)], exp, rtol=1e-6)
+
+
+# --------------------------------------------------------------------- #
+# producer->reader edge transfer + final write->home return: Phase1     #
+# writes its own tile, Phase2 on the OTHER rank consumes it via a task  #
+# edge; Write2 runs on descB's rank but its slot tile lives in descA    #
+# (last write returns home before scatter)                              #
+# --------------------------------------------------------------------- #
+EDGE_JDF = """
+descA [ type="collection" ]
+descB [ type="collection" ]
+M [ type="int" ]
+
+Phase1(m)
+m = 0 .. M-1
+: descA( m, 0 )
+RW A <- descA( m, 0 )
+     -> L Phase2( (m+1) % M )
+     -> descA( m, 0 )
+BODY
+{
+    A = A * 10.0
+}
+END
+
+Phase2(m)
+m = 0 .. M-1
+: descB( m, 0 )
+RW B <- descB( m, 0 )
+     -> descB( m, 0 )
+READ L <- A Phase1( (m+M-1) % M )
+BODY
+{
+    B = B + L
+}
+END
+"""
+
+
+def _edge_rank(rank, fabric, nb_ranks, A0, B0, M, nb):
+    ce = fabric.engine(rank)
+    dA = TwoDimBlockCyclic(M * nb, nb, nb, nb, dtype=np.float64,
+                           P=nb_ranks, Q=1, nodes=nb_ranks, rank=rank)
+    # descB's distribution is SHIFTED: tile m of B lives on the rank
+    # that does NOT own tile m of A, so every edge crosses ranks
+    class Shifted(TwoDimBlockCyclic):
+        def rank_of(self, m, n=0):
+            return (super().rank_of(m, n) + 1) % nb_ranks
+    dB = Shifted(M * nb, nb, nb, nb, dtype=np.float64,
+                 P=nb_ranks, Q=1, nodes=nb_ranks, rank=rank)
+    dA.name, dB.name = "descA", "descB"
+    dA.from_numpy(A0.copy())
+    dB.from_numpy(B0.copy())
+    tp = ptg.compile_jdf(EDGE_JDF, name="edge").new(
+        descA=dA, descB=dB, M=M, rank=rank, nb_ranks=nb_ranks)
+    w = ptg.wave(tp, comm=ce)
+    w.run()
+    return _gather_owned(dA, rank), _gather_owned(dB, rank)
+
+
+def test_dist_wave_edge_transfer_and_home_return():
+    M, nb = 4, 8
+    rng = np.random.RandomState(2)
+    A0 = rng.rand(M * nb, nb)
+    B0 = rng.rand(M * nb, nb)
+    results, _ = spmd(2, lambda r, f: _edge_rank(r, f, 2, A0, B0, M, nb))
+    gotA, gotB = {}, {}
+    for a, b in results:
+        gotA.update(a)
+        gotB.update(b)
+    for m in range(M):
+        sl = slice(m * nb, (m + 1) * nb)
+        np.testing.assert_allclose(gotA[(m, 0)], 10.0 * A0[sl], rtol=1e-6)
+        prev = slice(((m - 1) % M) * nb, (((m - 1) % M) + 1) * nb)
+        np.testing.assert_allclose(gotB[(m, 0)], B0[sl] + 10.0 * A0[prev],
+                                   rtol=1e-6)
+
+
+def test_dist_wave_requires_affinity():
+    """A class without affinity has no owner — must be rejected, not
+    silently executed everywhere (divergent schedules would hang)."""
+    NOAFF = """
+descA [ type="collection" ]
+M [ type="int" ]
+
+T(m)
+m = 0 .. M-1
+RW A <- descA( m, 0 )
+     -> descA( m, 0 )
+BODY
+{
+    A = A + 1.0
+}
+END
+"""
+
+    def run(rank, fabric):
+        ce = fabric.engine(rank)
+        dA = TwoDimBlockCyclic(16, 8, 8, 8, dtype=np.float64,
+                               P=2, Q=1, nodes=2, rank=rank)
+        dA.name = "descA"
+        dA.from_numpy(np.zeros((16, 8)))
+        tp = ptg.compile_jdf(NOAFF, name="noaff").new(
+            descA=dA, M=2, rank=rank, nb_ranks=2)
+        with pytest.raises(WaveError, match="affinity"):
+            ptg.wave(tp, comm=ce)
+        return True
+
+    results, _ = spmd(2, run)
+    assert all(results)
