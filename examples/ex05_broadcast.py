@@ -52,14 +52,24 @@ END
 
 
 def main(NB: int = 7) -> int:
+    # single process by default; under tools/launch.py -n N the context
+    # auto-wires the TCP comm engine and this same program runs SPMD —
+    # the cross-rank edges of the broadcast go through the remote-dep
+    # engine with the configured bcast topology
     ctx = parsec_tpu.init(nb_cores=2)
     try:
         mydata = LocalArrayCollection(np.zeros((NB + 1, 1), dtype=np.int64),
-                                      NB + 1)
-        tp = ptg.compile_jdf(BCAST_JDF, name="bcast").new(mydata=mydata, NB=NB)
+                                      NB + 1, nodes=ctx.nb_ranks,
+                                      rank=ctx.rank)
+        tp = ptg.compile_jdf(BCAST_JDF, name="bcast").new(
+            mydata=mydata, NB=NB, rank=ctx.rank, nb_ranks=ctx.nb_ranks)
         ctx.add_taskpool(tp)
         ctx.wait()
-        assert tp.nb_local_tasks == NB + 2
+        mine = sum(1 for k in range(NB + 1) if mydata.rank_of(k) == ctx.rank)
+        mine += 1 if mydata.rank_of(0) == ctx.rank else 0
+        assert tp.nb_local_tasks == mine, (tp.nb_local_tasks, mine)
+        print(f"rank {ctx.rank}/{ctx.nb_ranks}: {tp.nb_local_tasks} local "
+              f"tasks OK")
     finally:
         ctx.fini()
     return 0

@@ -26,6 +26,51 @@ from .termdet import termdet_new
 from .vpmap import VPMap, VirtualProcess, default_nb_cores
 
 
+_jax_distributed_on = False
+
+
+def _maybe_init_jax_distributed() -> None:
+    """jax.distributed.initialize from params — every participating
+    process calls this and jax builds ONE global device list spanning
+    them (jax.devices() = all ranks' chips; meshes/GSPMD then shard
+    across processes over DCN/ICI). Idempotent per process."""
+    global _jax_distributed_on
+    coord = params.get("jax_coordinator")
+    if not coord or _jax_distributed_on:
+        return
+    import jax
+    jax.distributed.initialize(
+        coordinator_address=coord,
+        num_processes=int(params.get("jax_num_processes")),
+        process_id=int(params.get("jax_process_id")))
+    _jax_distributed_on = True
+
+
+def _comm_from_params():
+    """Auto-wire the control-plane comm engine from launcher params
+    (tools/launch.py exports PARSEC_MCA_comm_* per rank — the analog of
+    mpiexec handing each process its communicator)."""
+    transport = params.get("comm_transport")
+    eps = params.get("comm_endpoints")
+    if not transport or transport in ("none", "0"):
+        return None
+    if transport != "tcp":
+        raise ValueError(f"unknown comm_transport {transport!r} "
+                         f"(supported: tcp)")
+    if not eps:
+        raise ValueError("comm_transport=tcp needs comm_endpoints")
+    rank = int(params.get("comm_rank"))
+    if rank < 0:
+        raise ValueError("comm_transport=tcp needs comm_rank >= 0")
+    endpoints = []
+    for e in eps.split(","):
+        host, port = e.rsplit(":", 1)
+        endpoints.append((host, int(port)))
+    from ..comm import RemoteDepEngine
+    from ..comm.tcp import TCPCommEngine
+    return RemoteDepEngine(TCPCommEngine(rank, endpoints))
+
+
 class Context:
     """ref: parsec_context_t"""
 
@@ -39,6 +84,12 @@ class Context:
                  profile: bool = False) -> None:
         if argv:
             params.parse_argv(argv)
+        # multi-process bootstrap (launcher-provided env/params): a
+        # jax.distributed global mesh and/or an auto-wired TCP comm
+        # engine, BEFORE anything touches jax devices or ranks
+        _maybe_init_jax_distributed()
+        if comm is None:
+            comm = _comm_from_params()
         self.rank = rank
         self.nb_ranks = nb_ranks
         self.comm = comm                       # comm engine / remote-dep driver

@@ -243,6 +243,23 @@ def register_core_params() -> None:
     params.reg_bool("comm_failure_strict", False,
                     "treat ANY torn peer connection as a rank failure "
                     "(default: only when the peer owes data or is sent to)")
+    # multi-process deployment (tools/launch.py sets these per rank —
+    # the mpiexec analog; ref: parsec_remote_dep_set_ctx runtime.h:221)
+    params.reg_string("comm_transport", "",
+                      "auto-wire a comm engine at init: \"tcp\" (endpoints "
+                      "from comm_endpoints) or empty for none")
+    params.reg_string("comm_endpoints", "",
+                      "comma list of host:port control-plane endpoints, "
+                      "one per rank, identical on every rank")
+    params.reg_int("comm_rank", -1, "this process's rank in comm_endpoints")
+    params.reg_string("jax_coordinator", "",
+                      "host:port of the jax.distributed coordinator; set "
+                      "on every rank to build one global device mesh "
+                      "across processes (GSPMD over DCN/ICI)")
+    params.reg_int("jax_num_processes", 0,
+                   "process count for jax.distributed.initialize")
+    params.reg_int("jax_process_id", -1,
+                   "this process's id for jax.distributed.initialize")
 
 
 register_core_params()

@@ -1,0 +1,72 @@
+"""tools/launch.py — the mpiexec analog: one command deploys the same
+program SPMD across real OS processes, each rank's Context auto-wiring
+its comm engine from the launcher's env (VERDICT r2 item 4)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _launch(n, prog, extra=(), timeout=240):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    p = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", str(n), *extra, os.path.join(ROOT, prog)],
+        capture_output=True, text=True, timeout=timeout, env=env)
+    assert p.returncode == 0, (p.returncode, p.stdout[-3000:],
+                               p.stderr[-2000:])
+    return p.stdout
+
+
+def test_launch_ex05_two_ranks():
+    out = _launch(2, "examples/ex05_broadcast.py")
+    assert "[0] rank 0/2" in out and "[1] rank 1/2" in out
+
+
+def test_launch_dposv_three_ranks():
+    out = _launch(3, "examples/ex10_dposv_multiprocess.py", timeout=300)
+    for r in range(3):
+        assert f"rank {r}/3: dposv ok" in out, out[-2000:]
+
+
+def test_launch_jax_distributed_global_mesh(tmp_path):
+    probe = tmp_path / "probe.py"
+    probe.write_text(
+        "import sys; sys.path.insert(0, %r)\n"
+        "import parsec_tpu\n"
+        "ctx = parsec_tpu.init(nb_cores=1)\n"
+        "import jax\n"
+        "print(f'rank {ctx.rank}: global={len(jax.devices())} "
+        "procs={jax.process_count()}')\n"
+        "ctx.fini()\n" % ROOT)
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    p = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "2", "--jax-distributed", str(probe)],
+        capture_output=True, text=True, timeout=240, env=env)
+    assert p.returncode == 0, (p.stdout[-3000:], p.stderr[-2000:])
+    # 2 processes x 4 local virtual devices = ONE 8-device global mesh
+    assert "global=8 procs=2" in p.stdout, p.stdout[-2000:]
+
+
+def test_launch_fail_fast(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import sys, os\n"
+                   "rank = int(os.environ['PARSEC_MCA_comm_rank'])\n"
+                   "sys.exit(9 if rank == 1 else 0)\n")
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    p = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "2", str(bad)],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert p.returncode == 9

@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""parsec_tpu process launcher — the mpiexec analog.
+
+Spawns N SPMD rank processes of a user program, wiring each one's comm
+engine via PARSEC_MCA_* env vars (the reference hands each process its
+communicator through mpiexec + MPI_Init; here the launcher allocates the
+control-plane endpoints and each rank's Context auto-builds a
+TCPCommEngine + RemoteDepEngine at init, runtime/context.py
+_comm_from_params). Ref: parsec/parsec_mpi_funnelled.c:245-365 (the
+transport this replaces), SURVEY.md §5.8.
+
+Usage:
+  python tools/launch.py -n N [options] prog.py [prog args...]
+
+Options:
+  -n N                 number of ranks (default 2)
+  --jax-distributed    also start a jax.distributed coordinator so the
+                       ranks form ONE global jax device mesh (GSPMD
+                       across processes); rank 0 hosts the coordinator
+  --host H             bind host (default 127.0.0.1)
+  --timeout S          per-rank wall clock limit (default 3600)
+  --env K=V            extra env var for every rank (repeatable)
+
+Each rank's stdout/stderr is streamed line-by-line with a "[r]" prefix.
+Exit status: 0 when every rank exits 0; otherwise the first non-zero
+rank's status (remaining ranks are killed — fail fast, like mpiexec).
+"""
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import threading
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        prog="launch.py", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("-n", type=int, default=2, dest="nranks")
+    ap.add_argument("--jax-distributed", action="store_true")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--timeout", type=float, default=3600.0)
+    ap.add_argument("--env", action="append", default=[])
+    ap.add_argument("prog")
+    ap.add_argument("prog_args", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+
+    from parsec_tpu.comm.tcp import free_ports
+
+    n = args.nranks
+    ports = free_ports(n + (1 if args.jax_distributed else 0))
+    endpoints = ",".join(f"{args.host}:{p}" for p in ports[:n])
+
+    base_env = dict(os.environ)
+    for kv in args.env:
+        k, _, v = kv.partition("=")
+        base_env[k] = v
+    base_env["PARSEC_MCA_comm_transport"] = "tcp"
+    base_env["PARSEC_MCA_comm_endpoints"] = endpoints
+    if args.jax_distributed:
+        base_env["PARSEC_MCA_jax_coordinator"] = \
+            f"{args.host}:{ports[n]}"
+        base_env["PARSEC_MCA_jax_num_processes"] = str(n)
+
+    procs = []
+    for r in range(n):
+        env = dict(base_env)
+        env["PARSEC_MCA_comm_rank"] = str(r)
+        if args.jax_distributed:
+            env["PARSEC_MCA_jax_process_id"] = str(r)
+        procs.append(subprocess.Popen(
+            [sys.executable, args.prog] + args.prog_args,
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+
+    def pump(r, stream):
+        for line in stream:
+            sys.stdout.write(f"[{r}] {line}")
+            sys.stdout.flush()
+
+    pumps = [threading.Thread(target=pump, args=(r, p.stdout), daemon=True)
+             for r, p in enumerate(procs)]
+    for t in pumps:
+        t.start()
+
+    rc = 0
+    try:
+        for r, p in enumerate(procs):
+            try:
+                p.wait(timeout=args.timeout)
+            except subprocess.TimeoutExpired:
+                sys.stderr.write(f"launch.py: rank {r} exceeded "
+                                 f"{args.timeout}s; killing all\n")
+                rc = rc or 124
+                break
+            if p.returncode != 0 and rc == 0:
+                sys.stderr.write(f"launch.py: rank {r} exited "
+                                 f"{p.returncode}; killing the rest\n")
+                rc = p.returncode
+                break
+    except KeyboardInterrupt:
+        rc = 130
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for t in pumps:
+            t.join(timeout=2)
+    if rc == 0 and any(p.returncode != 0 for p in procs):
+        rc = next(p.returncode for p in procs if p.returncode != 0)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
