@@ -738,24 +738,10 @@ class PTGTaskpool(Taskpool):
         the ``AxB`` dimension form or (quoted) one Python expression
         evaluating to an int/tuple — instance-dependent shapes like
         partial edge tiles need the latter."""
-        shape_src = None
-        for d in f.deps:
-            if "shape" in d.properties:
-                shape_src = d.properties["shape"]
-                break
-        if shape_src is None:
+        shape = scratch_shape(f, env)
+        if shape is None:
             raise RuntimeError(
                 f"flow {f.name}: NEW target needs a [shape=...] property")
-        try:
-            val = Expr(shape_src)(env)
-        except (SyntaxError, NameError, TypeError):
-            val = None
-        if isinstance(val, (tuple, list)):
-            shape = tuple(int(v) for v in val)
-        elif isinstance(val, (int, np.integer)):
-            shape = (int(val),)
-        else:
-            shape = tuple(int(Expr(x)(env)) for x in shape_src.split("x"))
         dt = np.dtype(f_prop(f, "dtype", "float32"))
         data = Data(nb_elts=int(np.prod(shape)))
         copy = DataCopy(data, 0, payload=np.zeros(shape, dtype=dt))
@@ -875,3 +861,26 @@ def f_prop(f: FlowAST, key: str, default: str) -> str:
         if key in d.properties:
             return d.properties[key]
     return default
+
+
+def scratch_shape(f: FlowAST, env: Dict[str, Any]) -> Optional[Tuple[int, ...]]:
+    """Shape a flow's [shape=...] property declares for this instance
+    (``AxB`` dims or one Python expression -> int/tuple), or None when
+    the property is absent. Shared by the runtime's NEW allocation and
+    wave scratch pools so both accept the same JDFs."""
+    shape_src = None
+    for d in f.deps:
+        if "shape" in d.properties:
+            shape_src = d.properties["shape"]
+            break
+    if shape_src is None:
+        return None
+    try:
+        val = Expr(shape_src)(env)
+    except (SyntaxError, NameError, TypeError):
+        val = None
+    if isinstance(val, (tuple, list)):
+        return tuple(int(v) for v in val)
+    if isinstance(val, (int, np.integer)):
+        return (int(val),)
+    return tuple(int(Expr(x)(env)) for x in shape_src.split("x"))

@@ -39,10 +39,12 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ...data.datatype import Datatype
+from ...data.reshape import reshape_array
 from ...utils import logging as plog
 from .ast import Expr
 from .lower import LoweredDAG, lower, make_engine
-from .runtime import PTGTaskpool, _expand_args
+from .runtime import PTGTaskpool, _expand_args, f_prop, scratch_shape
 
 __all__ = ["WaveError", "WaveRunner", "wave"]
 
@@ -64,7 +66,7 @@ class _ClassPlan:
 
     __slots__ = ("tc", "ast", "flow_idx", "flow_names", "flow_coll",
                  "written", "reads", "range_locals", "body_locals", "code",
-                 "kernels")
+                 "kernels", "in_tnames", "wb_names", "in_tname", "wb_name")
 
     def __init__(self, tc) -> None:
         self.tc = tc
@@ -79,6 +81,15 @@ class _ClassPlan:
         # a flow with in-deps reads its slot's current value (RW reads
         # then writes; WRITE-only flows have no in-deps and may clobber)
         self.reads = [bool(tc.ast.flows[i].deps_in()) for i in self.flow_idx]
+        nf = len(self.flow_idx)
+        # reshape-property support: per-flow [type]/[type_data] names
+        # collected across instances (must be uniform — kernels are
+        # per-class), resolved to concrete conversions at kernel trace
+        # time when pool tile shapes exist
+        self.in_tnames: List[set] = [set() for _ in range(nf)]
+        self.wb_names: List[set] = [set() for _ in range(nf)]
+        self.in_tname: List[Optional[str]] = [None] * nf
+        self.wb_name: List[Optional[str]] = [None] * nf
         self.range_locals = [ld.name for ld in tc.ast.locals
                              if ld.range is not None]
         self.code = compile(_pick_body(tc.ast).code,
@@ -121,25 +132,25 @@ class WaveRunner:
             # shape uniformity (pools are stacked arrays) is enforced by
             # np.stack in build_pools; ragged tilings raise there
         self.plans = [_ClassPlan(tc) for tc in tp.task_classes]
-        # reshape property semantics ([type]/[type_data] conversions,
-        # region-masked writeback) live in the per-task runtime; pools
-        # scatter whole tiles, so accepting such JDFs would silently
-        # clobber out-of-region values. type_remote alone is fine: wave
-        # is single-rank and type_remote is wire-only (a no-op here).
-        for tc in tp.task_classes:
-            for f in tc.ast.flows:
-                for d in f.deps:
-                    for key in ("type", "type_data"):
-                        nm = d.properties.get(key)
-                        if nm is not None and nm != "full":
-                            raise WaveError(
-                                f"{tc.ast.name}.{f.name}: [{key}={nm}] "
-                                f"reshape semantics need the per-task "
-                                f"runtime; wave pools scatter whole tiles")
+        # reshape properties ([type]/[type_data]) are served IN-KERNEL:
+        # input conversions apply after the gather (masked cast, XLA
+        # fuses them into the body), region-masked memory writebacks
+        # merge with the pre-body tile value at scatter. The names must
+        # be uniform per (class, flow) — kernels are per-class — and
+        # conversions materialize at first execute when pool tile
+        # shapes are known. type_remote is wire-format only and is
+        # ignored here (single-rank: local edges never reshape on it;
+        # DistWaveRunner rejects it).
+        # NEW scratch flows get per-class scratch pools (ids after the
+        # real collections), zero-initialized each run like the
+        # per-task runtime's runtime-allocated NEW tiles.
+        self._n_real_colls = len(self.coll_names)
+        self._scratch: Dict[Tuple, Dict[str, Any]] = {}
         # slot tables: per task, per (non-ctl) flow position in the
         # class's flow_idx list -> flat tile index (collection fixed per
         # class/flow, validated during assignment)
         self._assign_slots()
+        self._validate_tnames()
 
     # ------------------------------------------------------------------ #
     # slot assignment                                                    #
@@ -169,6 +180,38 @@ class WaveRunner:
             pos = {fi: k for k, fi in enumerate(p.flow_idx)}
             flow_pos.append(pos)
 
+        # class-local ordinal of each task (scratch-pool slot index for
+        # NEW flows: one scratch tile per instance)
+        ordinal = np.zeros(n, np.int32)
+        counts: Dict[int, int] = {}
+        for t in range(n):
+            ci = int(dag.class_of[t])
+            ordinal[t] = counts.get(ci, 0)
+            counts[ci] = counts.get(ci, 0) + 1
+        self._class_ordinal = ordinal
+        self._class_count = counts
+
+        # IN and OUT slots are SEPARATE: a written flow without a memory
+        # out-dep renames into a per-instance scratch slot, so its body
+        # output reaches successors without mutating the home tile —
+        # the per-task runtime's copy-rename semantics (this also lets
+        # instances write back to a DIFFERENT tile than they read, and
+        # lets guarded deps bind different collections per instance:
+        # chunks group by the per-task collection signature)
+        slot_out = np.full((n, max_df), -1, np.int32)
+        scoll = np.full((n, max_df), -1, np.int16)
+        socoll = np.full((n, max_df), -1, np.int16)
+        # per-INSTANCE: does this flow write a declared memory target
+        # (the only scatters where a [type*] writeback mask applies)?
+        wb_apply = np.zeros((n, max_df), bool)
+        # per-INSTANCE extra masked scatter: a flow with BOTH a masked
+        # memory writeback AND task successors produces TWO values —
+        # successors get the full body output (rename slot), memory
+        # gets the region-merge; these arrays carry the memory target
+        wbx_cid = np.full((n, max_df), -1, np.int16)
+        wbx_idx = np.full((n, max_df), -1, np.int32)
+        self._wbx_cid, self._wbx_idx = wbx_cid, wbx_idx
+
         for t in order:
             ci = int(dag.class_of[t])
             p = self.plans[ci]
@@ -176,33 +219,183 @@ class WaveRunner:
             env = tc.env_of(dag.locals_of[t])
             for k, fi in enumerate(p.flow_idx):
                 f = tc.ast.flows[fi]
-                s = self._slot_of_flow(t, f, env, flow_pos, slot)
+                s = self._slot_of_flow(t, f, env, flow_pos, slot, scoll,
+                                       slot_out, socoll)
                 if s is None:
                     raise WaveError(
                         f"{p.ast.name}{dag.locals_of[t]}.{f.name}: flow "
-                        f"does not resolve to a collection tile (NEW/NULL "
-                        f"flows need the per-task runtime)")
+                        f"does not resolve to a collection tile or scratch "
+                        f"pool (NULL flows need the per-task runtime)")
                 coll_id, idx = s
                 if p.flow_coll[k] == -1:
-                    p.flow_coll[k] = coll_id
-                elif p.flow_coll[k] != coll_id:
-                    raise WaveError(
-                        f"{p.ast.name}.{f.name}: instances bind tiles from "
-                        f"different collections; wave batching needs one")
+                    p.flow_coll[k] = coll_id   # representative (shapes)
+                scoll[t, k] = coll_id
                 slot[t, k] = idx
+                tname = self._inst_in_tname(f, env)
+                p.in_tnames[k].add(tname)
                 if p.written[k]:
-                    self._check_writeback(p, f, env, coll_id, idx)
+                    out_cid, out_idx, has_target = self._out_slot_of_flow(
+                        t, p, k, f, env, coll_id, idx, tname,
+                        wbx_cid, wbx_idx)
+                    socoll[t, k] = out_cid
+                    slot_out[t, k] = out_idx
+                    wb_apply[t, k] = has_target
         self._slot = slot
+        self._slot_out = slot_out
+        self._slot_coll = scoll
+        self._slot_out_coll = socoll
+        self._wb_apply = wb_apply
         # only collections the DAG actually touches are staged; only
         # written ones are scattered back (D2H can be ~4 MB/s — a full
         # gather of an untouched pool costs minutes)
-        self._used_colls = {cid for p in self.plans
-                            for cid in p.flow_coll if cid >= 0}
-        self._written_colls = {p.flow_coll[k] for p in self.plans
-                               for k in range(len(p.flow_idx))
-                               if p.written[k] and p.flow_coll[k] >= 0}
+        self._used_colls = ({int(c) for c in np.unique(scoll) if c >= 0}
+                            | {int(c) for c in np.unique(socoll) if c >= 0}
+                            | {int(c) for c in np.unique(wbx_cid) if c >= 0})
+        self._written_colls = (
+            {int(c) for c in np.unique(socoll) if c >= 0}
+            | {int(c) for c in np.unique(wbx_cid) if c >= 0})
 
-    def _slot_of_flow(self, tid, f, env, flow_pos, slot):
+    def _inst_in_tname(self, f, env) -> Optional[str]:
+        """The [type*] name this instance's input edge declares (same
+        first-applicable-dep rule as the runtime's _input_dtt;
+        type_remote is wire-only and never applies locally)."""
+        for d in f.deps_in():
+            t = d.resolve(env)
+            if t is None:
+                continue
+            props = d.properties
+            if t.kind == "memory":
+                nm = props.get("type_data") or props.get("type")
+            else:
+                nm = props.get("type")
+            return None if nm == "full" else nm
+        return None
+
+    def _scratch_slot(self, tid, f, env, shape=None) -> Tuple[int, int]:
+        """NEW flow: one scratch tile per instance in a per-(class,
+        flow) zero-initialized pool (the runtime-allocated NEW tile
+        analog; shape from [shape=]/[dtype=] props, uniform across
+        instances — pools are stacked arrays)."""
+        ci = int(self.dag.class_of[tid])
+        if shape is None:
+            shape = scratch_shape(f, env)
+        if shape is None:
+            raise WaveError(
+                f"{self.plans[ci].ast.name}.{f.name}: NEW flow needs a "
+                f"[shape=...] property")
+        key = (ci, f.name, "new")
+        sp = self._scratch.get(key)
+        if sp is None:
+            sp = self._scratch[key] = {
+                "cid": self._n_real_colls + len(self._scratch),
+                "shape": shape,
+                "dtype": np.dtype(f_prop(f, "dtype", "float32")),
+                "like": None,
+                "n": self._class_count[ci],
+                "label": f"{self.plans[ci].ast.name}.{f.name}",
+            }
+        elif sp["shape"] != shape:
+            raise WaveError(
+                f"{sp['label']}: NEW shapes differ across instances "
+                f"({sp['shape']} vs {shape}); scratch pools are stacked")
+        return sp["cid"], int(self._class_ordinal[tid])
+
+    def _rename_slot(self, tid, f, like_cid: int) -> Tuple[int, int]:
+        """Written flow with NO memory out-target: its output must reach
+        successors without touching the home tile — rename into a
+        per-instance scratch slot (the copy-rename the per-task runtime
+        gets from fresh DataCopies). Tile shape/dtype copied from the
+        input slot's pool at staging."""
+        ci = int(self.dag.class_of[tid])
+        key = (ci, f.name, "ren")
+        sp = self._scratch.get(key)
+        if sp is None:
+            sp = self._scratch[key] = {
+                "cid": self._n_real_colls + len(self._scratch),
+                "shape": None,
+                "dtype": None,
+                "like": like_cid,
+                "n": self._class_count[ci],
+                "label": f"{self.plans[ci].ast.name}.{f.name}",
+            }
+        elif sp["like"] != like_cid:
+            # the rename pool copies tile shape/dtype from ONE input
+            # pool; instances binding different input collections could
+            # need different tiles — fail at build, not with an opaque
+            # XLA shape error at execute
+            raise WaveError(
+                f"{sp['label']}: renamed instances bind different input "
+                f"collections (pools {sp['like']} vs {like_cid}); "
+                f"unsupported in wave mode")
+        return sp["cid"], int(self._class_ordinal[tid])
+
+    def _out_slot_of_flow(self, tid, p, k, f, env, in_cid, in_idx, tname,
+                          wbx_cid, wbx_idx) -> Tuple[int, int, bool]:
+        """Where this written flow's output lands.
+
+        Mirrors the runtime's copy binding: a flow's body mutates the
+        copy BOUND to it, so by default the output lands in the input
+        slot (home tiles and shared producer copies are mutated in
+        place, like the reference's parsec_data_copy_t sharing). The
+        exceptions:
+        - a memory out-dep names the tile — must be the input slot
+          (or the input is private scratch: NEW tiles write back home);
+        - a [type*] INPUT conversion applies — the runtime binds a
+          DETACHED converted copy there, so the output renames into a
+          private scratch slot and the home/producer value stays put.
+        """
+        targets = set()
+        inst_masked = False
+        has_task_succ = False
+        for d in f.deps_out():
+            t = d.resolve(env)
+            if t is None:
+                continue
+            if t.kind == "task":
+                has_task_succ = True
+                continue
+            if t.kind != "memory":
+                continue
+            cid = self._coll_id.get(t.collection)
+            if cid is None:
+                raise WaveError(
+                    f"{p.ast.name}.{f.name}: writes back to unbound "
+                    f"collection {t.collection!r}")
+            coords = tuple(int(a(env)) for a in t.args)
+            targets.add((cid, self._tile_lookup(cid, coords)))
+            nm = d.properties.get("type_data") or d.properties.get("type")
+            nm = None if nm == "full" else nm
+            inst_masked = inst_masked or nm is not None
+            p.wb_names[k].add(nm)
+        if len(targets) > 1:
+            raise WaveError(
+                f"{p.ast.name}.{f.name}: one instance writes back to "
+                f"multiple tiles {sorted(targets)}; unsupported in wave "
+                f"mode")
+        if targets:
+            cid, idx = next(iter(targets))
+            if inst_masked and has_task_succ:
+                # TWO distinct values leave this flow: successors get
+                # the FULL body output (runtime: the detached clone),
+                # memory gets the region-masked merge. Main scatter
+                # renames; the memory target rides the extra-scatter
+                # arrays (masked merge against its own old value).
+                wbx_cid[tid, k] = cid
+                wbx_idx[tid, k] = idx
+                return self._rename_slot(tid, f, in_cid) + (False,)
+            if (cid, idx) != (in_cid, in_idx) and \
+                    in_cid < self._n_real_colls:
+                raise WaveError(
+                    f"{p.ast.name}.{f.name}: writes back to a different "
+                    f"tile than its slot; unsupported in wave mode (the "
+                    f"body would also mutate the source in the runtime)")
+            return cid, idx, True
+        if tname is not None:
+            return self._rename_slot(tid, f, in_cid) + (False,)
+        return in_cid, in_idx, False
+
+    def _slot_of_flow(self, tid, f, env, flow_pos, slot, scoll,
+                      slot_out, socoll):
         deps_in = f.deps_in()
         for d in deps_in:
             t = d.resolve(env)
@@ -214,6 +407,8 @@ class WaveRunner:
                     return None
                 coords = tuple(int(a(env)) for a in t.args)
                 return coll_id, self._tile_lookup(coll_id, coords)
+            if t.kind == "new":
+                return self._scratch_slot(tid, f, env)
             if t.kind == "task":
                 for args in _expand_args(t.args, env):
                     past = self.tp.jdf.task_class_by_name(t.task_class)
@@ -228,14 +423,22 @@ class WaveRunner:
                     k = flow_pos[pci].get(pfi)
                     if k is None:
                         return None
-                    idx = int(slot[pid, k])
+                    # a WRITTEN producer flow hands successors its OUT
+                    # slot (post-rename); a READ flow forwards its input
+                    if pplan.written[k]:
+                        idx = int(slot_out[pid, k])
+                        cid = int(socoll[pid, k])
+                    else:
+                        idx = int(slot[pid, k])
+                        cid = int(scoll[pid, k])
                     if idx < 0:
                         return None
-                    return pplan.flow_coll[k], idx
+                    return cid, idx
                 continue
-            return None  # new / null
+            return None  # null
         if not deps_in:
-            # WRITE-only flow: bind to its memory out-target
+            # WRITE-only flow: bind to its memory out-target, or a
+            # scratch pool when it only feeds successors ([shape=] set)
             for d in f.deps_out():
                 t = d.resolve(env)
                 if t is not None and t.kind == "memory":
@@ -244,6 +447,9 @@ class WaveRunner:
                         return None
                     coords = tuple(int(a(env)) for a in t.args)
                     return coll_id, self._tile_lookup(coll_id, coords)
+            ssh = scratch_shape(f, env)
+            if ssh is not None:
+                return self._scratch_slot(tid, f, env, shape=ssh)
         return None
 
     def _tile_lookup(self, coll_id: int, coords: Tuple[int, ...]) -> int:
@@ -259,32 +465,68 @@ class WaveRunner:
                             f"{self.coll_names[coll_id]}")
         return hit
 
-    def _check_writeback(self, p, f, env, coll_id, idx) -> None:
-        for d in f.deps_out():
-            t = d.resolve(env)
-            if t is None or t.kind != "memory":
-                continue
-            tc_id = self._coll_id.get(t.collection)
-            if tc_id is None:
-                raise WaveError(
-                    f"{p.ast.name}.{f.name}: writes back to unbound "
-                    f"collection {t.collection!r}")
-            coords = tuple(int(a(env)) for a in t.args)
-            if tc_id != coll_id or self._tile_lookup(tc_id, coords) != idx:
-                raise WaveError(
-                    f"{p.ast.name}.{f.name}: writes back to a different "
-                    f"tile than its slot; unsupported in wave mode")
+    # ------------------------------------------------------------------ #
+    # reshape-property conversions                                       #
+    # ------------------------------------------------------------------ #
+    def _validate_tnames(self) -> None:
+        """Uniformity + resolvability of collected [type*] names (the
+        kernels are per-class, so per-instance variation is unservable;
+        the general runtime handles those JDFs)."""
+        for p in self.plans:
+            for k in range(len(p.flow_idx)):
+                for which, names in (("in", p.in_tnames[k]),
+                                     ("writeback", p.wb_names[k])):
+                    real = {n for n in names if n is not None}
+                    if len(real) > 1 or (real and None in names):
+                        raise WaveError(
+                            f"{p.ast.name}.{p.flow_names[k]}: [type*] "
+                            f"names vary across instances "
+                            f"({sorted(names, key=str)}); per-class wave "
+                            f"kernels need one — use the per-task runtime")
+                    for nm in real:
+                        val = self.tp.global_env.get(nm)
+                        if not isinstance(val, Datatype) and \
+                                nm not in ("lower", "upper", "full"):
+                            raise WaveError(
+                                f"{p.ast.name}.{p.flow_names[k]} "
+                                f"({which}): [type={nm}] is neither a "
+                                f"Datatype global nor a region shorthand")
+                p.in_tname[k] = next(iter(
+                    {n for n in p.in_tnames[k] if n is not None}), None)
+                p.wb_name[k] = next(iter(
+                    {n for n in p.wb_names[k] if n is not None}), None)
+
+    def _resolve_dst(self, p, k, nm, tile_shape, pool_dtype):
+        """Concrete Datatype for a validated [type*] name (called at
+        kernel TRACE time, when pool tile shapes are in hand)."""
+        val = self.tp.global_env.get(nm)
+        if isinstance(val, Datatype):
+            dst = val
+        else:   # validated shorthand
+            dst = Datatype(pool_dtype, tuple(tile_shape), nm)
+        if tuple(dst.shape) != tuple(tile_shape):
+            raise WaveError(
+                f"{p.ast.name}.{p.flow_names[k]}: [type={nm}] shape "
+                f"{dst.shape} differs from the pool tile {tile_shape}; "
+                f"wave pools are fixed-shape — use the per-task runtime")
+        return dst
 
     # ------------------------------------------------------------------ #
     # kernels                                                            #
     # ------------------------------------------------------------------ #
-    def _kernel(self, ci: int, k: int, statics: Tuple = ()):
-        """The jitted chunk kernel for class ``ci``, chunk size ``k`` and
-        static body-local values ``statics``:
-        fn(pools_tuple, locals_i32[k, n_locals], idx_i32[n_flows, k])
-        -> pools_tuple with written slots scattered in place."""
+    def _kernel(self, ci: int, k: int, statics: Tuple, incols: Tuple,
+                outcols: Tuple, wbflags: Tuple = (), wbxcols: Tuple = ()):
+        """The jitted chunk kernel for class ``ci``, chunk size ``k``,
+        static body-local values ``statics``, per-flow pool ids
+        ``incols``/``outcols``, per-flow writeback-mask applicability
+        ``wbflags``, and per-flow extra masked-scatter pool ids
+        ``wbxcols`` (guarded deps may bind different pools / have or
+        lack a memory target per instance — chunks group by the full
+        signature): fn(pools, locals_i32[k, n_locals], idx_in, idx_out,
+        idx_wbx [n_flows, k]) -> pools with written slots scattered."""
         p = self.plans[ci]
-        kern = p.kernels.get((k, statics))
+        key = (k, statics, incols, outcols, wbflags, wbxcols)
+        kern = p.kernels.get(key)
         if kern is not None:
             return kern
         import jax
@@ -293,7 +535,8 @@ class WaveRunner:
         global_env = self.tp.global_env
         flow_names = p.flow_names
         written = p.written
-        flow_coll = p.flow_coll
+        in_tname = p.in_tname
+        wb_name = p.wb_name
         range_locals = p.range_locals
         derived = [(ld.name, ld.expr) for ld in p.ast.locals
                    if ld.range is None]
@@ -301,6 +544,19 @@ class WaveRunner:
 
         static_pairs = [(range_locals[i], v)
                         for i, v in zip(p.body_locals, statics)]
+
+        def conv_in(j, v):
+            # [type]/[type_data] input conversion (masked cast) — XLA
+            # fuses it into the body (ref: parsec_reshape.c consumer-
+            # side promise trigger); resolved here at trace time, when
+            # the per-tile shape is in hand
+            nm = in_tname[j]
+            if nm is None:
+                return v
+            dst = self._resolve_dst(p, j, nm, tuple(v.shape), v.dtype)
+            if dst.compatible_wire(Datatype(v.dtype, tuple(v.shape))):
+                return v
+            return reshape_array(v, dst)
 
         def one(loc_row, *flow_vals):
             env = dict(global_env)
@@ -310,8 +566,8 @@ class WaveRunner:
                 env[nm] = v
             for nm, ex in derived:
                 env[nm] = ex(env)
-            for nm, v in zip(flow_names, flow_vals):
-                env[nm] = v
+            for j, (nm, v) in enumerate(zip(flow_names, flow_vals)):
+                env[nm] = conv_in(j, v)
             env["np"] = np
             env["jnp"] = jnp
             env["es_rank"] = 0
@@ -319,22 +575,66 @@ class WaveRunner:
             exec(code, env)
             return tuple(env[nm] for nm, w in zip(flow_names, written) if w)
 
-        def chunk_fn(pools, locs, idx):
-            gathered = [pools[flow_coll[j]][idx[j]]
+        def merge(j, cid, val, dest_old):
+            # region-masked memory writeback: only in-region elements
+            # land; the rest keep the DESTINATION's pre-wave values
+            # (the detached-clone semantics of the per-task runtime).
+            # val is BATCHED [k, ...]; the declared dtype round-trip
+            # mirrors reshape_to + np.copyto, the mask broadcasts
+            dst = self._resolve_dst(
+                p, j, wb_name[j], tuple(pools_shapes[cid][1:]),
+                pools_dtypes[cid])
+            conv = val.astype(dst.dtype).astype(pools_dtypes[cid])
+            mask = dst.mask()
+            return (conv if mask is None else
+                    jnp.where(jnp.asarray(mask), conv, dest_old))
+
+        pools_shapes: Dict[int, Tuple] = {}
+        pools_dtypes: Dict[int, Any] = {}
+
+        def chunk_fn(pools, locs, idx_in, idx_out, idx_wbx):
+            for c, pl in enumerate(pools):
+                pools_shapes[c] = tuple(pl.shape)
+                pools_dtypes[c] = pl.dtype
+            gathered = [pools[incols[j]][idx_in[j]]
                         for j in range(len(flow_names))]
+            # old DESTINATION values for masked merges, gathered before
+            # any scatter of this chunk lands
+            dest_old = {j: pools[outcols[j]][idx_out[j]]
+                        for j in range(len(flow_names))
+                        if written[j] and wb_name[j] is not None
+                        and wbflags and wbflags[j]}
+            wbx_old = {j: pools[wbxcols[j]][idx_wbx[j]]
+                       for j in range(len(flow_names))
+                       if wbxcols and wbxcols[j] >= 0}
             outs = jax.vmap(one)(locs, *gathered)
             pools = list(pools)
             oi = 0
             for j, w in enumerate(written):
                 if not w:
                     continue
-                cid = flow_coll[j]
-                pools[cid] = pools[cid].at[idx[j]].set(outs[oi])
+                cid = outcols[j]
+                val = outs[oi]
+                # the masked merge applies only at declared MEMORY-
+                # target scatters (wbflags, per-instance): an instance
+                # whose guarded out-dep resolved to no target writes in
+                # place or renames, and its successors must see the
+                # FULL body output
+                if j in dest_old:
+                    val = merge(j, cid, val, dest_old[j])
+                pools[cid] = pools[cid].at[idx_out[j]].set(val)
+                if j in wbx_old:
+                    # dual output: the rename slot above carried the
+                    # full value to successors; the memory target gets
+                    # the region-masked merge
+                    xcid = wbxcols[j]
+                    pools[xcid] = pools[xcid].at[idx_wbx[j]].set(
+                        merge(j, xcid, outs[oi], wbx_old[j]))
                 oi += 1
             return tuple(pools)
 
         kern = jax.jit(chunk_fn, donate_argnums=(0,))
-        p.kernels[(k, statics)] = kern
+        p.kernels[key] = kern
         return kern
 
     @staticmethod
@@ -361,7 +661,6 @@ class WaveRunner:
         """Execute one ready antichain (or the local slice of one) as
         batched per-class chunk kernels; returns (pools, n_calls)."""
         dag = self.dag
-        slot = self._slot
         n_calls = 0
         for sub in self._split_war(ids, classes):
             sids, cls = sub
@@ -372,15 +671,20 @@ class WaveRunner:
                 # (no priority ordering: a wave is an antichain and
                 # every member executes before the next readiness
                 # update — order has no observable effect)
-                # body-referenced locals become static kernel args:
-                # group members by their values (uniform per wave in
-                # the common panel-structured DAGs)
+                # body-referenced locals become static kernel args, and
+                # guarded deps may bind different pools per instance:
+                # group members by (locals statics, collection signature)
                 groups: Dict[Tuple, List[int]] = {}
                 for t in members:
                     sv = tuple(int(dag.locals_of[t][i])
                                for i in p.body_locals)
-                    groups.setdefault(sv, []).append(int(t))
-                for statics, g in groups.items():
+                    icl = tuple(int(c) for c in self._slot_coll[t, :nf])
+                    ocl = tuple(int(c) for c in self._slot_out_coll[t, :nf])
+                    wfl = tuple(bool(b) for b in self._wb_apply[t, :nf])
+                    xcl = tuple(int(c) for c in self._wbx_cid[t, :nf])
+                    groups.setdefault((sv, icl, ocl, wfl, xcl),
+                                      []).append(int(t))
+                for (statics, icl, ocl, wfl, xcl), g in groups.items():
                     garr = np.asarray(g, np.int64)
                     off = 0
                     for k in self._chunks(len(garr), self.max_chunk):
@@ -391,10 +695,13 @@ class WaveRunner:
                         locs = (np.asarray(lrows, np.int32)
                                 .reshape(k, nl)
                                 if nl else np.zeros((k, 0), np.int32))
-                        idx = slot[chunk, :nf].T.copy()  # [n_flows, k]
+                        idx_in = self._slot[chunk, :nf].T.copy()
+                        idx_out = self._slot_out[chunk, :nf].T.copy()
+                        idx_wbx = self._wbx_idx[chunk, :nf].T.copy()
                         try:
-                            pools = self._kernel(int(ci), k, statics)(
-                                pools, locs, idx)
+                            pools = self._kernel(int(ci), k, statics,
+                                                 icl, ocl, wfl, xcl)(
+                                pools, locs, idx_in, idx_out, idx_wbx)
                         except Exception as exc:
                             if "Tracer" in type(exc).__name__ or \
                                     "Concretization" in type(exc).__name__:
@@ -440,24 +747,32 @@ class WaveRunner:
         tile the other writes — legal dataflow, but unservable by
         in-place scatters) raises WaveError: run it through the per-task
         runtime, whose copies rename WAR hazards away."""
-        slot = self._slot
         reads: Dict[Tuple[int, int], List[int]] = {}
         writes: Dict[Tuple[int, int], int] = {}
         for pos, t in enumerate(ids):
             p = self.plans[int(classes[pos])]
             for k in range(len(p.flow_idx)):
-                key = (p.flow_coll[k], int(slot[t, k]))
-                if p.written[k]:
-                    prev = writes.get(key)
-                    if prev is not None and prev != int(t):
-                        raise WaveError(
-                            f"frontier holds two writers of the same "
-                            f"tile (tasks {prev} and {int(t)}): the DAG "
-                            f"races — in-place scatters would keep an "
-                            f"arbitrary one")
-                    writes[key] = int(t)
-                else:
+                # IN and OUT slots differ for renamed/cross-tile writes:
+                # the read is against the in slot, the write against the
+                # out slot (an RW flow is both)
+                if p.reads[k] or not p.written[k]:
+                    key = (int(self._slot_coll[t, k]), int(self._slot[t, k]))
                     reads.setdefault(key, []).append(int(t))
+                if p.written[k]:
+                    wkeys = [(int(self._slot_out_coll[t, k]),
+                              int(self._slot_out[t, k]))]
+                    if int(self._wbx_cid[t, k]) >= 0:
+                        wkeys.append((int(self._wbx_cid[t, k]),
+                                      int(self._wbx_idx[t, k])))
+                    for key in wkeys:
+                        prev = writes.get(key)
+                        if prev is not None and prev != int(t):
+                            raise WaveError(
+                                f"frontier holds two writers of the same "
+                                f"tile (tasks {prev} and {int(t)}): the "
+                                f"DAG races — in-place scatters would "
+                                f"keep an arbitrary one")
+                        writes[key] = int(t)
         out_edges: Dict[int, List[int]] = {}
         indeg: Dict[int, int] = {int(t): 0 for t in ids}
         n_conf = 0
@@ -526,6 +841,20 @@ class WaveRunner:
             else:
                 arr = jnp.asarray(stacked)
             pools.append(arr)
+        # scratch pools (NEW flows + write renames): zero-initialized
+        # each run, ids after real collections; rename pools copy tile
+        # shape/dtype from the pool they rename ("like" — already
+        # staged: its cid is always smaller). A tile-pool sharding spec
+        # needn't fit scratch shapes — scratch stays single-device.
+        for sp in sorted(self._scratch.values(), key=lambda s: s["cid"]):
+            if sp["shape"] is not None:
+                z = np.zeros((sp["n"],) + sp["shape"], sp["dtype"])
+            else:
+                like = pools[sp["like"]]
+                z = np.zeros((sp["n"],) + tuple(like.shape[1:]),
+                             np.dtype(str(like.dtype)))
+            pools.append(jax.device_put(z, device) if device is not None
+                         else jnp.asarray(z))
         return tuple(pools)
 
     def scatter_pools(self, pools: Tuple) -> None:

@@ -133,6 +133,19 @@ class DistWaveRunner(WaveRunner):
         for t in range(dag.n_tasks):
             tc = self.plans[int(dag.class_of[t])].tc
             out[t] = tc.rank_of_instance(tc.env_of(dag.locals_of[t]))
+        # [type_remote] converts payloads only on cross-rank edges — a
+        # per-EDGE property the per-class kernels and raw-tile exchange
+        # cannot honor; the general runtime serves those JDFs
+        for p in self.plans:
+            for f in p.ast.flows:
+                for d in f.deps:
+                    nm = d.properties.get("type_remote")
+                    if nm is not None and nm != "full":
+                        raise WaveError(
+                            f"{p.ast.name}.{f.name}: [type_remote={nm}] "
+                            f"is per-edge wire conversion; distributed "
+                            f"wave ships raw tiles — use the per-task "
+                            f"runtime")
         return out
 
     def _compute_levels(self) -> List[np.ndarray]:
@@ -174,7 +187,6 @@ class DistWaveRunner(WaveRunner):
         DAG + distribution, so all SPMD ranks compute the same one.
         """
         dag = self.dag
-        slot = self._slot
         wave_of = np.zeros(dag.n_tasks, np.int32)
         for lv, members in enumerate(self._levels):
             wave_of[members] = lv + 1
@@ -186,10 +198,24 @@ class DistWaveRunner(WaveRunner):
             p = self.plans[int(dag.class_of[t])]
             w, r = int(wave_of[t]), int(self._rank_of_task[t])
             for k in range(len(p.flow_idx)):
-                key = (p.flow_coll[k], int(slot[t, k]))
                 if p.written[k]:
+                    key = (int(self._slot_out_coll[t, k]),
+                           int(self._slot_out[t, k]))
                     writers.setdefault(key, []).append((w, t, r))
+                    if p.wb_name[k] is not None and self._wb_apply[t, k]:
+                        # a masked writeback READS the destination tile
+                        # (out-of-region merge) — its current value must
+                        # be local even for WRITE-only flows
+                        readers.setdefault(key, []).append((w, t, r))
+                    if int(self._wbx_cid[t, k]) >= 0:
+                        # dual-output flow: the extra masked scatter both
+                        # reads and writes its memory target
+                        keyx = (int(self._wbx_cid[t, k]),
+                                int(self._wbx_idx[t, k]))
+                        writers.setdefault(keyx, []).append((w, t, r))
+                        readers.setdefault(keyx, []).append((w, t, r))
                 if p.reads[k]:
+                    key = (int(self._slot_coll[t, k]), int(self._slot[t, k]))
                     readers.setdefault(key, []).append((w, t, r))
 
         transfers: Set[Tuple[int, int, int, int, int]] = set()
@@ -207,17 +233,22 @@ class DistWaveRunner(WaveRunner):
 
         for key, rl in readers.items():
             ws = ws_sorted.get(key, ())
-            home = self._home_rank(*key)
+            # scratch pools (NEW flows) have no home: pre-write reads
+            # see zeros on every rank — consistent without a transfer
+            is_scratch = key[0] >= self._n_real_colls
+            home = None if is_scratch else self._home_rank(*key)
             for (w, _t, r) in rl:
                 src_wave, src_rank = 0, home
                 for (ww, _wt, wr) in ws:
                     if ww >= w:
                         break
                     src_wave, src_rank = ww, wr
-                if src_rank != r:
+                if src_rank is not None and src_rank != r:
                     transfers.add((src_wave, src_rank, r) + key)
 
         for key, ws in ws_sorted.items():
+            if key[0] >= self._n_real_colls:
+                continue   # scratch: nothing to return home
             w, _t, r = ws[-1]
             home = self._home_rank(*key)
             if r != home:

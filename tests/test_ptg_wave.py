@@ -62,8 +62,8 @@ def test_wave_dispatch_scales_with_waves_not_tasks():
     calls = 0
     orig = w._kernel
 
-    def counting(ci, k, statics=()):
-        fn = orig(ci, k, statics)
+    def counting(*kargs):
+        fn = orig(*kargs)
 
         def wrapped(*a):
             nonlocal calls
@@ -132,8 +132,9 @@ END
     assert np.allclose(descA.to_numpy(), 0.0)
 
 
-def test_wave_rejects_new_flows():
-    """Flows with NEW scratch sources can't live in collection pools."""
+def test_wave_new_scratch_flows():
+    """NEW scratch sources live in per-class zero-initialized scratch
+    pools (round-2 VERDICT item 5: previously rejected)."""
     jdf = """
 descA [ type="collection" ]
 NT [ type="int" ]
@@ -150,15 +151,16 @@ READ S <- NEW  [shape=4 dtype=float32]
 
 BODY
 {
-    A = A + S
+    A = A + S + 1.0
 }
 END
 """
     fac = ptg.compile_jdf(jdf, name="newflow")
     descA = TwoDimBlockCyclic(8, 4, 4, 4, dtype=np.float32).from_numpy(
         np.zeros((8, 4), np.float32))
-    with pytest.raises(WaveError):
-        WaveRunner(fac.new(NT=2, descA=descA))
+    WaveRunner(fac.new(NT=2, descA=descA)).run()
+    # scratch arrives zeroed (the runtime's NEW tiles are zeroed too)
+    assert np.allclose(descA.to_numpy(), 1.0)
 
 
 def test_chunk_decomposition():
@@ -286,9 +288,10 @@ def test_wave_sharded_over_mesh():
                        atol=1e-3)
 
 
-def test_wave_rejects_reshape_properties():
-    """[type]/[type_data] reshape semantics live in the per-task
-    runtime; wave pools scatter whole tiles and must refuse."""
+def test_wave_reshape_properties_masked_writeback():
+    """[type_data=lower] in/out: the body sees the masked read, the
+    writeback preserves the upper region (round-2 VERDICT item 5:
+    previously rejected; full parity suite in test_wave_reshape.py)."""
     jdf = """
 descA [ type="collection" ]
 
@@ -299,7 +302,7 @@ k = 0 .. 0
 : descA( 0, 0 )
 
 RW   A <- descA( 0, 0 )    [type_data=lower]
-     -> descA( 0, 0 )
+     -> descA( 0, 0 )      [type_data=lower]
 
 BODY
 {
@@ -308,10 +311,12 @@ BODY
 END
 """
     fac = ptg.compile_jdf(jdf, name="reshapey")
+    base = np.arange(16, dtype=np.float32).reshape(4, 4) + 1.0
     descA = TwoDimBlockCyclic(4, 4, 4, 4, dtype=np.float32).from_numpy(
-        np.ones((4, 4), np.float32))
-    with pytest.raises(WaveError, match="per-task runtime"):
-        WaveRunner(fac.new(descA=descA))
+        base.copy())
+    WaveRunner(fac.new(descA=descA)).run()
+    expect = np.where(np.tril(np.ones((4, 4), bool)), 2.0 * base, base)
+    assert np.allclose(descA.to_numpy(), expect), descA.to_numpy()
 
 
 def test_wave_rejects_waw_frontier():
