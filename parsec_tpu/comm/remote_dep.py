@@ -76,7 +76,7 @@ class RemoteDepEngine:
         self._dtd_expect: Dict[Tuple, Callable] = {}
         # rendezvous bookkeeping: handle_id -> (taskpool, remaining, handle)
         self._pending_handles: Dict[int, Tuple] = {}
-        self._pending_xfers: Dict[int, Any] = {}  # uuid -> taskpool
+        self._pending_xfers: Dict[int, Any] = {}  # uuid -> (tp, dst_rank)
         # memory writebacks buffered until the taskpool's startup has
         # credited the expected arrivals as pending actions (delivering
         # sooner would drive runtime_actions negative):
@@ -116,6 +116,7 @@ class RemoteDepEngine:
         if hasattr(self.ce, "on_peer_failure"):
             def _on_failure(peer: int, reason: str) -> None:
                 from .tcp import RankFailedError
+                self._release_parks_for(peer)
                 context.record_task_error(RankFailedError(peer, reason))
             self.ce.on_peer_failure = _on_failure
 
@@ -184,7 +185,7 @@ class RemoteDepEngine:
                     u, shape, dtype = plane.register(payload_arr)
                     uuids[r] = u
                     with self._lock:
-                        self._pending_xfers[u] = tp
+                        self._pending_xfers[u] = (tp, r)
                 tp.add_pending_action(len(ranks))
                 msg["xfer"] = {"uuids": uuids, "shape": shape,
                                "dtype": dtype, "src": self.rank}
@@ -310,12 +311,32 @@ class RemoteDepEngine:
                          "consumer rank %d: %s", self.rank, uuid, src,
                          payload["failed"])
         with self._lock:
-            tp = self._pending_xfers.pop(uuid, None)
+            ent = self._pending_xfers.pop(uuid, None)
         plane = getattr(self.ce, "device_plane", None)
         if plane is not None:
             plane.release(uuid)
-        if tp is not None:
+        if ent is not None:
+            ent[0].pending_action_done(1)
+
+    def _release_parks_for(self, peer: int) -> None:
+        """A consumer rank died: its ACKs will never come. Reclaim every
+        buffer parked for it and retire the pending actions, so the
+        producer's wait() aborts cleanly (RankFailedError) instead of
+        hanging on a park that cannot be released (round-2 review:
+        park-lifetime management)."""
+        with self._lock:
+            dead = [(u, self._pending_xfers.pop(u))
+                    for u in [u for u, (_t, dst) in
+                              self._pending_xfers.items() if dst == peer]]
+        if not dead:
+            return
+        plane = getattr(self.ce, "device_plane", None)
+        for u, (tp, _dst) in dead:
+            if plane is not None:
+                plane.release(u)
             tp.pending_action_done(1)
+        plog.warning("rank %d: reclaimed %d parked transfer(s) destined "
+                     "to dead rank %d", self.rank, len(dead), peer)
 
     def note_get_served(self, handle_id: int) -> None:
         # progress() fans out to every idle worker: the decrement must be

@@ -109,9 +109,11 @@ class DeviceDataPlane:
             self._parked.pop(uuid, None)
 
     def pull(self, src_rank: int, uuid: int, shape: Tuple,
-             dtype: str) -> Any:
+             dtype: str, device=None) -> Any:
         """Fetch a parked array from ``src_rank`` device-to-device;
-        returns a local device array (materializes asynchronously)."""
+        returns a local device array (materializes asynchronously).
+        ``device`` selects the landing device for multi-device ranks
+        (default: the plane's primary device)."""
         import jax
         from jax.sharding import SingleDeviceSharding
 
@@ -138,7 +140,8 @@ class DeviceDataPlane:
                     closer()
         spec = jax.ShapeDtypeStruct(
             shape, np.dtype(dtype),
-            sharding=SingleDeviceSharding(self.device))
+            sharding=SingleDeviceSharding(
+                device if device is not None else self.device))
         out = conn.pull(uuid, [spec])[0]
         with self._lock:
             self.stats["pulls"] += 1

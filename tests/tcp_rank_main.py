@@ -150,6 +150,83 @@ def run_wave(eng, rank, nb_ranks, n=256, nb=64):
     return err
 
 
+def run_xfer_stress(eng, rank, nb_ranks, n_tiles=96, nb=512, workers=8):
+    """Device-plane soak: rank 0 parks n_tiles MB-scale device arrays,
+    rank 1 pulls them all from a thread pool (concurrent pulls over one
+    connection), verifies contents, acks; rank 0 asserts every park was
+    reclaimed and the byte count matches."""
+    import concurrent.futures as cf
+    import threading
+    import time as _time
+
+    import jax
+    from parsec_tpu.comm import DeviceDataPlane
+
+    TAG_DESC = 100
+    TAG_DONE = 101
+    plane = DeviceDataPlane(eng)
+    plane.exchange()
+    tile_bytes = nb * nb * 4
+    if rank == 0:
+        arrays = [jax.device_put(np.full((nb, nb), i, np.float32))
+                  for i in range(n_tiles)]
+        jax.block_until_ready(arrays)
+        descs = []
+        for i, a in enumerate(arrays):
+            u, shape, dt = plane.register(a)
+            descs.append((i, u, shape, dt))
+        eng.send_am(1, TAG_DESC, {"descs": descs})
+        acked = []
+        eng.tag_register(TAG_DONE, lambda src, p: (
+            [plane.release(u) for u in p["uuids"]], acked.append(p)))
+        deadline = _time.time() + 240
+        while not acked and _time.time() < deadline:
+            eng.progress()
+            _time.sleep(0.001)
+        assert acked, "no completion from consumer"
+        assert acked[0]["errors"] == [], acked[0]["errors"]
+        with plane._lock:
+            leaked = len(plane._parked)
+        eng.sync()
+        return {"rank": 0, "leaked_parks": leaked,
+                "serves": plane.stats["serves"]}
+    # consumer
+    inbox = []
+    eng.tag_register(TAG_DESC, lambda src, p: inbox.append(p))
+    deadline = _time.time() + 120
+    while not inbox and _time.time() < deadline:
+        eng.progress()
+        _time.sleep(0.001)
+    assert inbox, "no descriptors"
+    descs = inbox[0]["descs"]
+    errors = []
+    lock = threading.Lock()
+
+    def pull_one(ent):
+        i, u, shape, dt = ent
+        try:
+            arr = plane.pull(0, u, tuple(shape), dt)
+            jax.block_until_ready(arr)
+            v = float(np.asarray(arr[0, 0]))
+            if v != float(i):
+                with lock:
+                    errors.append(f"tile {i}: got {v}")
+            return u
+        except Exception as exc:  # noqa: BLE001
+            with lock:
+                errors.append(f"tile {i}: {type(exc).__name__}: {exc}")
+            return None
+
+    with cf.ThreadPoolExecutor(workers) as ex:
+        uuids = [u for u in ex.map(pull_one, descs) if u is not None]
+    eng.send_am(0, TAG_DONE, {"uuids": uuids, "errors": errors})
+    eng.sync()
+    return {"rank": 1, "pulls": plane.stats["pulls"],
+            "bytes": plane.stats["bytes_pulled"],
+            "expected_bytes": len(descs) * tile_bytes,
+            "errors": errors}
+
+
 FAIL_JDF = CHAIN_JDF.replace("X[0, 0] = X[0, 0] + 1.0", "X = hook(X, k)")
 
 
@@ -199,6 +276,13 @@ def main() -> int:
         parsec_tpu.params.set_cmdline("comm_failure_strict", "1")
 
     eng = TCPCommEngine(rank, [("127.0.0.1", p) for p in ports])
+    if mode == "xfer_stress":
+        try:
+            out = run_xfer_stress(eng, rank, nb_ranks)
+            print(json.dumps(out), flush=True)
+            return 0
+        finally:
+            eng.fini()
     if mode == "wave":
         # distributed wave execution drives the CE directly (no context)
         try:
