@@ -1422,6 +1422,247 @@ static PyTypeObject DagType = []{
 }();
 
 /* ================================================================== */
+/* RWLock (ref: parsec/class/parsec_rwlock.c — compact atomic         */
+/* readers-writer lock). Write-preferring: a writer first serializes  */
+/* against other writers, then raises the writer flag so new readers  */
+/* park, then waits for active readers to drain. Spins release the    */
+/* GIL so Python threads genuinely contend.                           */
+/* ================================================================== */
+struct RWLockObject {
+  PyObject_HEAD
+  SpinLock wr;                       // writer-vs-writer serialization
+  std::atomic<uint32_t> writer;      // a writer holds or awaits the lock
+  std::atomic<int32_t> readers;      // active readers
+};
+
+static PyObject* RWLock_new(PyTypeObject* type, PyObject*, PyObject*) {
+  RWLockObject* self = (RWLockObject*)type->tp_alloc(type, 0);
+  if (!self) return nullptr;
+  new (&self->wr) SpinLock();
+  new (&self->writer) std::atomic<uint32_t>(0);
+  new (&self->readers) std::atomic<int32_t>(0);
+  return (PyObject*)self;
+}
+
+static void RWLock_dealloc(RWLockObject* self) {
+  Py_TYPE(self)->tp_free((PyObject*)self);
+}
+
+static inline void rw_pause() {
+#if defined(__x86_64__)
+  __builtin_ia32_pause();
+#endif
+}
+
+static PyObject* RWLock_read_lock(RWLockObject* self, PyObject*) {
+  Py_BEGIN_ALLOW_THREADS
+  for (;;) {
+    while (self->writer.load(std::memory_order_acquire)) rw_pause();
+    self->readers.fetch_add(1, std::memory_order_acquire);
+    if (!self->writer.load(std::memory_order_acquire)) break;
+    // a writer raised its flag between our check and increment: back
+    // out so it can drain, then retry behind it
+    self->readers.fetch_sub(1, std::memory_order_release);
+  }
+  Py_END_ALLOW_THREADS
+  Py_RETURN_NONE;
+}
+
+static PyObject* RWLock_read_unlock(RWLockObject* self, PyObject*) {
+  self->readers.fetch_sub(1, std::memory_order_release);
+  Py_RETURN_NONE;
+}
+
+static PyObject* RWLock_write_lock(RWLockObject* self, PyObject*) {
+  Py_BEGIN_ALLOW_THREADS
+  self->wr.lock();
+  self->writer.store(1, std::memory_order_release);
+  while (self->readers.load(std::memory_order_acquire) > 0) rw_pause();
+  Py_END_ALLOW_THREADS
+  Py_RETURN_NONE;
+}
+
+static PyObject* RWLock_write_unlock(RWLockObject* self, PyObject*) {
+  self->writer.store(0, std::memory_order_release);
+  self->wr.unlock();
+  Py_RETURN_NONE;
+}
+
+static PyObject* RWLock_nreaders(RWLockObject* self, PyObject*) {
+  return PyLong_FromLong(self->readers.load(std::memory_order_relaxed));
+}
+
+static PyMethodDef RWLock_methods[] = {
+    {"read_lock", (PyCFunction)RWLock_read_lock, METH_NOARGS,
+     "acquire in shared mode (spins while a writer holds or awaits)"},
+    {"read_unlock", (PyCFunction)RWLock_read_unlock, METH_NOARGS, ""},
+    {"write_lock", (PyCFunction)RWLock_write_lock, METH_NOARGS,
+     "acquire exclusively (serializes writers, drains readers)"},
+    {"write_unlock", (PyCFunction)RWLock_write_unlock, METH_NOARGS, ""},
+    {"nreaders", (PyCFunction)RWLock_nreaders, METH_NOARGS,
+     "active reader count (diagnostic)"},
+    {nullptr, nullptr, 0, nullptr}};
+
+static PyTypeObject RWLockType = []{
+  PyTypeObject t = {PyVarObject_HEAD_INIT(nullptr, 0)};
+  t.tp_name = "_parsec_native.RWLock";
+  t.tp_basicsize = sizeof(RWLockObject);
+  t.tp_flags = Py_TPFLAGS_DEFAULT;
+  t.tp_doc = "Write-preferring atomic readers-writer lock.";
+  t.tp_new = RWLock_new;
+  t.tp_dealloc = (destructor)RWLock_dealloc;
+  t.tp_methods = RWLock_methods;
+  return t;
+}();
+
+/* ================================================================== */
+/* ValueArray (ref: parsec/class/value_array.h — growable array of    */
+/* fixed-size byte elements; items are raw bytes, zero-filled on      */
+/* growth).                                                           */
+/* ================================================================== */
+struct ValueArrayObject {
+  PyObject_HEAD
+  Py_ssize_t item_size;
+  Py_ssize_t nitems;
+  std::vector<unsigned char>* buf;
+  SpinLock lock;
+};
+
+static PyObject* VA_new(PyTypeObject* type, PyObject* args, PyObject*) {
+  Py_ssize_t item_size;
+  if (!PyArg_ParseTuple(args, "n", &item_size)) return nullptr;
+  if (item_size <= 0) {
+    PyErr_SetString(PyExc_ValueError, "item_size must be positive");
+    return nullptr;
+  }
+  ValueArrayObject* self = (ValueArrayObject*)type->tp_alloc(type, 0);
+  if (!self) return nullptr;
+  self->item_size = item_size;
+  self->nitems = 0;
+  self->buf = new std::vector<unsigned char>();
+  new (&self->lock) SpinLock();
+  return (PyObject*)self;
+}
+
+static void VA_dealloc(ValueArrayObject* self) {
+  delete self->buf;
+  Py_TYPE(self)->tp_free((PyObject*)self);
+}
+
+static PyObject* VA_set_size(ValueArrayObject* self, PyObject* args) {
+  Py_ssize_t n;
+  if (!PyArg_ParseTuple(args, "n", &n)) return nullptr;
+  if (n < 0) {
+    PyErr_SetString(PyExc_ValueError, "negative size");
+    return nullptr;
+  }
+  {
+    SpinGuard g(self->lock);
+    self->buf->resize((size_t)(n * self->item_size), 0);
+    self->nitems = n;
+  }
+  Py_RETURN_NONE;
+}
+
+static PyObject* VA_get(ValueArrayObject* self, PyObject* args) {
+  Py_ssize_t i;
+  if (!PyArg_ParseTuple(args, "n", &i)) return nullptr;
+  SpinGuard g(self->lock);
+  if (i < 0 || i >= self->nitems) {
+    PyErr_SetString(PyExc_IndexError, "ValueArray index out of range");
+    return nullptr;
+  }
+  return PyBytes_FromStringAndSize(
+      (const char*)self->buf->data() + i * self->item_size,
+      self->item_size);
+}
+
+static PyObject* VA_set(ValueArrayObject* self, PyObject* args) {
+  Py_ssize_t i;
+  Py_buffer view;
+  if (!PyArg_ParseTuple(args, "ny*", &i, &view)) return nullptr;
+  bool bad_len = view.len != self->item_size;
+  bool bad_idx = false;
+  if (!bad_len) {
+    SpinGuard g(self->lock);
+    if (i < 0 || i >= self->nitems) {
+      bad_idx = true;
+    } else {
+      std::memcpy(self->buf->data() + i * self->item_size, view.buf,
+                  (size_t)self->item_size);
+    }
+  }
+  PyBuffer_Release(&view);
+  if (bad_len) {
+    PyErr_Format(PyExc_ValueError, "expected %zd bytes per item",
+                 self->item_size);
+    return nullptr;
+  }
+  if (bad_idx) {
+    PyErr_SetString(PyExc_IndexError, "ValueArray index out of range");
+    return nullptr;
+  }
+  Py_RETURN_NONE;
+}
+
+static PyObject* VA_push_back(ValueArrayObject* self, PyObject* args) {
+  Py_buffer view;
+  if (!PyArg_ParseTuple(args, "y*", &view)) return nullptr;
+  if (view.len != self->item_size) {
+    PyBuffer_Release(&view);
+    PyErr_Format(PyExc_ValueError, "expected %zd bytes per item",
+                 self->item_size);
+    return nullptr;
+  }
+  Py_ssize_t idx;
+  {
+    SpinGuard g(self->lock);
+    idx = self->nitems;
+    self->buf->resize((size_t)((idx + 1) * self->item_size));
+    std::memcpy(self->buf->data() + idx * self->item_size, view.buf,
+                (size_t)self->item_size);
+    self->nitems = idx + 1;
+  }
+  PyBuffer_Release(&view);
+  return PyLong_FromSsize_t(idx);
+}
+
+static PyObject* VA_item_size(ValueArrayObject* self, PyObject*) {
+  return PyLong_FromSsize_t(self->item_size);
+}
+
+static Py_ssize_t VA_len(PyObject* o) {
+  ValueArrayObject* self = (ValueArrayObject*)o;
+  SpinGuard g(self->lock);
+  return self->nitems;
+}
+
+static PyMethodDef VA_methods[] = {
+    {"set_size", (PyCFunction)VA_set_size, METH_VARARGS,
+     "resize to n items (growth zero-fills)"},
+    {"get", (PyCFunction)VA_get, METH_VARARGS, "get(i) -> bytes"},
+    {"set", (PyCFunction)VA_set, METH_VARARGS, "set(i, bytes)"},
+    {"push_back", (PyCFunction)VA_push_back, METH_VARARGS,
+     "append one item, returns its index"},
+    {"item_size", (PyCFunction)VA_item_size, METH_NOARGS, ""},
+    {nullptr, nullptr, 0, nullptr}};
+
+static PySequenceMethods VA_as_seq = {VA_len};
+
+static PyTypeObject VAType = []{
+  PyTypeObject t = {PyVarObject_HEAD_INIT(nullptr, 0)};
+  t.tp_name = "_parsec_native.ValueArray";
+  t.tp_basicsize = sizeof(ValueArrayObject);
+  t.tp_flags = Py_TPFLAGS_DEFAULT;
+  t.tp_doc = "Growable array of fixed-size byte elements.";
+  t.tp_new = VA_new;
+  t.tp_dealloc = (destructor)VA_dealloc;
+  t.tp_methods = VA_methods;
+  t.tp_as_sequence = &VA_as_seq;
+  return t;
+}();
+
+/* ================================================================== */
 /* module                                                              */
 /* ================================================================== */
 static PyModuleDef native_module = {
@@ -1441,7 +1682,8 @@ PyMODINIT_FUNC PyInit__parsec_native(void) {
       {"Dequeue", &DequeueType}, {"OrderedList", &OrderedType},
       {"HashTable64", &HT64Type}, {"ZoneMalloc", &ZoneType},
       {"HBBuffer", &HBBufferType}, {"MaxHeap", &MaxHeapType},
-      {"NativeDAG", &DagType},
+      {"NativeDAG", &DagType},     {"RWLock", &RWLockType},
+      {"ValueArray", &VAType},
   };
   for (auto& t : types) {
     if (PyType_Ready(t.type) < 0) return nullptr;

@@ -203,3 +203,137 @@ def test_maxheap_split():
     stolen = h.split()
     assert len(stolen) + len(h) == 9
     assert len(stolen) >= 1
+
+
+# --------------------------------------------------------------------- #
+# rwlock + value_array (ref: parsec/class/parsec_rwlock.c,              #
+# value_array.h — the last class-system parity row, round-2 VERDICT 9)  #
+# --------------------------------------------------------------------- #
+def _rwlock_impls():
+    from parsec_tpu.core import sync
+    impls = [("python", sync.PyRWLock)]
+    if sync.RWLock is not sync.PyRWLock:
+        impls.append(("native", sync.RWLock))
+    return impls
+
+
+def _va_impls():
+    from parsec_tpu.core import sync
+    impls = [("python", sync.PyValueArray)]
+    if sync.ValueArray is not sync.PyValueArray:
+        impls.append(("native", sync.ValueArray))
+    return impls
+
+
+@pytest.mark.parametrize("name,cls", _rwlock_impls())
+def test_rwlock_under_contention(name, cls):
+    """Readers run concurrently, writers are exclusive: a shared counter
+    updated under write_lock must never tear, and readers must never
+    observe a half-applied update (two fields kept equal)."""
+    import threading
+
+    lk = cls()
+    state = {"a": 0, "b": 0}
+    N_WRITES = 200
+    errors = []
+
+    def writer():
+        for _ in range(N_WRITES):
+            lk.write_lock()
+            state["a"] += 1
+            state["b"] += 1
+            lk.write_unlock()
+
+    def reader():
+        for _ in range(400):
+            lk.read_lock()
+            a, b = state["a"], state["b"]
+            if a != b:
+                errors.append((a, b))
+            lk.read_unlock()
+
+    threads = ([threading.Thread(target=writer) for _ in range(2)]
+               + [threading.Thread(target=reader) for _ in range(4)])
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+        assert not t.is_alive(), "rwlock deadlock"
+    assert errors == [], f"readers saw torn writes: {errors[:5]}"
+    assert state["a"] == state["b"] == 2 * N_WRITES
+    assert lk.nreaders() == 0
+
+
+@pytest.mark.parametrize("name,cls", _rwlock_impls())
+def test_rwlock_readers_share(name, cls):
+    """Two readers must hold the lock simultaneously (a mutex in
+    disguise would serialize them and this test would time out waiting
+    for the second reader to observe the first)."""
+    import threading
+
+    lk = cls()
+    both_in = threading.Barrier(2, timeout=20)
+
+    def reader():
+        lk.read_lock()
+        both_in.wait()   # blocks until BOTH threads hold the read lock
+        lk.read_unlock()
+
+    threads = [threading.Thread(target=reader) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+        assert not t.is_alive(), "readers failed to share the lock"
+
+
+@pytest.mark.parametrize("name,cls", _va_impls())
+def test_value_array_basics(name, cls):
+    import struct
+
+    va = cls(8)
+    assert len(va) == 0 and va.item_size() == 8
+    va.set_size(3)
+    assert len(va) == 3
+    assert va.get(2) == b"\0" * 8          # growth zero-fills
+    va.set(1, struct.pack("<q", -42))
+    assert struct.unpack("<q", va.get(1))[0] == -42
+    idx = va.push_back(struct.pack("<q", 7))
+    assert idx == 3 and len(va) == 4
+    va.set_size(2)                          # shrink drops the tail
+    assert len(va) == 2
+    with pytest.raises(IndexError):
+        va.get(2)
+    with pytest.raises(ValueError):
+        va.set(0, b"short")
+
+
+@pytest.mark.parametrize("name,cls", _va_impls())
+def test_value_array_concurrent_push(name, cls):
+    """Concurrent push_back: every index handed out exactly once and
+    every element lands intact."""
+    import struct
+    import threading
+
+    va = cls(8)
+    got = [[] for _ in range(4)]
+
+    def pusher(slot):
+        for i in range(250):
+            v = slot * 1000 + i
+            idx = va.push_back(struct.pack("<q", v))
+            got[slot].append((idx, v))
+
+    threads = [threading.Thread(target=pusher, args=(s,)) for s in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+        assert not t.is_alive()
+    assert len(va) == 1000
+    indices = sorted(i for slot in got for (i, _v) in slot)
+    assert indices == list(range(1000))     # unique, dense
+    import struct as _s
+    for slot in got:
+        for idx, v in slot:
+            assert _s.unpack("<q", va.get(idx))[0] == v
