@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Break the cross-rank hop latency into components (VERDICT r2 item 8:
+replace the 'thread-scheduling dominates' prose with a measured table).
+
+A PTG ping-pong chain runs over the in-process fabric with timestamp
+probes at the four stages of one hop:
+
+  send      producer's comm engine posts the activation
+  arrival   the message lands in the receiver's transport inbox
+  callback  the receiver's activation handler runs (a worker woke up
+            and drained the inbox — the wakeup + progress component)
+  body      the successor task's body executes (release_deps, schedule,
+            prepare_input — the dispatch component)
+  next send the successor's own completion posts the next activation
+            (completion + iterate_successors + pack — turnaround)
+
+Components reported (median over hops):
+  wire       = arrival - send        (transport post; ~memcpy in-process)
+  wakeup     = callback - arrival    (worker wake + inbox drain)
+  dispatch   = body - callback       (release/schedule/prepare/exec entry)
+  turnaround = next send - body      (complete + successors + pack)
+
+Usage: python tools/rtt_breakdown.py [hops]
+Prints one JSON line; exit 0.
+"""
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+sys.path.insert(0, os.path.join(ROOT, "tests"))
+
+RTT_JDF = """
+descX [ type="collection" ]
+NB [ type="int" ]
+
+PING(k)
+
+k = 0 .. NB-1
+
+: descX( k % 2, 0 )
+
+RW X <- (k == 0) ? descX( 0, 0 ) : X PING( k-1 )
+     -> (k < NB-1) ? X PING( k+1 )
+     -> (k == NB-1) ? descX( (NB-1) % 2, 0 )
+
+BODY
+{
+    X[0, 0] = X[0, 0] + 1.0
+    stamp()
+}
+END
+"""
+
+
+def measure(hops: int = 60, mb: int = 8):
+    import numpy as np
+
+    import parsec_tpu
+    from parsec_tpu.comm import LocalFabric, RemoteDepEngine
+    from parsec_tpu.comm.engine import TAG_ACTIVATE
+    from parsec_tpu.collections import TwoDimBlockCyclic
+    from parsec_tpu.dsl import ptg
+
+    events = []   # (kind, t) — the chain is serial, so global order pairs
+
+    def rank_fn(rank, fabric):
+        eng = RemoteDepEngine(fabric.engine(rank))
+        ce = eng.ce
+
+        orig_send = ce.send_am
+
+        def send_am(dst, tag, payload):
+            if tag == TAG_ACTIVATE:
+                events.append(("send", time.perf_counter()))
+            return orig_send(dst, tag, payload)
+
+        ce.send_am = send_am
+        orig_cb = ce._tag_cbs[TAG_ACTIVATE]
+
+        def on_act(src, msg):
+            events.append(("cb", time.perf_counter()))
+            return orig_cb(src, msg)
+
+        ce._tag_cbs[TAG_ACTIVATE] = on_act
+        ctx = parsec_tpu.Context(nb_cores=1, comm=eng, enable_tpu=False)
+        orig_arr = ce.on_arrival
+
+        def on_arr():
+            events.append(("arrival", time.perf_counter()))
+            if orig_arr is not None:
+                orig_arr()
+
+        ce.on_arrival = on_arr
+        try:
+            coll = TwoDimBlockCyclic(2 * mb, mb, mb, mb, P=2, Q=1,
+                                     nodes=2, rank=rank, dtype=np.float32)
+            coll.name = "descX"
+            tp = ptg.compile_jdf(RTT_JDF, name="rttb").new(
+                descX=coll, NB=hops, rank=rank, nb_ranks=2)
+            tp.global_env["stamp"] = lambda: events.append(
+                ("body", time.perf_counter()))
+            t0 = time.perf_counter()
+            ctx.add_taskpool(tp)
+            ctx.wait()
+            return time.perf_counter() - t0
+        finally:
+            ctx.fini()
+
+    from conftest import spmd
+    results, _ = spmd(2, rank_fn)
+    wall = max(r for r in results if r is not None)
+
+    ev = sorted(events, key=lambda e: e[1])
+    comp = {"wire": [], "wakeup": [], "dispatch": [], "turnaround": []}
+    # walk send -> arrival -> cb -> body -> (next) send
+    for i, (kind, t) in enumerate(ev):
+        if kind != "send":
+            continue
+        seq = {"send": t}
+        want = ["arrival", "cb", "body", "send"]
+        j = i + 1
+        for w in want:
+            while j < len(ev) and ev[j][0] != w:
+                j += 1
+            if j >= len(ev):
+                break
+            seq[w + "2" if w == "send" else w] = ev[j][1]
+            j += 1
+        if "arrival" in seq and "cb" in seq and "body" in seq:
+            comp["wire"].append(seq["arrival"] - seq["send"])
+            comp["wakeup"].append(seq["cb"] - seq["arrival"])
+            comp["dispatch"].append(seq["body"] - seq["cb"])
+            if "send2" in seq:
+                comp["turnaround"].append(seq["send2"] - seq["body"])
+
+    def med(xs):
+        xs = sorted(xs)
+        return xs[len(xs) // 2] * 1e6 if xs else float("nan")
+
+    out = {k: round(med(v), 1) for k, v in comp.items()}
+    out["hop_total_us"] = round(sum(v for v in out.values()), 1)
+    out["rtt_us"] = round(2 * out["hop_total_us"], 1)
+    out["wall_us_per_rtt"] = round(wall / (hops / 2) * 1e6, 1)
+    out["hops"] = hops
+    return out
+
+
+if __name__ == "__main__":
+    hops = int(sys.argv[1]) if len(sys.argv) > 1 else 60
+    print(json.dumps(measure(hops)))
