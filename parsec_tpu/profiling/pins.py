@@ -326,3 +326,13 @@ class HWCountersModule(PinsModule):
                 n = max(1, self.count.get(name, 0))
                 out[name] = {k: v / n for k, v in tot.items()}
         return out
+
+
+# discoverable by (framework="pins", name) like the reference's MCA
+# component tables (mca_repository.c); out-of-tree modules load by
+# dotted path or entry point through the same repository
+from ..utils import mca as _mca  # noqa: E402
+
+for _cls in (TaskProfilerModule, PrintStealsModule, AlperfModule,
+             IteratorsCheckerModule, TaskTimeModule, HWCountersModule):
+    _mca.register("pins", _cls.name, _cls)

@@ -160,16 +160,21 @@ class FourCounterTermDet(LocalTermDet):
             self.taskpool.termination_detected()
 
 
+from ..utils import mca as _mca
+
 _MODULES: Dict[str, Any] = {
     "local": LocalTermDet,
     "user_trigger": UserTriggerTermDet,
     "fourcounter": FourCounterTermDet,
 }
+for _n, _c in _MODULES.items():
+    _mca.register("termdet", _n, _c)
 
 
 def termdet_new(name: str, taskpool, **kw) -> TermDet:
-    try:
-        cls = _MODULES[name]
-    except KeyError:
-        raise ValueError(f"unknown termdet module {name!r}; have {sorted(_MODULES)}")
+    cls = _mca.open_component("termdet", name)
+    if cls is None:
+        raise ValueError(
+            f"unknown termdet module {name!r}; "
+            f"have {_mca.components('termdet')}")
     return cls(taskpool, **kw)

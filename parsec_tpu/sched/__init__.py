@@ -12,29 +12,37 @@ from .modules import (APScheduler, GDScheduler, IPScheduler, LFQScheduler,
                       LHQScheduler, LLScheduler, LTQScheduler, PBQScheduler,
                       RNDScheduler, SPQScheduler)
 
-_REGISTRY: Dict[str, Type[SchedulerModule]] = {
-    cls.name: cls for cls in (
-        LFQScheduler, LHQScheduler, LTQScheduler, LLScheduler, GDScheduler,
-        APScheduler, IPScheduler, SPQScheduler, PBQScheduler, RNDScheduler)
-}
+from ..utils import mca
+
+for _cls in (LFQScheduler, LHQScheduler, LTQScheduler, LLScheduler,
+             GDScheduler, APScheduler, IPScheduler, SPQScheduler,
+             PBQScheduler, RNDScheduler):
+    mca.register("sched", _cls.name, _cls)
+
+# kept for introspection/tests; the authoritative table is the MCA
+# repository ("sched" framework — dotted paths and entry points load
+# out-of-tree schedulers by name, mca_repository.c analog)
+_REGISTRY: Dict[str, Type[SchedulerModule]] = dict(
+    (n, mca.open_component("sched", n)) for n in mca.components("sched"))
 
 
 def sched_new(name: str) -> SchedulerModule:
-    try:
-        return _REGISTRY[name]()
-    except KeyError:
+    cls = mca.open_component("sched", name)
+    if cls is None:
         # the reference's MCA select logs help and falls back to the
         # default component rather than failing init (scheduling.c:246-272)
         from ..utils.show_help import show_help
         show_help("help-runtime.txt", "unknown-scheduler", want_error=True,
-                  name=name, available=", ".join(sorted(_REGISTRY)),
+                  name=name, available=", ".join(available()),
                   fallback="lfq")
-        return _REGISTRY["lfq"]()
+        cls = mca.open_component("sched", "lfq")
+    return cls()
 
 
 def sched_register(cls: Type[SchedulerModule]) -> None:
+    mca.register("sched", cls.name, cls)
     _REGISTRY[cls.name] = cls
 
 
 def available() -> list:
-    return sorted(_REGISTRY)
+    return mca.components("sched")

@@ -1,0 +1,90 @@
+"""MCA component repository: components discoverable and loadable by
+(framework, name) at runtime.
+
+Reference behavior: ``mca_components_open_bytype`` — every pluggable
+subsystem (sched, device, pins, termdet) is a *framework* whose
+components live in a repository; static tables hold the built-ins and
+components can be opened by name at runtime
+(ref: parsec/mca/mca_repository.c:1-225,
+parsec/mca/mca_static_components.h.in).
+
+TPU-native re-design: the built-in tables register here at import; two
+DYNAMIC paths close the reference's load-by-type gap —
+- a dotted path as the component name (``mypkg.mymod:MyClass`` or
+  ``mypkg.mymod.MyClass``) imports the module and returns the class, so
+  ``--mca sched mypkg.sched:Fancy`` plugs an out-of-tree scheduler in
+  with no code changes;
+- installed distributions may advertise components through the
+  ``parsec_tpu.<framework>`` entry-point group (the analog of dropping
+  a DSO into the reference's component dir).
+Opened dynamic components are cached in the framework table, so
+repeated opens are dict lookups.
+"""
+from __future__ import annotations
+
+import importlib
+from typing import Any, Dict, List, Optional
+
+_frameworks: Dict[str, Dict[str, Any]] = {}
+
+
+def register(framework: str, name: str, component: Any) -> None:
+    """Add a component to ``framework``'s table (built-ins do this at
+    import; dynamic opens cache through here too)."""
+    _frameworks.setdefault(framework, {})[name] = component
+
+
+def _load_dotted(path: str) -> Any:
+    """Import ``pkg.mod:Attr`` (or ``pkg.mod.Attr``) and return Attr."""
+    if ":" in path:
+        modname, _, attr = path.partition(":")
+        return getattr(importlib.import_module(modname), attr)
+    modname, _, attr = path.rpartition(".")
+    if not modname:
+        raise ImportError(f"not a dotted component path: {path!r}")
+    return getattr(importlib.import_module(modname), attr)
+
+
+def open_component(framework: str, name: str) -> Optional[Any]:
+    """Look up a component: framework table, then dotted-path import,
+    then the ``parsec_tpu.<framework>`` entry-point group. Returns None
+    when nothing matches (callers decide their fallback, like the
+    reference's select-with-default)."""
+    tbl = _frameworks.setdefault(framework, {})
+    comp = tbl.get(name)
+    if comp is not None:
+        return comp
+    if "." in name or ":" in name:
+        try:
+            comp = _load_dotted(name)
+        except (ImportError, AttributeError):
+            return None
+        tbl[name] = comp
+        return comp
+    try:
+        from importlib import metadata
+        for ep in metadata.entry_points(group=f"parsec_tpu.{framework}"):
+            if ep.name == name:
+                comp = ep.load()
+                tbl[name] = comp
+                return comp
+    except Exception:  # pragma: no cover - metadata backend quirks
+        pass
+    return None
+
+
+def components(framework: str) -> List[str]:
+    """Registered + advertised component names for one framework."""
+    names = set(_frameworks.get(framework, {}))
+    try:
+        from importlib import metadata
+        names.update(
+            ep.name
+            for ep in metadata.entry_points(group=f"parsec_tpu.{framework}"))
+    except Exception:  # pragma: no cover
+        pass
+    return sorted(names)
+
+
+def frameworks() -> List[str]:
+    return sorted(_frameworks)
