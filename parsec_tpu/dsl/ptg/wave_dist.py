@@ -57,14 +57,26 @@ def _ensure_wave_inbox(ce):
     CE regardless of how many runners/pools exist; keys carry the pool
     name + run epoch so concurrent or back-to-back runs can't alias.
     Messages for an epoch older than the pool's current one are dropped
-    on arrival (their run already finished or failed)."""
+    on arrival (their run already finished or failed). Park-release
+    acks (device-plane payload hop) ride the same tag."""
     cv = getattr(ce, "_wave_inbox_cv", None)
     if cv is None:
         ce._wave_inbox = {}
         ce._wave_epochs = getattr(ce, "_wave_epochs", {})
+        ce._wave_parks = set()
         cv = ce._wave_inbox_cv = threading.Condition()
 
         def _on_msg(src: int, msg: Dict) -> None:
+            if "ack_uuids" in msg:
+                plane = getattr(ce, "device_plane", None)
+                for u in msg["ack_uuids"]:
+                    if plane is not None:
+                        plane.release(u)
+                with cv:
+                    for u in msg["ack_uuids"]:
+                        ce._wave_parks.discard(u)
+                    cv.notify_all()
+                return
             key = (msg["pool"], msg["epoch"], src, msg["wave"])
             with cv:
                 if msg["epoch"] < ce._wave_epochs.get(msg["pool"], 0):
@@ -74,6 +86,13 @@ def _ensure_wave_inbox(ce):
 
         ce.tag_register(TAG_WAVE, _on_msg)
     return ce._wave_inbox, cv
+
+
+def _is_single_device(arr) -> bool:
+    try:
+        return len(arr.devices()) == 1
+    except Exception:  # numpy or committed-less tracer output
+        return False
 
 
 class DistWaveRunner(WaveRunner):
@@ -296,11 +315,13 @@ class DistWaveRunner(WaveRunner):
                 pools = self._comm_step(lv + 1, pools)
         finally:
             # drop anything still keyed to this run (abort/timeout paths
-            # must not leak tile payloads on the long-lived CE)
+            # must not leak tile payloads on the long-lived CE), and
+            # wait out the consumers' park acks (device-plane hop)
             with cv:
                 for k in [k for k in inbox
                           if k[0] == pool_name and k[1] == epoch]:
                     del inbox[k]
+            self._drain_parks()
         plog.debug.verbose(
             3, "dist wave %s rank %d: %d/%d tasks in %d waves, %d kernel "
             "calls, %d transfers scheduled", pool_name, self.rank,
@@ -310,14 +331,35 @@ class DistWaveRunner(WaveRunner):
 
     def _comm_step(self, w: int, pools: Tuple) -> Tuple:
         """Push my wave-w writes to their remote readers, then absorb
-        what wave w wrote elsewhere that I will read."""
+        what wave w wrote elsewhere that I will read.
+
+        Payload hop: with a DeviceDataPlane attached on both ends, the
+        gathered tiles stay ONE stacked DEVICE array — the producer
+        parks it, the message carries only the descriptor, and the
+        consumer pulls device-to-device then acks the park (the
+        schedule is unchanged; only the bytes' route differs). Without
+        a plane (or for multi-device/sharded pools) tiles ride the CE
+        as host bytes."""
+        import jax
+        import jax.numpy as jnp
+
         pool_name, epoch = self._cur
+        plane = getattr(self.ce, "device_plane", None)
         for dst in sorted(self._sends.get(w, ())):
             colls = []
             for cid in sorted(self._sends[w][dst]):
                 idxs = self._sends[w][dst][cid]
-                arr = np.asarray(pools[cid][np.asarray(idxs, np.int32)])
-                colls.append((cid, idxs, arr))
+                gathered = pools[cid][np.asarray(idxs, np.int32)]
+                if plane is not None and _is_single_device(gathered):
+                    jax.block_until_ready(gathered)
+                    u, shape, dt = plane.register(gathered)
+                    _ib, cv = _ensure_wave_inbox(self.ce)
+                    with cv:
+                        self.ce._wave_parks.add(u)
+                    colls.append((cid, idxs,
+                                  {"xfer": (u, tuple(shape), dt)}))
+                else:
+                    colls.append((cid, idxs, np.asarray(gathered)))
             self.ce.send_am(dst, TAG_WAVE,
                             {"pool": pool_name, "epoch": epoch, "wave": w,
                              "colls": colls})
@@ -329,18 +371,55 @@ class DistWaveRunner(WaveRunner):
         # .at[].set() per (src, coll) would copy the whole stacked pool
         # each time (pools are O(matrix) — tens of copies per run)
         upd: Dict[int, Tuple[List[int], List[Any]]] = {}
+        pulled: List[Tuple[int, int, Any]] = []   # (src, uuid, array)
         for src in srcs:
             msg = self._await_msg(src, w)
-            for cid, idxs, arr in msg["colls"]:
+            for cid, idxs, payload in msg["colls"]:
+                if isinstance(payload, dict):
+                    u, shape, dt = payload["xfer"]
+                    arr = plane.pull(src, u, tuple(shape), dt)
+                    pulled.append((src, u, arr))
+                else:
+                    arr = np.asarray(payload)
                 lst = upd.setdefault(cid, ([], []))
                 lst[0].extend(idxs)
-                lst[1].append(np.asarray(arr))
+                lst[1].append(arr)
+        if pulled:
+            # the ack releases the producer's park: only after the
+            # bytes actually landed
+            jax.block_until_ready([a for (_s, _u, a) in pulled])
+            by_src: Dict[int, List[int]] = {}
+            for (s, u, _a) in pulled:
+                by_src.setdefault(s, []).append(u)
+            for s, uuids in by_src.items():
+                self.ce.send_am(s, TAG_WAVE, {"ack_uuids": uuids})
         plist = list(pools)
         for cid, (idxs, arrs) in upd.items():
-            vals = np.concatenate(arrs, axis=0)
+            vals = (jnp.concatenate([jnp.asarray(a) for a in arrs], axis=0)
+                    if len(arrs) > 1 else jnp.asarray(arrs[0]))
             plist[cid] = self._scatter_kernel(len(idxs))(
                 plist[cid], np.asarray(idxs, np.int32), vals)
         return tuple(plist)
+
+    def _drain_parks(self) -> None:
+        """Wait for consumers' park acks so no transfer buffers leak on
+        the long-lived CE (generous timeout, warn instead of failing a
+        completed run)."""
+        _ib, cv = _ensure_wave_inbox(self.ce)
+        deadline = time.monotonic() + self.comm_timeout
+        while True:
+            with cv:
+                n = len(self.ce._wave_parks)
+            if n == 0:
+                return
+            if time.monotonic() > deadline:
+                plog.warning("rank %d: %d wave transfer park(s) never "
+                             "acked within %.0fs", self.rank, n,
+                             self.comm_timeout)
+                return
+            self.ce.progress()
+            with cv:
+                cv.wait(0.0005)
 
     def _scatter_kernel(self, k: int):
         """Donated jitted pool scatter for k tiles (cached per count —

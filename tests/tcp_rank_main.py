@@ -123,11 +123,19 @@ def run_dposv(ctx, eng, rank, nb_ranks, n=96, nb=32, nrhs=16,
     return err
 
 
-def run_wave(eng, rank, nb_ranks, n=256, nb=64):
+def run_wave(eng, rank, nb_ranks, n=256, nb=64, use_plane=False):
     """Distributed WAVE dpotrf across real OS processes: every rank
     executes its block-cyclic slice as batched kernels, tile exchange
-    rides TAG_WAVE messages over the sockets (dsl/ptg/wave_dist.py)."""
+    rides TAG_WAVE messages over the sockets (dsl/ptg/wave_dist.py).
+    With ``use_plane`` the tile payloads move device-to-device through
+    the transfer plane; TCP carries only descriptors + acks."""
     from parsec_tpu.ops import dpotrf_taskpool, make_spd
+
+    plane = None
+    if use_plane:
+        from parsec_tpu.comm import DeviceDataPlane
+        plane = DeviceDataPlane(eng)
+        plane.exchange()
 
     M = make_spd(n, dtype=np.float64)
     coll = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float64, P=nb_ranks,
@@ -147,7 +155,12 @@ def run_wave(eng, rank, nb_ranks, n=256, nb=64):
             t = np.tril(t)
         err = max(err, float(np.abs(
             t - ref[i * nb:(i + 1) * nb, j * nb:(j + 1) * nb]).max()))
-    return err
+    stats = None
+    if plane is not None:
+        with plane._lock:
+            leaked = len(plane._parked)
+        stats = dict(plane.stats, leaked_parks=leaked)
+    return err, stats
 
 
 def run_xfer_stress(eng, rank, nb_ranks, n_tiles=96, nb=512, workers=8):
@@ -283,14 +296,18 @@ def main() -> int:
             return 0
         finally:
             eng.fini()
-    if mode == "wave":
+    if mode in ("wave", "wave_xfer"):
         # distributed wave execution drives the CE directly (no context)
         try:
-            err = run_wave(eng, rank, nb_ranks)
+            err, xstats = run_wave(eng, rank, nb_ranks,
+                                   use_plane=(mode == "wave_xfer"))
             eng.sync()
-            print(json.dumps({"rank": rank, "max_err": err,
-                              "msgs": eng.fabric.msg_count,
-                              "bytes": eng.fabric.bytes_count}), flush=True)
+            out = {"rank": rank, "max_err": err,
+                   "msgs": eng.fabric.msg_count,
+                   "bytes": eng.fabric.bytes_count}
+            if xstats is not None:
+                out["xfer"] = xstats
+            print(json.dumps(out), flush=True)
             return 0
         finally:
             eng.fini()

@@ -169,6 +169,22 @@ def test_wave_dpotrf_across_processes():
     assert sum(o["bytes"] for o in outs) > 4 * 64 * 64 * 4  # tiles crossed
 
 
+def test_wave_dpotrf_device_plane_across_processes():
+    """Distributed wave with the device-plane payload hop: tile
+    exchanges move device-to-device through the transfer plane, TCP
+    carries only descriptors and park acks; zero leaked parks, same
+    numerics."""
+    outs = _run_ranks(2, 0, mode="wave_xfer", timeout=300)
+    assert all(o["max_err"] < 5e-3 for o in outs), outs
+    tile_bytes = 64 * 64 * 8
+    pulls = sum(o["xfer"]["pulls"] for o in outs)
+    assert pulls > 0, outs
+    assert all(o["xfer"]["leaked_parks"] == 0 for o in outs), outs
+    # the control plane must NOT be carrying the tiles: wire bytes stay
+    # far below the exchanged tile volume
+    assert sum(o["bytes"] for o in outs) < pulls * tile_bytes / 2, outs
+
+
 def test_dposv_across_processes():
     """Distributed Cholesky solve across 4 real OS processes: three
     sequential taskpools, panel broadcasts, cross-rank writebacks and
