@@ -62,6 +62,33 @@ def check_numerics(L_np, M, n):
     return float(np.abs(L @ (L.T @ X) - ref).max() / np.abs(ref).max())
 
 
+def check_numerics_device(tile_map, M, n, nb):
+    """Same residual computed ON DEVICE from the factored tiles: only
+    scalars cross the link. A bulk D2H of the factor (256 MB at the
+    tunnel's worst ~3 MB/s) takes minutes AND degrades the link for
+    every later mode in the composite — verification must not poison
+    the measurements it gates."""
+    import jax
+    import jax.numpy as jnp
+
+    coords = sorted(tile_map)
+    tiles = [tile_map[c] for c in coords]
+
+    def resid(ts, Md, X):
+        L = jnp.zeros((n, n), ts[0].dtype)
+        for (m, k), t in zip(coords, ts):
+            if m == k:
+                t = jnp.tril(t)
+            L = L.at[m * nb:(m + 1) * nb, k * nb:(k + 1) * nb].set(t)
+        ref = Md @ X
+        return jnp.abs(L @ (L.T @ X) - ref).max() / jnp.abs(ref).max()
+
+    rng = np.random.RandomState(0)
+    X = jax.device_put(rng.rand(n, 4).astype(np.float32))
+    Md = jax.device_put(M.astype(np.float32))
+    return float(jax.jit(resid)(tiles, Md, X))
+
+
 NUMERICS_TOL = 5e-2
 
 
@@ -113,11 +140,8 @@ def bench_capture(n, nb, reps, dtype):
         jax.block_until_ready(out)
         dt = time.perf_counter() - t0
         best = dt if best is None else min(best, dt)
-    Lh = np.zeros((n, n), dtype)
-    for (m, k), arr in out["descA"].items():
-        if m >= k:  # lower tiles only: skip untouched upper-tile pulls
-            Lh[m * nb:(m + 1) * nb, k * nb:(k + 1) * nb] = np.asarray(arr)
-    return best, check_numerics(Lh, M, n)
+    lower = {(m, k): arr for (m, k), arr in out["descA"].items() if m >= k}
+    return best, check_numerics_device(lower, M, n, nb)
 
 
 def bench_wave(n, nb, reps, dtype):
@@ -145,8 +169,10 @@ def bench_wave(n, nb, reps, dtype):
         jax.block_until_ready(pools)
         dt = time.perf_counter() - t0
         best = dt if best is None else min(best, dt)
-    w.scatter_pools(pools)
-    return best, check_numerics(np.tril(A.to_numpy()), M, n)
+    cid = w.coll_names.index("descA") if "descA" in w.coll_names else 0
+    coords = sorted(A.tiles())
+    lower = {c: pools[cid][i] for i, c in enumerate(coords) if c[0] >= c[1]}
+    return best, check_numerics_device(lower, M, n, nb)
 
 
 def bench_runtime(n, nb, reps, cores, dtype):
@@ -268,14 +294,21 @@ def bench_all(n, nb, reps, cores, dtype):
     if g is not None:
         extras["chip_gemm_gflops(2048^3,f32)"] = round(g, 1)
 
+    # strongest candidate FIRST: the tunnel degrades within a session
+    # under load, so later modes see a worse link than earlier ones.
+    # NB=1024 halves the kernel count vs 512: on a latency-degraded
+    # tunnel the larger calls amortize per-dispatch cost ~2x better
+    # (2026-07-30: 15.0 vs 7.4 TF/s); both are MXU-bound when healthy
+    _record("wave", n, 1024,
+            _try("wave1024", lambda: bench_wave(n, 1024, reps, dtype)))
     _record("wave", n, 512,
             _try("wave512", lambda: bench_wave(n, 512, reps, dtype)))
+    _record("capture", n, nb,
+            _try("capture", lambda: bench_capture(n, nb, reps, dtype)))
     n_rt = int(os.environ.get("BENCH_RUNTIME_N", "4096"))
     _record("runtime", n_rt, 512,
             _try("runtime512",
                  lambda: bench_runtime(n_rt, 512, max(2, reps), cores, dtype)))
-    _record("capture", n, nb,
-            _try("capture", lambda: bench_capture(n, nb, reps, dtype)))
 
     if not candidates:
         print(json.dumps({"metric": "dpotrf_gflops", "value": 0.0,
