@@ -17,6 +17,8 @@ import pytest
     "examples.ex07_raw_ctl",
     "examples.ex08_dposv_checkpoint",
     "examples.ex09_capture",
+    "examples.ex10_dposv_multiprocess",
+    "examples.ex11_wave_distributed",
 ])
 def test_example_runs(mod):
     m = importlib.import_module(mod)
