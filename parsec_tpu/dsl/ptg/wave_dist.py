@@ -303,6 +303,7 @@ class DistWaveRunner(WaveRunner):
                 ce._wave_epochs.get(pool_name, 0) + 1)
         self._cur = (pool_name, epoch)
 
+        ok = False
         try:
             pools = self._comm_step(0, pools)
             n_calls = 0
@@ -313,15 +314,19 @@ class DistWaveRunner(WaveRunner):
                         mine, self.dag.class_of[mine], pools)
                     n_calls += nc
                 pools = self._comm_step(lv + 1, pools)
+            ok = True
         finally:
             # drop anything still keyed to this run (abort/timeout paths
             # must not leak tile payloads on the long-lived CE), and
-            # wait out the consumers' park acks (device-plane hop)
+            # wait out the consumers' park acks (device-plane hop). On
+            # the exception path acks may never come (the peer that
+            # would send them is likely the failure) — don't stall the
+            # real error behind a second full timeout
             with cv:
                 for k in [k for k in inbox
                           if k[0] == pool_name and k[1] == epoch]:
                     del inbox[k]
-            self._drain_parks()
+            self._drain_parks(timeout=self.comm_timeout if ok else 1.0)
         plog.debug.verbose(
             3, "dist wave %s rank %d: %d/%d tasks in %d waves, %d kernel "
             "calls, %d transfers scheduled", pool_name, self.rank,
@@ -376,6 +381,12 @@ class DistWaveRunner(WaveRunner):
             msg = self._await_msg(src, w)
             for cid, idxs, payload in msg["colls"]:
                 if isinstance(payload, dict):
+                    if plane is None:  # not assert: must survive python -O
+                        raise WaveError(
+                            f"rank {self.rank}: peer {src} sent a device-"
+                            f"plane transfer descriptor but this rank has "
+                            f"no DeviceDataPlane attached (attach one on "
+                            f"every rank)")
                     u, shape, dt = payload["xfer"]
                     arr = plane.pull(src, u, tuple(shape), dt)
                     pulled.append((src, u, arr))
@@ -401,12 +412,11 @@ class DistWaveRunner(WaveRunner):
                 plist[cid], np.asarray(idxs, np.int32), vals)
         return tuple(plist)
 
-    def _drain_parks(self) -> None:
+    def _drain_parks(self, timeout: float) -> None:
         """Wait for consumers' park acks so no transfer buffers leak on
-        the long-lived CE (generous timeout, warn instead of failing a
-        completed run)."""
+        the long-lived CE (warn instead of failing a completed run)."""
         _ib, cv = _ensure_wave_inbox(self.ce)
-        deadline = time.monotonic() + self.comm_timeout
+        deadline = time.monotonic() + timeout
         while True:
             with cv:
                 n = len(self.ce._wave_parks)
@@ -414,8 +424,7 @@ class DistWaveRunner(WaveRunner):
                 return
             if time.monotonic() > deadline:
                 plog.warning("rank %d: %d wave transfer park(s) never "
-                             "acked within %.0fs", self.rank, n,
-                             self.comm_timeout)
+                             "acked within %.0fs", self.rank, n, timeout)
                 return
             self.ce.progress()
             with cv:

@@ -21,9 +21,12 @@ for _cls in (LFQScheduler, LHQScheduler, LTQScheduler, LLScheduler,
 
 # kept for introspection/tests; the authoritative table is the MCA
 # repository ("sched" framework — dotted paths and entry points load
-# out-of-tree schedulers by name, mca_repository.c analog)
-_REGISTRY: Dict[str, Type[SchedulerModule]] = dict(
-    (n, mca.open_component("sched", n)) for n in mca.components("sched"))
+# out-of-tree schedulers by name, mca_repository.c analog). Built from
+# the static tuple: entry points stay LAZY (loaded only when selected)
+_REGISTRY: Dict[str, Type[SchedulerModule]] = {
+    cls.name: cls for cls in (
+        LFQScheduler, LHQScheduler, LTQScheduler, LLScheduler, GDScheduler,
+        APScheduler, IPScheduler, SPQScheduler, PBQScheduler, RNDScheduler)}
 
 
 def sched_new(name: str) -> SchedulerModule:

@@ -365,7 +365,7 @@ END
 
 def test_wave_sharded_dpotrf_at_size():
     """End-to-end SHARDED dpotrf at meaningful size (round-2 VERDICT
-    item 10: the sharded path was only toy-tested): NT=16 (2048/128)
+    item 10: the sharded path was only toy-tested): NT=16 (1024/64)
     over the full 8-device virtual mesh, every wave kernel GSPMD-
     partitioned, numerics vs numpy Cholesky."""
     import time
