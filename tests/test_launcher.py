@@ -70,3 +70,45 @@ def test_launch_fail_fast(tmp_path):
          "-n", "2", str(bad)],
         capture_output=True, text=True, timeout=120, env=env)
     assert p.returncode == 9
+
+
+def test_launch_jax_distributed_cross_process_collective(tmp_path):
+    """A jitted reduction over an array sharded across BOTH processes:
+    XLA inserts a cross-process all-reduce over the distributed runtime
+    — the actual §5.8 execution substrate, not just device counting."""
+    probe = tmp_path / "coll.py"
+    probe.write_text(
+        "import sys; sys.path.insert(0, %r)\n"
+        "import numpy as np\n"
+        "import parsec_tpu\n"
+        "ctx = parsec_tpu.init(nb_cores=1)\n"
+        "import jax, jax.numpy as jnp\n"
+        "from jax.sharding import Mesh, NamedSharding, PartitionSpec as P\n"
+        "devs = jax.devices()\n"
+        "mesh = Mesh(np.array(devs), ('x',))\n"
+        "sh = NamedSharding(mesh, P('x'))\n"
+        "n = len(devs)\n"
+        "local = [jax.device_put(\n"
+        "    np.full((1, 4), float(devs.index(d)), np.float32), d)\n"
+        "    for d in jax.local_devices()]\n"
+        "garr = jax.make_array_from_single_device_arrays(\n"
+        "    (n, 4), sh, local)\n"
+        "out = jax.jit(lambda a: a.sum(),\n"
+        "              out_shardings=NamedSharding(mesh, P()))(garr)\n"
+        "total = float(out)\n"
+        "expect = 4.0 * sum(range(n))\n"
+        "assert total == expect, (total, expect)\n"
+        "print(f'rank {ctx.rank}: allreduce over {n} devices across '\n"
+        "      f'{jax.process_count()} processes = {total} OK')\n"
+        "ctx.fini()\n" % ROOT)
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    p = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "2", "--jax-distributed", str(probe)],
+        capture_output=True, text=True, timeout=240, env=env)
+    assert p.returncode == 0, (p.stdout[-3000:], p.stderr[-2000:])
+    assert p.stdout.count("across 2 processes = 112.0 OK") == 2, \
+        p.stdout[-2000:]
