@@ -719,10 +719,13 @@ class WaveRunner:
     def execute(self, pools: Tuple) -> Tuple:
         """Run the DAG over device tile pools (one stacked array per
         collection, ordered by self.coll_names); returns final pools."""
+        import time as _time
+
         dag = self.dag
         eng = make_engine(dag)
         ready = np.asarray(eng.start(), np.int32)
         n_waves = n_calls = 0
+        t0 = _time.perf_counter()
         while ready.size:
             n_waves += 1
             pools, nc = self._execute_frontier(ready, dag.class_of[ready],
@@ -733,9 +736,15 @@ class WaveRunner:
         if int(done) != dag.n_tasks:
             raise WaveError(
                 f"wave execution stalled: {done}/{dag.n_tasks} tasks ran")
-        plog.debug.verbose(3, "wave %s: %d tasks in %d waves, %d kernel "
-                           "calls", self.tp.name, dag.n_tasks, n_waves,
-                           n_calls)
+        # observability: the engineering counters a profiler of the
+        # per-task path would have shown (wave bypasses PINS sites by
+        # design — dispatch IS what it amortizes away)
+        self.stats = {"tasks": dag.n_tasks, "waves": n_waves,
+                      "kernel_calls": n_calls,
+                      "dispatch_secs": round(_time.perf_counter() - t0, 6),
+                      "compiled_kernels": sum(len(p.kernels)
+                                              for p in self.plans)}
+        plog.debug.verbose(3, "wave %s: %s", self.tp.name, self.stats)
         return pools
 
     def _split_war(self, ids: np.ndarray, classes: np.ndarray):

@@ -302,6 +302,8 @@ class DistWaveRunner(WaveRunner):
             epoch = ce._wave_epochs[pool_name] = (
                 ce._wave_epochs.get(pool_name, 0) + 1)
         self._cur = (pool_name, epoch)
+        self._sent_tiles = 0
+        self._recv_tiles = 0
 
         ok = False
         try:
@@ -315,6 +317,15 @@ class DistWaveRunner(WaveRunner):
                     n_calls += nc
                 pools = self._comm_step(lv + 1, pools)
             ok = True
+            self.stats = {
+                "tasks": self.dag.n_tasks,
+                "local_tasks": int((self._rank_of_task == self.rank).sum()),
+                "waves": len(self._levels),
+                "kernel_calls": n_calls,
+                "transfers_scheduled": self._n_transfers,
+                "tiles_sent": self._sent_tiles,
+                "tiles_recv": self._recv_tiles,
+            }
         finally:
             # drop anything still keyed to this run (abort/timeout paths
             # must not leak tile payloads on the long-lived CE), and
@@ -365,6 +376,7 @@ class DistWaveRunner(WaveRunner):
                                   {"xfer": (u, tuple(shape), dt)}))
                 else:
                     colls.append((cid, idxs, np.asarray(gathered)))
+                self._sent_tiles += len(idxs)
             self.ce.send_am(dst, TAG_WAVE,
                             {"pool": pool_name, "epoch": epoch, "wave": w,
                              "colls": colls})
@@ -395,6 +407,7 @@ class DistWaveRunner(WaveRunner):
                 lst = upd.setdefault(cid, ([], []))
                 lst[0].extend(idxs)
                 lst[1].append(arr)
+                self._recv_tiles += len(idxs)
         if pulled:
             # the ack releases the producer's park: only after the
             # bytes actually landed
@@ -452,6 +465,13 @@ class DistWaveRunner(WaveRunner):
                 msg = inbox.pop(key, None)
             if msg is not None:
                 return msg
+            # failure detection: a transport that noticed the peer die
+            # aborts the wave NOW, not after the full timeout (§5.3 —
+            # the reference's MPI would hang here)
+            if src in getattr(self.ce, "dead_peers", ()):
+                from ...comm.tcp import RankFailedError
+                raise RankFailedError(
+                    src, f"died owing wave-{w} exchange for {pool_name}")
             self.ce.progress()
             with cv:
                 if key in inbox:

@@ -296,6 +296,25 @@ def main() -> int:
             return 0
         finally:
             eng.fini()
+    if mode == "wave_fail":
+        # rank 1 dies before contributing its waves; rank 0 must abort
+        # QUICKLY via the failure detector, not the full comm timeout
+        import time as _time
+        try:
+            if rank == 1:
+                os._exit(3)   # simulated crash, no goodbye
+            from parsec_tpu.comm.tcp import RankFailedError
+            t0 = _time.time()
+            try:
+                run_wave(eng, rank, nb_ranks)
+                detected = False
+            except RankFailedError:
+                detected = True
+            print(json.dumps({"rank": rank, "detected": detected,
+                              "secs": _time.time() - t0}), flush=True)
+            return 0 if detected else 7
+        finally:
+            eng.fini()
     if mode in ("wave", "wave_xfer"):
         # distributed wave execution drives the CE directly (no context)
         try:

@@ -185,6 +185,17 @@ def test_wave_dpotrf_device_plane_across_processes():
     assert sum(o["bytes"] for o in outs) < pulls * tile_bytes / 2, outs
 
 
+def test_wave_peer_death_aborts_quickly():
+    """A rank dying mid-distributed-wave must abort the survivors via
+    the failure detector in seconds — not hang for the 120 s exchange
+    timeout (the reference's MPI would hang forever, SURVEY.md §5.3)."""
+    outs = _run_ranks(2, 0, mode="wave_fail", timeout=180,
+                      expect_rcs=[0, 3])
+    ok = outs[0]
+    assert ok["detected"], ok
+    assert ok["secs"] < 60, f"took {ok['secs']}s — detector not used"
+
+
 def test_dposv_across_processes():
     """Distributed Cholesky solve across 4 real OS processes: three
     sequential taskpools, panel broadcasts, cross-rank writebacks and

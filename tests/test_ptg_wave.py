@@ -400,3 +400,16 @@ def test_wave_sharded_dpotrf_at_size():
     ref = np.linalg.cholesky(M.astype(np.float64))
     assert np.allclose(L, ref, atol=1e-3), \
         f"max err {np.abs(L - ref).max()}"
+
+
+def test_wave_stats():
+    """execute() leaves engineering counters on the runner (the wave
+    path bypasses PINS by design — dispatch is what it amortizes; the
+    stats are its observability surface)."""
+    A, _ = _spd_coll(512, 128)
+    w = wave(dpotrf_taskpool(A))
+    w.run()
+    s = w.stats
+    assert s["tasks"] == 20 and s["waves"] > 1
+    assert 0 < s["kernel_calls"] < s["tasks"]
+    assert s["dispatch_secs"] > 0 and s["compiled_kernels"] > 0

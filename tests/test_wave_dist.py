@@ -286,3 +286,37 @@ def test_dist_wave_pdgemm(nb_ranks=2):
             C[m * nb:(m + 1) * nb, k * nb:(k + 1) * nb] = t
     ref = Am @ Bm
     assert np.abs(C - ref).max() / np.abs(ref).max() < 1e-5
+
+
+def test_dist_wave_stats():
+    """Distributed runs expose exchange counters; SPMD ranks agree on
+    the schedule so sent == recv across the job."""
+    n, nb = 256, 64
+    M = make_spd(n, dtype=np.float64)
+
+    def run(rank, fabric):
+        _dpotrf_rank(rank, fabric, 2, M, n, nb, 2, 1)
+        return None
+
+    # need the runner objects: inline a variant keeping them
+    runners = [None, None]
+
+    def run2(rank, fabric):
+        ce = fabric.engine(rank)
+        coll = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float64,
+                                 P=2, Q=1, nodes=2, rank=rank)
+        coll.name = "descA"
+        coll.from_numpy(M.copy())
+        tp = dpotrf_taskpool(coll, rank=rank, nb_ranks=2)
+        w = ptg.wave(tp, comm=ce)
+        w.run()
+        runners[rank] = w
+        return w.stats
+
+    results, _ = spmd(2, run2)
+    s0, s1 = results
+    assert s0["tasks"] == s1["tasks"]
+    assert s0["local_tasks"] + s1["local_tasks"] == s0["tasks"]
+    assert s0["transfers_scheduled"] == s1["transfers_scheduled"] > 0
+    assert s0["tiles_sent"] + s1["tiles_sent"] \
+        == s0["tiles_recv"] + s1["tiles_recv"] > 0
