@@ -320,3 +320,38 @@ def test_dist_wave_stats():
     assert s0["transfers_scheduled"] == s1["transfers_scheduled"] > 0
     assert s0["tiles_sent"] + s1["tiles_sent"] \
         == s0["tiles_recv"] + s1["tiles_recv"] > 0
+
+
+def test_dist_wave_dgeqrf(nb_ranks=2):
+    """QR distributed: scratch-flow (T factor) forwarding crosses ranks
+    through the same static schedule (scratch pools are replicated and
+    exchanged like real tiles, minus home transfers)."""
+    from parsec_tpu.ops import dgeqrf_taskpool
+
+    n, nb = 256, 64
+    rng = np.random.RandomState(4)
+    Am = rng.rand(n, n).astype(np.float64)
+
+    def run(rank, fabric):
+        ce = fabric.engine(rank)
+        coll = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float64,
+                                 P=nb_ranks, Q=1, nodes=nb_ranks, rank=rank)
+        coll.name = "descA"
+        coll.from_numpy(Am.copy())
+        tp = dgeqrf_taskpool(coll, rank=rank, nb_ranks=nb_ranks)
+        w = ptg.wave(tp, comm=ce)
+        w.run()
+        return _gather_owned(coll, rank)
+
+    results, _ = spmd(nb_ranks, run)
+    out = np.zeros((n, n))
+    for owned in results:
+        for (m, k), t in owned.items():
+            out[m * nb:(m + 1) * nb, k * nb:(k + 1) * nb] = t
+    # single-rank wave is the reference (parity there is tested
+    # separately); the distributed run must reproduce it exactly
+    A1 = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float64).from_numpy(
+        Am.copy())
+    from parsec_tpu.dsl.ptg.wave import WaveRunner
+    WaveRunner(dgeqrf_taskpool(A1)).run()
+    np.testing.assert_allclose(out, A1.to_numpy(), rtol=1e-6, atol=1e-9)
