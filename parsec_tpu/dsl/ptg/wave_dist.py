@@ -306,6 +306,7 @@ class DistWaveRunner(WaveRunner):
         self._recv_tiles = 0
 
         ok = False
+        t0 = time.perf_counter()
         try:
             pools = self._comm_step(0, pools)
             n_calls = 0
@@ -317,11 +318,15 @@ class DistWaveRunner(WaveRunner):
                     n_calls += nc
                 pools = self._comm_step(lv + 1, pools)
             ok = True
+            # same schema as WaveRunner.stats plus the exchange counters
             self.stats = {
                 "tasks": self.dag.n_tasks,
-                "local_tasks": int((self._rank_of_task == self.rank).sum()),
                 "waves": len(self._levels),
                 "kernel_calls": n_calls,
+                "dispatch_secs": round(time.perf_counter() - t0, 6),
+                "compiled_kernels": sum(len(p.kernels)
+                                        for p in self.plans),
+                "local_tasks": int((self._rank_of_task == self.rank).sum()),
                 "transfers_scheduled": self._n_transfers,
                 "tiles_sent": self._sent_tiles,
                 "tiles_recv": self._recv_tiles,
@@ -465,14 +470,22 @@ class DistWaveRunner(WaveRunner):
                 msg = inbox.pop(key, None)
             if msg is not None:
                 return msg
-            # failure detection: a transport that noticed the peer die
-            # aborts the wave NOW, not after the full timeout (§5.3 —
-            # the reference's MPI would hang here)
-            if src in getattr(self.ce, "dead_peers", ()):
+            self.ce.progress()
+            # failure detection AFTER the drain: the peer's final
+            # message may have been queued by the recv thread right
+            # before it died — progress() just delivered it (same
+            # final-drain-then-raise order as tcp._barrier_wait). A
+            # cleanly finished peer can't send the owed message either.
+            gone = (src in getattr(self.ce, "dead_peers", ())
+                    or src in getattr(self.ce, "finished_peers", ()))
+            if gone:
+                with cv:
+                    msg = inbox.pop(key, None)
+                if msg is not None:
+                    return msg
                 from ...comm.tcp import RankFailedError
                 raise RankFailedError(
-                    src, f"died owing wave-{w} exchange for {pool_name}")
-            self.ce.progress()
+                    src, f"gone owing wave-{w} exchange for {pool_name}")
             with cv:
                 if key in inbox:
                     continue

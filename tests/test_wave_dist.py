@@ -295,13 +295,6 @@ def test_dist_wave_stats():
     M = make_spd(n, dtype=np.float64)
 
     def run(rank, fabric):
-        _dpotrf_rank(rank, fabric, 2, M, n, nb, 2, 1)
-        return None
-
-    # need the runner objects: inline a variant keeping them
-    runners = [None, None]
-
-    def run2(rank, fabric):
         ce = fabric.engine(rank)
         coll = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float64,
                                  P=2, Q=1, nodes=2, rank=rank)
@@ -310,10 +303,9 @@ def test_dist_wave_stats():
         tp = dpotrf_taskpool(coll, rank=rank, nb_ranks=2)
         w = ptg.wave(tp, comm=ce)
         w.run()
-        runners[rank] = w
         return w.stats
 
-    results, _ = spmd(2, run2)
+    results, _ = spmd(2, run)
     s0, s1 = results
     assert s0["tasks"] == s1["tasks"]
     assert s0["local_tasks"] + s1["local_tasks"] == s0["tasks"]
