@@ -5,6 +5,7 @@ hash, atomics — SURVEY.md §4 "Unit tests") against the C++ extension, and
 runs the same battery against the pure-Python fallbacks so both stay
 behaviorally identical.
 """
+import os
 import threading
 
 import pytest
@@ -170,6 +171,8 @@ def test_zone_malloc_errors(cls):
         z.free(o)  # double free
 
 
+@pytest.mark.skipif(os.environ.get("PARSEC_TPU_NATIVE") == "0",
+                    reason="native layer deliberately disabled")
 def test_native_layer_is_active():
     """The driver environment has g++; the native core must actually load."""
     assert native_available
