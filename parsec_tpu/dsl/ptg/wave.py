@@ -146,6 +146,7 @@ class WaveRunner:
         # per-task runtime's runtime-allocated NEW tiles.
         self._n_real_colls = len(self.coll_names)
         self._scratch: Dict[Tuple, Dict[str, Any]] = {}
+        self._g2l = None   # DistWaveRunner: global->local pool row maps
         # slot tables: per task, per (non-ctl) flow position in the
         # class's flow_idx list -> flat tile index (collection fixed per
         # class/flow, validated during assignment)
@@ -698,6 +699,26 @@ class WaveRunner:
                         idx_in = self._slot[chunk, :nf].T.copy()
                         idx_out = self._slot_out[chunk, :nf].T.copy()
                         idx_wbx = self._wbx_idx[chunk, :nf].T.copy()
+                        if self._g2l is not None:
+                            # sliced pools (dist): translate the global
+                            # tile indices into this rank's pool rows
+                            bad = False
+                            for j in range(nf):
+                                idx_in[j] = self._g2l[icl[j]][idx_in[j]]
+                                bad |= bool((idx_in[j] < 0).any())
+                                if ocl[j] >= 0:
+                                    idx_out[j] = \
+                                        self._g2l[ocl[j]][idx_out[j]]
+                                    bad |= bool((idx_out[j] < 0).any())
+                                if xcl[j] >= 0:
+                                    idx_wbx[j] = \
+                                        self._g2l[xcl[j]][idx_wbx[j]]
+                                    bad |= bool((idx_wbx[j] < 0).any())
+                            if bad:
+                                raise WaveError(
+                                    "sliced-pool translation hit a tile "
+                                    "this rank never staged (local-map "
+                                    "construction bug)")
                         try:
                             pools = self._kernel(int(ci), k, statics,
                                                  icl, ocl, wfl, xcl)(

@@ -23,17 +23,19 @@ distribution) in ONE engine:
   Both ends derive the identical schedule from the identical DAG, so no
   control messages, tags negotiation, or rendezvous are needed at all —
   the data messages themselves are the entire protocol;
-- cross-rank write-after-read needs no handling: replicated pools mean
-  a remote write only reaches this rank's pool in the post-wave
+- cross-rank write-after-read needs no handling: a remote write only
+  reaches this rank's staged copy of the tile in the post-wave
   exchange, which runs after local execution — the reader batched in
   the same wave saw the old value, exactly WAR semantics. (Local
   same-wave WAR is layered by WaveRunner._split_war as before; two
   same-wave writers of one tile are rejected statically — racy DAG.)
 
-Memory model: every rank stages full-size pools (replicated). Tiles a
-rank neither owns nor receives hold stale/garbage values that no local
-task reads — the schedule guarantees any read slot is current. This
-trades HBM for simplicity; a sliced-pool variant is the follow-up.
+Memory model: pools are SLICED — each rank stages only the tiles its
+tasks touch plus its transfer endpoints (the halo), O(local tiles)
+HBM instead of O(matrix) per rank. The exchange schedule speaks global
+tile indices on the wire; gathers/scatters translate them to local
+pool rows (``_g2l``). Owned tiles no local task touches are never
+staged and their home copies stand.
 """
 from __future__ import annotations
 
@@ -134,6 +136,7 @@ class DistWaveRunner(WaveRunner):
         self._rank_of_task = self._compute_task_ranks()
         self._levels = self._compute_levels()
         self._build_comm_schedule()
+        self._build_local_maps()
         self._scatter_kerns: Dict[int, Any] = {}
         _ensure_wave_inbox(self.ce)
 
@@ -289,7 +292,100 @@ class DistWaveRunner(WaveRunner):
                     lst.sort()
         self._sends = sends
         self._recvs = {w: sorted(s) for w, s in recvs.items()}
+        self._transfers = transfers
         self._n_transfers = len(transfers)
+
+    def _build_local_maps(self) -> None:
+        """SLICED pools: this rank stages only the tiles it touches —
+        local task slots plus the endpoints of transfers it takes part
+        in. Memory per rank becomes O(local tiles + halo) instead of
+        O(whole matrix); the exchange schedule keeps speaking GLOBAL
+        tile indices on the wire, translated to pool rows at gathers
+        and scatters (wave.py does the same for kernel indices via
+        self._g2l)."""
+        n_pools = self._n_real_colls + len(self._scratch)
+        sizes = [len(self._tile_index[c])
+                 for c in range(self._n_real_colls)]
+        for sp in sorted(self._scratch.values(), key=lambda s: s["cid"]):
+            sizes.append(sp["n"])
+        touched: List[set] = [set() for _ in range(n_pools)]
+        for t in np.nonzero(self._rank_of_task == self.rank)[0]:
+            p = self.plans[int(self.dag.class_of[t])]
+            for k in range(len(p.flow_idx)):
+                touched[int(self._slot_coll[t, k])].add(
+                    int(self._slot[t, k]))
+                if p.written[k]:
+                    touched[int(self._slot_out_coll[t, k])].add(
+                        int(self._slot_out[t, k]))
+                    if int(self._wbx_cid[t, k]) >= 0:
+                        touched[int(self._wbx_cid[t, k])].add(
+                            int(self._wbx_idx[t, k]))
+        for (w, src, dst, cid, idx) in self._transfers:
+            if src == self.rank or dst == self.rank:
+                touched[cid].add(idx)
+        self._l2g = [np.asarray(sorted(s), np.int32) for s in touched]
+        g2l = []
+        for c in range(n_pools):
+            m = np.full(max(sizes[c], 1), -1, np.int32)
+            if len(self._l2g[c]):
+                m[self._l2g[c]] = np.arange(len(self._l2g[c]),
+                                            dtype=np.int32)
+            g2l.append(m)
+        self._g2l = g2l
+
+    def _pool_tile_spec(self, cid: int):
+        """(tile_shape, dtype) of one pool, without staging it. NOT the
+        (mb, nb) block size — edge tiles of a short matrix can be
+        smaller than the block while still uniform across the pool."""
+        if cid < self._n_real_colls:
+            coll = self.collections[self.coll_names[cid]]
+            c0 = self._coords_by_idx[cid][0]
+            dt = np.dtype(getattr(coll, "dtype", np.float32))
+            ts = getattr(coll, "tile_shape", None)
+            if callable(ts):
+                return tuple(int(v) for v in ts(*c0)), dt
+            arr = np.asarray(coll.data_of(*c0).sync_to_host().payload)
+            return tuple(arr.shape), arr.dtype
+        sp = next(s for s in self._scratch.values() if s["cid"] == cid)
+        if sp["shape"] is not None:
+            return tuple(sp["shape"]), np.dtype(sp["dtype"])
+        return self._pool_tile_spec(sp["like"])
+
+    def build_pools(self, device=None, sharding=None) -> Tuple:
+        """Stage only this rank's slice of every pool (see
+        _build_local_maps). ``sharding`` is not meaningful with sliced
+        pools (slices differ per rank) — single-device placement only."""
+        import jax
+        import jax.numpy as jnp
+
+        if sharding is not None:
+            raise WaveError("sharded pools and sliced distributed pools "
+                            "are mutually exclusive; pass device= instead")
+
+        def put(z):
+            return jax.device_put(z, device) if device is not None \
+                else jnp.asarray(z)
+
+        pools: List[Any] = []
+        for cid, name in enumerate(self.coll_names):
+            loc = self._l2g[cid]
+            if cid not in self._used_colls or not len(loc):
+                pools.append(jnp.zeros((0,), np.float32))
+                continue
+            coll = self.collections[name]
+            coords = self._coords_by_idx[cid]
+            tiles = [np.asarray(
+                coll.data_of(*coords[int(g)]).sync_to_host().payload)
+                for g in loc]
+            pools.append(put(np.stack(tiles)))
+        for sp in sorted(self._scratch.values(), key=lambda s: s["cid"]):
+            loc = self._l2g[sp["cid"]]
+            if not len(loc):
+                pools.append(jnp.zeros((0,), np.float32))
+                continue
+            shape, dt = self._pool_tile_spec(sp["cid"])
+            pools.append(put(np.zeros((len(loc),) + shape, dt)))
+        return tuple(pools)
 
     # ------------------------------------------------------------------ #
     # execution                                                          #
@@ -330,6 +426,7 @@ class DistWaveRunner(WaveRunner):
                 "transfers_scheduled": self._n_transfers,
                 "tiles_sent": self._sent_tiles,
                 "tiles_recv": self._recv_tiles,
+                "local_tiles": int(sum(len(g) for g in self._l2g)),
             }
         finally:
             # drop anything still keyed to this run (abort/timeout paths
@@ -369,8 +466,9 @@ class DistWaveRunner(WaveRunner):
         for dst in sorted(self._sends.get(w, ())):
             colls = []
             for cid in sorted(self._sends[w][dst]):
-                idxs = self._sends[w][dst][cid]
-                gathered = pools[cid][np.asarray(idxs, np.int32)]
+                idxs = self._sends[w][dst][cid]   # GLOBAL on the wire
+                gathered = pools[cid][self._g2l[cid][
+                    np.asarray(idxs, np.int32)]]
                 if plane is not None and _is_single_device(gathered):
                     jax.block_until_ready(gathered)
                     u, shape, dt = plane.register(gathered)
@@ -426,8 +524,9 @@ class DistWaveRunner(WaveRunner):
         for cid, (idxs, arrs) in upd.items():
             vals = (jnp.concatenate([jnp.asarray(a) for a in arrs], axis=0)
                     if len(arrs) > 1 else jnp.asarray(arrs[0]))
+            lidx = self._g2l[cid][np.asarray(idxs, np.int32)]
             plist[cid] = self._scatter_kernel(len(idxs))(
-                plist[cid], np.asarray(idxs, np.int32), vals)
+                plist[cid], lidx, vals)
         return tuple(plist)
 
     def _drain_parks(self, timeout: float) -> None:
@@ -500,24 +599,27 @@ class DistWaveRunner(WaveRunner):
     # pool staging                                                       #
     # ------------------------------------------------------------------ #
     def scatter_pools(self, pools: Tuple) -> None:
-        """Write back only the tiles this rank OWNS (their home is
-        here); the final-state transfers brought every last write home
-        first, so owned tiles are current on their owner."""
+        """Write back only the tiles this rank OWNS **and staged**
+        (their home is here and some task touched them — untouched
+        owned tiles were never staged and their home copies stand);
+        the final-state transfers brought every last write home first,
+        so owned tiles are current on their owner."""
         for cid, name in enumerate(self.coll_names):
             if cid not in self._written_colls:
                 continue
             coll = self.collections[name]
             coords = self._coords_by_idx[cid]
-            owned = [i for i, c in enumerate(coords)
-                     if int(coll.rank_of(*c)) == self.rank]
+            owned = [(j, int(g)) for j, g in enumerate(self._l2g[cid])
+                     if int(coll.rank_of(*coords[int(g)])) == self.rank]
             if not owned:
                 continue
-            host = np.asarray(pools[cid][np.asarray(owned, np.int32)])
-            for j, i in enumerate(owned):
-                data = coll.data_of(*coords[i])
+            host = np.asarray(
+                pools[cid][np.asarray([j for j, _g in owned], np.int32)])
+            for row, (_j, g) in enumerate(owned):
+                data = coll.data_of(*coords[g])
                 hc = data.host_copy()
                 if hc.payload is None:
-                    hc.payload = host[j].copy()
+                    hc.payload = host[row].copy()
                 else:
-                    np.copyto(hc.payload, host[j])
+                    np.copyto(hc.payload, host[row])
                 data.version_bump(0)

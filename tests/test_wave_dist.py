@@ -347,3 +347,29 @@ def test_dist_wave_dgeqrf(nb_ranks=2):
     from parsec_tpu.dsl.ptg.wave import WaveRunner
     WaveRunner(dgeqrf_taskpool(A1)).run()
     np.testing.assert_allclose(out, A1.to_numpy(), rtol=1e-6, atol=1e-9)
+
+
+def test_dist_wave_pools_are_sliced():
+    """Each rank stages only its touched tiles + halo — summed over
+    ranks that's less than 2x the matrix (full replication would be
+    exactly 2x the tile count at 2 ranks)."""
+    n, nb = 512, 64           # NT=8: 36 lower tiles in play
+    M = make_spd(n, dtype=np.float64)
+
+    def run(rank, fabric):
+        ce = fabric.engine(rank)
+        coll = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float64,
+                                 P=2, Q=1, nodes=2, rank=rank)
+        coll.name = "descA"
+        coll.from_numpy(M.copy())
+        tp = dpotrf_taskpool(coll, rank=rank, nb_ranks=2)
+        w = ptg.wave(tp, comm=ce)
+        w.run()
+        return w.stats["local_tiles"], len(list(coll.tiles()))
+
+    results, _ = spmd(2, run)
+    total_local = sum(r[0] for r in results)
+    full = results[0][1]
+    assert total_local < 2 * full, (total_local, full)
+    # and each rank holds strictly less than the whole collection
+    assert all(r[0] < full for r in results), results
