@@ -79,7 +79,10 @@ def check_numerics_device(tile_map, M, n, nb):
         for (m, k), t in zip(coords, ts):
             if m == k:
                 t = jnp.tril(t)
-            L = L.at[m * nb:(m + 1) * nb, k * nb:(k + 1) * nb].set(t)
+            # slice extents from the tile's true shape (ragged tilings:
+            # edge tiles are lm%nb short)
+            L = L.at[m * nb:m * nb + t.shape[0],
+                     k * nb:k * nb + t.shape[1]].set(t)
         return jnp.abs(L @ (L.T @ X) - ref).max() / jnp.abs(ref).max()
 
     rng = np.random.RandomState(0)
@@ -173,9 +176,10 @@ def bench_wave(n, nb, reps, dtype):
         jax.block_until_ready(pools)
         dt = time.perf_counter() - t0
         best = dt if best is None else min(best, dt)
-    cid = w.coll_names.index("descA") if "descA" in w.coll_names else 0
-    coords = sorted(A.tiles())
-    lower = {c: pools[cid][i] for i, c in enumerate(coords) if c[0] >= c[1]}
+    # shape-split pools: map each tile through the (pool, row) index
+    loc = w._pool_of.get("descA") or next(iter(w._pool_of.values()))
+    lower = {c: pools[pid][row] for c, (pid, row) in loc.items()
+             if c[0] >= c[1]}
     return best, check_numerics_device(lower, M, n, nb)
 
 

@@ -10,8 +10,10 @@ parsec/scheduling.c:586-625; on TPU the idiomatic fix is batching onto
 the MXU, not a faster scalar loop):
 
 - the lowered DAG (lower.py) tracks readiness in dense native counters;
-- every collection lives on device as ONE stacked tile pool
-  ``[n_tiles, mb, nb]``;
+- every collection lives on device as stacked tile pools
+  ``[n_tiles, mb, nb]``, one pool per distinct tile shape (ragged
+  tilings — the reference's lm%mb edge tiles — split into interior +
+  edge + corner pools, each uniform, each batched exactly);
 - each ready antichain ("wave") is grouped by task class and executed as
   a few fixed-size chunked calls of a jitted, vmapped body kernel that
   gathers input tiles from the pools by index, runs the batched tile op
@@ -64,7 +66,7 @@ class _ClassPlan:
     """Per-task-class kernel metadata: which flows carry data, where
     their slots live, and the compiled chunked kernels."""
 
-    __slots__ = ("tc", "ast", "flow_idx", "flow_names", "flow_coll",
+    __slots__ = ("tc", "ast", "flow_idx", "flow_names",
                  "written", "reads", "range_locals", "body_locals", "code",
                  "kernels", "in_tnames", "wb_names", "in_tname", "wb_name")
 
@@ -75,7 +77,6 @@ class _ClassPlan:
                          if not f.is_ctl]
         self.flow_names = [tc.ast.flows[i].name for i in self.flow_idx]
         from ...data.data import FlowAccess
-        self.flow_coll: List[int] = [-1] * len(self.flow_idx)
         self.written = [bool(tc.flows[i].access & FlowAccess.WRITE)
                         for i in self.flow_idx]
         # a flow with in-deps reads its slot's current value (RW reads
@@ -123,14 +124,47 @@ class WaveRunner:
         if not self.collections:
             raise WaveError("taskpool binds no data collections")
         self.coll_names = sorted(self.collections)
-        self._coll_id = {n: i for i, n in enumerate(self.coll_names)}
-        self._tile_index: List[Dict[Tuple, int]] = []
+        # Pools are SHAPE-SPLIT: each collection's tiles are partitioned
+        # by their true tile shape and every shape class becomes its own
+        # stacked pool. A ragged tiling (the reference's first-class
+        # lm%mb edge tiles, parsec/data_dist/matrix/matrix.c:106,116)
+        # yields at most 4 pools per matrix (interior + bottom/right
+        # edge + corner); bodies see exact shapes, so edge tiles need no
+        # padding or masking and the math is the per-task runtime's.
+        # Chunk kernels already group by the per-instance pool
+        # signature, so mixed-shape classes batch per shape. Pool order
+        # is deterministic (largest tile first within each collection)
+        # and derived from the distribution only — SPMD ranks agree.
+        self.pool_names: List[str] = []       # pool id -> collection name
+        self._pool_coords: List[List[Tuple]] = []
+        self._pool_shapes: List[Tuple] = []
+        self._pool_of: Dict[str, Dict[Tuple, Tuple[int, int]]] = {}
         for n in self.coll_names:
             coll = self.collections[n]
             coords = sorted(coll.tiles())
-            self._tile_index.append({c: i for i, c in enumerate(coords)})
-            # shape uniformity (pools are stacked arrays) is enforced by
-            # np.stack in build_pools; ragged tilings raise there
+            ts = getattr(coll, "tile_shape", None)
+            if callable(ts):
+                by_shape: Dict[Tuple, List[Tuple]] = {}
+                for c in coords:
+                    by_shape.setdefault(
+                        tuple(int(v) for v in ts(*c)), []).append(c)
+                shapes = sorted(by_shape,
+                                key=lambda s: (-int(np.prod(s)), s))
+            else:
+                # no descriptor contract: one pool, shapes resolved at
+                # staging (np.stack still rejects a ragged tiling there
+                # — ragged needs tile_shape; no payload is touched here,
+                # unused collections stay unstaged)
+                by_shape = {None: coords}
+                shapes = [None]
+            loc = self._pool_of.setdefault(n, {})
+            for sh in shapes:
+                pid = len(self.pool_names)
+                self.pool_names.append(n)
+                self._pool_coords.append(by_shape[sh])
+                self._pool_shapes.append(sh)
+                for i, c in enumerate(by_shape[sh]):
+                    loc[c] = (pid, i)
         self.plans = [_ClassPlan(tc) for tc in tp.task_classes]
         # reshape properties ([type]/[type_data]) are served IN-KERNEL:
         # input conversions apply after the gather (masked cast, XLA
@@ -144,7 +178,7 @@ class WaveRunner:
         # NEW scratch flows get per-class scratch pools (ids after the
         # real collections), zero-initialized each run like the
         # per-task runtime's runtime-allocated NEW tiles.
-        self._n_real_colls = len(self.coll_names)
+        self._n_real_colls = len(self.pool_names)
         self._scratch: Dict[Tuple, Dict[str, Any]] = {}
         self._g2l = None   # DistWaveRunner: global->local pool row maps
         # slot tables: per task, per (non-ctl) flow position in the
@@ -228,8 +262,6 @@ class WaveRunner:
                         f"does not resolve to a collection tile or scratch "
                         f"pool (NULL flows need the per-task runtime)")
                 coll_id, idx = s
-                if p.flow_coll[k] == -1:
-                    p.flow_coll[k] = coll_id   # representative (shapes)
                 scoll[t, k] = coll_id
                 slot[t, k] = idx
                 tname = self._inst_in_tname(f, env)
@@ -308,7 +340,13 @@ class WaveRunner:
         gets from fresh DataCopies). Tile shape/dtype copied from the
         input slot's pool at staging."""
         ci = int(self.dag.class_of[tid])
-        key = (ci, f.name, "ren")
+        # keyed by the like-pool: instances binding different input
+        # pools (guarded collections, or shape-split edge tiles) rename
+        # into separate pools so tile shapes stay exact per pool. Rows
+        # are per-key ordinals (assignment order is the deterministic
+        # topo walk, so SPMD ranks agree), sized to the instances that
+        # actually rename through this pool — not the whole class.
+        key = (ci, f.name, "ren", like_cid)
         sp = self._scratch.get(key)
         if sp is None:
             sp = self._scratch[key] = {
@@ -316,19 +354,13 @@ class WaveRunner:
                 "shape": None,
                 "dtype": None,
                 "like": like_cid,
-                "n": self._class_count[ci],
+                "rows": {},
+                "n": 0,
                 "label": f"{self.plans[ci].ast.name}.{f.name}",
             }
-        elif sp["like"] != like_cid:
-            # the rename pool copies tile shape/dtype from ONE input
-            # pool; instances binding different input collections could
-            # need different tiles — fail at build, not with an opaque
-            # XLA shape error at execute
-            raise WaveError(
-                f"{sp['label']}: renamed instances bind different input "
-                f"collections (pools {sp['like']} vs {like_cid}); "
-                f"unsupported in wave mode")
-        return sp["cid"], int(self._class_ordinal[tid])
+        row = sp["rows"].setdefault(int(tid), len(sp["rows"]))
+        sp["n"] = len(sp["rows"])
+        return sp["cid"], row
 
     def _out_slot_of_flow(self, tid, p, k, f, env, in_cid, in_idx, tname,
                           wbx_cid, wbx_idx) -> Tuple[int, int, bool]:
@@ -357,13 +389,13 @@ class WaveRunner:
                 continue
             if t.kind != "memory":
                 continue
-            cid = self._coll_id.get(t.collection)
-            if cid is None:
+            coords = tuple(int(a(env)) for a in t.args)
+            hit = self._locate_tile(t.collection, coords)
+            if hit is None:
                 raise WaveError(
                     f"{p.ast.name}.{f.name}: writes back to unbound "
                     f"collection {t.collection!r}")
-            coords = tuple(int(a(env)) for a in t.args)
-            targets.add((cid, self._tile_lookup(cid, coords)))
+            targets.add(hit)
             nm = d.properties.get("type_data") or d.properties.get("type")
             nm = None if nm == "full" else nm
             inst_masked = inst_masked or nm is not None
@@ -403,11 +435,8 @@ class WaveRunner:
             if t is None:
                 continue
             if t.kind == "memory":
-                coll_id = self._coll_id.get(t.collection)
-                if coll_id is None:
-                    return None
                 coords = tuple(int(a(env)) for a in t.args)
-                return coll_id, self._tile_lookup(coll_id, coords)
+                return self._locate_tile(t.collection, coords)
             if t.kind == "new":
                 return self._scratch_slot(tid, f, env)
             if t.kind == "task":
@@ -443,27 +472,28 @@ class WaveRunner:
             for d in f.deps_out():
                 t = d.resolve(env)
                 if t is not None and t.kind == "memory":
-                    coll_id = self._coll_id.get(t.collection)
-                    if coll_id is None:
-                        return None
                     coords = tuple(int(a(env)) for a in t.args)
-                    return coll_id, self._tile_lookup(coll_id, coords)
+                    return self._locate_tile(t.collection, coords)
             ssh = scratch_shape(f, env)
             if ssh is not None:
                 return self._scratch_slot(tid, f, env, shape=ssh)
         return None
 
-    def _tile_lookup(self, coll_id: int, coords: Tuple[int, ...]) -> int:
-        """Map dep-target args to the flat tile index; vector-style
-        1-arg targets pad a trailing 0 (data_of(m) == data_of(m, 0))."""
-        idx = self._tile_index[coll_id]
-        hit = idx.get(coords)
+    def _locate_tile(self, coll_name: str,
+                     coords: Tuple[int, ...]) -> Optional[Tuple[int, int]]:
+        """Map a dep target to its (pool id, pool row); None when the
+        collection is unbound. Vector-style 1-arg targets pad a trailing
+        0 (data_of(m) == data_of(m, 0))."""
+        loc = self._pool_of.get(coll_name)
+        if loc is None:
+            return None
+        hit = loc.get(coords)
         while hit is None and len(coords) < 2:
             coords = coords + (0,)
-            hit = idx.get(coords)
+            hit = loc.get(coords)
         if hit is None:
             raise WaveError(f"no tile {coords} in collection "
-                            f"{self.coll_names[coll_id]}")
+                            f"{coll_name}")
         return hit
 
     # ------------------------------------------------------------------ #
@@ -738,8 +768,9 @@ class WaveRunner:
         return pools, n_calls
 
     def execute(self, pools: Tuple) -> Tuple:
-        """Run the DAG over device tile pools (one stacked array per
-        collection, ordered by self.coll_names); returns final pools."""
+        """Run the DAG over device tile pools (stacked arrays ordered
+        by self.pool_names, shape-split per collection); returns final
+        pools."""
         import time as _time
 
         dag = self.dag
@@ -844,8 +875,9 @@ class WaveRunner:
     # convenience: run against the bound collections                     #
     # ------------------------------------------------------------------ #
     def build_pools(self, device=None, sharding=None) -> Tuple:
-        """Stage each collection as one stacked [n_tiles, mb, nb] device
-        array. ``sharding`` (a jax.sharding.Sharding over the tile dims,
+        """Stage each collection as stacked [n_tiles, mb, nb] device
+        arrays, one per shape-split pool (self.pool_names order).
+        ``sharding`` (a jax.sharding.Sharding over the tile dims,
         e.g. NamedSharding(mesh, P(None, "tp", "sp"))) runs every wave
         kernel SPMD over the mesh — GSPMD partitions the batched tile
         ops and inserts the collectives (the scaling-book recipe); right
@@ -853,19 +885,18 @@ class WaveRunner:
         import jax
         import jax.numpy as jnp
         pools = []
-        for cid, name in enumerate(self.coll_names):
-            if cid not in self._used_colls:
+        for pid, name in enumerate(self.pool_names):
+            if pid not in self._used_colls:
                 pools.append(jnp.zeros((0,), np.float32))  # placeholder
                 continue
             coll = self.collections[name]
-            coords = sorted(coll.tiles())
             tiles = []
-            for c in coords:
+            for c in self._pool_coords[pid]:
                 data = coll.data_of(*c)
                 tiles.append(np.asarray(data.sync_to_host().payload))
             stacked = np.stack(tiles)
             if sharding is not None:
-                arr = jax.device_put(stacked, sharding)
+                arr = self._put_sharded(stacked, sharding)
             elif device is not None:
                 arr = jax.device_put(stacked, device)
             else:
@@ -875,7 +906,8 @@ class WaveRunner:
         # each run, ids after real collections; rename pools copy tile
         # shape/dtype from the pool they rename ("like" — already
         # staged: its cid is always smaller). A tile-pool sharding spec
-        # needn't fit scratch shapes — scratch stays single-device.
+        # needn't fit scratch shapes — scratch replicates on the mesh
+        # (or stays single-device without one).
         for sp in sorted(self._scratch.values(), key=lambda s: s["cid"]):
             if sp["shape"] is not None:
                 z = np.zeros((sp["n"],) + sp["shape"], sp["dtype"])
@@ -883,18 +915,50 @@ class WaveRunner:
                 like = pools[sp["like"]]
                 z = np.zeros((sp["n"],) + tuple(like.shape[1:]),
                              np.dtype(str(like.dtype)))
-            pools.append(jax.device_put(z, device) if device is not None
-                         else jnp.asarray(z))
+            if sharding is not None:
+                pools.append(self._put_replicated(z, sharding))
+            else:
+                pools.append(jax.device_put(z, device)
+                             if device is not None else jnp.asarray(z))
         return tuple(pools)
 
+    @staticmethod
+    def _put_replicated(x, sharding):
+        """Replicate an array over the sharding's mesh (scratch pools
+        and pools whose tile shape the spec cannot divide)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+        mesh = getattr(sharding, "mesh", None)
+        if mesh is None:
+            return jax.device_put(x, sharding)
+        return jax.device_put(x, NamedSharding(mesh, PartitionSpec()))
+
+    def _put_sharded(self, x, sharding):
+        """Place one stacked pool under the caller's sharding spec;
+        shape-split edge pools whose tile dims the spec does not divide
+        fall back to mesh replication (small pools — the interior pool
+        is the one that carries the FLOPs). Only the divisibility probe
+        falls back: genuine spec/mesh errors from device_put propagate."""
+        import jax
+        try:
+            sharding.shard_shape(tuple(x.shape))
+        except ValueError as e:
+            if "divid" not in str(e) and "evenly" not in str(e):
+                raise   # malformed spec/mesh: the user must hear it
+            plog.debug.verbose(
+                2, "wave pool of tile shape %s not divisible by the "
+                "sharding spec; replicating it on the mesh",
+                tuple(x.shape[1:]))
+            return self._put_replicated(x, sharding)
+        return jax.device_put(x, sharding)
+
     def scatter_pools(self, pools: Tuple) -> None:
-        for cid, name in enumerate(self.coll_names):
-            if cid not in self._written_colls:
+        for pid, name in enumerate(self.pool_names):
+            if pid not in self._written_colls:
                 continue  # no task wrote this pool: home copies stand
             coll = self.collections[name]
-            coords = sorted(coll.tiles())
-            host = np.asarray(pools[cid])
-            for i, c in enumerate(coords):
+            host = np.asarray(pools[pid])
+            for i, c in enumerate(self._pool_coords[pid]):
                 data = coll.data_of(*c)
                 hc = data.host_copy()
                 if hc.payload is None:

@@ -126,13 +126,6 @@ class DistWaveRunner(WaveRunner):
         super().__init__(tp, max_chunk=max_chunk)
         self.rank = int(tp.rank)
         self.nb_ranks = int(tp.nb_ranks)
-        # canonical coords per flat tile index (inverse of _tile_index)
-        self._coords_by_idx: List[List[Tuple]] = []
-        for cid in range(len(self.coll_names)):
-            inv: List[Tuple] = [None] * len(self._tile_index[cid])
-            for c, i in self._tile_index[cid].items():
-                inv[i] = c
-            self._coords_by_idx.append(inv)
         self._rank_of_task = self._compute_task_ranks()
         self._levels = self._compute_levels()
         self._build_comm_schedule()
@@ -195,8 +188,8 @@ class DistWaveRunner(WaveRunner):
         return levels
 
     def _home_rank(self, cid: int, idx: int) -> int:
-        coll = self.collections[self.coll_names[cid]]
-        return int(coll.rank_of(*self._coords_by_idx[cid][idx]))
+        coll = self.collections[self.pool_names[cid]]
+        return int(coll.rank_of(*self._pool_coords[cid][idx]))
 
     def _build_comm_schedule(self) -> None:
         """Derive the full exchange schedule from the slot table.
@@ -248,8 +241,8 @@ class DistWaveRunner(WaveRunner):
                 if a[0] == b[0] and a[1] != b[1]:
                     cid, idx = key
                     raise WaveError(
-                        f"two writers of tile {self._coords_by_idx[cid][idx]}"
-                        f" in {self.coll_names[cid]} share wave {a[0]} "
+                        f"two writers of tile {self._pool_coords[cid][idx]}"
+                        f" in {self.pool_names[cid]} share wave {a[0]} "
                         f"(tasks {a[1]}, {b[1]}): the DAG races")
             ws_sorted[key] = ws
 
@@ -304,7 +297,7 @@ class DistWaveRunner(WaveRunner):
         and scatters (wave.py does the same for kernel indices via
         self._g2l)."""
         n_pools = self._n_real_colls + len(self._scratch)
-        sizes = [len(self._tile_index[c])
+        sizes = [len(self._pool_coords[c])
                  for c in range(self._n_real_colls)]
         for sp in sorted(self._scratch.values(), key=lambda s: s["cid"]):
             sizes.append(sp["n"])
@@ -338,14 +331,16 @@ class DistWaveRunner(WaveRunner):
         (mb, nb) block size — edge tiles of a short matrix can be
         smaller than the block while still uniform across the pool."""
         if cid < self._n_real_colls:
-            coll = self.collections[self.coll_names[cid]]
-            c0 = self._coords_by_idx[cid][0]
-            dt = np.dtype(getattr(coll, "dtype", np.float32))
-            ts = getattr(coll, "tile_shape", None)
-            if callable(ts):
-                return tuple(int(v) for v in ts(*c0)), dt
-            arr = np.asarray(coll.data_of(*c0).sync_to_host().payload)
-            return tuple(arr.shape), arr.dtype
+            coll = self.collections[self.pool_names[cid]]
+            sh = self._pool_shapes[cid]
+            dt = getattr(coll, "dtype", None)
+            if sh is None or dt is None:
+                c0 = self._pool_coords[cid][0]
+                arr = np.asarray(
+                    coll.data_of(*c0).sync_to_host().payload)
+                sh = tuple(arr.shape) if sh is None else sh
+                dt = arr.dtype if dt is None else dt
+            return tuple(sh), np.dtype(dt)
         sp = next(s for s in self._scratch.values() if s["cid"] == cid)
         if sp["shape"] is not None:
             return tuple(sp["shape"]), np.dtype(sp["dtype"])
@@ -367,13 +362,13 @@ class DistWaveRunner(WaveRunner):
                 else jnp.asarray(z)
 
         pools: List[Any] = []
-        for cid, name in enumerate(self.coll_names):
+        for cid, name in enumerate(self.pool_names):
             loc = self._l2g[cid]
             if cid not in self._used_colls or not len(loc):
                 pools.append(jnp.zeros((0,), np.float32))
                 continue
             coll = self.collections[name]
-            coords = self._coords_by_idx[cid]
+            coords = self._pool_coords[cid]
             tiles = [np.asarray(
                 coll.data_of(*coords[int(g)]).sync_to_host().payload)
                 for g in loc]
@@ -604,11 +599,11 @@ class DistWaveRunner(WaveRunner):
         owned tiles were never staged and their home copies stand);
         the final-state transfers brought every last write home first,
         so owned tiles are current on their owner."""
-        for cid, name in enumerate(self.coll_names):
+        for cid, name in enumerate(self.pool_names):
             if cid not in self._written_colls:
                 continue
             coll = self.collections[name]
-            coords = self._coords_by_idx[cid]
+            coords = self._pool_coords[cid]
             owned = [(j, int(g)) for j, g in enumerate(self._l2g[cid])
                      if int(coll.rank_of(*coords[int(g)])) == self.rank]
             if not owned:

@@ -413,3 +413,48 @@ def test_wave_stats():
     assert s["tasks"] == 20 and s["waves"] > 1
     assert 0 < s["kernel_calls"] < s["tasks"]
     assert s["dispatch_secs"] > 0 and s["compiled_kernels"] > 0
+
+
+# --------------------------------------------------------------------- #
+# ragged tilings: N not divisible by NB rides the wave engine through   #
+# shape-split pools (interior/edge/corner stacks, exact tile shapes —   #
+# the reference's lm%mb edge-tile contract, matrix.c:106,116)           #
+# --------------------------------------------------------------------- #
+@pytest.mark.parametrize("n,nb", [(1000, 128), (520, 128), (136, 64)])
+def test_wave_dpotrf_ragged(n, nb):
+    A, M = _spd_coll(n, nb)
+    w = wave(dpotrf_taskpool(A), max_chunk=64)
+    # the ragged tiling must split into >1 pool for the one collection
+    assert len(w.pool_names) > len(w.coll_names)
+    assert all(tuple(np.asarray(
+        A.tile_shape(*c))) == tuple(w._pool_shapes[pid])
+        for pid in range(len(w.pool_names))
+        for c in w._pool_coords[pid])
+    w.run()
+    L = np.tril(A.to_numpy()).astype(np.float64)
+    assert np.allclose(L, np.linalg.cholesky(M.astype(np.float64)),
+                       atol=1e-3)
+
+
+def test_wave_dgetrf_ragged():
+    n, nb = 840, 128        # 840 = 6*128 + 72: bottom/right/corner pools
+    A, M = _spd_coll(n, nb)
+    wave(dgetrf_nopiv_taskpool(A), max_chunk=32).run()
+    LU = A.to_numpy().astype(np.float64)
+    L = np.tril(LU, -1) + np.eye(n)
+    U = np.triu(LU)
+    assert np.abs(L @ U - M).max() / np.abs(M).max() < 1e-5
+
+
+def test_wave_pdgemm_ragged():
+    n, nb = 600, 128        # 600 = 4*128 + 88
+    rng = np.random.RandomState(7)
+    Am = rng.rand(n, n).astype(np.float32)
+    Bm = rng.rand(n, n).astype(np.float32)
+    A = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float32).from_numpy(Am)
+    B = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float32).from_numpy(Bm)
+    C = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float32).from_numpy(
+        np.zeros((n, n), np.float32))
+    wave(pdgemm_taskpool(A, B, C), max_chunk=16).run()
+    ref = Am.astype(np.float64) @ Bm.astype(np.float64)
+    assert np.abs(C.to_numpy().astype(np.float64) - ref).max() / n < 1e-6
