@@ -264,3 +264,25 @@ def test_dagenum_enumerates_without_executing(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "20 tasks, 30 dependence edges, critical path 10" in out
     assert dot.read_text().count("->") == 30
+
+
+def test_dagenum_sim_schedule(tmp_path, capsys):
+    """--sim: the PARSEC_SIM analog (simulated task dates over the
+    symbolic DAG). Invariants: serial >= wave makespan >= critical
+    path (level-synchronous slack is never negative)."""
+    import re
+
+    import dagenum
+    from parsec_tpu.ops.dpotrf import DPOTRF_L_JDF
+
+    jdf = tmp_path / "dpotrf.jdf"
+    jdf.write_text(DPOTRF_L_JDF)
+    assert dagenum.main([str(jdf), "-g", "NT=4", "--sim",
+                         "--cost", "POTRF=2.0", "--cost", "GEMM=0.5"]) == 0
+    out = capsys.readouterr().out
+    cp = float(re.search(r"critical path ([\d.]+)s", out).group(1))
+    serial = float(re.search(r"serial ([\d.]+)s", out).group(1))
+    wave = float(re.search(r"wave makespan ([\d.]+)s", out).group(1))
+    peak = int(re.search(r"peak (\d+)", out).group(1))
+    assert serial >= wave >= cp > 0
+    assert peak >= 3    # NT=4 exposes at least the 3-wide TRSM wave

@@ -10,6 +10,20 @@ Globals of collection type are synthesized as dummy tile holders sized
 from --tiles MTxNT (default 4x4). Prints per-class instance counts, edge
 count, and the critical-path length (depth of the DAG); --dot writes a
 Graphviz rendering of the full instance graph.
+
+--sim adds the simulated-date walk the reference builds as PARSEC_SIM
+(parsec_internal.h:524,674 — every task carries a sim_exec_date =
+max over predecessors + its duration): per-class durations come from
+repeated --cost CLASS=SECONDS (default 1.0), and the report gives the
+critical path in simulated time, the serial time, the achievable-
+parallelism profile (average + peak concurrency on infinite
+processors), and the WAVE schedule's makespan/slack — wave execution
+barriers at dependence levels, so its makespan is the sum of each
+level's longest task; slack vs the critical path is the price of
+level-synchronous batching.
+
+    python tools/dagenum.py parsec_tpu/ops/jdf/dpotrf.jdf -g NT=64 \\
+        --tiles 64x64 --sim --cost POTRF=2.5 --cost GEMM=1.0
 """
 import argparse
 import os
@@ -65,6 +79,11 @@ def main(argv=None) -> int:
     ap.add_argument("--tiles", default="4x4",
                     help="MTxNT of synthesized collections (default 4x4)")
     ap.add_argument("--dot", default=None, help="write a Graphviz file")
+    ap.add_argument("--sim", action="store_true",
+                    help="simulated-date schedule analysis (PARSEC_SIM)")
+    ap.add_argument("--cost", action="append", default=[],
+                    metavar="CLASS=SECONDS",
+                    help="per-class task duration for --sim (default 1.0)")
     args = ap.parse_args(argv)
     parts = args.tiles.lower().split("x")
     if len(parts) != 2 or not all(p.isdigit() for p in parts):
@@ -89,6 +108,56 @@ def main(argv=None) -> int:
           f"critical path {max(depth.values(), default=0)}")
     for name in sorted(counts):
         print(f"  {name:<12} {counts[name]:>6}")
+
+    if args.sim:
+        cost = {}
+        for c in args.cost:
+            if "=" not in c:
+                ap.error(f"--cost {c!r}: expected CLASS=SECONDS")
+            name, v = c.split("=", 1)
+            if name not in counts:
+                ap.error(f"--cost {c!r}: no task class {name!r} in this "
+                         f"JDF (classes: {', '.join(sorted(counts))})")
+            cost[name] = float(v)
+        # sim_exec_date walk (parsec_internal.h:674): a task starts at
+        # the max end date of its predecessors and runs its class's
+        # duration — the end-date max is the schedule-independent
+        # critical path (infinite processors, zero comm)
+        end = {}
+        lvl = {}
+        lvl_max = {}     # dependence level -> longest member (wave cost)
+        serial = 0.0
+        for inst in order:  # topo order: preds resolved first
+            d = cost.get(inst.tc.ast.name, 1.0)
+            serial += d
+            s = max((end[p] for p in inst.preds), default=0.0)
+            end[inst.key] = s + d
+            lv = 1 + max((lvl[p] for p in inst.preds), default=0)
+            lvl[inst.key] = lv
+            lvl_max[lv] = max(lvl_max.get(lv, 0.0), d)
+        cp = max(end.values(), default=0.0)
+        # achievable-parallelism profile: concurrency sweep over the
+        # as-soon-as-possible schedule's start/end events
+        events = []
+        for inst in order:
+            d = cost.get(inst.tc.ast.name, 1.0)
+            events.append((end[inst.key] - d, 1))
+            events.append((end[inst.key], -1))
+        events.sort()
+        cur = peak = 0
+        for _t, e in events:
+            cur += e
+            peak = max(peak, cur)
+        # wave execution barriers at dependence levels: its makespan is
+        # the sum of each level's longest task; the slack vs the
+        # critical path is the price of level-synchronous batching
+        wave_ms = sum(lvl_max.values())
+        print(f"  sim: critical path {cp:.3f}s, serial {serial:.3f}s, "
+              f"avg parallelism {serial / cp if cp else 0.0:.1f}, "
+              f"peak {peak}")
+        print(f"  sim: wave makespan {wave_ms:.3f}s over "
+              f"{len(lvl_max)} levels, slack vs critical path "
+              f"{((wave_ms - cp) / cp * 100.0) if cp else 0.0:+.1f}%")
 
     if args.dot:
         with open(args.dot, "w") as fh:
