@@ -1,0 +1,15 @@
+#!/usr/bin/env python
+"""ssh stand-in for multi-host launcher tests (no sshd in CI): accepts
+`fake_ssh.py <host> <shell-command>` exactly like `ssh host cmd` and
+runs the command in a local shell. The launcher's remote path (command
+construction, env wiring through `env K=V`, real-interface endpoint
+binding on loopback aliases) is exercised for real; only the transport
+to the other machine is faked."""
+import subprocess
+import sys
+
+if __name__ == "__main__":
+    if len(sys.argv) < 3:
+        sys.stderr.write("usage: fake_ssh.py <host> <command>\n")
+        sys.exit(2)
+    sys.exit(subprocess.call(["/bin/sh", "-c", sys.argv[-1]]))

@@ -112,3 +112,37 @@ def test_launch_jax_distributed_cross_process_collective(tmp_path):
     assert p.returncode == 0, (p.stdout[-3000:], p.stderr[-2000:])
     assert p.stdout.count("across 2 processes = 112.0 OK") == 2, \
         p.stdout[-2000:]
+
+
+def test_launch_multi_host_ssh():
+    """--hosts NAME:BINDADDR spawns non-local ranks through --ssh and
+    binds each rank's endpoint on its own interface (two loopback
+    aliases here; the ssh transport is tests/fake_ssh.py since CI has
+    no sshd — the command construction, `env` wiring, and per-host
+    endpoint binding are the real code path). The program itself does
+    a cross-rank broadcast, so the two "hosts" really talk."""
+    fake = os.path.join(ROOT, "tests", "fake_ssh.py")
+    out = _launch(2, "examples/ex05_broadcast.py", extra=(
+        "--hosts", "nodeA:127.0.0.2,nodeB:127.0.0.3",
+        "--ssh", f"{sys.executable} {fake}",
+        "--port-base", "29410"))
+    assert "[0] rank 0/2" in out and "[1] rank 1/2" in out
+
+
+def test_launch_multi_host_local_names_spawn_directly(tmp_path):
+    """127.* / localhost entries in --hosts bypass ssh entirely."""
+    probe = tmp_path / "p.py"
+    probe.write_text(
+        "import os\n"
+        "print('rank', os.environ['PARSEC_MCA_comm_rank'], 'ep',\n"
+        "      os.environ['PARSEC_MCA_comm_endpoints'])\n")
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    p = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "2", "--hosts", "127.0.0.1", "--ssh", "/nonexistent-ssh",
+         "--port-base", "29420", str(probe)],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert p.returncode == 0, (p.stdout[-2000:], p.stderr[-1000:])
+    assert "ep 127.0.0.1:29420,127.0.0.1:29421" in p.stdout

@@ -21,12 +21,35 @@ Options:
   --timeout S          per-rank wall clock limit (default 3600)
   --env K=V            extra env var for every rank (repeatable)
 
+Multi-host (the thing mpiexec exists to do):
+  --hosts H1,H2,...    place ranks round-robin on these hosts; each
+                       rank's endpoint binds ITS host's real interface
+                       and non-local ranks are spawned through --ssh
+                       (`ssh Hk 'cd WORKDIR && env VARS python prog'`).
+                       An entry is NAME[:BINDADDR] — ssh to NAME, bind
+                       the endpoint on BINDADDR (management vs data
+                       plane). Hosts named localhost/127.* spawn
+                       directly.
+  --ssh CMD            remote-spawn command (default "ssh"; any agent
+                       that accepts `CMD host shell-command` works)
+  --python EXE         remote interpreter (default: this one)
+  --workdir DIR        remote working directory + PYTHONPATH (default:
+                       this repo's root — assume a shared filesystem or
+                       an identical checkout, like any MPI deployment)
+  --port-base P        first control-plane port for --hosts runs
+                       (default 28900; rank r listens on P+r, the jax
+                       coordinator on P+N)
+
+The v5p-style deployment recipe lives in docs/guide.md ("Multi-host
+deployment").
+
 Each rank's stdout/stderr is streamed line-by-line with a "[r]" prefix.
 Exit status: 0 when every rank exits 0; otherwise the first non-zero
 rank's status (remaining ranks are killed — fail fast, like mpiexec).
 """
 import argparse
 import os
+import shlex
 import signal
 import subprocess
 import sys
@@ -34,6 +57,13 @@ import threading
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
+
+_LOCAL_NAMES = ("localhost", "127.", "::1")
+
+
+def _is_local(host: str) -> bool:
+    return host == "" or host == "::1" or \
+        any(host == n or host.startswith(n) for n in _LOCAL_NAMES)
 
 
 def main() -> int:
@@ -45,6 +75,11 @@ def main() -> int:
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--timeout", type=float, default=3600.0)
     ap.add_argument("--env", action="append", default=[])
+    ap.add_argument("--hosts", default=None)
+    ap.add_argument("--ssh", default="ssh")
+    ap.add_argument("--python", default=sys.executable)
+    ap.add_argument("--workdir", default=ROOT)
+    ap.add_argument("--port-base", type=int, default=28900)
     ap.add_argument("prog")
     ap.add_argument("prog_args", nargs=argparse.REMAINDER)
     args = ap.parse_args()
@@ -52,30 +87,73 @@ def main() -> int:
     from parsec_tpu.comm.tcp import free_ports
 
     n = args.nranks
-    ports = free_ports(n + (1 if args.jax_distributed else 0))
-    endpoints = ",".join(f"{args.host}:{p}" for p in ports[:n])
+    if args.hosts:
+        # each entry is NAME[:BINDADDR]: NAME is the --ssh target (the
+        # management hostname), BINDADDR the data-plane interface the
+        # rank's endpoint binds/advertises (defaults to NAME)
+        hosts = []
+        for h in args.hosts.split(","):
+            h = h.strip()
+            if h:
+                name, _, bind = h.partition(":")
+                hosts.append((name, bind or name))
+        if not hosts:
+            ap.error("--hosts: empty host list")
+        host_of = [hosts[r % len(hosts)][0] for r in range(n)]
+        bind_of = [hosts[r % len(hosts)][1] for r in range(n)]
+        # remote hosts can't join a local free-port probe: fixed
+        # port-base layout, unique per rank even when hosts repeat
+        ports = [args.port_base + r for r in range(n + 1)]
+    else:
+        host_of = [args.host] * n
+        bind_of = host_of
+        ports = free_ports(n + (1 if args.jax_distributed else 0))
+    endpoints = ",".join(f"{bind_of[r]}:{ports[r]}" for r in range(n))
 
-    base_env = dict(os.environ)
+    # vars the launcher wires (carried to remote ranks over --ssh; the
+    # full local environ only reaches directly-spawned local ranks)
+    wired = {}
     for kv in args.env:
         k, _, v = kv.partition("=")
-        base_env[k] = v
-    base_env["PARSEC_MCA_comm_transport"] = "tcp"
-    base_env["PARSEC_MCA_comm_endpoints"] = endpoints
+        wired[k] = v
+    wired["PARSEC_MCA_comm_transport"] = "tcp"
+    wired["PARSEC_MCA_comm_endpoints"] = endpoints
     if args.jax_distributed:
-        base_env["PARSEC_MCA_jax_coordinator"] = \
-            f"{args.host}:{ports[n]}"
-        base_env["PARSEC_MCA_jax_num_processes"] = str(n)
+        wired["PARSEC_MCA_jax_coordinator"] = f"{bind_of[0]}:{ports[n]}"
+        wired["PARSEC_MCA_jax_num_processes"] = str(n)
+    base_env = dict(os.environ)
+    base_env.update(wired)
 
     procs = []
     for r in range(n):
-        env = dict(base_env)
-        env["PARSEC_MCA_comm_rank"] = str(r)
+        rank_over = {"PARSEC_MCA_comm_rank": str(r)}
         if args.jax_distributed:
-            env["PARSEC_MCA_jax_process_id"] = str(r)
-        procs.append(subprocess.Popen(
-            [sys.executable, args.prog] + args.prog_args,
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True))
+            rank_over["PARSEC_MCA_jax_process_id"] = str(r)
+        if args.hosts and not _is_local(host_of[r]):
+            over = dict(wired)
+            over.update(rank_over)
+            over.setdefault("PYTHONPATH", args.workdir)
+            parts = ["cd", shlex.quote(args.workdir), "&&", "env"]
+            parts += [f"{k}={shlex.quote(v)}"
+                      for k, v in sorted(over.items())]
+            # resolve prog against the REMOTE workdir (the local
+            # checkout path means nothing on the other machine);
+            # absolute paths are taken as-is
+            rprog = args.prog if os.path.isabs(args.prog) else \
+                os.path.join(args.workdir, args.prog)
+            parts += [shlex.quote(args.python), shlex.quote(rprog)]
+            parts += [shlex.quote(a) for a in args.prog_args]
+            cmd = shlex.split(args.ssh) + [host_of[r], " ".join(parts)]
+            procs.append(subprocess.Popen(
+                cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True))
+        else:
+            env = dict(base_env)
+            env.update(rank_over)
+            procs.append(subprocess.Popen(
+                [sys.executable, args.prog] + args.prog_args,
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True))
 
     def pump(r, stream):
         for line in stream:
