@@ -416,7 +416,8 @@ class DistWaveRunner(WaveRunner):
                 "kernel_calls": n_calls,
                 "dispatch_secs": round(time.perf_counter() - t0, 6),
                 "compiled_kernels": sum(len(p.kernels)
-                                        for p in self.plans),
+                                        for p in self.plans)
+                + len(self._fused_kerns),
                 "local_tasks": int((self._rank_of_task == self.rank).sum()),
                 "transfers_scheduled": self._n_transfers,
                 "tiles_sent": self._sent_tiles,

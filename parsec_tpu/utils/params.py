@@ -83,6 +83,15 @@ class ParamRegistry:
             if p is not None:
                 p._resolved = False
 
+    def unset_cmdline(self, name: str) -> None:
+        """Remove a cmdline-layer override (lower layers shine through
+        again); no-op when none is set."""
+        with _lock:
+            self._cmdline.pop(name, None)
+            p = self._params.get(name)
+            if p is not None:
+                p._resolved = False
+
     def parse_argv(self, argv: List[str]) -> List[str]:
         """Consume ``--mca name value`` / ``--parsec name=value`` pairs.
 

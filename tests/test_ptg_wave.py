@@ -173,10 +173,12 @@ def test_chunk_decomposition():
     assert sum(W._chunks(1023, 64)) == 1023
 
 
-def test_wave_cyclic_war_raises():
-    """Two co-ready tasks each reading the tile the other writes: legal
-    dataflow, but unservable by in-place scatters — must raise, not
-    corrupt."""
+def test_wave_cyclic_war():
+    """Two co-ready tasks each reading the tile the other writes (a
+    swap): fused waves gather every input before any scatter, so both
+    read pre-wave values and the swap is exact (the per-task runtime's
+    copy semantics). With fusion disabled the layered in-place scatters
+    cannot serve it — must raise, not corrupt."""
     jdf = """
 descA [ type="collection" ]
 NT [ type="int" ]
@@ -214,11 +216,26 @@ BODY
 END
 """
     fac = ptg.compile_jdf(jdf, name="swap")
+    M0 = np.arange(32, dtype=np.float32).reshape(8, 4)
     descA = TwoDimBlockCyclic(8, 4, 4, 4, dtype=np.float32).from_numpy(
-        np.arange(32, dtype=np.float32).reshape(8, 4))
+        M0.copy())
     w = wave(fac.new(NT=1, descA=descA))
-    with pytest.raises(WaveError, match="cyclic"):
-        w.run()
+    assert w._fuse
+    w.run()
+    swapped = np.vstack([M0[4:], M0[:4]])
+    np.testing.assert_array_equal(descA.to_numpy(), swapped)
+
+    from parsec_tpu.utils.params import params
+    params.set_cmdline("wave_fuse", "0")
+    try:
+        descB = TwoDimBlockCyclic(8, 4, 4, 4, dtype=np.float32).from_numpy(
+            M0.copy())
+        w2 = wave(fac.new(NT=1, descA=descB))
+        assert not w2._fuse
+        with pytest.raises(WaveError, match="cyclic"):
+            w2.run()
+    finally:
+        params.unset_cmdline("wave_fuse")
 
 
 def test_lowering_cache_evicts_with_jdf():
