@@ -889,24 +889,29 @@ class WaveRunner:
             entries, gather_bytes = self._frontier_entries(
                 ids, classes, pools)
             if gather_bytes <= self._fuse_bytes:
-                self._check_two_writers(ids, classes)
                 if len(entries) == 1:
+                    self._check_two_writers(ids, classes)
                     return self._call_chunk(entries[0][0], entries[0][1],
                                             pools), 1
                 specs = tuple(e[0] for e in entries)
                 if specs in self._fused_kerns or \
                         len(self._fused_kerns) < self._fuse_programs:
-                    args = [e[1] for e in entries]
-                    try:
-                        pools = self._fused_kernel(specs)(pools, args)
-                    except Exception as exc:
-                        werr = self._trace_error(exc, "fused wave")
-                        if werr is not None:
-                            raise werr from exc
-                        raise
-                    return pools, 1
+                    self._check_two_writers(ids, classes)
+                    return self._call_fused(specs, entries, pools), 1
         n_calls = 0
-        layers = self._split_war(ids, classes)
+        try:
+            layers = self._split_war(ids, classes)
+        except WaveError:
+            if entries is None:
+                raise       # fusion off: the layered contract stands
+            # _split_war re-raises two-writer races via
+            # _check_two_writers; if that passes, the failure was a
+            # CYCLIC WAR frontier — only the fused gather-before-
+            # scatter form can serve it, so correctness overrides the
+            # fusion byte/program budgets
+            self._check_two_writers(ids, classes)
+            return self._call_fused(tuple(e[0] for e in entries),
+                                    entries, pools), 1
         for sids, cls in layers:
             if len(layers) == 1 and entries is not None:
                 sub_entries = entries
@@ -916,6 +921,16 @@ class WaveRunner:
                 pools = self._call_chunk(spec, a, pools)
                 n_calls += 1
         return pools, n_calls
+
+    def _call_fused(self, specs: Tuple, entries, pools: Tuple) -> Tuple:
+        args = [e[1] for e in entries]
+        try:
+            return self._fused_kernel(specs)(pools, args)
+        except Exception as exc:
+            werr = self._trace_error(exc, "fused wave")
+            if werr is not None:
+                raise werr from exc
+            raise
 
     def execute(self, pools: Tuple) -> Tuple:
         """Run the DAG over device tile pools (stacked arrays ordered

@@ -127,15 +127,15 @@ def run_wave(eng, rank, nb_ranks, n=256, nb=64, use_plane=False):
     """Distributed WAVE dpotrf across real OS processes: every rank
     executes its block-cyclic slice as batched kernels, tile exchange
     rides TAG_WAVE messages over the sockets (dsl/ptg/wave_dist.py).
-    With ``use_plane`` the tile payloads move device-to-device through
-    the transfer plane; TCP carries only descriptors + acks."""
+    With ``use_plane`` the runner's DEFAULT device-plane attach stands
+    (tile payloads move device-to-device, TCP carries descriptors +
+    acks); without it the host-byte fallback is forced via the
+    wave_dist_plane MCA param."""
     from parsec_tpu.ops import dpotrf_taskpool, make_spd
 
-    plane = None
-    if use_plane:
-        from parsec_tpu.comm import DeviceDataPlane
-        plane = DeviceDataPlane(eng)
-        plane.exchange()
+    if not use_plane:
+        from parsec_tpu.utils.params import params
+        params.set_cmdline("wave_dist_plane", "off")
 
     M = make_spd(n, dtype=np.float64)
     coll = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float64, P=nb_ranks,
@@ -144,6 +144,7 @@ def run_wave(eng, rank, nb_ranks, n=256, nb=64, use_plane=False):
     coll.from_numpy(M.copy())
     tp = dpotrf_taskpool(coll, rank=rank, nb_ranks=nb_ranks)
     w = ptg.wave(tp, comm=eng)
+    plane = getattr(eng, "device_plane", None)   # runner auto-attach
     w.run()
     ref = np.linalg.cholesky(M)
     err = 0.0

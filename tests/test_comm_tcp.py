@@ -159,10 +159,11 @@ def test_xfer_stress_across_processes():
 
 
 def test_wave_dpotrf_across_processes():
-    """Distributed WAVE dpotrf across 2 real OS processes: each rank
-    runs its block-cyclic slice as batched kernels; the static tile
-    exchange schedule rides the sockets (wave throughput + distribution
-    in one engine — round-2 VERDICT item 3)."""
+    """Distributed WAVE dpotrf across 2 real OS processes with the
+    HOST-BYTE fallback forced (wave_dist_plane=off): the static tile
+    exchange schedule rides the sockets end to end (wave throughput +
+    distribution in one engine — round-2 VERDICT item 3; the default
+    device-plane hop is covered by the _device_plane variant)."""
     outs = _run_ranks(2, 0, mode="wave", timeout=300)
     assert all(o["max_err"] < 5e-3 for o in outs), outs
     assert all(o["msgs"] > 0 for o in outs)
@@ -170,10 +171,11 @@ def test_wave_dpotrf_across_processes():
 
 
 def test_wave_dpotrf_device_plane_across_processes():
-    """Distributed wave with the device-plane payload hop: tile
-    exchanges move device-to-device through the transfer plane, TCP
-    carries only descriptors and park acks; zero leaked parks, same
-    numerics."""
+    """Distributed wave with the device-plane payload hop — the
+    DEFAULT on cross-process transports (the runner auto-attaches;
+    nothing opts in): tile exchanges move device-to-device through the
+    transfer plane, TCP carries only descriptors and park acks; zero
+    leaked parks, same numerics."""
     outs = _run_ranks(2, 0, mode="wave_xfer", timeout=300)
     assert all(o["max_err"] < 5e-3 for o in outs), outs
     tile_bytes = 64 * 64 * 8

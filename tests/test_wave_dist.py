@@ -413,3 +413,90 @@ def test_dist_wave_dgetrf_ragged(nb_ranks=2):
     L = np.tril(LU, -1) + np.eye(n)
     U = np.triu(LU)
     assert np.abs(L @ U - M).max() / np.abs(M).max() < 1e-5
+
+
+# --------------------------------------------------------------------- #
+# collective lanes: a tile read by P remote ranks propagates along a    #
+# static broadcast tree (re-forwarded by receivers, the reference's     #
+# remote_dep.c:272-358 collective propagation) instead of P sends from  #
+# the source                                                            #
+# --------------------------------------------------------------------- #
+BCAST_JDF = """
+descA [ type="collection" ]
+descB [ type="collection" ]
+R [ type="int" ]
+
+Read(r)
+r = 0 .. R-1
+: descB( r, 0 )
+RW B <- descB( r, 0 )
+     -> descB( r, 0 )
+READ L <- descA( 0, 0 )
+BODY
+{
+    B = B + L
+}
+END
+"""
+
+
+def _bcast_rank(rank, fabric, nb_ranks, A0, B0, nb):
+    ce = fabric.engine(rank)
+    mk = lambda: TwoDimBlockCyclic(nb_ranks * nb, nb, nb, nb,
+                                   dtype=np.float64, P=nb_ranks, Q=1,
+                                   nodes=nb_ranks, rank=rank)
+    dA, dB = mk(), mk()
+    dA.name, dB.name = "descA", "descB"
+    dA.from_numpy(A0.copy())
+    dB.from_numpy(B0.copy())
+    tp = ptg.compile_jdf(BCAST_JDF, name="bcastw").new(
+        descA=dA, descB=dB, R=nb_ranks, rank=rank, nb_ranks=nb_ranks)
+    w = ptg.wave(tp, comm=ce)
+    w.run()
+    return w.stats, _gather_owned(dB, rank)
+
+
+def _run_bcast(nb_ranks, topo):
+    from parsec_tpu.utils.params import params
+    nb = 8
+    rng = np.random.RandomState(3)
+    A0 = rng.rand(nb_ranks * nb, nb)
+    B0 = rng.rand(nb_ranks * nb, nb)
+    params.set_cmdline("wave_dist_bcast", topo)
+    try:
+        results, _ = spmd(
+            nb_ranks,
+            lambda r, f: _bcast_rank(r, f, nb_ranks, A0, B0, nb))
+    finally:
+        params.unset_cmdline("wave_dist_bcast")
+    # numerics: every rank's row block got A's first tile added
+    for r, (_st, owned) in enumerate(results):
+        np.testing.assert_allclose(
+            owned[(r, 0)], B0[r * nb:(r + 1) * nb] + A0[:nb],
+            rtol=1e-6)
+    return [st for st, _o in results]
+
+
+def test_dist_wave_bcast_tree_offloads_root(nb_ranks=4):
+    """descA(0,0) is read by all 4 ranks: star ships 3 tiles from the
+    root; the binomial tree ships 2 from the root and 1 re-forward from
+    an interior rank — the root's send count scales sub-linearly."""
+    star = _run_bcast(nb_ranks, "star")
+    assert star[0]["tiles_sent"] == nb_ranks - 1
+    assert sum(s["tiles_forwarded"] for s in star) == 0
+
+    tree = _run_bcast(nb_ranks, "binomial")
+    assert tree[0]["bcast_topology"] == "binomial"
+    assert tree[0]["tiles_sent"] < nb_ranks - 1      # root offloaded
+    assert sum(s["tiles_forwarded"] for s in tree) >= 1
+    # same tile volume reaches the readers either way
+    assert sum(s["tiles_recv"] for s in tree) == \
+        sum(s["tiles_recv"] for s in star) == nb_ranks - 1
+
+
+def test_dist_wave_bcast_chain_root_sends_once(nb_ranks=4):
+    """Chain topology: the root ships each broadcast tile exactly ONCE
+    regardless of reader count (O(1) in P), the chain re-forwards."""
+    chain = _run_bcast(nb_ranks, "chain")
+    assert chain[0]["tiles_sent"] == 1
+    assert sum(s["tiles_forwarded"] for s in chain) == nb_ranks - 2

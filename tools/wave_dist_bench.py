@@ -7,8 +7,10 @@ execute() region (pools staged, ranks sync'd before the clock starts),
 numerics-gates the assembled factor, and prints one JSON line.
 
 Usage: python tools/wave_dist_bench.py [N [NB [NP]]]   (default 16384 512 2)
-Env: WAVE_DIST_DTYPE (float32), WAVE_DIST_REPS (1), WAVE_DIST_PLANE=1
-(attach a DeviceDataPlane per rank: exchanges go device-to-device).
+Env: WAVE_DIST_DTYPE (float32), WAVE_DIST_REPS (1). The device plane is
+ON by default (exchanges go device-to-device; the runner attaches a
+DeviceDataPlane per rank on TCP transports); WAVE_DIST_PLANE=0 opts
+back into host-byte exchanges.
 """
 import json
 import os
@@ -38,9 +40,11 @@ def rank_main() -> int:
 
     M = make_spd(n, dtype=dtype)
     eng = TCPCommEngine(rank, [("127.0.0.1", p) for p in ports])
-    if os.environ.get("WAVE_DIST_PLANE") == "1":
-        from parsec_tpu.comm import DeviceDataPlane
-        DeviceDataPlane(eng).exchange()
+    if os.environ.get("WAVE_DIST_PLANE") == "0":
+        # the runner attaches a DeviceDataPlane by default on TCP
+        # transports; this opts back into host-byte exchanges
+        from parsec_tpu.utils.params import params
+        params.set_cmdline("wave_dist_plane", "off")
     try:
         coll = TwoDimBlockCyclic(n, n, nb, nb, dtype=dtype, P=nb_ranks,
                                  Q=1, nodes=nb_ranks, rank=rank)
