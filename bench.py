@@ -183,13 +183,54 @@ def bench_wave(n, nb, reps, dtype):
     return best, check_numerics_device(lower, M, n, nb)
 
 
-def bench_runtime(n, nb, reps, cores, dtype):
-    """Per-task dispatch through the scheduler + TPU device module."""
+def bench_runtime(n, nb, reps, cores, dtype, dispatch="turbo"):
+    """Per-task dispatch through the context (ctx.add_taskpool + wait).
+
+    dispatch="turbo" (default): static dep management — the lowered DAG
+    runs on the native C select/release loop with precompiled slot
+    binding, one XLA call per task (dsl/ptg/turbo.py; the reference's
+    index-array mode + scheduling.c hot loop). dispatch="classic":
+    dynamic hash dep tracking + scheduler + device module per task (the
+    historical runtime_gflops path, kept as runtime_classic in extras).
+    """
     import parsec_tpu
     from parsec_tpu.collections import TwoDimBlockCyclic
     from parsec_tpu.ops import dpotrf_taskpool, make_spd
+    from parsec_tpu.utils.params import params
 
     M = make_input(n, dtype)
+    if dispatch == "turbo":
+        # drive the TurboRunner directly so pool staging (the H2D of
+        # the whole matrix) happens OUTSIDE the clock, mirroring how
+        # the classic path's HBM prestage is untimed: the timed region
+        # is per-task dispatch + kernels only (steady-state model)
+        import jax
+        from parsec_tpu.dsl.ptg.turbo import TurboRunner
+        from parsec_tpu.collections import TwoDimBlockCyclic as TDBC
+        from parsec_tpu.ops import dpotrf_taskpool as mk_tp
+
+        params.set_cmdline("ptg_dep_management", "static")
+        try:
+            dev = jax.devices()[0]
+            best = None
+            A = None
+            for _ in range(max(2, reps)):
+                A = TDBC(n, n, nb, nb, dtype=dtype).from_numpy(M)
+                r = TurboRunner(mk_tp(A))
+                pools = r.build_pools(device=dev)
+                jax.block_until_ready(pools)
+                t0 = time.perf_counter()
+                pools = r.execute_per_task(pools, device=dev)
+                jax.block_until_ready(pools)
+                dt = time.perf_counter() - t0
+                best = dt if best is None else min(best, dt)
+            # shape-split (pool, row) map for the device-side check
+            loc = r._pool_of.get("descA") or next(iter(r._pool_of.values()))
+            lower = {c: pools[pid][row] for c, (pid, row) in loc.items()
+                     if c[0] >= c[1]}
+            return best, check_numerics_device(lower, M, n, nb)
+        finally:
+            params.unset_cmdline("ptg_dep_management")
     ctx = parsec_tpu.init(nb_cores=cores)
     try:
         # warmup: 3x3 tiles so POTRF/TRSM/SYRK *and* GEMM kernels compile
@@ -215,7 +256,8 @@ def bench_runtime(n, nb, reps, cores, dtype):
                     A.data_of(tm, tn).get_copy(tpu_devs[0].device_index).payload
                     for (tm, tn) in A.tiles()])
             t0 = time.perf_counter()
-            ctx.add_taskpool(dpotrf_taskpool(A))
+            tp = dpotrf_taskpool(A)
+            ctx.add_taskpool(tp)
             ctx.wait()
             # the DAG is done when every output tile's device result
             # exists; block on the newest copies so async dispatch is
@@ -234,22 +276,63 @@ def bench_runtime(n, nb, reps, cores, dtype):
         ctx.fini()
 
 
-def bench_chip_gemm(reps=10, n=2048):
-    """Bare-chip microbench: effective rate of a pipelined dependent
-    GEMM chain (normalizes tunnel anomalies: if this number is absurd,
-    so is everything measured through the same chip)."""
+# f32-input matmul ceiling for this device class (v5e-class MXU;
+# bf16-input passes peak ~197 TF/s — anything above this is a tunnel
+# timing artifact, not physics). Round-3's chained microbench read half
+# an exaflop through the relay's async-ack behavior; every peak
+# estimate is sanity-capped against this.
+CHIP_CAP_GFLOPS = 250e3
+
+
+def bench_chip_peak(n=2048, chain=16, reps=5):
+    """Trustworthy chip peak for the MFU denominator (ref: the peak-
+    model role of device_cuda_module.c:465-468).
+
+    Two estimates, both ending in a real device sync:
+    - sync-amortized: one GEMM timed to completion, with the measured
+      per-call link latency (a tiny GEMM's round-trip) subtracted;
+    - chained: K dependent GEMMs behind ONE block_until_ready.
+    The best PHYSICALLY POSSIBLE estimate wins; values above the
+    device-class cap are discarded as relay artifacts.
+    Returns (peak_gflops, details)."""
     import jax
     rng = np.random.RandomState(0)
     x = jax.device_put(rng.rand(n, n).astype(np.float32))
-    f = jax.jit(lambda a: a @ a * (1.0 / n))
-    y = f(x)
-    jax.block_until_ready(y)
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        y = f(y)
-    jax.block_until_ready(y)
-    dt = (time.perf_counter() - t0) / reps
-    return 2 * n ** 3 / dt / 1e9
+    s = jax.device_put(rng.rand(128, 128).astype(np.float32))
+    f = jax.jit(lambda a: a @ a * (1.0 / a.shape[0]))
+    jax.block_until_ready(f(x))
+    jax.block_until_ready(f(s))
+
+    def best_of(fn, k=reps):
+        b = None
+        for _ in range(k):
+            t0 = time.perf_counter()
+            fn()
+            dt = time.perf_counter() - t0
+            b = dt if b is None or dt < b else b
+        return b
+
+    t_small = best_of(lambda: jax.block_until_ready(f(s)))
+    t_sync = best_of(lambda: jax.block_until_ready(f(x)))
+
+    def chain_run():
+        y = f(x)
+        for _ in range(chain - 1):
+            y = f(y)
+        jax.block_until_ready(y)
+
+    t_chain = best_of(chain_run) / chain
+    flops = 2.0 * n ** 3
+    est_chain = flops / t_chain / 1e9
+    est_sync = flops / max(t_sync - t_small, 1e-9) / 1e9
+    details = {"sync_ms": round(t_sync * 1e3, 3),
+               "call_latency_ms": round(t_small * 1e3, 3),
+               "chained_gflops": round(est_chain, 1),
+               "sync_amortized_gflops": round(est_sync, 1)}
+    cands = [v for v in (est_chain, est_sync) if v <= CHIP_CAP_GFLOPS]
+    details["artifact_rejected"] = len(cands) < 2
+    peak = max(cands) if cands else CHIP_CAP_GFLOPS
+    return peak, details
 
 
 def bench_all(n, nb, reps, cores, dtype):
@@ -298,9 +381,12 @@ def bench_all(n, nb, reps, cores, dtype):
         else:
             extras[key] = f"numerics failed: {err}"
 
-    g = _try("chip_gemm", bench_chip_gemm)
-    if g is not None:
-        extras["chip_gemm_gflops(2048^3,f32)"] = round(g, 1)
+    peak = None
+    pk = _try("chip_peak", bench_chip_peak)
+    if pk is not None:
+        peak, det = pk
+        extras["chip_peak_gflops(f32)"] = round(peak, 1)
+        extras["chip_peak_detail"] = det
 
     # strongest candidate FIRST: the tunnel degrades within a session
     # under load, so later modes see a worse link than earlier ones.
@@ -316,7 +402,13 @@ def bench_all(n, nb, reps, cores, dtype):
     n_rt = int(os.environ.get("BENCH_RUNTIME_N", "4096"))
     _record("runtime", n_rt, 512,
             _try("runtime512",
-                 lambda: bench_runtime(n_rt, 512, max(2, reps), cores, dtype)))
+                 lambda: bench_runtime(n_rt, 512, max(2, reps), cores,
+                                       dtype)))
+    # the historical dynamic-hash + scheduler path, for continuity
+    _record("runtime_classic", n_rt, 512,
+            _try("runtime_classic512",
+                 lambda: bench_runtime(n_rt, 512, max(2, reps), cores,
+                                       dtype, dispatch="classic")))
 
     if not candidates:
         print(json.dumps({"metric": "dpotrf_gflops", "value": 0.0,
@@ -325,13 +417,15 @@ def bench_all(n, nb, reps, cores, dtype):
                           "extras": extras}))
         return
     mode, n_used, nb_used, gf = max(candidates, key=lambda c: c[3])
-    # tunnel_degraded compares chip_gemm against the XLA-path modes
-    # (capture/wave) only: the per-task runtime mode is Python-dispatch
-    # bound by design, so a >10x gap to bare GEMM is its NORMAL state,
-    # not a tunnel signal
+    # tunnel_degraded compares the trusted chip peak against the
+    # XLA-path modes (capture/wave) only: the per-task runtime mode is
+    # dispatch bound by design, so a >10x gap to bare GEMM is its
+    # NORMAL state, not a tunnel signal
     xla_gfs = [c[3] for c in candidates if c[0] in ("capture", "wave")]
-    if g is not None and (not xla_gfs or g > 10 * max(xla_gfs)):
+    if peak is not None and (not xla_gfs or peak > 10 * max(xla_gfs)):
         extras["tunnel_degraded"] = True
+    if peak is not None:
+        extras["mfu"] = round(gf / peak, 4)
     emit_line(n_used, nb_used, dtype, mode, gf, extras)
 
 

@@ -48,7 +48,7 @@ def main(n: int = 512, nb: int = 64) -> int:
         for (i, j) in A.tiles():
             if A.rank_of(i, j) != rank or i < j:
                 continue
-            t = np.asarray(A.data_of(i, j).host_copy().payload)
+            t = np.asarray(A.data_of(i, j).sync_to_host().payload)
             if i == j:
                 t = np.tril(t)
             err = max(err, float(np.abs(

@@ -231,6 +231,34 @@ class JaxDevice(Device):
         else:
             self._inflight.append(rec)
 
+    def drain(self, context=None) -> None:
+        """Retire every remaining window entry (called at wait()-exit:
+        the DAGs are complete, and the records would otherwise pin the
+        final tasks' object graphs — taskpool, collections, copies —
+        until some future taskpool's progress happens to run). Async
+        kernel failures in these trailing entries are RECORDED on the
+        context so the caller's raise_pending_error surfaces them
+        instead of a silently-successful wait()."""
+        if not self._manager_lock.acquire(blocking=True):
+            return  # pragma: no cover - Lock.acquire(True) returns True
+        try:
+            for rec in self._window:
+                self.load_sub(rec.est)
+                try:
+                    for a in rec.outputs:
+                        if a is not None and hasattr(a, "block_until_ready"):
+                            a.block_until_ready()
+                except Exception as exc:
+                    if context is not None:
+                        context.record_task_error(exc, rec.task)
+                    else:
+                        plog.warning(
+                            "async kernel of %s failed at drain: %s",
+                            rec.task.snprintf(), exc)
+            self._window = []
+        finally:
+            self._manager_lock.release()
+
     def _retire(self, rec: _InFlight, es=None) -> None:
         """Release a window entry: drop its load contribution and surface
         any async kernel error — against the task that DISPATCHED it

@@ -54,7 +54,7 @@ class LoweredDAG:
 
     __slots__ = ("n_tasks", "class_names", "class_of", "locals_of", "id_of",
                  "indptr", "succ", "succ_flow", "out_flow", "indegree",
-                 "priority", "max_flows")
+                 "priority", "max_flows", "kernel_cache")
 
     def __init__(self, n_tasks: int, class_names: List[str],
                  class_of: np.ndarray, locals_of: List[Tuple],
@@ -74,6 +74,13 @@ class LoweredDAG:
         self.indegree = indegree
         self.priority = priority
         self.max_flows = max_flows
+        # compiled chunk/fused/turbo kernels shared by every runner
+        # built over this DAG: the DAG itself is cached per (JDF, bound
+        # globals), and kernel traces are a pure function of that same
+        # signature, so repeated taskpool instantiations (benchmark
+        # reps, iterative solvers) reuse XLA programs instead of
+        # recompiling per runner
+        self.kernel_cache: Dict[Tuple, Any] = {}
 
     @property
     def n_edges(self) -> int:

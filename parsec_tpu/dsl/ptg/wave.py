@@ -72,7 +72,8 @@ class _ClassPlan:
 
     __slots__ = ("tc", "ast", "flow_idx", "flow_names",
                  "written", "reads", "range_locals", "body_locals", "code",
-                 "kernels", "in_tnames", "wb_names", "in_tname", "wb_name")
+                 "kernels", "in_tnames", "wb_names", "in_tname", "wb_name",
+                 "_kplan")
 
     def __init__(self, tc) -> None:
         self.tc = tc
@@ -107,6 +108,168 @@ class _ClassPlan:
         self.body_locals = [i for i, nm in enumerate(self.range_locals)
                             if nm in names]
         self.kernels: Dict[Tuple, Any] = {}
+        self._kplan = None
+
+    def kplan(self) -> "_KPlan":
+        """The light view kernel traces capture: per-class metadata
+        WITHOUT the task-class/taskpool back-references, so kernels
+        cached on the (process-cached) LoweredDAG cannot pin runners,
+        collections, or device pools for process lifetime."""
+        if self._kplan is None:
+            self._kplan = _KPlan(self)
+        return self._kplan
+
+
+class _KPlan:
+    __slots__ = ("name", "nf", "flow_names", "written", "wb_name",
+                 "in_tname", "range_locals", "body_locals", "derived",
+                 "code")
+
+    def __init__(self, p: _ClassPlan) -> None:
+        self.name = p.ast.name
+        self.nf = len(p.flow_idx)
+        self.flow_names = p.flow_names
+        self.written = p.written
+        # in_tname/wb_name lists are assigned ELEMENT-wise by
+        # _validate_tnames — sharing the list objects keeps the view
+        # current regardless of construction order
+        self.wb_name = p.wb_name
+        self.in_tname = p.in_tname
+        self.range_locals = p.range_locals
+        self.body_locals = p.body_locals
+        self.derived = [(ld.name, ld.expr) for ld in p.ast.locals
+                        if ld.range is None]
+        self.code = p.code
+
+
+# --------------------------------------------------------------------- #
+# kernel trace logic: module-level so jitted closures capture only the  #
+# light _KPlan views + a collection-pruned env — never a runner (cached #
+# traces live on the process-cached LoweredDAG and must not pin pools)  #
+# --------------------------------------------------------------------- #
+def _resolve_dst_f(genv, p: _KPlan, k, nm, tile_shape, pool_dtype):
+    """Concrete Datatype for a validated [type*] name (called at kernel
+    TRACE time, when pool tile shapes are in hand)."""
+    val = genv.get(nm)
+    if isinstance(val, Datatype):
+        dst = val
+    else:   # validated shorthand
+        dst = Datatype(pool_dtype, tuple(tile_shape), nm)
+    if tuple(dst.shape) != tuple(tile_shape):
+        raise WaveError(
+            f"{p.name}.{p.flow_names[k]}: [type={nm}] shape "
+            f"{dst.shape} differs from the pool tile {tile_shape}; "
+            f"wave pools are fixed-shape — use the per-task runtime")
+    return dst
+
+
+def _make_one_f(genv, p: _KPlan, statics: Tuple):
+    """Traceable single-instance body with the given static body-local
+    values; [type]/[type_data] input conversions (masked casts) applied
+    after the gather so XLA fuses them into the body (ref:
+    parsec_reshape.c consumer-side promise trigger)."""
+    import jax.numpy as jnp
+
+    flow_names = p.flow_names
+    written = p.written
+    in_tname = p.in_tname
+    range_locals = p.range_locals
+    derived = p.derived
+    code = p.code
+    static_pairs = [(range_locals[i], v)
+                    for i, v in zip(p.body_locals, statics)]
+
+    def conv_in(j, v):
+        nm = in_tname[j]
+        if nm is None:
+            return v
+        dst = _resolve_dst_f(genv, p, j, nm, tuple(v.shape), v.dtype)
+        if dst.compatible_wire(Datatype(v.dtype, tuple(v.shape))):
+            return v
+        return reshape_array(v, dst)
+
+    def one(loc_row, *flow_vals):
+        env = dict(genv)
+        for nm, v in zip(range_locals, loc_row):
+            env[nm] = v
+        for nm, v in static_pairs:  # concrete: bodies may branch
+            env[nm] = v
+        for nm, ex in derived:
+            env[nm] = ex(env)
+        for j, (nm, v) in enumerate(zip(flow_names, flow_vals)):
+            env[nm] = conv_in(j, v)
+        env["np"] = np
+        env["jnp"] = jnp
+        env["es_rank"] = 0
+        env["this_task"] = None
+        exec(code, env)
+        return tuple(env[nm] for nm, w in zip(flow_names, written) if w)
+
+    return one
+
+
+def _merge_masked_f(genv, p: _KPlan, j, val, dest_old):
+    """Region-masked memory writeback: only in-region elements land;
+    the rest keep the DESTINATION's pre-wave values (the detached-clone
+    semantics of the per-task runtime). ``val`` is BATCHED [k, ...];
+    the declared dtype round-trip mirrors reshape_to + np.copyto, the
+    mask broadcasts."""
+    import jax.numpy as jnp
+
+    dst = _resolve_dst_f(genv, p, j, p.wb_name[j],
+                         tuple(dest_old.shape[1:]), dest_old.dtype)
+    conv = val.astype(dst.dtype).astype(dest_old.dtype)
+    mask = dst.mask()
+    return (conv if mask is None else
+            jnp.where(jnp.asarray(mask), conv, dest_old))
+
+
+def _gather_group_f(kplans, pools, spec, idx_in, idx_out, idx_wbx):
+    """Gather one group's inputs + masked-merge destinations from the
+    (pre-scatter) pools."""
+    _ci, _k, _st, incols, outcols, wbflags, wbxcols = spec
+    p = kplans[_ci]
+    nf = p.nf
+    gathered = [pools[incols[j]][idx_in[j]] for j in range(nf)]
+    dest_old = {j: pools[outcols[j]][idx_out[j]] for j in range(nf)
+                if p.written[j] and p.wb_name[j] is not None
+                and wbflags and wbflags[j]}
+    wbx_old = {j: pools[wbxcols[j]][idx_wbx[j]] for j in range(nf)
+               if wbxcols and wbxcols[j] >= 0}
+    return gathered, dest_old, wbx_old
+
+
+def _compute_scatter_f(genv, kplans, pools, spec, staged, locs, idx_out,
+                       idx_wbx) -> None:
+    """vmap one group's body over its gathered inputs and scatter
+    written outputs into ``pools`` (a list, mutated in place).
+
+    The masked merge applies only at declared MEMORY-target scatters
+    (wbflags, per-instance): an instance whose guarded out-dep resolved
+    to no target writes in place or renames, and its successors must
+    see the FULL body output. A dual-output flow additionally scatters
+    the region-merge into its memory target (wbx) while the rename slot
+    carries the full value."""
+    import jax
+
+    ci, _k, statics, _incols, outcols, _wbflags, wbxcols = spec
+    p = kplans[ci]
+    gathered, dest_old, wbx_old = staged
+    outs = jax.vmap(_make_one_f(genv, p, statics))(locs, *gathered)
+    oi = 0
+    for j, w in enumerate(p.written):
+        if not w:
+            continue
+        cid = outcols[j]
+        val = outs[oi]
+        if j in dest_old:
+            val = _merge_masked_f(genv, p, j, val, dest_old[j])
+        pools[cid] = pools[cid].at[idx_out[j]].set(val)
+        if j in wbx_old:
+            xcid = wbxcols[j]
+            pools[xcid] = pools[xcid].at[idx_wbx[j]].set(
+                _merge_masked_f(genv, p, j, outs[oi], wbx_old[j]))
+        oi += 1
 
 
 class WaveRunner:
@@ -201,6 +364,8 @@ class WaveRunner:
         # class/flow, validated during assignment)
         self._assign_slots()
         self._validate_tnames()
+        self._kplans = [p.kplan() for p in self.plans]
+        self._trace_env = self._build_trace_env()
 
     # ------------------------------------------------------------------ #
     # slot assignment                                                    #
@@ -542,134 +707,36 @@ class WaveRunner:
                 p.wb_name[k] = next(iter(
                     {n for n in p.wb_names[k] if n is not None}), None)
 
-    def _resolve_dst(self, p, k, nm, tile_shape, pool_dtype):
-        """Concrete Datatype for a validated [type*] name (called at
-        kernel TRACE time, when pool tile shapes are in hand)."""
-        val = self.tp.global_env.get(nm)
-        if isinstance(val, Datatype):
-            dst = val
-        else:   # validated shorthand
-            dst = Datatype(pool_dtype, tuple(tile_shape), nm)
-        if tuple(dst.shape) != tuple(tile_shape):
-            raise WaveError(
-                f"{p.ast.name}.{p.flow_names[k]}: [type={nm}] shape "
-                f"{dst.shape} differs from the pool tile {tile_shape}; "
-                f"wave pools are fixed-shape — use the per-task runtime")
-        return dst
+    def _build_trace_env(self) -> Dict[str, Any]:
+        """global_env for kernel TRACING, with DataCollection values
+        dropped unless a body or derived-local expression names them:
+        cached kernel traces (they live on the process-cached DAG) must
+        not pin collections — and through their attached lazy device
+        copies, result pools — for process lifetime."""
+        from ...collections.collection import DataCollection
+        needed = set()
+        for p in self.plans:
+            needed |= set(p.code.co_names)
+            for ld in p.ast.locals:
+                if ld.range is None:
+                    needed |= set(ld.expr._code.co_names)
+            if p.ast.priority is not None:
+                needed |= set(p.ast.priority._code.co_names)
+        env = {k: v for k, v in self.tp.global_env.items()
+               if not isinstance(v, DataCollection) or k in needed}
+        # a body that NAMES a collection bakes that instance into the
+        # trace: such kernels must stay per-runner (a later taskpool
+        # with the same structural signature but different data would
+        # reuse the stale baked values) — and per-runner caching also
+        # avoids pinning the named collection process-long
+        self._kernels_shareable = not any(
+            isinstance(env.get(nm), DataCollection) for nm in needed)
+        return env
 
     # ------------------------------------------------------------------ #
-    # kernels                                                            #
+    # kernels (trace logic lives in the module-level _*_f functions so   #
+    # cached traces capture kplans + a pruned env, never the runner)     #
     # ------------------------------------------------------------------ #
-    def _make_one(self, ci: int, statics: Tuple):
-        """Traceable single-instance body for class ``ci`` with the
-        given static body-local values; [type]/[type_data] input
-        conversions (masked casts) applied after the gather so XLA
-        fuses them into the body (ref: parsec_reshape.c consumer-side
-        promise trigger), resolved at trace time when tile shapes are
-        in hand."""
-        import jax.numpy as jnp
-
-        p = self.plans[ci]
-        global_env = self.tp.global_env
-        flow_names = p.flow_names
-        written = p.written
-        in_tname = p.in_tname
-        range_locals = p.range_locals
-        derived = [(ld.name, ld.expr) for ld in p.ast.locals
-                   if ld.range is None]
-        code = p.code
-        static_pairs = [(range_locals[i], v)
-                        for i, v in zip(p.body_locals, statics)]
-
-        def conv_in(j, v):
-            nm = in_tname[j]
-            if nm is None:
-                return v
-            dst = self._resolve_dst(p, j, nm, tuple(v.shape), v.dtype)
-            if dst.compatible_wire(Datatype(v.dtype, tuple(v.shape))):
-                return v
-            return reshape_array(v, dst)
-
-        def one(loc_row, *flow_vals):
-            env = dict(global_env)
-            for nm, v in zip(range_locals, loc_row):
-                env[nm] = v
-            for nm, v in static_pairs:  # concrete: bodies may branch
-                env[nm] = v
-            for nm, ex in derived:
-                env[nm] = ex(env)
-            for j, (nm, v) in enumerate(zip(flow_names, flow_vals)):
-                env[nm] = conv_in(j, v)
-            env["np"] = np
-            env["jnp"] = jnp
-            env["es_rank"] = 0
-            env["this_task"] = None
-            exec(code, env)
-            return tuple(env[nm] for nm, w in zip(flow_names, written) if w)
-
-        return one
-
-    def _merge_masked(self, p, j, val, dest_old):
-        """Region-masked memory writeback: only in-region elements
-        land; the rest keep the DESTINATION's pre-wave values (the
-        detached-clone semantics of the per-task runtime). ``val`` is
-        BATCHED [k, ...]; the declared dtype round-trip mirrors
-        reshape_to + np.copyto, the mask broadcasts."""
-        import jax.numpy as jnp
-
-        dst = self._resolve_dst(p, j, p.wb_name[j],
-                                tuple(dest_old.shape[1:]), dest_old.dtype)
-        conv = val.astype(dst.dtype).astype(dest_old.dtype)
-        mask = dst.mask()
-        return (conv if mask is None else
-                jnp.where(jnp.asarray(mask), conv, dest_old))
-
-    def _gather_group(self, pools, spec, idx_in, idx_out, idx_wbx):
-        """Gather one group's inputs + masked-merge destinations from
-        the (pre-scatter) pools."""
-        _ci, _k, _st, incols, outcols, wbflags, wbxcols = spec
-        p = self.plans[_ci]
-        nf = len(p.flow_names)
-        gathered = [pools[incols[j]][idx_in[j]] for j in range(nf)]
-        dest_old = {j: pools[outcols[j]][idx_out[j]] for j in range(nf)
-                    if p.written[j] and p.wb_name[j] is not None
-                    and wbflags and wbflags[j]}
-        wbx_old = {j: pools[wbxcols[j]][idx_wbx[j]] for j in range(nf)
-                   if wbxcols and wbxcols[j] >= 0}
-        return gathered, dest_old, wbx_old
-
-    def _compute_scatter(self, pools, spec, staged, locs, idx_out,
-                         idx_wbx) -> None:
-        """vmap one group's body over its gathered inputs and scatter
-        written outputs into ``pools`` (a list, mutated in place).
-
-        The masked merge applies only at declared MEMORY-target
-        scatters (wbflags, per-instance): an instance whose guarded
-        out-dep resolved to no target writes in place or renames, and
-        its successors must see the FULL body output. A dual-output
-        flow additionally scatters the region-merge into its memory
-        target (wbx) while the rename slot carries the full value."""
-        import jax
-
-        ci, _k, statics, _incols, outcols, _wbflags, wbxcols = spec
-        p = self.plans[ci]
-        gathered, dest_old, wbx_old = staged
-        outs = jax.vmap(self._make_one(ci, statics))(locs, *gathered)
-        oi = 0
-        for j, w in enumerate(p.written):
-            if not w:
-                continue
-            cid = outcols[j]
-            val = outs[oi]
-            if j in dest_old:
-                val = self._merge_masked(p, j, val, dest_old[j])
-            pools[cid] = pools[cid].at[idx_out[j]].set(val)
-            if j in wbx_old:
-                xcid = wbxcols[j]
-                pools[xcid] = pools[xcid].at[idx_wbx[j]].set(
-                    self._merge_masked(p, j, outs[oi], wbx_old[j]))
-            oi += 1
-
     def _kernel(self, ci: int, k: int, statics: Tuple, incols: Tuple,
                 outcols: Tuple, wbflags: Tuple = (), wbxcols: Tuple = ()):
         """The jitted chunk kernel for class ``ci``, chunk size ``k``,
@@ -679,26 +746,40 @@ class WaveRunner:
         ``wbxcols`` (guarded deps may bind different pools / have or
         lack a memory target per instance — chunks group by the full
         signature): fn(pools, locals_i32[k, n_locals], idx_in, idx_out,
-        idx_wbx [n_flows, k]) -> pools with written slots scattered."""
+        idx_wbx [n_flows, k]) -> pools with written slots scattered.
+
+        Kernel traces capture ONLY light per-class metadata (kplans)
+        and a collection-pruned trace env — never the runner — so the
+        DAG-level cache cannot pin pools or collections (see
+        _build_trace_env)."""
         p = self.plans[ci]
         key = (k, statics, incols, outcols, wbflags, wbxcols)
         kern = p.kernels.get(key)
         if kern is not None:
             return kern
+        spec = (ci, k, statics, incols, outcols, wbflags, wbxcols)
+        if self._kernels_shareable:
+            kern = self.dag.kernel_cache.get(spec)
+            if kern is not None:
+                p.kernels[key] = kern
+                return kern
         import jax
 
-        spec = (ci, k, statics, incols, outcols, wbflags, wbxcols)
+        kplans = self._kplans
+        genv = self._trace_env
 
         def chunk_fn(pools, locs, idx_in, idx_out, idx_wbx):
-            staged = self._gather_group(pools, spec, idx_in, idx_out,
-                                        idx_wbx)
+            staged = _gather_group_f(kplans, pools, spec, idx_in,
+                                     idx_out, idx_wbx)
             pools = list(pools)
-            self._compute_scatter(pools, spec, staged, locs, idx_out,
-                                  idx_wbx)
+            _compute_scatter_f(genv, kplans, pools, spec, staged, locs,
+                               idx_out, idx_wbx)
             return tuple(pools)
 
         kern = jax.jit(chunk_fn, donate_argnums=(0,))
         p.kernels[key] = kern
+        if self._kernels_shareable:
+            self.dag.kernel_cache[spec] = kern
         return kern
 
     def _fused_kernel(self, specs: Tuple):
@@ -714,20 +795,30 @@ class WaveRunner:
         kern = self._fused_kerns.get(specs)
         if kern is not None:
             return kern
+        if self._kernels_shareable:
+            kern = self.dag.kernel_cache.get(("fused", specs))
+            if kern is not None:
+                self._fused_kerns[specs] = kern
+                return kern
         import jax
 
+        kplans = self._kplans
+        genv = self._trace_env
+
         def wave_fn(pools, args):
-            staged = [self._gather_group(pools, sp, a["idx_in"],
-                                         a["idx_out"], a["idx_wbx"])
+            staged = [_gather_group_f(kplans, pools, sp, a["idx_in"],
+                                      a["idx_out"], a["idx_wbx"])
                       for sp, a in zip(specs, args)]
             plist = list(pools)
             for sp, a, st in zip(specs, args, staged):
-                self._compute_scatter(plist, sp, st, a["locs"],
-                                      a["idx_out"], a["idx_wbx"])
+                _compute_scatter_f(genv, kplans, plist, sp, st,
+                                   a["locs"], a["idx_out"], a["idx_wbx"])
             return tuple(plist)
 
         kern = jax.jit(wave_fn, donate_argnums=(0,))
         self._fused_kerns[specs] = kern
+        if self._kernels_shareable:
+            self.dag.kernel_cache[("fused", specs)] = kern
         return kern
 
     @staticmethod

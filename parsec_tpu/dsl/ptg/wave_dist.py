@@ -680,11 +680,61 @@ class DistWaveRunner(WaveRunner):
     # pool staging                                                       #
     # ------------------------------------------------------------------ #
     def scatter_pools(self, pools: Tuple) -> None:
-        """Write back only the tiles this rank OWNS **and staged**
-        (their home is here and some task touched them — untouched
-        owned tiles were never staged and their home copies stand);
-        the final-state transfers brought every last write home first,
-        so owned tiles are current on their owner."""
+        """Register this rank's results: only tiles it OWNS **and
+        staged** (their home is here and some task touched them —
+        untouched owned tiles were never staged and their home copies
+        stand); the final-state transfers brought every last write home
+        first, so owned tiles are current on their owner.
+
+        Writeback is LAZY by default (VERDICT r3 weak #7): each owned
+        tile's newest copy becomes a LazyPoolCopy slicing the device
+        pool on first read, so a single-tile host read pulls exactly
+        one tile instead of the eager owned-slice D2H + per-row copy
+        loop (the never-bulk-pull lesson — a 1 GB pull at this
+        tunnel's 3-4 MB/s D2H is ~5 min). MCA ``wave_lazy_writeback=0``
+        restores the eager host loop."""
+        from ...utils.params import params
+        if not bool(params.get_or("wave_lazy_writeback", "bool", True)):
+            return self._scatter_pools_eager(pools)
+        from .turbo import LazyPoolCopy, _PoolHolder
+        from ...data.data import Coherency
+        holder = _PoolHolder()
+        holder.pools = pools
+        self._wb_holder = holder   # pools live as long as the copies
+        did = self._writeback_device_id()
+        for cid, name in enumerate(self.pool_names):
+            if cid not in self._written_colls:
+                continue
+            coll = self.collections[name]
+            coords = self._pool_coords[cid]
+            for j, g in enumerate(self._l2g[cid]):
+                c = coords[int(g)]
+                if int(coll.rank_of(*c)) != self.rank:
+                    continue
+                data = coll.data_of(*c)
+                old = data.get_copy(did)
+                if old is not None:
+                    data._detach_copy(old)
+                h0 = data.get_copy(0)
+                lazy = LazyPoolCopy(data, did, holder, cid, j,
+                                    dtt=None if h0 is None else h0.dtt)
+                data.attach_copy(lazy)
+                lazy.coherency = Coherency.OWNED
+                data.version_bump(did)
+
+    def _writeback_device_id(self) -> int:
+        """Device slot for the lazy result copies: the context's
+        accelerator module when one is attached, else slot 1 (any
+        non-host id works — sync_to_host without a device list converts
+        directly)."""
+        ctx = getattr(self.tp, "context", None)
+        if ctx is not None:
+            for d in getattr(ctx, "devices", ()):
+                if d.device_type == "tpu":
+                    return d.device_index
+        return 1
+
+    def _scatter_pools_eager(self, pools: Tuple) -> None:
         for cid, name in enumerate(self.pool_names):
             if cid not in self._written_colls:
                 continue

@@ -1347,6 +1347,58 @@ static PyObject* Dag_complete_batch(DagObject* self, PyObject* args) {
   return dag_ready_list(ready);
 }
 
+/* The reference's select->release hot loop (scheduling.c:586-625) in
+ * one C call: a priority max-heap over the counter arrays drives the
+ * whole DAG; Python is re-entered exactly once per task (the chore
+ * invocation).  Consumes the engine's counters (single-shot, like
+ * start/complete).  Heap keys order by priority desc then task id asc
+ * (deterministic tie-break). */
+static inline int64_t dag_heap_key(int32_t prio, int32_t tid) {
+  return ((int64_t)prio << 32) | (uint32_t)(INT32_MAX - tid);
+}
+
+static PyObject* Dag_run_loop(DagObject* self, PyObject* args) {
+  PyObject* tramp;
+  PyObject* o_prio;
+  if (!PyArg_ParseTuple(args, "OO", &tramp, &o_prio)) return nullptr;
+  if (!PyCallable_Check(tramp)) {
+    PyErr_SetString(PyExc_TypeError, "trampoline must be callable");
+    return nullptr;
+  }
+  std::vector<int32_t> prio;
+  if (!dag_copy_buffer(o_prio, prio, "priority")) return nullptr;
+  if ((int32_t)prio.size() != self->n_tasks) {
+    PyErr_Format(PyExc_ValueError, "priority array has %zu entries for "
+                 "%d tasks", prio.size(), (int)self->n_tasks);
+    return nullptr;
+  }
+  std::vector<int64_t> heap;
+  heap.reserve((size_t)self->n_tasks);
+  for (int32_t t = 0; t < self->n_tasks; t++)
+    if (self->indeg[t].load(std::memory_order_relaxed) == 0)
+      heap.push_back(dag_heap_key(prio[t], t));
+  std::make_heap(heap.begin(), heap.end());
+  long executed = 0;
+  std::vector<int32_t> ready;
+  while (!heap.empty()) {
+    std::pop_heap(heap.begin(), heap.end());
+    int64_t k = heap.back();
+    heap.pop_back();
+    int32_t tid = INT32_MAX - (int32_t)(k & 0xffffffff);
+    PyObject* r = PyObject_CallFunction(tramp, "i", (int)tid);
+    if (!r) return nullptr;   /* body raised: propagate, DAG aborts */
+    Py_DECREF(r);
+    ready.clear();
+    if (dag_release_edges(self, tid, nullptr, ready) < 0) return nullptr;
+    for (int32_t s : ready) {
+      heap.push_back(dag_heap_key(prio[s], s));
+      std::push_heap(heap.begin(), heap.end());
+    }
+    executed++;
+  }
+  return PyLong_FromLong(executed);
+}
+
 static PyObject* Dag_take_bindings(DagObject* self, PyObject* args) {
   int tid;
   if (!PyArg_ParseTuple(args, "i", &tid)) return nullptr;
@@ -1399,6 +1451,10 @@ static PyMethodDef Dag_methods[] = {
      "non-None copies[out_flow] into the successor's flow slot"},
     {"complete_batch", (PyCFunction)Dag_complete_batch, METH_VARARGS,
      "complete_batch(int32 ids) -> newly ready ids (no binding routing)"},
+    {"run_loop", (PyCFunction)Dag_run_loop, METH_VARARGS,
+     "run_loop(trampoline, int32 priorities) -> executed count; drives "
+     "the whole DAG from a C priority heap, calling trampoline(tid) "
+     "once per task (single-shot: consumes the counters)"},
     {"take_bindings", (PyCFunction)Dag_take_bindings, METH_VARARGS,
      "take_bindings(tid) -> tuple of max_flows entries (refs transferred)"},
     {"indegree_of", (PyCFunction)Dag_indegree_of, METH_VARARGS, ""},

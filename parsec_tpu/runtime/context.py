@@ -189,6 +189,9 @@ class Context:
         self._task_errors: List[BaseException] = []
         self._active_taskpools = 0
         self._tp_lock = threading.Lock()
+        # native dispatch loops (turbo static PTG): queued by _startup,
+        # claimed by ONE worker from the wait loop
+        self._native_loops: List[Any] = []
         self._started = False
         self._finalized = False
 
@@ -258,6 +261,25 @@ class Context:
                     schedule(es0, startup[i:i + chunk],
                              distance=0 if i == 0 else 1)
         tp.tdm.taskpool_ready()
+
+    def submit_native_loop(self, fn) -> None:
+        """Queue a native dispatch loop (ref: the generated static-mode
+        progress drive, scheduling.c:586-625): one worker claims it from
+        the wait loop and runs the whole lowered DAG through
+        NativeDAG.run_loop, Python re-entered only at chore bodies."""
+        with self._tp_lock:
+            self._native_loops.append(fn)
+        self.wake_workers(1)
+
+    def run_native_loops(self, es) -> bool:
+        if not self._native_loops:
+            return False
+        with self._tp_lock:
+            if not self._native_loops:
+                return False
+            fn = self._native_loops.pop(0)
+        fn(es)
+        return True
 
     def _taskpool_done(self, tp: Taskpool) -> None:
         with self._tp_lock:
@@ -348,6 +370,14 @@ class Context:
                 except (AttributeError, OSError):
                     pass
         self._started = False
+        # retire the devices' trailing in-flight window records: the
+        # DAGs are done, and leftover records would pin the final
+        # tasks' object graphs (taskpool -> collections -> copies)
+        # until some future taskpool's progress
+        for dev in self.devices:
+            drain = getattr(dev, "drain", None)
+            if drain is not None:
+                drain(self)
         self.raise_pending_error()
 
     def _worker_main(self, es: ExecutionStream, widx: int) -> None:

@@ -234,6 +234,9 @@ def context_wait_loop(es: ExecutionStream) -> None:
                 backoff.hit()
                 task_progress(es, task)
                 continue
+            if ctx.run_native_loops(es):
+                backoff.hit()
+                continue
             progressed = ctx.progress_engines(es)
         except BaseException as exc:  # a task body blew up: abort the DAG,
             ctx.record_task_error(exc, task)  # don't silently kill the worker

@@ -151,7 +151,7 @@ def run_wave(eng, rank, nb_ranks, n=256, nb=64, use_plane=False):
     for (i, j) in coll.tiles():
         if coll.rank_of(i, j) != rank or i < j:
             continue
-        t = np.asarray(coll.data_of(i, j).host_copy().payload)
+        t = np.asarray(coll.data_of(i, j).sync_to_host().payload)
         if i == j:
             t = np.tril(t)
         err = max(err, float(np.abs(

@@ -94,13 +94,16 @@ def test_binding_routing_and_overrelease():
 
 
 def test_static_mode_end_to_end():
-    """dpotrf through the runtime with static dep management: engine
-    engaged, numerics match the hash path."""
+    """dpotrf through the runtime with static dep management on the
+    CLASSIC dispatch (eligible pools default to the turbo native loop,
+    covered by test_turbo.py): engine engaged, numerics match the hash
+    path."""
     n, nb = 512, 128
     M = make_spd(n, dtype=np.float32)
     ctx = parsec_tpu.init(nb_cores=2)
     try:
         params.set_cmdline("ptg_dep_management", "static")
+        params.set_cmdline("ptg_dispatch", "classic")
         A = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float32).from_numpy(M)
         tp = dpotrf_taskpool(A)
         ctx.add_taskpool(tp)
@@ -111,6 +114,7 @@ def test_static_mode_end_to_end():
         assert np.allclose(L, ref, atol=1e-2)
     finally:
         params.set_cmdline("ptg_dep_management", "hash")
+        params.unset_cmdline("ptg_dispatch")
         ctx.fini()
 
 

@@ -202,7 +202,7 @@ def test_dist_wave_masked_writeback():
         for (i, j) in coll.tiles():
             if coll.rank_of(i, j) == rank:
                 out[(i, j)] = np.asarray(
-                    coll.data_of(i, j).host_copy().payload).copy()
+                    coll.data_of(i, j).sync_to_host().payload).copy()
         return out
 
     results, _ = spmd(2, run)

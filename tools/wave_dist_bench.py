@@ -71,7 +71,7 @@ def rank_main() -> int:
         for (i, j) in coll.tiles():
             if coll.rank_of(i, j) != rank or i < j:
                 continue
-            t = np.asarray(coll.data_of(i, j).host_copy().payload,
+            t = np.asarray(coll.data_of(i, j).sync_to_host().payload,
                            dtype=np.float64)
             if i == j:
                 t = np.tril(t)
