@@ -243,34 +243,26 @@ class JaxDevice(Device):
             return  # pragma: no cover - Lock.acquire(True) returns True
         try:
             for rec in self._window:
-                self.load_sub(rec.est)
-                try:
-                    for a in rec.outputs:
-                        if a is not None and hasattr(a, "block_until_ready"):
-                            a.block_until_ready()
-                except Exception as exc:
-                    if context is not None:
-                        context.record_task_error(exc, rec.task)
-                    else:
-                        plog.warning(
-                            "async kernel of %s failed at drain: %s",
-                            rec.task.snprintf(), exc)
+                self._retire(rec, context=context)
             self._window = []
         finally:
             self._manager_lock.release()
 
-    def _retire(self, rec: _InFlight, es=None) -> None:
+    def _retire(self, rec: _InFlight, es=None, context=None) -> None:
         """Release a window entry: drop its load contribution and surface
         any async kernel error — against the task that DISPATCHED it
-        (es present: recorded as a task error; teardown: logged)."""
+        (es or context present: recorded as a task error; teardown:
+        logged)."""
         self.load_sub(rec.est)
         try:
             for a in rec.outputs:
                 if a is not None and hasattr(a, "block_until_ready"):
                     a.block_until_ready()
         except Exception as exc:
-            if es is not None:
-                es.context.record_task_error(exc, rec.task)
+            ctx = context if context is not None else \
+                (es.context if es is not None else None)
+            if ctx is not None:
+                ctx.record_task_error(exc, rec.task)
             else:
                 plog.warning("async kernel of %s failed at drain: %s",
                              rec.task.snprintf(), exc)

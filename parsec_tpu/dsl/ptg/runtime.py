@@ -270,19 +270,26 @@ class PTGTaskClass(TaskClass):
             return self.resolve_dtt_name(tname, copy, f.name)
         return None
 
-    def _edge_is_remote(self, t, env: Dict[str, Any]) -> bool:
-        """Does this task-sourced in-dep cross ranks? (Both ends evaluate
-        the same dep — SPMD-consistent, like the reference's
-        remote_dep_mpi_retrieve_datatype both-ends lookup.)"""
-        if self.tp.nb_ranks == 1:
-            return False
+    def producer_rank_of(self, t, env: Dict[str, Any]) -> Optional[int]:
+        """Rank of a task-sourced dep target's FIRST expanded producer
+        instance; None when unresolvable. Shared by _edge_is_remote and
+        the distributed wave's wire-type decision — both ends of an
+        edge must resolve identically (the reference's both-ends
+        remote_dep_mpi_retrieve_datatype lookup)."""
         try:
             ptc = self.tp.class_by_name(t.task_class)
             args = next(iter(_expand_args(t.args, env)))
             penv = ptc.env_of(ptc.ast.locals_from_param_args(args))
-            return ptc.rank_of_instance(penv) != self.tp.rank
+            return ptc.rank_of_instance(penv)
         except (KeyError, StopIteration):
+            return None
+
+    def _edge_is_remote(self, t, env: Dict[str, Any]) -> bool:
+        """Does this task-sourced in-dep cross ranks?"""
+        if self.tp.nb_ranks == 1:
             return False
+        pr = self.producer_rank_of(t, env)
+        return pr is not None and pr != self.tp.rank
 
     def resolve_dtt_name(self, tname: str, copy, flow_name: str) -> Datatype:
         """A [type*=NAME] property: a Datatype global, or one of the

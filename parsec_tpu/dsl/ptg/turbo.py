@@ -186,7 +186,31 @@ class TurboRunner(WaveRunner):
                          range(int(dag.indptr[t]), int(dag.indptr[t + 1])))
             succ2.extend(sorted(extra_by_src.get(t, ())))
             indptr2[t + 1] = len(succ2)
-        out = (indptr2, np.asarray(succ2, np.int32), indeg2)
+        succ2a = np.asarray(succ2, np.int32)
+        # cyclic WAR (two tasks each reading the slot the other writes)
+        # turns into a CYCLE here — per-task in-place scatters cannot
+        # serve it; fail at build so the caller falls back to an engine
+        # that can (fused wave gathers-before-scatter; the classic
+        # runtime's copies)
+        ind = np.array(indeg2, copy=True)
+        frontier = [int(t) for t in np.nonzero(ind == 0)[0]]
+        seen = 0
+        while frontier:
+            seen += len(frontier)
+            nxt = []
+            for t in frontier:
+                for e in range(int(indptr2[t]), int(indptr2[t + 1])):
+                    s = int(succ2a[e])
+                    ind[s] -= 1
+                    if ind[s] == 0:
+                        nxt.append(s)
+            frontier = nxt
+        if seen != dag.n_tasks:
+            raise WaveError(
+                "cyclic write-after-read conflicts: per-task in-place "
+                "scatters cannot serve this DAG — the classic runtime "
+                "(copies) or fused wave (gather-before-scatter) can")
+        out = (indptr2, succ2a, indeg2)
         dag.kernel_cache["turbo_war"] = out
         plog.debug.verbose(3, "turbo %s: %d WAR ordering edges added",
                            self.tp.name, len(set(extra)))

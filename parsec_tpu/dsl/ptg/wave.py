@@ -163,11 +163,14 @@ def _resolve_dst_f(genv, p: _KPlan, k, nm, tile_shape, pool_dtype):
     return dst
 
 
-def _make_one_f(genv, p: _KPlan, statics: Tuple):
+def _make_one_f(genv, p: _KPlan, statics: Tuple, wires: Tuple = ()):
     """Traceable single-instance body with the given static body-local
     values; [type]/[type_data] input conversions (masked casts) applied
     after the gather so XLA fuses them into the body (ref:
-    parsec_reshape.c consumer-side promise trigger)."""
+    parsec_reshape.c consumer-side promise trigger). ``wires`` carries
+    per-flow [type_remote] names for this GROUP (distributed wave:
+    instances whose bound producer lives on another rank convert the
+    received raw tile consumer-side, the remote_dep_mpi.c:766 lookup)."""
     import jax.numpy as jnp
 
     flow_names = p.flow_names
@@ -180,7 +183,8 @@ def _make_one_f(genv, p: _KPlan, statics: Tuple):
                     for i, v in zip(p.body_locals, statics)]
 
     def conv_in(j, v):
-        nm = in_tname[j]
+        nm = (wires[j] if wires and wires[j] is not None
+              else in_tname[j])
         if nm is None:
             return v
         dst = _resolve_dst_f(genv, p, j, nm, tuple(v.shape), v.dtype)
@@ -227,7 +231,7 @@ def _merge_masked_f(genv, p: _KPlan, j, val, dest_old):
 def _gather_group_f(kplans, pools, spec, idx_in, idx_out, idx_wbx):
     """Gather one group's inputs + masked-merge destinations from the
     (pre-scatter) pools."""
-    _ci, _k, _st, incols, outcols, wbflags, wbxcols = spec
+    _ci, _k, _st, incols, outcols, wbflags, wbxcols, _cnv = spec
     p = kplans[_ci]
     nf = p.nf
     gathered = [pools[incols[j]][idx_in[j]] for j in range(nf)]
@@ -252,10 +256,10 @@ def _compute_scatter_f(genv, kplans, pools, spec, staged, locs, idx_out,
     carries the full value."""
     import jax
 
-    ci, _k, statics, _incols, outcols, _wbflags, wbxcols = spec
+    ci, _k, statics, _incols, outcols, _wbflags, wbxcols, cnv = spec
     p = kplans[ci]
     gathered, dest_old, wbx_old = staged
-    outs = jax.vmap(_make_one_f(genv, p, statics))(locs, *gathered)
+    outs = jax.vmap(_make_one_f(genv, p, statics, cnv))(locs, *gathered)
     oi = 0
     for j, w in enumerate(p.written):
         if not w:
@@ -341,7 +345,8 @@ class WaveRunner:
         # conversions materialize at first execute when pool tile
         # shapes are known. type_remote is wire-format only and is
         # ignored here (single-rank: local edges never reshape on it;
-        # DistWaveRunner rejects it).
+        # DistWaveRunner applies it per instance on cross-rank edges
+        # via the _wire_tname_of hook).
         # NEW scratch flows get per-class scratch pools (ids after the
         # real collections), zero-initialized each run like the
         # per-task runtime's runtime-allocated NEW tiles.
@@ -370,9 +375,20 @@ class WaveRunner:
     # ------------------------------------------------------------------ #
     # slot assignment                                                    #
     # ------------------------------------------------------------------ #
+    def _wire_tname_of(self, tc, f, env) -> Optional[str]:
+        """[type_remote] hook: wire conversions exist only on cross-
+        rank edges — the distributed runner overrides this; single-rank
+        wave has no remote edges."""
+        return None
+
     def _assign_slots(self) -> None:
         dag = self.dag
         n = dag.n_tasks
+        # per-INSTANCE wire conversions ([type_remote] on a bound
+        # remote edge, dist only): sparse (task, flow) -> name; chunks
+        # group by the per-flow name tuple so per-class kernels stay
+        # uniform while local and remote instances convert differently
+        self._wconv: Dict[Tuple[int, int], str] = {}
         max_df = max((len(p.flow_idx) for p in self.plans), default=0)
         slot = np.full((n, max_df), -1, np.int32)
         # topo order via Kahn over the lowered CSR
@@ -446,6 +462,9 @@ class WaveRunner:
                 slot[t, k] = idx
                 tname = self._inst_in_tname(f, env)
                 p.in_tnames[k].add(tname)
+                wnm = self._wire_tname_of(tc, f, env)
+                if wnm is not None:
+                    self._wconv[(t, k)] = wnm
                 if p.written[k]:
                     out_cid, out_idx, has_target = self._out_slot_of_flow(
                         t, p, k, f, env, coll_id, idx, tname,
@@ -738,7 +757,8 @@ class WaveRunner:
     # cached traces capture kplans + a pruned env, never the runner)     #
     # ------------------------------------------------------------------ #
     def _kernel(self, ci: int, k: int, statics: Tuple, incols: Tuple,
-                outcols: Tuple, wbflags: Tuple = (), wbxcols: Tuple = ()):
+                outcols: Tuple, wbflags: Tuple = (), wbxcols: Tuple = (),
+                cnv: Tuple = ()):
         """The jitted chunk kernel for class ``ci``, chunk size ``k``,
         static body-local values ``statics``, per-flow pool ids
         ``incols``/``outcols``, per-flow writeback-mask applicability
@@ -753,11 +773,11 @@ class WaveRunner:
         DAG-level cache cannot pin pools or collections (see
         _build_trace_env)."""
         p = self.plans[ci]
-        key = (k, statics, incols, outcols, wbflags, wbxcols)
+        key = (k, statics, incols, outcols, wbflags, wbxcols, cnv)
         kern = p.kernels.get(key)
         if kern is not None:
             return kern
-        spec = (ci, k, statics, incols, outcols, wbflags, wbxcols)
+        spec = (ci, k, statics, incols, outcols, wbflags, wbxcols, cnv)
         if self._kernels_shareable:
             kern = self.dag.kernel_cache.get(spec)
             if kern is not None:
@@ -858,6 +878,7 @@ class WaveRunner:
             p = self.plans[int(ci)]
             nf = len(p.flow_idx)
             groups: Dict[Tuple, List[int]] = {}
+            none_cnv = (None,) * nf
             for t in members:
                 sv = tuple(int(dag.locals_of[t][i])
                            for i in p.body_locals)
@@ -865,9 +886,12 @@ class WaveRunner:
                 ocl = tuple(int(c) for c in self._slot_out_coll[t, :nf])
                 wfl = tuple(bool(b) for b in self._wb_apply[t, :nf])
                 xcl = tuple(int(c) for c in self._wbx_cid[t, :nf])
-                groups.setdefault((sv, icl, ocl, wfl, xcl),
+                cnv = (tuple(self._wconv.get((int(t), j))
+                             for j in range(nf))
+                       if self._wconv else none_cnv)
+                groups.setdefault((sv, icl, ocl, wfl, xcl, cnv),
                                   []).append(int(t))
-            for (statics, icl, ocl, wfl, xcl), g in groups.items():
+            for (statics, icl, ocl, wfl, xcl, cnv), g in groups.items():
                 garr = np.asarray(g, np.int64)
                 off = 0
                 for k in self._chunks(len(garr), self.max_chunk):
@@ -898,7 +922,7 @@ class WaveRunner:
                                 "sliced-pool translation hit a tile "
                                 "this rank never staged (local-map "
                                 "construction bug)")
-                    spec = (int(ci), k, statics, icl, ocl, wfl, xcl)
+                    spec = (int(ci), k, statics, icl, ocl, wfl, xcl, cnv)
                     entries.append((spec, {"locs": locs, "idx_in": idx_in,
                                            "idx_out": idx_out,
                                            "idx_wbx": idx_wbx}))

@@ -47,6 +47,7 @@ import numpy as np
 
 from ...comm.engine import TAG_USER_BASE
 from ...comm.remote_dep import bcast_children
+from ...data.datatype import Datatype
 from ...utils import logging as plog
 from .wave import WaveError, WaveRunner
 
@@ -173,20 +174,37 @@ class DistWaveRunner(WaveRunner):
         for t in range(dag.n_tasks):
             tc = self.plans[int(dag.class_of[t])].tc
             out[t] = tc.rank_of_instance(tc.env_of(dag.locals_of[t]))
-        # [type_remote] converts payloads only on cross-rank edges — a
-        # per-EDGE property the per-class kernels and raw-tile exchange
-        # cannot honor; the general runtime serves those JDFs
-        for p in self.plans:
-            for f in p.ast.flows:
-                for d in f.deps:
-                    nm = d.properties.get("type_remote")
-                    if nm is not None and nm != "full":
-                        raise WaveError(
-                            f"{p.ast.name}.{f.name}: [type_remote={nm}] "
-                            f"is per-edge wire conversion; distributed "
-                            f"wave ships raw tiles — use the per-task "
-                            f"runtime")
         return out
+
+    def _wire_tname_of(self, tc, f, env):
+        """[type_remote] on the instance's bound in-dep applies when
+        the producer lives on ANOTHER rank (consumer-side resolution,
+        the remote_dep_mpi.c:766 datatype lookup; parsec_reshape.c):
+        the exchange still ships the raw tile — the masked wire cast
+        runs inside the consumer's kernel, per instance (local edges
+        ignore it, the local_no_reshape semantics). Both ends derive
+        ranks from the same static affinity, so the decision is
+        SPMD-consistent."""
+        for d in f.deps_in():
+            t = d.resolve(env)
+            if t is None:
+                continue
+            if t.kind != "task" or d.properties.get("type") is not None:
+                return None   # the local [type] rule already applies
+            nm = d.properties.get("type_remote")
+            if nm is None or nm == "full":
+                return None
+            prank = tc.producer_rank_of(t, env)
+            if prank is None or prank == tc.rank_of_instance(env):
+                return None   # local edge: wire type never applies
+            val = self.tp.global_env.get(nm)
+            if not isinstance(val, Datatype) and \
+                    nm not in ("lower", "upper", "full"):
+                raise WaveError(
+                    f"{tc.ast.name}.{f.name}: [type_remote={nm}] is "
+                    f"neither a Datatype global nor a region shorthand")
+            return nm
+        return None
 
     def _compute_levels(self) -> List[np.ndarray]:
         """Dependence levels of the DAG = the wave schedule (a task's

@@ -239,3 +239,56 @@ def test_turbo_cached_kernels_do_not_pin_runner(static_ctx):
     assert ref() is None, ("turbo runner (and its HBM pools) pinned "
                            "after the taskpool died — a kernel-cache "
                            "closure captured it")
+
+
+def test_turbo_cyclic_war_falls_back_to_classic(static_ctx):
+    """A co-ready swap (cyclic WAR) is unservable by per-task in-place
+    scatters: TurboRunner must refuse at build (cycle in the augmented
+    CSR — a silent deadlock otherwise) and the startup gate must fall
+    back to the classic static path. NOTE the classic per-task runtime
+    gives such DAGs order-dependent results too (memory-sourced reads
+    bind the home copies, which the co-ready writer mutates in place) —
+    only fused wave's gather-before-scatter serves a true swap
+    (test_wave_cyclic_war); properly synchronized JDFs use CTL edges.
+    The contract here: no turbo, no deadlock, run completes."""
+    jdf = """
+descA [ type="collection" ]
+
+SA(j)
+j = 0 .. 0
+: descA( 0, 0 )
+READ  X <- descA( 1, 0 )
+RW    Z <- descA( 0, 0 )
+      -> descA( 0, 0 )
+BODY
+{
+    Z = X
+}
+END
+
+SB(j)
+j = 0 .. 0
+: descA( 1, 0 )
+READ  X <- descA( 0, 0 )
+RW    Z <- descA( 1, 0 )
+      -> descA( 1, 0 )
+BODY
+{
+    Z = X
+}
+END
+"""
+    fac = ptg.compile_jdf(jdf, name="swapt")
+    M0 = np.arange(32, dtype=np.float32).reshape(8, 4)
+    A = TwoDimBlockCyclic(8, 4, 4, 4, dtype=np.float32).from_numpy(
+        M0.copy())
+    tp = fac.new(descA=A)
+    static_ctx.add_taskpool(tp)
+    static_ctx.wait()
+    assert tp._turbo is None, "turbo must refuse a cyclic-WAR DAG"
+    out = A.to_numpy()
+    # one of the two classic serializations (order-dependent by design)
+    half = np.vstack([M0[4:], M0[:4]])
+    assert np.array_equal(out, half) or \
+        np.array_equal(out[:4], M0[4:]) or \
+        np.array_equal(out[4:], M0[:4]), out

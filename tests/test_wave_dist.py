@@ -543,3 +543,84 @@ def test_dist_wave_lazy_writeback_single_tile_pull(nb_ranks=2):
             L[m * nb:(m + 1) * nb, k * nb:(k + 1) * nb] = t
     np.testing.assert_allclose(np.tril(L), np.linalg.cholesky(M),
                                rtol=0, atol=1e-8 * n)
+
+
+# --------------------------------------------------------------------- #
+# [type_remote] wire conversion: applies per instance on CROSS-RANK     #
+# edges only (consumer-side masked cast in the kernel; raw tiles ride   #
+# the exchange), ignored on local edges — parsec_reshape.c +            #
+# remote_dep_mpi.c:766 semantics, previously rejected by dist-wave      #
+# --------------------------------------------------------------------- #
+WIRE_JDF = """
+descA [ type="collection" ]
+
+Prod(k)
+k = 0 .. 0
+: descA( 0, 0 )
+RW A <- descA( 0, 0 )
+     -> A ConsR( 0 )
+     -> A ConsL( 0 )
+     -> descA( 0, 0 )
+BODY
+{
+    A = A + 1.0
+}
+END
+
+ConsR(k)
+k = 0 .. 0
+: descA( 1, 0 )
+READ A <- A Prod( 0 )      [type_remote=lower]
+RW   B <- descA( 1, 0 )
+       -> descA( 1, 0 )
+BODY
+{
+    B = A
+}
+END
+
+ConsL(k)
+k = 0 .. 0
+: descA( 2, 0 )
+READ A <- A Prod( 0 )      [type_remote=lower]
+RW   B <- descA( 2, 0 )
+       -> descA( 2, 0 )
+BODY
+{
+    B = A
+}
+END
+"""
+
+
+def test_dist_wave_type_remote_wire_conversion(nb_ranks=2):
+    """ConsR lives on rank 1 (remote edge: sees tril of Prod's output);
+    ConsL lives on rank 0 with Prod (local edge: [type_remote] must be
+    ignored — full tile). P=2 row-cyclic: rows 0,2 -> rank 0, row 1 ->
+    rank 1."""
+    nb = 8
+    rng = np.random.RandomState(11)
+    A0 = rng.rand(3 * nb, nb)
+
+    def rank_fn(rank, fabric):
+        ce = fabric.engine(rank)
+        coll = TwoDimBlockCyclic(3 * nb, nb, nb, nb, dtype=np.float64,
+                                 P=nb_ranks, Q=1, nodes=nb_ranks,
+                                 rank=rank)
+        coll.name = "descA"
+        coll.from_numpy(A0.copy())
+        tp = ptg.compile_jdf(WIRE_JDF, name="wirejdf").new(
+            descA=coll, rank=rank, nb_ranks=nb_ranks)
+        w = ptg.wave(tp, comm=ce)
+        assert w._wconv, "no wire conversion was planned"
+        w.run()
+        return _gather_owned(coll, rank)
+
+    results, _ = spmd(nb_ranks, rank_fn, timeout=120)
+    got = {}
+    for r in results:
+        got.update(r)
+    prod = A0[:nb] + 1.0
+    np.testing.assert_allclose(got[(1, 0)], np.tril(prod), rtol=1e-6)
+    np.testing.assert_allclose(got[(2, 0)], prod, rtol=1e-6)
+    np.testing.assert_allclose(got[(0, 0)], prod, rtol=1e-6)
