@@ -19,6 +19,7 @@ import pytest
     "examples.ex09_capture",
     "examples.ex10_dposv_multiprocess",
     "examples.ex11_wave_distributed",
+    "examples.ex12_turbo_dispatch",
 ])
 def test_example_runs(mod):
     m = importlib.import_module(mod)
