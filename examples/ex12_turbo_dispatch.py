@@ -29,8 +29,9 @@ from parsec_tpu.utils.params import params
 
 def main(n: int = 512, nb: int = 128) -> int:
     params.set_cmdline("ptg_dep_management", "static")
-    ctx = parsec_tpu.init(nb_cores=2)
+    ctx = None
     try:
+        ctx = parsec_tpu.init(nb_cores=2)
         M = make_spd(n, dtype=np.float32)
         A = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float32).from_numpy(M)
         tp = dpotrf_taskpool(A)
@@ -49,8 +50,9 @@ def main(n: int = 512, nb: int = 128) -> int:
         assert resid < 1e-4
         return 0
     finally:
-        ctx.fini()
         params.unset_cmdline("ptg_dep_management")
+        if ctx is not None:
+            ctx.fini()
 
 
 if __name__ == "__main__":

@@ -427,16 +427,24 @@ class DistWaveRunner(WaveRunner):
 
     def build_pools(self, device=None, sharding=None) -> Tuple:
         """Stage only this rank's slice of every pool (see
-        _build_local_maps). ``sharding`` is not meaningful with sliced
-        pools (slices differ per rank) — single-device placement only."""
+        _build_local_maps).
+
+        ``sharding`` enables the HYBRID process x mesh layout: each
+        rank's sliced pools shard over its OWN local sub-mesh (a
+        jax.sharding.Sharding over the tile dims), so wave kernels run
+        GSPMD across the rank's chips while the static exchange
+        schedule still moves tiles between ranks. Gathered exchange
+        tiles from sharded pools are multi-device, so payloads take the
+        host-byte hop automatically (the device plane requires
+        single-device arrays — _comm_step's _is_single_device check);
+        pools whose tile shape the spec cannot divide replicate on the
+        sub-mesh, like the single-rank path."""
         import jax
         import jax.numpy as jnp
 
-        if sharding is not None:
-            raise WaveError("sharded pools and sliced distributed pools "
-                            "are mutually exclusive; pass device= instead")
-
         def put(z):
+            if sharding is not None:
+                return self._put_sharded(z, sharding)
             return jax.device_put(z, device) if device is not None \
                 else jnp.asarray(z)
 
@@ -458,7 +466,11 @@ class DistWaveRunner(WaveRunner):
                 pools.append(jnp.zeros((0,), np.float32))
                 continue
             shape, dt = self._pool_tile_spec(sp["cid"])
-            pools.append(put(np.zeros((len(loc),) + shape, dt)))
+            z = np.zeros((len(loc),) + shape, dt)
+            # scratch replicates on the sub-mesh (a tile-dim spec need
+            # not fit scratch ranks), exactly like the single-rank path
+            pools.append(self._put_replicated(z, sharding)
+                         if sharding is not None else put(z))
         return tuple(pools)
 
     # ------------------------------------------------------------------ #

@@ -624,3 +624,46 @@ def test_dist_wave_type_remote_wire_conversion(nb_ranks=2):
     np.testing.assert_allclose(got[(1, 0)], np.tril(prod), rtol=1e-6)
     np.testing.assert_allclose(got[(2, 0)], prod, rtol=1e-6)
     np.testing.assert_allclose(got[(0, 0)], prod, rtol=1e-6)
+
+
+def test_dist_wave_hybrid_process_mesh_sharding(nb_ranks=2):
+    """HYBRID layout (SURVEY §5.8): ranks partition the DAG by the
+    data distribution while each rank's sliced pools shard over its
+    OWN sub-mesh — wave kernels run GSPMD across the rank's devices,
+    the static exchange moves tiles between ranks (host-byte hop:
+    gathered tiles from sharded pools are multi-device)."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    n, nb = 256, 64
+    M = make_spd(n, dtype=np.float64)
+    cpus = jax.devices("cpu")
+    if len(cpus) < 2 * 4:
+        pytest.skip("needs 8 virtual cpu devices")
+
+    def rank_fn(rank, fabric):
+        ce = fabric.engine(rank)
+        coll = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float64,
+                                 P=nb_ranks, Q=1, nodes=nb_ranks,
+                                 rank=rank)
+        coll.name = "descA"
+        coll.from_numpy(M.copy())
+        tp = dpotrf_taskpool(coll, rank=rank, nb_ranks=nb_ranks)
+        w = ptg.wave(tp, comm=ce)
+        mesh = Mesh(np.array(cpus[rank * 4:(rank + 1) * 4])
+                    .reshape(2, 2), ("tp", "sp"))
+        sh = NamedSharding(mesh, P(None, "tp", "sp"))
+        pools = w.build_pools(sharding=sh)
+        assert any(getattr(p, "ndim", 0) == 3 and len(p.devices()) == 4
+                   for p in pools), "no pool was sharded over the sub-mesh"
+        pools = w.execute(pools)
+        w.scatter_pools(pools)
+        return _gather_owned(coll, rank)
+
+    results, _ = spmd(nb_ranks, rank_fn, timeout=240)
+    L = np.zeros((n, n))
+    for owned in results:
+        for (m, k), t in owned.items():
+            L[m * nb:(m + 1) * nb, k * nb:(k + 1) * nb] = t
+    np.testing.assert_allclose(np.tril(L), np.linalg.cholesky(M),
+                               rtol=0, atol=1e-8 * n)
