@@ -322,3 +322,59 @@ def test_wave_dgeqrf_scratch_flows_parity():
     Rref = np.linalg.qr(Am.astype(np.float64))[1]
     np.testing.assert_allclose(np.abs(np.diag(np.triu(got))),
                                np.abs(np.diag(Rref)), rtol=1e-3)
+
+
+# --------------------------------------------------------------------- #
+# TURBO differential: the same reshape/NEW scenarios through the native #
+# per-task loop (turbo inherits wave's slot + kernel machinery at       #
+# chunk size 1 — its semantics must match the classic runtime too)     #
+# --------------------------------------------------------------------- #
+def _run_turbo(fac, base, **globals_):
+    from parsec_tpu.dsl.ptg.turbo import TurboRunner
+
+    coll = TwoDimBlockCyclic(N, N, NB, NB, dtype=np.float32)
+    coll.name = "descA"
+    coll.from_numpy(base.copy())
+    TurboRunner(fac.new(descA=coll, **globals_)).run()
+    return coll.to_numpy()
+
+
+@pytest.mark.parametrize("jdf,name,globals_", [
+    (MASKED_RW, "masked_rw_t", {"NT": N // NB}),
+    (INPUT_CONV_CHAIN, "inconv_t", {}),
+    (NEW_CHAIN, "newchain_t", {"NT": N // NB}),
+    (GUARDED_WB, "guardedwb_t", None),
+])
+def test_turbo_reshape_parity(jdf, name, globals_):
+    fac = ptg.compile_jdf(jdf, name=name)
+    base = _base()
+    if globals_ is None:
+        # GUARDED_WB binds a second collection (mirror the original test)
+        ctx = parsec_tpu.init(nb_cores=1)
+        try:
+            dA = TwoDimBlockCyclic(N, N, NB, NB, dtype=np.float32)
+            dB = TwoDimBlockCyclic(N, N, NB, NB, dtype=np.float32)
+            dA.name, dB.name = "descA", "descB"
+            dA.from_numpy(base.copy())
+            dB.from_numpy(base.copy())
+            tp = fac.new(descA=dA, descB=dB, NT=N // NB)
+            ctx.add_taskpool(tp)
+            ctx.wait()
+            ref = (dA.to_numpy(), dB.to_numpy())
+        finally:
+            ctx.fini()
+        from parsec_tpu.dsl.ptg.turbo import TurboRunner
+        dA2 = TwoDimBlockCyclic(N, N, NB, NB, dtype=np.float32)
+        dB2 = TwoDimBlockCyclic(N, N, NB, NB, dtype=np.float32)
+        dA2.name, dB2.name = "descA", "descB"
+        dA2.from_numpy(base.copy())
+        dB2.from_numpy(base.copy())
+        TurboRunner(fac.new(descA=dA2, descB=dB2, NT=N // NB)).run()
+        np.testing.assert_allclose(dA2.to_numpy(), ref[0], rtol=1e-5,
+                                   atol=1e-6)
+        np.testing.assert_allclose(dB2.to_numpy(), ref[1], rtol=1e-5,
+                                   atol=1e-6)
+        return
+    ref = _run_runtime(fac, base, **globals_)
+    got = _run_turbo(fac, base, **globals_)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
