@@ -292,3 +292,23 @@ END
     assert np.array_equal(out, half) or \
         np.array_equal(out[:4], M0[4:]) or \
         np.array_equal(out[4:], M0[:4]), out
+
+
+def test_turbo_dgeqrf_scratch_and_rename(static_ctx):
+    """QR exercises NEW scratch pools (T factors) and rename slots
+    under PER-TASK priority order — the WAR/WAW edge machinery's
+    hardest customer. R's diagonal must match numpy's up to sign."""
+    from parsec_tpu.ops import dgeqrf_taskpool
+
+    n, nb = 256, 64
+    rng = np.random.RandomState(3)
+    M = rng.rand(n, n).astype(np.float32)
+    A = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float32).from_numpy(M)
+    tp = dgeqrf_taskpool(A)
+    static_ctx.add_taskpool(tp)
+    static_ctx.wait()
+    assert tp._turbo is not None
+    R = np.triu(A.to_numpy())
+    Rref = np.linalg.qr(M.astype(np.float64), mode="r")
+    np.testing.assert_allclose(np.abs(np.diag(R)),
+                               np.abs(np.diag(Rref)), rtol=1e-3)
