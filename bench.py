@@ -11,11 +11,15 @@ sustains ~80% of the A100's 19.5 TFLOP/s FP64-TC peak), making the target
 
 Execution modes (BENCH_MODE):
 
-- ``all`` (default): the honest composite — runs {capture, wave@NB=512,
-  runtime@NB=512, chip_gemm microbench}, emits the headline from the
-  BEST numerics-passing mode, keeps every mode in extras, and flags
-  ``tunnel_degraded`` when the bare-chip GEMM rate and the headline
-  disagree by >10x (round-2 VERDICT item 2).
+- ``all`` (default): the honest composite — runs {capture_chain@N=32768,
+  wave@NB=1024/512, capture, runtime@NB=512, chip_gemm microbench, link
+  probe}, emits the headline from the BEST numerics-passing mode, keeps
+  every mode in extras, and flags ``tunnel_degraded`` when the bare-chip
+  GEMM rate and the headline disagree by >10x (round-2 VERDICT item 2).
+- ``chain``: the tunnel-proof mode (round-4 VERDICT item 1) — K whole-
+  DAG factorizations inside ONE jitted call, input synthesized on device
+  from a PRNG, residual computed on device; only scalars cross the link,
+  so wall time is 1x call latency + K x compute at any link health.
 - ``capture``: the PTG DAG compiled into ONE XLA executable via graph
   capture (dsl/ptg/capture.py) — single dispatch, zero host loop in the
   timed region, MXU-bound.
@@ -28,9 +32,10 @@ Execution modes (BENCH_MODE):
 Knobs (env): BENCH_N (default 8192), BENCH_NB (2048), BENCH_DTYPE
 (float32), BENCH_REPS (3, best-of), BENCH_CORES (runtime mode worker
 threads, default 1: eager completion makes one thread the fastest driver
-on a single-CPU-core host). Don't raise BENCH_N casually: the untimed
-staging/verify transfers are tunnel-bound (BASELINE.md notes the link can
-be as slow as ~7-27 MB/s).
+on a single-CPU-core host), BENCH_CHAIN_N (32768) / BENCH_CHAIN_NB
+(4096) / BENCH_CHAIN_K (4) for the chain mode. Input staging and
+verification never cross the link in the XLA modes (on-device synthesis
++ device-side residuals), so large N is safe at any link bandwidth.
 """
 import json
 import os
@@ -119,6 +124,114 @@ def dpotrf_flops(n):
     return n ** 3 / 3.0 + n ** 2 / 2.0
 
 
+def _synth_lower(key, nt, nb, n, jdt):
+    """Lower tiles of A = (B + B^T)/2 + n*I synthesized on device from a
+    folded PRNG key, tile-wise — the full matrix never materializes and
+    nothing crosses the link (round-4 VERDICT: zero-H2D input path)."""
+    import jax.numpy as jnp
+    from jax import random
+    tiles = {}
+    for m in range(nt):
+        for k in range(m + 1):
+            bmk = random.uniform(random.fold_in(key, m * nt + k),
+                                 (nb, nb), jnp.float32)
+            t = (bmk + random.uniform(random.fold_in(key, k * nt + m),
+                                      (nb, nb), jnp.float32).T) * 0.5
+            if m == k:
+                t = t + n * jnp.eye(nb, dtype=jnp.float32)
+            tiles[(m, k)] = t.astype(jdt)
+    return tiles
+
+
+def _synth_ref(low, X, nt, jdt):
+    """ref_m = sum_k M[m,k] @ X_k from lower tiles only (symmetry)."""
+    return [sum((low[(m, k)] if k <= m else low[(k, m)].T.astype(jdt))
+                @ X[k] for k in range(nt)) for m in range(nt)]
+
+
+def _resid_blocks(tril, X, ref, nt):
+    """max-norm residual ||L(L^T X) - ref|| / ||ref|| block-wise from
+    factored lower tiles; returns a scalar, no N^2 reconstruction."""
+    import jax.numpy as jnp
+    y = [sum(tril[(m, k)].T @ X[m] for m in range(k, nt))
+         for k in range(nt)]
+    num, den = jnp.float32(0), jnp.float32(0)
+    for m in range(nt):
+        z = sum(tril[(m, k)] @ y[k] for k in range(m + 1))
+        num = jnp.maximum(num, jnp.abs(z - ref[m]).max())
+        den = jnp.maximum(den, jnp.abs(ref[m]).max())
+    return num / den
+
+
+def bench_capture_chain(n, nb, reps, dtype, chain_k):
+    """Tunnel-proof mode: K whole-DAG factorizations inside ONE jitted
+    XLA call — input synthesis, the captured dpotrf DAG, and the
+    residual all run on device; only two scalars ever cross the link.
+
+    Why (round-4 VERDICT Weak #1): at BENCH_N=8192 a dpotrf is ~15 ms
+    of on-chip work, so on a 200 ms/call session every host-loop mode
+    measures the link, not the framework. Here total wall time is
+    1 x call latency + K x compute: at N=32768, K x 11.7 TFLOP of work
+    dwarfs even a badly degraded link. The SPD input is synthesized
+    per-iteration from a folded PRNG key (A = (B + B^T)/2 + n*I,
+    tile-wise — full matrix never materializes), so zero H2D staging;
+    the residual ||L(L^T X) - A X|| / ||A X|| is computed block-wise
+    from the factored tiles (no N^2 reconstruction) and max-reduced
+    across iterations, so a single scalar gates numerics for all K.
+    Ref: the watchdog-gate timing pattern of
+    /root/reference/tests/dsl/dtd/dtd_test_simple_gemm.c:651-660."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax, random
+    from parsec_tpu.collections import TwoDimBlockCyclic
+    from parsec_tpu.dsl import ptg
+    from parsec_tpu.ops import dpotrf_taskpool
+
+    if n % nb:
+        raise ValueError(
+            f"chain/capture bench modes use uniform tilings (N={n} % "
+            f"NB={nb} != 0); ragged tilings are exercised by the wave "
+            f"engine tests (tests/test_ptg_wave.py) and dryrun gate")
+    nt = n // nb
+    jdt = jnp.dtype(dtype)
+    # structure-only collection: tiles are lazy (matrix.py:43) and the
+    # captured _execute only touches coords its deps name (the lower
+    # triangle), so no host tile is ever allocated
+    A = TwoDimBlockCyclic(n, n, nb, nb, dtype=dtype)
+    cg = ptg.capture(dpotrf_taskpool(A))
+    nvec = 4
+
+    def body(i, carry):
+        maxerr, acc = carry
+        key = random.fold_in(random.PRNGKey(17), i)
+        low = _synth_lower(key, nt, nb, n, jdt)
+        X = random.normal(random.fold_in(key, nt * nt), (nt, nb, nvec),
+                          jnp.float32)
+        ref = _synth_ref(low, X, nt, jdt)
+        out = cg._execute({"descA": low})["descA"]
+        tril = {c: (jnp.tril(t) if c[0] == c[1] else t)
+                for c, t in out.items()}
+        err = _resid_blocks(tril, X, ref, nt)
+        return (jnp.maximum(maxerr, err),
+                acc + tril[(nt - 1, nt - 1)][0, 0])
+
+    @jax.jit
+    def chained(j0):
+        return lax.fori_loop(j0, j0 + chain_k, body,
+                             (jnp.float32(0), jnp.float32(0)))
+
+    err, acc = chained(0)   # compile + first window (untimed)
+    sync_device([err, acc])
+    best = None
+    for r in range(reps):
+        t0 = time.perf_counter()
+        err, acc = chained(r * chain_k)
+        sync_device([err, acc])
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    return best / chain_k, float(err)
+
+
 def emit_line(n, nb, dtype, mode, gflops, extras=None):
     line = {
         "metric": f"dpotrf_gflops(N={n},NB={nb},{dtype.name},1chip,{mode})",
@@ -141,62 +254,72 @@ def emit(n, nb, dtype, mode, best, err, extras=None):
 
 
 def bench_capture(n, nb, reps, dtype):
-    """Whole-DAG XLA execution: one captured executable per shape."""
-    import jax
-    from parsec_tpu.collections import TwoDimBlockCyclic
-    from parsec_tpu.dsl import ptg
-    from parsec_tpu.ops import dpotrf_taskpool
-
-    M = make_input(n, dtype)
-    A = TwoDimBlockCyclic(n, n, nb, nb, dtype=dtype).from_numpy(M)
-    cg = ptg.capture(dpotrf_taskpool(A))
-    dev = jax.devices()[0]
-    tiles = {"descA": {c: jax.device_put(A.tile(*c), dev)
-                       for c in A.tiles()}}
-    jax.block_until_ready(tiles)
-    out = cg.fn(tiles)            # compile (untimed, one-time per shape)
-    jax.block_until_ready(out)
-    best = None
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        out = cg.fn(tiles)
-        sync_device(list(out["descA"].values()))
-        dt = time.perf_counter() - t0
-        best = dt if best is None else min(best, dt)
-    lower = {(m, k): arr for (m, k), arr in out["descA"].items() if m >= k}
-    return best, check_numerics_device(lower, M, n, nb)
+    """Whole-DAG XLA execution: one captured executable per shape
+    (a chain of length 1 — synthesis + DAG + residual in one call)."""
+    return bench_capture_chain(n, nb, reps, dtype, 1)
 
 
 def bench_wave(n, nb, reps, dtype):
     """Wave execution: ready antichains as batched per-class XLA calls
     over device tile pools (dsl/ptg/wave.py) — the runtime path that
-    stays scalable at small NB where per-task dispatch would dominate."""
+    stays scalable at small NB where per-task dispatch would dominate.
+    Pools are synthesized ON DEVICE (round-4 VERDICT Weak #1: the old
+    256 MB H2D staging poisoned the link for every later mode); the
+    timed region — wave execution — is unchanged."""
     import jax
+    import jax.numpy as jnp
+    from jax import random
     from parsec_tpu.collections import TwoDimBlockCyclic
     from parsec_tpu.dsl.ptg.wave import wave
     from parsec_tpu.ops import dpotrf_taskpool
 
-    M = make_input(n, dtype)
-    A = TwoDimBlockCyclic(n, n, nb, nb, dtype=dtype).from_numpy(M)
+    if n % nb:
+        raise ValueError(f"bench wave mode uses uniform tilings "
+                         f"(N={n} % NB={nb} != 0)")
+    nt = n // nb
+    jdt = jnp.dtype(dtype)
+    A = TwoDimBlockCyclic(n, n, nb, nb, dtype=dtype)   # tiles stay lazy
     w = wave(dpotrf_taskpool(A),
              max_chunk=int(os.environ.get("BENCH_WAVE_CHUNK", "256")))
-    dev = jax.devices()[0]
-    pools = w.execute(w.build_pools(device=dev))   # warm the kernel cache
+    nvec = 4
+    key = random.PRNGKey(23)
+
+    cache = {}
+
+    def tile_fn(_name, c):
+        if not cache:   # built once per trace, all on device
+            cache.update(_synth_lower(key, nt, nb, n, jdt))
+        return cache[c] if c[0] >= c[1] else jnp.zeros((nb, nb), jdt)
+
+    def synth():
+        cache.clear()
+        return w.synth_pools(tile_fn)
+
+    def resid(pools):
+        loc = w._pool_of["descA"]
+        tril = {}
+        for (m, k), (pid, row) in loc.items():
+            if m >= k:
+                t = pools[pid][row]
+                tril[(m, k)] = jnp.tril(t) if m == k else t
+        X = random.normal(random.fold_in(key, nt * nt), (nt, nb, nvec),
+                          jnp.float32)
+        ref = _synth_ref(_synth_lower(key, nt, nb, n, jdt), X, nt, jdt)
+        return _resid_blocks(tril, X, ref, nt)
+
+    resid_j = jax.jit(resid)
+    pools = w.execute(synth())      # warm the kernel cache
     jax.block_until_ready(pools)
     best = None
     for _ in range(reps):
-        pools = w.build_pools(device=dev)
+        pools = synth()
         jax.block_until_ready(pools)
         t0 = time.perf_counter()
         pools = w.execute(pools)
         sync_device(pools)
         dt = time.perf_counter() - t0
         best = dt if best is None else min(best, dt)
-    # shape-split pools: map each tile through the (pool, row) index
-    loc = w._pool_of.get("descA") or next(iter(w._pool_of.values()))
-    lower = {c: pools[pid][row] for c, (pid, row) in loc.items()
-             if c[0] >= c[1]}
-    return best, check_numerics_device(lower, M, n, nb)
+    return best, float(resid_j(pools))
 
 
 def bench_runtime(n, nb, reps, cores, dtype, dispatch="turbo"):
@@ -351,6 +474,27 @@ def bench_chip_peak(n=4096, chain=24, reps=3):
     return peak, details
 
 
+def bench_link(size_mb=4, reps=2):
+    """H2D/D2H bandwidth as first-class extras (round-4 VERDICT Weak
+    #3): the link diagnostics ride the record so rounds are machine-
+    comparable even when the tunnel reshapes every host-loop number."""
+    import jax
+    x = np.random.RandomState(1).rand(size_mb * (1 << 18)).astype(np.float32)
+    best_h = best_d = None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        xd = jax.device_put(x)
+        jax.block_until_ready(xd)
+        th = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        np.asarray(xd)
+        td = time.perf_counter() - t0
+        best_h = th if best_h is None else min(best_h, th)
+        best_d = td if best_d is None else min(best_d, td)
+    return {"link_h2d_mbps": round(size_mb / best_h, 1),
+            "link_d2h_mbps": round(size_mb / best_d, 1)}
+
+
 def bench_all(n, nb, reps, cores, dtype):
     """The honest composite: run every engineering mode {capture, wave@512,
     runtime@512} plus the bare-chip GEMM microbench, carry them ALL in
@@ -403,9 +547,29 @@ def bench_all(n, nb, reps, cores, dtype):
         peak, det = pk
         extras["chip_peak_gflops(f32)"] = round(peak, 1)
         extras["chip_peak_detail"] = det
+        extras["call_latency_ms"] = det["call_latency_ms"]
+    ld = _try("link", bench_link)
+    if ld is not None:
+        extras.update(ld)
 
-    # strongest candidate FIRST: the tunnel degrades within a session
-    # under load, so later modes see a worse link than earlier ones.
+    # the latency-proof headline contender FIRST (round-4 VERDICT item
+    # 1): K factorizations of the captured DAG behind ONE XLA call with
+    # on-device synthesis + residual — total wall time is 1x link
+    # latency + K x compute, so the gate survives a 200 ms/call session
+    # (measured 2026-07-31: 38.7 TF/s on a 206 ms/call link). 16 GB-HBM
+    # fallback at N=16384 if the full size fails to place.
+    chain_nb = int(os.environ.get("BENCH_CHAIN_NB", "4096"))
+    chain_k = int(os.environ.get("BENCH_CHAIN_K", "4"))
+    chain_n = int(os.environ.get("BENCH_CHAIN_N", "32768"))
+    for cn in [chain_n] + ([16384] if chain_n > 16384 else []):
+        r = _try(f"capture_chain{cn}",
+                 lambda cn=cn: bench_capture_chain(cn, chain_nb, reps,
+                                                   dtype, chain_k))
+        if r is not None:
+            extras["capture_chain_k"] = chain_k
+            _record("capture_chain", cn, chain_nb, r)
+            break
+
     # NB=1024 halves the kernel count vs 512: on a latency-degraded
     # tunnel the larger calls amortize per-dispatch cost ~2x better
     # (2026-07-30: 15.0 vs 7.4 TF/s); both are MXU-bound when healthy
@@ -437,7 +601,8 @@ def bench_all(n, nb, reps, cores, dtype):
     # XLA-path modes (capture/wave) only: the per-task runtime mode is
     # dispatch bound by design, so a >10x gap to bare GEMM is its
     # NORMAL state, not a tunnel signal
-    xla_gfs = [c[3] for c in candidates if c[0] in ("capture", "wave")]
+    xla_gfs = [c[3] for c in candidates
+               if c[0] in ("capture", "wave", "capture_chain")]
     if peak is not None and (not xla_gfs or peak > 10 * max(xla_gfs)):
         extras["tunnel_degraded"] = True
     if peak is not None:
@@ -458,6 +623,11 @@ def main() -> None:
         return
     if mode == "capture":
         best, err = bench_capture(n, nb, reps, dtype)
+    elif mode == "chain":
+        n = int(os.environ.get("BENCH_CHAIN_N", "32768"))
+        nb = int(os.environ.get("BENCH_CHAIN_NB", "4096"))
+        best, err = bench_capture_chain(
+            n, nb, reps, dtype, int(os.environ.get("BENCH_CHAIN_K", "4")))
     elif mode == "wave":
         best, err = bench_wave(n, nb, reps, dtype)
     else:

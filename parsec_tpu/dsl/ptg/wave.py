@@ -1178,19 +1178,57 @@ class WaveRunner:
         # staged: its cid is always smaller). A tile-pool sharding spec
         # needn't fit scratch shapes — scratch replicates on the mesh
         # (or stays single-device without one).
-        for sp in sorted(self._scratch.values(), key=lambda s: s["cid"]):
-            if sp["shape"] is not None:
-                z = np.zeros((sp["n"],) + sp["shape"], sp["dtype"])
-            else:
-                like = pools[sp["like"]]
-                z = np.zeros((sp["n"],) + tuple(like.shape[1:]),
-                             np.dtype(str(like.dtype)))
+        for cnt, shape, dt in self._scratch_specs(pools):
+            z = np.zeros((cnt,) + shape, dt)
             if sharding is not None:
                 pools.append(self._put_replicated(z, sharding))
             else:
                 pools.append(jax.device_put(z, device)
                              if device is not None else jnp.asarray(z))
         return tuple(pools)
+
+    def _scratch_specs(self, pools) -> List[Tuple[int, Tuple, Any]]:
+        """(count, tile_shape, dtype) per scratch pool in cid order —
+        the single authority for scratch layout (build_pools and
+        synth_pools both consume it)."""
+        specs = []
+        for sp in sorted(self._scratch.values(), key=lambda s: s["cid"]):
+            if sp["shape"] is not None:
+                specs.append((sp["n"], tuple(sp["shape"]),
+                              np.dtype(sp["dtype"])))
+            else:
+                like = pools[sp["like"]]
+                specs.append((sp["n"], tuple(like.shape[1:]),
+                              np.dtype(str(like.dtype))))
+        return specs
+
+    def synth_pools(self, tile_fn, device=None) -> Tuple:
+        """Build pools entirely ON DEVICE inside one jit from a
+        traceable per-tile synthesis function
+        ``tile_fn(coll_name, coord) -> array`` — zero H2D staging
+        (benches/demos feed PRNG-generated inputs over a tunnel whose
+        bandwidth cannot be trusted). Pool/scratch layout is identical
+        to :meth:`build_pools` by construction (same pool walk, same
+        :meth:`_scratch_specs`)."""
+        import jax
+        import jax.numpy as jnp
+
+        def build():
+            pools = []
+            for pid, name in enumerate(self.pool_names):
+                if pid not in self._used_colls:
+                    pools.append(jnp.zeros((0,), np.float32))
+                    continue
+                pools.append(jnp.stack([tile_fn(name, c)
+                                        for c in self._pool_coords[pid]]))
+            for cnt, shape, dt in self._scratch_specs(pools):
+                pools.append(jnp.zeros((cnt,) + shape, dt))
+            return tuple(pools)
+
+        if device is not None:
+            with jax.default_device(device):
+                return jax.jit(build)()
+        return jax.jit(build)()
 
     @staticmethod
     def _put_replicated(x, sharding):

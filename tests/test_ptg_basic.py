@@ -334,7 +334,7 @@ k = 0 .. NT-1
 READ A <- (k > 0) ? dummy( k-1 )
 BODY
 {
-    got.append((k, None if A is None else float(A[0])))
+    got.append((k, None if A is None else float(A[0, 0])))
 }
 END
 """
