@@ -54,6 +54,7 @@ from .wave import WaveError, WaveRunner
 __all__ = ["TAG_WAVE", "DistWaveRunner"]
 
 TAG_WAVE = TAG_USER_BASE - 4
+_LANE_RDV_LOCK = threading.Lock()
 
 
 def _ensure_wave_inbox(ce):
@@ -100,6 +101,115 @@ def _is_single_device(arr) -> bool:
         return False
 
 
+class _CollectiveLane:
+    """ONE compiled XLA collective per broadcast group instead of P
+    descriptor sends (SURVEY §5.8's TPU-native target; the reference's
+    dynamic trees are /root/reference/parsec/remote_dep.c:272-358).
+
+    A full-broadcast tile group becomes a single all-reduce over a mesh
+    with one device per rank: every rank contributes a stacked array
+    that is ZERO except at rows it sources, so the sum over the rank
+    axis IS the broadcast — XLA compiles the data movement (psum over
+    ICI on real hardware), no per-destination messages at all.
+
+    Substrates:
+    - multi-process (launcher --jax-distributed): every rank holds one
+      shard of a global array and calls the same jitted reduction —
+      multi-controller SPMD, XLA's distributed runtime moves the bytes;
+    - in-process (SPMD rank threads in one process, >= nb_ranks local
+      devices): ranks deposit their shard at a rendezvous keyed by
+      (pool, epoch, wave, cid); the LAST depositor issues the one
+      multi-device call and everyone picks the replicated result up.
+    """
+
+    def __init__(self, mode: str, nb_ranks: int, rank: int,
+                 rendezvous=None, timeout: float = 120.0) -> None:
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        self.mode = mode
+        self.nb_ranks = nb_ranks
+        self.rank = rank
+        self.timeout = timeout
+        if mode == "multiproc":
+            by_proc = {}
+            for d in jax.devices():
+                by_proc.setdefault(d.process_index, d)
+            devs = [by_proc[p] for p in sorted(by_proc)]
+            self.device = by_proc[jax.process_index()]
+        else:
+            devs = jax.local_devices()[:nb_ranks]
+            self.device = devs[rank]
+        self.mesh = Mesh(np.array(devs), ("r",))
+        self._in_sh = NamedSharding(self.mesh, PartitionSpec("r"))
+        self._out_sh = NamedSharding(self.mesh, PartitionSpec())
+        # jax.jit specializes per input shape/dtype internally — one
+        # wrapper covers every pool/pad bucket
+        self._sum = jax.jit(lambda g: g.sum(axis=0),
+                            out_shardings=self._out_sh)
+        self._rdv = rendezvous   # shared dict+condvar for in-process
+
+    def reduce(self, key: Tuple, contrib) -> Any:
+        """All-reduce one padded contribution stack; returns the
+        replicated result's shard on this rank's lane device."""
+        import jax
+
+        # each rank's deposit is its slice of the [ranks, ...] global
+        # array: shard shape carries the leading rank axis
+        contrib = jax.device_put(contrib[None], self.device)
+        gshape = (self.nb_ranks,) + tuple(contrib.shape[1:])
+        if self.mode == "multiproc":
+            garr = jax.make_array_from_single_device_arrays(
+                gshape, self._in_sh, [contrib])
+            out = self._sum(garr)
+            return next(s.data for s in out.addressable_shards
+                        if s.device == self.device)
+        # in-process rendezvous: last depositor issues the single call
+        slots, results, cv = self._rdv
+        with cv:
+            mine = slots.setdefault(key, {})
+            mine[self.rank] = contrib
+            if len(mine) == self.nb_ranks:
+                try:
+                    garr = jax.make_array_from_single_device_arrays(
+                        gshape, self._in_sh,
+                        [mine[r] for r in range(self.nb_ranks)])
+                    results[key] = [self._sum(garr), self.nb_ranks]
+                except BaseException:
+                    # peers-only refcount: the issuer re-raises and
+                    # never reaches the pickup decrement below
+                    results[key] = [None, self.nb_ranks - 1]
+                    raise
+                finally:
+                    del slots[key]
+                    cv.notify_all()
+            else:
+                deadline = time.monotonic() + self.timeout
+                while key not in results:
+                    if time.monotonic() > deadline:
+                        # withdraw the deposit so a late issuer can't
+                        # fire with this rank's share unaccounted
+                        ours = slots.get(key)
+                        if ours is not None:
+                            ours.pop(self.rank, None)
+                            if not ours:
+                                del slots[key]
+                        raise WaveError(
+                            f"rank {self.rank}: collective-lane "
+                            f"rendezvous {key} timed out")
+                    cv.wait(1.0)
+            ent = results[key]
+            ent[1] -= 1
+            out = ent[0]
+            if ent[1] <= 0:
+                del results[key]
+        if out is None:
+            raise WaveError(f"rank {self.rank}: collective-lane issuer "
+                            f"failed for {key}")
+        return next(s.data for s in out.addressable_shards
+                    if s.device == self.device)
+
+
 class DistWaveRunner(WaveRunner):
     """Wave executor for a multi-rank PTG taskpool.
 
@@ -133,6 +243,7 @@ class DistWaveRunner(WaveRunner):
         self.nb_ranks = int(tp.nb_ranks)
         self._rank_of_task = self._compute_task_ranks()
         self._levels = self._compute_levels()
+        self._setup_collective_lane()
         self._build_comm_schedule()
         self._build_local_maps()
         self._scatter_kerns: Dict[int, Any] = {}
@@ -156,8 +267,57 @@ class DistWaveRunner(WaveRunner):
             from ...comm.tcp import TCPCommEngine
             if not isinstance(self.ce, TCPCommEngine):
                 return
+            if self._lane is not None and self._lane.mode == "multiproc" \
+                    and self._lane_sched:
+                # the lane's blocking XLA collective and the transfer
+                # plane share the PJRT client: a pull parked behind a
+                # peer's in-flight all-reduce deadlocks (observed on the
+                # CPU substrate). With the lane carrying the broadcast
+                # volume, the p2p remainder rides host-byte TCP, which
+                # only needs socket threads. A lane with NOTHING
+                # scheduled (e.g. 2 ranks: no multi-dst edge exists)
+                # keeps the plane. wave_dist_plane=on forces the plane
+                # anyway (real multi-host TPU: separate hardware
+                # queues).
+                return
         from ...comm.xfer import DeviceDataPlane
         DeviceDataPlane(self.ce).exchange(timeout=self.comm_timeout)
+
+    def _setup_collective_lane(self) -> None:
+        """MCA ``wave_dist_collective`` = auto/on/off. auto: attach the
+        compiled-collective lane when this is a multi-controller jax
+        runtime with exactly one process per rank (the launcher's
+        --jax-distributed global mesh). on: additionally allow the
+        in-process substrate (one process owning >= nb_ranks devices,
+        SPMD rank threads — the virtual-mesh test/dryrun layout). The
+        decision is a pure function of process topology + params, so
+        all SPMD ranks agree."""
+        from ...utils.params import params
+        self._lane: Optional[_CollectiveLane] = None
+        mode = str(params.get_or("wave_dist_collective", "string", "auto"))
+        if mode == "off" or self.nb_ranks < 2:
+            return
+        try:
+            import jax
+            if jax.process_count() == self.nb_ranks:
+                self._lane = _CollectiveLane(
+                    "multiproc", self.nb_ranks, self.rank,
+                    timeout=self.comm_timeout)
+            elif mode == "on" and jax.process_count() == 1 and \
+                    len(jax.local_devices()) >= self.nb_ranks:
+                fab = getattr(self.ce, "fabric", None) or self.ce
+                with _LANE_RDV_LOCK:   # SPMD threads race the attach
+                    rdv = getattr(fab, "_lane_rdv", None)
+                    if rdv is None:
+                        rdv = ({}, {}, threading.Condition())
+                        fab._lane_rdv = rdv
+                self._lane = _CollectiveLane(
+                    "inproc", self.nb_ranks, self.rank, rendezvous=rdv,
+                    timeout=self.comm_timeout)
+        except Exception:
+            if mode == "on":
+                raise
+            self._lane = None   # auto: no usable substrate -> trees
 
     # ------------------------------------------------------------------ #
     # static analysis                                                    #
@@ -327,8 +487,18 @@ class DistWaveRunner(WaveRunner):
         for (w, src, dst, cid, idx) in transfers:
             grouped.setdefault((w, src, cid, idx), []).append(dst)
         edges: Set[Tuple[int, int, int, int, int, int]] = set()
+        # lane_sched[wave][cid] -> sorted [(idx, src)]: full broadcasts
+        # ride ONE compiled collective instead of a descriptor tree
+        lane_sched: Dict[int, Dict[int, List[Tuple[int, int]]]] = {}
         for (w, src, cid, idx), dsts in grouped.items():
             dsts = sorted(set(dsts))
+            # full broadcasts only, and never for a single destination
+            # (a 1-dst all-reduce over the whole mesh loses to one send)
+            if self._lane is not None and len(dsts) >= 2 \
+                    and len(dsts) == self.nb_ranks - 1:
+                lane_sched.setdefault(w, {}).setdefault(
+                    cid, []).append((idx, src))
+                continue
             if topo == "star" or len(dsts) == 1:
                 for d in dsts:
                     edges.add((w, src, d, cid, idx, 0))
@@ -363,6 +533,8 @@ class DistWaveRunner(WaveRunner):
         self._recvs = {w: {g: sorted(s) for g, s in by_gen.items()}
                        for w, by_gen in recvs.items()}
         self._bcast_topo = topo
+        self._lane_sched = {w: {c: sorted(v) for c, v in by_c.items()}
+                            for w, by_c in lane_sched.items()}
         self._transfers = {(w, s, d, c, i)
                            for (w, s, d, c, i, _g) in edges}
         self._n_transfers = len(self._transfers)
@@ -395,6 +567,10 @@ class DistWaveRunner(WaveRunner):
         for (w, src, dst, cid, idx) in self._transfers:
             if src == self.rank or dst == self.rank:
                 touched[cid].add(idx)
+        for by_cid in self._lane_sched.values():
+            # lane tiles: every rank is an endpoint (full broadcast)
+            for cid, entries in by_cid.items():
+                touched[cid].update(i for (i, _s) in entries)
         self._l2g = [np.asarray(sorted(s), np.int32) for s in touched]
         g2l = []
         for c in range(n_pools):
@@ -489,6 +665,8 @@ class DistWaveRunner(WaveRunner):
         self._fwd_tiles = 0
         self._fwd_host_stacks = 0
         self._fwd_device_stacks = 0
+        self._lane_calls = 0
+        self._lane_tiles = 0
 
         ok = False
         t0 = time.perf_counter()
@@ -520,6 +698,10 @@ class DistWaveRunner(WaveRunner):
                 "fwd_host_stacks": self._fwd_host_stacks,
                 "fwd_device_stacks": self._fwd_device_stacks,
                 "bcast_topology": self._bcast_topo,
+                "collective_lane": (self._lane.mode
+                                    if self._lane is not None else None),
+                "collective_calls": self._lane_calls,
+                "collective_tiles": self._lane_tiles,
                 "device_plane": getattr(self.ce, "device_plane",
                                         None) is not None,
                 "local_tiles": int(sum(len(g) for g in self._l2g)),
@@ -543,6 +725,53 @@ class DistWaveRunner(WaveRunner):
             len(self._levels), n_calls, self._n_transfers)
         return pools
 
+    def _lane_step(self, w: int, pools: Tuple) -> Tuple:
+        """Execute this wave's full-broadcast groups as ONE compiled
+        collective per (wave, pool): gather my sourced rows into a
+        zero-padded contribution stack, all-reduce over the lane mesh
+        (sum == broadcast), scatter the replicated result into my
+        staged pool rows. Counts ride stats as collective_calls /
+        collective_tiles; none of these tiles appear in _sends."""
+        sched = self._lane_sched.get(w)
+        if not sched:
+            return pools
+        import jax
+        import jax.numpy as jnp
+
+        pool_name, epoch = self._cur
+        plist = list(pools)
+        for cid in sorted(sched):
+            entries = sched[cid]
+            idxs = np.asarray([i for (i, _s) in entries], np.int32)
+            srcs = np.asarray([s for (_i, s) in entries], np.int32)
+            n = len(entries)
+            npad = 1 << max(0, (n - 1).bit_length())   # bucket compiles
+            shape, _dt = self._pool_tile_spec(cid)
+            # dtype from the STAGED pool, not the collection spec: with
+            # x64 off an f64 collection stages f32 device pools
+            dt = (plist[cid].dtype if hasattr(plist[cid], "dtype")
+                  else _dt)
+            lidx = self._g2l[cid][idxs]
+            mine = np.nonzero(srcs == self.rank)[0]
+            contrib = jnp.zeros((npad,) + tuple(shape), dt)
+            if len(mine):
+                rows = plist[cid][lidx[mine]]
+                if not _is_single_device(rows):
+                    rows = np.asarray(rows)   # sharded pools: host hop
+                contrib = contrib.at[np.asarray(mine, np.int32)].set(
+                    jax.device_put(rows, self._lane.device))
+            out = self._lane.reduce((pool_name, epoch, w, cid), contrib)
+            vals = out[:n]
+            if _is_single_device(plist[cid]):
+                dev = next(iter(plist[cid].devices()))
+                vals = jax.device_put(vals, dev)
+            else:
+                vals = np.asarray(vals)       # sharded pools
+            plist[cid] = self._scatter_kernel(n)(plist[cid], lidx, vals)
+            self._lane_calls += 1
+            self._lane_tiles += n
+        return tuple(plist)
+
     def _comm_step(self, w: int, pools: Tuple) -> Tuple:
         """Push my wave-w writes to their remote readers, then absorb
         what wave w wrote elsewhere that I will read.
@@ -557,6 +786,7 @@ class DistWaveRunner(WaveRunner):
         import jax
         import jax.numpy as jnp
 
+        pools = self._lane_step(w, pools)
         pool_name, epoch = self._cur
         plane = getattr(self.ce, "device_plane", None)
         send_gens = self._sends.get(w, {})

@@ -495,6 +495,80 @@ def test_dist_wave_bcast_tree_offloads_root(nb_ranks=4):
         sum(s["tiles_recv"] for s in star) == nb_ranks - 1
 
 
+def test_dist_wave_collective_lane_bcast(nb_ranks=4):
+    """A full-broadcast tile rides ONE compiled XLA collective (sum over
+    the lane mesh's rank axis == broadcast) instead of P descriptor
+    sends (round-4 VERDICT Missing #2; SURVEY §5.8 target;
+    ref /root/reference/parsec/remote_dep.c:272-358). Differential: the
+    tree path and the lane produce identical results (numerics asserted
+    inside _run_bcast for both), and the lane run ships ZERO p2p tiles."""
+    from parsec_tpu.utils.params import params
+
+    tree = _run_bcast(nb_ranks, "binomial")
+    assert sum(s["tiles_sent"] for s in tree) == nb_ranks - 1
+    assert all(s["collective_calls"] == 0 for s in tree)
+
+    params.set_cmdline("wave_dist_collective", "on")
+    try:
+        lane = _run_bcast(nb_ranks, "binomial")
+    finally:
+        params.unset_cmdline("wave_dist_collective")
+    assert all(s["collective_lane"] == "inproc" for s in lane), lane
+    # every rank took part in exactly one collective op carrying the
+    # one broadcast tile; no point-to-point tile moved at all
+    assert all(s["collective_calls"] == 1 for s in lane), lane
+    assert all(s["collective_tiles"] == 1 for s in lane), lane
+    assert sum(s["tiles_sent"] for s in lane) == 0, lane
+    assert sum(s["tiles_recv"] for s in lane) == 0, lane
+
+
+def test_dist_wave_collective_lane_dpotrf_matches(nb_ranks=4):
+    """dpotrf on a 4-rank row-cyclic distribution: every POTRF/TRSM
+    panel tile is read by all other ranks, so the lane carries the
+    panel broadcasts. Differential vs the tree path on the same input:
+    identical factor, and the lane replaces a nonzero share of sends."""
+    from parsec_tpu.utils.params import params
+
+    n, nb = 256, 32
+    M = make_spd(n, dtype=np.float64)
+
+    def run(lane_on):
+        def rank_fn(r, f):
+            ce = f.engine(r)
+            coll = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float64,
+                                     P=nb_ranks, Q=1, nodes=nb_ranks,
+                                     rank=r)
+            coll.name = "descA"
+            coll.from_numpy(M.copy())
+            tp = dpotrf_taskpool(coll, rank=r, nb_ranks=nb_ranks)
+            w = ptg.wave(tp, comm=ce)
+            w.run()
+            return w.stats, _gather_owned(coll, rank=r)
+
+        if lane_on:
+            params.set_cmdline("wave_dist_collective", "on")
+        try:
+            results, _ = spmd(nb_ranks, rank_fn, timeout=180)
+        finally:
+            if lane_on:
+                params.unset_cmdline("wave_dist_collective")
+        L = np.zeros((n, n))
+        for (_st, owned) in results:
+            for (m, k), t in owned.items():
+                L[m * nb:(m + 1) * nb, k * nb:(k + 1) * nb] = t
+        return np.tril(L), [st for (st, _o) in results]
+
+    L_tree, st_tree = run(False)
+    L_lane, st_lane = run(True)
+    ref = np.linalg.cholesky(M)
+    np.testing.assert_allclose(L_tree, ref, rtol=0, atol=1e-8 * n)
+    np.testing.assert_allclose(L_lane, L_tree, rtol=0, atol=0)
+    assert sum(s["collective_calls"] for s in st_lane) > 0, st_lane
+    assert sum(s["collective_tiles"] for s in st_lane) > 0
+    assert sum(s["tiles_sent"] for s in st_lane) < \
+        sum(s["tiles_sent"] for s in st_tree), (st_lane, st_tree)
+
+
 def test_dist_wave_bcast_chain_root_sends_once(nb_ranks=4):
     """Chain topology: the root ships each broadcast tile exactly ONCE
     regardless of reader count (O(1) in P), the chain re-forwards."""
