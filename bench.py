@@ -322,6 +322,11 @@ def bench_wave(n, nb, reps, dtype):
     return best, float(resid_j(pools))
 
 
+#: per-mode side facts picked up by bench_all into extras (e.g. the
+#: CPU-side dispatch rate that survives link-latency compression)
+_MODE_NOTES = {}
+
+
 def bench_runtime(n, nb, reps, cores, dtype, dispatch="turbo"):
     """Per-task dispatch through the context (ctx.add_taskpool + wait).
 
@@ -352,7 +357,8 @@ def bench_runtime(n, nb, reps, cores, dtype, dispatch="turbo"):
         try:
             dev = jax.devices()[0]
             best = None
-            A = None
+            best_disp = None
+            A = r = None
             for _ in range(max(2, reps)):
                 A = TDBC(n, n, nb, nb, dtype=dtype).from_numpy(M)
                 r = TurboRunner(mk_tp(A))
@@ -363,6 +369,18 @@ def bench_runtime(n, nb, reps, cores, dtype, dispatch="turbo"):
                 sync_device(pools)
                 dt = time.perf_counter() - t0
                 best = dt if best is None else min(best, dt)
+                ds = r.stats["dispatch_secs"]
+                best_disp = ds if best_disp is None else min(best_disp, ds)
+            # the CPU-side submission rate: turbo's own cost, which the
+            # link's per-call latency cannot compress the way wall
+            # GFLOP/s ratios are compressed on a degraded session
+            _MODE_NOTES["runtime"] = {
+                "turbo_dispatch_us_per_task": round(
+                    best_disp * 1e6 / r.dag.n_tasks, 1),
+                "turbo_tasks": int(r.dag.n_tasks),
+                "turbo_aot_prebound": not hasattr(
+                    r._entries[0][0], "lower"),
+            }
             # shape-split (pool, row) map for the device-side check
             loc = r._pool_of.get("descA") or next(iter(r._pool_of.values()))
             lower = {c: pools[pid][row] for c, (pid, row) in loc.items()
@@ -410,6 +428,10 @@ def bench_runtime(n, nb, reps, cores, dtype, dispatch="turbo"):
             sync_device(pend)
             dt = time.perf_counter() - t0
             best = dt if best is None else min(best, dt)
+        nt = (n + nb - 1) // nb
+        n_tasks = nt * (nt + 1) * (nt + 2) // 6
+        _MODE_NOTES["runtime_classic"] = {
+            "classic_wall_us_per_task": round(best * 1e6 / n_tasks, 1)}
         return best, check_numerics(A.to_numpy(), M, n)
     finally:
         ctx.fini()
@@ -590,6 +612,17 @@ def bench_all(n, nb, reps, cores, dtype):
                  lambda: bench_runtime(n_rt, 512, max(2, reps), cores,
                                        dtype, dispatch="classic")))
 
+    for note in _MODE_NOTES.values():
+        extras.update(note)
+    if "turbo_dispatch_us_per_task" in extras and \
+            "classic_wall_us_per_task" in extras:
+        # submission vs wall: the wall ratio (runtime vs runtime_classic
+        # gflops above) compresses toward 1 on a latency-degraded link
+        # because BOTH pay the same per-call link cost; the CPU-side
+        # dispatch rate is the framework's own number
+        extras["turbo_submit_vs_classic_wall"] = round(
+            extras["classic_wall_us_per_task"]
+            / max(extras["turbo_dispatch_us_per_task"], 1e-9), 2)
     if not candidates:
         print(json.dumps({"metric": "dpotrf_gflops", "value": 0.0,
                           "unit": "GFLOP/s", "vs_baseline": 0.0,

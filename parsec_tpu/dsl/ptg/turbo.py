@@ -22,8 +22,18 @@ A TIME in any dependence-respecting priority order, and a task's
 writes land in its slot in place — exactly the runtime's shared-copy
 mutation model (a flow's body mutates the copy bound to it).  There is
 no antichain batching and no gather-before-scatter wave semantics;
-this is genuine per-task dispatch, engineered to the µs scale the
-reference gets from C.
+this is genuine per-task dispatch.
+
+The honest floor (tools/turbo_profile.py, table in BASELINE.md): the
+C select/release loop itself runs at reference scale (~0.3 us/task)
+and the Python trampoline adds well under 1 us, but every task is
+still ONE XLA executable submission, and that submission — even
+AOT-pre-bound with donated buffers — costs on the order of 100 us
+CPU-side.  Turbo's per-task cost is therefore the XLA dispatch floor,
+one to two orders above the reference's ~1 us generated-C hook call,
+and 5-10x below the classic dynamic-hash path.  Cutting further means
+not dispatching per task at all — that is wave/capture's job, not
+turbo's.
 
 Writebacks are LAZY and device-resident: after the run, each written
 tile's newest copy is a lazy slice of the device pool, materialized on
@@ -218,11 +228,15 @@ class TurboRunner(WaveRunner):
 
     # ------------------------------------------------------------------ #
     def _build_entries(self, pools, device=None) -> None:
-        """Per-task (spec, arrays) entries with the index arrays staged
-        as DEVICE constants once: per-task calls then pass only cached
-        device buffers (a numpy arg would pay a host->device conversion
-        per call). Cached on the DAG — repeated taskpool instantiations
-        with the same signature reuse them."""
+        """Per-task (callable, arrays) entries: the index arrays staged
+        as DEVICE constants once (a numpy arg would pay a host->device
+        conversion per call), and the chunk kernel PRE-BOUND as an
+        AOT-compiled executable per spec — the per-task cost is then
+        pure submission, not signature matching / argument processing
+        (round-4 VERDICT item 4; the reference's analog is the jdf2c-
+        generated direct hook call, scheduling.c:586-625). Cached on
+        the DAG — repeated taskpool instantiations with the same
+        signature reuse them."""
         import jax
 
         dag = self.dag
@@ -232,18 +246,44 @@ class TurboRunner(WaveRunner):
             self._entries = cached
             return
         entries = []
+        compiled: Dict[Tuple, Any] = {}
         for t in range(dag.n_tasks):
             ids = np.asarray([t], np.int64)
             ent, _ = self._frontier_entries(ids, dag.class_of[ids], pools)
             spec, a = ent[0]
             put = (lambda x: jax.device_put(x, device)) \
                 if device is not None else jax.device_put
-            entries.append((spec, {k: put(v) for k, v in a.items()}))
+            a = {k: put(v) for k, v in a.items()}
+            fn = compiled.get(spec)
+            if fn is None:
+                fn = compiled[spec] = self._prebind(spec, pools, a)
+            entries.append((fn, a))
         # ONE barrier for all staged index arrays: a per-entry sync
         # would pay one link round trip per task
-        jax.block_until_ready([v for _s, a in entries
+        jax.block_until_ready([v for _fn, a in entries
                                for v in a.values()])
-        self._entries = dag.kernel_cache[ck] = entries
+        if self._kernels_shareable:
+            dag.kernel_cache[ck] = entries
+        self._entries = entries
+
+    def _prebind(self, spec: Tuple, pools, a) -> Any:
+        """AOT-lower + compile the spec's chunk kernel against the run's
+        concrete pool/index shapes (donation preserved from the jit
+        wrapper). Falls back to the jitted callable when the AOT API is
+        unavailable — semantics identical, dispatch a little heavier."""
+        kern = self._kernel(*spec)
+        try:
+            return kern.lower(pools, a["locs"], a["idx_in"], a["idx_out"],
+                              a["idx_wbx"]).compile()
+        except Exception as exc:
+            # body trace errors get the friendly wave diagnosis (the
+            # trace runs inside lower() here, not at first call)
+            werr = self._trace_error(exc, self.plans[spec[0]].ast.name)
+            if werr is not None:
+                raise werr from exc
+            plog.debug.verbose(1, "turbo AOT prebind unavailable (%s); "
+                               "using jit dispatch", exc)
+            return kern
 
     def execute_per_task(self, pools, device=None) -> Tuple:
         """Run every task as ONE XLA call in C-driven priority order."""
@@ -254,11 +294,11 @@ class TurboRunner(WaveRunner):
         holder = self._holder
         holder.pools = pools
         entries = self._entries
-        call = self._call_chunk
 
         def tramp(tid: int) -> None:
-            spec, a = entries[tid]
-            holder.pools = call(spec, a, holder.pools)
+            fn, a = entries[tid]
+            holder.pools = fn(holder.pools, a["locs"], a["idx_in"],
+                              a["idx_out"], a["idx_wbx"])
 
         dag = self.dag
         indptr, succ, indeg = self._aug    # WAR/WAW-augmented CSR
