@@ -1209,26 +1209,35 @@ class WaveRunner:
         (benches/demos feed PRNG-generated inputs over a tunnel whose
         bandwidth cannot be trusted). Pool/scratch layout is identical
         to :meth:`build_pools` by construction (same pool walk, same
-        :meth:`_scratch_specs`)."""
+        :meth:`_scratch_specs`). The jitted builder is cached per
+        tile_fn object — pass the SAME function across calls to avoid
+        a retrace per staging."""
         import jax
         import jax.numpy as jnp
 
-        def build():
-            pools = []
-            for pid, name in enumerate(self.pool_names):
-                if pid not in self._used_colls:
-                    pools.append(jnp.zeros((0,), np.float32))
-                    continue
-                pools.append(jnp.stack([tile_fn(name, c)
-                                        for c in self._pool_coords[pid]]))
-            for cnt, shape, dt in self._scratch_specs(pools):
-                pools.append(jnp.zeros((cnt,) + shape, dt))
-            return tuple(pools)
+        jitted = getattr(self, "_synth_jits", None)
+        if jitted is None:
+            jitted = self._synth_jits = {}
+        fn = jitted.get(tile_fn)
+        if fn is None:
+            def build():
+                pools = []
+                for pid, name in enumerate(self.pool_names):
+                    if pid not in self._used_colls:
+                        pools.append(jnp.zeros((0,), np.float32))
+                        continue
+                    pools.append(jnp.stack(
+                        [tile_fn(name, c)
+                         for c in self._pool_coords[pid]]))
+                for cnt, shape, dt in self._scratch_specs(pools):
+                    pools.append(jnp.zeros((cnt,) + shape, dt))
+                return tuple(pools)
+            fn = jitted[tile_fn] = jax.jit(build)
 
         if device is not None:
             with jax.default_device(device):
-                return jax.jit(build)()
-        return jax.jit(build)()
+                return fn()
+        return fn()
 
     @staticmethod
     def _put_replicated(x, sharding):

@@ -1,8 +1,12 @@
 """North-star-scale wave demo on one chip: dpotrf NT>=64 at NB=512.
 
-Times each stage so tunnel/host costs are attributable; verification is
-device-side (the D2H link can be ~4 MB/s — a full gather would take
-tens of minutes). Usage: python tools/wave_chip_demo.py [N] [NB].
+Times each stage so tunnel/host costs are attributable; input is
+synthesized ON DEVICE (WaveRunner.synth_pools — the round-4 lesson:
+a 4 GB H2D stage at tunnel rates takes ~minutes and degrades the link
+for everything after), and verification is device-side (the D2H link
+can be ~4 MB/s — a full gather would take tens of minutes).
+Usage: python tools/wave_chip_demo.py [N] [NB].
+WAVE_DEMO_HOST_INPUT=1 restores the round-2 host-staged input path.
 """
 import os
 import sys
@@ -27,16 +31,23 @@ def main():
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 32768
     nb = int(sys.argv[2]) if len(sys.argv) > 2 else 512
     nt = n // nb
-    rng = np.random.RandomState(0)
+    host_input = os.environ.get("WAVE_DEMO_HOST_INPUT") == "1"
     t0 = time.perf_counter()
-    B = rng.rand(n, n).astype(np.float32)
-    M = (B + B.T) / 2
-    del B
-    M[np.arange(n), np.arange(n)] += n
-    log(f"input built ({time.perf_counter()-t0:.1f}s)")
+    if host_input:
+        rng = np.random.RandomState(0)
+        B = rng.rand(n, n).astype(np.float32)
+        M = (B + B.T) / 2
+        del B
+        M[np.arange(n), np.arange(n)] += n
+        log(f"host input built ({time.perf_counter()-t0:.1f}s)")
+    else:
+        M = None   # spot-check pulls its two reference tiles D2H
+        log("on-device synthesis mode (zero H2D staging)")
 
     t0 = time.perf_counter()
-    A = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float32).from_numpy(M)
+    A = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float32)
+    if host_input:
+        A.from_numpy(M)
     tp = dpotrf_taskpool(A)
     w = wave(tp, max_chunk=256)
     log(f"NT={nt}: {w.nb_tasks} tasks; collection+lower+slots "
@@ -44,9 +55,29 @@ def main():
 
     dev = jax.devices()[0]
     t0 = time.perf_counter()
-    pools = w.build_pools(device=dev)
+    if host_input:
+        pools = w.build_pools(device=dev)
+    else:
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from bench import _synth_lower
+
+        cache = {}
+
+        def tile_fn(_name, c):
+            if not cache:
+                cache.update(_synth_lower(
+                    jax.random.PRNGKey(23), nt, nb, n, jnp.float32))
+            return cache[c] if c[0] >= c[1] \
+                else jnp.zeros((nb, nb), jnp.float32)
+
+        def synth():
+            cache.clear()
+            return w.synth_pools(tile_fn, device=dev)
+
+        pools = synth()
     jax.block_until_ready(pools)
-    log(f"pools staged to {dev} ({time.perf_counter()-t0:.1f}s)")
+    log(f"pools on {dev} ({time.perf_counter()-t0:.1f}s)")
 
     t0 = time.perf_counter()
     out = w.execute(pools)
@@ -55,9 +86,17 @@ def main():
     log(f"first run incl compiles ({warm:.1f}s)")
 
     t0 = time.perf_counter()
-    pools = w.build_pools(device=dev)
+    pools = w.build_pools(device=dev) if host_input else synth()
     jax.block_until_ready(pools)
     log(f"pools re-staged ({time.perf_counter()-t0:.1f}s)")
+    if M is None:
+        # spot-check references: pull the two INPUT tiles this mode
+        # never materializes on the host (~2 MB D2H total)
+        loc = w._pool_of["descA"]
+        p00, r00 = loc[(0, 0)]
+        pn0, rn0 = loc[(nt - 1, 0)]
+        in00 = np.asarray(pools[p00][r00])
+        inn0 = np.asarray(pools[pn0][rn0])
     t0 = time.perf_counter()
     out = w.execute(pools)
     jax.block_until_ready(out)
@@ -81,10 +120,11 @@ def main():
     t0 = time.perf_counter()
     tiles = np.asarray(out[0][np.array([0, (nt - 1) * nt])])
     log(f"pulled 2 tiles D2H ({time.perf_counter()-t0:.1f}s)")
-    L00 = np.linalg.cholesky(M[:nb, :nb].astype(np.float64))
+    m00 = M[:nb, :nb] if M is not None else in00
+    mn0 = M[(nt - 1) * nb:, :nb] if M is not None else inn0
+    L00 = np.linalg.cholesky(m00.astype(np.float64))
     e0 = np.abs(np.tril(tiles[0]) - L00).max() / np.abs(L00).max()
-    ref_t = M[(nt - 1) * nb:, :nb].astype(np.float64) @ \
-        np.linalg.inv(L00).T
+    ref_t = mn0.astype(np.float64) @ np.linalg.inv(L00).T
     e1 = np.abs(tiles[1] - ref_t).max() / np.abs(ref_t).max()
     log(f"tile checks: |L00 err|={e0:.3e} |L(nt-1,0) err|={e1:.3e}")
     assert e0 < 1e-4 and e1 < 1e-3, "tile spot-check failed"
