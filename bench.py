@@ -143,6 +143,47 @@ def _synth_lower(key, nt, nb, n, jdt):
     return tiles
 
 
+def synth_spd_pool_fn(key, nt, nb, n, jdt):
+    """Whole-pool SPD synthesis for WaveRunner.synth_pools(pool_fn=):
+    same tile values as _synth_lower (B[m,k] = uniform(fold_in(key,
+    m*nt+k)); A = (B+B^T)/2 + n*I on the diagonal; upper tiles zero)
+    but built one block-ROW at a time with vmapped PRNG inside a
+    fori_loop, so the traced program is O(nt), not O(nt^2) — the
+    per-tile form at NT=64 emitted a 360 KB MLIR module that OOM-killed
+    the relay's compile helper."""
+    import jax.numpy as jnp
+    from jax import lax, random, vmap
+
+    def pool_fn(_name, coords):
+        # coords may be a SUBSET of the square (uplo/shape-split
+        # pools): absent coords map to an out-of-bounds row and the
+        # scatter drops them instead of clobbering row 0
+        pos = np.full((nt, nt), len(coords), np.int32)
+        for i, (m, k) in enumerate(coords):
+            pos[m, k] = i
+        pos_j = jnp.asarray(pos)
+        kgrid = jnp.arange(nt)
+        eye = n * jnp.eye(nb, dtype=jnp.float32)
+
+        def gen_row(m):
+            ka = vmap(lambda k: random.fold_in(key, m * nt + k))(kgrid)
+            kb = vmap(lambda k: random.fold_in(key, k * nt + m))(kgrid)
+            A = vmap(lambda kk: random.uniform(kk, (nb, nb)))(ka)
+            Bt = vmap(lambda kk: random.uniform(kk, (nb, nb)))(kb)
+            row = (A + jnp.transpose(Bt, (0, 2, 1))) * 0.5
+            row = jnp.where((kgrid == m)[:, None, None], row + eye, row)
+            row = jnp.where((kgrid <= m)[:, None, None], row, 0.0)
+            return row.astype(jdt)
+
+        def body(m, out):
+            return out.at[pos_j[m]].set(gen_row(m), mode="drop")
+
+        init = jnp.zeros((len(coords), nb, nb), jdt)
+        return lax.fori_loop(0, nt, body, init)
+
+    return pool_fn
+
+
 def _synth_ref(low, X, nt, jdt):
     """ref_m = sum_k M[m,k] @ X_k from lower tiles only (symmetry)."""
     return [sum((low[(m, k)] if k <= m else low[(k, m)].T.astype(jdt))
@@ -284,16 +325,10 @@ def bench_wave(n, nb, reps, dtype):
     nvec = 4
     key = random.PRNGKey(23)
 
-    cache = {}
-
-    def tile_fn(_name, c):
-        if not cache:   # built once per trace, all on device
-            cache.update(_synth_lower(key, nt, nb, n, jdt))
-        return cache[c] if c[0] >= c[1] else jnp.zeros((nb, nb), jdt)
+    pool_fn = synth_spd_pool_fn(key, nt, nb, n, jdt)
 
     def synth():
-        cache.clear()
-        return w.synth_pools(tile_fn)
+        return w.synth_pools(pool_fn=pool_fn)
 
     def resid(pools):
         loc = w._pool_of["descA"]
@@ -639,6 +674,14 @@ def bench_all(n, nb, reps, cores, dtype):
     if peak is not None and (not xla_gfs or peak > 10 * max(xla_gfs)):
         extras["tunnel_degraded"] = True
     if peak is not None:
+        # the chained-GEMM estimator is itself latency-bound on a bad
+        # link (24 calls behind one sync); a measured engine rate ABOVE
+        # it proves the chip is at least that fast — floor the
+        # denominator on the headline itself so mfu <= 1 always holds
+        if gf > peak:
+            peak = gf
+            extras["peak_floored_by_engine"] = True
+            extras["chip_peak_gflops(f32)"] = round(peak, 1)
         extras["mfu"] = round(gf / peak, 4)
     emit_line(n_used, nb_used, dtype, mode, gf, extras)
 

@@ -1202,23 +1202,35 @@ class WaveRunner:
                               np.dtype(str(like.dtype))))
         return specs
 
-    def synth_pools(self, tile_fn, device=None) -> Tuple:
-        """Build pools entirely ON DEVICE inside one jit from a
-        traceable per-tile synthesis function
-        ``tile_fn(coll_name, coord) -> array`` — zero H2D staging
-        (benches/demos feed PRNG-generated inputs over a tunnel whose
-        bandwidth cannot be trusted). Pool/scratch layout is identical
-        to :meth:`build_pools` by construction (same pool walk, same
-        :meth:`_scratch_specs`). The jitted builder is cached per
-        tile_fn object — pass the SAME function across calls to avoid
-        a retrace per staging."""
+    def synth_pools(self, tile_fn=None, device=None,
+                    pool_fn=None) -> Tuple:
+        """Build pools entirely ON DEVICE inside one jit — zero H2D
+        staging (benches/demos feed PRNG-generated inputs over a tunnel
+        whose bandwidth cannot be trusted). Two synthesis granularities:
+
+        - ``tile_fn(coll_name, coord) -> array``: simple, but the
+          traced program is O(n_tiles) — a 4096-tile stack at NT=64
+          produced a 360 KB MLIR module that OOM-killed the relay's
+          compile helper;
+        - ``pool_fn(coll_name, coords) -> stacked [len(coords), ...]``:
+          the whole pool in one expression (vmap/scan inside keeps the
+          program O(1) in tile count) — required at north-star sizes.
+
+        Pool/scratch layout is identical to :meth:`build_pools` by
+        construction (same pool walk, same :meth:`_scratch_specs`).
+        The jitted builder is cached per function object — pass the
+        SAME callable across calls to avoid a retrace per staging."""
         import jax
         import jax.numpy as jnp
 
+        assert (tile_fn is None) != (pool_fn is None), \
+            "pass exactly one of tile_fn / pool_fn"
         jitted = getattr(self, "_synth_jits", None)
         if jitted is None:
             jitted = self._synth_jits = {}
-        fn = jitted.get(tile_fn)
+        cache_key = ("tile", tile_fn) if tile_fn is not None \
+            else ("pool", pool_fn)
+        fn = jitted.get(cache_key)
         if fn is None:
             def build():
                 pools = []
@@ -1226,13 +1238,16 @@ class WaveRunner:
                     if pid not in self._used_colls:
                         pools.append(jnp.zeros((0,), np.float32))
                         continue
-                    pools.append(jnp.stack(
-                        [tile_fn(name, c)
-                         for c in self._pool_coords[pid]]))
+                    coords = self._pool_coords[pid]
+                    if pool_fn is not None:
+                        pools.append(pool_fn(name, coords))
+                    else:
+                        pools.append(jnp.stack(
+                            [tile_fn(name, c) for c in coords]))
                 for cnt, shape, dt in self._scratch_specs(pools):
                     pools.append(jnp.zeros((cnt,) + shape, dt))
                 return tuple(pools)
-            fn = jitted[tile_fn] = jax.jit(build)
+            fn = jitted[cache_key] = jax.jit(build)
 
         if device is not None:
             with jax.default_device(device):

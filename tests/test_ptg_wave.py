@@ -475,3 +475,49 @@ def test_wave_pdgemm_ragged():
     wave(pdgemm_taskpool(A, B, C), max_chunk=16).run()
     ref = Am.astype(np.float64) @ Bm.astype(np.float64)
     assert np.abs(C.to_numpy().astype(np.float64) - ref).max() / n < 1e-6
+
+
+def test_synth_pools_parity_and_subset_coords():
+    """On-device pool synthesis (zero-H2D staging, bench/demo path):
+    the vectorized whole-pool builder (bench.synth_spd_pool_fn) must
+    produce exactly the per-tile _synth_lower values in build_pools'
+    layout, both granularities must agree, and a SUBSET coordinate set
+    (e.g. a lower-uplo pool) must not clobber row 0 with dropped
+    scatter writes (the pos-default bug class)."""
+    import os
+    import sys
+
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from bench import _synth_lower, synth_spd_pool_fn
+
+    n, nb = 128, 32
+    nt = n // nb
+    key = jax.random.PRNGKey(23)
+    A = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float32)
+    w = wave(dpotrf_taskpool(A))
+    pool_fn = synth_spd_pool_fn(key, nt, nb, n, jnp.float32)
+
+    def tile_fn(_name, c):
+        low = _synth_lower(key, nt, nb, n, jnp.float32)
+        return low[c] if c[0] >= c[1] else jnp.zeros((nb, nb),
+                                                     jnp.float32)
+
+    by_pool = w.synth_pools(pool_fn=pool_fn)
+    by_tile = w.synth_pools(tile_fn)
+    assert len(by_pool) == len(by_tile) == len(w.build_pools())
+    for a, b in zip(by_pool, by_tile):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # subset coords: lower triangle only — absent uppers must be
+    # DROPPED, not scattered onto row 0
+    coords = [(m, k) for m in range(nt) for k in range(m + 1)]
+    sub = np.asarray(jax.jit(lambda: pool_fn("descA", coords))())
+    low = {c: np.asarray(v) for c, v in
+           jax.jit(lambda: _synth_lower(key, nt, nb, n,
+                                        jnp.float32))().items()}
+    for i, c in enumerate(coords):
+        np.testing.assert_array_equal(sub[i], low[c])

@@ -60,20 +60,13 @@ def main():
     else:
         sys.path.insert(0, os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
-        from bench import _synth_lower
+        from bench import synth_spd_pool_fn
 
-        cache = {}
-
-        def tile_fn(_name, c):
-            if not cache:
-                cache.update(_synth_lower(
-                    jax.random.PRNGKey(23), nt, nb, n, jnp.float32))
-            return cache[c] if c[0] >= c[1] \
-                else jnp.zeros((nb, nb), jnp.float32)
+        pool_fn = synth_spd_pool_fn(jax.random.PRNGKey(23), nt, nb, n,
+                                    jnp.float32)
 
         def synth():
-            cache.clear()
-            return w.synth_pools(tile_fn, device=dev)
+            return w.synth_pools(pool_fn=pool_fn, device=dev)
 
         pools = synth()
     jax.block_until_ready(pools)
