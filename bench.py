@@ -33,7 +33,7 @@ Knobs (env): BENCH_N (default 8192), BENCH_NB (2048), BENCH_DTYPE
 (float32), BENCH_REPS (3, best-of), BENCH_CORES (runtime mode worker
 threads, default 1: eager completion makes one thread the fastest driver
 on a single-CPU-core host), BENCH_CHAIN_N (32768) / BENCH_CHAIN_NB
-(4096) / BENCH_CHAIN_K (4) for the chain mode. Input staging and
+(2048) / BENCH_CHAIN_K (4) for the chain mode. Input staging and
 verification never cross the link in the XLA modes (on-device synthesis
 + device-side residuals), so large N is safe at any link bandwidth.
 """
@@ -615,7 +615,12 @@ def bench_all(n, nb, reps, cores, dtype):
     # latency + K x compute, so the gate survives a 200 ms/call session
     # (measured 2026-07-31: 38.7 TF/s on a 206 ms/call link). 16 GB-HBM
     # fallback at N=16384 if the full size fails to place.
-    chain_nb = int(os.environ.get("BENCH_CHAIN_NB", "4096"))
+    # NB sweep on the 2026-07-31 degraded session (N=32768): 4096 ->
+    # 38.7 TF/s (~3 min with compile), 2048 -> 44.4 (~4 min), 1024 ->
+    # 47.0 (~11 min: the 5,984-task capture compile alone is ~10 min).
+    # 2048 is the default: near-best rate at a compile cost safe for
+    # the driver's one-shot run
+    chain_nb = int(os.environ.get("BENCH_CHAIN_NB", "2048"))
     chain_k = int(os.environ.get("BENCH_CHAIN_K", "4"))
     chain_n = int(os.environ.get("BENCH_CHAIN_N", "32768"))
     for cn in [chain_n] + ([16384] if chain_n > 16384 else []):
@@ -701,7 +706,7 @@ def main() -> None:
         best, err = bench_capture(n, nb, reps, dtype)
     elif mode == "chain":
         n = int(os.environ.get("BENCH_CHAIN_N", "32768"))
-        nb = int(os.environ.get("BENCH_CHAIN_NB", "4096"))
+        nb = int(os.environ.get("BENCH_CHAIN_NB", "2048"))
         best, err = bench_capture_chain(
             n, nb, reps, dtype, int(os.environ.get("BENCH_CHAIN_K", "4")))
     elif mode == "wave":

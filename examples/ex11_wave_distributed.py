@@ -56,8 +56,18 @@ def main(n: int = 512, nb: int = 64) -> int:
         assert err < 1e-4, f"rank {rank}: residual {err}"
         mine = int((w._rank_of_task == rank).sum()) if nb_ranks > 1 \
             else w.nb_tasks
+        lane = ""
+        st = getattr(w, "stats", None)
+        if nb_ranks > 1 and st and st.get("collective_lane"):
+            # under launch.py --jax-distributed, full panel broadcasts
+            # ride ONE compiled all-reduce per (wave, pool) instead of
+            # per-destination sends (wave_dist_collective)
+            lane = (f", lane[{st['collective_lane']}]: "
+                    f"{st['collective_calls']} collectives carried "
+                    f"{st['collective_tiles']} tiles "
+                    f"(p2p sends {st['tiles_sent']})")
         print(f"rank {rank}/{nb_ranks}: wave dpotrf ok — {mine}/"
-              f"{w.nb_tasks} tasks here, max_err={err:.2e}")
+              f"{w.nb_tasks} tasks here, max_err={err:.2e}{lane}")
     finally:
         ctx.fini()
     return 0
