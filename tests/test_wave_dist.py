@@ -741,3 +741,70 @@ def test_dist_wave_hybrid_process_mesh_sharding(nb_ranks=2):
             L[m * nb:(m + 1) * nb, k * nb:(k + 1) * nb] = t
     np.testing.assert_allclose(np.tril(L), np.linalg.cholesky(M),
                                rtol=0, atol=1e-8 * n)
+
+
+def test_collective_lane_issuer_failure_wakes_peers():
+    """In-process lane rendezvous: when the issuing rank's collective
+    call raises, waiting peers must get a WaveError promptly (not hang
+    to the timeout), and the failure entry must not leak refcounts."""
+    import threading
+
+    import jax.numpy as jnp
+
+    from parsec_tpu.dsl.ptg.wave_dist import _CollectiveLane
+
+    rdv = ({}, {}, threading.Condition())
+    lanes = [_CollectiveLane("inproc", 2, r, rendezvous=rdv, timeout=15)
+             for r in range(2)]
+
+    class Boom(RuntimeError):
+        pass
+
+    def exploding_sum(_garr):
+        raise Boom("collective died")
+
+    results = {}
+
+    def waiter():
+        try:
+            lanes[0].reduce(("p", 1, 0, 0), jnp.zeros((1, 4, 4)))
+            results[0] = "ok"
+        except WaveError as e:
+            results[0] = f"waveerror: {e}"
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    # let rank 0 deposit and park
+    import time
+    deadline = time.monotonic() + 10
+    slots, res, cv = rdv
+    while time.monotonic() < deadline:
+        with cv:
+            if ("p", 1, 0, 0) in slots and 0 in slots[("p", 1, 0, 0)]:
+                break
+        time.sleep(0.01)
+    lanes[1]._sum = exploding_sum
+    with pytest.raises(Boom):
+        lanes[1].reduce(("p", 1, 0, 0), jnp.zeros((1, 4, 4)))
+    t.join(10)
+    assert not t.is_alive(), "peer hung after issuer failure"
+    assert results[0].startswith("waveerror"), results
+    assert not slots and not res, "rendezvous state leaked"
+
+
+def test_collective_lane_waiter_timeout_withdraws_deposit():
+    """A lone depositor whose peers never arrive times out with a
+    WaveError and withdraws its deposit so the shared rendezvous holds
+    no stale state."""
+    import threading
+
+    import jax.numpy as jnp
+
+    from parsec_tpu.dsl.ptg.wave_dist import _CollectiveLane
+
+    rdv = ({}, {}, threading.Condition())
+    lane = _CollectiveLane("inproc", 2, 0, rendezvous=rdv, timeout=1.5)
+    with pytest.raises(WaveError, match="timed out"):
+        lane.reduce(("p", 1, 0, 0), jnp.zeros((1, 4, 4)))
+    slots, res, _cv = rdv
+    assert not slots and not res, "rendezvous state leaked after timeout"
