@@ -569,6 +569,52 @@ def test_dist_wave_collective_lane_dpotrf_matches(nb_ranks=4):
         sum(s["tiles_sent"] for s in st_tree), (st_lane, st_tree)
 
 
+def test_dist_wave_collective_lane_ragged_dpotrf(nb_ranks=4):
+    """The lane over SHAPE-SPLIT pools: a ragged tiling (N % nb != 0)
+    splits descA into multiple pools with distinct tile shapes; each
+    (wave, pool) broadcast group gets its own collective call with its
+    own shapes. Differential vs the tree path on the same ragged
+    input."""
+    from parsec_tpu.utils.params import params
+
+    n, nb = 232, 32          # NT=8, last tile 8 rows: 4 shape pools
+    M = make_spd(n, dtype=np.float64)
+
+    def run(lane_on):
+        def rank_fn(r, f):
+            ce = f.engine(r)
+            coll = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float64,
+                                     P=nb_ranks, Q=1, nodes=nb_ranks,
+                                     rank=r)
+            coll.name = "descA"
+            coll.from_numpy(M.copy())
+            tp = dpotrf_taskpool(coll, rank=r, nb_ranks=nb_ranks)
+            w = ptg.wave(tp, comm=ce)
+            w.run()
+            return w.stats, _gather_owned(coll, rank=r)
+
+        if lane_on:
+            params.set_cmdline("wave_dist_collective", "on")
+        try:
+            results, _ = spmd(nb_ranks, rank_fn, timeout=180)
+        finally:
+            if lane_on:
+                params.unset_cmdline("wave_dist_collective")
+        L = np.zeros((n, n))
+        for (_st, owned) in results:
+            for (m, k), t in owned.items():
+                L[m * nb:m * nb + t.shape[0],
+                  k * nb:k * nb + t.shape[1]] = t
+        return np.tril(L), [st for (st, _o) in results]
+
+    L_tree, _ = run(False)
+    L_lane, st_lane = run(True)
+    ref = np.linalg.cholesky(M)
+    np.testing.assert_allclose(L_tree, ref, rtol=0, atol=1e-8 * n)
+    np.testing.assert_allclose(L_lane, L_tree, rtol=0, atol=0)
+    assert sum(s["collective_calls"] for s in st_lane) > 0, st_lane
+
+
 def test_dist_wave_bcast_chain_root_sends_once(nb_ranks=4):
     """Chain topology: the root ships each broadcast tile exactly ONCE
     regardless of reader count (O(1) in P), the chain re-forwards."""

@@ -18,6 +18,10 @@ Env:   NORTHSTAR_SHARDING=hybrid  -> each rank's pools shard over its
        own sub-mesh of the virtual devices (process x mesh GSPMD);
        needs ranks * submesh <= device count.
        NORTHSTAR_BCAST=binomial|chain|star (default binomial).
+       NORTHSTAR_COLLECTIVE=on -> full broadcasts ride the compiled
+       collective lane (wave_dist_collective; in-process substrate).
+       NORTHSTAR_GRID=PxQ -> override the process grid (default: most
+       square). P=ranks,Q=1 makes every panel a full broadcast.
 
 Self-relaunches with a CPU-pinned env (8 virtual devices) when invoked
 under the TPU plugin. Prints one JSON line with the full report.
@@ -72,6 +76,8 @@ def main() -> int:
     sharding = os.environ.get("NORTHSTAR_SHARDING", "")
     bcast = os.environ.get("NORTHSTAR_BCAST", "binomial")
     params.set_cmdline("wave_dist_bcast", bcast)
+    if os.environ.get("NORTHSTAR_COLLECTIVE") == "on":
+        params.set_cmdline("wave_dist_collective", "on")
 
     def log(msg):
         print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
@@ -98,7 +104,13 @@ def main() -> int:
     assert hit is dag, "lowering cache missed on identical shape"
 
     fabric = LocalFabric(ranks)
-    P = max(p for p in range(1, int(ranks ** 0.5) + 1) if ranks % p == 0)
+    grid = os.environ.get("NORTHSTAR_GRID")
+    if grid:
+        P = int(grid.lower().split("x")[0])
+        assert ranks % P == 0, f"grid {grid} does not divide {ranks} ranks"
+    else:
+        P = max(p for p in range(1, int(ranks ** 0.5) + 1)
+                if ranks % p == 0)
     results = [None] * ranks
     errors = [None] * ranks
     barrier = threading.Barrier(ranks)
@@ -187,6 +199,11 @@ def main() -> int:
         "tiles_recv": sum(s["tiles_recv"] for s in stats),
         "tiles_forwarded": sum(s["tiles_forwarded"] for s in stats),
         "bcast_topology": stats[0]["bcast_topology"],
+        "collective_lane": stats[0].get("collective_lane"),
+        "collective_calls": sum(s.get("collective_calls", 0)
+                                for s in stats),
+        "collective_tiles": sum(s.get("collective_tiles", 0)
+                                for s in stats),
         "peak_rss_mb": round(resource.getrusage(
             resource.RUSAGE_SELF).ru_maxrss / 1024.0, 1),
     }
