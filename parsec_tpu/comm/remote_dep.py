@@ -77,12 +77,15 @@ class RemoteDepEngine:
         # rendezvous bookkeeping: handle_id -> (taskpool, remaining, handle)
         self._pending_handles: Dict[int, Tuple] = {}
         self._pending_xfers: Dict[int, Any] = {}  # uuid -> (tp, dst_rank)
-        # memory writebacks buffered until the taskpool's startup has
-        # credited the expected arrivals as pending actions (delivering
-        # sooner would drive runtime_actions negative):
-        # wire_id -> [(src, msg), ...]; ready ids in _mem_ready
+        # inbound traffic buffered until the taskpool's startup has
+        # credited its task/action counts (delivering sooner would drive
+        # runtime_actions negative — or, for activations, let a fast
+        # remote-released task COMPLETE and decrement nb_tasks before
+        # set_nb_tasks runs, which either trips the >=0 assertion or is
+        # silently overwritten into a hang):
+        # wire_id -> [(src, msg), ...]; ready ids in _counts_ready
         self._early_mem_puts: Dict[int, List[Tuple[int, Dict]]] = {}
-        self._mem_ready: set = set()
+        self._counts_ready: set = set()
         # activations that raced ahead of our local taskpool registration
         # (a faster rank can start pool N+1 while we are still in pool
         # N's wait; the reference holds such activations until the
@@ -129,11 +132,10 @@ class RemoteDepEngine:
             wire_id = len(self._taskpools)
             self._taskpools[wire_id] = tp
             tp.comm_tp_id = wire_id
-            early = self._early_activations.pop(wire_id, [])
         if hasattr(tp, "comm"):
             tp.comm = self
-        for src, msg in early:
-            self._on_activate(src, msg)
+        # early activations stay buffered: they deliver in counts_ready(),
+        # once startup has credited nb_tasks (see _on_activate)
 
     def progress(self, es) -> int:
         return self.ce.progress()
@@ -215,9 +217,11 @@ class RemoteDepEngine:
         self.stats["activates_recv"] += 1
         with self._lock:
             tp = self._taskpools.get(msg["tp_id"])
-            if tp is None:
-                # raced ahead of our registration: hold until the SPMD
-                # program reaches this taskpool locally
+            if tp is None or msg["tp_id"] not in self._counts_ready:
+                # raced ahead of our registration OR of startup's
+                # set_nb_tasks: hold until counts_ready(), else a fast
+                # remote-released task could complete and decrement
+                # nb_tasks before the total is credited
                 self._early_activations.setdefault(
                     msg["tp_id"], []).append((src, msg))
                 return
@@ -373,20 +377,23 @@ class RemoteDepEngine:
                          "data": None if arr is None else np.asarray(arr)})
         self.stats["mem_puts_sent"] += 1
 
-    def mem_puts_ready(self, tp) -> None:
-        """The taskpool counted its expected incoming writebacks (its
-        startup ran add_pending_action): deliver buffered puts and stop
-        buffering for this pool."""
+    def counts_ready(self, tp) -> None:
+        """The taskpool's startup credited its counts (set_nb_tasks ran
+        and expected writebacks are pending actions): deliver buffered
+        activations and memory puts, stop buffering for this pool."""
         with self._lock:
-            self._mem_ready.add(tp.comm_tp_id)
-            held = self._early_mem_puts.pop(tp.comm_tp_id, [])
-        for src, msg in held:
+            self._counts_ready.add(tp.comm_tp_id)
+            held_act = self._early_activations.pop(tp.comm_tp_id, [])
+            held_put = self._early_mem_puts.pop(tp.comm_tp_id, [])
+        for src, msg in held_act:
+            self._on_activate(src, msg)
+        for src, msg in held_put:
             self._on_mem_put(src, msg)
 
     def _on_mem_put(self, src: int, msg: Dict) -> None:
         with self._lock:
             tp = self._taskpools.get(msg["tp_id"])
-            if tp is None or msg["tp_id"] not in self._mem_ready:
+            if tp is None or msg["tp_id"] not in self._counts_ready:
                 self._early_mem_puts.setdefault(
                     msg["tp_id"], []).append((src, msg))
                 return

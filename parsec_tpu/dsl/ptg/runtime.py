@@ -661,13 +661,17 @@ class PTGTaskpool(Taskpool):
                 total += 1
                 if tc.goal_of(locals_, env) == 0:
                     startup.append(tc.make_task(locals_, None))
+        # counts FIRST, delivery second: activations/puts released by
+        # counts_ready may schedule tasks that complete on a worker
+        # thread immediately — nb_tasks must already hold the total or
+        # the decrement goes negative (or is overwritten into a hang)
+        self.nb_local_tasks = total
+        self.set_nb_tasks(total)
         if expected_mem_puts:
             self.add_pending_action(expected_mem_puts)
         if count_foreign:
             # expectations credited: buffered early arrivals may deliver
-            self.comm.mem_puts_ready(self)
-        self.nb_local_tasks = total
-        self.set_nb_tasks(total)
+            self.comm.counts_ready(self)
         plog.debug.verbose(4, "ptg %s: %d local tasks, %d startup",
                            self.name, total, len(startup))
         return startup

@@ -101,6 +101,23 @@ def _is_single_device(arr) -> bool:
         return False
 
 
+def _lane_local_devices(nb_ranks: int):
+    """Device pool for the in-process lane: the default platform's local
+    devices when it can seat one per rank, else the virtual CPU mesh.
+    An accelerator plugin that force-prepends itself (the tunnel's axon
+    platform exposes ONE chip) must not hide the 8-device CPU substrate
+    the SPMD tests and the driver's dryrun run on."""
+    import jax
+
+    devs = jax.local_devices()
+    if len(devs) < nb_ranks:
+        try:
+            devs = jax.devices("cpu")
+        except RuntimeError:
+            pass
+    return devs
+
+
 class _CollectiveLane:
     """ONE compiled XLA collective per broadcast group instead of P
     descriptor sends (SURVEY §5.8's TPU-native target; the reference's
@@ -138,7 +155,7 @@ class _CollectiveLane:
             devs = [by_proc[p] for p in sorted(by_proc)]
             self.device = by_proc[jax.process_index()]
         else:
-            devs = jax.local_devices()[:nb_ranks]
+            devs = _lane_local_devices(nb_ranks)[:nb_ranks]
             self.device = devs[rank]
         self.mesh = Mesh(np.array(devs), ("r",))
         self._in_sh = NamedSharding(self.mesh, PartitionSpec("r"))
@@ -304,7 +321,7 @@ class DistWaveRunner(WaveRunner):
                     "multiproc", self.nb_ranks, self.rank,
                     timeout=self.comm_timeout)
             elif mode == "on" and jax.process_count() == 1 and \
-                    len(jax.local_devices()) >= self.nb_ranks:
+                    len(_lane_local_devices(self.nb_ranks)) >= self.nb_ranks:
                 fab = getattr(self.ce, "fabric", None) or self.ce
                 with _LANE_RDV_LOCK:   # SPMD threads race the attach
                     rdv = getattr(fab, "_lane_rdv", None)

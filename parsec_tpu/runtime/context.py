@@ -93,6 +93,26 @@ class Context:
         self.rank = rank
         self.nb_ranks = nb_ranks
         self.comm = comm                       # comm engine / remote-dep driver
+        # synchronization state BEFORE comm binding: attach() installs an
+        # arrival callback that a peer's thread may fire immediately
+        # (in-process fabrics deliver synchronously from the sender) —
+        # wake_workers / record_task_error must find these initialized
+        self._work_cond = threading.Condition()     # idle park/wake
+        # taskpool bookkeeping
+        self.taskpools: Dict[int, Taskpool] = {}
+        self._task_errors: List[BaseException] = []
+        self._active_taskpools = 0
+        self._tp_lock = threading.Lock()
+        # deferred work: callbacks that must run on a scheduler thread with
+        # a live execution stream (e.g. completing a generator task when its
+        # nested taskpool terminates — the detection fires on an arbitrary
+        # thread; ref: HOOK_RETURN_ASYNC re-entry, scheduling.c:503-506)
+        self._deferred: "deque" = deque()
+        # native dispatch loops (turbo static PTG): queued by _startup,
+        # claimed by ONE worker from the wait loop
+        self._native_loops: List[Any] = []
+        self._started = False
+        self._finalized = False
         # comm binding first: it defines our rank, which profiling and
         # device setup label their output with
         # (ref: parsec_remote_dep_init parsec.c:796)
@@ -177,26 +197,6 @@ class Context:
                 plog.warning("sde_push disabled: %s", e)
         plog.debug.verbose(3, "context: %d threads, %d vps, %d devices, sched=%s",
                            self.nb_cores, len(self.vps), len(self.devices), name)
-
-        # deferred work: callbacks that must run on a scheduler thread with
-        # a live execution stream (e.g. completing a generator task when its
-        # nested taskpool terminates — the detection fires on an arbitrary
-        # thread; ref: HOOK_RETURN_ASYNC re-entry, scheduling.c:503-506)
-        self._deferred: "deque" = deque()
-
-        # taskpool bookkeeping
-        self.taskpools: Dict[int, Taskpool] = {}
-        self._task_errors: List[BaseException] = []
-        self._active_taskpools = 0
-        self._tp_lock = threading.Lock()
-        # native dispatch loops (turbo static PTG): queued by _startup,
-        # claimed by ONE worker from the wait loop
-        self._native_loops: List[Any] = []
-        self._started = False
-        self._finalized = False
-
-        # idle park/wake
-        self._work_cond = threading.Condition()
 
         # worker threads (all but stream 0, which the caller's thread drives)
         self._start_gen = 0
@@ -319,7 +319,11 @@ class Context:
                               f"{exc!r}")
             plog.warning("%s", debug_history.history.dump(limit=64))
         self._task_errors.append(exc)
-        self.wake_workers(self.nb_cores)
+        # no count argument: nb_cores is not yet set when a transport
+        # thread reports a dead peer during comm.attach() in __init__
+        # (the same init-race window as the arrival wakeup fix), and
+        # wake_workers notifies every parked worker regardless
+        self.wake_workers()
 
     def raise_pending_error(self) -> None:
         if self._task_errors:

@@ -358,3 +358,62 @@ def test_dead_consumer_parks_reclaimed():
     # idempotent: a second failure report finds nothing
     eng._release_parks_for(1)
     assert tp.pending == 1
+
+
+def test_activation_gated_until_counts_ready():
+    """An activation that lands after taskpool registration but BEFORE
+    startup credits nb_tasks must stay buffered: delivering it early can
+    release — and complete — a task while nb_tasks is still 0, tripping
+    the termdet >=0 assertion or overwriting the decrement into a hang
+    (the full-suite all2all flake, round 5). Delivery happens only at
+    counts_ready()."""
+    from parsec_tpu.comm.engine import TAG_ACTIVATE
+
+    fabric = LocalFabric(2)
+    e0 = RemoteDepEngine(fabric.engine(0))
+    e1 = RemoteDepEngine(fabric.engine(1))
+
+    class StubTP:
+        pass
+
+    tp = StubTP()
+    e1.taskpool_register(tp)           # registered, counts NOT credited
+    msg = {"tp_id": tp.comm_tp_id, "root": 0, "ranks": [1],
+           "edges": {1: []}, "src_task": None, "dtt": None, "data": None}
+    e0.ce.send_am(1, TAG_ACTIVATE, msg)
+    e1.progress(None)                  # handler must buffer, not deliver
+    assert list(e1._early_activations) == [tp.comm_tp_id]
+
+    delivered = []
+    e1._on_activate = lambda src, m: delivered.append(m)
+    e1.counts_ready(tp)
+    assert [m["tp_id"] for m in delivered] == [tp.comm_tp_id]
+    assert not e1._early_activations
+
+
+def test_arrival_wakeup_during_context_init():
+    """A peer's message can land the instant attach() installs the
+    arrival callback — while Context.__init__ is still running (the
+    LocalFabric fires on_arrival from the SENDER's thread). The wakeup
+    must find the park/wake state already initialized (round-5 fix:
+    AttributeError '_work_cond' surfacing as a task-body failure)."""
+    import types
+
+    import parsec_tpu
+
+    fabric = LocalFabric(1)
+    eng = RemoteDepEngine(fabric.engine(0))
+    orig_attach = RemoteDepEngine.attach
+    fired = []
+
+    def attach_and_fire(self, context):
+        orig_attach(self, context)
+        self.ce._notify_arrival()      # simulated racing arrival
+        fired.append(True)
+
+    eng.attach = types.MethodType(attach_and_fire, eng)
+    ctx = parsec_tpu.Context(nb_cores=1, comm=eng, enable_tpu=False)
+    try:
+        assert fired
+    finally:
+        ctx.fini()
