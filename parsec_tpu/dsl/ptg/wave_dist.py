@@ -123,26 +123,32 @@ class _CollectiveLane:
     descriptor sends (SURVEY §5.8's TPU-native target; the reference's
     dynamic trees are /root/reference/parsec/remote_dep.c:272-358).
 
-    A full-broadcast tile group becomes a single all-reduce over a mesh
-    with one device per rank: every rank contributes a stacked array
-    that is ZERO except at rows it sources, so the sum over the rank
-    axis IS the broadcast — XLA compiles the data movement (psum over
-    ICI on real hardware), no per-destination messages at all.
+    A broadcast tile group becomes a single all-reduce over a mesh with
+    one device per participating rank: every participant contributes a
+    stacked array that is ZERO except at rows it sources, so the sum
+    over the rank axis IS the broadcast — XLA compiles the data
+    movement (psum over ICI on real hardware), no per-destination
+    messages at all.
 
     Substrates:
     - multi-process (launcher --jax-distributed): every rank holds one
       shard of a global array and calls the same jitted reduction —
-      multi-controller SPMD, XLA's distributed runtime moves the bytes;
+      multi-controller SPMD, XLA's distributed runtime moves the bytes.
+      Only FULL broadcasts ride this mode: a multi-controller
+      computation needs every process in the call.
     - in-process (SPMD rank threads in one process, >= nb_ranks local
-      devices): ranks deposit their shard at a rendezvous keyed by
-      (pool, epoch, wave, cid); the LAST depositor issues the one
-      multi-device call and everyone picks the replicated result up.
+      devices): participants deposit their shard at a rendezvous keyed
+      by (pool, epoch, wave, cid, members); the LAST depositor issues
+      the one multi-device call and everyone picks the replicated
+      result up. PARTIAL groups (``members`` = any >= 3 ranks, e.g. a
+      2D block-cyclic panel's column readers) reduce over a sub-mesh of
+      just the member devices — the common case for P x Q
+      distributions, where no tile is read by ALL other ranks.
     """
 
     def __init__(self, mode: str, nb_ranks: int, rank: int,
                  rendezvous=None, timeout: float = 120.0) -> None:
         import jax
-        from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
         self.mode = mode
         self.nb_ranks = nb_ranks
@@ -157,45 +163,71 @@ class _CollectiveLane:
         else:
             devs = _lane_local_devices(nb_ranks)[:nb_ranks]
             self.device = devs[rank]
-        self.mesh = Mesh(np.array(devs), ("r",))
-        self._in_sh = NamedSharding(self.mesh, PartitionSpec("r"))
-        self._out_sh = NamedSharding(self.mesh, PartitionSpec())
-        # jax.jit specializes per input shape/dtype internally — one
-        # wrapper covers every pool/pad bucket
-        self._sum = jax.jit(lambda g: g.sum(axis=0),
-                            out_shardings=self._out_sh)
+        self.devs = devs                     # rank -> lane device
         self._rdv = rendezvous   # shared dict+condvar for in-process
+        # (members tuple) -> (in_sh, sum_fn) over the member-device
+        # (sub-)mesh; jax.jit specializes per input shape/dtype
+        # internally, so one wrapper covers every pool/pad bucket
+        self._group_sh: Dict[Tuple[int, ...], Tuple] = {}
+        # the full-mesh entry doubles as the fast path in reduce();
+        # _sum stays an attribute so tests can fault-inject the issuer
+        self._in_sh, self._sum = self._group_sharding(
+            tuple(range(nb_ranks)))
 
-    def reduce(self, key: Tuple, contrib) -> Any:
+    def _group_sharding(self, members: Tuple[int, ...]) -> Tuple:
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        ent = self._group_sh.get(members)
+        if ent is None:
+            mesh = Mesh(np.array([self.devs[r] for r in members]), ("r",))
+            in_sh = NamedSharding(mesh, PartitionSpec("r"))
+            out_sh = NamedSharding(mesh, PartitionSpec())
+            fn = jax.jit(lambda g: g.sum(axis=0), out_shardings=out_sh)
+            ent = (in_sh, fn)
+            self._group_sh[members] = ent
+        return ent
+
+    def reduce(self, key: Tuple, contrib,
+               members: Optional[Tuple[int, ...]] = None) -> Any:
         """All-reduce one padded contribution stack; returns the
-        replicated result's shard on this rank's lane device."""
+        replicated result's shard on this rank's lane device.
+
+        ``members``: sorted tuple of participating ranks for a PARTIAL
+        group (in-process substrate only — a multi-controller
+        computation needs every process); None = all ranks."""
         import jax
 
-        # each rank's deposit is its slice of the [ranks, ...] global
-        # array: shard shape carries the leading rank axis
+        full = members is None or len(members) == self.nb_ranks
+        parts = tuple(range(self.nb_ranks)) if full else members
+        in_sh, sum_fn = ((self._in_sh, self._sum) if full
+                         else self._group_sharding(parts))
+        # each rank's deposit is its slice of the [participants, ...]
+        # global array: shard shape carries the leading rank axis
         contrib = jax.device_put(contrib[None], self.device)
-        gshape = (self.nb_ranks,) + tuple(contrib.shape[1:])
+        gshape = (len(parts),) + tuple(contrib.shape[1:])
         if self.mode == "multiproc":
+            assert full, "multiproc lane schedules full broadcasts only"
             garr = jax.make_array_from_single_device_arrays(
-                gshape, self._in_sh, [contrib])
-            out = self._sum(garr)
+                gshape, in_sh, [contrib])
+            out = sum_fn(garr)
             return next(s.data for s in out.addressable_shards
                         if s.device == self.device)
         # in-process rendezvous: last depositor issues the single call
+        key = key + (parts,)
         slots, results, cv = self._rdv
         with cv:
             mine = slots.setdefault(key, {})
             mine[self.rank] = contrib
-            if len(mine) == self.nb_ranks:
+            if len(mine) == len(parts):
                 try:
                     garr = jax.make_array_from_single_device_arrays(
-                        gshape, self._in_sh,
-                        [mine[r] for r in range(self.nb_ranks)])
-                    results[key] = [self._sum(garr), self.nb_ranks]
+                        gshape, in_sh, [mine[r] for r in parts])
+                    results[key] = [sum_fn(garr), len(parts)]
                 except BaseException:
                     # peers-only refcount: the issuer re-raises and
                     # never reaches the pickup decrement below
-                    results[key] = [None, self.nb_ranks - 1]
+                    results[key] = [None, len(parts) - 1]
                     raise
                 finally:
                     del slots[key]
@@ -504,17 +536,27 @@ class DistWaveRunner(WaveRunner):
         for (w, src, dst, cid, idx) in transfers:
             grouped.setdefault((w, src, cid, idx), []).append(dst)
         edges: Set[Tuple[int, int, int, int, int, int]] = set()
-        # lane_sched[wave][cid] -> sorted [(idx, src)]: full broadcasts
-        # ride ONE compiled collective instead of a descriptor tree
-        lane_sched: Dict[int, Dict[int, List[Tuple[int, int]]]] = {}
+        # lane_sched[wave][(cid, members)] -> sorted [(idx, src)]:
+        # broadcast groups ride ONE compiled collective per (wave, pool,
+        # member set) instead of a descriptor tree. members is the
+        # sorted participant tuple ({src} | dsts) — identical on every
+        # rank, so the rendezvous and the reduce order agree globally.
+        lane_sched: Dict[int, Dict[Tuple[int, Tuple[int, ...]],
+                                   List[Tuple[int, int]]]] = {}
         for (w, src, cid, idx), dsts in grouped.items():
             dsts = sorted(set(dsts))
-            # full broadcasts only, and never for a single destination
-            # (a 1-dst all-reduce over the whole mesh loses to one send)
+            # never for a single destination (a 1-dst collective loses
+            # to one send). Full broadcasts ride either substrate;
+            # PARTIAL groups (>= 2 dsts but not all ranks — the 2D
+            # block-cyclic panel case) only the in-process sub-mesh
+            # substrate: a multi-controller computation needs every
+            # process in the call.
             if self._lane is not None and len(dsts) >= 2 \
-                    and len(dsts) == self.nb_ranks - 1:
+                    and (len(dsts) == self.nb_ranks - 1
+                         or self._lane.mode == "inproc"):
+                members = tuple(sorted({src, *dsts}))
                 lane_sched.setdefault(w, {}).setdefault(
-                    cid, []).append((idx, src))
+                    (cid, members), []).append((idx, src))
                 continue
             if topo == "star" or len(dsts) == 1:
                 for d in dsts:
@@ -584,10 +626,11 @@ class DistWaveRunner(WaveRunner):
         for (w, src, dst, cid, idx) in self._transfers:
             if src == self.rank or dst == self.rank:
                 touched[cid].add(idx)
-        for by_cid in self._lane_sched.values():
-            # lane tiles: every rank is an endpoint (full broadcast)
-            for cid, entries in by_cid.items():
-                touched[cid].update(i for (i, _s) in entries)
+        for by_grp in self._lane_sched.values():
+            # lane tiles: every group MEMBER is an endpoint
+            for (cid, members), entries in by_grp.items():
+                if self.rank in members:
+                    touched[cid].update(i for (i, _s) in entries)
         self._l2g = [np.asarray(sorted(s), np.int32) for s in touched]
         g2l = []
         for c in range(n_pools):
@@ -743,12 +786,14 @@ class DistWaveRunner(WaveRunner):
         return pools
 
     def _lane_step(self, w: int, pools: Tuple) -> Tuple:
-        """Execute this wave's full-broadcast groups as ONE compiled
-        collective per (wave, pool): gather my sourced rows into a
-        zero-padded contribution stack, all-reduce over the lane mesh
-        (sum == broadcast), scatter the replicated result into my
-        staged pool rows. Counts ride stats as collective_calls /
-        collective_tiles; none of these tiles appear in _sends."""
+        """Execute this wave's broadcast groups as ONE compiled
+        collective per (wave, pool, member set): gather my sourced rows
+        into a zero-padded contribution stack, all-reduce over the
+        group's lane (sub-)mesh (sum == broadcast), scatter the
+        replicated result into my staged pool rows. Groups this rank is
+        not a member of are skipped — their members rendezvous without
+        us. Counts ride stats as collective_calls / collective_tiles;
+        none of these tiles appear in _sends."""
         sched = self._lane_sched.get(w)
         if not sched:
             return pools
@@ -757,8 +802,12 @@ class DistWaveRunner(WaveRunner):
 
         pool_name, epoch = self._cur
         plist = list(pools)
-        for cid in sorted(sched):
-            entries = sched[cid]
+        # sorted keys: every rank walks its shared groups in the same
+        # global order, so the blocking rendezvous can never cycle
+        for cid, members in sorted(sched):
+            if self.rank not in members:
+                continue
+            entries = sched[(cid, members)]
             idxs = np.asarray([i for (i, _s) in entries], np.int32)
             srcs = np.asarray([s for (_i, s) in entries], np.int32)
             n = len(entries)
@@ -777,7 +826,8 @@ class DistWaveRunner(WaveRunner):
                     rows = np.asarray(rows)   # sharded pools: host hop
                 contrib = contrib.at[np.asarray(mine, np.int32)].set(
                     jax.device_put(rows, self._lane.device))
-            out = self._lane.reduce((pool_name, epoch, w, cid), contrib)
+            out = self._lane.reduce((pool_name, epoch, w, cid), contrib,
+                                    members=members)
             vals = out[:n]
             if _is_single_device(plist[cid]):
                 dev = next(iter(plist[cid].devices()))

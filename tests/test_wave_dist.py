@@ -615,6 +615,63 @@ def test_dist_wave_collective_lane_ragged_dpotrf(nb_ranks=4):
     assert sum(s["collective_calls"] for s in st_lane) > 0, st_lane
 
 
+def test_dist_wave_collective_lane_partial_groups(nb_ranks=4):
+    """PARTIAL broadcast groups on a 2D block-cyclic distribution: at
+    P=2 x Q=2 a dpotrf panel tile is read by a row/column SUBSET of
+    ranks, never by all three others — the round-5 full-broadcast-only
+    lane scheduled NOTHING here (northstar at 2x4 recorded
+    collective_calls=0). Groups of >= 3 members must now reduce over a
+    member-device sub-mesh; the remaining 1-dst edges stay p2p.
+    Differential vs the tree path on the same input."""
+    from parsec_tpu.utils.params import params
+
+    n, nb = 256, 32
+    M = make_spd(n, dtype=np.float64)
+    P = 2
+
+    def run(lane_on):
+        def rank_fn(r, f):
+            ce = f.engine(r)
+            coll = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float64,
+                                     P=P, Q=nb_ranks // P,
+                                     nodes=nb_ranks, rank=r)
+            coll.name = "descA"
+            coll.from_numpy(M.copy())
+            tp = dpotrf_taskpool(coll, rank=r, nb_ranks=nb_ranks)
+            w = ptg.wave(tp, comm=ce)
+            if lane_on:
+                # the member sets really are partial: no group spans
+                # every rank on this distribution
+                groups = {m for by_g in w._lane_sched.values()
+                          for (_c, m) in by_g}
+                assert groups, "no lane groups scheduled at P=2xQ=2"
+                assert all(len(m) < nb_ranks for m in groups), groups
+            w.run()
+            return w.stats, _gather_owned(coll, rank=r)
+
+        if lane_on:
+            params.set_cmdline("wave_dist_collective", "on")
+        try:
+            results, _ = spmd(nb_ranks, rank_fn, timeout=180)
+        finally:
+            if lane_on:
+                params.unset_cmdline("wave_dist_collective")
+        L = np.zeros((n, n))
+        for (_st, owned) in results:
+            for (m, k), t in owned.items():
+                L[m * nb:(m + 1) * nb, k * nb:(k + 1) * nb] = t
+        return np.tril(L), [st for (st, _o) in results]
+
+    L_tree, st_tree = run(False)
+    L_lane, st_lane = run(True)
+    ref = np.linalg.cholesky(M)
+    np.testing.assert_allclose(L_tree, ref, rtol=0, atol=1e-8 * n)
+    np.testing.assert_allclose(L_lane, L_tree, rtol=0, atol=0)
+    assert sum(s["collective_calls"] for s in st_lane) > 0, st_lane
+    assert sum(s["tiles_sent"] for s in st_lane) < \
+        sum(s["tiles_sent"] for s in st_tree), (st_lane, st_tree)
+
+
 def test_dist_wave_bcast_chain_root_sends_once(nb_ranks=4):
     """Chain topology: the root ships each broadcast tile exactly ONCE
     regardless of reader count (O(1) in P), the chain re-forwards."""
