@@ -543,21 +543,34 @@ class DistWaveRunner(WaveRunner):
         # rank, so the rendezvous and the reduce order agree globally.
         lane_sched: Dict[int, Dict[Tuple[int, Tuple[int, ...]],
                                    List[Tuple[int, int]]]] = {}
+        # multiproc partial groups synchronize EVERY process on the
+        # global mesh; below this member share the |dsts| p2p sends are
+        # cheaper than a full-mesh barrier + O(nb_ranks x tile) traffic
+        # (an SPMD-consistent pure function of the static schedule +
+        # params, so all ranks agree). In-process sub-mesh groups cost
+        # only their members and take no threshold.
+        min_pct = int(params.get_or(
+            "wave_dist_collective_min_pct", "int", 50))
         for (w, src, cid, idx), dsts in grouped.items():
             dsts = sorted(set(dsts))
             # never for a single destination (a 1-dst collective loses
-            # to one send). Full broadcasts ride either substrate;
-            # PARTIAL groups (>= 2 dsts but not all ranks — the 2D
-            # block-cyclic panel case) only the in-process sub-mesh
-            # substrate: a multi-controller computation needs every
-            # process in the call.
-            if self._lane is not None and len(dsts) >= 2 \
-                    and (len(dsts) == self.nb_ranks - 1
-                         or self._lane.mode == "inproc"):
+            # to one send). PARTIAL groups (>= 2 dsts but not all ranks
+            # — the 2D block-cyclic panel case) ride both substrates:
+            # in-process reduces over a sub-mesh of just the member
+            # devices; multiproc keeps the global mesh — a
+            # multi-controller computation needs every process in the
+            # call, so non-members join with zero contributions and
+            # discard the result (_lane_step).
+            if self._lane is not None and len(dsts) >= 2:
                 members = tuple(sorted({src, *dsts}))
-                lane_sched.setdefault(w, {}).setdefault(
-                    (cid, members), []).append((idx, src))
-                continue
+                if (self._lane.mode == "multiproc"
+                        and len(dsts) < self.nb_ranks - 1
+                        and len(members) * 100 < self.nb_ranks * min_pct):
+                    pass   # small group on a big mesh: trees win
+                else:
+                    lane_sched.setdefault(w, {}).setdefault(
+                        (cid, members), []).append((idx, src))
+                    continue
             if topo == "star" or len(dsts) == 1:
                 for d in dsts:
                     edges.add((w, src, d, cid, idx, 0))
@@ -802,32 +815,53 @@ class DistWaveRunner(WaveRunner):
 
         pool_name, epoch = self._cur
         plist = list(pools)
+        multiproc = self._lane.mode == "multiproc"
         # sorted keys: every rank walks its shared groups in the same
-        # global order, so the blocking rendezvous can never cycle
+        # global order, so the blocking rendezvous can never cycle —
+        # and on multiproc every PROCESS issues the same global calls
+        # in the same order, which multi-controller XLA requires
         for cid, members in sorted(sched):
-            if self.rank not in members:
-                continue
+            member = self.rank in members
+            if not member and not multiproc:
+                continue   # in-process: their rendezvous excludes us
             entries = sched[(cid, members)]
             idxs = np.asarray([i for (i, _s) in entries], np.int32)
             srcs = np.asarray([s for (_i, s) in entries], np.int32)
             n = len(entries)
             npad = 1 << max(0, (n - 1).bit_length())   # bucket compiles
             shape, _dt = self._pool_tile_spec(cid)
-            # dtype from the STAGED pool, not the collection spec: with
-            # x64 off an f64 collection stages f32 device pools
-            dt = (plist[cid].dtype if hasattr(plist[cid], "dtype")
-                  else _dt)
-            lidx = self._g2l[cid][idxs]
-            mine = np.nonzero(srcs == self.rank)[0]
+            if multiproc:
+                # the dtype must be an SPMD-consistent pure function of
+                # the spec: a non-member process whose sliced pool is
+                # the (0,) float32 placeholder would otherwise compile
+                # a different-width program for the SAME global
+                # collective. canonicalize applies the x64 downcast
+                # rule build_pools' staging applies.
+                dt = jax.dtypes.canonicalize_dtype(_dt)
+            else:
+                # dtype from the STAGED pool, not the collection spec:
+                # with x64 off an f64 collection stages f32 pools
+                dt = (plist[cid].dtype if hasattr(plist[cid], "dtype")
+                      else _dt)
+            mine = (np.nonzero(srcs == self.rank)[0] if member
+                    else np.empty(0, np.intp))
             contrib = jnp.zeros((npad,) + tuple(shape), dt)
             if len(mine):
+                lidx = self._g2l[cid][idxs]
                 rows = plist[cid][lidx[mine]]
                 if not _is_single_device(rows):
                     rows = np.asarray(rows)   # sharded pools: host hop
                 contrib = contrib.at[np.asarray(mine, np.int32)].set(
                     jax.device_put(rows, self._lane.device))
-            out = self._lane.reduce((pool_name, epoch, w, cid), contrib,
-                                    members=members)
+            out = self._lane.reduce(
+                (pool_name, epoch, w, cid), contrib,
+                # multiproc: the global mesh — non-members contributed
+                # zeros and drop the result below
+                members=None if multiproc else members)
+            self._lane_calls += 1
+            if not member:
+                continue   # joined the SPMD call; nothing staged here
+            lidx = self._g2l[cid][idxs]
             vals = out[:n]
             if _is_single_device(plist[cid]):
                 dev = next(iter(plist[cid].devices()))
@@ -835,7 +869,6 @@ class DistWaveRunner(WaveRunner):
             else:
                 vals = np.asarray(vals)       # sharded pools
             plist[cid] = self._scatter_kernel(n)(plist[cid], lidx, vals)
-            self._lane_calls += 1
             self._lane_tiles += n
         return tuple(plist)
 
