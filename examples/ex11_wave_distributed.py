@@ -59,9 +59,10 @@ def main(n: int = 512, nb: int = 64) -> int:
         lane = ""
         st = getattr(w, "stats", None)
         if nb_ranks > 1 and st and st.get("collective_lane"):
-            # under launch.py --jax-distributed, full panel broadcasts
-            # ride ONE compiled all-reduce per (wave, pool) instead of
-            # per-destination sends (wave_dist_collective)
+            # under launch.py --jax-distributed, panel broadcasts (full
+            # AND partial reader groups) ride ONE compiled all-reduce
+            # per (wave, pool, member set) instead of per-destination
+            # sends (wave_dist_collective)
             lane = (f", lane[{st['collective_lane']}]: "
                     f"{st['collective_calls']} collectives carried "
                     f"{st['collective_tiles']} tiles "
