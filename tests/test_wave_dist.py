@@ -522,112 +522,17 @@ def test_dist_wave_collective_lane_bcast(nb_ranks=4):
     assert sum(s["tiles_recv"] for s in lane) == 0, lane
 
 
-def test_dist_wave_collective_lane_dpotrf_matches(nb_ranks=4):
-    """dpotrf on a 4-rank row-cyclic distribution: every POTRF/TRSM
-    panel tile is read by all other ranks, so the lane carries the
-    panel broadcasts. Differential vs the tree path on the same input:
-    identical factor, and the lane replaces a nonzero share of sends."""
+def _lane_differential(nb_ranks, n, nb, P, check_runner=None):
+    """Shared scaffold for the lane differential tests: run dist-wave
+    dpotrf twice on the same SPD input — trees, then the compiled
+    collective lane — and assert the tree factor matches numpy
+    cholesky, the lane factor is bit-identical to the trees, the lane
+    fired, and it displaced p2p sends. Tile assembly is shape-aware so
+    ragged (shape-split) tilings ride the same helper. Returns
+    (st_tree, st_lane) for per-test extra asserts."""
     from parsec_tpu.utils.params import params
 
-    n, nb = 256, 32
     M = make_spd(n, dtype=np.float64)
-
-    def run(lane_on):
-        def rank_fn(r, f):
-            ce = f.engine(r)
-            coll = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float64,
-                                     P=nb_ranks, Q=1, nodes=nb_ranks,
-                                     rank=r)
-            coll.name = "descA"
-            coll.from_numpy(M.copy())
-            tp = dpotrf_taskpool(coll, rank=r, nb_ranks=nb_ranks)
-            w = ptg.wave(tp, comm=ce)
-            w.run()
-            return w.stats, _gather_owned(coll, rank=r)
-
-        if lane_on:
-            params.set_cmdline("wave_dist_collective", "on")
-        try:
-            results, _ = spmd(nb_ranks, rank_fn, timeout=180)
-        finally:
-            if lane_on:
-                params.unset_cmdline("wave_dist_collective")
-        L = np.zeros((n, n))
-        for (_st, owned) in results:
-            for (m, k), t in owned.items():
-                L[m * nb:(m + 1) * nb, k * nb:(k + 1) * nb] = t
-        return np.tril(L), [st for (st, _o) in results]
-
-    L_tree, st_tree = run(False)
-    L_lane, st_lane = run(True)
-    ref = np.linalg.cholesky(M)
-    np.testing.assert_allclose(L_tree, ref, rtol=0, atol=1e-8 * n)
-    np.testing.assert_allclose(L_lane, L_tree, rtol=0, atol=0)
-    assert sum(s["collective_calls"] for s in st_lane) > 0, st_lane
-    assert sum(s["collective_tiles"] for s in st_lane) > 0
-    assert sum(s["tiles_sent"] for s in st_lane) < \
-        sum(s["tiles_sent"] for s in st_tree), (st_lane, st_tree)
-
-
-def test_dist_wave_collective_lane_ragged_dpotrf(nb_ranks=4):
-    """The lane over SHAPE-SPLIT pools: a ragged tiling (N % nb != 0)
-    splits descA into multiple pools with distinct tile shapes; each
-    (wave, pool) broadcast group gets its own collective call with its
-    own shapes. Differential vs the tree path on the same ragged
-    input."""
-    from parsec_tpu.utils.params import params
-
-    n, nb = 232, 32          # NT=8, last tile 8 rows: 4 shape pools
-    M = make_spd(n, dtype=np.float64)
-
-    def run(lane_on):
-        def rank_fn(r, f):
-            ce = f.engine(r)
-            coll = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float64,
-                                     P=nb_ranks, Q=1, nodes=nb_ranks,
-                                     rank=r)
-            coll.name = "descA"
-            coll.from_numpy(M.copy())
-            tp = dpotrf_taskpool(coll, rank=r, nb_ranks=nb_ranks)
-            w = ptg.wave(tp, comm=ce)
-            w.run()
-            return w.stats, _gather_owned(coll, rank=r)
-
-        if lane_on:
-            params.set_cmdline("wave_dist_collective", "on")
-        try:
-            results, _ = spmd(nb_ranks, rank_fn, timeout=180)
-        finally:
-            if lane_on:
-                params.unset_cmdline("wave_dist_collective")
-        L = np.zeros((n, n))
-        for (_st, owned) in results:
-            for (m, k), t in owned.items():
-                L[m * nb:m * nb + t.shape[0],
-                  k * nb:k * nb + t.shape[1]] = t
-        return np.tril(L), [st for (st, _o) in results]
-
-    L_tree, _ = run(False)
-    L_lane, st_lane = run(True)
-    ref = np.linalg.cholesky(M)
-    np.testing.assert_allclose(L_tree, ref, rtol=0, atol=1e-8 * n)
-    np.testing.assert_allclose(L_lane, L_tree, rtol=0, atol=0)
-    assert sum(s["collective_calls"] for s in st_lane) > 0, st_lane
-
-
-def test_dist_wave_collective_lane_partial_groups(nb_ranks=4):
-    """PARTIAL broadcast groups on a 2D block-cyclic distribution: at
-    P=2 x Q=2 a dpotrf panel tile is read by a row/column SUBSET of
-    ranks, never by all three others — the round-5 full-broadcast-only
-    lane scheduled NOTHING here (northstar at 2x4 recorded
-    collective_calls=0). Groups of >= 3 members must now reduce over a
-    member-device sub-mesh; the remaining 1-dst edges stay p2p.
-    Differential vs the tree path on the same input."""
-    from parsec_tpu.utils.params import params
-
-    n, nb = 256, 32
-    M = make_spd(n, dtype=np.float64)
-    P = 2
 
     def run(lane_on):
         def rank_fn(r, f):
@@ -639,13 +544,8 @@ def test_dist_wave_collective_lane_partial_groups(nb_ranks=4):
             coll.from_numpy(M.copy())
             tp = dpotrf_taskpool(coll, rank=r, nb_ranks=nb_ranks)
             w = ptg.wave(tp, comm=ce)
-            if lane_on:
-                # the member sets really are partial: no group spans
-                # every rank on this distribution
-                groups = {m for by_g in w._lane_sched.values()
-                          for (_c, m) in by_g}
-                assert groups, "no lane groups scheduled at P=2xQ=2"
-                assert all(len(m) < nb_ranks for m in groups), groups
+            if check_runner is not None:
+                check_runner(w, lane_on)
             w.run()
             return w.stats, _gather_owned(coll, rank=r)
 
@@ -658,8 +558,9 @@ def test_dist_wave_collective_lane_partial_groups(nb_ranks=4):
                 params.unset_cmdline("wave_dist_collective")
         L = np.zeros((n, n))
         for (_st, owned) in results:
-            for (m, k), t in owned.items():
-                L[m * nb:(m + 1) * nb, k * nb:(k + 1) * nb] = t
+            for (m, k), t in owned.items():    # edge tiles may be short
+                L[m * nb:m * nb + t.shape[0],
+                  k * nb:k * nb + t.shape[1]] = t
         return np.tril(L), [st for (st, _o) in results]
 
     L_tree, st_tree = run(False)
@@ -670,6 +571,52 @@ def test_dist_wave_collective_lane_partial_groups(nb_ranks=4):
     assert sum(s["collective_calls"] for s in st_lane) > 0, st_lane
     assert sum(s["tiles_sent"] for s in st_lane) < \
         sum(s["tiles_sent"] for s in st_tree), (st_lane, st_tree)
+    return st_tree, st_lane
+
+
+def test_dist_wave_collective_lane_dpotrf_matches(nb_ranks=4):
+    """dpotrf on a 4-rank row-cyclic distribution: every POTRF/TRSM
+    panel tile is read by all other ranks, so the lane carries the
+    panel broadcasts as FULL groups. Differential vs the tree path on
+    the same input: identical factor, fewer p2p sends."""
+    _st_tree, st_lane = _lane_differential(nb_ranks, 256, 32, P=nb_ranks)
+    assert sum(s["collective_tiles"] for s in st_lane) > 0
+
+
+def test_dist_wave_collective_lane_ragged_dpotrf(nb_ranks=4):
+    """The lane over SHAPE-SPLIT pools: a ragged tiling (N % nb != 0)
+    splits descA into multiple pools with distinct tile shapes; each
+    (wave, pool, member set) broadcast group gets its own collective
+    call with its own shapes. Differential vs the tree path on the
+    same ragged input."""
+    _lane_differential(nb_ranks, 232, 32, P=nb_ranks)  # NT=8, edge 8 rows
+
+
+def test_dist_wave_collective_lane_partial_groups(nb_ranks=4):
+    """PARTIAL broadcast groups on a 2D block-cyclic distribution: at
+    P=2 x Q=2 a dpotrf panel tile is read by a row/column SUBSET of
+    ranks, never by all three others — the full-broadcast-only lane
+    scheduled NOTHING here (northstar at 2x4 recorded
+    collective_calls=0). Groups of >= 3 members must reduce over a
+    member-device sub-mesh; the remaining 1-dst edges stay p2p."""
+    def check(w, lane_on):
+        if lane_on:
+            # the member sets really are partial: no group spans
+            # every rank on this distribution
+            groups = {m for by_g in w._lane_sched.values()
+                      for (_c, m) in by_g}
+            assert groups, "no lane groups scheduled at P=2xQ=2"
+            assert all(len(m) < nb_ranks for m in groups), groups
+
+    _lane_differential(nb_ranks, 256, 32, P=2, check_runner=check)
+
+
+def test_dist_wave_collective_lane_ragged_partial(nb_ranks=4):
+    """Composition of the two lane generalizations: SHAPE-SPLIT pools
+    (ragged N % nb != 0) x PARTIAL member groups (P=2 x Q=2). Each
+    (wave, pool, member set) gets its own sub-mesh collective with its
+    own tile shape; differential vs the tree path."""
+    _lane_differential(nb_ranks, 232, 32, P=2)
 
 
 def test_dist_wave_bcast_chain_root_sends_once(nb_ranks=4):
