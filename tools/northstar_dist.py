@@ -18,10 +18,11 @@ Env:   NORTHSTAR_SHARDING=hybrid  -> each rank's pools shard over its
        own sub-mesh of the virtual devices (process x mesh GSPMD);
        needs ranks * submesh <= device count.
        NORTHSTAR_BCAST=binomial|chain|star (default binomial).
-       NORTHSTAR_COLLECTIVE=on -> full broadcasts ride the compiled
+       NORTHSTAR_COLLECTIVE=on -> broadcast groups (full AND
+       partial member sets — any P x Q grid) ride the compiled
        collective lane (wave_dist_collective; in-process substrate).
        NORTHSTAR_GRID=PxQ -> override the process grid (default: most
-       square). P=ranks,Q=1 makes every panel a full broadcast.
+       square).
 
 Self-relaunches with a CPU-pinned env (8 virtual devices) when invoked
 under the TPU plugin. Prints one JSON line with the full report.
