@@ -214,7 +214,6 @@ class RemoteDepEngine:
                 self.stats["activates_sent"] += 1
 
     def _on_activate(self, src: int, msg: Dict) -> None:
-        self.stats["activates_recv"] += 1
         with self._lock:
             tp = self._taskpools.get(msg["tp_id"])
             if tp is None or msg["tp_id"] not in self._counts_ready:
@@ -225,6 +224,9 @@ class RemoteDepEngine:
                 self._early_activations.setdefault(
                     msg["tp_id"], []).append((src, msg))
                 return
+        # count AFTER the gate: counts_ready re-invokes this handler for
+        # buffered messages, which would double-count receives
+        self.stats["activates_recv"] += 1
         # re-forward to my children in the bcast tree
         positions = [msg["root"]] + list(msg["ranks"])
         me_pos = positions.index(self.rank)

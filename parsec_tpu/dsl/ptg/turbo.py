@@ -297,8 +297,20 @@ class TurboRunner(WaveRunner):
 
         def tramp(tid: int) -> None:
             fn, a = entries[tid]
-            holder.pools = fn(holder.pools, a["locs"], a["idx_in"],
-                              a["idx_out"], a["idx_wbx"])
+            try:
+                holder.pools = fn(holder.pools, a["locs"], a["idx_in"],
+                                  a["idx_out"], a["idx_wbx"])
+            except WaveError:
+                raise
+            except Exception as exc:
+                # AOT-unavailable fallback: the body traces at FIRST
+                # call, so trace errors surface here — give them the
+                # same wave diagnosis _prebind gives AOT-path failures
+                name = self.plans[int(self.dag.class_of[tid])].ast.name
+                werr = self._trace_error(exc, name)
+                if werr is not None:
+                    raise werr from exc
+                raise
 
         dag = self.dag
         indptr, succ, indeg = self._aug    # WAR/WAW-augmented CSR

@@ -309,6 +309,23 @@ class DistWaveRunner(WaveRunner):
         runner SPMD, so the address exchange converges."""
         from ...utils.params import params
         mode = str(params.get_or("wave_dist_plane", "string", "auto"))
+        # the lane's blocking XLA collective and the transfer plane
+        # share the PJRT client: a pull parked behind a peer's
+        # in-flight all-reduce deadlocks (observed on the CPU
+        # substrate). With the lane carrying the broadcast volume, the
+        # p2p remainder rides host-byte TCP, which only needs socket
+        # threads. A lane with NOTHING scheduled (e.g. 2 ranks: no
+        # multi-dst edge exists) keeps the plane. wave_dist_plane=on
+        # forces the plane anyway (real multi-host TPU: separate
+        # hardware queues). _plane_ok gates USE in _comm_step, not just
+        # attachment — a plane attached by an earlier runner on this
+        # long-lived CE must not be used either (same deadlock); it is
+        # a pure function of the static schedule + params, so all SPMD
+        # ranks route the same way.
+        hazard = (self._lane is not None
+                  and self._lane.mode == "multiproc"
+                  and bool(self._lane_sched))
+        self._plane_ok = (not hazard) or mode == "on"
         if mode == "off" or \
                 getattr(self.ce, "device_plane", None) is not None:
             return
@@ -316,18 +333,7 @@ class DistWaveRunner(WaveRunner):
             from ...comm.tcp import TCPCommEngine
             if not isinstance(self.ce, TCPCommEngine):
                 return
-            if self._lane is not None and self._lane.mode == "multiproc" \
-                    and self._lane_sched:
-                # the lane's blocking XLA collective and the transfer
-                # plane share the PJRT client: a pull parked behind a
-                # peer's in-flight all-reduce deadlocks (observed on the
-                # CPU substrate). With the lane carrying the broadcast
-                # volume, the p2p remainder rides host-byte TCP, which
-                # only needs socket threads. A lane with NOTHING
-                # scheduled (e.g. 2 ranks: no multi-dst edge exists)
-                # keeps the plane. wave_dist_plane=on forces the plane
-                # anyway (real multi-host TPU: separate hardware
-                # queues).
+            if hazard:
                 return
         from ...comm.xfer import DeviceDataPlane
         DeviceDataPlane(self.ce).exchange(timeout=self.comm_timeout)
@@ -775,8 +781,9 @@ class DistWaveRunner(WaveRunner):
                                     if self._lane is not None else None),
                 "collective_calls": self._lane_calls,
                 "collective_tiles": self._lane_tiles,
-                "device_plane": getattr(self.ce, "device_plane",
-                                        None) is not None,
+                "device_plane": (getattr(self.ce, "device_plane",
+                                         None) is not None
+                                 and self._plane_ok),
                 "local_tiles": int(sum(len(g) for g in self._l2g)),
             }
         finally:
@@ -845,9 +852,9 @@ class DistWaveRunner(WaveRunner):
                       else _dt)
             mine = (np.nonzero(srcs == self.rank)[0] if member
                     else np.empty(0, np.intp))
+            lidx = self._g2l[cid][idxs] if member else None
             contrib = jnp.zeros((npad,) + tuple(shape), dt)
             if len(mine):
-                lidx = self._g2l[cid][idxs]
                 rows = plist[cid][lidx[mine]]
                 if not _is_single_device(rows):
                     rows = np.asarray(rows)   # sharded pools: host hop
@@ -861,7 +868,6 @@ class DistWaveRunner(WaveRunner):
             self._lane_calls += 1
             if not member:
                 continue   # joined the SPMD call; nothing staged here
-            lidx = self._g2l[cid][idxs]
             vals = out[:n]
             if _is_single_device(plist[cid]):
                 dev = next(iter(plist[cid].devices()))
@@ -888,7 +894,11 @@ class DistWaveRunner(WaveRunner):
 
         pools = self._lane_step(w, pools)
         pool_name, epoch = self._cur
-        plane = getattr(self.ce, "device_plane", None)
+        # _plane_ok: never park payloads on the plane while the lane
+        # issues blocking collectives on the same PJRT client (set in
+        # _auto_device_plane; covers planes attached by earlier runners)
+        plane = (getattr(self.ce, "device_plane", None)
+                 if self._plane_ok else None)
         send_gens = self._sends.get(w, {})
         recv_gens = self._recvs.get(w, {})
         if not send_gens and not recv_gens:

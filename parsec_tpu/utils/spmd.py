@@ -1,0 +1,50 @@
+"""In-process SPMD thread harness.
+
+One thread per rank over a shared fabric — the reference's CI strategy
+(distributed behavior validated by oversubscribed mpiexec on one node,
+SURVEY.md §4), except the "node" is one process. This is the single
+canonical copy: the test conftest, the driver's multichip dryrun, and
+the north-star tool all delegate here so fixes to the join/propagation
+logic reach every harness.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional, Tuple
+
+
+def spmd_threads(nb_ranks: int, fn: Callable[[int, Any], Any],
+                 timeout: float = 120.0,
+                 fabric: Optional[Any] = None) -> Tuple[List[Any], Any]:
+    """Run ``fn(rank, fabric)`` on one daemon thread per rank.
+
+    ``fabric`` defaults to a fresh ``LocalFabric``; pass e.g. a
+    MeshFabric to change the transport. Joins every thread with
+    ``timeout`` (a still-alive thread is a hang — asserted), then
+    re-raises the first rank's error. Returns (results, fabric).
+    """
+    from ..comm import LocalFabric
+
+    if fabric is None:
+        fabric = LocalFabric(nb_ranks)
+    assert fabric.nb_ranks == nb_ranks
+    results: List[Any] = [None] * nb_ranks
+    errors: List[Optional[BaseException]] = [None] * nb_ranks
+
+    def runner(r: int) -> None:
+        try:
+            results[r] = fn(r, fabric)
+        except BaseException as e:  # noqa: BLE001 - propagated below
+            errors[r] = e
+
+    threads = [threading.Thread(target=runner, args=(r,), daemon=True)
+               for r in range(nb_ranks)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+        assert not t.is_alive(), "rank thread hung"
+    for e in errors:
+        if e is not None:
+            raise e
+    return results, fabric

@@ -32,36 +32,11 @@ except RuntimeError:
 
 def spmd(nb_ranks, fn, timeout=120, fabric=None):
     """Run fn(rank, fabric) on one thread per rank over an in-process
-    fabric (LocalFabric by default; pass e.g. a MeshFabric to change the
-    transport); propagate exceptions (the reference's analog:
-    oversubscribed mpiexec on one node, SURVEY.md §4)."""
-    import threading
+    fabric; propagate exceptions. Delegates to the canonical harness
+    (parsec_tpu/utils/spmd.py)."""
+    from parsec_tpu.utils.spmd import spmd_threads
 
-    from parsec_tpu.comm import LocalFabric
-
-    if fabric is None:
-        fabric = LocalFabric(nb_ranks)
-    assert fabric.nb_ranks == nb_ranks
-    errors = [None] * nb_ranks
-    results = [None] * nb_ranks
-
-    def runner(r):
-        try:
-            results[r] = fn(r, fabric)
-        except BaseException as e:  # noqa: BLE001
-            errors[r] = e
-
-    threads = [threading.Thread(target=runner, args=(r,), daemon=True)
-               for r in range(nb_ranks)]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join(timeout)
-        assert not t.is_alive(), "rank thread hung"
-    for e in errors:
-        if e is not None:
-            raise e
-    return results, fabric
+    return spmd_threads(nb_ranks, fn, timeout=timeout, fabric=fabric)
 
 
 @pytest.fixture

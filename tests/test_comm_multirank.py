@@ -15,28 +15,11 @@ from parsec_tpu.dsl.dtd import AFFINITY, INOUT, INPUT, VALUE, unpack_args
 
 
 def spmd(nb_ranks, fn, timeout=60):
-    """Run fn(rank, fabric) on one thread per rank; propagate exceptions."""
-    fabric = LocalFabric(nb_ranks)
-    errors = [None] * nb_ranks
-    results = [None] * nb_ranks
+    """Run fn(rank, fabric) on one thread per rank; propagate exceptions.
+    Delegates to the canonical harness (parsec_tpu/utils/spmd.py)."""
+    from parsec_tpu.utils.spmd import spmd_threads
 
-    def runner(r):
-        try:
-            results[r] = fn(r, fabric)
-        except BaseException as e:  # noqa: BLE001
-            errors[r] = e
-
-    threads = [threading.Thread(target=runner, args=(r,), daemon=True)
-               for r in range(nb_ranks)]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join(timeout)
-        assert not t.is_alive(), "rank thread hung"
-    for e in errors:
-        if e is not None:
-            raise e
-    return results, fabric
+    return spmd_threads(nb_ranks, fn, timeout=timeout)
 
 
 def test_bcast_children_topologies():

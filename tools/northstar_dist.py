@@ -112,61 +112,49 @@ def main() -> int:
     else:
         P = max(p for p in range(1, int(ranks ** 0.5) + 1)
                 if ranks % p == 0)
-    results = [None] * ranks
-    errors = [None] * ranks
     barrier = threading.Barrier(ranks)
 
-    def rank_main(r):
-        try:
-            import jax
-            ce = fabric.engine(r)
-            coll = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float64,
-                                     P=P, Q=ranks // P,
-                                     nodes=ranks, rank=r)
-            coll.name = "descA"
-            coll.from_numpy(M)   # local tiles only are materialized
-            tp = dpotrf_taskpool(coll, rank=r, nb_ranks=ranks)
-            t0 = time.perf_counter()
-            w = ptg.wave(tp, comm=ce)
-            t_plan = time.perf_counter() - t0
-            cpus = jax.devices("cpu")
-            if sharding == "hybrid":
-                from jax.sharding import (Mesh, NamedSharding,
-                                          PartitionSpec as Psp)
-                sub = len(cpus) // ranks
-                assert sub >= 2, "hybrid needs >=2 devices per rank"
-                side = max(d for d in range(1, int(sub ** 0.5) + 1)
-                           if sub % d == 0)
-                mesh = Mesh(np.array(cpus[r * sub:(r + 1) * sub])
-                            .reshape(side, sub // side), ("tp", "sp"))
-                pools = w.build_pools(
-                    sharding=NamedSharding(mesh, Psp(None, "tp", "sp")))
-            else:
-                pools = w.build_pools(device=cpus[r % len(cpus)])
-            jax.block_until_ready(pools)
-            barrier.wait(600)            # all ranks staged
-            t0 = time.perf_counter()
-            pools = w.execute(pools)
-            jax.block_until_ready(pools)
-            t_exec = time.perf_counter() - t0
-            w.scatter_pools(pools)
-            owned = {c: np.asarray(coll.data_of(*c).sync_to_host().payload)
-                     for c in coll.tiles() if coll.rank_of(*c) == r}
-            results[r] = (t_plan, t_exec, w.stats, owned)
-        except BaseException as e:  # noqa: BLE001
-            errors[r] = e
+    def rank_main(r, fab):
+        import jax
+        ce = fab.engine(r)
+        coll = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float64,
+                                 P=P, Q=ranks // P,
+                                 nodes=ranks, rank=r)
+        coll.name = "descA"
+        coll.from_numpy(M)   # local tiles only are materialized
+        tp = dpotrf_taskpool(coll, rank=r, nb_ranks=ranks)
+        t0 = time.perf_counter()
+        w = ptg.wave(tp, comm=ce)
+        t_plan = time.perf_counter() - t0
+        cpus = jax.devices("cpu")
+        if sharding == "hybrid":
+            from jax.sharding import (Mesh, NamedSharding,
+                                      PartitionSpec as Psp)
+            sub = len(cpus) // ranks
+            assert sub >= 2, "hybrid needs >=2 devices per rank"
+            side = max(d for d in range(1, int(sub ** 0.5) + 1)
+                       if sub % d == 0)
+            mesh = Mesh(np.array(cpus[r * sub:(r + 1) * sub])
+                        .reshape(side, sub // side), ("tp", "sp"))
+            pools = w.build_pools(
+                sharding=NamedSharding(mesh, Psp(None, "tp", "sp")))
+        else:
+            pools = w.build_pools(device=cpus[r % len(cpus)])
+        jax.block_until_ready(pools)
+        barrier.wait(600)            # all ranks staged
+        t0 = time.perf_counter()
+        pools = w.execute(pools)
+        jax.block_until_ready(pools)
+        t_exec = time.perf_counter() - t0
+        w.scatter_pools(pools)
+        owned = {c: np.asarray(coll.data_of(*c).sync_to_host().payload)
+                 for c in coll.tiles() if coll.rank_of(*c) == r}
+        return (t_plan, t_exec, w.stats, owned)
 
-    threads = [threading.Thread(target=rank_main, args=(r,), daemon=True)
-               for r in range(ranks)]
+    from parsec_tpu.utils.spmd import spmd_threads
     t_all0 = time.perf_counter()
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join(7200)
-        assert not t.is_alive(), "rank thread hung"
-    for e in errors:
-        if e is not None:
-            raise e
+    results, _ = spmd_threads(ranks, rank_main, timeout=7200,
+                              fabric=fabric)
     t_wall = time.perf_counter() - t_all0
     log(f"all ranks done ({t_wall:.1f}s)")
 

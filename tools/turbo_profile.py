@@ -62,6 +62,12 @@ def main() -> int:
 
     prio = np.ascontiguousarray(r.dag.priority, np.int32)
     indptr, succ, indeg = r._aug
+    if r._make_aug_engine(indptr, succ, indeg) is None:
+        # pure-Python install: execute_per_task falls back to the Python
+        # loop, but the C-loop breakdown below has nothing to measure
+        print("native extension not built: no C run_loop to profile "
+              "(build with `python -m parsec_tpu.native.build`)")
+        return 1
 
     def best_of(f, reps=3):
         b = None
