@@ -22,6 +22,7 @@ from .data.datatype import Datatype, dtt_of_array
 from .data.arena import Arena
 from .utils.params import params
 from . import dsl
+from . import obs
 from .dsl import dtd
 
 __version__ = "0.1.0"
@@ -29,7 +30,7 @@ __version__ = "0.1.0"
 __all__ = [
     "Context", "init", "Taskpool", "TaskClass", "Task", "Chore", "Flow",
     "Dep", "HookReturn", "TaskStatus", "Data", "DataCopy", "Coherency",
-    "FlowAccess", "Datatype", "Arena", "params", "dtd", "dsl",
+    "FlowAccess", "Datatype", "Arena", "params", "dtd", "dsl", "obs",
     "CompoundTaskpool", "compose", "recursive_call",
     "data_new_with_payload", "dtt_of_array", "__version__",
 ]

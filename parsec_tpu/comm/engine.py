@@ -17,6 +17,7 @@ socket transport with the same interface.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 
@@ -63,6 +64,10 @@ class CommEngine:
         self._deferred: List[Tuple[int, int, Any]] = []
         self._deferred_lock = threading.Lock()
         self._deferred_warned: set = set()
+        # telemetry sink (obs.spans.CommObs) — None keeps every
+        # instrumented site on the one-attribute-check fast path
+        # (the PINS ``_active == 0`` pattern)
+        self._obs: Optional[Any] = None
 
     def _notify_arrival(self) -> None:
         cb = self.on_arrival
@@ -94,6 +99,11 @@ class CommEngine:
         A tag that never gets a handler is a bug: warn once, and fail
         loudly if the hold queue grows past MAX_DEFERRED instead of
         leaking quietly."""
+        obs = self._obs
+        if obs is not None:
+            # counted at ARRIVAL (deferred or not) so sent/received
+            # totals balance across ranks
+            obs.am_arrived(src, tag, payload)
         with self._deferred_lock:
             cb = self._tag_cbs.get(tag)
             if cb is None:
@@ -112,6 +122,11 @@ class CommEngine:
                     1, "rank %d: deferring message(s) for unregistered "
                     "tag %d", self.rank, tag)
             return False
+        if obs is not None:
+            t0 = time.monotonic_ns()
+            cb(src, payload)
+            obs.delivered(src, self.rank, tag, t0)
+            return True
         cb(src, payload)
         return True
 

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import copy as _copy
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
@@ -98,7 +99,13 @@ class LocalCommEngine(CommEngine):
 
     def send_am(self, dst: int, tag: int, payload: Any) -> None:
         # self-sends also loop back through the inbox for ordering fidelity
+        obs = self._obs
+        if obs is None:
+            self._transport_post(dst, self.rank, tag, _wire_copy(payload))
+            return
+        t0 = time.monotonic_ns()
         self._transport_post(dst, self.rank, tag, _wire_copy(payload))
+        obs.am_sent(self.rank, dst, tag, payload, t0)
 
     # -- one-sided emulation (GET-req AM + data reply) ----------------------
     def get(self, src_rank: int, remote_handle_id: int,
@@ -108,6 +115,9 @@ class LocalCommEngine(CommEngine):
             token = self._get_iter
             self._get_cbs[token] = on_complete
             self._get_srcs[token] = src_rank
+        obs = self._obs
+        if obs is not None:
+            obs.get_begin(token, src_rank)
         self.send_am(src_rank, TAG_GET_REQ,
                      {"handle": remote_handle_id, "token": token,
                       "requester": self.rank})
@@ -125,14 +135,22 @@ class LocalCommEngine(CommEngine):
         with self._lock:
             cb = self._get_cbs.pop(payload["token"])
             self._get_srcs.pop(payload["token"], None)
+        obs = self._obs
+        if obs is not None:
+            # one matched begin/end span per one-sided transfer
+            obs.get_end(payload["token"], src, payload["data"])
         cb(payload["data"])
 
     def put(self, dst_rank: int, remote_handle_id: int, array: Any,
             on_complete: Optional[Callable] = None) -> None:
         """One-sided put: copy into the remote registered region
         (PUT-data AM applied on the receiver's progress)."""
+        obs = self._obs
+        t0 = time.monotonic_ns() if obs is not None else 0
         self.send_am(dst_rank, TAG_PUT_DATA,
                      {"handle": remote_handle_id, "data": array})
+        if obs is not None:
+            obs.put(dst_rank, array, t0)
         if on_complete is not None:
             on_complete(array)
 
@@ -143,10 +161,14 @@ class LocalCommEngine(CommEngine):
 
     # -- progress -----------------------------------------------------------
     def progress(self) -> int:
+        obs = self._obs
+        t0 = time.monotonic_ns() if obs is not None else 0
         n = 0
         for src, tag, payload in self._transport_drain():
             if self.deliver_message(src, tag, payload):
                 n += 1
+        if obs is not None:
+            obs.progress(n, t0)  # span only when work was done
         return n
 
     def sync(self) -> None:

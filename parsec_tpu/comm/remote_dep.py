@@ -20,6 +20,7 @@ write completes, the receiver's recv-task waits for exactly that message
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -159,6 +160,8 @@ class RemoteDepEngine:
         flow per broadcast tree (the reference aggregates by remote_deps
         struct, remote_dep.h:143-160).
         """
+        obs = self.ce._obs
+        t0 = time.monotonic_ns() if obs is not None else 0
         by_flow: Dict[int, Dict[int, List[Tuple]]] = {}
         for dst, edges in remote_edges.items():
             for e in edges:
@@ -212,6 +215,11 @@ class RemoteDepEngine:
             for child_pos in bcast_children(0, len(positions), self.topology):
                 self.ce.send_am(positions[child_pos], TAG_ACTIVATE, msg)
                 self.stats["activates_sent"] += 1
+        if obs is not None:
+            obs.span("comm:activate_batch", t0,
+                     {"task": getattr(task, "locals", None),
+                      "flows": len(by_flow),
+                      "dsts": sorted(remote_edges)})
 
     def _on_activate(self, src: int, msg: Dict) -> None:
         with self._lock:
@@ -422,6 +430,8 @@ class RemoteDepEngine:
         the same GET rendezvous as PTG edges (short proto vs rendezvous,
         ref: remote_dep_mpi.c:244-252) — which on the mesh transport is
         the device-to-device data plane."""
+        obs = self.ce._obs
+        t0 = time.monotonic_ns() if obs is not None else 0
         msg = {"tp_id": tp.comm_tp_id, "tile": tile_key, "seq": seq}
         nbytes = getattr(arr, "nbytes", 0)
         if nbytes <= self.short_limit:
@@ -439,6 +449,10 @@ class RemoteDepEngine:
             msg["data_rank"] = self.rank
         self.ce.send_am(dst, TAG_DTD_DATA, msg)
         self.stats["dtd_sends"] += 1
+        if obs is not None:
+            obs.span("comm:dtd_send", t0,
+                     {"dst": dst, "bytes": nbytes,
+                      "rendezvous": "handle" in msg})
 
     def dtd_expect(self, tp, tile_key: Any, seq: int,
                    cb: Callable[[np.ndarray], None]) -> None:

@@ -272,7 +272,13 @@ class TCPCommEngine(LocalCommEngine):
         # needs the anti-aliasing wire copy the local fabric applies
         if dst == self.rank:
             payload = _wire_copy(payload)
+        obs = self._obs
+        if obs is None:
+            self._transport_post(dst, self.rank, tag, payload)
+            return
+        t0 = time.monotonic_ns()
         self._transport_post(dst, self.rank, tag, payload)
+        obs.am_sent(self.rank, dst, tag, payload, t0)
 
     def _transport_post(self, dst: int, src: int, tag: int, payload: Any) -> None:
         if dst in self.dead_peers:

@@ -29,6 +29,16 @@ __all__ = ["Mempool", "ThreadMempool"]
 _OWNER_ATTR = "_parsec_mempool_owner"
 
 
+def _drop_gauges(gauges: List[tuple]) -> None:
+    """Unregister a named pool's SDE gauges (finalizer-safe: must not
+    reference the pool). Passes each registered poll fn so a LIVE
+    same-named pool's re-registration is left untouched (the identity
+    guard SDERegistry.unregister exists for)."""
+    from ..profiling.sde import sde
+    for name, fn in gauges:
+        sde.unregister(name, fn)
+
+
 def _purge_owner(pool_ref: "weakref.ref", key: int) -> None:
     """weakref.finalize callback: drop a dead element's id entry without
     retaining the pool (a bound-method callback would keep the whole pool
@@ -49,12 +59,15 @@ class ThreadMempool:
         self.nb_elt = 0                # total constructed by this thread
 
     def allocate(self) -> Any:
+        pool = self.pool
         with self._lock:
             if self._free:
+                pool._note_alloc(hit=True)
                 return self._free.pop()
             self.nb_elt += 1  # under the lock: free() races from other threads
-        elt = self.pool.constructor()
-        self.pool._set_owner(elt, self)
+        pool._note_alloc(hit=False)
+        elt = pool.constructor()
+        pool._set_owner(elt, self)
         return elt
 
     def push(self, elt: Any) -> None:
@@ -76,12 +89,72 @@ class Mempool:
     (-1 = unbounded, the reference default)."""
 
     def __init__(self, constructor: Callable[[], Any],
-                 max_cached: int = -1) -> None:
+                 max_cached: int = -1, name: Optional[str] = None) -> None:
         self.constructor = constructor
         self.max_cached = max_cached
         self.owner_of: Dict[int, ThreadMempool] = {}
         self._threads: Dict[int, ThreadMempool] = {}
         self._lock = threading.Lock()
+        # telemetry: allocation counters + outstanding high-water (plain
+        # GIL int adds on the hot path, like sde.inc); a *named* pool
+        # additionally exports pull gauges under PARSEC::MEMPOOL::<NAME>
+        self.name = name
+        self.nb_allocs = 0       # total allocate() calls
+        self.nb_hits = 0         # served from a freelist (no construction)
+        self.nb_outstanding = 0  # allocated minus freed
+        self.outstanding_hwm = 0
+        self._gauges: List[tuple] = []  # (name, poll fn) for unregister
+        if name:
+            self._register_gauges(name)
+
+    def _note_alloc(self, hit: bool) -> None:
+        self.nb_allocs += 1
+        if hit:
+            self.nb_hits += 1
+        n = self.nb_outstanding = self.nb_outstanding + 1
+        if n > self.outstanding_hwm:
+            self.outstanding_hwm = n
+
+    def _register_gauges(self, name: str) -> None:
+        """Export this pool's accounting on the process-wide SDE registry
+        (contextless, like the reference's process-global counters).
+
+        The poll closures hold only a WEAK reference to the pool — a
+        strong one would pin every cached buffer for the process
+        lifetime (the exact leak the _purge_owner docstring warns
+        about) — and a finalizer drops the gauge names once the pool is
+        collected, so abandoned pools clean up after themselves.
+        ``unregister_gauges()`` does it eagerly."""
+        from ..profiling.sde import sde
+        prefix = f"PARSEC::MEMPOOL::{name.upper()}"
+        ref = weakref.ref(self)
+
+        def poll(attr: str):
+            def fn():
+                pool = ref()
+                if pool is None:
+                    return None
+                v = getattr(pool, attr)
+                return v() if callable(v) else v
+            return fn
+
+        self._gauges = []
+        for suffix, attr in (("ALLOCS", "nb_allocs"), ("HITS", "nb_hits"),
+                             ("OUTSTANDING", "nb_outstanding"),
+                             ("OUTSTANDING_HWM", "outstanding_hwm"),
+                             ("CACHED", "nb_cached"),
+                             ("CONSTRUCTED", "nb_constructed")):
+            gname = f"{prefix}::{suffix}"
+            fn = poll(attr)
+            sde.register_poll(gname, fn)
+            self._gauges.append((gname, fn))
+        weakref.finalize(self, _drop_gauges, list(self._gauges))
+
+    def unregister_gauges(self) -> None:
+        """Eagerly drop this pool's gauges from the global registry
+        (also happens automatically when the pool is collected)."""
+        _drop_gauges(self._gauges)
+        self._gauges = []
 
     def thread_mempool(self, thread_id: Optional[int] = None) -> ThreadMempool:
         tid = thread_id if thread_id is not None else threading.get_ident()
@@ -137,6 +210,7 @@ class Mempool:
         if owner is None:
             owner = self.owner_of.get(id(elt))
         if owner is not None:
+            self.nb_outstanding = max(0, self.nb_outstanding - 1)
             owner.push(elt)
         # unknown element: not pool-constructed; drop it (GC)
 

@@ -25,6 +25,9 @@ class Device:
         self.time_estimate_default = 1.0  # per-task default cost weight
         self.executed_tasks = 0
         self._load_lock = threading.Lock()
+        # telemetry sink (obs.spans.DeviceObs); wired by ContextObs —
+        # None keeps transfer sites on the one-attribute-check fast path
+        self._obs = None
 
     # registration hooks (no-ops by default)
     def taskpool_register(self, tp) -> None:

@@ -25,6 +25,7 @@ eviction drops our reference (clean) or writes back to host first (owned).
 from __future__ import annotations
 
 import threading
+import time
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -71,6 +72,7 @@ class JaxDevice(Device):
         # memory accounting + LRU (ref: zone_malloc + gpu_mem_lru/_owned_lru)
         self.mem_budget = self._probe_budget()
         self.mem_used = 0
+        self.mem_highwater = 0  # HBM accounting high-water mark (gauge)
         self._lru_clean: "OrderedDict[int, DataCopy]" = OrderedDict()
         self._lru_owned: "OrderedDict[int, DataCopy]" = OrderedDict()
         self._mem_lock = threading.Lock()
@@ -188,7 +190,11 @@ class JaxDevice(Device):
                 # credit the stale payload being replaced before reserving
                 self._account(-getattr(copy.payload, "nbytes", 0))
                 self._reserve(nbytes)
+                obs = self._obs
+                t0 = time.monotonic_ns() if obs is not None else 0
                 copy.payload = jax.device_put(src.payload, self.jax_device)
+                if obs is not None:
+                    obs.xfer("in", nbytes, t0)
                 self.stats["stage_in_bytes"] += nbytes
             data.complete_transfer_ownership(self.device_index, access)
             self._lru_touch(copy, owned=bool(access & FlowAccess.WRITE))
@@ -300,12 +306,16 @@ class JaxDevice(Device):
     def _account(self, delta: int) -> None:
         with self._mem_lock:
             self.mem_used = max(0, self.mem_used + delta)
+            if self.mem_used > self.mem_highwater:
+                self.mem_highwater = self.mem_used
 
     def _reserve(self, nbytes: int) -> None:
         """ref: parsec_gpu_data_reserve_device_space w/ LRU eviction and
         cycling guard (device_cuda_module.c:864-1040)."""
         with self._mem_lock:
             self.mem_used += nbytes
+            if self.mem_used > self.mem_highwater:
+                self.mem_highwater = self.mem_used
             if self.mem_used <= self.mem_budget:
                 return
             # evict clean copies first
@@ -335,7 +345,11 @@ class JaxDevice(Device):
             host = data.get_copy(0)
             if host is not None:
                 # np.array (not asarray): jax arrays view as READ-ONLY numpy
+                obs = self._obs
+                t0 = time.monotonic_ns() if obs is not None else 0
                 host.payload = np.array(copy.payload)
+                if obs is not None:
+                    obs.xfer("out", getattr(host.payload, "nbytes", 0), t0)
                 host.version = copy.version
                 host.coherency = Coherency.OWNED
                 data.owner_device = 0
@@ -366,7 +380,11 @@ class JaxDevice(Device):
         host = data.get_copy(0)
         # np.array (not asarray): numpy views of jax arrays are READ-ONLY,
         # and host bodies mutate the pulled payload in place
+        obs = self._obs
+        t0 = time.monotonic_ns() if obs is not None else 0
         arr = np.array(copy.payload)
+        if obs is not None:
+            obs.xfer("out", arr.nbytes, t0)
         if host is None:
             host = DataCopy(data, 0, payload=arr)
             data.attach_copy(host)

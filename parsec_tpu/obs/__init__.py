@@ -1,0 +1,179 @@
+"""obs — runtime-wide telemetry: one façade over the profiling islands.
+
+The repo grew three observability islands — ``profiling.trace`` (span
+traces), ``profiling.pins`` (hot-path callback sites), ``profiling.sde``
+(software counters) — plus ad-hoc ``stats`` dicts on the comm engine and
+devices. This package unifies them:
+
+- :mod:`obs.metrics` — ``MetricsRegistry``: counters/gauges (wrapping the
+  per-context SDE registry) + latency histograms, fed by a PINS module;
+- :mod:`obs.spans` — ``CommObs``/``DeviceObs``: span tracing + byte
+  counters for the comm engine and device transfers (a single
+  ``_obs is None`` check on the hot path, the PINS ``_active == 0``
+  pattern);
+- :mod:`obs.prometheus` — text exposition + strict line-format parser;
+- :mod:`obs.critpath` — offline critical-path / per-class breakdown /
+  compute-comm overlap analysis (CLI: ``tools/obs_report.py``).
+
+Enable per run with ``Context(profile=True)`` (spans + counters) and/or
+the ``metrics`` MCA param (histograms + counters without trace
+collection). ``ContextObs`` is the per-context wiring object; the
+runtime creates one in ``Context.__init__``.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from .critpath import analyze, critical_path, format_report, parse_dot
+from .metrics import (COMM_XFER_SECONDS, TASK_EXEC_SECONDS, Histogram,
+                      MetricsRegistry, MetricsTaskModule)
+from .prometheus import (fleet_to_prometheus, parse_exposition, render,
+                         sanitize_name)
+from .spans import (COMM_ACTIVE_TRANSFERS, COMM_BYTES_RECEIVED,
+                    COMM_BYTES_SENT, COMM_MSGS_RECEIVED, COMM_MSGS_SENT,
+                    COMM_PENDING_MESSAGES, CommObs, DeviceObs,
+                    payload_nbytes, register_device_gauges)
+
+__all__ = [
+    "MetricsRegistry", "Histogram", "MetricsTaskModule", "ContextObs",
+    "CommObs", "DeviceObs", "payload_nbytes",
+    "COMM_BYTES_SENT", "COMM_BYTES_RECEIVED", "COMM_MSGS_SENT",
+    "COMM_MSGS_RECEIVED", "COMM_ACTIVE_TRANSFERS", "COMM_PENDING_MESSAGES",
+    "TASK_EXEC_SECONDS", "COMM_XFER_SECONDS",
+    "render", "parse_exposition", "sanitize_name", "fleet_to_prometheus",
+    "analyze", "critical_path", "format_report", "parse_dot",
+    "validate_chrome_trace",
+]
+
+
+class ContextObs:
+    """Per-context telemetry wiring. Constructed by ``Context.__init__``
+    once the SDE registry, profile, comm engine, and devices exist.
+
+    Pull gauges (device memory/load, pending comm queues) are registered
+    unconditionally — they cost nothing until something reads them. The
+    hot-path hooks (comm spans/byte counters, device transfer spans, the
+    task-latency PINS module) are installed only when tracing or metrics
+    collection is on, so a bare run keeps the near-free fast path."""
+
+    def __init__(self, ctx: Any) -> None:
+        self.metrics = MetricsRegistry(ctx.sde)
+        self.enabled = bool(ctx.profile is not None or _metrics_param())
+        self._engines: List[Any] = []
+        self._devices: List[Any] = []
+        self._task_module: Optional[MetricsTaskModule] = None
+        self._profiler_with_hist: Optional[Any] = None
+        # device pull gauges always (poll-only, no hot-path cost); the
+        # span/histogram sink only when telemetry is on
+        for dev in ctx.devices:
+            register_device_gauges(ctx.sde, dev)
+            if self.enabled:
+                dev._obs = DeviceObs(self.metrics, dev, profile=ctx.profile)
+                self._devices.append(dev)
+        ce = getattr(ctx.comm, "ce", ctx.comm) if ctx.comm is not None else None
+        if ce is not None:
+            comm_obs = CommObs(self.metrics,
+                               profile=ctx.profile if self.enabled else None)
+            comm_obs.register_engine_gauges(ce)
+            if self.enabled:
+                ce._obs = comm_obs
+                self._engines.append(ce)
+            # remote-dep protocol counters as pull gauges
+            stats = getattr(ctx.comm, "stats", None)
+            if isinstance(stats, dict):
+                for key in stats:
+                    self.metrics.gauge(
+                        f"PARSEC::COMM::{key.upper()}",
+                        lambda s=stats, k=key: s[k])
+        if self.enabled:
+            profiler = getattr(ctx, "_task_profiler", None)
+            if profiler is not None:
+                # profiling on: the task profiler already hooks EXEC
+                # begin/end — feed the histogram from it instead of
+                # registering a second PINS callback on the hot path
+                from .metrics import ExecTimer
+                profiler.exec_timer = ExecTimer(
+                    self.metrics.histogram(TASK_EXEC_SECONDS))
+                self._profiler_with_hist = profiler
+            else:
+                self._task_module = MetricsTaskModule(self.metrics,
+                                                      context=ctx)
+                self._task_module.enable()
+
+    def fini(self) -> None:
+        """Unhook from global PINS sites and the engine/device sinks (a
+        later context must not feed this context's histograms)."""
+        if self._task_module is not None:
+            self._task_module.disable()
+            self._task_module = None
+        if self._profiler_with_hist is not None:
+            self._profiler_with_hist.exec_timer = None
+            self._profiler_with_hist = None
+        for ce in self._engines:
+            ce._obs = None
+        self._engines.clear()
+        for dev in self._devices:
+            dev._obs = None
+        self._devices.clear()
+
+    def render_prometheus(self, labels: Optional[Dict[str, str]] = None) -> str:
+        from ..profiling.sde import sde as global_sde
+        # include the process-global registry (named mempools, user
+        # counters) so every documented name appears in one exposition
+        return render(self.metrics, labels=labels, extra_sde=global_sde)
+
+
+def _metrics_param() -> bool:
+    from ..utils.params import params
+    try:
+        return bool(params.get("metrics"))
+    except KeyError:  # pragma: no cover - param registered at import
+        return False
+
+
+# ---------------------------------------------------------------------- #
+# minimal Chrome-trace schema check (used by the CI smoke test)          #
+# ---------------------------------------------------------------------- #
+def validate_chrome_trace(doc: Any) -> Dict[str, int]:
+    """Validate the exported trace against the minimal schema Perfetto
+    needs: a ``traceEvents`` list of dicts, each with a string ``name``
+    and ``ph``, numeric ``ts`` for non-metadata events, and — per
+    (pid, tid, name) — matched B/E counts. Returns summary counts;
+    raises ValueError on any violation."""
+    if not isinstance(doc, dict) or not isinstance(
+            doc.get("traceEvents"), list):
+        raise ValueError("trace must be an object with a traceEvents list")
+    opens: Dict[tuple, int] = {}
+    n_spans = n_meta = n_counter = 0
+    for i, ev in enumerate(doc["traceEvents"]):
+        if not isinstance(ev, dict):
+            raise ValueError(f"event {i} is not an object")
+        if not isinstance(ev.get("name"), str) or not isinstance(
+                ev.get("ph"), str):
+            raise ValueError(f"event {i} missing name/ph")
+        ph = ev["ph"]
+        if ph == "M":
+            n_meta += 1
+            continue
+        if not isinstance(ev.get("ts"), (int, float)):
+            raise ValueError(f"event {i} ({ev['name']}) missing numeric ts")
+        key = (ev.get("pid", 0), ev.get("tid", 0), ev["name"])
+        if ph == "B":
+            opens[key] = opens.get(key, 0) + 1
+            n_spans += 1
+        elif ph == "E":
+            if opens.get(key, 0) <= 0:
+                raise ValueError(f"event {i}: E without matching B for {key}")
+            opens[key] -= 1
+        elif ph == "X":
+            if not isinstance(ev.get("dur"), (int, float)):
+                raise ValueError(
+                    f"event {i} ({ev['name']}): X event missing numeric dur")
+            n_spans += 1
+        elif ph == "C":
+            n_counter += 1
+    unclosed = {k: v for k, v in opens.items() if v}
+    if unclosed:
+        raise ValueError(f"unclosed spans: {sorted(unclosed)[:5]}")
+    return {"spans": n_spans, "metadata": n_meta, "counters": n_counter,
+            "events": len(doc["traceEvents"])}

@@ -1,0 +1,277 @@
+"""Offline critical-path + overlap analysis over exported traces.
+
+Input: a Chrome-trace JSON written by ``profiling.trace.Profile.dump``
+plus (optionally) the executed-DAG DOT written by the grapher
+(``profiling_dot=<prefix>``). Output (see :func:`analyze`):
+
+- **critical path** — the longest duration-weighted path through the
+  executed DAG, with its task chain: the lower bound on makespan no
+  scheduler can beat without changing the DAG;
+- **per-task-class breakdown** — count / total / mean exec time per
+  class per rank (where the time went);
+- **compute/comm overlap fraction per rank** — the T3-style metric
+  (arXiv:2401.16677): the fraction of communication time hidden under
+  task execution. 1.0 = perfectly overlapped, 0.0 = fully exposed.
+
+The CLI front end is ``tools/obs_report.py``.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["load_trace_intervals", "parse_dot", "critical_path",
+           "merge_intervals", "overlap_us", "analyze", "format_report"]
+
+
+class Interval:
+    __slots__ = ("pid", "tid", "name", "begin", "end", "args")
+
+    def __init__(self, pid, tid, name, begin, end, args) -> None:
+        self.pid, self.tid, self.name = pid, tid, name
+        self.begin, self.end, self.args = begin, end, args
+
+    @property
+    def duration(self) -> float:
+        return self.end - self.begin
+
+
+def load_trace_intervals(doc: Dict[str, Any]) -> List[Interval]:
+    """Intervals from complete ("X", ts+dur) events and from B/E pairs
+    (matched per (pid, tid, name), LIFO — the same matching
+    ``Profile.to_dataframe`` applies). Timestamps are the export's
+    microseconds."""
+    events = doc.get("traceEvents", doc if isinstance(doc, list) else [])
+    out: List[Interval] = []
+    # complete events carry their own duration — no pairing needed
+    for e in events:
+        if e.get("ph") == "X":
+            out.append(Interval(e.get("pid", 0), e.get("tid", 0),
+                                e.get("name", ""), e["ts"],
+                                e["ts"] + e.get("dur", 0.0), e.get("args")))
+    # B/E events may interleave streams out of order in the list
+    be = sorted(
+        (e for e in events if e.get("ph") in ("B", "E")),
+        key=lambda e: (e.get("pid", 0), e.get("tid", 0), e.get("ts", 0.0)))
+    open_ev: Dict[Tuple, List[Tuple[float, Any]]] = {}
+    for e in be:
+        key = (e.get("pid", 0), e.get("tid", 0), e.get("name", ""))
+        if e["ph"] == "B":
+            open_ev.setdefault(key, []).append((e["ts"], e.get("args")))
+        else:
+            stack = open_ev.get(key)
+            if stack:
+                ts0, args = stack.pop()
+                out.append(Interval(key[0], key[1], key[2], ts0, e["ts"], args))
+    return out
+
+
+# ---------------------------------------------------------------------- #
+# DOT (grapher output) parsing                                           #
+# ---------------------------------------------------------------------- #
+_NODE_RE = re.compile(r'^\s*(\w+)\s*\[label="([^"]*)"')
+_EDGE_RE = re.compile(r"^\s*(\w+)\s*->\s*(\w+)")
+
+
+def parse_dot(text: str) -> Tuple[Dict[str, str], List[Tuple[str, str]]]:
+    """Returns (node_id -> label, [(src_label, dst_label), ...])."""
+    labels: Dict[str, str] = {}
+    raw_edges: List[Tuple[str, str]] = []
+    for line in text.splitlines():
+        if "->" in line:
+            m = _EDGE_RE.match(line)
+            if m:
+                raw_edges.append((m.group(1), m.group(2)))
+            continue
+        m = _NODE_RE.match(line)
+        if m:
+            labels[m.group(1)] = m.group(2)
+    edges = [(labels.get(a, a), labels.get(b, b)) for a, b in raw_edges]
+    return labels, edges
+
+
+def critical_path(durations: Dict[str, float],
+                  edges: List[Tuple[str, str]]) -> Tuple[float, List[str]]:
+    """Longest node-weighted path through the DAG. Nodes appearing only
+    in ``edges`` default to zero weight. Raises ValueError on a cycle."""
+    nodes = set(durations)
+    for a, b in edges:
+        nodes.update((a, b))
+    succs: Dict[str, List[str]] = {n: [] for n in nodes}
+    indeg: Dict[str, int] = {n: 0 for n in nodes}
+    for a, b in edges:
+        succs[a].append(b)
+        indeg[b] += 1
+    # Kahn topological order
+    order: List[str] = [n for n in nodes if indeg[n] == 0]
+    i = 0
+    while i < len(order):
+        for s in succs[order[i]]:
+            indeg[s] -= 1
+            if indeg[s] == 0:
+                order.append(s)
+        i += 1
+    if len(order) != len(nodes):
+        raise ValueError("dependency graph has a cycle")
+    dist: Dict[str, float] = {}
+    prev: Dict[str, Optional[str]] = {}
+    for n in order:
+        if n not in dist:
+            dist[n] = durations.get(n, 0.0)
+            prev[n] = None
+        for s in succs[n]:
+            cand = dist[n] + durations.get(s, 0.0)
+            if cand > dist.get(s, float("-inf")):
+                dist[s] = cand
+                prev[s] = n
+    if not dist:
+        return 0.0, []
+    tail = max(dist, key=lambda n: dist[n])
+    path: List[str] = []
+    cur: Optional[str] = tail
+    while cur is not None:
+        path.append(cur)
+        cur = prev[cur]
+    return dist[tail], list(reversed(path))
+
+
+# ---------------------------------------------------------------------- #
+# interval algebra                                                       #
+# ---------------------------------------------------------------------- #
+def merge_intervals(spans: List[Tuple[float, float]]) -> List[Tuple[float, float]]:
+    """Union of possibly-overlapping (begin, end) pairs."""
+    if not spans:
+        return []
+    spans = sorted(spans)
+    out = [list(spans[0])]
+    for b, e in spans[1:]:
+        if b <= out[-1][1]:
+            out[-1][1] = max(out[-1][1], e)
+        else:
+            out.append([b, e])
+    return [(b, e) for b, e in out]
+
+
+def overlap_us(a: List[Tuple[float, float]],
+               b: List[Tuple[float, float]]) -> float:
+    """Total length of the intersection of two merged interval lists."""
+    i = j = 0
+    total = 0.0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if hi > lo:
+            total += hi - lo
+        if a[i][1] < b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+# ---------------------------------------------------------------------- #
+# the report                                                             #
+# ---------------------------------------------------------------------- #
+def _is_compute(iv: Interval) -> bool:
+    return iv.name.startswith("exec:")
+
+
+def _is_comm(iv: Interval) -> bool:
+    return iv.name.startswith(("comm:", "dev:xfer"))
+
+
+def analyze(trace_docs: List[Dict[str, Any]],
+            dot_text: Optional[str] = None) -> Dict[str, Any]:
+    """Build the full report from one or more rank trace documents
+    (already-parsed Chrome JSON) and an optional grapher DOT."""
+    intervals: List[Interval] = []
+    for doc in trace_docs:
+        intervals.extend(load_trace_intervals(doc))
+
+    # per-task-class breakdown per rank
+    by_class: Dict[int, Dict[str, Dict[str, float]]] = {}
+    task_durations: Dict[str, float] = {}
+    for iv in intervals:
+        if not _is_compute(iv):
+            continue
+        cls = iv.name[len("exec:"):]
+        cell = by_class.setdefault(iv.pid, {}).setdefault(
+            cls, {"count": 0, "total_us": 0.0})
+        cell["count"] += 1
+        cell["total_us"] += iv.duration
+        if isinstance(iv.args, dict) and "task" in iv.args:
+            # individual executed-task durations keyed by the same
+            # printed name the grapher uses as the DOT node label
+            task_durations[iv.args["task"]] = (
+                task_durations.get(iv.args["task"], 0.0) + iv.duration)
+    for cells in by_class.values():
+        for cell in cells.values():
+            cell["mean_us"] = cell["total_us"] / max(1, cell["count"])
+
+    # T3-style compute/comm overlap per rank
+    overlap: Dict[int, Dict[str, float]] = {}
+    pids = sorted({iv.pid for iv in intervals})
+    for pid in pids:
+        compute = merge_intervals([(iv.begin, iv.end) for iv in intervals
+                                   if iv.pid == pid and _is_compute(iv)])
+        comm = merge_intervals([(iv.begin, iv.end) for iv in intervals
+                                if iv.pid == pid and _is_comm(iv)])
+        comm_us = sum(e - b for b, e in comm)
+        comp_us = sum(e - b for b, e in compute)
+        hidden = overlap_us(compute, comm)
+        overlap[pid] = {
+            "compute_us": comp_us,
+            "comm_us": comm_us,
+            "overlap_us": hidden,
+            "overlap_fraction": hidden / comm_us if comm_us > 0 else 0.0,
+        }
+
+    report: Dict[str, Any] = {
+        "ranks": pids,
+        "nb_intervals": len(intervals),
+        "by_class": by_class,
+        "overlap": overlap,
+    }
+
+    if dot_text:
+        _labels, edges = parse_dot(dot_text)
+        length, path = critical_path(task_durations, edges)
+        total_exec = sum(task_durations.values())
+        report["critical_path"] = {
+            "length_us": length,
+            "tasks": path,
+            "nb_tasks": len(path),
+            "total_exec_us": total_exec,
+            # >1 means the DAG has exploitable parallelism
+            "parallelism": total_exec / length if length > 0 else 0.0,
+        }
+    return report
+
+
+def format_report(report: Dict[str, Any]) -> str:
+    """Human-readable rendering (what tools/obs_report.py prints)."""
+    out: List[str] = []
+    cp = report.get("critical_path")
+    if cp is not None:
+        out.append(f"critical path: {cp['length_us'] / 1e3:.3f} ms over "
+                   f"{cp['nb_tasks']} tasks "
+                   f"(total exec {cp['total_exec_us'] / 1e3:.3f} ms, "
+                   f"parallelism {cp['parallelism']:.2f}x)")
+        if cp["tasks"]:
+            chain = " -> ".join(cp["tasks"][:8])
+            if cp["nb_tasks"] > 8:
+                chain += " -> ..."
+            out.append(f"  chain: {chain}")
+    out.append("per-task-class breakdown:")
+    for pid in sorted(report.get("by_class", {})):
+        for cls, cell in sorted(report["by_class"][pid].items()):
+            out.append(f"  rank {pid} {cls:<20} n={int(cell['count']):<6} "
+                       f"total={cell['total_us'] / 1e3:.3f} ms "
+                       f"mean={cell['mean_us']:.1f} us")
+    out.append("compute/comm overlap per rank:")
+    for pid in sorted(report.get("overlap", {})):
+        ov = report["overlap"][pid]
+        out.append(f"  rank {pid}: compute={ov['compute_us'] / 1e3:.3f} ms "
+                   f"comm={ov['comm_us'] / 1e3:.3f} ms "
+                   f"overlap fraction={ov['overlap_fraction']:.3f}")
+    return "\n".join(out)

@@ -1,0 +1,211 @@
+"""Span-tracing hooks for the comm engine and device layer.
+
+The scheduler hot path already has PINS sites; the comm engine and
+devices had none. ``CommObs`` / ``DeviceObs`` are the per-rank hook
+objects those layers call through a single attribute check
+(``self._obs is not None`` — the PINS ``_active == 0`` pattern), so
+uninstrumented runs pay one attribute load per site and nothing else.
+
+Spans land in the rank's ``profiling.trace.Profile`` on dedicated
+streams (``comm``, ``dev:<name>``) so Perfetto shows communication and
+transfers as their own rows next to the worker exec rows; byte counters
+land in the context's SDE registry under ``PARSEC::COMM::*`` /
+``PARSEC::DEVICE::*``; transfer latencies feed the metrics histogram.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+from .metrics import COMM_XFER_SECONDS, MetricsRegistry
+
+__all__ = ["CommObs", "DeviceObs", "register_device_gauges",
+           "COMM_BYTES_SENT", "COMM_BYTES_RECEIVED",
+           "COMM_MSGS_SENT", "COMM_MSGS_RECEIVED",
+           "COMM_ACTIVE_TRANSFERS", "COMM_PENDING_MESSAGES",
+           "payload_nbytes"]
+
+COMM_BYTES_SENT = "PARSEC::COMM::BYTES_SENT"
+COMM_BYTES_RECEIVED = "PARSEC::COMM::BYTES_RECEIVED"
+COMM_MSGS_SENT = "PARSEC::COMM::MSGS_SENT"
+COMM_MSGS_RECEIVED = "PARSEC::COMM::MSGS_RECEIVED"
+COMM_ACTIVE_TRANSFERS = "PARSEC::COMM::ACTIVE_TRANSFERS"
+COMM_PENDING_MESSAGES = "PARSEC::COMM::PENDING_MESSAGES"
+
+#: trace stream ids (outside any plausible worker th_id range)
+COMM_STREAM_TID = 1 << 20
+DEVICE_STREAM_TID = (1 << 20) + 1
+
+
+_TAG_NAMES: Dict[int, str] = {}
+
+
+def _tag_name(tag: int) -> str:
+    """Human label for a wire tag (span names beat raw tag ints in
+    Perfetto). Lazy so obs never imports the comm layer at module load."""
+    if not _TAG_NAMES:
+        from ..comm import engine as _e
+        _TAG_NAMES.update({
+            _e.TAG_ACTIVATE: "activate", _e.TAG_GET_REQ: "get_req",
+            _e.TAG_GET_DATA: "get_data", _e.TAG_PUT_DATA: "put_data",
+            _e.TAG_TERMDET: "termdet", _e.TAG_DTD_DATA: "dtd_data",
+            _e.TAG_MEM_PUT: "mem_put"})
+    return _TAG_NAMES.get(tag, str(tag))
+
+
+def payload_nbytes(payload: Any) -> int:
+    """Structural byte count of an AM payload. Sender and receiver apply
+    the SAME function to the SAME structure (deep-copied or re-pickled by
+    the wire), so BYTES_SENT and BYTES_RECEIVED balance across ranks."""
+    nbytes = getattr(payload, "nbytes", None)
+    if nbytes is not None:
+        return int(nbytes)
+    if isinstance(payload, dict):
+        return sum(payload_nbytes(v) for v in payload.values())
+    if isinstance(payload, (list, tuple)):
+        return sum(payload_nbytes(v) for v in payload)
+    return 8
+
+
+class CommObs:
+    """Per-rank comm telemetry sink. Construct with the rank's metrics
+    registry and (optionally) its Profile; every hook is safe to call
+    from any thread."""
+
+    __slots__ = ("metrics", "stream", "_open_gets", "_hist")
+
+    def __init__(self, metrics: MetricsRegistry,
+                 profile: Optional[Any] = None) -> None:
+        self.metrics = metrics
+        self.stream = (profile.stream(COMM_STREAM_TID, "comm")
+                       if profile is not None else None)
+        self._open_gets: Dict[int, int] = {}  # token -> t0_ns
+        self._hist = metrics.histogram(COMM_XFER_SECONDS)
+
+    # -- active messages -----------------------------------------------------
+    def am_sent(self, src: int, dst: int, tag: int, payload: Any,
+                t0_ns: int) -> None:
+        nbytes = payload_nbytes(payload)
+        sde = self.metrics.sde
+        sde.inc(COMM_MSGS_SENT)
+        sde.inc(COMM_BYTES_SENT, nbytes)
+        st = self.stream
+        if st is not None:
+            st.span("comm:send", t0_ns, time.monotonic_ns(),
+                    {"src": src, "dst": dst, "tag": tag, "bytes": nbytes})
+
+    def am_arrived(self, src: int, tag: int, payload: Any) -> None:
+        """Counted at arrival (even if the tag's handler is not bound yet
+        and the message is deferred) so sent/received totals balance."""
+        sde = self.metrics.sde
+        sde.inc(COMM_MSGS_RECEIVED)
+        sde.inc(COMM_BYTES_RECEIVED, payload_nbytes(payload))
+
+    def delivered(self, src: int, me: int, tag: int, t0_ns: int) -> None:
+        st = self.stream
+        if st is not None:
+            st.span(f"comm:deliver:{_tag_name(tag)}", t0_ns,
+                    time.monotonic_ns(), {"src": src, "dst": me, "tag": tag})
+
+    # -- one-sided transfers -------------------------------------------------
+    def get_begin(self, token: int, src_rank: int) -> None:
+        self._open_gets[token] = time.monotonic_ns()
+
+    def get_end(self, token: int, src_rank: int, payload: Any) -> None:
+        t0 = self._open_gets.pop(token, None)
+        if t0 is None:
+            return
+        t1 = time.monotonic_ns()
+        self._hist.observe((t1 - t0) / 1e9)
+        st = self.stream
+        if st is not None:
+            st.span("comm:get", t0, t1,
+                    {"src": src_rank, "token": token,
+                     "bytes": payload_nbytes(payload)})
+
+    def put(self, dst_rank: int, payload: Any, t0_ns: int) -> None:
+        # the span covers the local post only (one-sided puts complete
+        # on the receiver's progress with no ack) — so puts do NOT feed
+        # the transfer-latency histogram; GETs, which have a matched
+        # reply, do
+        st = self.stream
+        if st is not None:
+            st.span("comm:put", t0_ns, time.monotonic_ns(),
+                    {"dst": dst_rank, "bytes": payload_nbytes(payload)})
+
+    # -- generic protocol spans (remote_dep et al.) --------------------------
+    def span(self, key: str, t0_ns: int, info: Any = None) -> None:
+        st = self.stream
+        if st is not None:
+            st.span(key, t0_ns, time.monotonic_ns(), info)
+
+    # -- progress ------------------------------------------------------------
+    def progress(self, handled: int, t0_ns: int) -> None:
+        """Called after a drain; only drains that handled at least one
+        message become spans (idle polls would drown the trace)."""
+        if handled <= 0:
+            return
+        st = self.stream
+        if st is not None:
+            st.span("comm:progress", t0_ns, time.monotonic_ns(),
+                    {"handled": handled})
+
+    # -- engine gauge wiring -------------------------------------------------
+    def register_engine_gauges(self, ce: Any) -> None:
+        """Pull gauges over the engine's live queues: outstanding GET
+        tokens (ACTIVE_TRANSFERS) and not-yet-deliverable deferred
+        messages (PENDING_MESSAGES)."""
+        sde = self.metrics.sde
+        get_cbs = getattr(ce, "_get_cbs", None)
+        if get_cbs is not None:
+            sde.register_poll(COMM_ACTIVE_TRANSFERS, lambda: len(get_cbs))
+        sde.register_poll(COMM_PENDING_MESSAGES,
+                          lambda: len(ce._deferred))
+
+
+def register_device_gauges(sde: Any, device: Any) -> None:
+    """Pull gauges over one device's accounting state — poll-only, so
+    registering them costs nothing on any hot path (safe to do for
+    uninstrumented runs too)."""
+    prefix = f"PARSEC::DEVICE::{device.name}"
+    sde.register_poll(f"{prefix}::TASKS",
+                      lambda d=device: d.executed_tasks)
+    sde.register_poll(f"{prefix}::LOAD", lambda d=device: d.device_load)
+    if hasattr(device, "mem_used"):
+        sde.register_poll(f"{prefix}::MEM_USED",
+                          lambda d=device: d.mem_used)
+    if hasattr(device, "mem_highwater"):
+        sde.register_poll(f"{prefix}::MEM_HIGHWATER",
+                          lambda d=device: d.mem_highwater)
+    stats = getattr(device, "stats", None)
+    if isinstance(stats, dict):
+        for key in stats:
+            sde.register_poll(f"{prefix}::{key.upper()}",
+                              lambda s=stats, k=key: s[k])
+
+
+class DeviceObs:
+    """Per-device span/histogram sink — installed as ``device._obs``
+    only when telemetry is enabled, so uninstrumented transfer sites
+    keep the one-attribute-check fast path (gauges are registered
+    separately via :func:`register_device_gauges`)."""
+
+    __slots__ = ("metrics", "stream", "name", "_hist")
+
+    def __init__(self, metrics: MetricsRegistry, device: Any,
+                 profile: Optional[Any] = None) -> None:
+        self.metrics = metrics
+        self.name = device.name
+        self.stream = (profile.stream(DEVICE_STREAM_TID + device.device_index,
+                                      f"dev:{device.name}")
+                       if profile is not None else None)
+        self._hist = metrics.histogram(COMM_XFER_SECONDS)
+
+    def xfer(self, direction: str, nbytes: int, t0_ns: int) -> None:
+        """A host<->device transfer completed (direction: "in"|"out")."""
+        t1 = time.monotonic_ns()
+        self._hist.observe((t1 - t0_ns) / 1e9)
+        st = self.stream
+        if st is not None:
+            st.span(f"dev:xfer_{direction}", t0_ns, t1,
+                    {"device": self.name, "bytes": nbytes})

@@ -34,6 +34,12 @@ class _Handler(socketserver.StreamRequestHandler):
             line = raw.strip()
             if not line:
                 continue
+            if line.startswith(b"GET "):
+                # minimal HTTP so a Prometheus scraper (or curl) can hit
+                # the same port: GET /metrics returns text exposition of
+                # every rank's latest pushed counters
+                self._serve_http(server, line)
+                return
             if line == b"QUERY":
                 payload = json.dumps(server.fleet()).encode() + b"\n"
                 self.wfile.write(payload)
@@ -45,6 +51,29 @@ class _Handler(socketserver.StreamRequestHandler):
                 continue
             if isinstance(msg, dict):  # well-formed non-object JSON: drop
                 server._ingest(msg)
+
+    def _serve_http(self, server: "AggregatorServer", request: bytes) -> None:
+        from ..obs.prometheus import fleet_to_prometheus
+        # drain the request headers (blank line terminates)
+        for raw in self.rfile:
+            if not raw.strip():
+                break
+        path = request.split()[1].decode(errors="replace") \
+            if len(request.split()) > 1 else "/"
+        if path in ("/metrics", "/"):
+            body = fleet_to_prometheus(server.fleet()).encode()
+            head = (b"HTTP/1.0 200 OK\r\n"
+                    b"Content-Type: text/plain; version=0.0.4\r\n"
+                    b"Content-Length: " + str(len(body)).encode() +
+                    b"\r\n\r\n")
+        else:
+            body = b"not found\n"
+            head = (b"HTTP/1.0 404 Not Found\r\n"
+                    b"Content-Type: text/plain\r\n"
+                    b"Content-Length: " + str(len(body)).encode() +
+                    b"\r\n\r\n")
+        self.wfile.write(head + body)
+        self.wfile.flush()
 
 
 class _TCPServer(socketserver.ThreadingTCPServer):
@@ -131,13 +160,17 @@ class SDEPusher:
     AggregatorServer address (host:port). One per Context (= per rank)."""
 
     def __init__(self, sde, addr: str, rank: int = 0,
-                 interval: float = 1.0) -> None:
+                 interval: float = 1.0, extra_sde=None) -> None:
         host, sep, port = addr.rpartition(":")
         if not sep or not port.isdigit():
             raise ValueError(
                 f"sde_push address {addr!r} is not host:port")
         self._addr = (host or "127.0.0.1", int(port))
         self._sde = sde
+        # optional second registry merged into every push (the process-
+        # global one: named mempools, contextless user counters); the
+        # primary registry wins on name collision
+        self._extra_sde = extra_sde
         self.rank = rank
         self.interval = interval
         self._stop = threading.Event()
@@ -156,7 +189,10 @@ class SDEPusher:
     def push_once(self) -> bool:
         """One synchronous sample+send; False if the server is unreachable
         (pushes are best-effort: telemetry must never take down the run)."""
-        snap = {k: v for k, v in self._sde.snapshot().items()
+        merged = dict(self._extra_sde.snapshot()) \
+            if self._extra_sde is not None else {}
+        merged.update(self._sde.snapshot())
+        snap = {k: v for k, v in merged.items()
                 if isinstance(v, (int, float))}
         msg = json.dumps({"rank": self.rank, "ts": time.time(),
                           "counters": snap}) + "\n"

@@ -75,7 +75,10 @@ def write_profile(profile, path: str) -> str:
             fh.write(nb)
             _w_u32(fh, len(st.events))
             for ts, ph, key, info in st.events:
-                ib = b"" if info is None else json.dumps(info).encode()
+                # default=repr: like the Chrome export, arbitrary info
+                # payloads must never abort the binary dump
+                ib = b"" if info is None else json.dumps(
+                    info, default=repr).encode()
                 fh.write(struct.pack("<qBI", ts - profile._t0,
                                      ord(ph[0]), keys[key]))
                 _w_u32(fh, len(ib))

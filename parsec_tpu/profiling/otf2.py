@@ -102,6 +102,23 @@ def _have_real_otf2() -> bool:
         return False
 
 
+def _normalized_events(st):
+    """Expand complete ("X") spans — comm/device telemetry — into B/E
+    pairs and sort by timestamp: OTF2 event streams are strictly
+    time-ordered, while X events are appended at completion carrying
+    begin timestamps in the past."""
+    out = []
+    for ts, ph, key, info in st.events:
+        if ph == "X":
+            dur = (info or {}).get("dur_ns", 0)
+            out.append((ts, "B", key, None))
+            out.append((ts + dur, "E", key, None))
+        else:
+            out.append((ts, ph, key, info))
+    out.sort(key=lambda e: e[0])
+    return out
+
+
 def write_otf2(profile, path: str) -> str:
     """Write ``profile`` as an OTF2 archive rooted at ``path`` (a
     directory name). Returns the anchor path — ``<path>/anchor.otf2``
@@ -128,7 +145,7 @@ def write_otf2(profile, path: str) -> str:
     for loc_id, (tid, st) in enumerate(streams):
         with open(os.path.join(path, "traces", f"{loc_id}.evt"), "wb") as fh:
             prev_ts = 0
-            for ts, ph, key, info in st.events:
+            for ts, ph, key, info in _normalized_events(st):
                 rel = ts - profile._t0
                 dt = rel - prev_ts
                 prev_ts = rel
@@ -212,7 +229,7 @@ def _write_real_otf2(profile, path: str) -> str:  # pragma: no cover
         metrics: Dict[str, Any] = {}
         for _tid, st in sorted(profile._streams.items()):
             writer = trace.event_writer(st.name, group=group)
-            for ts, ph, key, info in st.events:
+            for ts, ph, key, info in _normalized_events(st):
                 rel = ts - profile._t0
                 if ph == "C":
                     m = metrics.get(key)

@@ -97,23 +97,38 @@ class TaskProfilerModule(PinsModule):
               PinsEvent.PREPARE_INPUT_BEGIN, PinsEvent.PREPARE_INPUT_END,
               PinsEvent.COMPLETE_EXEC_BEGIN, PinsEvent.COMPLETE_EXEC_END]
 
-    def __init__(self, profile) -> None:
+    def __init__(self, profile, context: Any = None) -> None:
         self.profile = profile  # profiling.trace.Profile
+        # PINS sites are process-global but profiles are per-rank: with
+        # several in-process SPMD contexts, a context-bound module must
+        # ignore the other ranks' events or every profile records every
+        # rank's tasks (interleaved B/E pairs corrupt the durations)
+        self.context = context
+        # optional latency sink (an obs.metrics.ExecTimer): with metrics
+        # on, the exec duration feeds the histogram from THIS module's
+        # existing hook instead of a second PINS callback per task
+        self.exec_timer: Any = None
 
     def callback(self, es: Any, event: PinsEvent, payload: Any) -> None:
+        if self.context is not None and es.context is not self.context:
+            return
         stream = self.profile.thread_stream(es)
         name = payload.task_class.name if payload is not None and hasattr(payload, "task_class") else "runtime"
         if event in (PinsEvent.EXEC_BEGIN,):
-            stream.begin("exec:" + name, tid=es.th_id,
+            if self.exec_timer is not None:
+                self.exec_timer.begin(es.th_id)
+            stream.begin("exec:" + name,
                          info={"task": payload.snprintf()} if payload is not None else None)
         elif event in (PinsEvent.EXEC_END,):
             stream.end("exec:" + name)
+            if self.exec_timer is not None:
+                self.exec_timer.end(es.th_id)
         elif event == PinsEvent.PREPARE_INPUT_BEGIN:
-            stream.begin("prep:" + name, tid=es.th_id)
+            stream.begin("prep:" + name)
         elif event == PinsEvent.PREPARE_INPUT_END:
             stream.end("prep:" + name)
         elif event == PinsEvent.COMPLETE_EXEC_BEGIN:
-            stream.begin("complete:" + name, tid=es.th_id)
+            stream.begin("complete:" + name)
         elif event == PinsEvent.COMPLETE_EXEC_END:
             stream.end("complete:" + name)
 

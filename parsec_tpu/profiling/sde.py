@@ -65,13 +65,22 @@ class SDERegistry:
         return self._counters.get(name, 0)
 
     def snapshot(self) -> Dict[str, Any]:
-        out: Dict[str, Any] = dict(self._counters)
+        counters, gauges = self.snapshot_typed()
+        counters.update(gauges)
+        return counters
+
+    def snapshot_typed(self):
+        """(owned_counters, gauges) as two dicts — the owned/poll split is
+        the counter-vs-gauge distinction Prometheus exposition needs
+        (owned counters are monotonic; polls are point-in-time gauges)."""
+        counters = dict(self._counters)
+        gauges: Dict[str, Any] = {}
         for name, fn in list(self._polls.items()):
             try:
-                out[name] = fn()
+                gauges[name] = fn()
             except Exception:
-                out[name] = None
-        return out
+                gauges[name] = None
+        return counters, gauges
 
     def names(self):
         return sorted(set(self._counters) | set(self._polls))

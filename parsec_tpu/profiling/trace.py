@@ -52,15 +52,28 @@ class ThreadStream:
         self.name = name or f"thread-{tid}"
         self.events: List[tuple] = []  # (ts_ns, phase, key_or_name, info)
 
-    def trace(self, key: str, event_id: int = 0, info: Any = None,
-              phase: str = "i") -> None:
+    # NOTE: the former ``tid``/``event_id`` parameters of begin()/trace()
+    # were silently dropped from the emitted event tuple; a stream already
+    # IS one tid, so they are gone from the signatures (callers updated).
+    def trace(self, key: str, info: Any = None, phase: str = "i") -> None:
         self.events.append((time.monotonic_ns(), phase, key, info))
 
-    def begin(self, key: str, tid: Optional[int] = None, info: Any = None) -> None:
+    def begin(self, key: str, info: Any = None) -> None:
         self.events.append((time.monotonic_ns(), "B", key, info))
 
     def end(self, key: str, info: Any = None) -> None:
         self.events.append((time.monotonic_ns(), "E", key, info))
+
+    def span(self, key: str, t0_ns: int, t1_ns: int, info: Any = None) -> None:
+        """Append a COMPLETE span ("X" phase) with explicit timestamps —
+        for sites that only know a span is worth recording after it
+        finished (comm/device hooks). A complete event carries its own
+        duration, so concurrent same-name spans from several threads
+        landing on one shared stream cannot mis-nest the way B/E pairs
+        would (Chrome-trace requires B/E to nest per tid)."""
+        info = dict(info) if isinstance(info, dict) else {}
+        info["dur_ns"] = t1_ns - t0_ns
+        self.events.append((t0_ns, "X", key, info))
 
     def counter(self, key: str, value: float) -> None:
         self.events.append((time.monotonic_ns(), "C", key, value))
@@ -98,7 +111,12 @@ class Profile:
 
     # -- export -------------------------------------------------------------
     def to_chrome_trace(self) -> Dict[str, Any]:
-        events = []
+        events: List[Dict[str, Any]] = [
+            # process/thread metadata so Perfetto labels the rank row and
+            # each stream (thread_name events follow per stream below)
+            {"name": "process_name", "ph": "M", "pid": self.rank,
+             "args": {"name": f"rank {self.rank}"}},
+        ]
         for tid, st in sorted(self._streams.items()):
             events.append({"name": "thread_name", "ph": "M", "pid": self.rank,
                            "tid": tid, "args": {"name": st.name}})
@@ -109,6 +127,13 @@ class Profile:
                 }
                 if ph in ("B", "E"):
                     ev["ph"] = ph
+                elif ph == "X":
+                    ev["ph"] = "X"
+                    ev["dur"] = (info or {}).get("dur_ns", 0) / 1000.0
+                    args = {k: v for k, v in (info or {}).items()
+                            if k != "dur_ns"}
+                    if args:
+                        ev["args"] = args
                 elif ph == "C":
                     ev["ph"] = "C"
                     ev["args"] = {key: info}
@@ -125,7 +150,9 @@ class Profile:
         out = path if path.endswith(".json") else f"{path}.rank{self.rank}.trace.json"
         os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
         with open(out, "w") as fh:
-            json.dump(self.to_chrome_trace(), fh)
+            # default=repr: info payloads are arbitrary user objects
+            # (ndarrays, task handles) — export must never crash on them
+            json.dump(self.to_chrome_trace(), fh, default=repr)
         return out
 
     def dump_binary(self, path: str) -> str:
@@ -151,6 +178,12 @@ class Profile:
                                  "begin_ns": b - self._t0,
                                  "end_ns": ts - self._t0,
                                  "duration_ns": ts - b})
+                elif ph == "X":
+                    dur = (info or {}).get("dur_ns", 0)
+                    rows.append({"tid": tid, "name": key,
+                                 "begin_ns": ts - self._t0,
+                                 "end_ns": ts - self._t0 + dur,
+                                 "duration_ns": dur})
         return pd.DataFrame(rows)
 
     def nb_events(self) -> int:

@@ -134,7 +134,8 @@ class Context:
             # files written at fini only when a prefix was configured;
             # profile=True alone keeps the trace in memory for the caller
             self._prof_prefix = prof_prefix or None
-            self._task_profiler = TaskProfilerModule(self.profile)
+            self._task_profiler = TaskProfilerModule(self.profile,
+                                                     context=self)
             self._task_profiler.enable()
         # executed-DAG capture (ref: --parsec_dot, parsec.c:596-614)
         self._dot_prefix = params.get("profiling_dot") or None
@@ -180,17 +181,25 @@ class Context:
         # (the reference's registry is per-process, which IS per-rank there)
         self.sde = SDERegistry()
         self.sde.register_poll(PENDING_TASKS, self._pending_gauge)
+        # unified telemetry wiring (obs/): metrics registry over ctx.sde,
+        # comm/device gauges always, hot-path span hooks only when
+        # profiling or the ``metrics`` param is on
+        from ..obs import ContextObs
+        self.obs = ContextObs(self)
+        self.metrics = self.obs.metrics
         # live telemetry: push SDE snapshots to an aggregator if configured
         # (ref: PAPI-SDE counters feeding tools/aggregator_visu)
         self._sde_pusher = None
         push_addr = params.get("sde_push")
         if push_addr:
             from ..profiling.aggregator import SDEPusher
+            from ..profiling.sde import sde as _global_sde
             try:
                 self._sde_pusher = SDEPusher(
                     self.sde, push_addr, rank=self.rank,
                     interval=max(0.05,
                                  params.get("sde_push_interval_ms") / 1000.0),
+                    extra_sde=_global_sde,
                 ).start()
             except ValueError as e:
                 # telemetry must never take down the run
@@ -493,6 +502,8 @@ class Context:
             # unhook from the global PINS sites: a later context's events
             # must not leak into this finalized profile
             self._task_profiler.disable()
+        # unhook telemetry (PINS latency module + engine span sink)
+        self.obs.fini()
         if self._debug_history_on:
             from ..utils import debug_history
             debug_history.disable()  # refcounted across live contexts

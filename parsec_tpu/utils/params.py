@@ -225,6 +225,10 @@ def register_core_params() -> None:
                     "keep best ready task on releasing thread, bypass scheduler")
     params.reg_int("verbose", 0, "global debug verbosity")
     params.reg_string("profile", "", "enable profiling; path prefix for traces")
+    params.reg_bool("metrics", False,
+                    "collect runtime metrics (latency histograms + comm/"
+                    "device counters) without full trace capture; "
+                    "exposition via obs.prometheus / the aggregator")
     params.reg_string("profiling_dot", "",
                       "capture the executed DAG; path prefix for DOT files "
                       "(ref: --parsec_dot)")

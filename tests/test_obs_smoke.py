@@ -1,0 +1,98 @@
+"""CI smoke: ex02_chain runs with tracing + metrics enabled, its
+exported trace validates against the minimal Chrome-trace schema, and
+tools/obs_report.py produces the critical-path / breakdown / overlap
+report from it — so a telemetry regression fails tier-1."""
+import json
+import os
+import sys
+
+import pytest
+
+import parsec_tpu
+from parsec_tpu.obs import validate_chrome_trace
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "tools")
+if TOOLS not in sys.path:
+    sys.path.insert(0, TOOLS)
+
+import obs_report  # noqa: E402
+
+
+@pytest.fixture
+def traced_ex02(tmp_path):
+    """Run examples/ex02_chain.py with profile + DOT + metrics on;
+    yields (trace_path, dot_path)."""
+    prefix = str(tmp_path / "smoke")
+    parsec_tpu.params.set_cmdline("profile", prefix)
+    parsec_tpu.params.set_cmdline("profiling_dot", prefix)
+    parsec_tpu.params.set_cmdline("metrics", "1")
+    try:
+        from examples import ex02_chain
+        assert ex02_chain.main(6) == 0
+    finally:
+        parsec_tpu.params.unset_cmdline("profile")
+        parsec_tpu.params.unset_cmdline("profiling_dot")
+        parsec_tpu.params.unset_cmdline("metrics")
+    trace = tmp_path / "smoke.rank0.trace.json"
+    dot = tmp_path / "smoke.rank0.dot"
+    assert trace.exists(), "profile prefix did not produce a trace file"
+    assert dot.exists(), "profiling_dot did not produce a DOT file"
+    return str(trace), str(dot)
+
+
+def test_ex02_trace_validates_and_reports(traced_ex02, capsys):
+    trace, dot = traced_ex02
+    with open(trace) as fh:
+        doc = json.load(fh)
+    summary = validate_chrome_trace(doc)
+    assert summary["spans"] >= 7          # one exec span per chain task
+    assert summary["metadata"] >= 2       # process_name + thread_name
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert "process_name" in names and "thread_name" in names
+    assert any(n.startswith("exec:") for n in names)
+    # SDE counters were sampled into the trace at fini
+    assert any(e.get("ph") == "C" for e in doc["traceEvents"])
+
+    # the report end-to-end: critical path + breakdown + overlap
+    assert obs_report.main([trace, "--dot", dot]) == 0
+    out = capsys.readouterr().out
+    assert "critical path:" in out
+    assert "per-task-class breakdown:" in out
+    assert "overlap" in out
+    # the chain is sequential: critical path == total exec (7 tasks)
+    report = _report_json(trace, dot, capsys)
+    cp = report["critical_path"]
+    assert cp["nb_tasks"] == 7
+    assert cp["length_us"] == pytest.approx(cp["total_exec_us"], rel=1e-6)
+
+
+def _report_json(trace, dot, capsys):
+    assert obs_report.main([trace, "--dot", dot, "--json"]) == 0
+    return json.loads(capsys.readouterr().out)
+
+
+def test_binary_trace_roundtrip_with_obs_streams(tmp_path):
+    """The .ptt binary dump must survive the new comm/device streams and
+    non-JSON info payloads (repr fallback)."""
+    import numpy as np
+    from parsec_tpu.profiling.binfmt import read_profile
+    from parsec_tpu.profiling.trace import Profile
+    p = Profile(rank=0)
+    st = p.stream(1 << 20, "comm")
+    st.begin("comm:send", info={"arr": np.zeros(3)})  # not JSON-serializable
+    st.end("comm:send")
+    # complete ("X") span, 4000 ns long (timestamps on the profile base)
+    st.span("comm:get", p._t0 + 1000, p._t0 + 5000, {"bytes": 64})
+    out = p.dump_binary(str(tmp_path / "t"))
+    rp = read_profile(out)  # rebased at t0=0
+    assert rp.nb_events() == 3
+    # the .ptt toolchain sees the X span as an interval of its duration
+    import ptt_dump
+    ivs = ptt_dump.intervals_of(list(rp._streams.values())[0])
+    assert ("comm:get", 1000, 5000, {"bytes": 64, "dur_ns": 4000}) in ivs
+    # chrome export with the same payload must not crash either
+    out_json = p.dump(str(tmp_path / "t.json"))
+    with open(out_json) as fh:
+        doc = json.load(fh)
+    validate_chrome_trace(doc)

@@ -2,10 +2,15 @@
 """Live counter-aggregation server (the aggregator_visu demo_server
 analog). Run it, point ranks at it with ``--mca sde_push host:port``,
 and it reprints the fleet counter table every ``--interval`` seconds.
+The same port also answers ``GET /metrics`` with Prometheus text
+exposition (per-rank last values, ``rank`` label), so a scraper can sit
+directly on a running job.
 
     python tools/aggregator_server.py --port 9321
     # in the job's environment:
     PARSEC_MCA_sde_push=127.0.0.1:9321 python my_app.py
+    # scrape:
+    curl http://127.0.0.1:9321/metrics
 """
 import argparse
 import os

@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""Offline telemetry report: critical path, per-task-class breakdown,
+and the T3-style compute/comm overlap fraction per rank.
+
+Feed it the Chrome-trace JSON written at fini (``profile=<prefix>`` or
+``Context(profile=True)`` + ``Profile.dump``) and, for the critical
+path, the executed-DAG DOT (``profiling_dot=<prefix>``):
+
+    python tools/obs_report.py /tmp/run.rank0.trace.json \\
+        --dot /tmp/run.rank0.dot
+    python tools/obs_report.py run.rank*.trace.json --json
+
+Multiple rank traces merge into one report (ranks keyed by pid).
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from parsec_tpu.obs import analyze, format_report  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("traces", nargs="+",
+                    help="Chrome-trace JSON file(s), one per rank")
+    ap.add_argument("--dot", default=None,
+                    help="executed-DAG DOT from the grapher "
+                         "(enables the critical-path section)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the raw report as JSON instead of text")
+    args = ap.parse_args(argv)
+
+    docs = []
+    for path in args.traces:
+        with open(path) as fh:
+            docs.append(json.load(fh))
+    dot_text = None
+    if args.dot:
+        with open(args.dot) as fh:
+            dot_text = fh.read()
+
+    report = analyze(docs, dot_text=dot_text)
+    if args.json:
+        json.dump(report, sys.stdout, indent=2, default=repr)
+        print()
+    else:
+        print(format_report(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -106,6 +106,11 @@ def convert(paths, out):
             out.write(f'4 {t:.9f} ST_Task {tc} "{key}"\n')
         elif ph == "E":
             out.write(f"5 {t:.9f} ST_Task {tc}\n")
+        elif ph == "X":
+            # complete span (comm/device): push+pop around its duration
+            t1 = (ts + (info or {}).get("dur_ns", 0)) / 1e9
+            out.write(f'4 {t:.9f} ST_Task {tc} "{key}"\n')
+            out.write(f"5 {t1:.9f} ST_Task {tc}\n")
         elif ph == "C":
             out.write(f"6 {t:.9f} {var_alias[key]} {tc} {float(info)}\n")
         else:  # punctual marker events (stream.trace)

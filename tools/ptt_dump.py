@@ -24,7 +24,9 @@ from parsec_tpu.profiling.binfmt import read_profile  # noqa: E402
 
 
 def intervals_of(stream):
-    """Pair B/E events per key (LIFO nesting, like the dbp readers)."""
+    """Pair B/E events per key (LIFO nesting, like the dbp readers);
+    complete ("X") events — comm/device spans — carry their own
+    duration in info["dur_ns"]."""
     out = []
     open_ev = defaultdict(list)
     for ts, ph, key, info in stream.events:
@@ -33,6 +35,9 @@ def intervals_of(stream):
         elif ph == "E" and open_ev.get(key):
             b, binfo = open_ev[key].pop()
             out.append((key, b, ts, binfo))
+        elif ph == "X":
+            dur = (info or {}).get("dur_ns", 0)
+            out.append((key, ts, ts + dur, info))
     return out
 
 
