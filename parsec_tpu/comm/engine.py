@@ -149,7 +149,12 @@ class CommEngine:
             on_complete: Callable[[Any], None]) -> None:
         """One-sided get: fetch the remote registered region
         (emulated with a GET-request AM + data reply, like the funnelled
-        MPI engine, parsec_mpi_funnelled.c:245-365)."""
+        MPI engine, parsec_mpi_funnelled.c:245-365).
+
+        Aggregation contract: gets issued from message handlers during
+        one progress() drain MAY be batched per peer into a single
+        request/reply frame — on_complete still fires once per get,
+        but callers must not assume one wire message per call."""
         raise NotImplementedError
 
     def put(self, dst_rank: int, remote_handle_id: int, array: Any,

@@ -11,7 +11,7 @@ from __future__ import annotations
 import copy as _copy
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -78,6 +78,13 @@ class LocalCommEngine(CommEngine):
         self._get_srcs: Dict[int, int] = {}  # token -> peer rank owing data
         self._get_iter = 0
         self._lock = threading.Lock()
+        # GET aggregation: gets issued from handlers DURING a progress
+        # drain batch per peer and flush as ONE request frame at the end
+        # of that progress call (several same-cycle rendezvous to one
+        # peer cost one wire round-trip instead of N). Depth is
+        # per-thread: progress() runs on every scheduler thread.
+        self._get_queue: Dict[int, List[Tuple[int, int]]] = {}
+        self._drain_depth = threading.local()
         self.tag_register(TAG_GET_REQ, self._on_get_req)
         self.tag_register(TAG_GET_DATA, self._on_get_data)
         self.tag_register(TAG_PUT_DATA, self._on_put_data)
@@ -118,28 +125,78 @@ class LocalCommEngine(CommEngine):
         obs = self._obs
         if obs is not None:
             obs.get_begin(token, src_rank)
+        if getattr(self._drain_depth, "n", 0) > 0:
+            # inside a progress drain on this thread: batch — the flush
+            # at the end of this progress call sends one request per
+            # peer covering every GET the drained messages triggered
+            with self._lock:
+                self._get_queue.setdefault(src_rank, []).append(
+                    (remote_handle_id, token))
+            return
         self.send_am(src_rank, TAG_GET_REQ,
-                     {"handle": remote_handle_id, "token": token,
-                      "requester": self.rank})
+                     {"requester": self.rank,
+                      "gets": [(remote_handle_id, token)]})
+
+    def _flush_gets(self) -> None:
+        with self._lock:
+            if not self._get_queue:
+                return
+            pending, self._get_queue = self._get_queue, {}
+        first_exc = None
+        for peer, gets in pending.items():
+            try:
+                self.send_am(peer, TAG_GET_REQ,
+                             {"requester": self.rank, "gets": gets})
+            except Exception as exc:  # noqa: BLE001 - e.g. RankFailedError
+                # one dead peer must not starve the OTHER peers' batched
+                # requests (their callbacks would never fire); send to
+                # everyone, then surface the first failure
+                if first_exc is None:
+                    first_exc = exc
+        if first_exc is not None:
+            raise first_exc
+
+    def _serve_get(self, requester: int, h: MemHandle) -> Any:
+        """Materialize one GET reply payload (transport hook: the mesh
+        engine pushes the buffer onto the requester's device here)."""
+        return h.array
 
     def _on_get_req(self, src: int, payload: Any) -> None:
-        h = self._mem.get(payload["handle"])
-        assert h is not None, f"GET for unknown mem handle {payload['handle']}"
-        self.send_am(payload["requester"], TAG_GET_DATA,
-                     {"token": payload["token"], "data": h.array,
-                      "meta": h.meta})
+        req = payload["requester"]
+        items = []
+        for handle_id, token in payload["gets"]:
+            h = self._mem.get(handle_id)
+            assert h is not None, f"GET for unknown mem handle {handle_id}"
+            items.append({"token": token,
+                          "data": self._serve_get(req, h),
+                          "meta": h.meta})
+        # every same-cycle GET from one requester rides ONE reply frame
+        self.send_am(req, TAG_GET_DATA, {"items": items})
         if self.on_get_served is not None:
-            self.on_get_served(payload["handle"])
+            for handle_id, _token in payload["gets"]:
+                self.on_get_served(handle_id)
 
     def _on_get_data(self, src: int, payload: Any) -> None:
-        with self._lock:
-            cb = self._get_cbs.pop(payload["token"])
-            self._get_srcs.pop(payload["token"], None)
         obs = self._obs
-        if obs is not None:
-            # one matched begin/end span per one-sided transfer
-            obs.get_end(payload["token"], src, payload["data"])
-        cb(payload["data"])
+        first_exc = None
+        for item in payload["items"]:
+            with self._lock:
+                cb = self._get_cbs.pop(item["token"])
+                self._get_srcs.pop(item["token"], None)
+            if obs is not None:
+                # one matched begin/end span per one-sided transfer
+                obs.get_end(item["token"], src, item["data"])
+            try:
+                cb(item["data"])
+            except Exception as exc:  # noqa: BLE001
+                # the reply frame carries SEVERAL gets: one callback
+                # failing must not strand the remaining tokens (their
+                # bytes are already consumed from the inbox) — deliver
+                # everything, then surface the first failure
+                if first_exc is None:
+                    first_exc = exc
+        if first_exc is not None:
+            raise first_exc
 
     def put(self, dst_rank: int, remote_handle_id: int, array: Any,
             on_complete: Optional[Callable] = None) -> None:
@@ -164,9 +221,27 @@ class LocalCommEngine(CommEngine):
         obs = self._obs
         t0 = time.monotonic_ns() if obs is not None else 0
         n = 0
-        for src, tag, payload in self._transport_drain():
-            if self.deliver_message(src, tag, payload):
-                n += 1
+        tl = self._drain_depth
+        tl.n = getattr(tl, "n", 0) + 1
+        ok = False
+        try:
+            for src, tag, payload in self._transport_drain():
+                if self.deliver_message(src, tag, payload):
+                    n += 1
+            ok = True
+        finally:
+            tl.n -= 1
+            if tl.n == 0:
+                if ok:
+                    self._flush_gets()
+                else:
+                    # a handler raised mid-drain: still try to flush so
+                    # live peers' batched GETs are not stranded, but the
+                    # in-flight error must win over any flush failure
+                    try:
+                        self._flush_gets()
+                    except Exception:
+                        pass
         if obs is not None:
             obs.progress(n, t0)  # span only when work was done
         return n

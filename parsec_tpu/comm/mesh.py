@@ -24,7 +24,7 @@ from typing import Any, Callable, List, Optional
 
 import numpy as np
 
-from .engine import TAG_GET_DATA, TAG_GET_REQ, TAG_PUT_DATA
+from .engine import TAG_PUT_DATA
 from .local import LocalCommEngine, LocalFabric
 
 
@@ -80,15 +80,8 @@ class MeshCommEngine(LocalCommEngine):
         return out
 
     # -- GET: serve by pushing the buffer onto the requester's device ----
-    def _on_get_req(self, src: int, payload: Any) -> None:
-        h = self._mem.get(payload["handle"])
-        assert h is not None, f"GET for unknown mem handle {payload['handle']}"
-        data = self._to_device_of(payload["requester"], h.array)
-        self.send_am(payload["requester"], TAG_GET_DATA,
-                     {"token": payload["token"], "data": data,
-                      "meta": h.meta})
-        if self.on_get_served is not None:
-            self.on_get_served(payload["handle"])
+    def _serve_get(self, requester: int, h: Any) -> Any:
+        return self._to_device_of(requester, h.array)
 
     # -- PUT: transfer first, land in the registered region on arrival --
     def put(self, dst_rank: int, remote_handle_id: int, array: Any,

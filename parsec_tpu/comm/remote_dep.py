@@ -70,6 +70,17 @@ class RemoteDepEngine:
         self.context = None
         self.topology = params.get("runtime_comm_coll_bcast")
         self.short_limit = params.get("runtime_comm_short_limit")
+        # adaptive eager/rendezvous: per-peer cutoff from the measured
+        # GET round-trip EWMA x link bandwidth EWMA (the bandwidth-delay
+        # product — below it the inline copy beats a rendezvous's extra
+        # round-trip), clamped to [static short_limit, short_limit_max].
+        # Off by default: with the knob unset the static cutoff applies
+        # unchanged on every peer.
+        self._adaptive_short = bool(params.get("comm_adaptive_short_limit"))
+        self._short_limit_max = max(
+            int(params.get("comm_short_limit_max")), self.short_limit)
+        self._get_rtt: Dict[int, float] = {}      # peer -> EWMA seconds
+        self.adaptive_limits: Dict[int, int] = {}  # peer -> last cutoff
         self._taskpools: Dict[int, Any] = {}
         self._lock = threading.Lock()
         # DTD data-plane state: (tile_key, seq) -> payload | expectation
@@ -145,6 +156,51 @@ class RemoteDepEngine:
         self.ce.fini()
 
     # ------------------------------------------------------------------ #
+    # adaptive eager/rendezvous cutoff                                   #
+    # ------------------------------------------------------------------ #
+    _RTT_ALPHA = 0.2
+
+    def _note_get_rtt(self, peer: int, secs: float) -> None:
+        with self._lock:
+            cur = self._get_rtt.get(peer)
+            self._get_rtt[peer] = (secs if cur is None else
+                                   (1 - self._RTT_ALPHA) * cur
+                                   + self._RTT_ALPHA * secs)
+
+    def _timed_get(self, peer: int, handle_id: int,
+                   cb: Callable[[Any], None]) -> None:
+        """Rendezvous GET that feeds the per-peer round-trip EWMA (the
+        measurement half of the adaptive cutoff; the obs histogram
+        tracks the same round-trips when telemetry is on)."""
+        t0 = time.monotonic()
+
+        def on_data(arr):
+            self._note_get_rtt(peer, time.monotonic() - t0)
+            cb(arr)
+
+        self.ce.get(peer, handle_id, on_data)
+
+    def short_limit_for(self, peer: int) -> int:
+        """Effective eager/rendezvous cutoff toward ``peer``: static
+        unless adaptive mode is on AND both the link bandwidth and the
+        GET round-trip have been measured — then the bandwidth-delay
+        product (bytes a rendezvous round-trip 'wastes') bounded by
+        [runtime_comm_short_limit, comm_short_limit_max]."""
+        static = self.short_limit
+        if not self._adaptive_short or peer == self.rank:
+            return static
+        bw_fn = getattr(self.ce, "link_bw_mbps", None)
+        bw = bw_fn(peer) if callable(bw_fn) else None
+        with self._lock:
+            rtt = self._get_rtt.get(peer)
+        if bw is None or rtt is None:
+            return static
+        bdp = int(bw * 1e6 * rtt)
+        limit = max(static, min(bdp, self._short_limit_max))
+        self.adaptive_limits[peer] = limit
+        return limit
+
+    # ------------------------------------------------------------------ #
     # PTG activation protocol                                            #
     # ------------------------------------------------------------------ #
     def activate_batch(self, tp, task, flow_payloads: Dict[int, Any],
@@ -178,7 +234,10 @@ class RemoteDepEngine:
                 "dtt": (flow_dtts or {}).get(out_idx),
             }
             plane = getattr(self.ce, "device_plane", None)
-            inline = payload_arr is None or payload_arr.nbytes <= self.short_limit
+            # the message reaches every participant: the cutoff must be
+            # agreeable to all of them — take the most conservative
+            limit = min(self.short_limit_for(r) for r in ranks)
+            inline = payload_arr is None or payload_arr.nbytes <= limit
             if (plane is not None and not inline
                     and _is_device_array(payload_arr)):
                 # device data plane: park the DEVICE buffer, consumers
@@ -202,8 +261,11 @@ class RemoteDepEngine:
                 # SNAPSHOT the payload: a local successor released by the
                 # same completion may mutate the live host copy in place
                 # before the remote GET is served (the inline path copies
-                # at send time via the wire)
-                handle = self.ce.mem_register(np.array(payload_arr))
+                # at send time via the wire). Read-only so the wire's
+                # chunked path may send it zero-copy.
+                snap = np.array(payload_arr)
+                snap.setflags(write=False)
+                handle = self.ce.mem_register(snap)
                 # every non-root participant eventually GETs from the root
                 tp.add_pending_action(1)
                 self._pending_handles[handle.handle_id] = (tp, len(ranks), handle)
@@ -287,7 +349,7 @@ class RemoteDepEngine:
             # rendezvous: GET the payload from the data holder
             def on_data(arr):
                 self._deliver_activation(tp, my_edges, arr, msg.get("dtt"))
-            self.ce.get(msg["data_rank"], msg["handle"], on_data)
+            self._timed_get(msg["data_rank"], msg["handle"], on_data)
 
     def _deliver_activation(self, tp, edges: List[Tuple], arr,
                             dtt=None) -> None:
@@ -434,13 +496,18 @@ class RemoteDepEngine:
         t0 = time.monotonic_ns() if obs is not None else 0
         msg = {"tp_id": tp.comm_tp_id, "tile": tile_key, "seq": seq}
         nbytes = getattr(arr, "nbytes", 0)
-        if nbytes <= self.short_limit:
+        if nbytes <= self.short_limit_for(dst):
             msg["data"] = arr
         else:
             # snapshot mutable host buffers (a later local task may write
             # in place before the GET is served); immutable device arrays
-            # register as-is so the transfer stays on the data plane
-            snap = np.array(arr) if isinstance(arr, np.ndarray) else arr
+            # register as-is so the transfer stays on the data plane.
+            # Read-only marks the snapshot wire-zero-copy-safe.
+            if isinstance(arr, np.ndarray):
+                snap = np.array(arr)
+                snap.setflags(write=False)
+            else:
+                snap = arr
             handle = self.ce.mem_register(snap)
             tp.add_pending_action(1)
             with self._lock:
@@ -474,8 +541,8 @@ class RemoteDepEngine:
         key = (msg["tp_id"], msg["tile"], msg["seq"])
         if "handle" in msg:
             # rendezvous: fetch through the data plane, deliver on arrival
-            self.ce.get(msg["data_rank"], msg["handle"],
-                        lambda arr, k=key: self._dtd_deliver(k, arr))
+            self._timed_get(msg["data_rank"], msg["handle"],
+                            lambda arr, k=key: self._dtd_deliver(k, arr))
             return
         self._dtd_deliver(key, msg["data"])
 

@@ -4,10 +4,35 @@ Reference behavior being replaced: the funnelled MPI engine is the only
 in-tree transport and carries both the control plane (activations, GET
 requests) and the data plane over two-sided MPI
 (parsec/parsec_mpi_funnelled.c). Here the same activation/GET/PUT
-emulation (inherited from LocalCommEngine) rides length-prefixed pickle
-frames over TCP sockets — one duplex connection per rank pair, receiver
+emulation (inherited from LocalCommEngine) rides framed pickle messages
+over TCP sockets — one duplex connection per rank pair, receiver
 threads feeding a local inbox, callbacks dispatched from progress() on
 the caller's thread (funnelled semantics preserved).
+
+The wire fast path (framing in comm/wire.py):
+
+- each peer has a SEND QUEUE drained by a dedicated writer thread;
+  ``send_am`` serializes on the caller's thread (copy-at-enqueue for
+  everything below the chunk threshold — the historical snapshot
+  semantics) and returns as soon as the message fits the bounded
+  per-peer send buffer (``comm_send_buffer_bytes`` — backpressure
+  toward a slow link, so producers stall instead of queueing an
+  epoch's traffic in RAM);
+- queued small messages COALESCE into one multi-message frame per
+  syscall (``comm_coalesce_max_bytes``), so on a slow DCN the control
+  plane pays one syscall + one wakeup for a burst of activations;
+- buffers >= ``comm_chunk_bytes`` stream as bounded CHUNK frames with
+  pickle-5 zero-copy views; control messages interleave between chunks
+  instead of head-of-line blocking behind a multi-MB tile (callers on
+  the bulk path — GET rendezvous, wave tiles — snapshot their payloads
+  already, so zero-copy is safe there);
+- per-link COMPRESSION (zlib, lz4 when installed) is negotiated at the
+  connection handshake and engages only when the measured link
+  bandwidth EWMA drops below ``comm_compress_threshold_mbps`` (default
+  0 = never) AND a sample probe shows the traffic compresses; a peer
+  that never advertises codecs (HELLO missing or no common codec)
+  stays uncompressed. The v2 framing itself is a breaking wire change:
+  every rank of a job must run the same framing version.
 
 This is the DCN control-plane story of SURVEY.md §5.8 made concrete: on
 a multi-host TPU deployment the small latency-bound messages travel this
@@ -16,7 +41,8 @@ single-host multi-process runs (the tests) carry both over TCP.
 
 Connection setup: rank r listens on ``endpoints[r]``; r dials every rank
 s < r and accepts from every s > r (one connection per unordered pair),
-with a rank-identifying handshake byte frame.
+with a rank-identifying handshake byte frame followed by a HELLO
+capability frame.
 """
 from __future__ import annotations
 
@@ -25,15 +51,33 @@ import socket
 import struct
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..core.lists import Fifo
 from .engine import TAG_USER_BASE
 from ..utils import logging as plog
 from .local import LocalCommEngine, _wire_copy
+from . import wire
+from .wire import GOODBYE
 
 TAG_BARRIER = TAG_USER_BASE - 1  # reserved by the transport for sync()
-GOODBYE = (1 << 64) - 1  # frame-size sentinel: clean shutdown, not a crash
+
+#: bandwidth EWMA smoothing and the minimum send size that counts as a
+#: bandwidth sample (smaller sends measure syscall latency, not the link)
+_BW_ALPHA = 0.2
+_BW_SAMPLE_MIN = 1 << 15
+#: compression: re-probe cadence (frames) and the engage ratio
+_PROBE_EVERY = 256
+_PROBE_RATIO = 0.9
+#: smallest body worth compressing
+_COMP_MIN_BYTES = 512
+#: iovec safety cap for one sendmsg (IOV_MAX is 1024 on linux)
+_MAX_BATCH_MSGS = 256
+#: anti-starvation: after this many consecutive ctrl frames with bulk
+#: chunks waiting, one chunk is interleaved regardless — a sustained
+#: control stream must not stall an in-flight bulk transfer forever
+_CTRL_STREAK_MAX = 8
 
 
 class RankFailedError(RuntimeError):
@@ -63,6 +107,22 @@ def free_ports(n: int) -> List[int]:
     return ports
 
 
+def _sendall_vec(sock: socket.socket, pieces: List[Any]) -> None:
+    """Scatter-gather sendall: one syscall per iteration over the whole
+    piece list (the coalescing win — a batch of frames leaves in ONE
+    sendmsg instead of one syscall per message)."""
+    views = [memoryview(p) for p in pieces]
+    while views:
+        sent = sock.sendmsg(views)
+        while sent:
+            if len(views[0]) <= sent:
+                sent -= len(views[0])
+                views.pop(0)
+            else:
+                views[0] = views[0][sent:]
+                sent = 0
+
+
 class _FabricShim:
     """Satisfies the tiny surface LocalCommEngine expects of a fabric."""
 
@@ -72,12 +132,44 @@ class _FabricShim:
         self.bytes_count = 0
 
 
+class _Peer:
+    """Per-peer send state: the queues the writer thread drains.
+
+    ``ctrl`` holds coalescible message segments and standalone frames
+    (chunked-transfer headers, hello); ``bulk`` holds chunk items. The
+    writer always prefers ctrl, so control traffic interleaves between
+    the bounded chunks of an in-flight bulk payload."""
+
+    __slots__ = ("rank", "sock", "ctrl", "bulk", "cond", "writer",
+                 "goodbye", "bw_mbps", "codec", "engaged", "frames",
+                 "probe_ratio", "done", "queued_bytes")
+
+    def __init__(self, rank: int, sock: socket.socket) -> None:
+        self.rank = rank
+        self.sock = sock
+        self.ctrl: deque = deque()
+        self.bulk: deque = deque()
+        self.queued_bytes = 0      # backpressure accounting
+        self.cond = threading.Condition()
+        self.writer: Optional[threading.Thread] = None
+        self.goodbye = False       # enqueue-side: shutdown requested
+        self.done = False          # writer exited
+        self.bw_mbps: Optional[float] = None   # send-side link EWMA
+        self.codec: Optional[str] = None       # negotiated at HELLO
+        self.engaged = False                   # compression live now
+        self.frames = 0                        # frames sent (probe clock)
+        self.probe_ratio: Optional[float] = None
+
+
 class TCPCommEngine(LocalCommEngine):
     def __init__(self, rank: int, endpoints: List[Tuple[str, int]],
-                 connect_timeout: float = 30.0) -> None:
+                 connect_timeout: float = 30.0,
+                 coalesce_max_bytes: Optional[int] = None,
+                 chunk_bytes: Optional[int] = None,
+                 compress_threshold_mbps: Optional[float] = None) -> None:
+        from ..utils.params import params
         self._inbox: Fifo = Fifo()
-        self._conns: Dict[int, socket.socket] = {}
-        self._send_locks: Dict[int, threading.Lock] = {}
+        self._peers: Dict[int, _Peer] = {}
         self._recv_threads: List[threading.Thread] = []
         self._closing = False
         self.dead_peers: set = set()
@@ -90,6 +182,30 @@ class TCPCommEngine(LocalCommEngine):
         self._barrier_lock = threading.Lock()
         self._stat_lock = threading.Lock()
         self._conn_cond = threading.Condition()
+        self._xfer_iter = 0
+        self._rx_pending: Dict[int, int] = {}  # peer -> incomplete rx xfers
+        # wire knobs (constructor overrides beat the MCA layer — bench
+        # and tests compare configurations inside one process)
+        self.coalesce_max_bytes = (
+            coalesce_max_bytes if coalesce_max_bytes is not None
+            else params.get_or("comm_coalesce_max_bytes", "sizet", 1 << 16))
+        self.chunk_bytes = max(
+            1, chunk_bytes if chunk_bytes is not None
+            else params.get_or("comm_chunk_bytes", "sizet", 1 << 17))
+        self.compress_threshold_mbps = (
+            compress_threshold_mbps if compress_threshold_mbps is not None
+            else params.get_or("comm_compress_threshold_mbps", "int", 0))
+        self.send_buffer_bytes = max(
+            1, params.get_or("comm_send_buffer_bytes", "sizet", 1 << 26))
+        self._codecs = wire.available_codecs()
+        #: wire fast-path counters (plain dict: obs polls it when
+        #: telemetry is on, nothing on the hot path otherwise)
+        self.wire_stats = {
+            "frames_sent": 0, "msgs_sent": 0, "coalesced_msgs": 0,
+            "batches": 0, "chunks_sent": 0, "chunk_bytes_sent": 0,
+            "frames_compressed": 0, "bytes_precompress": 0,
+            "bytes_postcompress": 0, "msgs_chunked": 0,
+        }
         super().__init__(_FabricShim(len(endpoints)), rank)
         self.endpoints = endpoints
         self.connect_timeout = connect_timeout
@@ -142,7 +258,7 @@ class TCPCommEngine(LocalCommEngine):
                 sock.settimeout(None)
                 (peer,) = struct.unpack("<I", hdr)
                 with self._conn_cond:
-                    known = peer in self._conns
+                    known = peer in self._peers
                 if peer >= self.nb_ranks or peer == self.rank or known:
                     # stray/duplicate connection: never displace a real
                     # peer's socket
@@ -153,120 +269,73 @@ class TCPCommEngine(LocalCommEngine):
             return  # listener closed during fini
 
     def _register_conn(self, peer: int, sock: socket.socket) -> None:
+        p = _Peer(peer, sock)
         with self._conn_cond:
-            self._conns[peer] = sock
-            self._send_locks[peer] = threading.Lock()
+            self._peers[peer] = p
             self._conn_cond.notify_all()
+        p.writer = threading.Thread(
+            target=self._writer_loop, args=(p,), daemon=True,
+            name=f"tcp-send-r{self.rank}p{peer}")
+        p.writer.start()
         t = threading.Thread(target=self._recv_loop, args=(peer, sock),
                              daemon=True, name=f"tcp-recv-r{self.rank}p{peer}")
         t.start()
         self._recv_threads.append(t)
+        # capability advertisement: the receiving end only ever
+        # compresses toward us after seeing this (mixed-version peers
+        # never send one and stay on the uncompressed path)
+        hello = wire.pack_hello({"ver": wire.WIRE_VERSION,
+                                 "rank": self.rank,
+                                 "codecs": self._codecs})
+        with p.cond:
+            p.ctrl.append(("frame", hello))
+            p.queued_bytes += len(hello)
+            p.cond.notify()
 
-    def _conn_to(self, peer: int) -> socket.socket:
+    def _peer_to(self, peer: int) -> _Peer:
         with self._conn_cond:
-            ok = self._conn_cond.wait_for(lambda: peer in self._conns,
+            ok = self._conn_cond.wait_for(lambda: peer in self._peers,
                                           timeout=self.connect_timeout)
             if not ok:
                 raise TimeoutError(
                     f"rank {self.rank}: no connection from rank {peer}")
-            return self._conns[peer]
+            return self._peers[peer]
 
-    # -- framing --------------------------------------------------------
-    @staticmethod
-    def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
-        buf = b""
-        while len(buf) < n:
-            chunk = sock.recv(n - len(buf))
-            if not chunk:
-                return None
-            buf += chunk
-        return buf
+    # kept for tests/back-compat: peer -> socket view
+    @property
+    def _conns(self) -> Dict[int, socket.socket]:
+        with self._conn_cond:
+            return {r: p.sock for r, p in self._peers.items()}
 
-    def _recv_loop(self, peer: int, sock: socket.socket) -> None:
-        try:
-            while True:
-                hdr = self._recv_exact(sock, 8)
-                if hdr is None:
-                    self._peer_died(peer, "peer closed the connection")
-                    return
-                (size,) = struct.unpack("<Q", hdr)
-                if size == GOODBYE:
-                    with self._lock:
-                        owes_us = peer in self._get_srcs.values()
-                    if owes_us:
-                        # "clean" exit while owing rendezvous data is a
-                        # protocol violation — treat as a failure
-                        self._peer_died(
-                            peer, "shut down owing rendezvous data")
-                        return
-                    # orderly shutdown: the peer fini'd after completing
-                    # its work — not a failure, no scary warnings
-                    self.finished_peers.add(peer)
-                    return
-                nb_hdr = self._recv_exact(sock, 4)
-                if nb_hdr is None:
-                    self._peer_died(peer, "connection truncated mid-frame")
-                    return
-                (nbufs,) = struct.unpack("<I", nb_hdr)
-                sizes = []
-                if nbufs:
-                    sz_hdr = self._recv_exact(sock, 8 * nbufs)
-                    if sz_hdr is None:
-                        self._peer_died(peer, "truncated buffer sizes")
-                        return
-                    sizes = list(struct.unpack(f"<{nbufs}Q", sz_hdr))
-                frame = self._recv_exact(sock, size)
-                if frame is None:
-                    self._peer_died(peer, "connection truncated mid-frame")
-                    return
-                bufs = []
-                for bsz in sizes:
-                    b = self._recv_exact(sock, bsz)
-                    if b is None:
-                        self._peer_died(peer, "truncated oob buffer")
-                        return
-                    bufs.append(b)
-                # out-of-band buffers land as-received (zero extra copy);
-                # arrays reconstructed over them are read-only — host
-                # mutators copy-on-write via Data.materialize_host
-                src, tag, payload = pickle.loads(frame, buffers=bufs)
-                self._inbox.push((src, tag, payload))
-                self._notify_arrival()  # wake a parked worker now
-        except OSError as exc:
-            self._peer_died(peer, f"socket error: {exc}")
-            return
-        except Exception as exc:  # frame desync / unpickle failure: a
-            # silent receiver death would hang both ranks — make it loud
-            self._peer_died(peer, f"receiver died: {exc!r}")
-            return
+    def link_bw_mbps(self, peer: int) -> Optional[float]:
+        """Send-side bandwidth EWMA toward ``peer`` in MB/s (None until
+        a large-enough send has been measured). Feeds the adaptive
+        eager/rendezvous cutoff (remote_dep) and the LINK_BW gauges."""
+        p = self._peers.get(peer)
+        return p.bw_mbps if p is not None else None
 
-    def _peer_died(self, peer: int, reason: str) -> None:
-        """Failure detector: a torn connection while we're live marks the
-        peer dead (SURVEY.md §5.3 — the reference has nothing; a dead MPI
-        rank hangs the job). Reporting policy:
+    def chunks_inflight(self) -> int:
+        """Queued-but-unsent chunk SEGMENTS plus receive-side
+        incomplete TRANSFERS (the CHUNKS_INFLIGHT gauge; transfer
+        headers riding the bulk lane are not counted)."""
+        n = 0
+        with self._conn_cond:
+            peers = list(self._peers.values())
+        for p in peers:
+            n += sum(1 for it in p.bulk if it[0] == "chunk")
+        with self._stat_lock:
+            n += sum(self._rx_pending.values())
+        return n
 
-        - any later SEND to the peer raises RankFailedError (always);
-        - the death is reported to the runtime immediately when the peer
-          provably owes us data (a pending rendezvous GET), or always
-          under ``comm_failure_strict`` — strict is off by default
-          because with local termination detection a peer may
-          legitimately fini before our local tail work finishes."""
-        if self._closing or peer in self.dead_peers \
-                or peer in self.finished_peers:
-            return  # clean teardown (ours or theirs), or already reported
-        self.dead_peers.add(peer)
-        plog.warning("tcp rank %d: peer %d presumed FAILED (%s)",
-                     self.rank, peer, reason)
-        cb = self.on_peer_failure
-        if cb is None:
-            return
-        from ..utils.params import params
-        with self._lock:
-            owes_us = peer in self._get_srcs.values()
-        if owes_us or params.get("comm_failure_strict"):
-            cb(peer, reason)
+    def compress_ratio(self) -> Optional[float]:
+        """Cumulative post/pre compression byte ratio (None: nothing
+        was ever compressed)."""
+        with self._stat_lock:
+            pre = self.wire_stats["bytes_precompress"]
+            post = self.wire_stats["bytes_postcompress"]
+        return (post / pre) if pre else None
 
-    # -- the LocalCommEngine transport extension points -----------------
+    # -- send path ------------------------------------------------------
     def send_am(self, dst: int, tag: int, payload: Any) -> None:
         # remote sends serialize via pickle (its own copy); only loopback
         # needs the anti-aliasing wire copy the local fabric applies
@@ -281,10 +350,7 @@ class TCPCommEngine(LocalCommEngine):
         obs.am_sent(self.rank, dst, tag, payload, t0)
 
     def _transport_post(self, dst: int, src: int, tag: int, payload: Any) -> None:
-        if dst in self.dead_peers:
-            raise RankFailedError(dst, "send to failed rank")
-        if dst in self.finished_peers:
-            raise RankFailedError(dst, "send to peer after its clean shutdown")
+        self._check_live(dst)
         if dst == self.rank:
             with self._stat_lock:
                 self.fabric.msg_count += 1
@@ -292,11 +358,17 @@ class TCPCommEngine(LocalCommEngine):
             self._notify_arrival()
             return
         # protocol-5 out-of-band pickling: ndarray payloads are NOT
-        # serialized into the frame — their buffers go straight from the
-        # array to the socket (sendall of a memoryview), the wire's
-        # zero-copy path (ref: the raw MPI sends of remote_dep_mpi.c).
-        # sendall is synchronous, so snapshot semantics are preserved
-        # (the bytes are in kernel buffers before send_am returns).
+        # serialized into the frame — their buffers are collected as
+        # views. Buffers below the chunk threshold are COPIED into the
+        # queued segment here, on the caller's thread (the historical
+        # copy-at-send snapshot semantics: inline activation payloads
+        # may be mutated by a local successor right after this call
+        # returns). Buffers >= the threshold stream as chunks; they
+        # stay zero-copy ONLY when provably immutable (a read-only
+        # buffer export — the rendezvous/wave producers mark their
+        # snapshots so), else they too are copied at enqueue: the
+        # writer drains asynchronously, and a live host tile mutated
+        # after send_am returns must not tear on the wire.
         raw_bufs: list = []
         frame = pickle.dumps((src, tag, payload), protocol=5,
                              buffer_callback=raw_bufs.append)
@@ -312,20 +384,357 @@ class TCPCommEngine(LocalCommEngine):
         with self._stat_lock:
             self.fabric.msg_count += 1
             self.fabric.bytes_count += nbytes
-        hdr = (struct.pack("<Q", len(frame))
-               + struct.pack("<I", len(views))
-               + b"".join(struct.pack("<Q", v.nbytes) for v in views))
-        sock = self._conn_to(dst)
+        peer = self._peer_to(dst)
+        chunk = self.chunk_bytes
+        if all(v.nbytes < chunk for v in views):
+            seg = wire.pack_segment(frame, views)  # copies the views
+            with peer.cond:
+                self._backpressure_wait(peer, dst, len(seg))
+                peer.ctrl.append(("msg", seg))
+                peer.queued_bytes += len(seg)
+                peer.cond.notify()
+            return
+        # chunked path: the header (pickle + small buffers) leads the
+        # BULK lane, followed by each large buffer as bounded chunk
+        # frames — the hdr-before-first-chunk invariant is structural
+        # (bulk is FIFO), never a property of lane priorities.
+        with self._stat_lock:
+            self._xfer_iter += 1
+            xid = (self.rank << 40) | self._xfer_iter
+            self.wire_stats["msgs_chunked"] += 1
+        views = [v if v.nbytes < chunk or v.readonly
+                 else memoryview(bytes(v))  # snapshot mutable bulk now
+                 for v in views]
+        specs = [(v.nbytes >= chunk, v.nbytes,
+                  None if v.nbytes >= chunk else v) for v in views]
+        hdr = wire.pack_xfer_hdr(xid, frame, specs)
+        items = [("frame", hdr)]
+        qbytes = len(hdr)
+        for bidx, v in enumerate(views):
+            if v.nbytes < chunk:
+                continue
+            for off in range(0, v.nbytes, chunk):
+                items.append(("chunk", xid, bidx, off,
+                              v[off:off + chunk]))
+                qbytes += min(chunk, v.nbytes - off)
+        with peer.cond:
+            self._backpressure_wait(peer, dst, qbytes)
+            peer.bulk.extend(items)
+            peer.queued_bytes += qbytes
+            peer.cond.notify()
+
+    def _check_live(self, dst: int) -> None:
+        if dst in self.dead_peers:
+            raise RankFailedError(dst, "send to failed rank")
+        if dst in self.finished_peers:
+            raise RankFailedError(dst, "send to peer after its clean shutdown")
+
+    def _backpressure_wait(self, peer: _Peer, dst: int,
+                           nbytes: int) -> None:
+        """Bounded send buffer (call with ``peer.cond`` held): block
+        while the peer's queued bytes would exceed
+        ``comm_send_buffer_bytes`` — the v1 synchronous-sendall
+        backpressure with a buffer instead of O(one message), so a
+        producer outpacing a slow link stalls instead of queueing an
+        epoch's traffic in RAM. A message larger than the whole buffer
+        is admitted alone into an empty queue. Aborts with
+        RankFailedError when the peer dies while we wait."""
+        limit = self.send_buffer_bytes
+        while peer.queued_bytes > 0 \
+                and peer.queued_bytes + nbytes > limit:
+            self._check_live(dst)
+            if peer.done:
+                raise RankFailedError(dst, "send to failed rank")
+            peer.cond.wait(0.1)
+        self._check_live(dst)
+
+    # -- writer thread --------------------------------------------------
+    def _writer_loop(self, peer: _Peer) -> None:
+        """Drain one peer's queues: coalesce ctrl messages into batch
+        frames (one syscall each), interleave one bulk chunk whenever
+        the ctrl lane is idle, send the GOODBYE sentinel last."""
+        coalesce = self.coalesce_max_bytes
+        ctrl_streak = 0
         try:
-            with self._send_locks[dst]:
-                sock.sendall(hdr + frame)
-                for v in views:
-                    sock.sendall(v)
+            while True:
+                pieces: Optional[List[Any]] = None
+                nmsgs = 0
+                deq_bytes = 0
+                is_goodbye = False
+                with peer.cond:
+                    while not peer.ctrl and not peer.bulk \
+                            and not peer.goodbye \
+                            and peer.rank not in self.dead_peers:
+                        peer.cond.wait()
+                    if peer.rank in self.dead_peers:
+                        return   # _peer_died notified us: stop (finally
+                        #          drops whatever is still queued)
+                    take_ctrl = bool(peer.ctrl) and (
+                        not peer.bulk or ctrl_streak < _CTRL_STREAK_MAX)
+                    if take_ctrl:
+                        kind = peer.ctrl[0][0]
+                        if kind == "msg":
+                            segs = [peer.ctrl.popleft()[1]]
+                            total = len(segs[0])
+                            while (peer.ctrl
+                                   and peer.ctrl[0][0] == "msg"
+                                   and len(segs) < _MAX_BATCH_MSGS
+                                   and total + len(peer.ctrl[0][1])
+                                   <= coalesce):
+                                seg = peer.ctrl.popleft()[1]
+                                segs.append(seg)
+                                total += len(seg)
+                            pieces = wire.pack_batch(segs)
+                            nmsgs = len(segs)
+                            deq_bytes = total
+                        else:  # standalone frame (hello)
+                            body = peer.ctrl.popleft()[1]
+                            pieces = [body]
+                            deq_bytes = len(body)
+                        # the streak only counts ctrl frames sent WHILE
+                        # bulk was waiting (the starvation being bounded)
+                        ctrl_streak = ctrl_streak + 1 if peer.bulk else 0
+                    elif peer.bulk:
+                        item = peer.bulk.popleft()
+                        ctrl_streak = 0
+                        if item[0] == "frame":  # chunked-transfer header
+                            pieces = [item[1]]
+                            deq_bytes = len(item[1])
+                        else:
+                            _k, xid, bidx, off, view = item
+                            pieces = [wire.pack_chunk_hdr(xid, bidx, off),
+                                      view]
+                            deq_bytes = view.nbytes
+                            with self._stat_lock:
+                                self.wire_stats["chunks_sent"] += 1
+                                self.wire_stats["chunk_bytes_sent"] += \
+                                    view.nbytes
+                    else:  # goodbye, and both queues drained
+                        is_goodbye = True
+                if is_goodbye:
+                    try:
+                        peer.sock.sendall(struct.pack("<Q", GOODBYE))
+                    except OSError:
+                        pass
+                    return
+                pieces = self._maybe_compress(peer, pieces)
+                body_len = sum(len(p) if isinstance(p, (bytes, bytearray))
+                               else p.nbytes for p in pieces)
+                t0 = time.monotonic()
+                _sendall_vec(peer.sock,
+                             [struct.pack("<Q", body_len)] + pieces)
+                dt = time.monotonic() - t0
+                with peer.cond:  # release the backpressure budget
+                    peer.queued_bytes -= deq_bytes
+                    peer.cond.notify_all()
+                if body_len >= _BW_SAMPLE_MIN and dt > 0:
+                    inst = body_len / dt / 1e6
+                    peer.bw_mbps = (inst if peer.bw_mbps is None else
+                                    (1 - _BW_ALPHA) * peer.bw_mbps
+                                    + _BW_ALPHA * inst)
+                with self._stat_lock:
+                    peer.frames += 1
+                    self.wire_stats["frames_sent"] += 1
+                    if nmsgs:
+                        self.wire_stats["msgs_sent"] += nmsgs
+                        self.wire_stats["batches"] += 1
+                        if nmsgs > 1:
+                            self.wire_stats["coalesced_msgs"] += nmsgs
         except OSError as exc:
             # the send side can see the crash before the receiver thread
-            # does — the RankFailedError contract holds either way
-            self._peer_died(dst, f"send failed: {exc}")
-            raise RankFailedError(dst, f"send failed: {exc}") from exc
+            # does — later sends raise RankFailedError via dead_peers.
+            # send_am already returned for the frame that just failed
+            # (and anything still queued): an ACCEPTED send was LOST, so
+            # the death is reported to the runtime unconditionally
+            # (lost_sends) — the v1 path raised RankFailedError to the
+            # caller here, and a silent drop would trade that loud abort
+            # for a termdet hang.
+            self._peer_died(peer.rank, f"send failed: {exc}",
+                            lost_sends=True)
+        finally:
+            peer.done = True
+            with peer.cond:
+                dropped = len(peer.ctrl) + len(peer.bulk)
+                peer.ctrl.clear()
+                peer.bulk.clear()
+                peer.queued_bytes = 0
+                peer.cond.notify_all()
+            if dropped and not self._closing:
+                plog.warning(
+                    "tcp rank %d: dropped %d queued frame(s)/chunk(s) "
+                    "to dead peer %d", self.rank, dropped, peer.rank)
+
+    def _maybe_compress(self, peer: _Peer, pieces: List[Any]) -> List[Any]:
+        """Engage per-link compression when (a) the peer advertised a
+        common codec, (b) the measured bandwidth EWMA sits below the
+        MCA threshold (default 0 = never), and (c) a sample probe shows
+        the traffic actually compresses. Re-probes periodically so a
+        shift to incompressible payloads backs off."""
+        threshold = self.compress_threshold_mbps
+        codec = peer.codec
+        if not threshold or codec is None:
+            return pieces
+        bw = peer.bw_mbps
+        if bw is None or bw >= threshold:
+            return pieces
+        body_len = sum(len(p) if isinstance(p, (bytes, bytearray))
+                       else p.nbytes for p in pieces)
+        if body_len < _COMP_MIN_BYTES:
+            return pieces
+        probing = (peer.probe_ratio is None
+                   or peer.frames % _PROBE_EVERY == 0)
+        if not probing and not peer.engaged:
+            return pieces   # before the join: no copy between probes
+        body = b"".join(bytes(p) for p in pieces)
+        out = wire.compress_body(body, codec)
+        if probing:
+            # the probe IS this frame's compression — measured once,
+            # reused as the payload when it engages
+            peer.probe_ratio = (sum(len(p) for p in out) / len(body)
+                                if out is not None else 1.0)
+            peer.engaged = peer.probe_ratio <= _PROBE_RATIO
+            if not peer.engaged:
+                return pieces
+        if out is None:
+            return pieces
+        with self._stat_lock:
+            self.wire_stats["frames_compressed"] += 1
+            self.wire_stats["bytes_precompress"] += len(body)
+            self.wire_stats["bytes_postcompress"] += \
+                sum(len(p) for p in out)
+        return out
+
+    # -- receive path ---------------------------------------------------
+    @staticmethod
+    def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def _recv_loop(self, peer: int, sock: socket.socket) -> None:
+        xfers: Dict[int, wire.RxXfer] = {}  # this connection's partials
+        try:
+            while True:
+                hdr = self._recv_exact(sock, 8)
+                if hdr is None:
+                    self._peer_died(peer, "peer closed the connection")
+                    return
+                (size,) = struct.unpack("<Q", hdr)
+                if size == GOODBYE:
+                    with self._lock:
+                        owes_us = peer in self._get_srcs.values()
+                    if owes_us or xfers:
+                        # "clean" exit while owing rendezvous data or
+                        # mid-chunked-transfer is a protocol violation —
+                        # treat as a failure
+                        self._peer_died(
+                            peer, "shut down owing rendezvous data")
+                        return
+                    # orderly shutdown: the peer fini'd after completing
+                    # its work — not a failure, no scary warnings
+                    self.finished_peers.add(peer)
+                    return
+                body = self._recv_exact(sock, size)
+                if body is None:
+                    self._peer_died(peer, "connection truncated mid-frame")
+                    return
+                self._dispatch_body(peer, memoryview(body), xfers)
+        except OSError as exc:
+            self._peer_died(peer, f"socket error: {exc}")
+            return
+        except Exception as exc:  # frame desync / unpickle failure: a
+            # silent receiver death would hang both ranks — make it loud
+            self._peer_died(peer, f"receiver died: {exc!r}")
+            return
+        finally:
+            if xfers:
+                with self._stat_lock:
+                    self._rx_pending.pop(peer, None)
+
+    def _dispatch_body(self, peer: int, body: memoryview,
+                       xfers: Dict[int, wire.RxXfer]) -> None:
+        kind = body[0]
+        if kind == wire.K_BATCH:
+            for frame, bufs in wire.parse_batch(body):
+                # out-of-band buffers alias the received body (zero
+                # extra copy); arrays reconstructed over them are
+                # read-only — host mutators copy-on-write via
+                # Data.materialize_host
+                src, tag, payload = wire.load_message(frame, bufs)
+                self._inbox.push((src, tag, payload))
+                self._notify_arrival()  # wake a parked worker now
+        elif kind == wire.K_XFER_HDR:
+            xid, frame, specs = wire.parse_xfer_hdr(body)
+            rx = wire.RxXfer(frame, specs)
+            if rx.remaining <= 0:
+                src, tag, payload = rx.message()
+                self._inbox.push((src, tag, payload))
+                self._notify_arrival()
+                return
+            xfers[xid] = rx
+            with self._stat_lock:
+                self._rx_pending[peer] = len(xfers)
+        elif kind == wire.K_CHUNK:
+            xid, bidx, off, data = wire.parse_chunk(body)
+            rx = xfers.get(xid)
+            if rx is None:
+                raise ValueError(f"chunk for unknown transfer {xid}")
+            if rx.feed(bidx, off, data):
+                del xfers[xid]
+                with self._stat_lock:
+                    self._rx_pending[peer] = len(xfers)
+                src, tag, payload = rx.message()
+                self._inbox.push((src, tag, payload))
+                self._notify_arrival()
+        elif kind == wire.K_HELLO:
+            info = wire.parse_hello(body)
+            p = self._peers.get(peer)
+            if p is not None:
+                p.codec = wire.negotiate_codec(
+                    self._codecs, info.get("codecs", ()))
+        elif kind == wire.K_COMP:
+            self._dispatch_body(peer, memoryview(
+                wire.decompress_body(body)), xfers)
+        else:
+            raise ValueError(f"unknown frame kind {kind}")
+
+    def _peer_died(self, peer: int, reason: str,
+                   lost_sends: bool = False) -> None:
+        """Failure detector: a torn connection while we're live marks the
+        peer dead (SURVEY.md §5.3 — the reference has nothing; a dead MPI
+        rank hangs the job). Reporting policy:
+
+        - any later SEND to the peer raises RankFailedError (always);
+        - the death is reported to the runtime immediately when the peer
+          provably owes us data (a pending rendezvous GET), when
+          accepted-but-unsent frames were LOST with it (``lost_sends``
+          — the writer path; the caller already returned believing the
+          send succeeded), or always under ``comm_failure_strict`` —
+          strict is off by default because with local termination
+          detection a peer may legitimately fini before our local tail
+          work finishes."""
+        if self._closing or peer in self.dead_peers \
+                or peer in self.finished_peers:
+            return  # clean teardown (ours or theirs), or already reported
+        self.dead_peers.add(peer)
+        p = self._peers.get(peer)
+        if p is not None:
+            with p.cond:  # unblock anything parked on the writer
+                p.cond.notify_all()
+        plog.warning("tcp rank %d: peer %d presumed FAILED (%s)",
+                     self.rank, peer, reason)
+        cb = self.on_peer_failure
+        if cb is None:
+            return
+        from ..utils.params import params
+        with self._lock:
+            owes_us = peer in self._get_srcs.values()
+        if owes_us or lost_sends or params.get("comm_failure_strict"):
+            cb(peer, reason)
 
     def _transport_drain(self):
         while True:
@@ -405,25 +814,51 @@ class TCPCommEngine(LocalCommEngine):
 
     def fini(self) -> None:
         self._closing = True
-        # clean goodbye so live peers see an orderly shutdown, not a crash
-        for peer, sock in list(self._conns.items()):
-            if peer in self.dead_peers or peer in self.finished_peers:
+        # clean goodbye so live peers see an orderly shutdown, not a
+        # crash. The writer sends it only after BOTH queues drain (the
+        # final results / termdet messages must precede it), so fini
+        # waits for the writers to flush before tearing sockets down.
+        with self._conn_cond:
+            peers = dict(self._peers)
+        for rank_, p in peers.items():
+            if rank_ in self.dead_peers or rank_ in self.finished_peers:
                 continue
-            try:
-                with self._send_locks[peer]:
-                    sock.sendall(struct.pack("<Q", GOODBYE))
-            except OSError:
-                pass
+            with p.cond:
+                p.goodbye = True
+                p.cond.notify()
+        # progress-aware flush: a slow link draining a large bulk
+        # backlog gets as long as it keeps moving bytes (the links this
+        # wire targets run at single-digit MB/s); only a STALLED writer
+        # (15 s with zero queue progress) is abandoned
+        live = [p for r, p in peers.items()
+                if r not in self.dead_peers
+                and r not in self.finished_peers and p.writer is not None]
+        prev = None
+        stall = time.time() + 15.0
+        while True:
+            live = [p for p in live if p.writer.is_alive()]
+            if not live:
+                break
+            cur = sum(len(p.ctrl) + len(p.bulk) for p in live)
+            if prev is None or cur < prev:
+                prev = cur
+                stall = time.time() + 15.0
+            if time.time() > stall:
+                plog.warning(
+                    "tcp rank %d: %d writer(s) stalled with %d queued "
+                    "frame(s) at shutdown", self.rank, len(live), cur)
+                break
+            time.sleep(0.02)
         try:
             self._listener.close()
         except OSError:
             pass
-        for sock in self._conns.values():
+        for p in peers.values():
             try:
-                sock.shutdown(socket.SHUT_RDWR)
+                p.sock.shutdown(socket.SHUT_RDWR)
             except OSError:
                 pass
             try:
-                sock.close()
+                p.sock.close()
             except OSError:
                 pass

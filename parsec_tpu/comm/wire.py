@@ -1,0 +1,264 @@
+"""Wire framing for the TCP transport: the comm-engine fast path.
+
+Frame format (v2). Every frame on a connection is::
+
+    <u64 body_len> <body>
+
+``body_len == GOODBYE`` (2**64-1) is the clean-shutdown sentinel (no
+body follows). Otherwise the body's first byte is a *kind*:
+
+- ``K_BATCH``: one or more complete active messages coalesced into a
+  single frame (ONE syscall per batch on the send side). Each message
+  segment is ``<u32 pickle_len> <u32 nbufs> [<u64 size>]*nbufs
+  <pickle> <buf bytes>*`` — the pickle-5 frame plus its out-of-band
+  buffers, copied in-band at enqueue time (all are below the chunk
+  threshold by construction, so the copy is small and preserves the
+  historical copy-at-send snapshot semantics).
+- ``K_XFER_HDR``: header of a chunked message — a message whose
+  payload carries at least one buffer >= the chunk threshold. The
+  pickle frame and the small buffers ride in the header; each large
+  buffer is announced (size only) and its bytes follow as ``K_CHUNK``
+  frames, interleavable with control traffic.
+- ``K_CHUNK``: one bounded segment of one announced buffer
+  (``<u64 xfer_id> <u32 buf_index> <u64 offset> <bytes>``). The
+  receiver reassembles; the message is delivered when every announced
+  byte has landed. Chunks of one transfer are FIFO; *other* frames may
+  interleave between them — that is the point (no head-of-line
+  blocking of small control AMs behind a multi-MB payload).
+- ``K_HELLO``: capability advertisement sent once per connection right
+  after the rank handshake (``{"ver", "codecs", "rank"}``). A peer
+  that never sends one (mixed version) simply never negotiates a
+  codec, so compression silently stays off toward it.
+- ``K_COMP``: a compressed *body* (kind byte included) of any of the
+  above: ``<u8 codec_id> <u64 raw_len> <compressed>``. Only emitted
+  toward peers that advertised the codec.
+
+All integers little-endian, matching the v1 framing.
+"""
+from __future__ import annotations
+
+import pickle
+import struct
+import zlib
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+GOODBYE = (1 << 64) - 1  # frame-size sentinel: clean shutdown, not a crash
+
+K_BATCH = 0
+K_XFER_HDR = 1
+K_CHUNK = 2
+K_HELLO = 3
+K_COMP = 4
+
+WIRE_VERSION = 2
+
+_U64 = struct.Struct("<Q")
+_U32 = struct.Struct("<I")
+_SEG = struct.Struct("<II")          # pickle_len, nbufs
+_BATCH = struct.Struct("<BI")        # kind, nmsgs
+_XFER = struct.Struct("<BQII")       # kind, xfer_id, pickle_len, nbufs
+_BUFSPEC = struct.Struct("<BQ")      # chunked?, size
+_CHUNK = struct.Struct("<BQIQ")      # kind, xfer_id, buf_index, offset
+_COMP = struct.Struct("<BBQ")        # kind, codec_id, raw_len
+
+
+# -- codecs -------------------------------------------------------------
+def _lz4_mod():
+    try:
+        import lz4.frame as _lz4
+        return _lz4
+    except ImportError:
+        return None
+
+
+#: name -> (wire id, compress, decompress); lz4 is optional — absent
+#: installs simply don't advertise it at the handshake
+CODECS: Dict[str, Tuple[int, Any, Any]] = {
+    "zlib": (1, lambda b: zlib.compress(b, 1), zlib.decompress),
+}
+if _lz4_mod() is not None:  # pragma: no cover - env without lz4
+    _l = _lz4_mod()
+    CODECS["lz4"] = (2, _l.compress, _l.decompress)
+
+_CODEC_BY_ID = {cid: (name, comp, dec)
+                for name, (cid, comp, dec) in CODECS.items()}
+
+#: preference order when both ends support several
+_CODEC_PREF = ("lz4", "zlib")
+
+
+def available_codecs() -> List[str]:
+    return sorted(CODECS)
+
+
+def negotiate_codec(mine: Sequence[str],
+                    theirs: Sequence[str]) -> Optional[str]:
+    """Pick the preferred codec both ends advertised (None: no common
+    codec — e.g. a mixed-version peer that never sent a HELLO)."""
+    common = set(mine) & set(theirs)
+    for name in _CODEC_PREF:
+        if name in common:
+            return name
+    return sorted(common)[0] if common else None
+
+
+# -- message segments (K_BATCH) -----------------------------------------
+def pack_segment(frame: bytes, bufs: Sequence[Any]) -> bytes:
+    """One in-band message segment: pickle frame + copied buffers."""
+    parts = [_SEG.pack(len(frame), len(bufs))]
+    parts += [_U64.pack(len(b) if isinstance(b, (bytes, bytearray))
+                        else b.nbytes) for b in bufs]
+    parts.append(frame)
+    parts += [bytes(b) for b in bufs]
+    return b"".join(parts)
+
+
+def pack_batch(segments: Sequence[bytes]) -> List[bytes]:
+    """Body pieces of a K_BATCH frame holding ``segments`` messages."""
+    return [_BATCH.pack(K_BATCH, len(segments)), *segments]
+
+
+def parse_batch(body: memoryview) -> Iterator[Tuple[memoryview,
+                                                    List[memoryview]]]:
+    """Yield (pickle_frame, [buffers]) per coalesced message. The
+    yielded views alias ``body`` — zero extra copy on the receive
+    side; arrays reconstructed over them are read-only."""
+    _kind, nmsgs = _BATCH.unpack_from(body, 0)
+    off = _BATCH.size
+    for _ in range(nmsgs):
+        flen, nbufs = _SEG.unpack_from(body, off)
+        off += _SEG.size
+        sizes = [_U64.unpack_from(body, off + 8 * i)[0]
+                 for i in range(nbufs)]
+        off += 8 * nbufs
+        frame = body[off:off + flen]
+        off += flen
+        bufs = []
+        for sz in sizes:
+            bufs.append(body[off:off + sz])
+            off += sz
+        yield frame, bufs
+    if off != len(body):
+        raise ValueError(
+            f"batch frame desync: parsed {off} of {len(body)} bytes")
+
+
+# -- chunked transfers (K_XFER_HDR / K_CHUNK) ---------------------------
+def pack_xfer_hdr(xfer_id: int, frame: bytes,
+                  bufspecs: Sequence[Tuple[bool, int, Optional[Any]]]
+                  ) -> bytes:
+    """Header of a chunked message. ``bufspecs``: per pickle-5 buffer,
+    (chunked, size, inline_bytes-or-None) in buffer order; chunked
+    buffers announce size only, their bytes follow as K_CHUNK frames."""
+    parts = [_XFER.pack(K_XFER_HDR, xfer_id, len(frame), len(bufspecs))]
+    parts += [_BUFSPEC.pack(1 if chunked else 0, size)
+              for (chunked, size, _b) in bufspecs]
+    parts.append(frame)
+    parts += [bytes(b) for (chunked, _s, b) in bufspecs if not chunked]
+    return b"".join(parts)
+
+
+def parse_xfer_hdr(body: memoryview) -> Tuple[int, memoryview,
+                                              List[Tuple[bool, int,
+                                                         Optional[memoryview]]]]:
+    _kind, xfer_id, flen, nbufs = _XFER.unpack_from(body, 0)
+    off = _XFER.size
+    specs = []
+    for i in range(nbufs):
+        chunked, size = _BUFSPEC.unpack_from(body, off)
+        specs.append([bool(chunked), size, None])
+        off += _BUFSPEC.size
+    frame = body[off:off + flen]
+    off += flen
+    for spec in specs:
+        if not spec[0]:
+            spec[2] = body[off:off + spec[1]]
+            off += spec[1]
+    if off != len(body):
+        raise ValueError(
+            f"xfer header desync: parsed {off} of {len(body)} bytes")
+    return xfer_id, frame, [tuple(s) for s in specs]
+
+
+def pack_chunk_hdr(xfer_id: int, buf_index: int, offset: int) -> bytes:
+    return _CHUNK.pack(K_CHUNK, xfer_id, buf_index, offset)
+
+
+def parse_chunk(body: memoryview) -> Tuple[int, int, int, memoryview]:
+    _kind, xfer_id, buf_index, offset = _CHUNK.unpack_from(body, 0)
+    return xfer_id, buf_index, offset, body[_CHUNK.size:]
+
+
+class RxXfer:
+    """Receive-side reassembly of one chunked message."""
+
+    __slots__ = ("frame", "bufs", "remaining", "nbytes")
+
+    def __init__(self, frame: memoryview,
+                 bufspecs: Sequence[Tuple[bool, int, Optional[memoryview]]]
+                 ) -> None:
+        # the pickle frame must outlive the enclosing frame body
+        self.frame = bytes(frame)
+        self.bufs: List[Any] = []
+        self.remaining = 0
+        self.nbytes = len(self.frame)
+        for (chunked, size, inline) in bufspecs:
+            self.nbytes += size
+            if chunked:
+                self.bufs.append(bytearray(size))
+                self.remaining += size
+            else:
+                self.bufs.append(bytes(inline))
+
+    def feed(self, buf_index: int, offset: int, data: memoryview) -> bool:
+        """Land one chunk; True when the whole message has arrived."""
+        buf = self.bufs[buf_index]
+        if not isinstance(buf, bytearray):
+            raise ValueError(f"chunk for non-chunked buffer {buf_index}")
+        n = len(data)
+        if offset + n > len(buf):
+            raise ValueError(
+                f"chunk overruns buffer {buf_index}: "
+                f"{offset}+{n} > {len(buf)}")
+        buf[offset:offset + n] = data
+        self.remaining -= n
+        return self.remaining <= 0
+
+    def message(self) -> Any:
+        return pickle.loads(self.frame, buffers=self.bufs)
+
+
+def load_message(frame: memoryview, bufs: Sequence[Any]) -> Any:
+    """Unpickle one (src, tag, payload) message segment."""
+    return pickle.loads(frame, buffers=list(bufs))
+
+
+# -- hello / compression ------------------------------------------------
+def pack_hello(info: Dict[str, Any]) -> bytes:
+    return bytes([K_HELLO]) + pickle.dumps(info, protocol=4)
+
+
+def parse_hello(body: memoryview) -> Dict[str, Any]:
+    return pickle.loads(body[1:])
+
+
+def compress_body(body: bytes, codec: str) -> Optional[List[bytes]]:
+    """K_COMP pieces for ``body``, or None when compression does not
+    pay (the compressed form is not smaller)."""
+    cid, comp, _dec = CODECS[codec]
+    out = comp(body)
+    if len(out) + _COMP.size >= len(body):
+        return None
+    return [_COMP.pack(K_COMP, cid, len(body)), out]
+
+
+def decompress_body(body: memoryview) -> bytes:
+    _kind, cid, raw_len = _COMP.unpack_from(body, 0)
+    ent = _CODEC_BY_ID.get(cid)
+    if ent is None:
+        raise ValueError(f"unknown compression codec id {cid}")
+    out = ent[2](bytes(body[_COMP.size:]))
+    if len(out) != raw_len:
+        raise ValueError(
+            f"decompressed length {len(out)} != announced {raw_len}")
+    return out

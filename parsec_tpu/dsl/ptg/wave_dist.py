@@ -54,7 +54,74 @@ from .wave import WaveError, WaveRunner
 __all__ = ["TAG_WAVE", "DistWaveRunner"]
 
 TAG_WAVE = TAG_USER_BASE - 4
+TAG_WAVE_CFG = TAG_USER_BASE - 5
 _LANE_RDV_LOCK = threading.Lock()
+
+
+def _ensure_cfg_inbox(ce):
+    """Per-CE store for lane-config digests ((src, seq) -> digest)."""
+    ent = getattr(ce, "_wave_cfg_inbox", None)
+    if ent is None:
+        cv = threading.Condition()
+        vals: Dict[Tuple[int, int], str] = {}
+        ent = ce._wave_cfg_inbox = (vals, cv)
+        ce._wave_cfg_seq = 0
+
+        def _on_cfg(src: int, msg: Dict) -> None:
+            with cv:
+                vals[(src, msg["seq"])] = msg["digest"]
+                cv.notify_all()
+
+        ce.tag_register(TAG_WAVE_CFG, _on_cfg)
+    return ent
+
+
+def check_lane_schedule_uniformity(ce, digest: str,
+                                   timeout: float = 30.0) -> None:
+    """All-exchange a hash of the lane-scheduling params and fail fast
+    on divergence (ADVICE r5): multiproc lane schedules are a pure
+    function of (``wave_dist_collective``, ``wave_dist_collective_min_pct``)
+    — if any process resolves them differently it skips a global
+    all-reduce the others block in, a distributed hang until timeout.
+    A digest mismatch (or a peer that never answers because its params
+    routed it elsewhere) raises WaveError at runner setup instead."""
+    if ce.nb_ranks < 2:
+        return
+    vals, cv = _ensure_cfg_inbox(ce)
+    with cv:   # seq per exchange: runners are constructed SPMD, so the
+        seq = ce._wave_cfg_seq   # n-th exchange pairs up on every rank
+        ce._wave_cfg_seq = seq + 1
+    for r in range(ce.nb_ranks):
+        if r != ce.rank:
+            ce.send_am(r, TAG_WAVE_CFG, {"seq": seq, "digest": digest})
+    deadline = time.monotonic() + timeout
+    for r in range(ce.nb_ranks):
+        if r == ce.rank:
+            continue
+        while True:
+            with cv:
+                got = vals.get((r, seq))
+            if got is not None:
+                break
+            if time.monotonic() > deadline:
+                raise WaveError(
+                    f"rank {ce.rank}: no lane-schedule config from rank "
+                    f"{r} within {timeout}s — wave_dist_collective / "
+                    f"wave_dist_collective_min_pct likely diverge "
+                    f"across processes (they must be identical "
+                    f"everywhere)")
+            ce.progress()
+            with cv:
+                cv.wait(0.0005)
+        if got != digest:
+            raise WaveError(
+                f"rank {ce.rank}: lane-schedule params diverge from "
+                f"rank {r} (hash {got!r} != {digest!r}): "
+                f"wave_dist_collective and wave_dist_collective_min_pct "
+                f"must be identical on every process")
+    with cv:
+        for r in range(ce.nb_ranks):
+            vals.pop((r, seq), None)
 
 
 def _ensure_wave_inbox(ce):
@@ -293,6 +360,7 @@ class DistWaveRunner(WaveRunner):
         self._rank_of_task = self._compute_task_ranks()
         self._levels = self._compute_levels()
         self._setup_collective_lane()
+        self._check_lane_uniformity()
         self._build_comm_schedule()
         self._build_local_maps()
         self._scatter_kerns: Dict[int, Any] = {}
@@ -373,6 +441,31 @@ class DistWaveRunner(WaveRunner):
             if mode == "on":
                 raise
             self._lane = None   # auto: no usable substrate -> trees
+
+    def _check_lane_uniformity(self) -> None:
+        """Enforce SPMD-identical lane scheduling on MULTIPROC
+        deployments (one jax process per rank): exchange a hash of the
+        lane params over the CE and fail fast on mismatch instead of
+        hanging in a half-joined all-reduce. In-process SPMD rank
+        threads share one params registry, so uniformity holds by
+        construction and the exchange is skipped."""
+        if self.nb_ranks < 2:
+            return
+        try:
+            import jax
+            if jax.process_count() != self.nb_ranks:
+                return
+        except Exception:
+            return
+        import hashlib
+        from ...utils.params import params
+        mode = str(params.get_or("wave_dist_collective", "string", "auto"))
+        min_pct = int(params.get_or(
+            "wave_dist_collective_min_pct", "int", 50))
+        digest = hashlib.sha1(
+            repr((mode, min_pct)).encode()).hexdigest()
+        check_lane_schedule_uniformity(
+            self.ce, digest, timeout=min(30.0, self.comm_timeout))
 
     # ------------------------------------------------------------------ #
     # static analysis                                                    #
@@ -669,7 +762,23 @@ class DistWaveRunner(WaveRunner):
             sh = self._pool_shapes[cid]
             dt = getattr(coll, "dtype", None)
             if sh is None or dt is None:
-                c0 = self._pool_coords[cid][0]
+                # materialize a LOCALLY-OWNED tile only: on multiproc a
+                # non-member rank reaches this for pools it stages
+                # nothing of, and the pool's first global coord may
+                # live on another rank — data_of there would fail or
+                # fetch remote bytes. Without an owned coord the
+                # collection must declare the static contract.
+                c0 = next(
+                    (c for c in self._pool_coords[cid]
+                     if int(coll.rank_of(*c)) == self.rank), None)
+                if c0 is None:
+                    raise WaveError(
+                        f"rank {self.rank}: collection "
+                        f"{self.pool_names[cid]!r} declares no static "
+                        f"tile_shape/dtype and this rank owns no tile "
+                        f"of the pool — the collective lane requires "
+                        f"the static contract on non-member ranks (set "
+                        f"tile_shape/dtype on the collection)")
                 arr = np.asarray(
                     coll.data_of(*c0).sync_to_host().payload)
                 sh = tuple(arr.shape) if sh is None else sh
@@ -745,6 +854,7 @@ class DistWaveRunner(WaveRunner):
         self._fwd_host_stacks = 0
         self._fwd_device_stacks = 0
         self._lane_calls = 0
+        self._lane_joins = 0
         self._lane_tiles = 0
 
         ok = False
@@ -780,6 +890,7 @@ class DistWaveRunner(WaveRunner):
                 "collective_lane": (self._lane.mode
                                     if self._lane is not None else None),
                 "collective_calls": self._lane_calls,
+                "collective_joins": self._lane_joins,
                 "collective_tiles": self._lane_tiles,
                 "device_plane": (getattr(self.ce, "device_plane",
                                          None) is not None
@@ -865,9 +976,13 @@ class DistWaveRunner(WaveRunner):
                 # multiproc: the global mesh — non-members contributed
                 # zeros and drop the result below
                 members=None if multiproc else members)
-            self._lane_calls += 1
             if not member:
-                continue   # joined the SPMD call; nothing staged here
+                # joined the SPMD call with zero contributions (ADVICE
+                # r5): counted apart so collective_calls keeps meaning
+                # 'collectives that carried MY tiles'
+                self._lane_joins += 1
+                continue
+            self._lane_calls += 1
             vals = out[:n]
             if _is_single_device(plist[cid]):
                 dev = next(iter(plist[cid].devices()))
@@ -947,7 +1062,15 @@ class DistWaveRunner(WaveRunner):
                         colls.append((cid, idxs,
                                       {"xfer": (u, tuple(shape), dt)}))
                     else:
-                        colls.append((cid, idxs, np.asarray(gathered)))
+                        payload = np.asarray(gathered)
+                        try:
+                            # fresh gathered stack, mutated by no one:
+                            # read-only lets the TCP chunk path send it
+                            # zero-copy instead of re-snapshotting
+                            payload.setflags(write=False)
+                        except ValueError:
+                            pass   # foreign-base view: already safe
+                        colls.append((cid, idxs, payload))
                     self._sent_tiles += len(idxs)
                 self.ce.send_am(dst, TAG_WAVE,
                                 {"pool": pool_name, "epoch": epoch,

@@ -30,7 +30,9 @@ from .metrics import (COMM_XFER_SECONDS, TASK_EXEC_SECONDS, Histogram,
 from .prometheus import (fleet_to_prometheus, parse_exposition, render,
                          sanitize_name)
 from .spans import (COMM_ACTIVE_TRANSFERS, COMM_BYTES_RECEIVED,
-                    COMM_BYTES_SENT, COMM_MSGS_RECEIVED, COMM_MSGS_SENT,
+                    COMM_BYTES_SENT, COMM_CHUNKS_INFLIGHT, COMM_COALESCED,
+                    COMM_COMPRESS_RATIO, COMM_LINK_BW_PREFIX,
+                    COMM_MSGS_RECEIVED, COMM_MSGS_SENT,
                     COMM_PENDING_MESSAGES, CommObs, DeviceObs,
                     payload_nbytes, register_device_gauges)
 
@@ -39,6 +41,8 @@ __all__ = [
     "CommObs", "DeviceObs", "payload_nbytes",
     "COMM_BYTES_SENT", "COMM_BYTES_RECEIVED", "COMM_MSGS_SENT",
     "COMM_MSGS_RECEIVED", "COMM_ACTIVE_TRANSFERS", "COMM_PENDING_MESSAGES",
+    "COMM_COALESCED", "COMM_CHUNKS_INFLIGHT", "COMM_COMPRESS_RATIO",
+    "COMM_LINK_BW_PREFIX",
     "TASK_EXEC_SECONDS", "COMM_XFER_SECONDS",
     "render", "parse_exposition", "sanitize_name", "fleet_to_prometheus",
     "analyze", "critical_path", "format_report", "parse_dot",

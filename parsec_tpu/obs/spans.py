@@ -23,6 +23,8 @@ __all__ = ["CommObs", "DeviceObs", "register_device_gauges",
            "COMM_BYTES_SENT", "COMM_BYTES_RECEIVED",
            "COMM_MSGS_SENT", "COMM_MSGS_RECEIVED",
            "COMM_ACTIVE_TRANSFERS", "COMM_PENDING_MESSAGES",
+           "COMM_COALESCED", "COMM_CHUNKS_INFLIGHT",
+           "COMM_COMPRESS_RATIO", "COMM_LINK_BW_PREFIX",
            "payload_nbytes"]
 
 COMM_BYTES_SENT = "PARSEC::COMM::BYTES_SENT"
@@ -31,6 +33,14 @@ COMM_MSGS_SENT = "PARSEC::COMM::MSGS_SENT"
 COMM_MSGS_RECEIVED = "PARSEC::COMM::MSGS_RECEIVED"
 COMM_ACTIVE_TRANSFERS = "PARSEC::COMM::ACTIVE_TRANSFERS"
 COMM_PENDING_MESSAGES = "PARSEC::COMM::PENDING_MESSAGES"
+# wire fast-path telemetry (TCP transport): messages that rode a
+# multi-message coalesced frame, chunked-transfer segments in flight,
+# cumulative compressed/raw byte ratio, and per-peer send-bandwidth
+# EWMA gauges (PARSEC::COMM::LINK_BW::R<peer>, MB/s)
+COMM_COALESCED = "PARSEC::COMM::COALESCED"
+COMM_CHUNKS_INFLIGHT = "PARSEC::COMM::CHUNKS_INFLIGHT"
+COMM_COMPRESS_RATIO = "PARSEC::COMM::COMPRESS_RATIO"
+COMM_LINK_BW_PREFIX = "PARSEC::COMM::LINK_BW"
 
 #: trace stream ids (outside any plausible worker th_id range)
 COMM_STREAM_TID = 1 << 20
@@ -153,14 +163,37 @@ class CommObs:
     # -- engine gauge wiring -------------------------------------------------
     def register_engine_gauges(self, ce: Any) -> None:
         """Pull gauges over the engine's live queues: outstanding GET
-        tokens (ACTIVE_TRANSFERS) and not-yet-deliverable deferred
-        messages (PENDING_MESSAGES)."""
+        tokens (ACTIVE_TRANSFERS), not-yet-deliverable deferred
+        messages (PENDING_MESSAGES), and — on transports with the wire
+        fast path — coalescing/chunking/compression counters plus
+        per-peer link-bandwidth EWMA gauges. Poll-only: nothing lands
+        on the transport's hot path."""
         sde = self.metrics.sde
         get_cbs = getattr(ce, "_get_cbs", None)
         if get_cbs is not None:
             sde.register_poll(COMM_ACTIVE_TRANSFERS, lambda: len(get_cbs))
         sde.register_poll(COMM_PENDING_MESSAGES,
                           lambda: len(ce._deferred))
+        ws = getattr(ce, "wire_stats", None)
+        if ws is not None:
+            sde.register_poll(COMM_COALESCED,
+                              lambda w=ws: w["coalesced_msgs"])
+        if hasattr(ce, "chunks_inflight"):
+            sde.register_poll(COMM_CHUNKS_INFLIGHT, ce.chunks_inflight)
+        if hasattr(ce, "compress_ratio"):
+            sde.register_poll(
+                COMM_COMPRESS_RATIO,
+                lambda c=ce: (lambda r: 1.0 if r is None else r)(
+                    c.compress_ratio()))
+        if hasattr(ce, "link_bw_mbps"):
+            for peer in range(ce.nb_ranks):
+                if peer == ce.rank:
+                    continue
+                sde.register_poll(
+                    f"{COMM_LINK_BW_PREFIX}::R{peer}",
+                    lambda c=ce, p=peer: (lambda b: 0.0 if b is None
+                                          else round(b, 3))(
+                        c.link_bw_mbps(p)))
 
 
 def register_device_gauges(sde: Any, device: Any) -> None:

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import os
 import threading
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
@@ -91,6 +92,28 @@ class ParamRegistry:
             p = self._params.get(name)
             if p is not None:
                 p._resolved = False
+
+    def get_cmdline(self, name: str) -> Optional[str]:
+        """Raw cmdline-layer override for ``name`` (None when unset).
+        The public accessor for embedders that save/restore overrides —
+        the supported alternative to reaching into the private dict."""
+        with _lock:
+            return self._cmdline.get(name)
+
+    @contextmanager
+    def cmdline_override(self, name: str, value: str):
+        """Scoped cmdline-layer override: sets ``name`` for the body,
+        then restores whatever cmdline value (or absence) was there
+        before — safe to nest and exception-safe."""
+        prev = self.get_cmdline(name)
+        self.set_cmdline(name, value)
+        try:
+            yield self
+        finally:
+            if prev is None:
+                self.unset_cmdline(name)
+            else:
+                self.set_cmdline(name, prev)
 
     def parse_argv(self, argv: List[str]) -> List[str]:
         """Consume ``--mca name value`` / ``--parsec name=value`` pairs.
@@ -215,6 +238,30 @@ def register_core_params() -> None:
                       "broadcast topology: star|chain|binomial")
     params.reg_sizet("runtime_comm_short_limit", 4096,
                      "max payload inlined in an activate message")
+    params.reg_bool("comm_adaptive_short_limit", False,
+                    "tune the eager/rendezvous cutoff per peer from the "
+                    "measured GET round-trip and link bandwidth (the "
+                    "static runtime_comm_short_limit is the floor, "
+                    "comm_short_limit_max the ceiling)")
+    params.reg_sizet("comm_short_limit_max", 1 << 20,
+                     "ceiling for the adaptive eager/rendezvous cutoff")
+    params.reg_sizet("comm_coalesce_max_bytes", 1 << 16,
+                     "max bytes of queued small AMs coalesced into one "
+                     "wire frame/syscall on the TCP transport (0 = one "
+                     "frame per message)")
+    params.reg_sizet("comm_chunk_bytes", 1 << 17,
+                     "buffers at least this large stream as bounded "
+                     "chunk frames so control messages interleave with "
+                     "bulk data (TCP transport)")
+    params.reg_int("comm_compress_threshold_mbps", 0,
+                   "engage negotiated per-link compression when the "
+                   "measured send bandwidth EWMA drops below this many "
+                   "MB/s and a sample probe shows the traffic "
+                   "compresses (0 = never)")
+    params.reg_sizet("comm_send_buffer_bytes", 1 << 26,
+                     "per-peer bounded send buffer: send_am blocks "
+                     "while this many bytes are queued ahead of it "
+                     "(backpressure toward slow links)")
     params.reg_int("arena_max_used", -1, "cap on arena allocated buffers (-1 off)")
     params.reg_int("arena_max_cached", -1, "cap on arena cached buffers (-1 off)")
     params.reg_int("task_startup_iter", 64, "startup enumerator chunk iterations")

@@ -114,6 +114,19 @@ def test_launch_jax_distributed_cross_process_collective(tmp_path):
         p.stdout[-2000:]
 
 
+def _parse_lane_stats(stdout):
+    """Per-rank lane stats from the probe's LANE-OK lines."""
+    import re
+    out = []
+    for m in re.finditer(r"member=(\d) calls=(\d+) joins=(\d+) "
+                         r"ctiles=(\d+)", stdout):
+        out.append({"member": bool(int(m.group(1))),
+                    "calls": int(m.group(2)),
+                    "joins": int(m.group(3)),
+                    "ctiles": int(m.group(4))})
+    return out
+
+
 def test_launch_collective_lane_multiprocess(tmp_path):
     """The compiled collective lane over a REAL multi-controller mesh:
     2 launcher processes under --jax-distributed run dist-wave dpotrf;
@@ -141,6 +154,8 @@ def test_launch_collective_lane_multiprocess(tmp_path):
         "A.from_numpy(M.copy())\n"
         "tp = dpotrf_taskpool(A, rank=rank, nb_ranks=nr)\n"
         "w = ptg.wave(tp, comm=ctx.comm.ce)\n"
+        "member = any(rank in m for by_g in w._lane_sched.values()\n"
+        "             for (_c, m) in by_g)\n"
         "w.run()\n"
         "ref = np.linalg.cholesky(M)\n"
         "err = 0.0\n"
@@ -153,7 +168,9 @@ def test_launch_collective_lane_multiprocess(tmp_path):
         "s = w.stats\n"
         "assert err < 1e-4, err\n"
         "print(f'rank {rank}: lane={s[\"collective_lane\"]} '\n"
+        "      f'member={int(member)} '\n"
         "      f'calls={s[\"collective_calls\"]} '\n"
+        "      f'joins={s[\"collective_joins\"]} '\n"
         "      f'ctiles={s[\"collective_tiles\"]} '\n"
         "      f'sent={s[\"tiles_sent\"]} err={err:.1e} LANE-OK')\n"
         "ctx.fini()\n" % ROOT)
@@ -167,9 +184,13 @@ def test_launch_collective_lane_multiprocess(tmp_path):
     assert p.returncode == 0, (p.stdout[-3000:], p.stderr[-2000:])
     assert p.stdout.count("LANE-OK") == 3, p.stdout[-2000:]
     assert "lane=multiproc" in p.stdout, p.stdout[-2000:]
-    import re
-    calls = [int(m) for m in re.findall(r"calls=(\d+)", p.stdout)]
-    assert all(c > 0 for c in calls), p.stdout[-2000:]
+    # collective_calls/collective_tiles must prove MEMBERSHIP, not just
+    # that a zero-contribution join happened (ADVICE r5): every member
+    # rank carried tiles through the lane; row-cyclic panels make every
+    # rank a member here
+    stats = _parse_lane_stats(p.stdout)
+    assert len(stats) == 3 and all(s["member"] for s in stats), stats
+    assert all(s["calls"] > 0 and s["ctiles"] > 0 for s in stats), stats
 
 
 def test_launch_collective_lane_multiprocess_partial_groups(tmp_path):
@@ -201,6 +222,7 @@ def test_launch_collective_lane_multiprocess_partial_groups(tmp_path):
         "groups = {m for by_g in w._lane_sched.values()\n"
         "          for (_c, m) in by_g}\n"
         "assert any(len(m) < nr for m in groups), groups\n"
+        "member = any(rank in m for m in groups)\n"
         "w.run()\n"
         "ref = np.linalg.cholesky(M)\n"
         "err = 0.0\n"
@@ -213,7 +235,9 @@ def test_launch_collective_lane_multiprocess_partial_groups(tmp_path):
         "s = w.stats\n"
         "assert err < 1e-4, err\n"
         "print(f'rank {rank}: lane={s[\"collective_lane\"]} '\n"
+        "      f'member={int(member)} '\n"
         "      f'calls={s[\"collective_calls\"]} '\n"
+        "      f'joins={s[\"collective_joins\"]} '\n"
         "      f'ctiles={s[\"collective_tiles\"]} '\n"
         "      f'sent={s[\"tiles_sent\"]} err={err:.1e} LANE-OK')\n"
         "ctx.fini()\n" % ROOT)
@@ -228,9 +252,16 @@ def test_launch_collective_lane_multiprocess_partial_groups(tmp_path):
     assert p.returncode == 0, (p.stdout[-3000:], p.stderr[-2000:])
     assert p.stdout.count("LANE-OK") == 4, p.stdout[-2000:]
     assert "lane=multiproc" in p.stdout, p.stdout[-2000:]
-    import re
-    calls = [int(m) for m in re.findall(r"calls=(\d+)", p.stdout)]
-    assert all(c > 0 for c in calls), p.stdout[-2000:]
+    # member-only accounting (ADVICE r5): every MEMBER rank proves its
+    # tiles rode the lane; non-members of partial groups only join
+    # (collective_joins) and must not count calls for them
+    stats = _parse_lane_stats(p.stdout)
+    assert len(stats) == 4, p.stdout[-2000:]
+    for s in stats:
+        if s["member"]:
+            assert s["calls"] > 0 and s["ctiles"] > 0, stats
+        else:
+            assert s["ctiles"] == 0, stats
 
 
 def test_launch_multi_host_ssh():

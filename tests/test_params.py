@@ -62,6 +62,35 @@ def test_unknown_param_raises(reg):
         reg.get("nope")
 
 
+def test_get_cmdline_public_accessor(reg):
+    """The public cmdline-layer accessor (ADVICE r5: embedders must not
+    reach into params._cmdline)."""
+    reg.reg_string("s", "default")
+    assert reg.get_cmdline("s") is None
+    reg.set_cmdline("s", "v1")
+    assert reg.get_cmdline("s") == "v1"
+    reg.unset_cmdline("s")
+    assert reg.get_cmdline("s") is None
+
+
+def test_cmdline_override_contextmanager(reg):
+    reg.reg_string("s", "default")
+    with reg.cmdline_override("s", "inner"):
+        assert reg.get("s") == "inner"
+    assert reg.get("s") == "default"
+    assert reg.get_cmdline("s") is None
+    # restores a pre-existing override instead of popping it
+    reg.set_cmdline("s", "outer")
+    with reg.cmdline_override("s", "inner"):
+        assert reg.get("s") == "inner"
+    assert reg.get("s") == "outer"
+    # exception-safe
+    with pytest.raises(RuntimeError):
+        with reg.cmdline_override("s", "inner"):
+            raise RuntimeError("boom")
+    assert reg.get("s") == "outer"
+
+
 def test_file_values(reg, tmp_path, monkeypatch):
     conf = tmp_path / "mca.conf"
     conf.write_text("# comment\nfoo = 13\n")
