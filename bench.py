@@ -885,6 +885,104 @@ def bench_comm(n_msgs=4000, bulk_mb=8, reps=2):
     return out
 
 
+# ---------------------------------------------------------------------- #
+# fault-tolerance microbenchmark (ISSUE 4): heartbeat detection latency   #
+# over loopback TCP + snapshot/rollback overhead of the restart driver    #
+# ---------------------------------------------------------------------- #
+def bench_ft(reps=3, interval=0.01, timeout=0.15):
+    """Two probes. (1) Detection latency: loopback TCP pair with the
+    proactive detector on rank 0; rank 1 is chaos-silenced (sockets
+    stay open — only heartbeats can find it) and we time
+    silence -> eviction, best of ``reps``. (2) Restart overhead: a
+    small single-rank dpotrf through ft.restart.run_with_restart with
+    snapshot-every-stage vs the bare run, plus recovery wall time for
+    an injected transient task fault."""
+    import tempfile
+
+    from parsec_tpu.ft import HeartbeatDetector, run_with_restart, RestartPolicy
+
+    out = {}
+    best = None
+    rtt_ms = 0.0
+    for _ in range(reps):
+        e0, e1 = _tcp_pair()
+        det = HeartbeatDetector(e0, interval, timeout)
+        try:
+            det.start()
+            deadline = time.time() + 10
+            while not det.is_established(1) and time.time() < deadline:
+                time.sleep(0.002)
+            if not det.is_established(1):
+                raise RuntimeError("heartbeat never established")
+            rtt_ms = max(rtt_ms, (det.rtt_s(1) or 0.0) * 1e3)
+            e1.ft_silence()
+            t0 = time.perf_counter()
+            while 1 not in e0.dead_peers and time.time() < deadline:
+                time.sleep(0.001)
+            if 1 not in e0.dead_peers:
+                raise RuntimeError("silenced peer never detected")
+            lat = time.perf_counter() - t0
+            best = lat if best is None else min(best, lat)
+        finally:
+            det.stop()
+            e0.fini()
+            e1.fini()
+    out["ft_detection_latency_ms"] = round(best * 1e3, 2)
+    out["ft_heartbeat_timeout_ms"] = round(timeout * 1e3, 2)
+    out["ft_hb_rtt_ms"] = round(rtt_ms, 3)
+
+    # restart overhead: bare dpotrf vs snapshot-every-stage driver
+    import parsec_tpu
+    from parsec_tpu.collections import TwoDimBlockCyclic
+    from parsec_tpu.ops import dpotrf_taskpool, make_spd
+    from parsec_tpu.utils.params import params as _params
+
+    n, nb = 256, 64
+    M = make_spd(n)
+
+    def run(driver):
+        ctx = parsec_tpu.init(nb_cores=2, enable_tpu=False)
+        try:
+            A = TwoDimBlockCyclic(n, n, nb, nb,
+                                  dtype=np.float32).from_numpy(M)
+            t0 = time.perf_counter()
+            driver(ctx, A)
+            return time.perf_counter() - t0
+        finally:
+            ctx.fini()
+
+    def bare(ctx, A):
+        ctx.add_taskpool(dpotrf_taskpool(A))
+        ctx.wait()
+
+    run(bare)   # warmup: first-use costs must not skew the comparison
+    t_bare = min(run(bare) for _ in range(reps))
+    with tempfile.TemporaryDirectory() as d:
+        def snap(ctx, A):
+            run_with_restart(
+                ctx, [lambda: dpotrf_taskpool(A)], [A],
+                os.path.join(d, "bench"),
+                policy=RestartPolicy("restart", retries=1, every=1))
+
+        t_snap = min(run(snap) for _ in range(reps))
+
+        # recovery wall time: one injected transient fault, one retry
+        _params.set_cmdline("ft_inject", "taskfail:nth=2")
+        try:
+            t_recover = run(lambda ctx, A: run_with_restart(
+                ctx, [lambda: dpotrf_taskpool(A)], [A],
+                os.path.join(d, "bench_r"),
+                policy=RestartPolicy("restart", retries=2, backoff=0.01)))
+        finally:
+            _params.reset()
+    out["ft_dpotrf_bare_s"] = round(t_bare, 4)
+    out["ft_dpotrf_snapshot_s"] = round(t_snap, 4)
+    out["ft_snapshot_overhead_pct"] = round(
+        (t_snap / t_bare - 1.0) * 100.0, 1)
+    out["ft_recover_after_taskfail_s"] = round(t_recover, 4)
+    return out
+
+
 def main() -> None:
     n = int(os.environ.get("BENCH_N", "8192"))
     nb = int(os.environ.get("BENCH_NB", "2048"))
@@ -899,6 +997,13 @@ def main() -> None:
             "metric": "comm_small_am_msgs_per_s(loopback_tcp,coalesced)",
             "value": extras["comm_tcp_small_msgs_per_s"],
             "unit": "msgs/s", "extras": extras}))
+        return
+    if mode == "ft":
+        extras = bench_ft(reps=reps)
+        print(json.dumps({
+            "metric": "ft_detection_latency_ms(loopback_tcp,hb_10ms)",
+            "value": extras["ft_detection_latency_ms"],
+            "unit": "ms", "extras": extras}))
         return
     if mode == "all":
         bench_all(n, nb, reps, cores, dtype)

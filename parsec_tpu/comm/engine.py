@@ -29,6 +29,23 @@ class Capabilities:
         self.multithreaded = multithread
 
 
+class RankFailedError(RuntimeError):
+    """A peer rank failed mid-run (crash, kill, or heartbeat eviction).
+
+    Failure *detection* is the explicit extension beyond the reference
+    (SURVEY.md §5.3: PaRSEC has none — a dead MPI rank hangs the job).
+    Two detectors feed this: the reactive one (a torn TCP connection
+    while the engine is live, comm/tcp.py) and the proactive one (a
+    peer that stops answering heartbeats, ft/detector.py). Either way
+    the dead rank aborts this rank's DAG instead of hanging in termdet
+    forever. Recovery is the ft/restart.py driver over the
+    utils/checkpoint snapshots (or app-level, ex08)."""
+
+    def __init__(self, rank: int, reason: str = "connection lost") -> None:
+        super().__init__(f"rank {rank} failed: {reason}")
+        self.rank = rank
+
+
 class MemHandle:
     """Registered memory region handle (ref: parsec_ce_mem_reg_handle_t —
     wraps {ptr, count, datatype}); here it wraps a host array + metadata."""
@@ -46,6 +63,16 @@ class MemHandle:
 
 class CommEngine:
     """Transport interface (ref: parsec_comm_engine_t function table)."""
+
+    #: May the heartbeat detector evict a peer that was PROBED but never
+    #: answered? Only sound when a successful probe implies the peer was
+    #: verifiably alive and able to reply at probe time — true for TCP
+    #: (``hb_ok`` means its receiver thread processed our HELLO and
+    #: answers pings without any progress pumping), FALSE for the
+    #: in-process fabrics (a probe merely lands in an inbox; the peer
+    #: may be healthy but still compiling/initializing, not yet pumping
+    #: progress — evicting it would be a false positive).
+    ft_probe_baseline = False
 
     def __init__(self, rank: int, nb_ranks: int) -> None:
         self.rank = rank
@@ -68,6 +95,31 @@ class CommEngine:
         # instrumented site on the one-attribute-check fast path
         # (the PINS ``_active == 0`` pattern)
         self._obs: Optional[Any] = None
+        # -- fault tolerance (ft/) -------------------------------------
+        # uniform failure surface across ALL transports: the TCP engine
+        # used to be the only one carrying these, forcing hasattr guards
+        # on every consumer (remote_dep, wave_dist)
+        self.dead_peers: set = set()
+        #: called (peer, reason) when a peer is declared failed;
+        #: RemoteDepEngine.attach points this at the context's abort path
+        self.on_peer_failure: Optional[Callable[[int, str], None]] = None
+        #: HeartbeatDetector when one is installed (ft/detector.py)
+        self.ft_detector: Optional[Any] = None
+        #: injected-kill flag: the engine has gone dark (drops all
+        #: traffic, answers no heartbeats) — simulates a crashed process
+        self._ft_silenced = False
+        #: deterministic fault injector (ft/inject.py), or None (the
+        #: default: one never-taken branch on the send path)
+        self._ft: Optional[Any] = None
+        from ..utils.params import params
+        spec = params.get("ft_inject")
+        if spec:
+            from ..ft.inject import FaultInjector
+            self._ft = FaultInjector.from_spec(spec, rank=rank)
+        # every current-version engine answers heartbeat pings from its
+        # progress loop, detector installed or not — liveness proof
+        # must not depend on the *local* configuration
+        self.tag_register(TAG_HEARTBEAT, self._on_heartbeat)
 
     def _notify_arrival(self) -> None:
         cb = self.on_arrival
@@ -136,6 +188,91 @@ class CommEngine:
     def send_am(self, dst: int, tag: int, payload: Any) -> None:
         raise NotImplementedError
 
+    # -- fault tolerance (ft/) ----------------------------------------------
+    def report_peer_failure(self, peer: int, reason: str) -> None:
+        """Uniform failure funnel: mark ``peer`` dead and notify the
+        runtime. Reactive transports (tcp._peer_died) and the proactive
+        heartbeat detector both end here, so every consumer sees ONE
+        API regardless of transport. Idempotent."""
+        if peer in self.dead_peers or self.peer_finished(peer):
+            return
+        self.dead_peers.add(peer)
+        from ..utils import logging as plog
+        plog.warning("rank %d: peer %d presumed FAILED (%s)",
+                     self.rank, peer, reason)
+        cb = self.on_peer_failure
+        if cb is not None:
+            cb(peer, reason)
+
+    def peer_finished(self, peer: int) -> bool:
+        """True when ``peer`` shut down CLEANLY (it finished its work
+        and fini'd) — such a peer stops heartbeating but must never be
+        declared failed. Transports that can observe orderly shutdown
+        override this."""
+        return False
+
+    def ft_silence(self) -> None:
+        """Injected kill (ft/inject.py): the engine goes dark — drops
+        all inbound and outbound traffic and answers no heartbeats,
+        simulating a crashed process whose sockets may still be open
+        (so only PROACTIVE detection can find it)."""
+        self._ft_silenced = True
+
+    def ft_outbound(self, dst: int, tag: int) -> int:
+        """Chaos consult for one outbound frame: how many copies to
+        deliver — 0 (engine silenced, or injected drop), 1 (normal),
+        or 2 (injected duplicate). Injected delays sleep inside
+        ``on_send``; an injected failsend raises from here. The ONE
+        copy of the verdict semantics every transport's
+        ``_transport_post`` applies."""
+        if self._ft_silenced:
+            return 0
+        ft = self._ft
+        if ft is None or dst == self.rank:
+            return 1
+        verdict = ft.on_send(dst, tag)
+        if verdict == "drop":
+            return 0
+        return 2 if verdict == "dup" else 1
+
+    def ft_ping(self, peer: int, seq: int, t_ns: int) -> bool:
+        """Send one heartbeat probe toward ``peer``; True when a probe
+        actually left. The base path rides a TAG_HEARTBEAT active
+        message (in-process fabrics); the TCP engine overrides with a
+        wire-level K_PING frame answered by the peer's receiver thread,
+        so TCP liveness is independent of the progress cadence."""
+        if self._ft_silenced or peer in self.dead_peers \
+                or self.peer_finished(peer):
+            return False
+        try:
+            self.send_am(peer, TAG_HEARTBEAT,
+                         {"op": "ping", "seq": seq, "t": t_ns})
+        except Exception:  # noqa: BLE001 - a probe must never propagate
+            return False
+        return True
+
+    def _on_heartbeat(self, src: int, payload: Any) -> None:
+        if self._ft_silenced:
+            return
+        op = payload.get("op")
+        if op == "ping":
+            # any heartbeat traffic FROM the peer proves it speaks the
+            # protocol and is alive right now
+            det = self.ft_detector
+            if det is not None:
+                det.note_alive(src)
+            try:
+                self.send_am(src, TAG_HEARTBEAT,
+                             {"op": "pong", "seq": payload["seq"],
+                              "t": payload["t"]})
+            except Exception:  # noqa: BLE001 - peer died racing the reply
+                pass
+        elif op == "pong":
+            det = self.ft_detector
+            if det is not None:
+                det.note_alive(
+                    src, rtt=(time.monotonic_ns() - payload["t"]) / 1e9)
+
     # -- registered memory + one-sided emulation ----------------------------
     def mem_register(self, array: Any, meta: Any = None) -> MemHandle:
         h = MemHandle(array, meta)
@@ -182,4 +319,5 @@ TAG_PUT_DATA = 4
 TAG_TERMDET = 5
 TAG_DTD_DATA = 6
 TAG_MEM_PUT = 7
+TAG_HEARTBEAT = 8   # ft/ liveness probes (ping/pong AMs; tcp rides K_PING)
 TAG_USER_BASE = 16

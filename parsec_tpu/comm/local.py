@@ -16,8 +16,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..core.lists import Fifo
-from .engine import (CommEngine, MemHandle, TAG_GET_DATA, TAG_GET_REQ,
-                     TAG_PUT_DATA)
+from .engine import (CommEngine, MemHandle, RankFailedError, TAG_GET_DATA,
+                     TAG_GET_REQ, TAG_PUT_DATA)
 
 
 class LocalFabric:
@@ -30,6 +30,10 @@ class LocalFabric:
         self.engines: List[Optional["LocalCommEngine"]] = [None] * nb_ranks
         self.msg_count = 0
         self.bytes_count = 0
+        # ranks that fini'd CLEANLY (the in-process analog of the TCP
+        # GOODBYE): the heartbeat detector must never declare these
+        # failed when their pings stop
+        self.finished: set = set()
         self._stat_lock = threading.Lock()
 
     def engine(self, rank: int) -> "LocalCommEngine":
@@ -93,7 +97,8 @@ class LocalCommEngine(CommEngine):
     # transport extension points: subclasses replace these two to carry
     # the same AM/GET/PUT emulation over another wire (comm/tcp.py)
     def _transport_post(self, dst: int, src: int, tag: int, payload: Any) -> None:
-        self.fabric._post(dst, src, tag, payload)
+        for _ in range(self.ft_outbound(dst, tag)):
+            self.fabric._post(dst, src, tag, payload)
 
     def _transport_drain(self):
         """Yield pending (src, tag, payload) messages."""
@@ -106,6 +111,8 @@ class LocalCommEngine(CommEngine):
 
     def send_am(self, dst: int, tag: int, payload: Any) -> None:
         # self-sends also loop back through the inbox for ordering fidelity
+        if dst != self.rank and dst in self.dead_peers:
+            raise RankFailedError(dst, "send to failed rank")
         obs = self._obs
         if obs is None:
             self._transport_post(dst, self.rank, tag, _wire_copy(payload))
@@ -218,6 +225,8 @@ class LocalCommEngine(CommEngine):
 
     # -- progress -----------------------------------------------------------
     def progress(self) -> int:
+        if self._ft_silenced:
+            return 0   # injected kill: the inbox is never drained again
         obs = self._obs
         t0 = time.monotonic_ns() if obs is not None else 0
         n = 0
@@ -248,3 +257,28 @@ class LocalCommEngine(CommEngine):
 
     def sync(self) -> None:
         self.fabric.barrier.wait()
+
+    def peer_finished(self, peer: int) -> bool:
+        with self.fabric._stat_lock:
+            return peer in self.fabric.finished
+
+    def ft_ping(self, peer: int, seq: int, t_ns: int) -> bool:
+        """Probe-layer support gate (the in-process analog of TCP's
+        HELLO ``hb`` capability): only probe engines with a live
+        TAG_HEARTBEAT handler — the detector never judges a peer it
+        could not probe, so a handler-less (mixed-version) peer is
+        never declared dead."""
+        from .engine import TAG_HEARTBEAT
+        eng = (self.fabric.engines[peer]
+               if 0 <= peer < len(self.fabric.engines) else None)
+        if eng is None or TAG_HEARTBEAT not in eng._tag_cbs:
+            return False
+        return super().ft_ping(peer, seq, t_ns)
+
+    def fini(self) -> None:
+        # clean-shutdown advertisement (the in-process GOODBYE): a rank
+        # under an injected kill died SILENTLY and must not mark itself
+        # finished — proactive detection is the only way peers learn
+        if not self._ft_silenced:
+            with self.fabric._stat_lock:
+                self.fabric.finished.add(self.rank)

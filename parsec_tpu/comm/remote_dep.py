@@ -29,8 +29,8 @@ from ..data.data import Coherency, Data, DataCopy
 from ..runtime.scheduling import schedule
 from ..utils import logging as plog
 from ..utils.params import params
-from .engine import (CommEngine, TAG_ACTIVATE, TAG_DTD_DATA, TAG_GET_DATA,
-                     TAG_MEM_PUT, TAG_TERMDET)
+from .engine import (CommEngine, RankFailedError, TAG_ACTIVATE,
+                     TAG_DTD_DATA, TAG_GET_DATA, TAG_MEM_PUT, TAG_TERMDET)
 from .xfer import TAG_XFER_ACK, _is_device_array
 
 _log = plog.comm_stream
@@ -126,14 +126,15 @@ class RemoteDepEngine:
         # from the delivering thread; waking one worker drains the
         # inbox immediately.
         self.ce.on_arrival = lambda: context.wake_workers(1)
-        # failure detection: a transport that notices dead peers aborts
-        # this rank's DAG cleanly instead of hanging in termdet forever
-        if hasattr(self.ce, "on_peer_failure"):
-            def _on_failure(peer: int, reason: str) -> None:
-                from .tcp import RankFailedError
-                self._release_parks_for(peer)
-                context.record_task_error(RankFailedError(peer, reason))
-            self.ce.on_peer_failure = _on_failure
+        # failure detection: EVERY transport carries the uniform
+        # dead_peers / on_peer_failure surface now (comm/engine.py), so
+        # reactive (torn TCP connection) and proactive (ft/ heartbeat
+        # eviction) detections abort this rank's DAG cleanly through
+        # one path instead of hanging in termdet forever
+        def _on_failure(peer: int, reason: str) -> None:
+            self._release_parks_for(peer)
+            context.record_task_error(RankFailedError(peer, reason))
+        self.ce.on_peer_failure = _on_failure
 
     def taskpool_register(self, tp) -> None:
         """Wire ids are assigned by registration order — SPMD ranks register

@@ -55,7 +55,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..core.lists import Fifo
-from .engine import TAG_USER_BASE
+from .engine import RankFailedError, TAG_USER_BASE
 from ..utils import logging as plog
 from .local import LocalCommEngine, _wire_copy
 from . import wire
@@ -80,18 +80,8 @@ _MAX_BATCH_MSGS = 256
 _CTRL_STREAK_MAX = 8
 
 
-class RankFailedError(RuntimeError):
-    """A peer rank's connection died mid-run (process crash / kill).
-
-    Failure *detection* is the explicit extension beyond the reference
-    (SURVEY.md §5.3: PaRSEC has none — a dead MPI rank hangs the job):
-    a torn connection while the engine is live marks the peer dead and
-    aborts this rank's DAG instead of hanging in termdet forever.
-    Recovery stays app-level: checkpoint/restore_collection (ex08)."""
-
-    def __init__(self, rank: int, reason: str = "connection lost") -> None:
-        super().__init__(f"rank {rank} failed: {reason}")
-        self.rank = rank
+# RankFailedError moved to comm/engine.py (every transport raises it
+# now, not just this one); re-exported here for back-compat importers.
 
 
 def free_ports(n: int) -> List[int]:
@@ -142,7 +132,7 @@ class _Peer:
 
     __slots__ = ("rank", "sock", "ctrl", "bulk", "cond", "writer",
                  "goodbye", "bw_mbps", "codec", "engaged", "frames",
-                 "probe_ratio", "done", "queued_bytes")
+                 "probe_ratio", "done", "queued_bytes", "hb_ok")
 
     def __init__(self, rank: int, sock: socket.socket) -> None:
         self.rank = rank
@@ -159,9 +149,15 @@ class _Peer:
         self.engaged = False                   # compression live now
         self.frames = 0                        # frames sent (probe clock)
         self.probe_ratio: Optional[float] = None
+        self.hb_ok = False         # HELLO advertised heartbeat support
 
 
 class TCPCommEngine(LocalCommEngine):
+    #: a TCP probe only leaves when the peer's HELLO was processed
+    #: (hb_ok) — its receiver thread was alive then and answers pings
+    #: with no progress pumping, so probed-but-silent = genuinely dead
+    ft_probe_baseline = True
+
     def __init__(self, rank: int, endpoints: List[Tuple[str, int]],
                  connect_timeout: float = 30.0,
                  coalesce_max_bytes: Optional[int] = None,
@@ -172,11 +168,10 @@ class TCPCommEngine(LocalCommEngine):
         self._peers: Dict[int, _Peer] = {}
         self._recv_threads: List[threading.Thread] = []
         self._closing = False
-        self.dead_peers: set = set()
-        self.finished_peers: set = set()  # clean GOODBYE received
-        #: set by RemoteDepEngine.attach: called (peer, reason) from the
-        #: receiver thread when a live connection tears
-        self.on_peer_failure = None
+        # dead_peers / on_peer_failure live on the CommEngine base now
+        # (uniform across transports); finished_peers is TCP's record of
+        # clean GOODBYEs received
+        self.finished_peers: set = set()
         self._barrier_arrived: set = set()
         self._barrier_release = 0
         self._barrier_lock = threading.Lock()
@@ -286,7 +281,8 @@ class TCPCommEngine(LocalCommEngine):
         # never send one and stay on the uncompressed path)
         hello = wire.pack_hello({"ver": wire.WIRE_VERSION,
                                  "rank": self.rank,
-                                 "codecs": self._codecs})
+                                 "codecs": self._codecs,
+                                 "hb": True})
         with p.cond:
             p.ctrl.append(("frame", hello))
             p.queued_bytes += len(hello)
@@ -335,6 +331,56 @@ class TCPCommEngine(LocalCommEngine):
             post = self.wire_stats["bytes_postcompress"]
         return (post / pre) if pre else None
 
+    # -- fault tolerance ------------------------------------------------
+    def ft_ping(self, peer: int, seq: int, t_ns: int) -> bool:
+        """Wire-level heartbeat probe (K_PING): enqueued straight onto
+        the peer's ctrl lane and answered by the peer's receiver
+        thread. Never sent toward a peer whose HELLO did not advertise
+        heartbeat support — a mixed-version peer is never probed, so
+        the detector can never (wrongly) declare it dead."""
+        if self._ft_silenced or peer in self.dead_peers \
+                or peer in self.finished_peers:
+            return False
+        p = self._peers.get(peer)
+        if p is None or not p.hb_ok or p.done:
+            return False
+        # probe frames bypass _transport_post, so consult the chaos
+        # layer here too — ft_inject directives with hb=1 must be able
+        # to drop/duplicate heartbeats on this transport as well
+        from .engine import TAG_HEARTBEAT
+        copies = self.ft_outbound(peer, TAG_HEARTBEAT)
+        if copies == 0:
+            return False
+        frame = wire.pack_ping(seq, t_ns)
+        with p.cond:
+            for _ in range(copies):
+                p.ctrl.append(("frame", frame))
+                p.queued_bytes += len(frame)
+            p.cond.notify()
+        return True
+
+    def report_peer_failure(self, peer: int, reason: str) -> None:
+        """Uniform failure funnel (base-class API): a proactive
+        (heartbeat) eviction is unconditional — the peer is SILENT, so
+        unlike a torn connection there is no may-have-finished
+        ambiguity for the reporting policy to weigh."""
+        self._peer_died(peer, reason, lost_sends=True)
+
+    def ft_silence(self) -> None:
+        """Injected kill: beyond the base flag, wake every writer so it
+        exits WITHOUT flushing its queue — a real SIGKILL drops queued
+        frames, and survivors must not observe a message sequence that
+        is impossible under a real crash."""
+        super().ft_silence()
+        with self._conn_cond:
+            peers = list(self._peers.values())
+        for p in peers:
+            with p.cond:
+                p.cond.notify_all()
+
+    def peer_finished(self, peer: int) -> bool:
+        return peer in self.finished_peers
+
     # -- send path ------------------------------------------------------
     def send_am(self, dst: int, tag: int, payload: Any) -> None:
         # remote sends serialize via pickle (its own copy); only loopback
@@ -350,6 +396,11 @@ class TCPCommEngine(LocalCommEngine):
         obs.am_sent(self.rank, dst, tag, payload, t0)
 
     def _transport_post(self, dst: int, src: int, tag: int, payload: Any) -> None:
+        for _ in range(self.ft_outbound(dst, tag)):
+            self._transport_post_live(dst, src, tag, payload)
+
+    def _transport_post_live(self, dst: int, src: int, tag: int,
+                             payload: Any) -> None:
         self._check_live(dst)
         if dst == self.rank:
             with self._stat_lock:
@@ -464,11 +515,13 @@ class TCPCommEngine(LocalCommEngine):
                 with peer.cond:
                     while not peer.ctrl and not peer.bulk \
                             and not peer.goodbye \
+                            and not self._ft_silenced \
                             and peer.rank not in self.dead_peers:
                         peer.cond.wait()
-                    if peer.rank in self.dead_peers:
-                        return   # _peer_died notified us: stop (finally
-                        #          drops whatever is still queued)
+                    if peer.rank in self.dead_peers or self._ft_silenced:
+                        return   # _peer_died/ft_silence notified us:
+                        #          stop (finally drops whatever is
+                        #          still queued — a crash sends nothing)
                     take_ctrl = bool(peer.ctrl) and (
                         not peer.bulk or ctrl_streak < _CTRL_STREAK_MAX)
                     if take_ctrl:
@@ -559,7 +612,7 @@ class TCPCommEngine(LocalCommEngine):
                 peer.bulk.clear()
                 peer.queued_bytes = 0
                 peer.cond.notify_all()
-            if dropped and not self._closing:
+            if dropped and not self._closing and not self._ft_silenced:
                 plog.warning(
                     "tcp rank %d: dropped %d queued frame(s)/chunk(s) "
                     "to dead peer %d", self.rank, dropped, peer.rank)
@@ -657,6 +710,8 @@ class TCPCommEngine(LocalCommEngine):
 
     def _dispatch_body(self, peer: int, body: memoryview,
                        xfers: Dict[int, wire.RxXfer]) -> None:
+        if self._ft_silenced:
+            return   # injected kill: inbound traffic is never delivered
         kind = body[0]
         if kind == wire.K_BATCH:
             for frame, bufs in wire.parse_batch(body):
@@ -696,6 +751,29 @@ class TCPCommEngine(LocalCommEngine):
             if p is not None:
                 p.codec = wire.negotiate_codec(
                     self._codecs, info.get("codecs", ()))
+                p.hb_ok = bool(info.get("hb"))
+        elif kind == wire.K_PING:
+            # answered HERE, on the receiver thread (like K_HELLO): a
+            # rank whose workers are all stuck in a long kernel still
+            # proves liveness — the detector judges the TRANSPORT, not
+            # the progress cadence
+            seq, t_ns = wire.parse_ping(body)
+            det = self.ft_detector
+            if det is not None:
+                det.note_alive(peer)
+            p = self._peers.get(peer)
+            if p is not None and not p.done:
+                pong = wire.pack_ping(seq, t_ns, pong=True)
+                with p.cond:
+                    p.ctrl.append(("frame", pong))
+                    p.queued_bytes += len(pong)
+                    p.cond.notify()
+        elif kind == wire.K_PONG:
+            seq, t_ns = wire.parse_ping(body)
+            det = self.ft_detector
+            if det is not None:
+                det.note_alive(peer,
+                               rtt=(time.monotonic_ns() - t_ns) / 1e9)
         elif kind == wire.K_COMP:
             self._dispatch_body(peer, memoryview(
                 wire.decompress_body(body)), xfers)
@@ -814,6 +892,22 @@ class TCPCommEngine(LocalCommEngine):
 
     def fini(self) -> None:
         self._closing = True
+        if self._ft_silenced:
+            # injected kill: die WITHOUT a goodbye and WITHOUT flushing
+            # — peers must learn of the death proactively (heartbeat) or
+            # reactively (torn socket), exactly like a real crash
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+            with self._conn_cond:
+                peers = dict(self._peers)
+            for p in peers.values():
+                try:
+                    p.sock.close()
+                except OSError:
+                    pass
+            return
         # clean goodbye so live peers see an orderly shutdown, not a
         # crash. The writer sends it only after BOTH queues drain (the
         # final results / termdet messages must precede it), so fini

@@ -32,6 +32,15 @@ body follows). Otherwise the body's first byte is a *kind*:
 - ``K_COMP``: a compressed *body* (kind byte included) of any of the
   above: ``<u8 codec_id> <u64 raw_len> <compressed>``. Only emitted
   toward peers that advertised the codec.
+- ``K_PING`` / ``K_PONG``: heartbeat probe and its echo
+  (``<u32 seq> <u64 t_ns>``, the sender's monotonic clock — the pong
+  echoes it back so the sender computes the round trip). Handled
+  directly by the receiver THREAD (like K_HELLO), never queued through
+  the inbox: a rank stuck in a long kernel still answers, so TCP
+  liveness judgment (ft/detector.py) is independent of the progress
+  cadence. Only sent toward peers whose HELLO advertised ``"hb"`` — a
+  mixed-version peer is never probed and therefore never declared dead
+  by the proactive detector.
 
 All integers little-endian, matching the v1 framing.
 """
@@ -49,6 +58,8 @@ K_XFER_HDR = 1
 K_CHUNK = 2
 K_HELLO = 3
 K_COMP = 4
+K_PING = 5
+K_PONG = 6
 
 WIRE_VERSION = 2
 
@@ -60,6 +71,7 @@ _XFER = struct.Struct("<BQII")       # kind, xfer_id, pickle_len, nbufs
 _BUFSPEC = struct.Struct("<BQ")      # chunked?, size
 _CHUNK = struct.Struct("<BQIQ")      # kind, xfer_id, buf_index, offset
 _COMP = struct.Struct("<BBQ")        # kind, codec_id, raw_len
+_PING = struct.Struct("<BIQ")        # kind, seq, t_ns (sender monotonic)
 
 
 # -- codecs -------------------------------------------------------------
@@ -231,6 +243,18 @@ class RxXfer:
 def load_message(frame: memoryview, bufs: Sequence[Any]) -> Any:
     """Unpickle one (src, tag, payload) message segment."""
     return pickle.loads(frame, buffers=list(bufs))
+
+
+# -- heartbeats (ft/detector.py) ----------------------------------------
+def pack_ping(seq: int, t_ns: int, pong: bool = False) -> bytes:
+    """One heartbeat frame; the pong echoes the ping's (seq, t_ns)."""
+    return _PING.pack(K_PONG if pong else K_PING, seq & 0xFFFFFFFF, t_ns)
+
+
+def parse_ping(body: memoryview) -> Tuple[int, int]:
+    """-> (seq, t_ns); same layout for K_PING and K_PONG."""
+    _kind, seq, t_ns = _PING.unpack_from(body, 0)
+    return seq, t_ns
 
 
 # -- hello / compression ------------------------------------------------

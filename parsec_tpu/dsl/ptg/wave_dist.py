@@ -214,13 +214,18 @@ class _CollectiveLane:
     """
 
     def __init__(self, mode: str, nb_ranks: int, rank: int,
-                 rendezvous=None, timeout: float = 120.0) -> None:
+                 rendezvous=None, timeout: float = 120.0,
+                 dead_fn=None) -> None:
         import jax
 
         self.mode = mode
         self.nb_ranks = nb_ranks
         self.rank = rank
         self.timeout = timeout
+        # liveness probe for the rendezvous wait (ft/): a callable
+        # returning the CE's dead_peers so an evicted member aborts the
+        # collective NOW instead of burning the whole timeout
+        self.dead_fn = dead_fn or (lambda: ())
         if mode == "multiproc":
             by_proc = {}
             for d in jax.devices():
@@ -302,7 +307,14 @@ class _CollectiveLane:
             else:
                 deadline = time.monotonic() + self.timeout
                 while key not in results:
-                    if time.monotonic() > deadline:
+                    # collective abort on eviction (ft/): a member the
+                    # failure detector declared dead will never deposit
+                    # — raise the same RankFailedError every other wait
+                    # path raises instead of hanging out the timeout
+                    dead = self.dead_fn()
+                    gone = [r for r in parts
+                            if r != self.rank and r in dead]
+                    if gone or time.monotonic() > deadline:
                         # withdraw the deposit so a late issuer can't
                         # fire with this rank's share unaccounted
                         ours = slots.get(key)
@@ -310,10 +322,15 @@ class _CollectiveLane:
                             ours.pop(self.rank, None)
                             if not ours:
                                 del slots[key]
+                        if gone:
+                            from ...comm.engine import RankFailedError
+                            raise RankFailedError(
+                                gone[0], f"evicted during collective-"
+                                f"lane rendezvous {key}")
                         raise WaveError(
                             f"rank {self.rank}: collective-lane "
                             f"rendezvous {key} timed out")
-                    cv.wait(1.0)
+                    cv.wait(0.1)
             ent = results[key]
             ent[1] -= 1
             out = ent[0]
@@ -436,7 +453,9 @@ class DistWaveRunner(WaveRunner):
                         fab._lane_rdv = rdv
                 self._lane = _CollectiveLane(
                     "inproc", self.nb_ranks, self.rank, rendezvous=rdv,
-                    timeout=self.comm_timeout)
+                    timeout=self.comm_timeout,
+                    dead_fn=lambda ce=self.ce: getattr(
+                        ce, "dead_peers", ()))
         except Exception:
             if mode == "on":
                 raise

@@ -34,6 +34,7 @@ from .spans import (COMM_ACTIVE_TRANSFERS, COMM_BYTES_RECEIVED,
                     COMM_COMPRESS_RATIO, COMM_LINK_BW_PREFIX,
                     COMM_MSGS_RECEIVED, COMM_MSGS_SENT,
                     COMM_PENDING_MESSAGES, CommObs, DeviceObs,
+                    FT_HB_RTT_PREFIX, FT_PEER_ALIVE,
                     payload_nbytes, register_device_gauges)
 
 __all__ = [
@@ -42,7 +43,7 @@ __all__ = [
     "COMM_BYTES_SENT", "COMM_BYTES_RECEIVED", "COMM_MSGS_SENT",
     "COMM_MSGS_RECEIVED", "COMM_ACTIVE_TRANSFERS", "COMM_PENDING_MESSAGES",
     "COMM_COALESCED", "COMM_CHUNKS_INFLIGHT", "COMM_COMPRESS_RATIO",
-    "COMM_LINK_BW_PREFIX",
+    "COMM_LINK_BW_PREFIX", "FT_PEER_ALIVE", "FT_HB_RTT_PREFIX",
     "TASK_EXEC_SECONDS", "COMM_XFER_SECONDS",
     "render", "parse_exposition", "sanitize_name", "fleet_to_prometheus",
     "analyze", "critical_path", "format_report", "parse_dot",

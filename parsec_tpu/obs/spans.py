@@ -25,6 +25,7 @@ __all__ = ["CommObs", "DeviceObs", "register_device_gauges",
            "COMM_ACTIVE_TRANSFERS", "COMM_PENDING_MESSAGES",
            "COMM_COALESCED", "COMM_CHUNKS_INFLIGHT",
            "COMM_COMPRESS_RATIO", "COMM_LINK_BW_PREFIX",
+           "FT_PEER_ALIVE", "FT_HB_RTT_PREFIX",
            "payload_nbytes"]
 
 COMM_BYTES_SENT = "PARSEC::COMM::BYTES_SENT"
@@ -41,6 +42,11 @@ COMM_COALESCED = "PARSEC::COMM::COALESCED"
 COMM_CHUNKS_INFLIGHT = "PARSEC::COMM::CHUNKS_INFLIGHT"
 COMM_COMPRESS_RATIO = "PARSEC::COMM::COMPRESS_RATIO"
 COMM_LINK_BW_PREFIX = "PARSEC::COMM::LINK_BW"
+# fault-tolerance telemetry (ft/detector.py): peers currently confirmed
+# alive, and the per-peer heartbeat round-trip EWMA in milliseconds
+# (PARSEC::FT::HB_RTT::R<peer>, 0 until measured)
+FT_PEER_ALIVE = "PARSEC::FT::PEER_ALIVE"
+FT_HB_RTT_PREFIX = "PARSEC::FT::HB_RTT"
 
 #: trace stream ids (outside any plausible worker th_id range)
 COMM_STREAM_TID = 1 << 20
@@ -194,6 +200,17 @@ class CommObs:
                     lambda c=ce, p=peer: (lambda b: 0.0 if b is None
                                           else round(b, 3))(
                         c.link_bw_mbps(p)))
+        det = getattr(ce, "ft_detector", None)
+        if det is not None:
+            sde.register_poll(FT_PEER_ALIVE, det.alive_count)
+            for peer in range(ce.nb_ranks):
+                if peer == ce.rank:
+                    continue
+                sde.register_poll(
+                    f"{FT_HB_RTT_PREFIX}::R{peer}",
+                    lambda d=det, p=peer: (lambda r: 0.0 if r is None
+                                           else round(r * 1e3, 3))(
+                        d.rtt_s(p)))
 
 
 def register_device_gauges(sde: Any, device: Any) -> None:

@@ -121,6 +121,29 @@ class Context:
             self.rank = comm.rank
             self.nb_ranks = comm.nb_ranks
             rank = self.rank
+        # fault tolerance (ft/): proactive heartbeat detection when
+        # ft_heartbeat_interval is set (BEFORE the obs wiring below, so
+        # register_engine_gauges sees ce.ft_detector), and the
+        # task-boundary half of the fault injector when ft_inject has
+        # kill/taskfail directives
+        self._ft_detector = None
+        self._ft_pins = None
+        if self.comm is not None:
+            from ..ft.detector import maybe_install_detector
+            self._ft_detector = maybe_install_detector(self)
+        ft_inj = None
+        if self.comm is not None:
+            ft_inj = getattr(getattr(self.comm, "ce", self.comm),
+                             "_ft", None)
+        if ft_inj is None and params.get("ft_inject"):
+            from ..ft.inject import FaultInjector
+            ft_inj = FaultInjector.from_spec(params.get("ft_inject"),
+                                             rank=self.rank)
+        self.ft_injector = ft_inj
+        if ft_inj is not None and ft_inj.has_task_actions:
+            from ..ft.inject import FTInjectModule
+            self._ft_pins = FTInjectModule(ft_inj, self)
+            self._ft_pins.enable()
         self.vpmap = vpmap or VPMap.from_flat(nb_cores or default_nb_cores())
         self.nb_cores = self.vpmap.nb_total_threads
 
@@ -210,6 +233,10 @@ class Context:
         # worker threads (all but stream 0, which the caller's thread drives)
         self._start_gen = 0
         self._worker_gen: List[int] = [0] * (self.nb_cores - 1)
+        # workers currently inside context_wait_loop (guarded by
+        # _work_cond): clear_task_errors waits for this to hit zero so
+        # a rollback cannot race a worker still finishing its last task
+        self._workers_in_loop = 0
         self._threads: List[threading.Thread] = []
         for i, es in enumerate(self.execution_streams[1:]):
             t = threading.Thread(target=self._worker_main, args=(es, i),
@@ -328,11 +355,63 @@ class Context:
                               f"{exc!r}")
             plog.warning("%s", debug_history.history.dump(limit=64))
         self._task_errors.append(exc)
+        # termdet correction on rank eviction (ft/): the dead rank's
+        # tasks/actions can never settle, so waiting on the detectors is
+        # a guaranteed hang — abort every active pool NOW, which also
+        # unblocks taskpool-level waiters (DTD tp.wait) that do not
+        # consult the context's error list
+        from ..comm.engine import RankFailedError
+        if isinstance(exc, RankFailedError):
+            with self._tp_lock:
+                pools = list(self.taskpools.values())
+            for tp in pools:
+                tp.abort()
         # no count argument: nb_cores is not yet set when a transport
         # thread reports a dead peer during comm.attach() in __init__
         # (the same init-race window as the arrival wakeup fix), and
         # wake_workers notifies every parked worker regardless
         self.wake_workers()
+
+    def clear_task_errors(self) -> List[BaseException]:
+        """FT restart support (ft/restart.py): drop recorded errors and
+        every aborted taskpool's leftovers — scheduler queues, worker
+        bypass slots, deferred callbacks — so a rolled-back re-run can
+        be enqueued on this same context. Returns the drained errors.
+
+        QUIESCES the workers FIRST: ``wait()`` returns the moment the
+        error is recorded, but a worker can still be mid-task — its
+        in-place tile write, successor scheduling, or a late
+        record_task_error must not land AFTER this drain (a stale
+        error would instantly poison the retried stage). The recorded
+        errors keep ``all_tasks_done`` true while we wait, so every
+        worker drops out of its loop and parks; only then are the
+        errors, pools, and queues drained."""
+        with self._work_cond:
+            ok = self._work_cond.wait_for(
+                lambda: self._workers_in_loop == 0, timeout=10.0)
+        if not ok:  # pragma: no cover - a wedged task body
+            plog.warning("ft: %d worker(s) still busy after 10s; "
+                         "rollback may race their last task",
+                         self._workers_in_loop)
+        with self._tp_lock:
+            errors = list(self._task_errors)
+            self.taskpools.clear()
+            self._active_taskpools = 0
+            self._task_errors.clear()
+        drained = 0
+        for es in self.execution_streams:
+            es.next_task = None
+            # drain through EVERY stream: per-thread schedulers (lhq,
+            # ltq, ...) keep private buffers a select() through es0
+            # alone would never reach — a stale ready task surviving
+            # here would mutate the restored collections on the re-run
+            while self.scheduler.select(es) is not None:
+                drained += 1   # stale ready tasks of the aborted DAG
+        self._deferred.clear()
+        if drained:
+            plog.debug.verbose(2, "ft: dropped %d stale ready task(s) "
+                               "from the aborted DAG", drained)
+        return errors
 
     def raise_pending_error(self) -> None:
         if self._task_errors:
@@ -410,7 +489,13 @@ class Context:
                 if self.all_tasks_done():
                     self._worker_gen[widx] = self._start_gen
                     continue
-            context_wait_loop(es)
+                self._workers_in_loop += 1
+            try:
+                context_wait_loop(es)
+            finally:
+                with self._work_cond:
+                    self._workers_in_loop -= 1
+                    self._work_cond.notify_all()
 
     # ------------------------------------------------------------------ #
     # idle-loop helpers                                                  #
@@ -483,6 +568,10 @@ class Context:
                 self.taskpools.clear()
                 self._active_taskpools = 0
         self._finalized = True
+        if self._ft_detector is not None:
+            self._ft_detector.stop()   # before the engine dies under it
+        if self._ft_pins is not None:
+            self._ft_pins.disable()
         with self._work_cond:
             self._work_cond.notify_all()
         for t in self._threads:

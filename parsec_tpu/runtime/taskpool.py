@@ -227,6 +227,8 @@ class Taskpool(Obj):
         self._complete_cbs: List[Callable] = []
         self._lock = threading.Lock()
         self._completed = threading.Event()
+        self.aborted = False    # ft/: rank eviction aborted this DAG
+        self._finishing = False  # abort/termination claimed (see _claim)
         # lazily-constructed per-taskpool info items (ref: info object
         # arrays hanging off parsec_taskpool_t; torn down on completion)
         from ..core.info import InfoObjectArray, taskpool_infos
@@ -249,8 +251,41 @@ class Taskpool(Obj):
         self.tdm.taskpool_set_nb_tasks(n)
 
     # -- completion ---------------------------------------------------------
+    def _claim_finish(self, abort: bool) -> bool:
+        """Atomically claim the ONE finish of this pool. An abort (the
+        ft/ eviction path, fired from a detector/transport thread) and
+        a termdet settle (a worker thread) can race; whoever claims
+        first decides whether completion callbacks run — an unlocked
+        check-then-act would let callbacks fire on a pool the runtime
+        is simultaneously declaring failed."""
+        with self._lock:
+            if self._finishing:
+                return False
+            self._finishing = True
+            self.aborted = abort
+            return True
+
+    def abort(self) -> None:
+        """FT eviction path (ft/): the DAG cannot finish (a
+        participating rank is gone). Unblock ``wait_completed`` WITHOUT
+        running the completion callbacks — the pool did not complete,
+        and a waiter must consult the context's recorded errors. A late
+        termination_detected (counters settling after the abort) is a
+        no-op; losing the claim to a real termination is fine too (the
+        pool DID complete — nothing to abort)."""
+        if not self._claim_finish(abort=True):
+            return
+        plog.warning("taskpool %d (%s) aborted (rank eviction)",
+                     self.taskpool_id, self.name)
+        ctx = self.context
+        self._completed.set()
+        if ctx is not None:
+            ctx._taskpool_done(self)
+
     def termination_detected(self) -> None:
         """ref: parsec_taskpool_termination_detected (scheduling.c:212-230)"""
+        if not self._claim_finish(abort=False):
+            return
         plog.debug.verbose(5, "taskpool %d (%s) terminated", self.taskpool_id, self.name)
         if self.on_complete is not None:
             self.on_complete(self)

@@ -23,14 +23,34 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 
+class CheckpointMismatchError(ValueError):
+    """The snapshot's manifest does not match the restoring collection
+    (different geometry, rank count, or process grid). Raised BEFORE
+    any tile is loaded: a rank file holds only the tiles the saving
+    rank owned under ITS distribution, so restoring under a different
+    grid would silently leave foreign tiles empty / place tiles on the
+    wrong ranks."""
+
+
 def _manifest_of(coll: Any) -> Dict[str, Any]:
     man = {"lm": coll.lm, "ln": coll.ln, "mb": coll.mb, "nb": coll.nb,
            "dtype": np.dtype(coll.dtype).name,
-           "kind": type(coll).__name__}
+           "kind": type(coll).__name__,
+           # distribution identity: the shard set is only meaningful on
+           # the identical rank count / process grid it was written with
+           "nodes": getattr(coll, "nodes", 1),
+           "rank": getattr(coll, "rank", 0)}
     for attr in ("P", "Q", "krows", "kcols", "uplo"):
         if hasattr(coll, attr):
             man[attr] = getattr(coll, attr)
     return man
+
+
+def _grid_str(man: Dict[str, Any]) -> str:
+    grid = ""
+    if "P" in man and "Q" in man:
+        grid = f", grid {man['P']}x{man['Q']}"
+    return f"{man.get('nodes', '?')} rank(s){grid}"
 
 
 def checkpoint_path(prefix: str, rank: int) -> str:
@@ -61,13 +81,23 @@ def restore_collection(coll: Any, prefix: str) -> int:
         ours = _manifest_of(coll)
         # geometry AND distribution must match: a rank file holds only
         # the tiles the saving rank owned, so restoring under a
-        # different kind/grid would silently leave foreign tiles empty
-        for key in ("lm", "ln", "mb", "nb", "dtype", "kind", "P", "Q",
-                    "krows", "kcols", "uplo"):
-            if man.get(key) != ours.get(key):
-                raise ValueError(
-                    f"checkpoint {path} is incompatible: {key} "
-                    f"{man.get(key)!r} != {ours.get(key)!r}")
+        # different kind/grid/rank-count would silently leave foreign
+        # tiles empty or place tiles on the wrong ranks. Collect EVERY
+        # mismatch (one clear error beats a fix-one-rerun loop).
+        # "nodes"/"rank" are absent from pre-ft manifests: only compared
+        # when the snapshot recorded them.
+        keys = ["lm", "ln", "mb", "nb", "dtype", "kind", "P", "Q",
+                "krows", "kcols", "uplo"]
+        keys += [k for k in ("nodes", "rank") if k in man]
+        bad = [f"{k}: snapshot {man.get(k)!r} != ours {ours.get(k)!r}"
+               for k in keys if man.get(k) != ours.get(k)]
+        if bad:
+            raise CheckpointMismatchError(
+                f"checkpoint {path} is incompatible with the restoring "
+                f"collection ({'; '.join(bad)}). The snapshot was "
+                f"written on {_grid_str(man)}; this collection spans "
+                f"{_grid_str(ours)} — restore requires the identical "
+                f"tiling, dtype, rank count, and process grid.")
         n = 0
         for name in z.files:
             if not name.startswith("t"):

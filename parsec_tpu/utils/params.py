@@ -303,6 +303,29 @@ def register_core_params() -> None:
     params.reg_bool("comm_failure_strict", False,
                     "treat ANY torn peer connection as a rank failure "
                     "(default: only when the peer owes data or is sent to)")
+    # fault tolerance (ft/): proactive detection, injection, restart
+    params.reg_string("ft_heartbeat_interval", "",
+                      "seconds between heartbeat probes per peer (e.g. "
+                      "0.05); empty/0 = proactive failure detection off")
+    params.reg_string("ft_heartbeat_timeout", "",
+                      "declare an established peer dead after this many "
+                      "seconds of heartbeat silence (default: 8x the "
+                      "interval); must exceed the longest un-pumped "
+                      "progress stretch on in-process fabrics")
+    params.reg_string("ft_detector_mode", "timeout",
+                      "liveness judgment: timeout (fixed deadline) | phi "
+                      "(phi-accrual-style: deadline scales with the "
+                      "observed inter-arrival EWMA, floored at the "
+                      "timeout)")
+    params.reg_string("ft_inject", "",
+                      "deterministic fault-injection spec, e.g. "
+                      "\"kill:rank=1:after=3,drop:pct=2:seed=7\" "
+                      "(ops: kill, taskfail, drop, dup, delay, failsend; "
+                      "see ft/inject.py)")
+    params.reg_string("ft_restart_policy", "",
+                      "restart policy for ft.restart.run_with_restart: "
+                      "\"abort\" or "
+                      "\"restart:retries=N:backoff=S:every=K\"")
     # multi-process deployment (tools/launch.py sets these per rank —
     # the mpiexec analog; ref: parsec_remote_dep_set_ctx runtime.h:221)
     params.reg_string("comm_transport", "",

@@ -33,6 +33,76 @@ def test_restore_rejects_incompatible_geometry(tmp_path):
         ckpt.restore_collection(wrong, prefix)
 
 
+def test_restore_rejects_wrong_rank_count_and_grid(tmp_path):
+    """A snapshot written on a 4-rank 2x2 grid must fail FAST (clear
+    manifest-mismatch error) when restored onto a 2-rank 2x1 grid —
+    each shard holds only the tiles its writer owned under ITS
+    distribution, so loading the wrong shard set would silently drop
+    tiles."""
+    nb_ranks, n, nb = 4, 128, 32
+    prefix = str(tmp_path / "grid")
+
+    def save_rank(rank, fabric):
+        d = TwoDimBlockCyclic(n, n, nb, nb, P=2, Q=2, nodes=nb_ranks,
+                              rank=rank, dtype=np.float32)
+        return ckpt.save_collection(d, prefix)
+
+    spmd(nb_ranks, save_rank)
+
+    wrong = TwoDimBlockCyclic(n, n, nb, nb, P=2, Q=1, nodes=2, rank=0,
+                              dtype=np.float32)
+    with pytest.raises(ckpt.CheckpointMismatchError) as ei:
+        ckpt.restore_collection(wrong, prefix)
+    msg = str(ei.value)
+    # names every mismatched field and both grids, so the operator sees
+    # WHAT diverged without replaying the save
+    assert "nodes" in msg and "Q" in msg
+    assert "4 rank(s), grid 2x2" in msg
+    assert "2 rank(s), grid 2x1" in msg
+
+    # a single-rank collection can't swallow a 4-rank shard either
+    single = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float32)
+    with pytest.raises(ckpt.CheckpointMismatchError):
+        ckpt.restore_collection(single, prefix)
+
+
+def test_mismatch_error_aggregates_all_keys(tmp_path):
+    """One error listing EVERY divergent key (tile size and dtype here)
+    beats a fix-one-rerun loop."""
+    A = TwoDimBlockCyclic(64, 64, 32, 32, dtype=np.float32).from_numpy(
+        np.ones((64, 64), np.float32))
+    prefix = str(tmp_path / "agg")
+    ckpt.save_collection(A, prefix)
+    wrong = TwoDimBlockCyclic(64, 64, 16, 16, dtype=np.float64)
+    with pytest.raises(ckpt.CheckpointMismatchError) as ei:
+        ckpt.restore_collection(wrong, prefix)
+    msg = str(ei.value)
+    assert "mb" in msg and "dtype" in msg
+
+
+def test_restore_accepts_pre_ft_manifest(tmp_path):
+    """Snapshots written before the manifest carried nodes/rank (the
+    pre-ft format) still restore: those keys are only compared when the
+    snapshot recorded them."""
+    import json
+
+    rng = np.random.RandomState(3)
+    M = rng.rand(64, 64).astype(np.float32)
+    A = TwoDimBlockCyclic(64, 64, 32, 32, dtype=np.float32).from_numpy(M)
+    prefix = str(tmp_path / "oldfmt")
+    path = ckpt.save_collection(A, prefix)
+    # rewrite the manifest without the new keys (the old writer)
+    with np.load(path, allow_pickle=False) as z:
+        man = json.loads(str(z["__manifest__"]))
+        tiles = {k: z[k] for k in z.files if k.startswith("t")}
+    for k in ("nodes", "rank"):
+        man.pop(k, None)
+    np.savez(path, __manifest__=json.dumps(man), **tiles)
+    B = TwoDimBlockCyclic(64, 64, 32, 32, dtype=np.float32)
+    assert ckpt.restore_collection(B, prefix) == 4
+    np.testing.assert_array_equal(B.to_numpy(), M)
+
+
 def test_checkpoint_resume_mid_computation(ctx, tmp_path):
     """Factor, checkpoint at the quiescent point, clobber, restore, and
     continue with a solve — the resume path a failed run would take."""

@@ -295,9 +295,12 @@ def test_compressed_frame_roundtrip():
                       compress_threshold_mbps=10 ** 7)
     try:
         deadline = time.time() + 10           # negotiation done first
-        while e0._peers[1].codec is None and time.time() < deadline:
+        # _peer_to waits for the accept thread's registration: under
+        # full-suite load the connection may not be in _peers yet
+        peer = e0._peer_to(1)
+        while peer.codec is None and time.time() < deadline:
             time.sleep(0.005)
-        assert e0._peers[1].codec is not None
+        assert peer.codec is not None
         got = []
         e1.tag_register(400, lambda src, p: got.append(p))
         z = np.zeros(1 << 19)                 # 4 MB of zeros: compresses
@@ -325,10 +328,11 @@ def test_mixed_version_peer_stays_uncompressed():
         # carried codecs we know. Wait for the real HELLO first — the
         # override must not be raced and re-negotiated by its arrival.
         deadline = time.time() + 10
-        while e0._peers[1].codec is None and time.time() < deadline:
+        peer = e0._peer_to(1)      # waits for the accept registration
+        while peer.codec is None and time.time() < deadline:
             time.sleep(0.005)
-        assert e0._peers[1].codec is not None
-        e0._peers[1].codec = None
+        assert peer.codec is not None
+        peer.codec = None
         got = []
         e1.tag_register(500, lambda src, p: got.append(p))
         z = np.zeros(1 << 19)

@@ -1,0 +1,603 @@
+"""Fault-tolerance subsystem (parsec_tpu/ft/): proactive heartbeat
+detection, deterministic fault injection, checkpoint-integrated restart.
+
+All in-process (no real process kills): the injector silences a rank's
+engine at a task boundary — the observable footprint of a SIGKILL — and
+the survivors must DETECT it via heartbeats, abort with RankFailedError
+instead of hanging in termdet, and a restarted run from the last
+snapshot must reproduce the failure-free result.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import parsec_tpu
+from conftest import spmd
+from parsec_tpu.collections import TwoDimBlockCyclic
+from parsec_tpu.comm import LocalFabric, RankFailedError, RemoteDepEngine
+from parsec_tpu.comm.engine import TAG_HEARTBEAT
+from parsec_tpu.dsl import ptg
+from parsec_tpu.ft import (FaultInjector, HeartbeatDetector, InjectedKill,
+                           InjectedTaskFault, RestartPolicy,
+                           run_with_restart)
+from parsec_tpu.ft.inject import parse_inject_spec
+from parsec_tpu.utils.params import params
+
+
+@pytest.fixture(autouse=True)
+def _clean_params():
+    params.reset()
+    yield
+    params.reset()
+
+
+def _establish_all(ctx, eng, nb_ranks, rank):
+    """Pump until this rank's detector has heartbeat contact with every
+    peer, then barrier. On the in-process fabrics only ESTABLISHED
+    peers are ever evicted (an unanswered probe may just be a
+    not-yet-pumping startup), so kill tests must establish contact
+    BEFORE the workload — exactly what a long-running job has."""
+    det = ctx._ft_detector
+    if det is None:
+        return
+    deadline = time.monotonic() + 15.0
+    while any(not det.is_established(p)
+              for p in range(nb_ranks) if p != rank):
+        assert time.monotonic() < deadline, "heartbeat never established"
+        eng.ce.progress()
+        time.sleep(0.002)
+    eng.ce.sync()
+
+
+def _pump(engines, secs, until=None):
+    deadline = time.monotonic() + secs
+    while time.monotonic() < deadline:
+        for e in engines:
+            e.progress()
+        if until is not None and until():
+            return True
+        time.sleep(0.002)
+    return until() if until is not None else True
+
+
+# --------------------------------------------------------------------- #
+# detector                                                              #
+# --------------------------------------------------------------------- #
+def test_detection_latency_within_timeout():
+    """A silenced (kill-injected) peer is declared dead within the
+    configured heartbeat timeout — the core detection-latency bound."""
+    fab = LocalFabric(2)
+    e0, e1 = fab.engine(0), fab.engine(1)
+    det = HeartbeatDetector(e0, interval=0.02, timeout=0.3).start()
+    try:
+        assert _pump([e0, e1], 5.0, until=lambda: det.is_established(1))
+        assert det.rtt_s(1) is not None and det.rtt_s(1) < 1.0
+        assert det.alive_count() == 1
+        e1.ft_silence()                      # goes dark, sockets "open"
+        t0 = time.monotonic()
+        assert _pump([e0], 5.0, until=lambda: 1 in e0.dead_peers)
+        latency = time.monotonic() - t0
+        # timeout + one probe interval + scheduling slack
+        assert latency < 0.3 + 0.02 + 0.6, f"detected in {latency:.3f}s"
+        assert det.alive_count() == 0
+        assert det.evictions == 1
+    finally:
+        det.stop()
+
+
+def test_kill_before_first_contact_still_detected_tcp():
+    """On TCP a rank that dies right after startup — before the first
+    heartbeat exchange — must still be evicted: a successful probe
+    implies the peer's receiver thread was alive (it processed our
+    HELLO), so probed-but-silent is genuinely dead, baselined at the
+    start of probing."""
+    import concurrent.futures as cf
+
+    from parsec_tpu.comm.tcp import TCPCommEngine, free_ports
+
+    eps = [("127.0.0.1", p) for p in free_ports(2)]
+    with cf.ThreadPoolExecutor(2) as ex:
+        e0, e1 = list(ex.map(lambda r: TCPCommEngine(r, eps), range(2)))
+    det = HeartbeatDetector(e0, interval=0.02, timeout=0.3)
+    try:
+        # dark BEFORE any probe could be answered (HELLO already
+        # exchanged at connection setup — the support gate is satisfied)
+        deadline = time.monotonic() + 5.0
+        while not e0._peers.get(1) or not e0._peers[1].hb_ok:
+            assert time.monotonic() < deadline, "HELLO never processed"
+            time.sleep(0.005)
+        e1.ft_silence()
+        det.start()
+        t0 = time.monotonic()
+        assert _pump([], 5.0, until=lambda: 1 in e0.dead_peers)
+        assert time.monotonic() - t0 < 0.3 + 0.02 + 0.6
+        assert not det.is_established(1)
+    finally:
+        det.stop()
+        e0.fini()
+        e1.fini()
+
+
+def test_unresponsive_local_peer_not_evicted_before_contact():
+    """On the in-process fabrics an unanswered probe may just mean the
+    peer is not pumping progress yet (startup, a cold jit compile) —
+    only ESTABLISHED peers are ever judged there, so a slow-starting
+    healthy rank is never false-evicted."""
+    fab = LocalFabric(2)
+    e0, e1 = fab.engine(0), fab.engine(1)
+    det = HeartbeatDetector(e0, interval=0.02, timeout=0.1).start()
+    try:
+        _pump([e0], 0.5)        # e1 never progresses: "still starting"
+        assert 1 not in e0.dead_peers
+        # the moment it answers once, normal silence judgment applies
+        assert _pump([e0, e1], 5.0, until=lambda: det.is_established(1))
+        e1.ft_silence()
+        assert _pump([e0], 5.0, until=lambda: 1 in e0.dead_peers)
+    finally:
+        det.stop()
+
+
+def test_mixed_version_peer_never_declared_dead():
+    """A peer that cannot speak the heartbeat protocol (mixed version:
+    its TAG_HEARTBEAT handler never existed) is never ESTABLISHED and
+    therefore never evicted, no matter how long it stays silent."""
+    fab = LocalFabric(2)
+    e0, e1 = fab.engine(0), fab.engine(1)
+    e1.tag_unregister(TAG_HEARTBEAT)       # simulate a pre-ft build
+    det = HeartbeatDetector(e0, interval=0.02, timeout=0.1).start()
+    try:
+        _pump([e0, e1], 0.5)               # >> timeout, pings unanswered
+        assert not det.is_established(1)
+        assert 1 not in e0.dead_peers
+    finally:
+        det.stop()
+
+
+def test_cleanly_finished_peer_never_declared_dead():
+    """Finishing early is not failing: a rank that fini'd cleanly stops
+    heartbeating but must not be evicted (local-fabric finish mark; the
+    TCP GOODBYE plays the same role there)."""
+    fab = LocalFabric(2)
+    e0, e1 = fab.engine(0), fab.engine(1)
+    det = HeartbeatDetector(e0, interval=0.02, timeout=0.15).start()
+    try:
+        assert _pump([e0, e1], 5.0, until=lambda: det.is_established(1))
+        e1.fini()                           # clean shutdown, not a crash
+        _pump([e0], 0.5)                    # >> timeout
+        assert 1 not in e0.dead_peers
+        assert e0.peer_finished(1)
+    finally:
+        det.stop()
+
+
+def test_detector_phi_mode_and_bad_config():
+    fab = LocalFabric(2)
+    e0 = fab.engine(0)
+    with pytest.raises(ValueError, match="must exceed"):
+        HeartbeatDetector(e0, interval=0.1, timeout=0.1)
+    with pytest.raises(ValueError, match="ft_detector_mode"):
+        HeartbeatDetector(e0, interval=0.1, timeout=1.0, mode="psychic")
+    det = HeartbeatDetector(e0, interval=0.02, timeout=0.2, mode="phi")
+    # phi: with no gap history the fixed timeout is the floor
+    st = det._peers[1]
+    assert det._deadline_for(st) == 0.2
+    st.gap_s = 0.05
+    assert det._deadline_for(st) == pytest.approx(0.4)  # 8x gap EWMA
+
+
+def test_uniform_on_peer_failure_across_transports():
+    """Satellite: local/mesh engines carry the same report_peer_failure
+    / on_peer_failure / dead_peers surface the TCP engine had, and
+    remote_dep wires the context abort unconditionally."""
+    fab = LocalFabric(2)
+    eng = RemoteDepEngine(fab.engine(0))
+    ctx = parsec_tpu.Context(nb_cores=1, comm=eng, enable_tpu=False)
+    try:
+        assert eng.ce.on_peer_failure is not None   # no hasattr guard
+        eng.ce.report_peer_failure(1, "unit test")
+        assert 1 in eng.ce.dead_peers
+        with pytest.raises(RankFailedError):
+            eng.ce.send_am(1, 100, {"x": 1})
+        # idempotent: a second report records no second error
+        n_errs = len(ctx._task_errors)
+        eng.ce.report_peer_failure(1, "again")
+        assert len(ctx._task_errors) == n_errs
+        with pytest.raises(RuntimeError) as ei:
+            ctx.wait()
+        assert isinstance(ei.value.__cause__, RankFailedError)
+        ctx.clear_task_errors()             # let fini see a clean context
+    finally:
+        ctx.fini()
+    # the mesh engine (device-plane transport) carries the same surface
+    from parsec_tpu.comm import MeshFabric
+    mesh_eng = MeshFabric(2).engine(0)
+    mesh_eng.report_peer_failure(1, "unit test")
+    assert 1 in mesh_eng.dead_peers
+    with pytest.raises(RankFailedError):
+        mesh_eng.send_am(1, 100, {"x": 1})
+
+
+# --------------------------------------------------------------------- #
+# injector                                                              #
+# --------------------------------------------------------------------- #
+def test_inject_spec_parser():
+    ds = parse_inject_spec(
+        "kill:rank=1:after=3, drop:pct=2.5:seed=7:peer=2; failsend:nth=4")
+    assert [d["op"] for d in ds] == ["kill", "drop", "failsend"]
+    assert ds[0]["rank"] == 1 and ds[0]["after"] == 3
+    assert ds[1]["pct"] == 2.5 and ds[1]["peer"] == 2
+    with pytest.raises(ValueError, match="unknown op"):
+        parse_inject_spec("explode:rank=1")
+    with pytest.raises(ValueError, match="unknown key"):
+        parse_inject_spec("kill:when=later")
+    # a wire directive that could never fire is a config error, not a
+    # silent no-op (the chaos run would validate nothing)
+    with pytest.raises(ValueError, match="never fire"):
+        parse_inject_spec("drop:rank=1")
+
+
+def test_inject_wire_ops_deterministic():
+    inj_a = FaultInjector.from_spec("drop:pct=30:seed=42", rank=0)
+    inj_b = FaultInjector.from_spec("drop:pct=30:seed=42", rank=0)
+    va = [inj_a.on_send(1, 100) for _ in range(200)]
+    vb = [inj_b.on_send(1, 100) for _ in range(200)]
+    assert va == vb                          # seeded: reproducible
+    assert 20 < va.count("drop") < 100       # ~30% of 200
+    # rank-salted: another rank draws a different (but fixed) stream
+    inj_c = FaultInjector.from_spec("drop:pct=30:seed=42", rank=1)
+    vc = [inj_c.on_send(1, 100) for _ in range(200)]
+    assert vc != va
+    # heartbeat traffic is exempt unless hb=1
+    inj_d = FaultInjector.from_spec("drop:pct=100:seed=1", rank=0)
+    assert inj_d.on_send(1, TAG_HEARTBEAT) == "ok"
+    assert inj_d.on_send(1, 100) == "drop"
+    # the Nth send fails exactly once
+    inj_e = FaultInjector.from_spec("failsend:nth=3", rank=0)
+    assert inj_e.on_send(1, 100) == "ok"
+    assert inj_e.on_send(1, 100) == "ok"
+    with pytest.raises(RankFailedError):
+        inj_e.on_send(1, 100)
+    assert inj_e.on_send(1, 100) == "ok"
+
+
+def test_injected_drop_on_local_fabric():
+    """drop:pct=100 makes the local fabric a black hole toward peers
+    (messages vanish at the wire layer, self-sends untouched)."""
+    params.set_cmdline("ft_inject", "drop:pct=100:seed=1")
+    fab = LocalFabric(2)
+    e0, e1 = fab.engine(0), fab.engine(1)
+    got = []
+    e1.tag_register(100, lambda s, p: got.append(p))
+    e0.send_am(1, 100, {"i": 1})
+    e1.progress()
+    assert got == []
+    assert e0._ft.stats["dropped"] == 1
+
+
+# --------------------------------------------------------------------- #
+# kill a rank: detection + survivor abort (the acceptance scenario)     #
+# --------------------------------------------------------------------- #
+CHAIN_JDF = """
+descA [ type="collection" ]
+NB [ type="int" ]
+
+Step(k)
+
+k = 0 .. NB
+
+: descA( k, 0 )
+
+RW A <- (k == 0) ? descA( k, 0 ) : A Step( k-1 )
+     -> (k == NB) ? descA( k, 0 ) : A Step( k+1 )
+
+BODY
+{
+    A[0, 0] += 1.0
+}
+END
+"""
+
+
+def test_killed_rank_detected_survivors_raise():
+    """kill:rank=1:after=2 over a 3-rank PTG chain: rank 1 goes dark at
+    its 2nd task boundary; the survivors' detectors evict it within the
+    heartbeat timeout and their waits raise RankFailedError instead of
+    hanging in termdet; the victim aborts with InjectedKill."""
+    nb_ranks, NB, tile = 3, 12, 4
+    params.set_cmdline("ft_heartbeat_interval", "0.05")
+    params.set_cmdline("ft_heartbeat_timeout", "1.0")
+    params.set_cmdline("ft_inject", "kill:rank=1:after=2")
+
+    def rank_fn(rank, fabric):
+        eng = RemoteDepEngine(fabric.engine(rank))
+        ctx = parsec_tpu.Context(nb_cores=1, comm=eng, enable_tpu=False)
+        try:
+            assert ctx._ft_detector is not None
+            coll = TwoDimBlockCyclic((NB + 1) * tile, tile, tile, tile,
+                                     P=nb_ranks, Q=1, nodes=nb_ranks,
+                                     rank=rank)
+            coll.name = "descA"
+            tp = ptg.compile_jdf(CHAIN_JDF, name="chain").new(
+                descA=coll, NB=NB, rank=rank, nb_ranks=nb_ranks)
+            _establish_all(ctx, eng, nb_ranks, rank)
+            t0 = time.monotonic()
+            try:
+                ctx.add_taskpool(tp)
+                ctx.wait()
+                return ("completed", time.monotonic() - t0)
+            except RuntimeError as e:
+                return (type(e.__cause__).__name__, time.monotonic() - t0)
+        finally:
+            ctx.clear_task_errors()
+            ctx.fini()
+
+    results, _ = spmd(nb_ranks, rank_fn, timeout=60)
+    outcomes = {r: results[r][0] for r in range(nb_ranks)}
+    assert outcomes[1] == "InjectedKill"
+    for r in (0, 2):
+        assert outcomes[r] == "RankFailedError", outcomes
+        # detection bound: timeout + probe + generous sched slack —
+        # far below the spmd hang timeout this replaces
+        assert results[r][1] < 10.0, results[r]
+
+
+def test_taskfail_injection_and_restart_driver(tmp_path):
+    """A transient injected task fault aborts the stage; the restart
+    driver rolls back to the last snapshot, retries with backoff, and
+    the final result matches the failure-free run exactly."""
+    from parsec_tpu.ops import dpotrf_taskpool, make_spd
+
+    n, nb = 96, 32
+    M = make_spd(n)
+
+    # failure-free reference
+    ctx = parsec_tpu.init(nb_cores=2, enable_tpu=False)
+    try:
+        A_ref = TwoDimBlockCyclic(n, n, nb, nb,
+                                  dtype=np.float32).from_numpy(M)
+        ctx.add_taskpool(dpotrf_taskpool(A_ref))
+        ctx.wait()
+        ref = A_ref.to_numpy()
+    finally:
+        ctx.fini()
+
+    params.set_cmdline("ft_inject", "taskfail:nth=4")
+    ctx = parsec_tpu.init(nb_cores=2, enable_tpu=False)
+    try:
+        assert ctx.ft_injector is not None and ctx._ft_pins is not None
+        A = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float32).from_numpy(M)
+        stats = run_with_restart(
+            ctx, [lambda: dpotrf_taskpool(A)], [A],
+            str(tmp_path / "ck"),
+            policy=RestartPolicy("restart", retries=2, backoff=0.01))
+        assert stats["retries"] == 1
+        assert stats["snapshots"] == 2      # initial + final
+        assert ctx.ft_injector.stats["task_faults"] == 1
+        np.testing.assert_array_equal(A.to_numpy(), ref)
+    finally:
+        ctx.fini()
+
+
+def test_restart_policy_abort_and_exhaustion(tmp_path):
+    """abort mode never retries; restart mode re-raises once retries
+    are exhausted, leaving the context clean for fini."""
+    from parsec_tpu.ops import dpotrf_taskpool, make_spd
+
+    n, nb = 64, 32
+    M = make_spd(n)
+    with pytest.raises(ValueError, match="unknown restart mode"):
+        RestartPolicy("panic")
+    pol = RestartPolicy.parse("restart:retries=3:backoff=0.5:every=2")
+    assert (pol.mode, pol.retries, pol.backoff, pol.every) == \
+        ("restart", 3, 0.5, 2)
+
+    params.set_cmdline("ft_inject", "taskfail:nth=1")
+    ctx = parsec_tpu.init(nb_cores=2, enable_tpu=False)
+    try:
+        A = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float32).from_numpy(M)
+        with pytest.raises(RuntimeError) as ei:
+            run_with_restart(ctx, [lambda: dpotrf_taskpool(A)], [A],
+                             str(tmp_path / "ab"),
+                             policy=RestartPolicy("abort"))
+        assert isinstance(ei.value.__cause__, InjectedTaskFault)
+        assert not ctx._task_errors          # guaranteed-clean abort
+        # the same context is reusable after the clean abort
+        A2 = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float32).from_numpy(M)
+        ctx.add_taskpool(dpotrf_taskpool(A2))
+        ctx.wait()
+    finally:
+        ctx.fini()
+
+
+def test_retry_bound_holds_across_rollback_replays(tmp_path):
+    """With every>1 a rollback replays earlier (succeeding) stages;
+    their completion must NOT reset the failing stage's attempt count,
+    or a persistent fault retries forever (attempts are per stage)."""
+    from parsec_tpu.runtime.taskpool import Taskpool
+
+    ctx = parsec_tpu.init(nb_cores=1, enable_tpu=False)
+    try:
+        calls = {"ok": 0, "bad": 0}
+
+        def ok_stage():
+            calls["ok"] += 1
+            return Taskpool("ok-stage")     # zero tasks: completes
+
+        def bad_stage():
+            calls["bad"] += 1
+            raise RuntimeError("persistent fault")
+
+        with pytest.raises(RuntimeError, match="persistent fault"):
+            run_with_restart(
+                ctx, [ok_stage, bad_stage], [], str(tmp_path / "rb"),
+                policy=RestartPolicy("restart", retries=1,
+                                     backoff=0.01, every=2))
+        # initial run + exactly ONE bounded retry, then abort
+        assert calls["bad"] == 2
+        assert calls["ok"] == 2              # replayed once by rollback
+    finally:
+        ctx.fini()
+
+
+def test_injected_kill_is_hard_never_retried(tmp_path):
+    """A kill is a loss of THIS rank: even with retries budgeted, the
+    restart driver must abort immediately — retrying a stage on a
+    permanently silenced engine would hang termdet forever (the
+    failure mode ft/ exists to eliminate)."""
+    from parsec_tpu.ops import dpotrf_taskpool, make_spd
+
+    n, nb = 64, 32
+    params.set_cmdline("ft_inject", "kill:rank=0:after=1")
+    ctx = parsec_tpu.init(nb_cores=1, enable_tpu=False)
+    try:
+        A = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float32).from_numpy(
+            make_spd(n))
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError) as ei:
+            run_with_restart(
+                ctx, [lambda: dpotrf_taskpool(A)], [A],
+                str(tmp_path / "kill"),
+                policy=RestartPolicy("restart", retries=5, backoff=0.5))
+        assert isinstance(ei.value.__cause__, InjectedKill)
+        # no retry, no backoff burn: it aborted on the first failure
+        assert time.monotonic() - t0 < 0.5 * 5
+    finally:
+        ctx.fini()
+
+
+def test_dpotrf_kill_checkpoint_restart_identical(tmp_path):
+    """The acceptance scenario end to end: distributed dpotrf, rank 1
+    chaos-killed mid-factorization; every rank aborts (no termdet
+    hang); a fresh incarnation restores the pre-stage snapshot and
+    re-runs — numerically identical to a failure-free run."""
+    from parsec_tpu.ops import make_spd
+
+    nb_ranks, n, nb = 2, 128, 32
+    M = make_spd(n)
+    prefix = str(tmp_path / "ck")
+
+    def dist(rank):
+        d = TwoDimBlockCyclic(n, n, nb, nb, P=nb_ranks, Q=1,
+                              nodes=nb_ranks, rank=rank, dtype=np.float32)
+        for (i, j) in d.local_tiles():
+            np.copyto(d.tile(i, j),
+                      M[i * nb:(i + 1) * nb, j * nb:(j + 1) * nb])
+        return d
+
+    def run_rank(rank, fabric, inject):
+        eng = RemoteDepEngine(fabric.engine(rank))
+        ctx = parsec_tpu.Context(nb_cores=1, comm=eng, enable_tpu=False)
+        try:
+            A = dist(rank)
+            A.name = "descA"
+            _establish_all(ctx, eng, nb_ranks, rank)
+            from parsec_tpu.ops import dpotrf_taskpool
+            stages = [lambda: dpotrf_taskpool(A, rank=rank,
+                                              nb_ranks=nb_ranks)]
+            try:
+                stats = run_with_restart(
+                    ctx, stages, [A], prefix,
+                    policy=RestartPolicy("restart", retries=0),
+                    resume_from=0 if not inject else None)
+                local = {t: np.array(A.tile(*t)) for t in A.local_tiles()}
+                return ("ok", local, stats)
+            except RuntimeError as e:
+                return (type(e.__cause__).__name__, None, None)
+        finally:
+            ctx.clear_task_errors()
+            ctx.fini()
+
+    # incarnation 1: snapshot at stage 0, then rank 1 dies mid-DAG
+    params.set_cmdline("ft_heartbeat_interval", "0.05")
+    params.set_cmdline("ft_heartbeat_timeout", "1.0")
+    params.set_cmdline("ft_inject", "kill:rank=1:after=2")
+    results, _ = spmd(nb_ranks,
+                      lambda r, f: run_rank(r, f, inject=True), timeout=60)
+    assert results[1][0] == "InjectedKill"
+    assert results[0][0] == "RankFailedError"   # no termdet hang
+
+    # incarnation 2: fresh fabric, restore stage-0 snapshot, run clean
+    params.set_cmdline("ft_inject", "")
+    results, _ = spmd(nb_ranks,
+                      lambda r, f: run_rank(r, f, inject=False), timeout=60)
+    merged = {}
+    for st, local, stats in results:
+        assert st == "ok"
+        merged.update(local)
+
+    # failure-free reference on the same grid (no ft knobs at all)
+    params.reset()
+    ref_results, _ = spmd(nb_ranks,
+                          lambda r, f: run_rank(r, f, inject=False),
+                          timeout=60)
+    ref = {}
+    for st, local, _ in ref_results:
+        assert st == "ok"
+        ref.update(local)
+    assert set(merged) == set(ref)
+    for t in ref:
+        np.testing.assert_array_equal(merged[t], ref[t])
+
+
+# --------------------------------------------------------------------- #
+# termdet correction: taskpool-level waiters unblock on eviction        #
+# --------------------------------------------------------------------- #
+def test_taskpool_abort_unblocks_wait():
+    fab = LocalFabric(2)
+    eng = RemoteDepEngine(fab.engine(0))
+    ctx = parsec_tpu.Context(nb_cores=1, comm=eng, enable_tpu=False)
+    try:
+        from parsec_tpu.runtime.taskpool import Taskpool
+        from parsec_tpu.runtime.termdet import termdet_new
+        tp = Taskpool("ft-abort")
+        tp.tdm = termdet_new("user_trigger", tp)  # held open until trigger
+        ctx.add_taskpool(tp)
+        assert not tp.wait_completed(timeout=0.05)
+        eng.ce.report_peer_failure(1, "unit")
+        assert tp.wait_completed(timeout=5.0)
+        assert tp.aborted
+        # the late counter settle is a no-op, not a second completion
+        tp.tdm.user_trigger()
+        assert tp.aborted
+        ctx.clear_task_errors()
+    finally:
+        ctx.fini()
+
+
+def test_ft_gauges_registered():
+    """Satellite: PEER_ALIVE / HB_RTT::R<peer> appear in the context's
+    SDE registry when a detector is installed."""
+    from parsec_tpu.obs import FT_HB_RTT_PREFIX, FT_PEER_ALIVE
+
+    params.set_cmdline("ft_heartbeat_interval", "0.05")
+    params.set_cmdline("ft_heartbeat_timeout", "30")   # no evictions here
+    fab = LocalFabric(2)
+
+    def rank_fn(rank, fabric):
+        eng = RemoteDepEngine(fabric.engine(rank))
+        ctx = parsec_tpu.Context(nb_cores=1, comm=eng, enable_tpu=False)
+        try:
+            snap = ctx.sde.snapshot()
+            assert FT_PEER_ALIVE in snap
+            peer = 1 - rank
+            assert f"{FT_HB_RTT_PREFIX}::R{peer}" in snap
+            deadline = time.monotonic() + 5.0
+            alive = 0
+            while time.monotonic() < deadline:
+                eng.ce.progress()          # an idle context answers from
+                alive = ctx.sde.snapshot()[FT_PEER_ALIVE]  # its workers
+                if alive == 1:
+                    break
+                time.sleep(0.01)
+            # hold the engine alive until BOTH ranks measured: fini
+            # marks this rank finished, which drops it from the peer's
+            # alive gauge
+            eng.ce.sync()
+            return alive
+        finally:
+            ctx.fini()
+
+    counts, _ = spmd(2, rank_fn, fabric=fab)
+    assert counts == [1, 1]

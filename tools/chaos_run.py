@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""chaos_run — run any example/script under deterministic fault injection.
+
+Wires the ft/ knobs (injection spec, heartbeat detection, restart
+policy) into the MCA environment and executes the target script in this
+process, so a robustness claim can be exercised against any entry point
+without editing it::
+
+    # kill rank 1 after 5 tasks, detect within 0.5 s
+    python tools/chaos_run.py --inject "kill:rank=1:after=5" \\
+        --heartbeat 0.05 --timeout 0.5 -- examples/ex03_chain_multirank.py
+
+    # 2%% frame drop, reproducible
+    python tools/chaos_run.py --inject "drop:pct=2:seed=7" -- \\
+        examples/ex05_broadcast.py
+
+    # transient task fault + automatic rollback/retry
+    python tools/chaos_run.py --inject "taskfail:nth=3" \\
+        --restart "restart:retries=2:backoff=0.1" -- \\
+        examples/ex08_dposv_checkpoint.py
+
+Everything after ``--`` is the script and ITS argv. Exit status: the
+script's (an uncaught injected failure exits non-zero — which is the
+point: chaos_run makes "does it fail loudly instead of hanging?"
+a one-liner).
+"""
+import argparse
+import os
+import runpy
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="chaos_run.py",
+        description="run a script under ft/ fault injection")
+    ap.add_argument("--inject", default="",
+                    help="ft_inject spec (see parsec_tpu/ft/inject.py), "
+                         "e.g. 'kill:rank=1:after=5,drop:pct=2:seed=7'")
+    ap.add_argument("--heartbeat", type=float, default=0.0, metavar="SECS",
+                    help="enable the proactive detector with this probe "
+                         "interval")
+    ap.add_argument("--timeout", type=float, default=0.0, metavar="SECS",
+                    help="heartbeat eviction deadline (default 8x the "
+                         "interval)")
+    ap.add_argument("--restart", default="", metavar="POLICY",
+                    help="ft_restart_policy, e.g. "
+                         "'restart:retries=2:backoff=0.25:every=1'")
+    ap.add_argument("script", help="python script to run")
+    ap.add_argument("args", nargs=argparse.REMAINDER,
+                    help="argv for the script (prefix with --)")
+    ns = ap.parse_args(argv)
+
+    directives = []
+    if ns.inject:
+        # validate the spec HERE so a typo is a chaos_run error, not a
+        # silent no-op inside the target
+        from parsec_tpu.ft.inject import parse_inject_spec
+        directives = parse_inject_spec(ns.inject)
+        os.environ["PARSEC_MCA_ft_inject"] = ns.inject
+    if ns.timeout > 0 and ns.heartbeat <= 0:
+        # --timeout alone would export a deadline nobody enforces (no
+        # detector without an interval): derive the probe cadence
+        ns.heartbeat = ns.timeout / 8.0
+    if any(d["op"] == "kill" for d in directives) and ns.heartbeat <= 0:
+        ap.error("--inject kill:... without --heartbeat/--timeout would "
+                 "hang the survivors (no detector to evict the silenced "
+                 "rank) — pass --heartbeat SECS")
+    if ns.heartbeat > 0:
+        os.environ["PARSEC_MCA_ft_heartbeat_interval"] = str(ns.heartbeat)
+    if ns.timeout > 0:
+        os.environ["PARSEC_MCA_ft_heartbeat_timeout"] = str(ns.timeout)
+    if ns.restart:
+        from parsec_tpu.ft.restart import RestartPolicy
+        RestartPolicy.parse(ns.restart)
+        os.environ["PARSEC_MCA_ft_restart_policy"] = ns.restart
+
+    script = os.path.abspath(ns.script)
+    # drop only the LEADING separator: a later "--" belongs to the
+    # target script's own argv
+    args = ns.args[1:] if ns.args[:1] == ["--"] else ns.args
+    sys.argv = [script] + args
+    sys.path.insert(0, os.path.dirname(script))
+    runpy.run_path(script, run_name="__main__")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
