@@ -1,0 +1,167 @@
+"""Batched device dispatch: stack same-class ready tasks into ONE jitted call.
+
+The reference GPU module amortizes submission by pipelining stage-in /
+exec / stage-out across streams (device_cuda_module.c, SURVEY §3.4); on
+XLA the analogous lever is amortizing the *dispatch* itself — one
+executable submission for a whole antichain of same-class tasks instead
+of one per task (the batched-dispatch discipline of "Large Scale
+Distributed Linear Algebra With TPUs", arxiv 2112.09017, and the
+fine-grained compute/transfer overlap of T3, arxiv 2401.16677).
+
+A task class opts in by attaching a :class:`DeviceBatchSpec` to its
+device chore (``Chore.batch_spec``).  The spec separates the *per-task*
+part (``extract``: which staged arrays are batchable and what static
+context the body needs) from the *traceable* part (``call``: the body
+as a pure function of those arrays).  The device module groups ready
+tasks whose (spec, static context, shapes, dtypes) agree and dispatches
+each group through one jitted callable built here.
+
+Two stacking modes (``device_batch_mode``):
+
+- ``unroll`` (default): the batched program contains one per-example
+  subgraph per task — N independent copies of exactly the graph the
+  per-task path traces, returned from ONE dispatch.  Results are
+  bit-exact vs per-task execution (each op lowers identically; measured
+  for cholesky / triangular-solve / matmul on the CPU backend — note
+  vmap is NOT bit-exact there for triangular solve), at the cost of
+  program size growing with the bucket.
+- ``vmap``: inputs are stacked and the body is vmapped — smaller
+  programs and batched kernels (MXU-friendly on TPU), but XLA may pick
+  a *different batched algorithm* (e.g. blocked triangular solve), so
+  results are only approximately equal to per-task execution.
+
+Batch sizes are bucketed to powers of two so the jitted-callable cache
+stays small; the cache lives ON the spec (so it dies with its taskpool)
+keyed by (bucket, static, shapes/dtypes, donate mask, mode) — or in the
+process-wide per-token cache for specs declaring taskpool independence
+(``cache_token``).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+__all__ = ["DeviceBatchSpec", "bucket_size", "stacked_callable_key",
+           "build_stacked_callable", "cached_stacked_callable"]
+
+
+class DeviceBatchSpec:
+    """Recipe for stacking same-class tasks into one jitted dispatch.
+
+    ``extract(task, arrays) -> None | (bargs, flow_idx, static)``
+        Per-task, non-traced.  ``arrays`` is the device module's staged
+        per-flow array list.  Returns the batchable array args (all jax
+        arrays), the flow index behind each (for access/donation
+        decisions), and a hashable static key covering EVERYTHING the
+        body reads that is not a batched array (referenced locals,
+        VALUE params, absent-flow mask, ...).  ``None`` means this task
+        cannot batch (falls back to per-task ``dyld_fn`` dispatch).
+
+    ``call(bargs, static) -> tuple`` — the body as a traceable pure
+        function: per-task outputs for the written flows, in flow
+        order.  Invoked under jit (and under vmap in ``vmap`` mode), so
+        it must be jax-traceable; an untraceable body is detected at
+        the first batched dispatch and the spec permanently falls back
+        (``batchable = False``).
+
+    ``cache_token`` (optional): a stable hashable proving the traced
+    computation is taskpool-independent (e.g. the DTD user kernel:
+    ``call`` reassembles its args from the static key and calls only
+    that function).  When given, stacked callables are cached in the
+    process-wide cache keyed by the token, so a NEW taskpool inserting
+    the same kernel over the same shapes hits an already-compiled
+    callable (steady-state submission across runs).  Leave ``None``
+    when ``call`` closes over per-taskpool state (the PTG body env):
+    those cache on the spec and die with it.
+    """
+
+    __slots__ = ("name", "extract", "call", "batchable", "cache",
+                 "cache_token")
+
+    def __init__(self, name: str,
+                 extract: Callable[[Any, Any], Optional[Tuple]],
+                 call: Callable[[Tuple, Any], Tuple],
+                 cache_token: Any = None) -> None:
+        self.name = name
+        self.extract = extract
+        self.call = call
+        self.batchable = True   # cleared on first trace failure
+        self.cache: Dict[Any, Any] = {}   # stacked-callable AOT cache
+        self.cache_token = cache_token
+
+
+def bucket_size(navail: int, batch_max: int) -> int:
+    """Largest power-of-two <= min(navail, batch_max): bounded compile
+    set {2, 4, 8, ...} while still amortizing most of a burst."""
+    n = min(navail, max(2, batch_max))
+    b = 1
+    while b * 2 <= n:
+        b *= 2
+    return b
+
+
+def stacked_callable_key(n: int, nargs: int, static: Any,
+                         shapes: Tuple, donate: Tuple, mode: str) -> Tuple:
+    return (n, nargs, static, shapes, donate, mode)
+
+
+#: process-wide stacked-callable cache for specs with a ``cache_token``
+#: (taskpool-independent bodies): token -> key -> jitted callable
+_shared_cache: Dict[Any, Dict[Any, Any]] = {}
+
+
+def cached_stacked_callable(spec: DeviceBatchSpec, n: int, nargs: int,
+                            static: Any, shapes: Tuple, mode: str,
+                            donate: Tuple[bool, ...] = ()) -> Callable:
+    """The AOT-cached stacked callable for this signature: per-token
+    process-wide when the spec declares taskpool independence (a new
+    taskpool over the same kernel/shapes skips tracing AND compiling),
+    else per-spec (dies with the taskpool)."""
+    key = stacked_callable_key(n, nargs, static, shapes, donate, mode)
+    cache = (_shared_cache.setdefault(spec.cache_token, {})
+             if spec.cache_token is not None else spec.cache)
+    fn = cache.get(key)
+    if fn is None:
+        fn = build_stacked_callable(spec, n, nargs, static, mode, donate)
+        cache[key] = fn
+    return fn
+
+
+def build_stacked_callable(spec: DeviceBatchSpec, n: int, nargs: int,
+                           static: Any, mode: str,
+                           donate: Tuple[bool, ...] = ()) -> Callable:
+    """One jitted callable executing ``n`` same-signature tasks.
+
+    Flat calling convention (grouped by arg so donation maps to whole
+    arg groups): ``flat[j * n + i]`` is batch-arg ``j`` of task ``i``;
+    the result is flat grouped by output: ``out[k * n + i]`` is output
+    ``k`` of task ``i``.
+
+    The closure captures ``spec.call`` only (never the spec), so a
+    token-cached callable shared across taskpools keeps just the
+    underlying kernel alive.
+    """
+    import jax
+    call = spec.call
+
+    if mode == "vmap":
+        import jax.numpy as jnp
+
+        def stacked(*flat):
+            cols = tuple(jnp.stack(flat[j * n:(j + 1) * n])
+                         for j in range(nargs))
+            outs = jax.vmap(lambda *b: call(b, static))(*cols)
+            return tuple(outs[k][i] for k in range(len(outs))
+                         for i in range(n))
+    else:   # unroll: per-example subgraphs, bit-exact vs per-task
+
+        def stacked(*flat):
+            rows = [call(tuple(flat[j * n + i] for j in range(nargs)),
+                         static)
+                    for i in range(n)]
+            n_out = len(rows[0])
+            return tuple(rows[i][k] for k in range(n_out)
+                         for i in range(n))
+
+    donate_argnums = tuple(j * n + i for j, d in enumerate(donate) if d
+                           for i in range(n))
+    return jax.jit(stacked, donate_argnums=donate_argnums)

@@ -55,8 +55,18 @@ class Device:
             self.device_load = max(0.0, self.device_load - est)
 
     def progress(self, es) -> int:
-        """Advance asynchronous work; returns #completions handled."""
+        """Advance asynchronous work; returns the number of pipeline
+        steps handled (completions AND submissions — a batched device
+        flushing its accumulated ready queue made progress even when
+        nothing finished yet)."""
         return 0
+
+    def drain(self, context=None) -> None:
+        """Flush the device pipeline at a run boundary: retire trailing
+        in-flight records (recording async errors on ``context``) and
+        discard ready-queue entries stranded by a DAG abort.  Called by
+        ``Context.wait()`` exit and the FT rollback path
+        (``Context._drain_devices``); no-op for synchronous devices."""
 
     def fini(self) -> None:
         pass

@@ -40,7 +40,15 @@ _log = plog.device_stream
 
 
 def _array_ready(arr: Any) -> bool:
-    """True when the backing buffer is materialized (event-query analog)."""
+    """True when the backing buffer is materialized (event-query analog).
+    A DONATED buffer (device_donate: a successor batched call consumed
+    it) counts as ready — donation happens at the consumer's dispatch,
+    which XLA orders after this producer."""
+    try:
+        if arr.is_deleted():
+            return True
+    except AttributeError:
+        pass
     try:
         return arr.is_ready()
     except AttributeError:
@@ -77,13 +85,29 @@ class JaxDevice(Device):
         self._lru_owned: "OrderedDict[int, DataCopy]" = OrderedDict()
         self._mem_lock = threading.Lock()
         self.stats = {"stage_in_bytes": 0, "stage_out_bytes": 0,
-                      "evictions": 0, "tasks": 0}
+                      "evictions": 0, "tasks": 0,
+                      # batched-dispatch pipeline telemetry (guide §9.1)
+                      "batches": 0, "batched_tasks": 0,
+                      "dispatch_ns": 0, "dispatch_tasks": 0,
+                      "prefetch_issued": 0, "prefetch_hits": 0,
+                      "donated": 0}
         # eager completion (async dispatch IS completion; XLA orders the
         # dataflow) with a bounded in-flight window
         self.eager_complete = bool(params.get("tpu_eager_complete"))
         self.eager_window = int(params.get("tpu_eager_window"))
         self._window: List[_InFlight] = []
         self._eager_done: List[_InFlight] = []
+        # batched dispatch + async stage-in prefetch (the task-stream
+        # pipeline; ISSUE 5): same-class ready tasks accumulate in
+        # ``pending`` and are stacked into one jitted call per
+        # (class, shapes, dtypes, bucket) at the next manager flush
+        self.batch_max = int(params.get("device_batch_max"))
+        self.batch_mode = str(params.get("device_batch_mode"))
+        self.prefetch_depth = int(params.get("device_prefetch_depth"))
+        self.donate = bool(params.get("device_donate"))
+        # copies staged early by the prefetcher: id(copy) -> version;
+        # a stage-in that finds its copy here already valid is a HIT
+        self._prefetched: Dict[int, int] = {}
 
     def _probe_budget(self) -> int:
         try:
@@ -106,7 +130,22 @@ class JaxDevice(Device):
         self.load_add(est)
         task.es_hint = es.th_id
         self.pending.push_back((task, est))
-        # try to become the manager right away (first thread wins)
+        chore = task.task_class.incarnations[task.selected_chore]
+        spec = getattr(chore, "batch_spec", None)
+        if spec is not None and spec.batchable and self.batch_max > 1 \
+                and len(self.pending) < self.batch_max:
+            # accumulate: a same-class burst becomes ONE stacked
+            # dispatch at the next manager flush (idle workers call
+            # progress() every cycle, so the deferral is bounded by the
+            # releasing worker's remaining ready tasks).  Meanwhile
+            # stage-in the head of the queue early so its H2D overlaps
+            # the batch currently executing (the reference's push/exec
+            # stream overlap, device_cuda_module.c:1961-2012).
+            if 0 < len(self.pending) <= self.prefetch_depth:
+                self._prefetch_task(task)
+            return HookReturn.ASYNC
+        # queue full (or batching off): become the manager right away
+        # (first thread wins)
         self.progress(es)
         return HookReturn.ASYNC
 
@@ -118,17 +157,18 @@ class JaxDevice(Device):
             return 0  # someone else is the manager (CAS-owner pattern)
         try:
             n = 0
-            # push phase: submit everything pending
+            # push phase: drain everything pending and dispatch it —
+            # same-class/same-shape tasks as stacked batches, the rest
+            # per task.  Submissions count as progress (they advance
+            # the pipeline even when no completion is ready yet).
+            drained: List[Tuple[Task, float]] = []
             while True:
                 item = self.pending.pop_front()
                 if item is None:
                     break
-                task, est = item
-                try:
-                    self._submit(es, task, est)
-                except Exception as exc:  # surfacing beats hanging the DAG
-                    plog.warning("tpu submit failed for %s: %s", task.snprintf(), exc)
-                    raise
+                drained.append(item)
+            if drained:
+                n += self._dispatch_ready(es, drained)
             # poll phase: complete ready in-flight tasks
             if self._eager_done:
                 done, self._eager_done = self._eager_done, []
@@ -163,9 +203,15 @@ class JaxDevice(Device):
     # ------------------------------------------------------------------ #
     # stage-in / execute                                                 #
     # ------------------------------------------------------------------ #
-    def _stage_in(self, task: Task) -> List[Any]:
+    def _stage_in(self, task: Task,
+                  donate_ok: Optional[Dict[int, bool]] = None) -> List[Any]:
         """Resolve every input flow to an array on this device
-        (ref: parsec_cuda_kernel_push, device_cuda_module.c:2099-2195)."""
+        (ref: parsec_cuda_kernel_push, device_cuda_module.c:2099-2195).
+
+        ``donate_ok`` (flow_index -> bool), when given, marks WRITE
+        flows whose device buffer is exclusively ours — either freshly
+        device_put here or device-resident with no readers — and hence
+        safe to donate to a batched call."""
         import jax
         arrays: List[Any] = []
         for flow in task.task_class.flows:
@@ -177,6 +223,8 @@ class JaxDevice(Device):
             data = ref.data_in.data
             if data is None:
                 # detached copy (e.g. NEW tile scratch): move payload directly
+                if donate_ok is not None and access & FlowAccess.WRITE:
+                    donate_ok[flow.flow_index] = True
                 arrays.append(jax.device_put(ref.data_in.payload, self.jax_device))
                 continue
             copy = data.get_copy(self.device_index)
@@ -196,30 +244,55 @@ class JaxDevice(Device):
                 if obs is not None:
                     obs.xfer("in", nbytes, t0)
                 self.stats["stage_in_bytes"] += nbytes
+                self._prefetched.pop(id(copy), None)  # staged-over: stale
+            elif self._prefetched.pop(id(copy), None) is not None:
+                # the prefetcher staged this tile while an earlier batch
+                # executed and the version held: its H2D overlapped
+                # compute instead of serializing ahead of the dispatch
+                self.stats["prefetch_hits"] += 1
             data.complete_transfer_ownership(self.device_index, access)
             self._lru_touch(copy, owned=bool(access & FlowAccess.WRITE))
+            if donate_ok is not None and access & FlowAccess.WRITE \
+                    and copy.readers == 0:
+                donate_ok[flow.flow_index] = True
             arrays.append(copy.payload)
         return arrays
 
+    def _out_flows(self, task: Task) -> List[int]:
+        return [f.flow_index for f in task.task_class.flows
+                if (task.access_of(f) & FlowAccess.WRITE) and not f.ctl
+                and task.data[f.flow_index].data_in is not None]
+
     def _submit(self, es, task: Task, est: float) -> None:
+        self._submit_prepared(es, task, est, self._stage_in(task))
+
+    def _submit_prepared(self, es, task: Task, est: float,
+                         inputs: List[Any]) -> None:
+        """Per-task dispatch of an already-staged task (the classic
+        path; also the transparent fallback for singleton or
+        shape-divergent batches — semantics unchanged)."""
         tc = task.task_class
         chore = tc.incarnations[task.selected_chore]
         fn = chore.dyld_fn
         assert fn is not None, f"tpu chore of {tc.name} has no executable"
-        inputs = self._stage_in(task)
         # fn is the DSL's wrapper: (task, per-flow device arrays) -> outputs
+        t0 = time.perf_counter_ns()
         outputs = fn(task, inputs)
+        self.stats["dispatch_ns"] += time.perf_counter_ns() - t0
+        self.stats["dispatch_tasks"] += 1
         if outputs is None:
             outputs = ()
         elif not isinstance(outputs, (tuple, list)):
             outputs = (outputs,)
-        out_flows = [f.flow_index for f in tc.flows
-                     if (task.access_of(f) & FlowAccess.WRITE) and not f.ctl
-                     and task.data[f.flow_index].data_in is not None]
+        out_flows = self._out_flows(task)
         assert len(outputs) == len(out_flows), (
             f"{tc.name} tpu body returned {len(outputs)} arrays for "
             f"{len(out_flows)} written flows")
-        rec = _InFlight(task, list(outputs), out_flows, est)
+        self._finish_submit(es, task, est, list(outputs), out_flows)
+
+    def _finish_submit(self, es, task: Task, est: float,
+                       outputs: List[Any], out_flows: List[int]) -> None:
+        rec = _InFlight(task, outputs, out_flows, est)
         self.stats["tasks"] += 1
         if self.eager_complete:
             # TPU-native completion model: jax dispatch is async and XLA's
@@ -237,6 +310,219 @@ class JaxDevice(Device):
         else:
             self._inflight.append(rec)
 
+    # ------------------------------------------------------------------ #
+    # batched dispatch: stack same-class ready tasks into ONE jitted     #
+    # call (devices/batching.py; ISSUE 5 tentpole)                       #
+    # ------------------------------------------------------------------ #
+    def _dispatch_ready(self, es, items: List[Tuple[Task, float]]) -> int:
+        """Dispatch a drained ready set: group by (class, static context,
+        shapes, dtypes, donate mask), stack each group into power-of-two
+        buckets, fall back per-task for singletons / shape-divergent /
+        unbatchable tasks.  Returns the number of tasks submitted."""
+        from .batching import bucket_size
+        groups: Dict[Any, List[Tuple]] = {}
+        order: List[Any] = []   # dispatch groups in arrival order
+        n = 0
+        for idx, (task, est) in enumerate(items):
+            try:
+                chore = task.task_class.incarnations[task.selected_chore]
+                spec = getattr(chore, "batch_spec", None)
+                if spec is None or not spec.batchable or self.batch_max <= 1:
+                    self._submit(es, task, est)
+                    n += 1
+                    continue
+                donate_ok: Dict[int, bool] = {}
+                inputs = self._stage_in(
+                    task, donate_ok if self.donate else None)
+                ext = spec.extract(task, inputs)
+                if ext is None:
+                    self._submit_prepared(es, task, est, inputs)
+                    n += 1
+                    continue
+                bargs, flow_idx, static = ext
+                donate = tuple(bool(donate_ok.get(fi)) for fi in flow_idx)
+                shapes = tuple((tuple(a.shape), str(a.dtype)) for a in bargs)
+                key = (spec, static, shapes, donate)
+                if key not in groups:
+                    groups[key] = []
+                    order.append(key)
+                groups[key].append((task, est, inputs, bargs))
+            except Exception as exc:  # surfacing beats hanging the DAG
+                plog.warning("tpu submit failed for %s: %s",
+                             task.snprintf(), exc)
+                # the failing task is lost (its load is credited here);
+                # drained-but-untouched siblings and grouped entries go
+                # BACK to pending so a later progress dispatches them —
+                # or the abort path's drain() credits their load
+                self.load_sub(est)
+                for g in groups.values():
+                    for t2, e2, _inp, _ba in g:
+                        self.pending.push_back((t2, e2))
+                for t2, e2 in items[idx + 1:]:
+                    self.pending.push_back((t2, e2))
+                raise
+        for gidx, key in enumerate(order):
+            spec, static, shapes, donate = key
+            g = groups[key]
+            try:
+                # re-check batchable each bucket: a trace failure in the
+                # first chunk must not re-trace/re-fail the rest
+                while len(g) >= 2 and spec.batchable:
+                    b = bucket_size(len(g), self.batch_max)
+                    chunk, g = g[:b], g[b:]
+                    self._dispatch_batch(es, spec, static, donate, chunk)
+                    n += b
+                while g:   # singleton / post-downgrade remainder
+                    task, est, inputs, _ = g.pop(0)
+                    self._submit_prepared(es, task, est, inputs)
+                    n += 1
+            except Exception as exc:
+                plog.warning("tpu batch dispatch failed for %s: %s",
+                             spec.name, exc)
+                for t2, e2, _inp, _ba in g:   # undispatched of this group
+                    self.pending.push_back((t2, e2))
+                for k2 in order[gidx + 1:]:   # untouched later groups
+                    for t2, e2, _inp, _ba in groups[k2]:
+                        self.pending.push_back((t2, e2))
+                raise
+        return n
+
+    def _dispatch_batch(self, es, spec, static, donate,
+                        chunk: List[Tuple]) -> None:
+        """ONE stacked jitted call for ``chunk``; the lowered callable is
+        AOT-cached on the spec per (bucket, static, shapes, donate) so
+        steady-state submission is a cache hit.  Any trace/dispatch
+        failure (untraceable body, backend quirk) permanently downgrades
+        the spec to per-task dispatch — semantics are never at risk."""
+        from .batching import cached_stacked_callable
+        n = len(chunk)
+        nargs = len(chunk[0][3])
+        shapes = tuple((tuple(a.shape), str(a.dtype)) for a in chunk[0][3])
+        flat = [entry[3][j] for j in range(nargs) for entry in chunk]
+        if any(donate) and len({id(x) for x in flat}) != len(flat):
+            # the same buffer appears at two argument slots (a task
+            # whose flows alias one tile, e.g. f(x, x)): donating it
+            # while another slot still reads it is XLA's canonical
+            # `f(donate(a), a)` error — keep the batch, drop donation
+            donate = tuple(False for _ in donate)
+        fn = cached_stacked_callable(spec, n, nargs, static, shapes,
+                                     self.batch_mode, donate)
+        t0 = time.perf_counter_ns()
+        try:
+            outs = fn(*flat)
+        except Exception as exc:
+            if any(donate):
+                # donation-specific failures (backend aliasing rules)
+                # must not cost the whole batched path: retry this
+                # dispatch undonated before giving up on the spec
+                try:
+                    donate = tuple(False for _ in donate)
+                    fn = cached_stacked_callable(
+                        spec, n, nargs, static, shapes,
+                        self.batch_mode, donate)
+                    outs = fn(*flat)
+                    exc = None
+                except Exception as exc2:
+                    exc = exc2
+            if exc is not None:
+                spec.batchable = False
+                spec.cache.clear()
+                if spec.cache_token is not None:
+                    from .batching import _shared_cache
+                    _shared_cache.pop(spec.cache_token, None)
+                plog.warning("batched dispatch of %s disabled (%s: %s); "
+                             "falling back to per-task", spec.name,
+                             type(exc).__name__, exc)
+                for task, est, inputs, _ in chunk:
+                    self._submit_prepared(es, task, est, inputs)
+                return
+        self.stats["dispatch_ns"] += time.perf_counter_ns() - t0
+        self.stats["dispatch_tasks"] += n
+        self.stats["batches"] += 1
+        self.stats["batched_tasks"] += n
+        if any(donate):
+            self.stats["donated"] += sum(donate) * n
+        n_out = len(outs) // n if n else 0
+        for i, (task, est, inputs, _) in enumerate(chunk):
+            outputs = [outs[k * n + i] for k in range(n_out)]
+            out_flows = self._out_flows(task)
+            assert len(outputs) == len(out_flows), (
+                f"{task.task_class.name} batched body returned "
+                f"{len(outputs)} arrays for {len(out_flows)} written flows")
+            self._finish_submit(es, task, est, outputs, out_flows)
+
+    # ------------------------------------------------------------------ #
+    # async stage-in prefetch: overlap the NEXT batch's H2D with the     #
+    # current batch's execution (ref: the 3-stream push/exec/pop         #
+    # overlap, device_cuda_module.c:1961-2012)                           #
+    # ------------------------------------------------------------------ #
+    def _prefetch_task(self, task: Task) -> None:
+        """Early device_put of a queued task's host-resident inputs.
+        Runs on the submitting worker while the manager executes the
+        previous batch, so every check re-validates under the data lock
+        before committing (a racing stage-in must win)."""
+        import jax
+        for flow in task.task_class.flows:
+            if flow.ctl:
+                continue
+            ref = task.data[flow.flow_index]
+            if ref.data_in is None or ref.data_in.data is None:
+                continue
+            data = ref.data_in.data
+            with data._lock:
+                copy = data.get_copy(self.device_index)
+                newest = data.newest_version()
+                if copy is not None and copy.coherency != Coherency.INVALID \
+                        and copy.version >= newest:
+                    continue   # already device-resident and current
+                src = data.newest_copy(exclude_device=self.device_index)
+                # snapshot the version WITH the payload decision: the
+                # commit below must stamp the version these bytes had,
+                # not whatever the source advanced to meanwhile (an
+                # eviction writeback bumping the host copy between our
+                # device_put and the commit must not get its new
+                # version pinned onto old bytes)
+                src_version = src.version if src is not None else -1
+            from ..data.data import is_device_array
+            if src is None or src.payload is None \
+                    or is_device_array(src.payload):
+                continue   # nothing to pull, or source is device-side
+            nbytes = getattr(src.payload, "nbytes", 0)
+            self._reserve(nbytes)
+            obs = self._obs
+            t0 = time.monotonic_ns() if obs is not None else 0
+            buf = jax.device_put(src.payload, self.jax_device)
+            committed = False
+            old = 0
+            with data._lock:
+                if copy is None:
+                    copy = data.get_copy(self.device_index)
+                if copy is None:
+                    copy = DataCopy(data, self.device_index, payload=None,
+                                    dtt=ref.data_in.dtt)
+                    data.attach_copy(copy)
+                # commit only if a concurrent stage-in did not get there
+                # first (it owns the coherency transition; clobbering an
+                # OWNED copy or an in-use reader would corrupt state)
+                if copy.readers == 0 and copy.coherency != Coherency.OWNED \
+                        and (copy.coherency == Coherency.INVALID
+                             or copy.version < src_version):
+                    old = getattr(copy.payload, "nbytes", 0)
+                    copy.payload = buf
+                    copy.version = src_version
+                    copy.coherency = Coherency.SHARED
+                    self._prefetched[id(copy)] = src_version
+                    committed = True
+            if committed:
+                self._account(-old)
+                self._lru_touch(copy, owned=False)
+                if obs is not None:
+                    obs.xfer("in", nbytes, t0)
+                self.stats["prefetch_issued"] += 1
+                self.stats["stage_in_bytes"] += nbytes
+            else:
+                self._account(-nbytes)   # lost the race: undo the hold
+
     def drain(self, context=None) -> None:
         """Retire every remaining window entry (called at wait()-exit:
         the DAGs are complete, and the records would otherwise pin the
@@ -244,13 +530,30 @@ class JaxDevice(Device):
         until some future taskpool's progress happens to run). Async
         kernel failures in these trailing entries are RECORDED on the
         context so the caller's raise_pending_error surfaces them
-        instead of a silently-successful wait()."""
+        instead of a silently-successful wait().
+
+        Undispatched ``pending`` entries are DISCARDED: they can only
+        exist here when the DAG aborted mid-accumulation (batched
+        dispatch defers the flush), and executing them against a
+        poisoned run would be wrong — drop their load contribution and
+        let the abort path settle the taskpools."""
         if not self._manager_lock.acquire(blocking=True):
             return  # pragma: no cover - Lock.acquire(True) returns True
         try:
+            discarded = 0
+            while True:
+                item = self.pending.pop_front()
+                if item is None:
+                    break
+                self.load_sub(item[1])
+                discarded += 1
+            if discarded:
+                plog.debug.verbose(2, "tpu drain: discarded %d undispatched "
+                                   "task(s) of an aborted DAG", discarded)
             for rec in self._window:
                 self._retire(rec, context=context)
             self._window = []
+            self._prefetched.clear()
         finally:
             self._manager_lock.release()
 
@@ -262,8 +565,11 @@ class JaxDevice(Device):
         self.load_sub(rec.est)
         try:
             for a in rec.outputs:
-                if a is not None and hasattr(a, "block_until_ready"):
-                    a.block_until_ready()
+                if a is None or not hasattr(a, "block_until_ready"):
+                    continue
+                if getattr(a, "is_deleted", lambda: False)():
+                    continue  # donated to a successor batched call
+                a.block_until_ready()
         except Exception as exc:
             ctx = context if context is not None else \
                 (es.context if es is not None else None)
@@ -341,6 +647,11 @@ class JaxDevice(Device):
             return False  # in use; cycling guard keeps it resident
         import numpy as np
         data = copy.data
+        if getattr(copy.payload, "is_deleted", lambda: False)():
+            # donated to an in-flight batched call: the buffer is gone
+            # and the NEW version lands at that task's epilog — drop
+            # our accounting reference without touching the payload
+            writeback = False
         if writeback and copy.coherency == Coherency.OWNED:
             host = data.get_copy(0)
             if host is not None:
@@ -420,6 +731,7 @@ class JaxDevice(Device):
         for rec in self._window:
             self._retire(rec)  # teardown: must finalize every device
         self._window.clear()
+        self._prefetched.clear()
 
 
 def tpu_chore_hook(device_selector=None):

@@ -328,8 +328,50 @@ class DTDTaskpool(Taskpool):
                     if p.tile is not None or (p.mode & VALUE)]
             return fn(*args)
 
+        # batched-dispatch recipe (devices/batching.py): tile args are
+        # the batch axis, VALUE params are static (part of the group
+        # key, so only tasks passing EQUAL values stack together)
+        from ...devices.batching import DeviceBatchSpec
+
+        def extract(task: Task, arrays: List[Any]):
+            bargs: List[Any] = []
+            fidx: List[int] = []
+            tmpl: List[Any] = []
+            for p in task.user:
+                if p.tile is not None:
+                    if p.flow_index < 0:
+                        return None   # untracked tile: not batchable
+                    a = arrays[p.flow_index]
+                    if a is None:
+                        return None
+                    tmpl.append(None)
+                    bargs.append(a)
+                    fidx.append(p.flow_index)
+                elif p.mode & VALUE:
+                    try:
+                        hash(p.value)
+                    except TypeError:
+                        return None
+                    tmpl.append(("v", p.value))
+            return tuple(bargs), tuple(fidx), tuple(tmpl)
+
+        def call(bargs, static):
+            it = iter(bargs)
+            args = [next(it) if s is None else s[1] for s in static]
+            out = fn(*args)
+            if out is None:
+                return ()
+            return tuple(out) if isinstance(out, (tuple, list)) else (out,)
+
+        # cache_token=fn: ``call`` reassembles its args from the static
+        # key and invokes only the user kernel, so the compiled stacked
+        # callable is taskpool-independent and shared process-wide — a
+        # fresh taskpool inserting the same kernel over the same shapes
+        # dispatches without retracing
+        spec = DeviceBatchSpec(tc.name, extract, call, cache_token=fn)
         from ...devices.tpu import tpu_chore_hook
-        tc.incarnations.append(Chore(device_type, tpu_chore_hook(), dyld_fn=wrapped))
+        tc.incarnations.append(Chore(device_type, tpu_chore_hook(),
+                                     dyld_fn=wrapped, batch_spec=spec))
 
     # ------------------------------------------------------------------ #
     # insertion                                                          #

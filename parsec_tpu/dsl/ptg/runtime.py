@@ -473,16 +473,18 @@ class PTGTaskClass(TaskClass):
                 chores.append(Chore("cpu", self._cpu_hook_factory(code)))
             elif b.device_type == "tpu":
                 from ...devices.tpu import tpu_chore_hook
+                fn, spec = self._device_fn_factory(b)
                 chores.append(Chore(b.device_type, tpu_chore_hook(),
-                                    dyld_fn=self._device_fn_factory(b)))
+                                    dyld_fn=fn, batch_spec=spec))
             else:
                 # any other accelerator type routes to its attached
                 # device module (ref: per-device-type chore lists,
                 # parsec_internal.h:380-437; see devices/template.py)
                 from ...devices.template import template_chore_hook
+                fn, spec = self._device_fn_factory(b)
                 chores.append(Chore(b.device_type,
                                     template_chore_hook(b.device_type),
-                                    dyld_fn=self._device_fn_factory(b)))
+                                    dyld_fn=fn, batch_spec=spec))
         if not any(c.device_type == "cpu" for c in chores):
             # always provide a host fallback interpreting the first body
             b = bodies[0]
@@ -545,7 +547,10 @@ class PTGTaskClass(TaskClass):
 
     def _device_fn_factory(self, body: BodyAST):
         """Build the accelerator executable: flow names are device arrays;
-        assignments to written flow names are returned (in flow order)."""
+        assignments to written flow names are returned (in flow order).
+        Returns ``(fn, batch_spec)`` — the per-task wrapper plus the
+        batched-dispatch recipe (devices/batching.py), or spec=None when
+        the body reads per-task runtime state (``this_task``)."""
         code = compile(body.code, f"<jdf:{self.name}:BODY[tpu]>", "exec")
         written = [(i, f.name) for i, f in enumerate(self.ast.flows)
                    if not f.is_ctl and (self.flows[i].access & FlowAccess.WRITE)]
@@ -559,7 +564,69 @@ class PTGTaskClass(TaskClass):
             exec(code, env)
             return tuple(env[name] for i, name in written
                          if task.data[i].data_in is not None)
-        return fn
+        return fn, self._device_batch_spec(body, code, written)
+
+    def _device_batch_spec(self, body: BodyAST, code, written):
+        """Batching recipe for a JDF device body: present flow arrays
+        form the batch axis; the locals the body actually READS
+        (co_names ∩ declared locals) go into the static group key, so
+        e.g. every GEMM(k, m, n) of a wave stacks into one dispatch
+        (the body references no locals) while a body indexing on ``k``
+        still batches within equal ``k``."""
+        from ...devices.batching import DeviceBatchSpec
+        names = set(code.co_names)
+        if "this_task" in names:
+            return None   # reads per-task runtime state: never batchable
+        nonctl = [(i, f.name) for i, f in enumerate(self.ast.flows)
+                  if not f.is_ctl]
+        flow_name = dict(nonctl)
+        refd = [ld.name for ld in self.ast.locals if ld.name in names]
+
+        def extract(task: Task, arrays: List[Any]):
+            bargs: List[Any] = []
+            fidx: List[int] = []
+            absent: List[str] = []
+            for i, nm in nonctl:
+                a = arrays[i]
+                if a is None:
+                    absent.append(nm)
+                else:
+                    bargs.append(a)
+                    fidx.append(i)
+            if refd:
+                env = self.env_of(task.locals)
+                try:
+                    loc = tuple((nm, env[nm]) for nm in refd)
+                    hash(loc)
+                except (KeyError, TypeError):
+                    return None
+            else:   # body reads no locals: one group per shape signature
+                loc = ()
+            out_present = tuple(i for i, nm in written
+                                if task.data[i].data_in is not None)
+            static = (loc, tuple(absent), tuple(fidx), out_present)
+            return tuple(bargs), tuple(fidx), static
+
+        def call(bargs, static):
+            loc, absent, fidx, out_present = static
+            env = dict(self.tp.global_env)
+            env.update(loc)
+            for nm in absent:
+                env[nm] = None
+            for a, i in zip(bargs, fidx):
+                env[flow_name[i]] = a
+            env["es_rank"] = self.tp.rank
+            try:
+                import jax.numpy as jnp
+                env["jnp"] = jnp
+            except Exception:
+                pass
+            env["np"] = np
+            exec(code, env)
+            return tuple(env[nm] for i, nm in written if i in out_present)
+
+        return DeviceBatchSpec(f"{self.name}[{body.device_type}]",
+                               extract, call)
 
 
 def _detached_clone(copy: DataCopy) -> DataCopy:

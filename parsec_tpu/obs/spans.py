@@ -232,6 +232,27 @@ def register_device_gauges(sde: Any, device: Any) -> None:
         for key in stats:
             sde.register_poll(f"{prefix}::{key.upper()}",
                               lambda s=stats, k=key: s[k])
+    # batched-dispatch pipeline health (guide §9.1): mean tasks per
+    # stacked dispatch, fraction of prefetched stage-ins that the
+    # consuming task found already resident, and the mean CPU-side
+    # dispatch cost per task (batched + per-task submissions combined)
+    if isinstance(stats, dict) and "batches" in stats:
+        sde.register_poll(
+            f"{prefix}::BATCH_OCCUPANCY",
+            lambda s=stats: round(s["batched_tasks"] / s["batches"], 3)
+            if s["batches"] else 0.0)
+    if isinstance(stats, dict) and "prefetch_issued" in stats:
+        sde.register_poll(
+            f"{prefix}::PREFETCH_HIT_RATE",
+            lambda s=stats: round(s["prefetch_hits"]
+                                  / s["prefetch_issued"], 3)
+            if s["prefetch_issued"] else 0.0)
+    if isinstance(stats, dict) and "dispatch_ns" in stats:
+        sde.register_poll(
+            f"{prefix}::DISPATCH_US",
+            lambda s=stats: round(s["dispatch_ns"] / 1e3
+                                  / s["dispatch_tasks"], 3)
+            if s["dispatch_tasks"] else 0.0)
 
 
 class DeviceObs:

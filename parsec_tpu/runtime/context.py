@@ -393,6 +393,12 @@ class Context:
             plog.warning("ft: %d worker(s) still busy after 10s; "
                          "rollback may race their last task",
                          self._workers_in_loop)
+        # device pipelines BEFORE the error drain: retiring a window
+        # entry of the aborted DAG can record one more (stale) error,
+        # and the accumulated ready queues hold undispatched tasks of
+        # the dead DAG that must never execute against the restored
+        # collections
+        self._drain_devices()
         with self._tp_lock:
             errors = list(self._task_errors)
             self.taskpools.clear()
@@ -466,11 +472,19 @@ class Context:
         # DAGs are done, and leftover records would pin the final
         # tasks' object graphs (taskpool -> collections -> copies)
         # until some future taskpool's progress
+        self._drain_devices()
+        self.raise_pending_error()
+
+    def _drain_devices(self) -> None:
+        """Drain every device's pipeline: retire trailing in-flight
+        window entries (recording any async kernel error on this
+        context) and discard ready-queue entries a DAG abort left
+        undispatched (batched dispatch accumulates ready tasks between
+        manager flushes, so an abort can strand them there)."""
         for dev in self.devices:
             drain = getattr(dev, "drain", None)
             if drain is not None:
                 drain(self)
-        self.raise_pending_error()
 
     def _worker_main(self, es: ExecutionStream, widx: int) -> None:
         from .vpmap import bind_current_thread, binding_for

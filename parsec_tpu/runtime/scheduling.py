@@ -222,6 +222,7 @@ def context_wait_loop(es: ExecutionStream) -> None:
     """
     ctx = es.context
     backoff = _Backoff()
+    busy_spins = 0
     while not ctx.all_tasks_done():
         task = es.next_task
         es.next_task = None
@@ -233,11 +234,25 @@ def context_wait_loop(es: ExecutionStream) -> None:
             if task is not None:
                 backoff.hit()
                 task_progress(es, task)
+                # bounded device poll on the BUSY path: a sub-batch-max
+                # accumulation on a device must not starve behind a
+                # long run of CPU-bound tasks that never lets this
+                # worker reach the idle-cycle engine progress (an empty
+                # device queue makes this a try-lock + two list checks)
+                busy_spins += 1
+                if busy_spins & 63 == 0:
+                    for dev in ctx.devices:
+                        dev.progress(es)
                 continue
+            # engines before native loops: a claimed native loop owns
+            # this worker for a whole lowered DAG, and the device
+            # managers' accumulated ready batches / deferred prefetches
+            # must flush first so they overlap it (SURVEY.md §3.4; the
+            # batched-dispatch pipeline defers flushes to idle cycles)
+            progressed = ctx.progress_engines(es)
             if ctx.run_native_loops(es):
                 backoff.hit()
                 continue
-            progressed = ctx.progress_engines(es)
         except BaseException as exc:  # a task body blew up: abort the DAG,
             ctx.record_task_error(exc, task)  # don't silently kill the worker
             continue

@@ -57,16 +57,20 @@ class Chore:
 
     ref: __parsec_chore_t {type, evaluate, hook} parsec/parsec_internal.h:380-392
     """
-    __slots__ = ("device_type", "evaluate", "hook", "dyld_fn")
+    __slots__ = ("device_type", "evaluate", "hook", "dyld_fn", "batch_spec")
 
     def __init__(self, device_type: str,
                  hook: Callable[["ExecutionStream", "Task"], HookReturn],
                  evaluate: Optional[Callable[["Task"], bool]] = None,
-                 dyld_fn: Any = None) -> None:
+                 dyld_fn: Any = None, batch_spec: Any = None) -> None:
         self.device_type = device_type
         self.hook = hook
         self.evaluate = evaluate
         self.dyld_fn = dyld_fn  # device payload: e.g. the jax callable for tpu
+        # batched-dispatch recipe (devices/batching.DeviceBatchSpec):
+        # lets the device stack same-class ready tasks into one jitted
+        # call; None = per-task dispatch only
+        self.batch_spec = batch_spec
 
 
 class Dep:

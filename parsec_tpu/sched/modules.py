@@ -367,4 +367,5 @@ class RNDScheduler(SchedulerModule):
             return self._items.pop()
 
     def pending_tasks(self, context) -> int:
-        return len(self._items)
+        with self._lock:   # schedule/select mutate under the same lock
+            return len(self._items)

@@ -288,6 +288,26 @@ def register_core_params() -> None:
                    "max in-flight eager submissions before blocking")
     params.reg_sizet("tpu_memory_fraction_pct", 85,
                      "percent of HBM managed by the arena")
+    params.reg_int("device_batch_max", 16,
+                   "max same-class ready tasks stacked into one jitted "
+                   "device dispatch (<=1 disables batching: every task "
+                   "is its own XLA submission, the pre-batching "
+                   "behavior)")
+    params.reg_string("device_batch_mode", "unroll",
+                      "how batched tasks are stacked: unroll (one "
+                      "per-example subgraph per task inside one "
+                      "dispatch; bit-exact vs per-task) | vmap "
+                      "(stack + jax.vmap; smaller programs and "
+                      "MXU-friendly batched kernels, but batched "
+                      "algorithms may differ numerically)")
+    params.reg_int("device_prefetch_depth", 4,
+                   "stage-in (device_put) the inputs of up to this many "
+                   "queued tasks while the current batch executes "
+                   "(0 = no async prefetch)")
+    params.reg_bool("device_donate", False,
+                    "donate stale device input buffers of WRITE flows "
+                    "to the batched call (jax donate_argnums) to cut "
+                    "HBM churn; see the guide's donation caveats")
     params.reg_int("comm_max_inflight", 16, "max concurrent gets/puts in comm thread")
     params.reg_string("sde_push", "",
                       "host:port of a live counter aggregator to push SDE "

@@ -129,3 +129,270 @@ def test_load_balancing_spreads_independent_tiles(jctx):
     tp.wait()
     used = sum(1 for d in devs if d.executed_tasks > 0)
     assert used >= 2, f"all tasks landed on one device: {[d.executed_tasks for d in devs]}"
+
+
+# --------------------------------------------------------------------- #
+# batched dispatch + prefetch pipeline (ISSUE 5)                        #
+# --------------------------------------------------------------------- #
+def _burst_ctx(**over):
+    """Single-worker context with one XLA device: the submitting thread
+    accumulates the whole burst deterministically before the flush."""
+    import parsec_tpu
+    from parsec_tpu.utils.params import params
+    import contextlib
+    stack = contextlib.ExitStack()
+    stack.enter_context(params.cmdline_override("device_tpu_max", "1"))
+    for k, v in over.items():
+        stack.enter_context(params.cmdline_override(k, str(v)))
+    c = parsec_tpu.init(nb_cores=1)
+    return c, stack
+
+
+def _gemm_burst(ctx, burst, nb, seed=0):
+    """Insert a same-class burst of independent c -= a @ b.T tasks;
+    returns the c tiles (host np arrays read back after wait)."""
+    import jax
+    import jax.numpy as jnp
+    tp = dtd.taskpool_new()
+    ctx.add_taskpool(tp)
+
+    def body(es, task):
+        c, a, b = unpack_args(task)
+        c -= a @ b.T
+
+    boot = tp.tile_of_array(np.zeros((nb, nb), np.float32))
+    tp.insert_task(body, (boot, INOUT), (boot, INPUT), (boot, INPUT))
+    tp.add_chore(body, "tpu", jax.jit(
+        lambda c, a, b: c - jnp.dot(a, b.T,
+                                    preferred_element_type=jnp.float32)))
+    rng = np.random.RandomState(seed)
+    tiles = [[tp.tile_of_array(rng.rand(nb, nb).astype(np.float32))
+              for _ in range(3)] for _ in range(burst)]
+    for c, a, b in tiles:
+        tp.insert_task(body, (c, INOUT), (a, INPUT), (b, INPUT))
+    for c, a, b in tiles:
+        tp.data_flush(c)
+    tp.wait()
+    return [np.asarray(c.data.get_copy(0).payload).copy()
+            for c, _a, _b in tiles]
+
+
+def test_batched_dispatch_bit_exact_vs_per_task():
+    """A same-class burst through the stacked (unroll) batched path must
+    produce byte-identical results to per-task dispatch, and must
+    actually have batched (occupancy >= 2, multiple tasks/dispatch)."""
+    ctx, st = _burst_ctx(device_batch_max=1)
+    try:
+        ref = _gemm_burst(ctx, 24, 32)
+        devs = _jax_devices(ctx)
+        assert sum(d.stats["batches"] for d in devs) == 0
+    finally:
+        ctx.fini()
+        st.close()
+    ctx, st = _burst_ctx(device_batch_max=8, device_prefetch_depth=4)
+    try:
+        got = _gemm_burst(ctx, 24, 32)
+        devs = _jax_devices(ctx)
+        batches = sum(d.stats["batches"] for d in devs)
+        batched_tasks = sum(d.stats["batched_tasks"] for d in devs)
+        assert batches > 0, "burst never took the batched path"
+        assert batched_tasks / batches >= 2
+        assert sum(d.stats["prefetch_issued"] for d in devs) > 0
+        assert sum(d.stats["prefetch_hits"] for d in devs) > 0
+    finally:
+        ctx.fini()
+        st.close()
+    for r, g in zip(ref, got):
+        np.testing.assert_array_equal(r, g)
+
+
+def test_batched_dispatch_value_params_group_by_value():
+    """VALUE params are static: tasks passing different scalars must not
+    stack into one group (the scalar is baked into the traced call)."""
+    import jax
+    ctx, st = _burst_ctx(device_batch_max=8)
+    try:
+        tp = dtd.taskpool_new()
+        ctx.add_taskpool(tp)
+
+        def body(es, task):
+            args = unpack_args(task)
+            x, s = args[0], task.user[1].value
+            x *= s
+
+        boot = tp.tile_of_array(np.ones((4,), np.float32))
+        tp.insert_task(body, (boot, INOUT), (1.0, VALUE))
+        tp.add_chore(body, "tpu", jax.jit(lambda x, s: x * s))
+        tiles = [tp.tile_of_array(np.ones((4,), np.float32))
+                 for _ in range(8)]
+        for i, t in enumerate(tiles):
+            tp.insert_task(body, (t, INOUT), (float(i % 2 + 2), VALUE))
+        for t in tiles:
+            tp.data_flush(t)
+        tp.wait()
+        for i, t in enumerate(tiles):
+            np.testing.assert_allclose(
+                np.asarray(t.data.get_copy(0).payload),
+                np.full((4,), float(i % 2 + 2), np.float32))
+    finally:
+        ctx.fini()
+        st.close()
+
+
+def test_batched_dispatch_shape_divergent_falls_back():
+    """Same class, divergent tile shapes: every shape group dispatches
+    correctly (singletons ride the per-task path transparently)."""
+    import jax
+    import jax.numpy as jnp
+    ctx, st = _burst_ctx(device_batch_max=8)
+    try:
+        tp = dtd.taskpool_new()
+        ctx.add_taskpool(tp)
+
+        def body(es, task):
+            (x,) = unpack_args(task)
+            x += 1.0
+
+        boot = tp.tile_of_array(np.zeros((2, 2), np.float32))
+        tp.insert_task(body, (boot, INOUT))
+        tp.add_chore(body, "tpu", jax.jit(lambda x: x + jnp.float32(1.0)))
+        shapes = [(3, 3), (5, 5), (3, 3), (5, 5), (7, 7), (3, 3)]
+        tiles = [tp.tile_of_array(np.zeros(s, np.float32)) for s in shapes]
+        for t in tiles:
+            tp.insert_task(body, (t, INOUT))
+        for t in tiles:
+            tp.data_flush(t)
+        tp.wait()
+        for s, t in zip(shapes, tiles):
+            np.testing.assert_array_equal(
+                np.asarray(t.data.get_copy(0).payload),
+                np.ones(s, np.float32))
+    finally:
+        ctx.fini()
+        st.close()
+
+
+def test_untraceable_body_falls_back_per_task():
+    """A device chore that is not jax-traceable (host numpy inside) must
+    permanently downgrade to per-task dispatch, not fail the DAG."""
+    ctx, st = _burst_ctx(device_batch_max=4)
+    try:
+        tp = dtd.taskpool_new()
+        ctx.add_taskpool(tp)
+
+        def body(es, task):
+            (x,) = unpack_args(task)
+            x += 1.0
+
+        def hostile(x):
+            # np.asarray on a tracer raises: untraceable on purpose
+            return x + np.asarray(np.ones(np.asarray(x).shape,
+                                          np.float32))
+
+        boot = tp.tile_of_array(np.zeros((4,), np.float32))
+        tp.insert_task(body, (boot, INOUT))
+        tp.add_chore(body, "tpu", hostile)
+        tiles = [tp.tile_of_array(np.zeros((4,), np.float32))
+                 for _ in range(8)]
+        for t in tiles:
+            tp.insert_task(body, (t, INOUT))
+        for t in tiles:
+            tp.data_flush(t)
+        tp.wait()
+        for t in tiles:
+            np.testing.assert_array_equal(
+                np.asarray(t.data.get_copy(0).payload),
+                np.ones((4,), np.float32))
+        chore = next(c for c in tp.task_classes[0].incarnations
+                     if c.device_type == "tpu")
+        assert chore.batch_spec is not None
+        assert not chore.batch_spec.batchable   # permanently downgraded
+    finally:
+        ctx.fini()
+        st.close()
+
+
+# --------------------------------------------------------------------- #
+# TPUDevice.drain() error paths (ISSUE 5 satellite): an async kernel    #
+# failure in a trailing eager-window entry must surface via             #
+# record_task_error / raise_pending_error, not vanish                   #
+# --------------------------------------------------------------------- #
+class _FailingArray:
+    """A stub in-flight output whose readiness poll succeeds but whose
+    completion wait raises — the shape of an async XLA kernel failure."""
+
+    def is_ready(self):
+        return True
+
+    def is_deleted(self):
+        return False
+
+    def block_until_ready(self):
+        raise RuntimeError("injected async kernel failure")
+
+
+class _StubTask:
+    taskpool = None
+
+    def snprintf(self):
+        return "STUB(0)"
+
+
+def test_drain_records_async_error_on_context(jctx):
+    from parsec_tpu.devices.tpu import _InFlight
+    dev = _jax_devices(jctx)[0]
+    rec = _InFlight(_StubTask(), [_FailingArray()], [0], 1.0)
+    dev._window.append(rec)
+    load0 = dev.device_load
+    dev.drain(jctx)
+    assert dev._window == []
+    assert dev.device_load <= load0   # load contribution dropped
+    assert jctx._task_errors, "drain swallowed the async kernel failure"
+    with pytest.raises(RuntimeError, match="task body failed"):
+        jctx.raise_pending_error()
+    jctx._task_errors.clear()   # let fini() tear down cleanly
+
+
+def test_drain_without_context_logs_not_raises(jctx):
+    """Teardown drain (no context): the failure must be logged, never
+    propagated out of fini/drain."""
+    from parsec_tpu.devices.tpu import _InFlight
+    dev = _jax_devices(jctx)[0]
+    dev._window.append(_InFlight(_StubTask(), [_FailingArray()], [0], 1.0))
+    dev.drain()   # must not raise
+    assert dev._window == []
+    assert not jctx._task_errors
+
+
+def test_drain_discards_aborted_pending(jctx):
+    """Tasks stranded in the accumulation queue by a DAG abort are
+    discarded (never executed) and their load contribution dropped."""
+    dev = _jax_devices(jctx)[0]
+    dev.load_add(2.5)
+    dev.pending.push_back((_StubTask(), 2.5))
+    dev.drain(jctx)
+    assert len(dev.pending) == 0
+    assert dev.device_load == 0.0
+    assert not jctx._task_errors
+
+
+def test_window_poll_treats_donated_buffer_as_ready(jctx):
+    """A window entry whose output was donated to a successor batched
+    call (buffer deleted) must retire cleanly instead of erroring."""
+    from parsec_tpu.devices.tpu import _InFlight, _array_ready
+
+    class _Donated:
+        def is_deleted(self):
+            return True
+
+        def is_ready(self):   # pragma: no cover - must not be reached
+            raise RuntimeError("polled a deleted buffer")
+
+        def block_until_ready(self):   # pragma: no cover - ditto
+            raise RuntimeError("blocked on a deleted buffer")
+
+    assert _array_ready(_Donated())
+    dev = _jax_devices(jctx)[0]
+    dev._window.append(_InFlight(_StubTask(), [_Donated()], [0], 1.0))
+    dev.drain(jctx)
+    assert not jctx._task_errors

@@ -34,6 +34,38 @@ def test_dpotrf_matches_numpy(ctx):
     np.testing.assert_allclose(L, Lref, atol=5e-4)
 
 
+def test_dpotrf_batched_dispatch_bit_exact():
+    """The stacked (unroll-mode) batched device path must be BIT-EXACT
+    vs per-task dispatch: each task's subgraph lowers identically, one
+    dispatch or many (ISSUE 5 acceptance)."""
+    import parsec_tpu
+    from parsec_tpu.utils.params import params
+
+    M = make_spd(192)
+
+    def run(batch_max):
+        with params.cmdline_override("device_batch_max", str(batch_max)), \
+             params.cmdline_override("device_tpu_max", "1"):
+            c = parsec_tpu.init(nb_cores=2)
+            try:
+                A = TwoDimBlockCyclic(192, 192, 32, 32,
+                                      dtype=np.float32).from_numpy(M.copy())
+                tp = dpotrf_taskpool(A)
+                c.add_taskpool(tp)
+                c.wait()
+                devs = [d for d in c.devices if d.device_type == "tpu"]
+                batches = sum(d.stats["batches"] for d in devs)
+                return np.tril(A.to_numpy()), batches
+            finally:
+                c.fini()
+
+    L_single, b0 = run(1)
+    L_batched, b1 = run(16)
+    assert b0 == 0 and b1 > 0, (b0, b1)
+    np.testing.assert_array_equal(L_batched, L_single)
+    np.testing.assert_allclose(L_batched @ L_batched.T, M, atol=5e-4)
+
+
 def test_dpotrf_runs_on_device(ctx4):
     M = make_spd(128)
     A = TwoDimBlockCyclic(128, 128, 32, 32, dtype=np.float32).from_numpy(M)

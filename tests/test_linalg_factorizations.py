@@ -90,6 +90,29 @@ def test_dgetrf_nopiv_reconstructs(ctx, m, n, nb):
                                rtol=0, atol=5e-3)
 
 
+def test_dgetrf_nopiv_batched_dispatch_bit_exact():
+    """Batched (unroll) device dispatch must be bit-exact vs per-task
+    for the LU task classes too (ISSUE 5 acceptance)."""
+    import parsec_tpu
+    from parsec_tpu.utils.params import params
+
+    M = make_diag_dominant(128, 128)
+
+    def run(batch_max):
+        with params.cmdline_override("device_batch_max", str(batch_max)), \
+             params.cmdline_override("device_tpu_max", "1"):
+            c = parsec_tpu.init(nb_cores=2)
+            try:
+                A = TwoDimBlockCyclic(128, 128, 32, 32,
+                                      dtype=np.float32).from_numpy(M.copy())
+                _run(c, dgetrf_nopiv_taskpool(A))
+                return A.to_numpy()
+            finally:
+                c.fini()
+
+    np.testing.assert_array_equal(run(16), run(1))
+
+
 def test_dgetrf_nopiv_single_tile_matches_scipy(ctx):
     import scipy.linalg
     M = make_diag_dominant(40)

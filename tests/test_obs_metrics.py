@@ -205,3 +205,35 @@ def test_context_disabled_fast_path():
         assert any(n.startswith("PARSEC::DEVICE::") for n in ctx.sde.names())
     finally:
         ctx.fini()
+
+
+def test_device_pipeline_gauges_in_exposition():
+    """The batched-dispatch pipeline gauges (guide §9.1: batch
+    occupancy, prefetch hit rate, dispatch us/task) must surface in the
+    Prometheus exposition after a dpotrf run, with live values."""
+    from parsec_tpu.collections import TwoDimBlockCyclic
+    from parsec_tpu.ops import dpotrf_taskpool, make_spd
+    from parsec_tpu.utils.params import params
+
+    with params.cmdline_override("device_tpu_max", "1"):
+        ctx = parsec_tpu.Context(nb_cores=2)
+        try:
+            M = make_spd(192)
+            A = TwoDimBlockCyclic(192, 192, 32, 32,
+                                  dtype=np.float32).from_numpy(M)
+            ctx.add_taskpool(dpotrf_taskpool(A))
+            ctx.wait()
+            text = ctx.obs.render_prometheus(labels={"rank": "0"})
+        finally:
+            ctx.fini()
+    samples = parse_exposition(text)
+    rows = {n for (n, _l) in samples}
+    for want in ("batch_occupancy", "prefetch_hit_rate", "dispatch_us"):
+        assert any(n.startswith("parsec_device_") and n.endswith(want)
+                   for n in rows), (want, sorted(rows))
+    occ = [v for (n, _l), v in samples.items()
+           if n.startswith("parsec_device_") and n.endswith("batch_occupancy")]
+    assert max(occ) >= 2.0, f"dpotrf run never batched: occupancy={occ}"
+    disp = [v for (n, _l), v in samples.items()
+            if n.startswith("parsec_device_") and n.endswith("dispatch_us")]
+    assert max(disp) > 0.0

@@ -9,6 +9,14 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pltpu = pytest.importorskip("jax.experimental.pallas.tpu",
+                            reason="pallas TPU dialect not importable")
+if not hasattr(pltpu, "CompilerParams"):
+    # the kernels target the renamed pallas compiler-params API; older
+    # jax only ships TPUCompilerParams with different fields
+    pytest.skip("jax.experimental.pallas.tpu.CompilerParams not available",
+                allow_module_level=True)
+
 from parsec_tpu.ops import pallas_kernels as pk
 from parsec_tpu.parallel.ring_attention import local_attention
 

@@ -133,8 +133,13 @@ def test_wave_dpotrf_rate():
     w = ptg.wave(dpotrf_taskpool(A))
     pools = w.execute(w.build_pools())   # warm the kernel cache
     jax.block_until_ready(pools)
+    floor = float(os.environ.get("PARSEC_TEST_MIN_GFLOPS_WAVE", "3.5"))
     best = None
-    for _ in range(2):
+    # best-of-2, plus up to 2 extra attempts when still under the floor:
+    # a shared CI host mid-load-spike must not trip a regression alarm
+    # (the broken-dispatch rates this gate exists for are 5-10x lower,
+    # so a genuine regression fails all four attempts alike)
+    for attempt in range(4):
         pools = w.build_pools()
         jax.block_until_ready(pools)
         t0 = time.perf_counter()
@@ -142,6 +147,8 @@ def test_wave_dpotrf_rate():
         jax.block_until_ready(pools)
         dt = time.perf_counter() - t0
         best = dt if best is None else min(best, dt)
+        if attempt >= 1 and (n ** 3 / 3.0) / best / 1e9 >= floor:
+            break
     gflops = (n ** 3 / 3.0) / best / 1e9
     print(f"WAVE_DPOTRF n={n} nb={nb}: {gflops:.1f} gflops")
 
@@ -154,3 +161,77 @@ def test_wave_dpotrf_rate():
     assert gflops >= floor, \
         f"wave dpotrf sustained {gflops:.1f} < floor {floor} — the " \
         f"batched dispatch path has regressed"
+
+
+def test_batched_dispatch_beats_per_task():
+    """Device-module dispatch gate (ISSUE 5): for a same-class 64-task
+    burst on CPU-jax, the stacked batched path's amortized CPU-side
+    dispatch cost per task must beat per-task dispatch.
+
+    Deliberately generous (beat, not the bench's ~6x) and measured on
+    the device's own dispatch_ns counter rather than wall clock, so CI
+    load flakes cannot trip it; the bench (BENCH_MODE=dispatch) reports
+    the honest margin. Steady state: the burst runs twice per config
+    and the cheaper rep gates (first batched rep pays the one-time
+    stacked-callable compile)."""
+    import jax
+    import jax.numpy as jnp
+
+    import parsec_tpu
+    from parsec_tpu import dtd
+    from parsec_tpu.dsl.dtd import INOUT, INPUT
+    from parsec_tpu.utils.params import params
+
+    burst, nb = 64, 48
+    kern = jax.jit(lambda c, a, b:
+                   c - jnp.dot(a, b.T, preferred_element_type=jnp.float32))
+
+    def run(batch_max):
+        with params.cmdline_override("device_batch_max", str(batch_max)), \
+             params.cmdline_override("device_tpu_max", "1"):
+            ctx = parsec_tpu.init(nb_cores=1)
+            try:
+                devs = [d for d in ctx.devices
+                        if d.device_type == "tpu"]
+                assert devs, "no XLA device attached"
+                best = None
+                for rep in range(2):
+                    tp = dtd.taskpool_new()
+                    ctx.add_taskpool(tp)
+
+                    def body(es, task):
+                        c, a, b = dtd.unpack_args(task)
+                        c -= a @ b.T
+
+                    boot = tp.tile_of_array(np.zeros((nb, nb), np.float32))
+                    tp.insert_task(body, (boot, INOUT),
+                                   (boot, INPUT), (boot, INPUT))
+                    tp.add_chore(body, "tpu", kern)
+                    rng = np.random.RandomState(rep)
+                    tiles = [[tp.tile_of_array(
+                        rng.rand(nb, nb).astype(np.float32))
+                        for _ in range(3)] for _ in range(burst)]
+                    s0 = sum(d.stats["dispatch_ns"] for d in devs)
+                    c0 = sum(d.stats["dispatch_tasks"] for d in devs)
+                    for c, a, b in tiles:
+                        tp.insert_task(body, (c, INOUT),
+                                       (a, INPUT), (b, INPUT))
+                    tp.wait()
+                    dns = sum(d.stats["dispatch_ns"] for d in devs) - s0
+                    dt = sum(d.stats["dispatch_tasks"] for d in devs) - c0
+                    us = dns / 1e3 / max(1, dt)
+                    best = us if best is None else min(best, us)
+                batches = sum(d.stats["batches"] for d in devs)
+                return best, batches
+            finally:
+                ctx.fini()
+
+    pertask_us, b0 = run(1)
+    batched_us, b1 = run(16)
+    print(f"DISPATCH_GATE 64-burst nb={nb}: batched {batched_us:.1f} "
+          f"us/task vs per-task {pertask_us:.1f} us/task "
+          f"({b1} batches)")
+    assert b0 == 0 and b1 > 0, (b0, b1)
+    assert batched_us < pertask_us, \
+        f"batched dispatch {batched_us:.1f} us/task did not beat " \
+        f"per-task {pertask_us:.1f} us/task"
