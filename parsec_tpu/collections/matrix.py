@@ -79,8 +79,19 @@ class TiledMatrix(DataCollection):
                 d = data_new_with_payload(payload, device_id=0,
                                           key=(id(self), m, n))
                 d.collection = self
-                self._tiles[(m, n)] = d
+                d.mesh_coords = (m, n)   # chip placement within a rank's
+                self._tiles[(m, n)] = d  # device mesh (mesh_position_of)
             return d
+
+    def mesh_position_of(self, m: int, n: int,
+                         grid: Tuple[int, int]) -> Tuple[int, int]:
+        """Chip-grid position of tile (m, n) within the owning rank's
+        DEVICE MESH (``device_mesh_shape``; ISSUE 6): one level below
+        ``rank_of`` — ranks own tiles, chips within a rank's mesh own
+        the rank's tiles.  Generic tiled matrices spread tiles
+        round-robin over the chip grid."""
+        gp, gq = grid
+        return (m % gp, n % gq)
 
     # -- whole-matrix interop ----------------------------------------------
     def set_tile(self, m: int, n: int, values: np.ndarray) -> None:
@@ -141,6 +152,19 @@ class TwoDimBlockCyclic(TiledMatrix):
         pr = (m // self.krows) % self.P
         pc = (n // self.kcols) % self.Q
         return pr * self.Q + pc
+
+    def mesh_position_of(self, m: int, n: int,
+                         grid: Tuple[int, int]) -> Tuple[int, int]:
+        """Block-cyclic over the chip grid in LOCAL block coordinates:
+        a rank owns every P-th block row (Q-th block column), so
+        dividing by the rank grid first makes the rank's consecutive
+        local tiles land on consecutive chips — the same distribution
+        ``rank_of`` applies one level up.  The effective executor grid
+        is therefore (P*gp) x (Q*gq) without any rank seeing a foreign
+        tile."""
+        gp, gq = grid
+        return ((m // self.krows // self.P) % gp,
+                (n // self.kcols // self.Q) % gq)
 
     def vpid_of(self, m: int, n: int) -> int:
         return 0

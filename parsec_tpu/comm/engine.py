@@ -188,6 +188,15 @@ class CommEngine:
     def send_am(self, dst: int, tag: int, payload: Any) -> None:
         raise NotImplementedError
 
+    def mesh_local_with(self, peer: int) -> bool:
+        """True when ``peer`` shares this process's XLA client, so a
+        device-array payload can ship BY REFERENCE (jax arrays are
+        immutable) instead of serialize -> wire -> deserialize — the
+        mesh-local fast path remote_dep short-circuits through
+        (ISSUE 6). Cross-process transports stay False; in-process
+        fabrics override."""
+        return False
+
     # -- fault tolerance (ft/) ----------------------------------------------
     def report_peer_failure(self, peer: int, reason: str) -> None:
         """Uniform failure funnel: mark ``peer`` dead and notify the

@@ -255,6 +255,12 @@ class LocalCommEngine(CommEngine):
             obs.progress(n, t0)  # span only when work was done
         return n
 
+    def mesh_local_with(self, peer: int) -> bool:
+        """In-process SPMD ranks share one XLA client: device buffers
+        are directly addressable on every peer (the test-fabric analog
+        of two ranks whose chips sit on one mesh/slice)."""
+        return 0 <= peer < self.nb_ranks
+
     def sync(self) -> None:
         self.fabric.barrier.wait()
 

@@ -109,9 +109,17 @@ class RemoteDepEngine:
         ce.tag_register(TAG_TERMDET, self._on_termdet)
         ce.tag_register(TAG_XFER_ACK, self._on_xfer_ack)
         ce.on_get_served = self.note_get_served
+        # mesh-local fast path (ISSUE 6): device-array payloads to
+        # peers sharing this process's XLA client ship BY REFERENCE —
+        # no serialize/wire/deserialize, any size. Donation would
+        # invalidate a shipped buffer under the consumer, so the path
+        # disables itself while device_donate is on.
+        self._mesh_local = bool(params.get("comm_mesh_local")) \
+            and not bool(params.get("device_donate"))
         self.stats = {"activates_sent": 0, "activates_recv": 0,
                       "dtd_sends": 0, "dtd_recvs": 0, "forwards": 0,
-                      "mem_puts_sent": 0, "mem_puts_recv": 0}
+                      "mem_puts_sent": 0, "mem_puts_recv": 0,
+                      "mesh_local_sends": 0}
 
     # ------------------------------------------------------------------ #
     # context integration                                                #
@@ -239,7 +247,18 @@ class RemoteDepEngine:
             # agreeable to all of them — take the most conservative
             limit = min(self.short_limit_for(r) for r in ranks)
             inline = payload_arr is None or payload_arr.nbytes <= limit
-            if (plane is not None and not inline
+            if (self._mesh_local and payload_arr is not None
+                    and _is_device_array(payload_arr)
+                    and all(self.ce.mesh_local_with(r) for r in ranks)):
+                # mesh-local fast path: every participant addresses the
+                # same XLA client, so the immutable device buffer rides
+                # the activation by reference — the intra-mesh
+                # dependency costs a pointer, and any chip hop is an
+                # XLA transfer at the consumer's stage-in, not a wire
+                # round-trip through serialize/deserialize
+                msg["data"] = payload_arr
+                self.stats["mesh_local_sends"] += 1
+            elif (plane is not None and not inline
                     and _is_device_array(payload_arr)):
                 # device data plane: park the DEVICE buffer, consumers
                 # pull it device-to-device (no host pickling); one uuid
@@ -497,7 +516,14 @@ class RemoteDepEngine:
         t0 = time.monotonic_ns() if obs is not None else 0
         msg = {"tp_id": tp.comm_tp_id, "tile": tile_key, "seq": seq}
         nbytes = getattr(arr, "nbytes", 0)
-        if nbytes <= self.short_limit_for(dst):
+        mesh_local = (self._mesh_local and _is_device_array(arr)
+                      and self.ce.mesh_local_with(dst))
+        if mesh_local:
+            # mesh-local fast path: the immutable device buffer ships
+            # by reference, any size (see activate_batch)
+            msg["data"] = arr
+            self.stats["mesh_local_sends"] += 1
+        elif nbytes <= self.short_limit_for(dst):
             msg["data"] = arr
         else:
             # snapshot mutable host buffers (a later local task may write

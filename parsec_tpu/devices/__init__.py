@@ -34,6 +34,13 @@ def build_devices(context, enable_tpu: bool = True) -> List[Device]:
         cap = params.get("device_tpu_max")
         if cap >= 0:
             jdevs = jdevs[:cap]
+        mesh_dev = _maybe_mesh_device(context, jdevs)
+        if mesh_dev is not None:
+            devices.append(mesh_dev)
+            plog.device_stream.verbose(
+                3, "attached mesh device %s over %d chip(s)",
+                mesh_dev.name, len(mesh_dev.chips))
+            return devices
         from .tpu import JaxDevice
         for i, jd in enumerate(jdevs):
             devices.append(JaxDevice(1 + i, jd))
@@ -43,7 +50,48 @@ def build_devices(context, enable_tpu: bool = True) -> List[Device]:
     return devices
 
 
+def _maybe_mesh_device(context, jdevs):
+    """Build the rank's chip-mesh device when ``device_mesh_shape``
+    asks for one (ISSUE 6): this rank takes a contiguous slice of the
+    local chips offset by rank*chips (in-process SPMD ranks carve
+    disjoint sub-meshes of the virtual device pool; a multi-process
+    deployment owns its local chips outright). Falls back — with a
+    warning, never an error — to one device per chip when the jax
+    build lacks shard_map or too few chips exist."""
+    shape = params.get("device_mesh_shape")
+    if not shape or not jdevs:
+        return None
+    from .tpu import JaxMeshDevice, parse_mesh_shape
+    gp, gq = parse_mesh_shape(shape)
+    need = gp * gq
+    if need <= 1:
+        return None
+    from ..parallel.mesh import has_shard_map
+    if not has_shard_map():
+        plog.warning("device_mesh_shape=%s ignored: this jax build has "
+                     "no shard_map; attaching one device per chip",
+                     shape)
+        return None
+    if len(jdevs) < need:
+        plog.warning("device_mesh_shape=%s needs %d chips, have %d; "
+                     "attaching one device per chip", shape, need,
+                     len(jdevs))
+        return None
+    rank = int(getattr(context, "rank", 0) or 0)
+    off = (rank * need) % len(jdevs)
+    chips = (list(jdevs) * 2)[off:off + need]   # wraps, stays distinct
+    return JaxMeshDevice(1, chips, (gp, gq))
+
+
 from .template import TemplateDevice, template_chore_hook  # noqa: E402
 
 __all__ = ["Device", "CPUDevice", "build_devices", "get_best_device",
-           "TemplateDevice", "template_chore_hook"]
+           "TemplateDevice", "template_chore_hook", "JaxMeshDevice"]
+
+
+def __getattr__(name):
+    # lazy: importing the package must not import jax-heavy tpu.py
+    if name == "JaxMeshDevice":
+        from .tpu import JaxMeshDevice
+        return JaxMeshDevice
+    raise AttributeError(name)

@@ -35,13 +35,24 @@ stays small; the cache lives ON the spec (so it dies with its taskpool)
 keyed by (bucket, static, shapes/dtypes, donate mask, mode) — or in the
 process-wide per-token cache for specs declaring taskpool independence
 (``cache_token``).
+
+Mesh-sharded stacking (ISSUE 6): when the rank's device is a chip MESH
+(``device_mesh_shape``), a flush group whose size divides the chip
+count compiles through ``shard_map`` over the mesh instead — the
+stacked batch axis is sharded across the chips, each chip runs its
+local slice of per-example subgraphs, and ONE jitted call executes the
+whole group spread over the mesh (the distribute-then-collect shape of
+arxiv 2112.09017).  Inputs arrive as one global array per batch arg
+(assembled chip-locally by the device module), so intra-mesh data
+movement is XLA's job, not the wire's.
 """
 from __future__ import annotations
 
 from typing import Any, Callable, Dict, Optional, Tuple
 
 __all__ = ["DeviceBatchSpec", "bucket_size", "stacked_callable_key",
-           "build_stacked_callable", "cached_stacked_callable"]
+           "build_stacked_callable", "cached_stacked_callable",
+           "build_sharded_callable", "cached_sharded_callable"]
 
 
 class DeviceBatchSpec:
@@ -75,7 +86,7 @@ class DeviceBatchSpec:
     """
 
     __slots__ = ("name", "extract", "call", "batchable", "cache",
-                 "cache_token")
+                 "cache_token", "mesh_ok")
 
     def __init__(self, name: str,
                  extract: Callable[[Any, Any], Optional[Tuple]],
@@ -87,6 +98,9 @@ class DeviceBatchSpec:
         self.batchable = True   # cleared on first trace failure
         self.cache: Dict[Any, Any] = {}   # stacked-callable AOT cache
         self.cache_token = cache_token
+        # cleared when the mesh-sharded stacking of THIS class fails to
+        # trace/dispatch (the single-chip stacked path stays available)
+        self.mesh_ok = True
 
 
 def bucket_size(navail: int, batch_max: int) -> int:
@@ -165,3 +179,94 @@ def build_stacked_callable(spec: DeviceBatchSpec, n: int, nargs: int,
     donate_argnums = tuple(j * n + i for j, d in enumerate(donate) if d
                            for i in range(n))
     return jax.jit(stacked, donate_argnums=donate_argnums)
+
+
+def cached_sharded_callable(spec: DeviceBatchSpec, n: int, nargs: int,
+                            static: Any, shapes: Tuple, mode: str,
+                            mesh: Any) -> Callable:
+    """The AOT-cached mesh-sharded stacked callable for this signature.
+    The Mesh OBJECT joins the key (jax meshes hash by devices + axis
+    names): the key holds a strong reference, so a recycled id can
+    never alias a dead mesh's entry, a different mesh (another rank's
+    device in the same process) compiles its own entry, and a fresh
+    context rebuilding the SAME mesh over the same chips hits the
+    token-cached callable."""
+    key = ("mesh", mesh, n, nargs, static, shapes, mode)
+    cache = (_shared_cache.setdefault(spec.cache_token, {})
+             if spec.cache_token is not None else spec.cache)
+    fn = cache.get(key)
+    if fn is None:
+        fn = build_sharded_callable(spec, n, nargs, static, shapes,
+                                    mode, mesh)
+        cache[key] = fn
+    return fn
+
+
+def build_sharded_callable(spec: DeviceBatchSpec, n: int, nargs: int,
+                           static: Any, shapes: Tuple, mode: str,
+                           mesh: Any) -> Callable:
+    """One jitted shard_map call executing ``n`` same-signature tasks
+    SPREAD ACROSS the chip mesh.
+
+    Calling convention: one GLOBAL array per batch arg, shape
+    ``(n,) + row_shape``, sharded over every mesh axis on the leading
+    (batch) dim — chip ``c`` holds rows ``[c*n/k, (c+1)*n/k)``.  Each
+    chip's shard_map body runs its local rows; ``unroll`` mode emits
+    one per-example subgraph per local row (bit-exact vs the
+    single-chip stacked path: the SAME per-example graph lowers on one
+    chip either way), ``vmap`` vmaps the body over the local block.
+    Outputs come back as global arrays with the same leading-axis
+    sharding; the device module slices per-task rows from the
+    addressable shards so results stay chip-resident.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from ..parallel.mesh import shard_map_fwd
+
+    call = spec.call
+    k = int(mesh.devices.size)
+    assert n % k == 0, (n, k)
+    n_local = n // k
+    axes = tuple(mesh.axis_names)
+    batch_spec = PartitionSpec(axes)   # leading dim over ALL mesh axes
+    # output arity from an abstract trace of one example (shapes are
+    # the group key, so this is exact for every task in the group)
+    row_avals = tuple(jax.ShapeDtypeStruct(s, d) for (s, d) in shapes)
+    out_avals = jax.eval_shape(lambda *r: call(r, static), *row_avals)
+    n_out = len(out_avals)
+
+    if mode == "vmap":
+        def local_fn(*blocks):
+            return jax.vmap(lambda *b: call(b, static))(*blocks)
+    else:   # unroll: per-example subgraphs per local row, bit-exact
+        def local_fn(*blocks):
+            rows = [call(tuple(b[i] for b in blocks), static)
+                    for i in range(n_local)]
+            return tuple(jnp.stack([rows[i][o] for i in range(n_local)])
+                         for o in range(n_out))
+
+    sharded = shard_map_fwd(local_fn, mesh,
+                            in_specs=(batch_spec,) * nargs,
+                            out_specs=(batch_spec,) * n_out)
+    in_sh = NamedSharding(mesh, batch_spec)
+    fn = jax.jit(sharded, in_shardings=(in_sh,) * nargs,
+                 out_shardings=(in_sh,) * n_out)
+    return _ShardedCallable(fn, n_out, in_sh)
+
+
+class _ShardedCallable:
+    """A jitted shard_map dispatch plus the metadata the device module
+    needs to assemble inputs / slice outputs (jit objects reject
+    attribute assignment, hence the wrapper)."""
+
+    __slots__ = ("fn", "n_out", "sharding")
+
+    def __init__(self, fn: Callable, n_out: int, sharding: Any) -> None:
+        self.fn = fn
+        self.n_out = n_out
+        self.sharding = sharding
+
+    def __call__(self, *args):
+        return self.fn(*args)

@@ -239,6 +239,7 @@ class DTDTaskpool(Taskpool):
         # enqueue time, so DTD pools compose (parsec_compose chains enqueue
         # parts later) and nest (recursive_call) naturally
         self._pending_inserts: List[tuple] = []
+        self._mesh_hint_iter = 0   # insertion-order chip placement hint
         self.on_enqueue = self._replay_pending_inserts
 
     def _replay_pending_inserts(self, tp) -> None:
@@ -285,8 +286,14 @@ class DTDTaskpool(Taskpool):
         return tile
 
     def tile_of_array(self, arr: Any, key: Any = None) -> DTDTile:
-        """Wrap a host array as a tracked tile."""
+        """Wrap a host array as a tracked tile.  Keyless tiles get a
+        deterministic insertion-order ``mesh_hint`` so a chip-mesh
+        device (``device_mesh_shape``) round-robins them across its
+        chips in the same order on every run — SPMD-stable placement
+        without a collection's coordinate map."""
         data = data_new_with_payload(arr, device_id=0, key=key)
+        data.mesh_hint = self._mesh_hint_iter
+        self._mesh_hint_iter += 1
         return self.tile_of_data(data)
 
     def tile_new(self, shape: Tuple[int, ...], dtype=np.float32,

@@ -412,11 +412,18 @@ class PTGTaskClass(TaskClass):
             remote_edges.setdefault(dst, []).append(
                 (succ_tc.task_class_id, succ_locals, flow_name, out_idx))
             if out_idx not in flow_payloads and copy is not None:
-                plane = getattr(getattr(self.tp.comm, "ce", None),
-                                "device_plane", None)
+                ce = getattr(self.tp.comm, "ce", None)
+                plane = getattr(ce, "device_plane", None)
+                # mesh-local peers (one XLA client) take device buffers
+                # by reference — offering the device copy here is what
+                # lets remote_dep's fast path skip the D2H sync below
+                mesh_local = (getattr(self.tp.comm, "_mesh_local", False)
+                              and ce is not None
+                              and ce.mesh_local_with(dst))
                 newest = (copy.data.newest_copy()
                           if copy.data is not None else copy)
-                if plane is not None and newest is not None \
+                if (plane is not None or mesh_local) \
+                        and newest is not None \
                         and newest.payload is not None \
                         and _is_dev_arr(newest.payload):
                     # device data plane attached and the newest version

@@ -51,7 +51,7 @@ from ...data.datatype import Datatype
 from ...utils import logging as plog
 from .wave import WaveError, WaveRunner
 
-__all__ = ["TAG_WAVE", "DistWaveRunner"]
+__all__ = ["TAG_WAVE", "DistWaveRunner", "rank_mesh_sharding"]
 
 TAG_WAVE = TAG_USER_BASE - 4
 TAG_WAVE_CFG = TAG_USER_BASE - 5
@@ -185,6 +185,73 @@ def _lane_local_devices(nb_ranks: int):
     return devs
 
 
+def _rank_mesh_geometry():
+    """(gp, gq) of the per-rank chip mesh from ``device_mesh_shape``,
+    or None when no mesh is configured. A pure function of params, so
+    every SPMD rank derives the same geometry."""
+    from ...utils.params import params
+    try:
+        from ...devices.tpu import parse_mesh_shape
+        gp, gq = parse_mesh_shape(
+            params.get_or("device_mesh_shape", "string", "") or "")
+    except (ValueError, TypeError):
+        return None
+    return (gp, gq) if gp * gq > 1 else None
+
+
+def _lane_device_pool(nb_ranks: int):
+    """rank -> lane device. Without rank meshes: the first nb_ranks
+    local devices (the pre-mesh layout). With ``device_mesh_shape``
+    set, rank r's ranks own disjoint chip slices (devices/__init__
+    carves them at rank*chips), so the lane REUSES each rank's mesh —
+    its lane device is chip 0 of that rank's slice — instead of
+    parking every rank's collective on chips that all belong to rank
+    0's mesh (ISSUE 6 satellite: no ad-hoc foreign-chip meshes)."""
+    devs = _lane_local_devices(nb_ranks)
+    geom = _rank_mesh_geometry()
+    if geom is not None:
+        k = geom[0] * geom[1]
+        # rank slices must be DISJOINT or the lane mesh would repeat a
+        # device (jax Mesh rejects duplicates): with fewer devices than
+        # ranks*chips the mesh carving wrapped, so the lane keeps the
+        # pre-mesh one-device-per-rank layout
+        if len(devs) >= nb_ranks * k:
+            return [devs[r * k] for r in range(nb_ranks)]
+    return devs[:nb_ranks]
+
+
+def rank_mesh_sharding(rank: int, shape: Optional[str] = None,
+                       devices: Optional[List] = None):
+    """NamedSharding spreading a rank's sliced tile pools over its OWN
+    chip sub-mesh (built on parallel.mesh.make_mesh): tile dims shard
+    over the ("tp", "sp") mesh axes, the leading tile-index dim stays
+    replicated. The chip slice matches the device layer's carving
+    (rank*chips offset), so wave pools and the classic runtime's mesh
+    device agree on which chips a rank owns. Returns None when no mesh
+    is configured — callers fall back to single-device placement."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from ...parallel.mesh import make_mesh
+    if shape is not None:
+        from ...devices.tpu import parse_mesh_shape
+        gp, gq = parse_mesh_shape(shape)
+        geom = (gp, gq) if gp * gq > 1 else None
+    else:
+        geom = _rank_mesh_geometry()
+    if geom is None:
+        return None
+    gp, gq = geom
+    k = gp * gq
+    devs = list(devices) if devices is not None \
+        else list(_lane_local_devices(k))
+    if len(devs) < k:
+        return None
+    off = (rank * k) % len(devs)
+    chips = (devs * 2)[off:off + k]
+    mesh = make_mesh(sizes={"tp": gp, "sp": gq}, devices=chips)
+    return NamedSharding(mesh, PartitionSpec(None, "tp", "sp"))
+
+
 class _CollectiveLane:
     """ONE compiled XLA collective per broadcast group instead of P
     descriptor sends (SURVEY §5.8's TPU-native target; the reference's
@@ -215,7 +282,7 @@ class _CollectiveLane:
 
     def __init__(self, mode: str, nb_ranks: int, rank: int,
                  rendezvous=None, timeout: float = 120.0,
-                 dead_fn=None) -> None:
+                 dead_fn=None, devices=None) -> None:
         import jax
 
         self.mode = mode
@@ -233,7 +300,12 @@ class _CollectiveLane:
             devs = [by_proc[p] for p in sorted(by_proc)]
             self.device = by_proc[jax.process_index()]
         else:
-            devs = _lane_local_devices(nb_ranks)[:nb_ranks]
+            # ``devices`` (rank -> lane device) reuses each rank's OWN
+            # chip mesh when device_mesh_shape carves one per rank —
+            # sub-mesh all-reduces then run over chips the member ranks
+            # actually own (_lane_device_pool), not ad-hoc ones
+            devs = (list(devices) if devices is not None
+                    else _lane_local_devices(nb_ranks))[:nb_ranks]
             self.device = devs[rank]
         self.devs = devs                     # rank -> lane device
         self._rdv = rendezvous   # shared dict+condvar for in-process
@@ -455,7 +527,8 @@ class DistWaveRunner(WaveRunner):
                     "inproc", self.nb_ranks, self.rank, rendezvous=rdv,
                     timeout=self.comm_timeout,
                     dead_fn=lambda ce=self.ce: getattr(
-                        ce, "dead_peers", ()))
+                        ce, "dead_peers", ()),
+                    devices=_lane_device_pool(self.nb_ranks))
         except Exception:
             if mode == "on":
                 raise

@@ -227,6 +227,12 @@ def register_device_gauges(sde: Any, device: Any) -> None:
     if hasattr(device, "mem_highwater"):
         sde.register_poll(f"{prefix}::MEM_HIGHWATER",
                           lambda d=device: d.mem_highwater)
+    if hasattr(device, "mesh_shards"):
+        # chips in the device's mesh (device_mesh_shape; ISSUE 6) —
+        # COLLECTIVE_BYTES / MESH_DISPATCHES / MESH_MOVES ride the
+        # stats loop below
+        sde.register_poll(f"{prefix}::MESH_SHARDS",
+                          lambda d=device: d.mesh_shards)
     stats = getattr(device, "stats", None)
     if isinstance(stats, dict):
         for key in stats:

@@ -138,6 +138,39 @@ def vary_on(x, axes, like=None):
     return _pcast_varying(x, want) if want else x
 
 
+def shard_map_fwd(f, mesh, in_specs, out_specs):
+    """Forward-only shard_map for DISPATCH (no autodiff through it):
+    prefers the VMA-tracking ``jax.shard_map``, falls back to the
+    ``jax.experimental`` spelling on older builds.
+
+    The fallback is correct here precisely because nothing
+    differentiates through a device dispatch — the two spellings only
+    diverge in how psum transposes under grad (see
+    :func:`shard_map_compat`, which therefore never falls back).
+    Raises when neither spelling exists; callers treat that as
+    "no mesh" and stay on the single-chip path."""
+    import jax
+    if hasattr(jax, "shard_map"):
+        return shard_map_compat(f, mesh, in_specs, out_specs)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
+
+
+def has_shard_map() -> bool:
+    """True when SOME shard_map spelling exists (the gate for
+    forward-only mesh dispatch; gradient-correct code must instead
+    check ``hasattr(jax, "shard_map")`` — see shard_map_compat)."""
+    import jax
+    if hasattr(jax, "shard_map"):
+        return True
+    try:
+        from jax.experimental.shard_map import shard_map  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
 def shard_map_compat(f, mesh, in_specs, out_specs):
     """jax.shard_map with VMA (varying-manual-axes) tracking ON.
 

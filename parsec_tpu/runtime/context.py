@@ -189,6 +189,14 @@ class Context:
         # devices (ref: parsec_mca_device_init/attach parsec.c:832-837)
         from ..devices import build_devices
         self.devices = build_devices(self, enable_tpu=enable_tpu)
+        # mesh ownership (ISSUE 6): when this rank's accelerator is a
+        # chip MESH (device_mesh_shape), expose it so mesh-aware layers
+        # — the wave collective lane's sub-mesh all-reduces, pool
+        # sharding, bench — reuse the rank's mesh instead of building
+        # ad-hoc ones; drained with the device pipeline at wait() exit
+        self.device_mesh = next(
+            (d.mesh for d in self.devices
+             if getattr(d, "mesh", None) is not None), None)
 
         # scheduler (ref: parsec_set_scheduler scheduling.c:246-272)
         from ..sched import sched_new

@@ -300,6 +300,23 @@ def register_core_params() -> None:
                       "(stack + jax.vmap; smaller programs and "
                       "MXU-friendly batched kernels, but batched "
                       "algorithms may differ numerically)")
+    params.reg_string("device_mesh_shape", "",
+                      "attach this rank's XLA chips as ONE mesh device "
+                      "(\"PxQ\" grid or a chip count, e.g. \"2x2\" or "
+                      "\"4\"): tiles are placed block-cyclically across "
+                      "the chips and batched dispatch compiles through "
+                      "shard_map so one jitted call executes a batch "
+                      "spread over the mesh; intra-mesh dependencies "
+                      "ride XLA transfers/collectives instead of the "
+                      "wire. Empty = one device per chip (the "
+                      "pre-mesh behavior); falls back per-chip when "
+                      "the jax build lacks shard_map or too few chips "
+                      "exist")
+    params.reg_bool("comm_mesh_local", True,
+                    "ship device-array payloads by reference (no "
+                    "serialize/deserialize) to peers that share this "
+                    "process's XLA client — the mesh-local fast path; "
+                    "off forces every payload through host bytes")
     params.reg_int("device_prefetch_depth", 4,
                    "stage-in (device_put) the inputs of up to this many "
                    "queued tasks while the current batch executes "

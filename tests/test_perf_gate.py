@@ -110,17 +110,42 @@ def test_captured_dpotrf_rate():
             f"captured dpotrf sustained {gflops:.1f} < floor {floor}"
 
 
+def _calibrate_gemm_gflops(reps: int = 3) -> float:
+    """The host's CURRENT f32 GEMM rate through one jitted matmul —
+    the same XLA/CPU substrate the wave kernels run on, measured in
+    the same process at the same moment, so suite load discounts the
+    wave floor exactly as much as it discounts the wave itself."""
+    import jax
+    import jax.numpy as jnp
+
+    k = 1024
+    f = jax.jit(lambda a, b: a @ b)
+    a = jnp.asarray(np.random.RandomState(0).rand(k, k)
+                    .astype(np.float32))
+    jax.block_until_ready(f(a, a))   # compile outside the clock
+    best = None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(a, a))
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    return 2.0 * k ** 3 / best / 1e9
+
+
 def test_wave_dpotrf_rate():
     """Wave-execution rate gate at the north-star NB=512 (round-2
     VERDICT item 6: the path carrying the perf story had no regression
     alarm — a silent fall-back to per-task dispatch rates must FAIL).
 
-    Unlike the other gates this one is ON by default with a
-    conservative CPU floor: the 1-core CI host sustains ~35-48 GFLOP/s
-    here, per-task dispatch manages ~2, and broken batching ~0.5, so a
-    3.5 floor stays quiet across load flakes while any dispatch-path
-    breakage trips it. Chip runners raise the floor via
-    PARSEC_TEST_MIN_GFLOPS_WAVE (e.g. "5000")."""
+    The floor is LOAD-NORMALIZED (ISSUE 6 satellite, replacing the
+    PR-5 retry band-aid): a bare jitted GEMM calibrates the host's
+    current f32 rate before and after the wave measurement, and the
+    wave must sustain >= 5% of the slower calibration (healthy runs
+    measure ~20%+; a broken dispatch path manages ~1-3%). Parallel
+    test pressure slows the calibration GEMM and the wave kernels
+    alike, so the ratio holds where a fixed 3.5-GFLOP floor tripped
+    at 3.1 under suite load. An absolute PARSEC_TEST_MIN_GFLOPS_WAVE
+    (e.g. "5000" on a chip runner) overrides the ratio gate."""
     import jax
 
     from parsec_tpu.collections import TwoDimBlockCyclic
@@ -133,13 +158,9 @@ def test_wave_dpotrf_rate():
     w = ptg.wave(dpotrf_taskpool(A))
     pools = w.execute(w.build_pools())   # warm the kernel cache
     jax.block_until_ready(pools)
-    floor = float(os.environ.get("PARSEC_TEST_MIN_GFLOPS_WAVE", "3.5"))
+    calib_pre = _calibrate_gemm_gflops()
     best = None
-    # best-of-2, plus up to 2 extra attempts when still under the floor:
-    # a shared CI host mid-load-spike must not trip a regression alarm
-    # (the broken-dispatch rates this gate exists for are 5-10x lower,
-    # so a genuine regression fails all four attempts alike)
-    for attempt in range(4):
+    for _ in range(2):                   # best-of-2: GC/compaction blips
         pools = w.build_pools()
         jax.block_until_ready(pools)
         t0 = time.perf_counter()
@@ -147,20 +168,32 @@ def test_wave_dpotrf_rate():
         jax.block_until_ready(pools)
         dt = time.perf_counter() - t0
         best = dt if best is None else min(best, dt)
-        if attempt >= 1 and (n ** 3 / 3.0) / best / 1e9 >= floor:
-            break
+    calib_post = _calibrate_gemm_gflops()
+    calib = min(calib_pre, calib_post)
     gflops = (n ** 3 / 3.0) / best / 1e9
-    print(f"WAVE_DPOTRF n={n} nb={nb}: {gflops:.1f} gflops")
+    print(f"WAVE_DPOTRF n={n} nb={nb}: {gflops:.1f} gflops "
+          f"(host gemm calibration {calib:.1f})")
 
     w.scatter_pools(pools)
     L = np.tril(A.to_numpy()).astype(np.float64)
     ref = make_spd(n).astype(np.float64)
     assert np.linalg.norm(L @ L.T - ref) / np.linalg.norm(ref) < 1e-5
 
-    floor = float(os.environ.get("PARSEC_TEST_MIN_GFLOPS_WAVE", "3.5"))
+    env_floor = os.environ.get("PARSEC_TEST_MIN_GFLOPS_WAVE")
+    if env_floor:
+        assert gflops >= float(env_floor), \
+            f"wave dpotrf sustained {gflops:.1f} < declared floor " \
+            f"{env_floor} — the batched dispatch path has regressed"
+        return
+    # the ratio can only LOWER the bar under load — 3.5 (the historical
+    # absolute floor, ~10x above broken-dispatch rates on an idle CI
+    # host) caps it so a fast host never raises its own bar
+    floor = min(3.5, 0.05 * calib)
     assert gflops >= floor, \
-        f"wave dpotrf sustained {gflops:.1f} < floor {floor} — the " \
-        f"batched dispatch path has regressed"
+        f"wave dpotrf sustained {gflops:.1f} GFLOP/s < {floor:.1f} " \
+        f"(5% of the host's concurrent {calib:.1f}-GFLOP/s GEMM " \
+        f"calibration, capped at 3.5) — the batched dispatch path " \
+        f"has regressed"
 
 
 def test_batched_dispatch_beats_per_task():

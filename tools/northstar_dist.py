@@ -17,6 +17,11 @@ Usage: python tools/northstar_dist.py [NT [nb [ranks]]]
 Env:   NORTHSTAR_SHARDING=hybrid  -> each rank's pools shard over its
        own sub-mesh of the virtual devices (process x mesh GSPMD);
        needs ranks * submesh <= device count.
+       NORTHSTAR_SHARDING=mesh    -> same layout through the ISSUE-6
+       mesh machinery: rank_mesh_sharding carves each rank's chip
+       sub-mesh (NORTHSTAR_MESH_SHAPE, default 2x2) with the same
+       offsets the device layer uses, so intra-mesh dependencies ride
+       XLA sharding instead of the exchange.
        NORTHSTAR_BCAST=binomial|chain|star (default binomial).
        NORTHSTAR_COLLECTIVE=on -> broadcast groups (full AND
        partial member sets — any P x Q grid) ride the compiled
@@ -127,7 +132,14 @@ def main() -> int:
         w = ptg.wave(tp, comm=ce)
         t_plan = time.perf_counter() - t0
         cpus = jax.devices("cpu")
-        if sharding == "hybrid":
+        if sharding == "mesh":
+            from parsec_tpu.dsl.ptg.wave_dist import rank_mesh_sharding
+            sh = rank_mesh_sharding(
+                r, shape=os.environ.get("NORTHSTAR_MESH_SHAPE", "2x2"),
+                devices=cpus)
+            assert sh is not None, "mesh sharding needs a PxQ > 1 shape"
+            pools = w.build_pools(sharding=sh)
+        elif sharding == "hybrid":
             from jax.sharding import (Mesh, NamedSharding,
                                       PartitionSpec as Psp)
             sub = len(cpus) // ranks
@@ -167,7 +179,7 @@ def main() -> int:
     stats = [st for (_tp, _te, st, _o) in results]
     report = {
         "metric": f"northstar_dist_dpotrf(NT={nt},nb={nb},ranks={ranks}"
-                  + (",hybrid" if sharding == "hybrid" else "") + ")",
+                  + (f",{sharding}" if sharding else "") + ")",
         "tasks": dag.n_tasks,
         "waves": stats[0]["waves"],
         "residual": resid,
