@@ -33,6 +33,11 @@ Execution modes (BENCH_MODE):
   jitted-call pipeline, device_batch_max) vs per-task; reports
   amortized CPU-side dispatch µs/task, wall µs/task, batch occupancy
   and the prefetch hit rate (stage-in overlapped with execution).
+- ``overlap``: 2-rank classic-runtime dpotrf on a throttled link
+  (injected per-frame delay), overlap pipeline ON (segmented flush +
+  remote-GET prefetch + critical-path priorities) vs OFF — reports
+  each leg's wall, the live OVERLAP_FRACTION gauge, and bit-exactness
+  across legs.
 
 Knobs (env): BENCH_N (default 8192), BENCH_NB (2048), BENCH_DTYPE
 (float32), BENCH_REPS (3, best-of), BENCH_CORES (runtime mode worker
@@ -682,6 +687,13 @@ def bench_all(n, nb, reps, cores, dtype):
         ms = _try("mesh", lambda: bench_mesh(reps=2))
         if ms is not None:
             extras.update(ms)
+    # throttled-link overlap on/off comparison (ISSUE 7): scrubbed CPU
+    # subprocess, link-independent — the segmented-flush / GET-prefetch
+    # overlap story rides every record
+    if os.environ.get("BENCH_OVERLAP", "1") != "0":
+        ov = _try("overlap", lambda: bench_overlap())
+        if ov is not None:
+            extras.update(ov)
     if not candidates:
         print(json.dumps({"metric": "dpotrf_gflops", "value": 0.0,
                           "unit": "GFLOP/s", "vs_baseline": 0.0,
@@ -1239,6 +1251,185 @@ def bench_dispatch(burst=64, nb=96, reps=3) -> dict:
     return out
 
 
+def bench_overlap_inner(n=768, nb=64, ranks=2, delay_ms=8, cores=1,
+                        reps=2) -> dict:
+    """Overlap-aware execution on a THROTTLED link (ISSUE 7): the same
+    classic-runtime dpotrf with the overlap pipeline ON (segmented
+    flush + remote-GET prefetch + critical-path priorities, the
+    defaults) vs OFF (whole-batch flush, no prefetch, static
+    priorities — the pre-overlap behavior) — on a link where every
+    frame pays an injected ``delay_ms`` sleep (ft/inject.py's delay op
+    standing in for the 5.9 MB/s tunnel).
+
+    Each leg runs TWO stages: a plain dpotrf, then a second dpotrf
+    whose registration rank 1 holds until rank 0's first activation
+    races ahead of it — the real multi-pool pipeline window where the
+    remote-GET prefetch engages (the payload fetch overlaps the hold
+    instead of serializing behind counts_ready).  Each leg runs
+    ``reps`` times; the reported overlap fraction POOLS the live
+    tracker's interval totals (sum overlap_us / sum comm_us over all
+    ranks and reps — one noisy rank/rep cannot flip the sign) and the
+    wall is best-of-reps.  Also reports the segment/prefetch counters
+    and whether the factors are bit-exact across legs (unroll
+    segmentation must be)."""
+    import parsec_tpu
+    from parsec_tpu.collections import TwoDimBlockCyclic
+    from parsec_tpu.comm import LocalFabric, RemoteDepEngine
+    from parsec_tpu.ops import dpotrf_taskpool, make_spd
+    from parsec_tpu.utils.params import params as _params
+    from parsec_tpu.utils.spmd import spmd_threads
+
+    M = make_spd(n, dtype=np.float32)
+
+    def run_once(on):
+        from contextlib import ExitStack
+        overrides = {
+            "metrics": "1",
+            "comm_mesh_local": "0",   # payloads must ride the (slow) wire
+            "ft_inject": f"delay:pct=100:ms={delay_ms}",
+            "device_flush_segments": "4" if on else "1",
+            "comm_prefetch_inflight": "8" if on else "0",
+            "sched_dynamic_priority": "1" if on else "0",
+        }
+        with ExitStack() as st:
+            for k, v in overrides.items():
+                st.enter_context(_params.cmdline_override(k, v))
+            fabric = LocalFabric(ranks)
+
+            def rank_fn(r, fab):
+                eng = RemoteDepEngine(fab.engine(r))
+                ctx = parsec_tpu.Context(nb_cores=cores, comm=eng)
+                try:
+                    t0 = time.perf_counter()
+                    colls = []
+                    for stage in range(2):
+                        coll = TwoDimBlockCyclic(
+                            n, n, nb, nb, dtype=np.float32,
+                            P=ranks, Q=1, nodes=ranks, rank=r)
+                        coll.name = f"descA{stage}"
+                        coll.from_numpy(M.copy())
+                        colls.append(coll)
+                        tp = dpotrf_taskpool(coll, rank=r, nb_ranks=ranks)
+                        if stage == 1 and r == 1:
+                            # hold stage-2 registration until rank 0's
+                            # activation races ahead of it (bounded):
+                            # the GET-prefetch window of a multi-pool
+                            # pipeline, identical in both legs — only
+                            # whether the payload fetch overlaps the
+                            # hold differs
+                            deadline = time.time() + 10
+                            while time.time() < deadline \
+                                    and not eng._early_activations:
+                                eng.ce.progress()
+                                time.sleep(0.0005)
+                        ctx.add_taskpool(tp)
+                        ctx.wait()
+                    wall = time.perf_counter() - t0
+                    snap = ctx.obs.overlap.snapshot()
+                    segs = sum(getattr(d, "stats", {}).get(
+                        "flush_segments", 0) for d in ctx.devices)
+                    comm_stats = dict(eng.stats)
+                    owned = {(s, c): np.asarray(
+                        coll.data_of(*c).sync_to_host().payload)
+                        for s, coll in enumerate(colls)
+                        for c in coll.tiles() if coll.rank_of(*c) == r}
+                    return wall, snap, segs, comm_stats, owned
+                finally:
+                    ctx.fini()
+
+            results, _fab = spmd_threads(ranks, rank_fn, timeout=900,
+                                         fabric=fabric)
+        tiles = {}
+        for (_w, _snap, _s, _cs, owned) in results:
+            tiles.update(owned)
+        L = np.zeros((n, n), np.float32)
+        for (s, (tm, tk)), t in tiles.items():
+            if s == 0:
+                L[tm * nb:tm * nb + t.shape[0],
+                  tk * nb:tk * nb + t.shape[1]] = t
+        Lt = np.tril(L).astype(np.float64)
+        resid = float(np.abs(Lt @ Lt.T - M).max() / np.abs(M).max())
+        return results, tiles, resid
+
+    def leg(on):
+        walls, comm_us, overlap_us = [], 0.0, 0.0
+        segs = 0
+        pf = {"prefetch_gets": 0, "prefetch_hits": 0,
+              "prefetch_misses": 0, "prefetch_cancels": 0}
+        tiles = resid = None
+        for _ in range(reps):
+            results, tiles, resid = run_once(on)
+            walls.append(max(w for (w, _s, _g, _c, _t) in results))
+            comm_us += sum(s["comm_us"] for (_w, s, _g, _c, _t) in results)
+            overlap_us += sum(s["overlap_us"]
+                              for (_w, s, _g, _c, _t) in results)
+            segs += sum(g for (_w, _s, g, _c, _t) in results)
+            for k in pf:
+                pf[k] += sum(c[k] for (_w, _s, _g, c, _t) in results)
+        out = {"wall_s": round(min(walls), 3),
+               "overlap_fraction": round(overlap_us / max(1.0, comm_us),
+                                         4),
+               "flush_segments": segs, "residual": resid}
+        out.update(pf)
+        return out, tiles
+
+    run_once(True)     # warmup: kernel/stacked-callable compiles
+    on, tiles_on = leg(True)
+    off, tiles_off = leg(False)
+    out = {"overlap_n": n, "overlap_nb": nb, "overlap_ranks": ranks,
+           "overlap_link_delay_ms": delay_ms, "overlap_reps": reps}
+    out.update({f"on_{k}": v for k, v in on.items()})
+    out.update({f"off_{k}": v for k, v in off.items()})
+    out["overlap_bit_exact_on_vs_off"] = bool(
+        set(tiles_on) == set(tiles_off)
+        and all((tiles_on[c] == tiles_off[c]).all() for c in tiles_on))
+    out["overlap_gain"] = round(
+        on["overlap_fraction"] - off["overlap_fraction"], 4)
+    out["overlap_wall_speedup"] = round(
+        off["wall_s"] / max(1e-9, on["wall_s"]), 3)
+    return out
+
+
+_OVERLAP_DRIVER = r"""
+import json, os, sys
+sys.path.insert(0, os.environ["BENCH_REPO"])
+import bench
+
+print(json.dumps(bench.bench_overlap_inner(
+    n=int(os.environ.get("BENCH_OVERLAP_N", "768")),
+    nb=int(os.environ.get("BENCH_OVERLAP_NB", "64")),
+    ranks=int(os.environ.get("BENCH_OVERLAP_RANKS", "2")),
+    delay_ms=int(os.environ.get("BENCH_OVERLAP_DELAY_MS", "8")))))
+"""
+
+
+def bench_overlap(n=768, nb=64, ranks=2, delay_ms=8) -> dict:
+    """BENCH_MODE=overlap: the throttled-link overlap on/off comparison
+    in a scrubbed CPU subprocess (same pattern as bench_mesh: the
+    numbers must not depend on the tunnel session's TPU plugin)."""
+    import subprocess
+    import sys as _sys
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    keep = ("PATH", "HOME", "LANG", "LC_ALL", "TMPDIR", "USER")
+    env = {k: os.environ[k] for k in keep if k in os.environ}
+    env.update(JAX_PLATFORMS="cpu", PYTHONPATH=repo, BENCH_REPO=repo,
+               PARSEC_MCA_device_tpu_platform="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=2",
+               BENCH_OVERLAP_N=str(n), BENCH_OVERLAP_NB=str(nb),
+               BENCH_OVERLAP_RANKS=str(ranks),
+               BENCH_OVERLAP_DELAY_MS=str(delay_ms))
+    try:
+        p = subprocess.run([_sys.executable, "-c", _OVERLAP_DRIVER],
+                           env=env, capture_output=True, text=True,
+                           timeout=1200)
+        if p.returncode != 0:
+            return {"overlap_error": p.stdout[-200:] + p.stderr[-200:]}
+        return json.loads(p.stdout.strip().splitlines()[-1])
+    except Exception as exc:  # noqa: BLE001
+        return {"overlap_error": repr(exc)[:200]}
+
+
 def main() -> None:
     n = int(os.environ.get("BENCH_N", "8192"))
     nb = int(os.environ.get("BENCH_NB", "2048"))
@@ -1271,6 +1462,17 @@ def main() -> None:
             "metric": "mesh_wall_us_per_task(sharded,2x2,64-burst)",
             "value": extras.get("mesh_wall_us_per_task", -1.0),
             "unit": "us/task", "extras": extras}))
+        return
+    if mode == "overlap":
+        extras = bench_overlap(
+            n=int(os.environ.get("BENCH_OVERLAP_N", "768")),
+            nb=int(os.environ.get("BENCH_OVERLAP_NB", "64")),
+            ranks=int(os.environ.get("BENCH_OVERLAP_RANKS", "2")),
+            delay_ms=int(os.environ.get("BENCH_OVERLAP_DELAY_MS", "8")))
+        print(json.dumps({
+            "metric": "overlap_fraction_gain(throttled_link,on_vs_off)",
+            "value": extras.get("overlap_gain", -1.0),
+            "unit": "fraction", "extras": extras}))
         return
     if mode == "dispatch":
         extras = bench_dispatch(

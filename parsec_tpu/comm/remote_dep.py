@@ -59,6 +59,20 @@ def bcast_children(me_pos: int, nb: int, topology: str) -> List[int]:
     raise ValueError(f"unknown bcast topology {topology!r}")
 
 
+class _PrefetchedGet:
+    """One rendezvous GET issued AHEAD of its activation's delivery
+    (ISSUE 7 remote-GET prefetch).  ``done`` flips when the payload
+    lands; ``cb`` is set when the real delivery arrives first and wants
+    the data the moment it materializes."""
+
+    __slots__ = ("arr", "cb", "done")
+
+    def __init__(self) -> None:
+        self.arr = None
+        self.cb: Optional[Callable] = None
+        self.done = False
+
+
 class RemoteDepEngine:
     """Per-rank driver bound to one Context (the comm-thread analog; progress
     runs funnelled from the idle loop, ref: remote_dep_dequeue_main)."""
@@ -116,10 +130,26 @@ class RemoteDepEngine:
         # disables itself while device_donate is on.
         self._mesh_local = bool(params.get("comm_mesh_local")) \
             and not bool(params.get("device_donate"))
+        # remote-GET prefetch (ISSUE 7): an activation that races ahead
+        # of its taskpool's registration/startup counts is BUFFERED
+        # (see _early_activations) — but its rendezvous payload need
+        # not wait.  Up to ``comm_prefetch_inflight`` GETs are issued
+        # while the activation is still held, so the fetch overlaps the
+        # tail of the previous pool; the replayed delivery finds the
+        # bytes already local (a HIT).  Keyed (data_rank, handle_id);
+        # the delivery re-checks under the lock, so a prefetch landing
+        # mid-delivery and the replay racing it resolve cleanly.
+        self._prefetch_budget = int(params.get("comm_prefetch_inflight"))
+        self._prefetch_inflight = 0
+        self._prefetched_gets: Dict[Tuple[int, int], _PrefetchedGet] = {}
         self.stats = {"activates_sent": 0, "activates_recv": 0,
                       "dtd_sends": 0, "dtd_recvs": 0, "forwards": 0,
                       "mem_puts_sent": 0, "mem_puts_recv": 0,
-                      "mesh_local_sends": 0}
+                      "mesh_local_sends": 0,
+                      # prefetched-GET outcomes, DISTINCT from plain
+                      # GETs so the overlap gauges stay debuggable
+                      "prefetch_gets": 0, "prefetch_hits": 0,
+                      "prefetch_misses": 0, "prefetch_cancels": 0}
 
     # ------------------------------------------------------------------ #
     # context integration                                                #
@@ -141,6 +171,7 @@ class RemoteDepEngine:
         # one path instead of hanging in termdet forever
         def _on_failure(peer: int, reason: str) -> None:
             self._release_parks_for(peer)
+            self._cancel_prefetches(peer)  # its GET replies never come
             context.record_task_error(RankFailedError(peer, reason))
         self.ce.on_peer_failure = _on_failure
 
@@ -162,6 +193,7 @@ class RemoteDepEngine:
         return self.ce.progress()
 
     def fini(self) -> None:
+        self._cancel_prefetches()
         self.ce.fini()
 
     # ------------------------------------------------------------------ #
@@ -303,7 +335,8 @@ class RemoteDepEngine:
                       "flows": len(by_flow),
                       "dsts": sorted(remote_edges)})
 
-    def _on_activate(self, src: int, msg: Dict) -> None:
+    def _on_activate(self, src: int, msg: Dict, replay: bool = False) -> None:
+        held = prefetch = None
         with self._lock:
             tp = self._taskpools.get(msg["tp_id"])
             if tp is None or msg["tp_id"] not in self._counts_ready:
@@ -313,7 +346,16 @@ class RemoteDepEngine:
                 # nb_tasks before the total is credited
                 self._early_activations.setdefault(
                     msg["tp_id"], []).append((src, msg))
-                return
+                held = True
+                prefetch = self._plan_get_prefetch_locked(msg)
+        if held:
+            # the activation waits for counts_ready, its PAYLOAD need
+            # not: issue the rendezvous GET now (bounded by the
+            # comm_prefetch_inflight budget) so the fetch overlaps the
+            # tail of whatever this rank is still running
+            if prefetch is not None:
+                self._issue_get_prefetch(*prefetch)
+            return
         # count AFTER the gate: counts_ready re-invokes this handler for
         # buffered messages, which would double-count receives
         self.stats["activates_recv"] += 1
@@ -366,10 +408,110 @@ class RemoteDepEngine:
             self._deliver_activation(tp, my_edges, msg.get("data"),
                                      msg.get("dtt"))
         else:
-            # rendezvous: GET the payload from the data holder
+            # rendezvous: GET the payload from the data holder — unless
+            # a prefetched GET already fetched (or is fetching) it
             def on_data(arr):
                 self._deliver_activation(tp, my_edges, arr, msg.get("dtt"))
+            key = (msg["data_rank"], msg["handle"])
+            rec = None
+            with self._lock:
+                rec = self._prefetched_gets.get(key)
+                if rec is not None:
+                    if rec.done:
+                        del self._prefetched_gets[key]
+                    else:
+                        rec.cb = on_data   # deliver the moment it lands
+            if rec is not None:
+                self.stats["prefetch_hits"] += 1
+                if rec.done:
+                    on_data(rec.arr)
+                return
+            if replay and self._prefetch_budget > 0:
+                # a held activation whose GET was NOT prefetched
+                # (budget exhausted): the fetch serializes behind
+                # counts_ready after all — the debuggability signal
+                # for raising comm_prefetch_inflight
+                self.stats["prefetch_misses"] += 1
             self._timed_get(msg["data_rank"], msg["handle"], on_data)
+
+    # ------------------------------------------------------------------ #
+    # remote-GET prefetch (ISSUE 7)                                      #
+    # ------------------------------------------------------------------ #
+    def _plan_get_prefetch_locked(self, msg: Dict) -> Optional[Tuple[int, int]]:
+        """Under self._lock: decide whether a just-buffered activation's
+        rendezvous payload should be prefetched.  Returns the (peer,
+        handle) to fetch, or None (no handle / no edges for this rank /
+        budget exhausted / already prefetched)."""
+        if self._prefetch_budget <= 0 or msg.get("handle") is None:
+            return None
+        if not msg["edges"].get(self.rank):
+            return None   # pure-forwarding hop: children fetch themselves
+        key = (msg["data_rank"], msg["handle"])
+        if key in self._prefetched_gets \
+                or self._prefetch_inflight >= self._prefetch_budget:
+            return None
+        self._prefetched_gets[key] = _PrefetchedGet()
+        self._prefetch_inflight += 1
+        return key
+
+    def _issue_get_prefetch(self, peer: int, handle: int) -> None:
+        self.stats["prefetch_gets"] += 1
+
+        def on_data(arr):
+            cb = None
+            with self._lock:
+                rec = self._prefetched_gets.get((peer, handle))
+                if rec is None:
+                    # canceled (peer death / fini): the cancel already
+                    # released the budget slot — a late reply must not
+                    # decrement it a second time
+                    return
+                self._prefetch_inflight -= 1
+                rec.arr = arr
+                rec.done = True
+                cb = rec.cb
+                if cb is not None:
+                    del self._prefetched_gets[(peer, handle)]
+            if cb is not None:
+                cb(arr)   # the replayed delivery got here first
+
+        try:
+            self._timed_get(peer, handle, on_data)
+        except Exception:
+            # a dead peer must not leak the budget slot; a replayed
+            # delivery that has NOT latched on yet will issue (and fail)
+            # its own GET, surfacing the error on the normal path
+            cb = None
+            with self._lock:
+                rec = self._prefetched_gets.pop((peer, handle), None)
+                if rec is not None:
+                    self._prefetch_inflight -= 1
+                    self.stats["prefetch_cancels"] += 1
+                    cb = rec.cb
+            if cb is not None:
+                # a replayed delivery already latched onto this record
+                # (counted a hit, issued no GET of its own) — it must
+                # not be stranded with no fetch at all: fall back to a
+                # plain GET; if the transport is truly dead this raises
+                # too and surfaces exactly like the normal path
+                self._timed_get(peer, handle, cb)
+                return
+            raise
+
+    def _cancel_prefetches(self, peer: Optional[int] = None) -> None:
+        """Drop prefetched entries (all, or those owed by ``peer``) —
+        a dead producer's GET reply will never come, and fini must not
+        strand budget accounting."""
+        with self._lock:
+            keys = [k for k in self._prefetched_gets
+                    if peer is None or k[0] == peer]
+            dropped = 0
+            for k in keys:
+                rec = self._prefetched_gets.pop(k)
+                if not rec.done:
+                    self._prefetch_inflight -= 1
+                dropped += 1
+            self.stats["prefetch_cancels"] += dropped
 
     def _deliver_activation(self, tp, edges: List[Tuple], arr,
                             dtt=None) -> None:
@@ -478,7 +620,7 @@ class RemoteDepEngine:
             held_act = self._early_activations.pop(tp.comm_tp_id, [])
             held_put = self._early_mem_puts.pop(tp.comm_tp_id, [])
         for src, msg in held_act:
-            self._on_activate(src, msg)
+            self._on_activate(src, msg, replay=True)
         for src, msg in held_put:
             self._on_mem_put(src, msg)
 

@@ -50,7 +50,8 @@ from __future__ import annotations
 
 from typing import Any, Callable, Dict, Optional, Tuple
 
-__all__ = ["DeviceBatchSpec", "bucket_size", "stacked_callable_key",
+__all__ = ["DeviceBatchSpec", "bucket_size", "segment_plan",
+           "stacked_callable_key",
            "build_stacked_callable", "cached_stacked_callable",
            "build_sharded_callable", "cached_sharded_callable"]
 
@@ -111,6 +112,28 @@ def bucket_size(navail: int, batch_max: int) -> int:
     while b * 2 <= n:
         b *= 2
     return b
+
+
+def segment_plan(n: int, requested: int) -> int:
+    """Segments a flush group of ``n`` tasks splits into (ISSUE 7
+    segmented flush): the largest power of two <= min(requested, n // 2),
+    so every segment keeps >= 2 tasks (amortization survives) and the
+    per-segment sizes are themselves powers of two sharing the stacked-
+    callable cache with ordinary buckets.  1 = whole-batch flush.
+
+    Splitting an ``unroll``-mode group is BIT-EXACT vs the whole-batch
+    dispatch: each task's per-example subgraph lowers identically
+    whether its siblings share the executable or not — what changes is
+    *when* each task's outputs materialize.  A segment's outputs become
+    ready as soon as ITS sub-call finishes, so dependency sends (the
+    D2H + wire time the T3 overlap story hides) start while the later
+    segments are still executing instead of at the batch boundary."""
+    if requested <= 1 or n < 4:
+        return 1
+    s, limit = 1, min(requested, n // 2)
+    while s * 2 <= limit:
+        s *= 2
+    return s
 
 
 def stacked_callable_key(n: int, nargs: int, static: Any,

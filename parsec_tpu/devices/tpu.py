@@ -67,13 +67,27 @@ def _array_ready(arr: Any) -> bool:
 
 
 class _InFlight:
-    __slots__ = ("task", "outputs", "out_flows", "es_hint", "est")
+    __slots__ = ("task", "outputs", "out_flows", "es_hint", "est", "t0",
+                 "last_poll", "done_est")
 
     def __init__(self, task: Task, outputs: List[Any], out_flows: List[int], est: float) -> None:
         self.task = task
         self.outputs = outputs
         self.out_flows = out_flows
         self.est = est
+        # submission timestamp: with telemetry on, [t0, completion
+        # estimate] feeds the live overlap gauge's COMPUTE channel as
+        # the device-busy interval (obs/spans.OverlapTracker; exec PINS
+        # spans only see the async hook, not the kernel).  The kernel's
+        # true finish lies between the last poll that saw it NOT ready
+        # (last_poll) and the poll that saw it ready — the poll loops
+        # stamp the midpoint into done_est so a slow poll cadence (a
+        # progress thread sleeping in a throttled send) cannot inflate
+        # the busy window by a whole poll gap and silently "hide" its
+        # own comm time under it.
+        self.t0 = time.monotonic_ns()
+        self.last_poll = self.t0
+        self.done_est = 0
 
 
 class JaxDevice(Device):
@@ -101,7 +115,11 @@ class JaxDevice(Device):
                       "batches": 0, "batched_tasks": 0,
                       "dispatch_ns": 0, "dispatch_tasks": 0,
                       "prefetch_issued": 0, "prefetch_hits": 0,
-                      "donated": 0}
+                      "donated": 0,
+                      # segmented flush (ISSUE 7): flush groups that were
+                      # carved into pipelined sub-calls, and the total
+                      # sub-calls dispatched for them
+                      "segmented_flushes": 0, "flush_segments": 0}
         # eager completion (async dispatch IS completion; XLA orders the
         # dataflow) with a bounded in-flight window
         self.eager_complete = bool(params.get("tpu_eager_complete"))
@@ -116,6 +134,10 @@ class JaxDevice(Device):
         self.batch_mode = str(params.get("device_batch_mode"))
         self.prefetch_depth = int(params.get("device_prefetch_depth"))
         self.donate = bool(params.get("device_donate"))
+        # segmented flush (ISSUE 7): carve a flush group into pipelined
+        # jitted sub-calls so early segments' outputs retire (and their
+        # dependency sends start) while later segments still execute
+        self.flush_segments = int(params.get("device_flush_segments"))
         # copies staged early by the prefetcher: id(copy) -> version;
         # a stage-in that finds its copy here already valid is a HIT
         self._prefetched: Dict[int, int] = {}
@@ -186,22 +208,27 @@ class JaxDevice(Device):
                 for rec in done:
                     self._epilog(es, rec)
                     n += 1
+            now = time.monotonic_ns()
             if self._window:
                 # retire finished window entries so device_load drains on
                 # idle devices and async errors surface during the run
                 still_w = []
                 for rec in self._window:
                     if all(_array_ready(a) for a in rec.outputs):
+                        rec.done_est = (rec.last_poll + now) // 2
                         self._retire(rec, es)
                     else:
+                        rec.last_poll = now
                         still_w.append(rec)
                 self._window = still_w
             still: List[_InFlight] = []
             done = []
             for rec in self._inflight:
                 if all(_array_ready(a) for a in rec.outputs):
+                    rec.done_est = (rec.last_poll + now) // 2
                     done.append(rec)
                 else:
+                    rec.last_poll = now
                     still.append(rec)
             self._inflight = still
             for rec in done:
@@ -286,6 +313,17 @@ class JaxDevice(Device):
         chip-to-chip hop on a mesh; identity on a single chip)."""
         return payload
 
+    def _note_profile(self, es, cls_name: str, us_per_task: float,
+                      n: int) -> None:
+        """Feed the context's online class profile (critical-path-driven
+        scheduler priorities, ISSUE 7) with this class's measured
+        dispatch cost — one dict lookup + None check when profiling is
+        off."""
+        ctx = getattr(es, "context", None) if es is not None else None
+        prof = getattr(ctx, "class_profile", None)
+        if prof is not None:
+            prof.note(cls_name, us_per_task, n)
+
     def _out_flows(self, task: Task) -> List[int]:
         return [f.flow_index for f in task.task_class.flows
                 if (task.access_of(f) & FlowAccess.WRITE) and not f.ctl
@@ -306,8 +344,10 @@ class JaxDevice(Device):
         # fn is the DSL's wrapper: (task, per-flow device arrays) -> outputs
         t0 = time.perf_counter_ns()
         outputs = fn(task, inputs)
-        self.stats["dispatch_ns"] += time.perf_counter_ns() - t0
+        dt = time.perf_counter_ns() - t0
+        self.stats["dispatch_ns"] += dt
         self.stats["dispatch_tasks"] += 1
+        self._note_profile(es, tc.name, dt / 1e3, 1)
         if outputs is None:
             outputs = ()
         elif not isinstance(outputs, (tuple, list)):
@@ -417,6 +457,37 @@ class JaxDevice(Device):
 
     def _dispatch_batch(self, es, spec, static, donate,
                         chunk: List[Tuple]) -> None:
+        """Dispatch one flush group — as ONE stacked call, or (segmented
+        flush, ISSUE 7) as ``device_flush_segments`` pipelined stacked
+        sub-calls.  Sub-calls queue back to back on the async dispatch
+        stream, but each segment's outputs materialize when ITS
+        executable finishes, so the epilog's dependency release for the
+        first segment (eager sends, mesh-local offers, D2H for the
+        wire) overlaps the later segments' execution instead of waiting
+        for the batch boundary.  In ``unroll`` mode segmentation is
+        bit-exact vs the whole-batch dispatch (identical per-example
+        subgraphs, just grouped differently)."""
+        from .batching import segment_plan
+        n = len(chunk)
+        segs = segment_plan(n, self.flush_segments)
+        if segs <= 1:
+            return self._dispatch_stacked(es, spec, static, donate, chunk)
+        self.stats["segmented_flushes"] += 1
+        size = n // segs
+        for i in range(0, n, size):
+            if not spec.batchable:
+                # an earlier segment's trace failure downgraded the
+                # class (and already fell back per-task for itself):
+                # finish the group per-task without re-tracing
+                for task, est, inputs, _ in chunk[i:]:
+                    self._submit_prepared(es, task, est, inputs)
+                return
+            self.stats["flush_segments"] += 1
+            self._dispatch_stacked(es, spec, static, donate,
+                                   chunk[i:i + size])
+
+    def _dispatch_stacked(self, es, spec, static, donate,
+                          chunk: List[Tuple]) -> None:
         """ONE stacked jitted call for ``chunk``; the lowered callable is
         AOT-cached on the spec per (bucket, static, shapes, donate) so
         steady-state submission is a cache hit.  Any trace/dispatch
@@ -464,10 +535,12 @@ class JaxDevice(Device):
                 for task, est, inputs, _ in chunk:
                     self._submit_prepared(es, task, est, inputs)
                 return
-        self.stats["dispatch_ns"] += time.perf_counter_ns() - t0
+        dt = time.perf_counter_ns() - t0
+        self.stats["dispatch_ns"] += dt
         self.stats["dispatch_tasks"] += n
         self.stats["batches"] += 1
         self.stats["batched_tasks"] += n
+        self._note_profile(es, chunk[0][0].task_class.name, dt / 1e3 / n, n)
         if any(donate):
             self.stats["donated"] += sum(donate) * n
         n_out = len(outs) // n if n else 0
@@ -608,11 +681,29 @@ class JaxDevice(Device):
             else:
                 plog.warning("async kernel of %s failed at drain: %s",
                              rec.task.snprintf(), exc)
+        obs = self._obs
+        if obs is not None and obs.tracker is not None and es is not None:
+            # the device-busy interval for the live overlap gauge:
+            # [submit, poll-bracketed completion estimate] when the
+            # poll loop stamped one, [submit, now] when this retire
+            # itself waited for readiness. Drain/teardown retires
+            # (es=None) are skipped — their retire time says nothing
+            # about when the kernel finished.
+            obs.tracker.note("compute", rec.t0,
+                             rec.done_est or time.monotonic_ns())
 
     def _epilog(self, es, rec: _InFlight) -> None:
         """ref: parsec_cuda_kernel_epilog (device_cuda_module.c:2365-2430)."""
         from ..runtime.scheduling import complete_execution
         task = rec.task
+        if not self.eager_complete:
+            # non-eager: the poll loop just observed every output ready —
+            # note the device-busy interval (eager mode notes at window
+            # retire instead, where readiness is actually observed)
+            obs = self._obs
+            if obs is not None and obs.tracker is not None:
+                obs.tracker.note("compute", rec.t0,
+                                 rec.done_est or time.monotonic_ns())
         for arr, fidx in zip(rec.outputs, rec.out_flows):
             ref = task.data[fidx]
             data = ref.data_in.data if ref.data_in is not None else None
@@ -998,12 +1089,14 @@ class JaxMeshDevice(JaxDevice):
         except Exception as exc:
             raise _MeshDispatchFailed(
                 f"{type(exc).__name__}: {exc}") from exc
-        self.stats["dispatch_ns"] += time.perf_counter_ns() - t0
+        dt = time.perf_counter_ns() - t0
+        self.stats["dispatch_ns"] += dt
         self.stats["dispatch_tasks"] += n
         self.stats["batches"] += 1
         self.stats["batched_tasks"] += n
         self.stats["mesh_dispatches"] += 1
         self.stats["mesh_tasks"] += n
+        self._note_profile(es, chunk[0][0].task_class.name, dt / 1e3 / n, n)
         # phase 2 — submission: unbind each chip's output shard into
         # per-task rows with ONE jitted call per chip (results never
         # leave the mesh; a failure past this point is a real error,

@@ -35,15 +35,17 @@ from .spans import (COMM_ACTIVE_TRANSFERS, COMM_BYTES_RECEIVED,
                     COMM_MSGS_RECEIVED, COMM_MSGS_SENT,
                     COMM_PENDING_MESSAGES, CommObs, DeviceObs,
                     FT_HB_RTT_PREFIX, FT_PEER_ALIVE,
-                    payload_nbytes, register_device_gauges)
+                    OBS_EXPOSED_COMM_US, OBS_OVERLAP_FRACTION,
+                    OverlapTracker, payload_nbytes, register_device_gauges)
 
 __all__ = [
     "MetricsRegistry", "Histogram", "MetricsTaskModule", "ContextObs",
-    "CommObs", "DeviceObs", "payload_nbytes",
+    "CommObs", "DeviceObs", "OverlapTracker", "payload_nbytes",
     "COMM_BYTES_SENT", "COMM_BYTES_RECEIVED", "COMM_MSGS_SENT",
     "COMM_MSGS_RECEIVED", "COMM_ACTIVE_TRANSFERS", "COMM_PENDING_MESSAGES",
     "COMM_COALESCED", "COMM_CHUNKS_INFLIGHT", "COMM_COMPRESS_RATIO",
     "COMM_LINK_BW_PREFIX", "FT_PEER_ALIVE", "FT_HB_RTT_PREFIX",
+    "OBS_OVERLAP_FRACTION", "OBS_EXPOSED_COMM_US",
     "TASK_EXEC_SECONDS", "COMM_XFER_SECONDS",
     "render", "parse_exposition", "sanitize_name", "fleet_to_prometheus",
     "analyze", "critical_path", "format_report", "parse_dot",
@@ -68,17 +70,27 @@ class ContextObs:
         self._devices: List[Any] = []
         self._task_module: Optional[MetricsTaskModule] = None
         self._profiler_with_hist: Optional[Any] = None
+        # live T3 overlap gauge (ISSUE 7): compute/comm interval
+        # accumulator behind PARSEC::OBS::OVERLAP_FRACTION — only with
+        # telemetry on (its feeds are the span sinks below)
+        self.overlap: Optional[OverlapTracker] = None
+        if self.enabled:
+            self.overlap = OverlapTracker()
+            ctx.sde.register_poll(OBS_OVERLAP_FRACTION, self.overlap.fraction)
+            ctx.sde.register_poll(OBS_EXPOSED_COMM_US, self.overlap.exposed_us)
         # device pull gauges always (poll-only, no hot-path cost); the
         # span/histogram sink only when telemetry is on
         for dev in ctx.devices:
             register_device_gauges(ctx.sde, dev)
             if self.enabled:
-                dev._obs = DeviceObs(self.metrics, dev, profile=ctx.profile)
+                dev._obs = DeviceObs(self.metrics, dev, profile=ctx.profile,
+                                     tracker=self.overlap)
                 self._devices.append(dev)
         ce = getattr(ctx.comm, "ce", ctx.comm) if ctx.comm is not None else None
         if ce is not None:
             comm_obs = CommObs(self.metrics,
-                               profile=ctx.profile if self.enabled else None)
+                               profile=ctx.profile if self.enabled else None,
+                               tracker=self.overlap if self.enabled else None)
             comm_obs.register_engine_gauges(ce)
             if self.enabled:
                 ce._obs = comm_obs
@@ -98,11 +110,13 @@ class ContextObs:
                 # registering a second PINS callback on the hot path
                 from .metrics import ExecTimer
                 profiler.exec_timer = ExecTimer(
-                    self.metrics.histogram(TASK_EXEC_SECONDS))
+                    self.metrics.histogram(TASK_EXEC_SECONDS),
+                    tracker=self.overlap)
                 self._profiler_with_hist = profiler
             else:
                 self._task_module = MetricsTaskModule(self.metrics,
-                                                      context=ctx)
+                                                      context=ctx,
+                                                      tracker=self.overlap)
                 self._task_module.enable()
 
     def fini(self) -> None:

@@ -212,18 +212,32 @@ def analyze(trace_docs: List[Dict[str, Any]],
     overlap: Dict[int, Dict[str, float]] = {}
     pids = sorted({iv.pid for iv in intervals})
     for pid in pids:
-        compute = merge_intervals([(iv.begin, iv.end) for iv in intervals
-                                   if iv.pid == pid and _is_compute(iv)])
-        comm = merge_intervals([(iv.begin, iv.end) for iv in intervals
-                                if iv.pid == pid and _is_comm(iv)])
+        rank_ivs = [iv for iv in intervals if iv.pid == pid]
+        compute = merge_intervals([(iv.begin, iv.end) for iv in rank_ivs
+                                   if _is_compute(iv)])
+        comm = merge_intervals([(iv.begin, iv.end) for iv in rank_ivs
+                                if _is_comm(iv)])
         comm_us = sum(e - b for b, e in comm)
         comp_us = sum(e - b for b, e in compute)
         hidden = overlap_us(compute, comm)
+        # the rank's makespan: the span of everything it did — the
+        # denominator that tells whether the EXPOSED comm (the part no
+        # compute hid) actually matters for wall time
+        makespan = (max(iv.end for iv in rank_ivs)
+                    - min(iv.begin for iv in rank_ivs)) if rank_ivs else 0.0
+        exposed = comm_us - hidden
         overlap[pid] = {
             "compute_us": comp_us,
             "comm_us": comm_us,
             "overlap_us": hidden,
-            "overlap_fraction": hidden / comm_us if comm_us > 0 else 0.0,
+            # zero-comm ranks report PERFECT overlap (1.0): nothing to
+            # hide means nothing exposed — a single-rank run must not
+            # trip an overlap gate (tools/obs_report.py --gate-overlap)
+            "overlap_fraction": hidden / comm_us if comm_us > 0 else 1.0,
+            "exposed_comm_us": exposed,
+            "makespan_us": makespan,
+            "exposed_share_of_makespan": (exposed / makespan
+                                          if makespan > 0 else 0.0),
         }
 
     report: Dict[str, Any] = {
@@ -273,5 +287,8 @@ def format_report(report: Dict[str, Any]) -> str:
         ov = report["overlap"][pid]
         out.append(f"  rank {pid}: compute={ov['compute_us'] / 1e3:.3f} ms "
                    f"comm={ov['comm_us'] / 1e3:.3f} ms "
-                   f"overlap fraction={ov['overlap_fraction']:.3f}")
+                   f"overlap fraction={ov['overlap_fraction']:.3f} "
+                   f"exposed={ov.get('exposed_comm_us', 0.0) / 1e3:.3f} ms "
+                   f"({ov.get('exposed_share_of_makespan', 0.0):.1%} of "
+                   f"makespan)")
     return "\n".join(out)

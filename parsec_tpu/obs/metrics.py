@@ -127,15 +127,18 @@ class ExecTimer:
     """The single exec-latency feed: per-thread begin timestamps into a
     histogram. Shared by MetricsTaskModule (metrics without profiling)
     and TaskProfilerModule.exec_timer (metrics + profiling, one PINS
-    callback instead of two) so the measurement exists exactly once."""
+    callback instead of two) so the measurement exists exactly once.
+    When an ``OverlapTracker`` is attached the same intervals also feed
+    the live overlap gauge's COMPUTE channel (obs/spans.py)."""
 
-    __slots__ = ("hist", "_open", "_time")
+    __slots__ = ("hist", "_open", "_time", "tracker")
 
-    def __init__(self, hist: Histogram) -> None:
+    def __init__(self, hist: Histogram, tracker: Any = None) -> None:
         import time
         self._time = time
         self.hist = hist
         self._open: Dict[int, int] = {}
+        self.tracker = tracker
 
     def begin(self, th_id: int) -> None:
         self._open[th_id] = self._time.monotonic_ns()
@@ -143,7 +146,10 @@ class ExecTimer:
     def end(self, th_id: int) -> None:
         t0 = self._open.pop(th_id, None)
         if t0 is not None:
-            self.hist.observe((self._time.monotonic_ns() - t0) / 1e9)
+            t1 = self._time.monotonic_ns()
+            self.hist.observe((t1 - t0) / 1e9)
+            if self.tracker is not None:
+                self.tracker.note("compute", t0, t1)
 
 
 class MetricsTaskModule(PinsModule):
@@ -154,13 +160,15 @@ class MetricsTaskModule(PinsModule):
     name = "metrics_task"
     events = [PinsEvent.EXEC_BEGIN, PinsEvent.EXEC_END]
 
-    def __init__(self, metrics: MetricsRegistry, context: Any = None) -> None:
+    def __init__(self, metrics: MetricsRegistry, context: Any = None,
+                 tracker: Any = None) -> None:
         self.metrics = metrics
         # context filter: several in-process SPMD ranks share the global
         # PINS sites, but each rank's histogram must only see its own
         # tasks (same isolation as the per-context SDE registry)
         self.context = context
-        self.timer = ExecTimer(metrics.histogram(TASK_EXEC_SECONDS))
+        self.timer = ExecTimer(metrics.histogram(TASK_EXEC_SECONDS),
+                               tracker=tracker)
 
     def callback(self, es: Any, event: PinsEvent, payload: Any) -> None:
         if self.context is not None and es.context is not self.context:

@@ -14,18 +14,21 @@ land in the context's SDE registry under ``PARSEC::COMM::*`` /
 """
 from __future__ import annotations
 
+import threading
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from .metrics import COMM_XFER_SECONDS, MetricsRegistry
 
-__all__ = ["CommObs", "DeviceObs", "register_device_gauges",
+__all__ = ["CommObs", "DeviceObs", "OverlapTracker",
+           "register_device_gauges",
            "COMM_BYTES_SENT", "COMM_BYTES_RECEIVED",
            "COMM_MSGS_SENT", "COMM_MSGS_RECEIVED",
            "COMM_ACTIVE_TRANSFERS", "COMM_PENDING_MESSAGES",
            "COMM_COALESCED", "COMM_CHUNKS_INFLIGHT",
            "COMM_COMPRESS_RATIO", "COMM_LINK_BW_PREFIX",
            "FT_PEER_ALIVE", "FT_HB_RTT_PREFIX",
+           "OBS_OVERLAP_FRACTION", "OBS_EXPOSED_COMM_US",
            "payload_nbytes"]
 
 COMM_BYTES_SENT = "PARSEC::COMM::BYTES_SENT"
@@ -47,6 +50,14 @@ COMM_LINK_BW_PREFIX = "PARSEC::COMM::LINK_BW"
 # (PARSEC::FT::HB_RTT::R<peer>, 0 until measured)
 FT_PEER_ALIVE = "PARSEC::FT::PEER_ALIVE"
 FT_HB_RTT_PREFIX = "PARSEC::FT::HB_RTT"
+# LIVE T3-style overlap telemetry (ISSUE 7): the fraction of this
+# rank's communication time (comm spans + host<->device transfers)
+# hidden under task execution, and the exposed remainder in us — the
+# same metric obs/critpath.py computes offline, maintained online by
+# OverlapTracker so perf gates can assert it DURING a run.  1.0 for a
+# zero-comm rank (nothing to hide = nothing exposed).
+OBS_OVERLAP_FRACTION = "PARSEC::OBS::OVERLAP_FRACTION"
+OBS_EXPOSED_COMM_US = "PARSEC::OBS::EXPOSED_COMM_US"
 
 #: trace stream ids (outside any plausible worker th_id range)
 COMM_STREAM_TID = 1 << 20
@@ -83,20 +94,125 @@ def payload_nbytes(payload: Any) -> int:
     return 8
 
 
+class OverlapTracker:
+    """Online compute/comm interval accumulator behind the live
+    ``PARSEC::OBS::OVERLAP_FRACTION`` gauge (ISSUE 7).
+
+    The span sinks report completed intervals into two channels —
+    ``compute`` (task execution, fed by the EXEC-site timer) and
+    ``comm`` (comm-engine spans + host<->device transfers).  The gauge
+    read merges each channel's union and intersects them — the exact
+    T3 metric obs/critpath.py computes offline, on the live run.
+    Appends are O(1) under a lock; past ``COALESCE_AT`` intervals per
+    channel the lists merge, and if still too long the old prefix
+    (everything before a shared time watermark) is SEALED into scalar
+    totals — its union length and cross-channel intersection are exact
+    at seal time, so the reported fractions never drift while memory
+    stays bounded on long runs.  (The one approximation: a span that
+    *completes* after a seal but *started* before the watermark can no
+    longer intersect sealed intervals of the other channel, so overlap
+    may be slightly under-reported — conservative for a gate.)
+    Timestamps are monotonic-ns (the span sinks' clock); intervals are
+    stored in microseconds."""
+
+    __slots__ = ("_lock", "_iv", "_closed")
+
+    COALESCE_AT = 4096
+    #: intervals kept live per channel after a seal
+    KEEP_AT = 1024
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._iv: Dict[str, List[Tuple[float, float]]] = {
+            "compute": [], "comm": []}
+        # sealed-prefix totals (us): exact union lengths + their exact
+        # intersection, accumulated when old intervals are retired
+        self._closed = {"compute_us": 0.0, "comm_us": 0.0,
+                        "overlap_us": 0.0}
+
+    def note(self, channel: str, t0_ns: int, t1_ns: int) -> None:
+        if t1_ns <= t0_ns:
+            return
+        with self._lock:
+            self._iv[channel].append((t0_ns / 1e3, t1_ns / 1e3))
+            if len(self._iv[channel]) > self.COALESCE_AT:
+                self._compact_locked()
+
+    def _compact_locked(self) -> None:
+        """Merge both channels; if a channel is still over the budget
+        (disjoint intervals cannot merge away), seal everything before
+        a shared watermark into the closed totals."""
+        from .critpath import merge_intervals, overlap_us
+        comp = merge_intervals(self._iv["compute"])
+        comm = merge_intervals(self._iv["comm"])
+        if max(len(comp), len(comm)) > self.COALESCE_AT:
+            # watermark: the start of the KEEP_AT-th-from-last interval
+            # of every over-budget channel — both channels seal at the
+            # SAME cut so the sealed intersection is exact
+            w = min(ch[-self.KEEP_AT][0] for ch in (comp, comm)
+                    if len(ch) > self.KEEP_AT)
+
+            def split(ivs):
+                old, new = [], []
+                for b, e in ivs:
+                    if e <= w:
+                        old.append((b, e))
+                    elif b >= w:
+                        new.append((b, e))
+                    else:           # straddles the cut: clip, no loss
+                        old.append((b, w))
+                        new.append((w, e))
+                return old, new
+
+            old_comp, comp = split(comp)
+            old_comm, comm = split(comm)
+            self._closed["compute_us"] += sum(e - b for b, e in old_comp)
+            self._closed["comm_us"] += sum(e - b for b, e in old_comm)
+            self._closed["overlap_us"] += overlap_us(old_comp, old_comm)
+        self._iv["compute"], self._iv["comm"] = comp, comm
+
+    def snapshot(self) -> Dict[str, float]:
+        from .critpath import merge_intervals, overlap_us
+        with self._lock:
+            comp = list(self._iv["compute"])
+            comm = list(self._iv["comm"])
+            closed = dict(self._closed)
+        comp = merge_intervals(comp)
+        comm = merge_intervals(comm)
+        comm_us = closed["comm_us"] + sum(e - b for b, e in comm)
+        hidden = closed["overlap_us"] + overlap_us(comp, comm)
+        return {"compute_us": (closed["compute_us"]
+                               + sum(e - b for b, e in comp)),
+                "comm_us": comm_us, "overlap_us": hidden,
+                # zero-comm: nothing to hide — report PERFECT overlap
+                # (1.0) so gates don't trip on comm-free ranks
+                "overlap_fraction": (hidden / comm_us if comm_us > 0
+                                     else 1.0)}
+
+    def fraction(self) -> float:
+        return round(self.snapshot()["overlap_fraction"], 4)
+
+    def exposed_us(self) -> float:
+        s = self.snapshot()
+        return round(s["comm_us"] - s["overlap_us"], 1)
+
+
 class CommObs:
     """Per-rank comm telemetry sink. Construct with the rank's metrics
     registry and (optionally) its Profile; every hook is safe to call
     from any thread."""
 
-    __slots__ = ("metrics", "stream", "_open_gets", "_hist")
+    __slots__ = ("metrics", "stream", "_open_gets", "_hist", "tracker")
 
     def __init__(self, metrics: MetricsRegistry,
-                 profile: Optional[Any] = None) -> None:
+                 profile: Optional[Any] = None,
+                 tracker: Optional[OverlapTracker] = None) -> None:
         self.metrics = metrics
         self.stream = (profile.stream(COMM_STREAM_TID, "comm")
                        if profile is not None else None)
         self._open_gets: Dict[int, int] = {}  # token -> t0_ns
         self._hist = metrics.histogram(COMM_XFER_SECONDS)
+        self.tracker = tracker
 
     # -- active messages -----------------------------------------------------
     def am_sent(self, src: int, dst: int, tag: int, payload: Any,
@@ -105,9 +221,12 @@ class CommObs:
         sde = self.metrics.sde
         sde.inc(COMM_MSGS_SENT)
         sde.inc(COMM_BYTES_SENT, nbytes)
+        t1 = time.monotonic_ns()
+        if self.tracker is not None:
+            self.tracker.note("comm", t0_ns, t1)
         st = self.stream
         if st is not None:
-            st.span("comm:send", t0_ns, time.monotonic_ns(),
+            st.span("comm:send", t0_ns, t1,
                     {"src": src, "dst": dst, "tag": tag, "bytes": nbytes})
 
     def am_arrived(self, src: int, tag: int, payload: Any) -> None:
@@ -133,6 +252,8 @@ class CommObs:
             return
         t1 = time.monotonic_ns()
         self._hist.observe((t1 - t0) / 1e9)
+        if self.tracker is not None:
+            self.tracker.note("comm", t0, t1)
         st = self.stream
         if st is not None:
             st.span("comm:get", t0, t1,
@@ -144,16 +265,22 @@ class CommObs:
         # on the receiver's progress with no ack) — so puts do NOT feed
         # the transfer-latency histogram; GETs, which have a matched
         # reply, do
+        t1 = time.monotonic_ns()
+        if self.tracker is not None:
+            self.tracker.note("comm", t0_ns, t1)
         st = self.stream
         if st is not None:
-            st.span("comm:put", t0_ns, time.monotonic_ns(),
+            st.span("comm:put", t0_ns, t1,
                     {"dst": dst_rank, "bytes": payload_nbytes(payload)})
 
     # -- generic protocol spans (remote_dep et al.) --------------------------
     def span(self, key: str, t0_ns: int, info: Any = None) -> None:
+        t1 = time.monotonic_ns()
+        if self.tracker is not None:
+            self.tracker.note("comm", t0_ns, t1)
         st = self.stream
         if st is not None:
-            st.span(key, t0_ns, time.monotonic_ns(), info)
+            st.span(key, t0_ns, t1, info)
 
     # -- progress ------------------------------------------------------------
     def progress(self, handled: int, t0_ns: int) -> None:
@@ -267,21 +394,27 @@ class DeviceObs:
     keep the one-attribute-check fast path (gauges are registered
     separately via :func:`register_device_gauges`)."""
 
-    __slots__ = ("metrics", "stream", "name", "_hist")
+    __slots__ = ("metrics", "stream", "name", "_hist", "tracker")
 
     def __init__(self, metrics: MetricsRegistry, device: Any,
-                 profile: Optional[Any] = None) -> None:
+                 profile: Optional[Any] = None,
+                 tracker: Optional[OverlapTracker] = None) -> None:
         self.metrics = metrics
         self.name = device.name
         self.stream = (profile.stream(DEVICE_STREAM_TID + device.device_index,
                                       f"dev:{device.name}")
                        if profile is not None else None)
         self._hist = metrics.histogram(COMM_XFER_SECONDS)
+        self.tracker = tracker
 
     def xfer(self, direction: str, nbytes: int, t0_ns: int) -> None:
         """A host<->device transfer completed (direction: "in"|"out")."""
         t1 = time.monotonic_ns()
         self._hist.observe((t1 - t0_ns) / 1e9)
+        if self.tracker is not None:
+            # transfers count as COMM for the overlap gauge — the same
+            # classification the offline analyzer applies (dev:xfer*)
+            self.tracker.note("comm", t0_ns, t1)
         st = self.stream
         if st is not None:
             st.span(f"dev:xfer_{direction}", t0_ns, t1,

@@ -198,6 +198,15 @@ class Context:
             (d.mesh for d in self.devices
              if getattr(d, "mesh", None) is not None), None)
 
+        # online critical-path class profile (ISSUE 7): duration-
+        # weighted per-class EWMAs + upward-rank boosts the priority
+        # schedulers consume (runtime/profile.py); None = static
+        # priorities only (the pre-overlap behavior)
+        self.class_profile = None
+        if params.get("sched_dynamic_priority"):
+            from .profile import ClassProfile
+            self.class_profile = ClassProfile()
+
         # scheduler (ref: parsec_set_scheduler scheduling.c:246-272)
         from ..sched import sched_new
         name = scheduler or params.get("sched")
@@ -285,6 +294,11 @@ class Context:
             self._active_taskpools += 1
         for dev in self.devices:
             dev.taskpool_register(tp)
+        if self.class_profile is not None:
+            # class-level dataflow feeds the upward-rank boosts BEFORE
+            # startup tasks are scheduled, so even the first wave is
+            # stamped with graph-aware priorities
+            self.class_profile.observe_taskpool(tp)
         if self.comm is not None:
             self.comm.taskpool_register(tp)
         # after device+comm registration: DTD's buffered-insert replay may

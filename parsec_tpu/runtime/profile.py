@@ -1,0 +1,274 @@
+"""Online per-task-class profile driving critical-path scheduler
+priorities (ISSUE 7).
+
+The reference's schedulers order ready tasks by the JDF's *static*
+priority expression alone; nothing in the runtime reacts to where the
+time actually goes.  ``ClassProfile`` closes that loop with two cheap
+online signals:
+
+- a **duration-weighted per-class EWMA** fed from the device module's
+  dispatch timings (``dispatch_ns``) and the workers' CPU exec
+  timings — where each class's time goes;
+- the **class-level successor graph** read off the PTG ASTs at enqueue
+  (``POTRF -> TRSM -> {SYRK, GEMM}`` for dpotrf) — where each class
+  sits in the dataflow.
+
+From these it computes an **upward-rank boost** per class (the HEFT
+upward rank at class granularity):
+
+1. the class digraph is condensed into strongly connected components
+   (iterative Tarjan) — iterative workloads make the class graph
+   cyclic (``SYRK -> POTRF(k+1)``), so the plain longest-path recursion
+   would not terminate;
+2. each condensation node gets the classic upward rank
+   ``rank[scc] = weight[scc] + max(rank[succ])`` over the (acyclic)
+   condensation, with ``weight`` = the summed member EWMAs (one pass
+   through the cycle);
+3. *within* an SCC, classes are ordered by **scarcity**: ascending
+   duration-weighted share (instances seen x EWMA us).  The class with
+   the smallest total share is the sequential bottleneck of the cycle —
+   for dpotrf that ranks POTRF (NT instances) above TRSM/SYRK (~NT^2)
+   above GEMM (~NT^3), exactly the chain the critical path follows —
+   while the abundant classes have enough parallelism to fill in
+   behind.
+
+``effective(cls, static)`` packs the boost above the JDF's static
+priority expression, which stays as the tiebreak (so ``(NT - k)``-style
+depth ordering still decides among instances of one class).  Classes
+the profile has never seen (DTD bodies, foreign pools) get boost 0 and
+keep their static priority unchanged — enabling the profile never
+reorders workloads it knows nothing about.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Set, Tuple
+
+__all__ = ["ClassProfile"]
+
+#: the static priority rides in the low bits; one boost step dominates
+#: any static value inside the clamp window
+_STATIC_CLAMP = (1 << 21) - 1
+_PRIO_SCALE = 1 << 22
+
+
+class ClassProfile:
+    """Thread-safe online class profile + upward-rank boosts."""
+
+    #: EWMA smoothing for the per-instance duration (us)
+    ALPHA = 0.2
+    #: recompute the cached boosts at most every this many notes
+    RECOMPUTE_EVERY = 128
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._succ: Dict[str, Set[str]] = {}      # class -> successor classes
+        self._ewma_us: Dict[str, float] = {}      # class -> us/instance EWMA
+        self._count: Dict[str, int] = {}          # class -> instances seen
+        self._warm: Set[str] = set()              # classes past sample #1
+        self._boost: Dict[str, int] = {}          # cached ranks (read lock-free)
+        self._dirty = True
+        self._notes = 0
+
+    # ------------------------------------------------------------------ #
+    # feeding                                                            #
+    # ------------------------------------------------------------------ #
+    def observe_taskpool(self, tp: Any) -> None:
+        """Merge a PTG taskpool's class-level dataflow into the graph
+        (DTD pools carry no static classes and are skipped)."""
+        changed = False
+        with self._lock:
+            for tc in getattr(tp, "task_classes", ()):
+                ast = getattr(tc, "ast", None)
+                if ast is None:
+                    continue
+                succs = self._succ.setdefault(ast.name, set())
+                for f in ast.flows:
+                    for d in f.deps:
+                        for t in (d.target, d.alt_target):
+                            if t is None or t.kind != "task" \
+                                    or not t.task_class:
+                                continue
+                            if d.direction == "out":
+                                if t.task_class not in succs:
+                                    succs.add(t.task_class)
+                                    changed = True
+                            else:   # in-dep: producer -> this class
+                                ps = self._succ.setdefault(
+                                    t.task_class, set())
+                                if ast.name not in ps:
+                                    ps.add(ast.name)
+                                    changed = True
+            if changed:
+                self._dirty = True
+
+    def add_edges(self, cls: str, succs: Any = ()) -> None:
+        """Register ``cls`` (and its successor classes) directly — the
+        embedder/test-facing alternative to ``observe_taskpool``."""
+        with self._lock:
+            s = self._succ.setdefault(cls, set())
+            for t in succs:
+                s.add(t)
+                self._succ.setdefault(t, set())
+            self._dirty = True
+
+    def note(self, cls: str, us_per_task: float, n: int = 1) -> None:
+        """One measured dispatch/exec sample: ``n`` instances of ``cls``
+        at ``us_per_task`` microseconds each.  The FIRST sample of a
+        class is counted but not duration-weighted — it pays the
+        one-time jit trace/compile, which would otherwise dominate the
+        EWMA for the whole (short) run."""
+        with self._lock:
+            if cls not in self._succ:
+                return   # unknown class: never boosted, don't track
+            self._count[cls] = self._count.get(cls, 0) + n
+            self._notes += 1
+            if cls not in self._warm:
+                self._warm.add(cls)
+                self._dirty = True   # a class came online: re-rank now
+            else:
+                cur = self._ewma_us.get(cls)
+                self._ewma_us[cls] = (us_per_task if cur is None else
+                                      (1 - self.ALPHA) * cur
+                                      + self.ALPHA * us_per_task)
+            if self._notes >= self.RECOMPUTE_EVERY:
+                self._dirty = True
+
+    # ------------------------------------------------------------------ #
+    # consuming                                                          #
+    # ------------------------------------------------------------------ #
+    def boost_of(self, cls: str) -> int:
+        """The class's upward-rank boost (0 for unknown classes)."""
+        if self._dirty:
+            self._recompute()
+        return self._boost.get(cls, 0)
+
+    def effective(self, cls: str, static: int) -> int:
+        """The effective scheduling priority: boost in the high bits,
+        the (clamped) static JDF priority as the tiebreak."""
+        base = max(-_STATIC_CLAMP, min(int(static), _STATIC_CLAMP))
+        b = self.boost_of(cls)
+        return b * _PRIO_SCALE + base if b else base
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Debug/report view: per-class EWMA, count, and boost."""
+        if self._dirty:
+            self._recompute()
+        with self._lock:
+            return {c: {"ewma_us": round(self._ewma_us.get(c, 0.0), 3),
+                        "count": self._count.get(c, 0),
+                        "boost": self._boost.get(c, 0)}
+                    for c in self._succ}
+
+    # ------------------------------------------------------------------ #
+    # rank computation                                                   #
+    # ------------------------------------------------------------------ #
+    def _recompute(self) -> None:
+        with self._lock:
+            if not self._dirty:
+                return
+            self._dirty = False
+            self._notes = 0
+            succ = {c: set(s) for c, s in self._succ.items()}
+            for s in list(succ.values()):
+                for t in s:
+                    succ.setdefault(t, set())
+            ewma = dict(self._ewma_us)
+            count = dict(self._count)
+        sccs = _tarjan_sccs(succ)
+        scc_of = {c: i for i, scc in enumerate(sccs) for c in scc}
+        # condensation DAG + upward rank (weight = one pass through the
+        # component; unmeasured classes weigh a nominal 1 us so the
+        # pure-depth rank exists before the first sample lands)
+        weight = [sum(ewma.get(c, 1.0) for c in scc) for scc in sccs]
+        cond_succ: List[Set[int]] = [set() for _ in sccs]
+        for c, ss in succ.items():
+            for t in ss:
+                if scc_of[c] != scc_of[t]:
+                    cond_succ[scc_of[c]].add(scc_of[t])
+        rank = [0.0] * len(sccs)
+        for i in _reverse_topo(cond_succ):
+            rank[i] = weight[i] + max(
+                (rank[j] for j in cond_succ[i]), default=0.0)
+        # dense-rank the SCC levels so boosts stay small stable ints
+        levels = {r: li for li, r in enumerate(sorted(set(rank)))}
+        boost: Dict[str, int] = {}
+        for i, scc in enumerate(sccs):
+            members = sorted(
+                scc, key=lambda c: (-(count.get(c, 0)
+                                      * ewma.get(c, 1.0)), c))
+            # descending duration-weighted share: the scarcest class
+            # (least total time — the cycle's sequential bottleneck)
+            # lands last and gets the highest within-SCC ordinal
+            for o, c in enumerate(members):
+                boost[c] = levels[rank[i]] * 256 + min(o, 255)
+        with self._lock:
+            self._boost = boost
+
+
+def _tarjan_sccs(succ: Dict[str, Set[str]]) -> List[List[str]]:
+    """Iterative Tarjan strongly-connected components."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    out: List[List[str]] = []
+    counter = [0]
+    for root in succ:
+        if root in index:
+            continue
+        work: List[Tuple[str, Any]] = [(root, iter(sorted(succ[root])))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for child in it:
+                if child not in index:
+                    index[child] = low[child] = counter[0]
+                    counter[0] += 1
+                    stack.append(child)
+                    on_stack.add(child)
+                    work.append((child, iter(sorted(succ[child]))))
+                    advanced = True
+                    break
+                if child in on_stack:
+                    low[node] = min(low[node], index[child])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                out.append(scc)
+    return out
+
+
+def _reverse_topo(cond_succ: List[Set[int]]) -> List[int]:
+    """Condensation nodes ordered successors-first (Tarjan already
+    emits SCCs in reverse topological order, but recompute defensively
+    from the edges so the rank loop never reads an unset successor)."""
+    n = len(cond_succ)
+    indeg = [0] * n
+    for ss in cond_succ:
+        for t in ss:
+            indeg[t] += 1
+    order: List[int] = [i for i in range(n) if indeg[i] == 0]
+    i = 0
+    while i < len(order):
+        for t in cond_succ[order[i]]:
+            indeg[t] -= 1
+            if indeg[t] == 0:
+                order.append(t)
+        i += 1
+    return list(reversed(order))

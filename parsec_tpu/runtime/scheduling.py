@@ -54,6 +54,22 @@ class ExecutionStream:
         return x
 
 
+def stamp_dynamic_priority(ctx, tasks: List[Task]) -> None:
+    """Critical-path-driven priorities (ISSUE 7): re-stamp each task's
+    scheduling priority from the online class profile's upward-rank
+    boost, with the DSL's static priority expression as the tiebreak
+    (``runtime/profile.py``).  Idempotent — recomputed from the task's
+    immutable ``base_priority`` — so a rescheduled (AGAIN) task is not
+    boosted twice, and a no-op when ``sched_dynamic_priority`` is off
+    or the class is unknown to the profile (DTD bodies keep their
+    static priority untouched)."""
+    prof = ctx.class_profile
+    if prof is None:
+        return
+    for t in tasks:
+        t.priority = prof.effective(t.task_class.name, t.base_priority)
+
+
 def schedule(es: ExecutionStream, tasks: List[Task], distance: int = 0) -> None:
     """ref: __parsec_schedule (scheduling.c:284-328) — hand a ring of ready
     tasks to the scheduler module; paranoid checks that every task really is
@@ -61,6 +77,7 @@ def schedule(es: ExecutionStream, tasks: List[Task], distance: int = 0) -> None:
     if not tasks:
         return
     ctx = es.context
+    stamp_dynamic_priority(ctx, tasks)
     if __debug__:
         for t in tasks:
             assert t.status in (TaskStatus.NONE, TaskStatus.PREPARE_INPUT), \
@@ -78,6 +95,9 @@ def schedule_keep_best(es: ExecutionStream, tasks: List[Task], distance: int = 0
     (ref: scheduling.c:610-615, parsec_internal.h:463-470)."""
     if not tasks:
         return
+    # stamp BEFORE picking the bypass task so "highest priority" and the
+    # scheduler's queue order agree on the same (dynamic) priority
+    stamp_dynamic_priority(es.context, tasks)
     if es.context.keep_highest_priority_task and es.next_task is None:
         best = max(range(len(tasks)), key=lambda i: tasks[i].priority)
         es.next_task = tasks.pop(best)
@@ -155,8 +175,16 @@ def task_progress(es: ExecutionStream, task: Task, distance: int = 0) -> None:
                 schedule(es, [task], distance + 1)
                 return
             assert rc == HookReturn.DONE, f"prepare_input returned {rc}"
+    prof = es.context.class_profile
+    t0 = time.perf_counter_ns() if prof is not None else 0
     rc = execute(es, task)
     if rc == HookReturn.DONE:
+        if prof is not None:
+            # synchronous (CPU-chore) execution: feed the class profile
+            # with the measured body time — the host half of the
+            # duration-weighted EWMA (the device half comes from the
+            # device module's dispatch timings)
+            prof.note(tc.name, (time.perf_counter_ns() - t0) / 1e3)
         complete_execution(es, task)
     elif rc == HookReturn.ASYNC:
         pass  # device module owns completion now (SURVEY.md §3.4)

@@ -167,7 +167,8 @@ class TaskClass:
 class Task(Obj):
     """One task instance (ref: parsec_task_t)."""
 
-    __slots__ = ("taskpool", "task_class", "locals", "priority", "status",
+    __slots__ = ("taskpool", "task_class", "locals", "priority",
+                 "base_priority", "status",
                  "chore_mask", "selected_device", "selected_chore", "data",
                  "repo_entry", "body_args", "user", "es_hint", "dtd",
                  "flow_access")
@@ -179,6 +180,11 @@ class Task(Obj):
         self.task_class = task_class
         self.locals = locals_
         self.priority = priority
+        # the DSL's static priority expression, kept apart from
+        # ``priority`` (which the dynamic critical-path profile may
+        # re-stamp at every schedule — runtime/profile.py): re-stamping
+        # recomputes from this base, so it stays idempotent
+        self.base_priority = priority
         self.status = TaskStatus.NONE
         self.chore_mask = task_class.chore_mask_all()
         self.selected_device = None      # devices.Device once placed

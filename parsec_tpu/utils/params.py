@@ -321,6 +321,26 @@ def register_core_params() -> None:
                    "stage-in (device_put) the inputs of up to this many "
                    "queued tasks while the current batch executes "
                    "(0 = no async prefetch)")
+    params.reg_int("device_flush_segments", 4,
+                   "carve each batched flush group into up to this many "
+                   "pipelined jitted sub-calls so a segment's written "
+                   "tiles retire (and their dependency sends start) "
+                   "while the rest of the batch is still executing "
+                   "(<=1 = whole-batch flush, the pre-overlap behavior; "
+                   "segments never shrink below 2 tasks)")
+    params.reg_int("comm_prefetch_inflight", 8,
+                   "max rendezvous GETs prefetched for activations that "
+                   "arrived ahead of their taskpool's registration/"
+                   "startup counts: the payload fetch overlaps the tail "
+                   "of the previous pool instead of serializing behind "
+                   "counts_ready (0 = no GET prefetch)")
+    params.reg_bool("sched_dynamic_priority", True,
+                    "critical-path-driven scheduling: an online per-"
+                    "class profile (duration-weighted EWMA fed from "
+                    "device dispatch + CPU exec timings) computes an "
+                    "upward-rank boost per task class; priority "
+                    "schedulers pop critical-path classes first, with "
+                    "the PTG spec's static priority as the tiebreak")
     params.reg_bool("device_donate", False,
                     "donate stale device input buffers of WRITE flows "
                     "to the batched call (jax donate_argnums) to cut "

@@ -368,7 +368,7 @@ def test_activation_gated_until_counts_ready():
     assert list(e1._early_activations) == [tp.comm_tp_id]
 
     delivered = []
-    e1._on_activate = lambda src, m: delivered.append(m)
+    e1._on_activate = lambda src, m, replay=False: delivered.append(m)
     e1.counts_ready(tp)
     assert [m["tp_id"] for m in delivered] == [tp.comm_tp_id]
     assert not e1._early_activations

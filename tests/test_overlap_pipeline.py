@@ -1,0 +1,374 @@
+"""Overlap-aware execution (ISSUE 7): segmented flush bit-exactness +
+fallback, remote-GET prefetch for early activations, and the live
+overlap tracker's interval algebra."""
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import parsec_tpu
+from conftest import spmd
+from parsec_tpu import dtd
+from parsec_tpu.collections import TwoDimBlockCyclic
+from parsec_tpu.comm import RemoteDepEngine
+from parsec_tpu.dsl import ptg
+from parsec_tpu.dsl.dtd import INOUT, INPUT
+from parsec_tpu.ops import dpotrf_taskpool, make_spd
+from parsec_tpu.utils.params import params
+
+
+def _tpu_devs(ctx):
+    return [d for d in ctx.devices if d.device_type == "tpu"]
+
+
+# --------------------------------------------------------------------- #
+# segmented flush: bit-exact differential + counters + fallback         #
+# --------------------------------------------------------------------- #
+def _run_dpotrf(segments: int):
+    """One classic-runtime dpotrf (POTRF/TRSM/SYRK/GEMM classes) with
+    the given device_flush_segments; returns (L, segment stats)."""
+    M = make_spd(256)
+    with params.cmdline_override("device_tpu_max", "1"), \
+         params.cmdline_override("device_flush_segments", str(segments)):
+        ctx = parsec_tpu.Context(nb_cores=2)
+        try:
+            A = TwoDimBlockCyclic(256, 256, 32, 32,
+                                  dtype=np.float32).from_numpy(M)
+            ctx.add_taskpool(dpotrf_taskpool(A))
+            ctx.wait()
+            devs = _tpu_devs(ctx)
+            st = {k: sum(d.stats[k] for d in devs)
+                  for k in ("segmented_flushes", "flush_segments",
+                            "batches", "batched_tasks")}
+            return A.to_numpy().copy(), st
+        finally:
+            ctx.fini()
+
+
+def test_segmented_flush_bit_exact_dpotrf():
+    """Acceptance: segmented flush is BIT-EXACT vs whole-batch unroll
+    dispatch for the cholesky/trsm/syrk/gemm classes, and the segment
+    counters prove the pipelined path really ran."""
+    L_whole, st_whole = _run_dpotrf(1)
+    L_seg, st_seg = _run_dpotrf(4)
+    assert st_whole["segmented_flushes"] == 0
+    assert st_whole["flush_segments"] == 0
+    assert st_seg["segmented_flushes"] > 0
+    # every carved group produced >= 2 sub-calls
+    assert st_seg["flush_segments"] >= 2 * st_seg["segmented_flushes"]
+    assert st_seg["batches"] > st_whole["batches"]  # more, smaller calls
+    assert np.array_equal(L_whole, L_seg), \
+        "segmented flush is not bit-exact vs whole-batch dispatch"
+
+
+def _run_dtd_burst(segments: int, kern, burst=32, nb=48):
+    with params.cmdline_override("device_tpu_max", "1"), \
+         params.cmdline_override("device_flush_segments", str(segments)):
+        ctx = parsec_tpu.init(nb_cores=2)
+        try:
+            tp = dtd.taskpool_new()
+            ctx.add_taskpool(tp)
+
+            def body(es, task):   # host fallback
+                c, a, b = dtd.unpack_args(task)
+                c -= a @ b.T
+
+            boot = tp.tile_of_array(np.zeros((nb, nb), np.float32))
+            tp.insert_task(body, (boot, INOUT), (boot, INPUT),
+                           (boot, INPUT))
+            tp.add_chore(body, "tpu", kern)
+            rng = np.random.RandomState(7)
+            tiles = [[tp.tile_of_array(rng.rand(nb, nb).astype(np.float32))
+                      for _ in range(3)] for _ in range(burst)]
+            for c, a, b in tiles:
+                tp.insert_task(body, (c, INOUT), (a, INPUT), (b, INPUT))
+            tp.wait()
+            devs = _tpu_devs(ctx)
+            st = {k: sum(d.stats[k] for d in devs)
+                  for k in ("segmented_flushes", "flush_segments",
+                            "batches")}
+            out = [np.asarray(c.data.sync_to_host().payload)
+                   for c, _a, _b in tiles]
+            return out, st
+        finally:
+            ctx.fini()
+
+
+def test_segmented_flush_bit_exact_dtd_burst():
+    import jax
+    import jax.numpy as jnp
+    kern = jax.jit(lambda c, a, b:
+                   c - jnp.dot(a, b.T,
+                               preferred_element_type=jnp.float32))
+    out_whole, st_whole = _run_dtd_burst(1, kern)
+    out_seg, st_seg = _run_dtd_burst(4, kern)
+    assert st_seg["segmented_flushes"] > 0 >= st_whole["segmented_flushes"]
+    assert all(np.array_equal(a, b) for a, b in zip(out_whole, out_seg))
+
+
+def test_segmented_flush_untraceable_falls_back_per_task():
+    """A trace failure inside the FIRST segment must downgrade the class
+    and finish the whole group per-task — same transparent fallback as
+    the whole-batch path, results unchanged."""
+    def kern(c, a, b):   # np.asarray on a tracer raises under jit
+        return c - np.asarray(a) @ np.asarray(b).T
+
+    out, st = _run_dtd_burst(4, kern, burst=16)
+    assert st["batches"] == 0, "untraceable body must not batch"
+    rng = np.random.RandomState(7)
+    tiles = [[rng.rand(48, 48).astype(np.float32) for _ in range(3)]
+             for _ in range(16)]
+    for got, (c, a, b) in zip(out, tiles):
+        np.testing.assert_allclose(got, c - a @ b.T, atol=1e-4)
+
+
+# --------------------------------------------------------------------- #
+# remote-GET prefetch: an activation racing ahead of registration       #
+# --------------------------------------------------------------------- #
+PREFETCH_JDF = """
+descX [ type="collection" ]
+
+PROD(k)
+
+k = 0 .. 0
+
+: descX( 0, 0 )
+
+RW X <- descX( 0, 0 )
+     -> X CONS( 0 )
+     -> descX( 0, 0 )
+
+BODY
+{
+    X[:, :] = X + 1.0
+}
+END
+
+CONS(k)
+
+k = 0 .. 0
+
+: descX( 1, 0 )
+
+READ X <- X PROD( 0 )
+RW   Y <- descX( 1, 0 )
+       -> descX( 1, 0 )
+
+BODY
+{
+    Y[:, :] = X * 2.0
+}
+END
+"""
+
+
+def test_remote_get_prefetch_early_activation():
+    """Rank 1 delays its taskpool registration while rank 0 completes
+    PROD and ships the activation: the 32 KB payload (> short_limit)
+    rides a rendezvous handle, the activation is buffered early, and
+    the GET must be PREFETCHED while buffered — the replayed delivery
+    then hits the prefetched payload, never issuing a second GET."""
+    nb_ranks, mb = 2, 64   # 64x64 f64 = 32 KB > 4096 (rendezvous)
+    A0 = np.random.RandomState(3).rand(2 * mb, mb)
+
+    def rank_fn(rank, fabric):
+        eng = RemoteDepEngine(fabric.engine(rank))
+        ctx = parsec_tpu.Context(nb_cores=1, comm=eng, enable_tpu=False)
+        try:
+            coll = TwoDimBlockCyclic(2 * mb, mb, mb, mb, P=2, Q=1,
+                                     nodes=2, rank=rank, dtype=np.float64)
+            coll.name = "descX"
+            coll.from_numpy(A0.copy())
+            tp = ptg.compile_jdf(PREFETCH_JDF, name="prefetch_jdf").new(
+                descX=coll, rank=rank, nb_ranks=nb_ranks)
+            if rank == 1:
+                # hold registration: rank 0's activation must arrive
+                # FIRST and be buffered as an early activation
+                deadline = time.time() + 60
+                while time.time() < deadline \
+                        and not eng._early_activations:
+                    eng.ce.progress()
+                    time.sleep(0.001)
+                assert eng._early_activations, \
+                    "activation never buffered ahead of registration"
+                assert eng.stats["prefetch_gets"] == 1, eng.stats
+                # let the prefetched payload land before registering,
+                # so the hit is the already-done flavor
+                while time.time() < deadline and not any(
+                        r.done for r in eng._prefetched_gets.values()):
+                    eng.ce.progress()
+                    time.sleep(0.001)
+                assert any(r.done
+                           for r in eng._prefetched_gets.values())
+            ctx.add_taskpool(tp)
+            ctx.wait()
+            stats = dict(eng.stats)
+            out = (np.asarray(coll.data_of(1, 0).sync_to_host().payload)
+                   if rank == 1 else None)
+            return stats, out
+        finally:
+            ctx.fini()
+
+    results, _fabric = spmd(nb_ranks, rank_fn, timeout=120)
+    stats1, out1 = results[1]
+    assert stats1["prefetch_gets"] == 1
+    assert stats1["prefetch_hits"] == 1
+    assert stats1["prefetch_misses"] == 0
+    assert stats1["prefetch_cancels"] == 0
+    assert results[0][0]["prefetch_gets"] == 0   # rank 0 never buffered
+    np.testing.assert_allclose(out1, (A0[:mb] + 1.0) * 2.0, rtol=1e-12)
+
+
+def test_prefetch_budget_zero_counts_miss():
+    """With comm_prefetch_inflight=0 nothing is prefetched and nothing
+    is counted — the off switch restores the pre-overlap behavior."""
+    nb_ranks, mb = 2, 64
+    A0 = np.random.RandomState(4).rand(2 * mb, mb)
+
+    def rank_fn(rank, fabric):
+        eng = RemoteDepEngine(fabric.engine(rank))
+        ctx = parsec_tpu.Context(nb_cores=1, comm=eng, enable_tpu=False)
+        try:
+            coll = TwoDimBlockCyclic(2 * mb, mb, mb, mb, P=2, Q=1,
+                                     nodes=2, rank=rank, dtype=np.float64)
+            coll.name = "descX"
+            coll.from_numpy(A0.copy())
+            tp = ptg.compile_jdf(PREFETCH_JDF, name="prefetch_jdf").new(
+                descX=coll, rank=rank, nb_ranks=nb_ranks)
+            if rank == 1:
+                deadline = time.time() + 60
+                while time.time() < deadline \
+                        and not eng._early_activations:
+                    eng.ce.progress()
+                    time.sleep(0.001)
+                assert eng._early_activations
+            ctx.add_taskpool(tp)
+            ctx.wait()
+            return dict(eng.stats)
+        finally:
+            ctx.fini()
+
+    with params.cmdline_override("comm_prefetch_inflight", "0"):
+        results, _fabric = spmd(nb_ranks, rank_fn, timeout=120)
+    stats1 = results[1]
+    assert stats1["prefetch_gets"] == 0
+    assert stats1["prefetch_hits"] == 0
+    # budget 0 = feature off: not even a miss is charged
+    assert stats1["prefetch_misses"] == 0
+
+
+def test_prefetch_late_reply_after_cancel_releases_budget_once():
+    """A cancel (peer death / fini) racing a GET reply already sitting
+    in the receive queue must release the budget slot exactly ONCE —
+    a double decrement would let _plan_get_prefetch_locked admit more
+    than comm_prefetch_inflight concurrent prefetches forever after."""
+    from parsec_tpu.comm import LocalFabric
+    from parsec_tpu.comm.remote_dep import _PrefetchedGet
+
+    fabric = LocalFabric(2)
+    eng = RemoteDepEngine(fabric.engine(1))
+    captured = []
+    eng._timed_get = lambda peer, handle, cb: captured.append(cb)
+    key = (0, 7)
+    with eng._lock:
+        eng._prefetched_gets[key] = _PrefetchedGet()
+        eng._prefetch_inflight += 1
+    eng._issue_get_prefetch(*key)
+    assert captured and eng._prefetch_inflight == 1
+    eng._cancel_prefetches(0)            # the cancel releases the slot
+    assert eng._prefetch_inflight == 0
+    assert eng.stats["prefetch_cancels"] == 1
+    captured[0](np.zeros(1))             # late reply: record is gone
+    assert eng._prefetch_inflight == 0   # NOT -1
+
+
+def test_prefetch_issue_failure_falls_back_to_latched_delivery():
+    """If the prefetch GET fails to issue AFTER a replayed delivery
+    already latched onto the record (set rec.cb, issued no GET of its
+    own), the cleanup must not strand that delivery — it falls back to
+    a plain GET for the latched callback instead of raising."""
+    from parsec_tpu.comm import LocalFabric
+    from parsec_tpu.comm.remote_dep import _PrefetchedGet
+
+    fabric = LocalFabric(2)
+    eng = RemoteDepEngine(fabric.engine(1))
+    calls = []
+
+    def timed_get(peer, handle, cb):
+        calls.append(cb)
+        if len(calls) == 1:
+            raise RuntimeError("transport burp")
+
+    eng._timed_get = timed_get
+    key = (0, 9)
+    rec = _PrefetchedGet()
+    delivered = []
+    rec.cb = delivered.append            # the replayed delivery's hook
+    with eng._lock:
+        eng._prefetched_gets[key] = rec
+        eng._prefetch_inflight += 1
+    eng._issue_get_prefetch(*key)        # must NOT raise: falls back
+    assert len(calls) == 2 and calls[1] is rec.cb
+    assert eng._prefetch_inflight == 0
+    assert key not in eng._prefetched_gets
+    assert eng.stats["prefetch_cancels"] == 1
+
+
+# --------------------------------------------------------------------- #
+# the live overlap tracker                                              #
+# --------------------------------------------------------------------- #
+def test_overlap_tracker_interval_algebra():
+    from parsec_tpu.obs import OverlapTracker
+    tr = OverlapTracker()
+    # zero comm: perfect overlap by definition (gate-safe)
+    assert tr.snapshot()["overlap_fraction"] == 1.0
+    tr.note("compute", 0, 100_000)            # [0, 100] us
+    assert tr.snapshot()["overlap_fraction"] == 1.0
+    tr.note("comm", 50_000, 150_000)          # [50, 150] us: half hidden
+    snap = tr.snapshot()
+    assert snap["comm_us"] == pytest.approx(100.0)
+    assert snap["overlap_fraction"] == pytest.approx(0.5)
+    assert tr.exposed_us() == pytest.approx(50.0)
+    tr.note("compute", 100_000, 150_000)      # cover the rest
+    assert tr.fraction() == pytest.approx(1.0)
+
+
+def test_overlap_tracker_coalesces_bounded():
+    from parsec_tpu.obs import OverlapTracker
+    tr = OverlapTracker()
+    for i in range(3 * tr.COALESCE_AT):
+        tr.note("comm", 1000 * i, 1000 * i + 500)
+    assert len(tr._iv["comm"]) <= 2 * tr.COALESCE_AT
+    # nothing lost to the coalescing
+    assert tr.snapshot()["comm_us"] == pytest.approx(
+        3 * tr.COALESCE_AT * 0.5)
+
+
+# --------------------------------------------------------------------- #
+# obs_report --gate-overlap (satellite)                                 #
+# --------------------------------------------------------------------- #
+def test_obs_report_gate_overlap(tmp_path, capsys):
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools"))
+    import obs_report
+
+    def doc(events):
+        return {"traceEvents": events, "metadata": {}}
+
+    exposed = [
+        {"name": "exec:K", "ph": "X", "pid": 0, "tid": 0, "ts": 0,
+         "dur": 100.0, "args": {"task": "K(0)"}},
+        {"name": "comm:get", "ph": "X", "pid": 0, "tid": 9, "ts": 200.0,
+         "dur": 100.0},
+    ]
+    p_bad = tmp_path / "bad.trace.json"
+    p_bad.write_text(__import__("json").dumps(doc(exposed)))
+    assert obs_report.main([str(p_bad), "--gate-overlap", "0.5"]) == 2
+    # zero-comm rank reports 1.0 and passes any gate
+    p_ok = tmp_path / "ok.trace.json"
+    p_ok.write_text(__import__("json").dumps(doc(exposed[:1])))
+    assert obs_report.main([str(p_ok), "--gate-overlap", "0.99"]) == 0
+    capsys.readouterr()

@@ -1,0 +1,235 @@
+"""Scheduler priority semantics (ISSUE 7 satellite): ap/spq/pbq pop
+order under mixed priorities, the keep_highest_priority_task bypass
+slot, FIFO-within-priority under dynamic updates, and the online
+ClassProfile's upward-rank/scarcity boosts."""
+import numpy as np
+import pytest
+
+import parsec_tpu
+from parsec_tpu.runtime.profile import ClassProfile, _PRIO_SCALE
+from parsec_tpu.runtime.scheduling import schedule, schedule_keep_best
+from parsec_tpu.runtime.taskpool import Task, TaskClass
+from parsec_tpu.utils.params import params
+
+
+class _FakePool:
+    """Just enough taskpool for a Task living in scheduler queues."""
+    taskpool_id = 0
+    name = "fake"
+
+
+def _mk_tasks(prios, cls="T"):
+    tc = TaskClass(cls, 0, 0)
+    tp = _FakePool()
+    return [Task(tp, tc, (i,), priority=p) for i, p in enumerate(prios)]
+
+
+def _ctx(sched, cores=1, **kw):
+    return parsec_tpu.init(nb_cores=cores, scheduler=sched,
+                           enable_tpu=False, **kw)
+
+
+# --------------------------------------------------------------------- #
+# pop order under mixed priorities                                      #
+# --------------------------------------------------------------------- #
+@pytest.mark.parametrize("sched", ["ap", "spq"])
+def test_priority_pop_order_desc_fifo_within(sched):
+    ctx = _ctx(sched)
+    try:
+        es = ctx.execution_streams[0]
+        tasks = _mk_tasks([1, 5, 3, 5, 0])
+        ctx.scheduler.schedule(es, list(tasks))
+        got = [ctx.scheduler.select(es) for _ in range(5)]
+        # priority desc; FIFO between the two priority-5 tasks
+        assert got == [tasks[1], tasks[3], tasks[2], tasks[0], tasks[4]]
+        assert ctx.scheduler.select(es) is None
+    finally:
+        ctx.fini()
+
+
+def test_ip_pops_worst_first():
+    ctx = _ctx("ip")
+    try:
+        es = ctx.execution_streams[0]
+        tasks = _mk_tasks([1, 5, 3])
+        ctx.scheduler.schedule(es, list(tasks))
+        got = [ctx.scheduler.select(es) for _ in range(3)]
+        assert got == [tasks[0], tasks[2], tasks[1]]
+    finally:
+        ctx.fini()
+
+
+def test_pbq_local_buffer_pops_best():
+    """pbq keeps a priority-aware local buffer: a local push set pops
+    highest-priority first on the pushing stream."""
+    ctx = _ctx("pbq", cores=2)
+    try:
+        es = ctx.execution_streams[0]
+        tasks = _mk_tasks([2, 9, 4])
+        ctx.scheduler.schedule(es, list(tasks), distance=0)
+        got = [ctx.scheduler.select(es) for _ in range(3)]
+        assert got == [tasks[1], tasks[2], tasks[0]]
+    finally:
+        ctx.fini()
+
+
+# --------------------------------------------------------------------- #
+# the keep_highest_priority_task bypass slot (scheduling.py)            #
+# --------------------------------------------------------------------- #
+def test_keep_highest_priority_bypass_slot():
+    ctx = _ctx("ap")
+    try:
+        es = ctx.execution_streams[0]
+        assert ctx.keep_highest_priority_task
+        tasks = _mk_tasks([3, 8, 5])
+        schedule_keep_best(es, list(tasks))
+        # the best freshly-enabled task stays on the releasing thread
+        assert es.next_task is tasks[1]
+        # the rest went to the scheduler in priority order
+        assert ctx.scheduler.select(es) is tasks[2]
+        assert ctx.scheduler.select(es) is tasks[0]
+        # an occupied slot is never displaced
+        es.next_task = tasks[1]
+        more = _mk_tasks([99])
+        schedule_keep_best(es, list(more))
+        assert es.next_task is tasks[1]
+        assert ctx.scheduler.select(es) is more[0]
+        es.next_task = None
+    finally:
+        ctx.fini()
+
+
+# --------------------------------------------------------------------- #
+# dynamic priorities: stamping + FIFO within equal priority             #
+# --------------------------------------------------------------------- #
+def test_dynamic_boost_jumps_queue_static_breaks_ties():
+    """A critical-path class (profile boost) beats a higher STATIC
+    priority of a non-critical class; within one class the static
+    expression still decides."""
+    ctx = _ctx("ap")
+    try:
+        es = ctx.execution_streams[0]
+        prof = ctx.class_profile
+        assert prof is not None   # sched_dynamic_priority default on
+        prof.add_edges("CRIT", ["LEAF"])
+        prof.add_edges("LEAF", [])
+        tc_crit = TaskClass("CRIT", 0, 0)
+        tc_leaf = TaskClass("LEAF", 1, 0)
+        tp = _FakePool()
+        leaf_hi = Task(tp, tc_leaf, (0,), priority=1000)
+        crit_lo = Task(tp, tc_crit, (1,), priority=1)
+        crit_hi = Task(tp, tc_crit, (2,), priority=7)
+        schedule(es, [leaf_hi, crit_lo, crit_hi])
+        got = [ctx.scheduler.select(es) for _ in range(3)]
+        assert got == [crit_hi, crit_lo, leaf_hi]
+        # the stamp is boost * SCALE + static, recomputed from base
+        assert crit_hi.priority == prof.boost_of("CRIT") * _PRIO_SCALE + 7
+        assert crit_hi.base_priority == 7
+    finally:
+        ctx.fini()
+
+
+def test_dynamic_updates_keep_fifo_within_priority():
+    """Profile updates between pushes must not reorder equal-priority
+    tasks: FIFO within a priority is a scheduler invariant."""
+    ctx = _ctx("ap")
+    try:
+        es = ctx.execution_streams[0]
+        prof = ctx.class_profile
+        prof.add_edges("A", ["B"])
+        prof.add_edges("B", [])
+        tc = TaskClass("A", 0, 0)
+        tp = _FakePool()
+        first = Task(tp, tc, (0,), priority=5)
+        schedule(es, [first])
+        # an EWMA update between pushes (same class set: boosts stable)
+        prof.note("A", 100.0)
+        prof.note("A", 250.0)
+        second = Task(tp, tc, (1,), priority=5)
+        schedule(es, [second])
+        assert first.priority == second.priority
+        assert ctx.scheduler.select(es) is first
+        assert ctx.scheduler.select(es) is second
+    finally:
+        ctx.fini()
+
+
+def test_dynamic_priority_off_keeps_static():
+    with params.cmdline_override("sched_dynamic_priority", "0"):
+        ctx = _ctx("ap")
+    try:
+        assert ctx.class_profile is None
+        es = ctx.execution_streams[0]
+        tasks = _mk_tasks([4, 2])
+        schedule(es, list(tasks))
+        assert tasks[0].priority == 4   # untouched
+        assert ctx.scheduler.select(es) is tasks[0]
+    finally:
+        ctx.fini()
+
+
+# --------------------------------------------------------------------- #
+# ClassProfile: upward rank + scarcity                                  #
+# --------------------------------------------------------------------- #
+def test_class_profile_chain_ranks_descend():
+    prof = ClassProfile()
+    prof.add_edges("A", ["B"])
+    prof.add_edges("B", ["C"])
+    prof.add_edges("C", [])
+    assert prof.boost_of("A") > prof.boost_of("B") > prof.boost_of("C")
+    # unknown classes are never boosted and keep their static priority
+    assert prof.boost_of("ZZZ") == 0
+    assert prof.effective("ZZZ", 42) == 42
+
+
+def test_class_profile_cycle_scarcity_orders_dpotrf_classes():
+    """The dpotrf class graph is one SCC; within it the duration-
+    weighted scarcity must rank POTRF (rare) above GEMM (abundant)."""
+    prof = ClassProfile()
+    prof.add_edges("POTRF", ["TRSM"])
+    prof.add_edges("TRSM", ["SYRK", "GEMM"])
+    prof.add_edges("SYRK", ["POTRF", "SYRK"])
+    prof.add_edges("GEMM", ["TRSM", "GEMM"])
+    # steady-state-ish samples: first per class is discarded (compile)
+    for _ in range(3):
+        prof.note("POTRF", 100.0, 4)
+        prof.note("TRSM", 100.0, 16)
+        prof.note("SYRK", 100.0, 16)
+        prof.note("GEMM", 100.0, 64)
+    assert prof.boost_of("POTRF") > prof.boost_of("GEMM")
+    assert prof.boost_of("TRSM") > prof.boost_of("GEMM")
+    snap = prof.snapshot()
+    assert snap["GEMM"]["count"] == 3 * 64
+
+
+def test_class_profile_effective_packing():
+    prof = ClassProfile()
+    prof.add_edges("A", ["B"])
+    prof.add_edges("B", [])
+    # boost dominates any clamped static; static breaks ties in-class
+    assert prof.effective("A", -10) > prof.effective("B", 10**9)
+    assert prof.effective("A", 3) > prof.effective("A", 2)
+
+
+def test_dpotrf_run_populates_profile():
+    """End-to-end: a classic-runtime dpotrf feeds the profile and the
+    result stays correct with dynamic priorities on (the default)."""
+    from parsec_tpu.collections import TwoDimBlockCyclic
+    from parsec_tpu.ops import dpotrf_taskpool, make_spd
+
+    with params.cmdline_override("device_tpu_max", "1"):
+        ctx = parsec_tpu.Context(nb_cores=2)
+        try:
+            M = make_spd(192)
+            A = TwoDimBlockCyclic(192, 192, 32, 32,
+                                  dtype=np.float32).from_numpy(M)
+            ctx.add_taskpool(dpotrf_taskpool(A))
+            ctx.wait()
+            L = np.tril(A.to_numpy()).astype(np.float64)
+            resid = np.abs(L @ L.T - M).max() / np.abs(M).max()
+            assert resid < 1e-5
+            snap = ctx.class_profile.snapshot()
+            assert set(snap) == {"POTRF", "TRSM", "SYRK", "GEMM"}
+            assert all(c["count"] > 0 for c in snap.values())
+        finally:
+            ctx.fini()

@@ -31,6 +31,12 @@ def main(argv=None) -> int:
                          "(enables the critical-path section)")
     ap.add_argument("--json", action="store_true",
                     help="emit the raw report as JSON instead of text")
+    ap.add_argument("--gate-overlap", type=float, default=None,
+                    metavar="FRAC",
+                    help="exit non-zero when any rank's compute/comm "
+                         "overlap fraction is below FRAC (zero-comm "
+                         "ranks report 1.0 and never trip the gate) — "
+                         "the CI hook for the T3 overlap target")
     args = ap.parse_args(argv)
 
     docs = []
@@ -48,6 +54,18 @@ def main(argv=None) -> int:
         print()
     else:
         print(format_report(report))
+    if args.gate_overlap is not None:
+        bad = {pid: ov["overlap_fraction"]
+               for pid, ov in report.get("overlap", {}).items()
+               if ov["overlap_fraction"] < args.gate_overlap}
+        if bad:
+            print(f"OVERLAP GATE FAILED: {len(bad)} rank(s) below "
+                  f"{args.gate_overlap}: "
+                  + ", ".join(f"rank {p}={f:.3f}"
+                              for p, f in sorted(bad.items())),
+                  file=sys.stderr)
+            return 2
+        print(f"overlap gate passed: every rank >= {args.gate_overlap}")
     return 0
 
 
