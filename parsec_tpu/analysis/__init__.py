@@ -1,0 +1,69 @@
+"""Static analysis over the task-graph DSLs and the runtime itself.
+
+The reference PTG compiler front-loads a battery of sanity checks over
+the parsed JDF before emitting code (``jdf_sanity_checks``, jdf.c) —
+mismatched flow endpoints, unused symbols, unguardable dataflow are
+compile-time errors there, while our Python reproduction historically
+discovered every spec bug at runtime (usually as a hang or a wrong
+residual deep inside a multirank run).  This package is that missing
+compile-time story, plus two lints the reference never had:
+
+- :mod:`.ptg_check` — the JDF dataflow verifier: endpoint existence and
+  direction compatibility, arity, dependency reciprocity, unused
+  globals/locals, statically-unsatisfiable guards, and CTL/data cycle
+  detection by enumerating a small concrete instantiation (PTG1xx).
+- :mod:`.body_check` — the batch/donation-safety linter: predicts, from
+  the stdlib ``ast`` of PTG BODY code and DTD task functions, the
+  per-class fallbacks the device layer would otherwise hit at trace
+  time (``this_task`` reads, untraceable constructs, nondeterminism,
+  aliased same-tile args) and names the exact downgrade (BDY2xx).
+- :mod:`.lock_check` — the runtime concurrency lint: fields registered
+  in a module's ``_GUARDED_BY`` map may only be touched while holding
+  the declared lock, and no blocking call may run while holding an
+  engine/data lock (LCK3xx).
+
+``tools/parsec_lint.py`` drives all three over the shipped specs,
+examples, and the ``parsec_tpu/`` source tree; ``--strict`` turns any
+error/warn finding into a non-zero exit (the tier-1 self-lint gate).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List
+
+#: finding severities: ``error`` = the spec/source is wrong, ``warn`` =
+#: suspicious or performance-degrading (both fail ``--strict``);
+#: ``note`` = informational only (never fails a gate)
+SEVERITIES = ("error", "warn", "note")
+
+
+@dataclass
+class Finding:
+    """One analysis finding (the ``jdf_fatal``/``jdf_warn`` analog)."""
+
+    code: str          # e.g. "PTG105"
+    message: str
+    where: str = ""    # "file:line task.flow" when known
+    severity: str = "error"
+
+    def __post_init__(self) -> None:
+        assert self.severity in SEVERITIES, self.severity
+
+    def __str__(self) -> str:
+        loc = f"{self.where}: " if self.where else ""
+        return f"{self.code} [{self.severity}] {loc}{self.message}"
+
+
+def gate(findings: List["Finding"]) -> List["Finding"]:
+    """The findings that fail a ``--strict`` run (errors + warnings)."""
+    return [f for f in findings if f.severity in ("error", "warn")]
+
+
+from .ptg_check import verify_jdf, verify_jdf_text  # noqa: E402
+from .body_check import check_jdf_bodies, check_function  # noqa: E402
+from .lock_check import lint_source, lint_file, lint_tree  # noqa: E402
+
+__all__ = ["Finding", "gate", "SEVERITIES",
+           "verify_jdf", "verify_jdf_text",
+           "check_jdf_bodies", "check_function",
+           "lint_source", "lint_file", "lint_tree"]

@@ -79,6 +79,25 @@ _MAX_BATCH_MSGS = 256
 #: control stream must not stall an in-flight bulk transfer forever
 _CTRL_STREAK_MAX = 8
 
+#: declared lock discipline, enforced by the concurrency lint
+#: (parsec_tpu/analysis/lock_check.py): per-peer send queues belong to
+#: the peer's condition (writer thread vs. every sender), the peer map
+#: to the connection condition (accept thread vs. everyone), wire
+#: counters and barrier state to their dedicated locks.  The same lint
+#: verifies no socket send/recv or sleep ever runs while one of these
+#: is held — the writer drains OUTSIDE peer.cond by construction.
+_GUARDED_BY = {
+    "_Peer.ctrl": "cond",
+    "_Peer.bulk": "cond",
+    "_Peer.queued_bytes": "cond",
+    "TCPCommEngine._peers": "_conn_cond",
+    "TCPCommEngine.wire_stats": "_stat_lock",
+    "TCPCommEngine._rx_pending": "_stat_lock",
+    "TCPCommEngine._xfer_iter": "_stat_lock",
+    "TCPCommEngine._barrier_arrived": "_barrier_lock",
+    "TCPCommEngine._barrier_release": "_barrier_lock",
+}
+
 
 # RankFailedError moved to comm/engine.py (every transport raises it
 # now, not just this one); re-exported here for back-compat importers.
@@ -307,7 +326,8 @@ class TCPCommEngine(LocalCommEngine):
         """Send-side bandwidth EWMA toward ``peer`` in MB/s (None until
         a large-enough send has been measured). Feeds the adaptive
         eager/rendezvous cutoff (remote_dep) and the LINK_BW gauges."""
-        p = self._peers.get(peer)
+        with self._conn_cond:
+            p = self._peers.get(peer)
         return p.bw_mbps if p is not None else None
 
     def chunks_inflight(self) -> int:
@@ -318,7 +338,10 @@ class TCPCommEngine(LocalCommEngine):
         with self._conn_cond:
             peers = list(self._peers.values())
         for p in peers:
-            n += sum(1 for it in p.bulk if it[0] == "chunk")
+            # under p.cond: the writer mutates the deque concurrently,
+            # and iterating a mutating deque raises RuntimeError
+            with p.cond:
+                n += sum(1 for it in p.bulk if it[0] == "chunk")
         with self._stat_lock:
             n += sum(self._rx_pending.values())
         return n
@@ -341,7 +364,8 @@ class TCPCommEngine(LocalCommEngine):
         if self._ft_silenced or peer in self.dead_peers \
                 or peer in self.finished_peers:
             return False
-        p = self._peers.get(peer)
+        with self._conn_cond:
+            p = self._peers.get(peer)
         if p is None or not p.hb_ok or p.done:
             return False
         # probe frames bypass _transport_post, so consult the chaos
@@ -481,7 +505,7 @@ class TCPCommEngine(LocalCommEngine):
             raise RankFailedError(dst, "send to peer after its clean shutdown")
 
     def _backpressure_wait(self, peer: _Peer, dst: int,
-                           nbytes: int) -> None:
+                           nbytes: int) -> None:  # holds: peer.cond
         """Bounded send buffer (call with ``peer.cond`` held): block
         while the peer's queued bytes would exceed
         ``comm_send_buffer_bytes`` — the v1 synchronous-sendall
@@ -747,7 +771,8 @@ class TCPCommEngine(LocalCommEngine):
                 self._notify_arrival()
         elif kind == wire.K_HELLO:
             info = wire.parse_hello(body)
-            p = self._peers.get(peer)
+            with self._conn_cond:
+                p = self._peers.get(peer)
             if p is not None:
                 p.codec = wire.negotiate_codec(
                     self._codecs, info.get("codecs", ()))
@@ -761,7 +786,8 @@ class TCPCommEngine(LocalCommEngine):
             det = self.ft_detector
             if det is not None:
                 det.note_alive(peer)
-            p = self._peers.get(peer)
+            with self._conn_cond:
+                p = self._peers.get(peer)
             if p is not None and not p.done:
                 pong = wire.pack_ping(seq, t_ns, pong=True)
                 with p.cond:
@@ -799,7 +825,8 @@ class TCPCommEngine(LocalCommEngine):
                 or peer in self.finished_peers:
             return  # clean teardown (ours or theirs), or already reported
         self.dead_peers.add(peer)
-        p = self._peers.get(peer)
+        with self._conn_cond:
+            p = self._peers.get(peer)
         if p is not None:
             with p.cond:  # unblock anything parked on the writer
                 p.cond.notify_all()
@@ -933,7 +960,10 @@ class TCPCommEngine(LocalCommEngine):
             live = [p for p in live if p.writer.is_alive()]
             if not live:
                 break
-            cur = sum(len(p.ctrl) + len(p.bulk) for p in live)
+            cur = 0
+            for p in live:
+                with p.cond:
+                    cur += len(p.ctrl) + len(p.bulk)
             if prev is None or cur < prev:
                 prev = cur
                 stall = time.time() + 15.0

@@ -21,6 +21,15 @@ from typing import Any, Dict, List, Optional
 
 from ..core.object import Obj
 
+#: declared lock discipline, enforced by the concurrency lint
+#: (parsec_tpu/analysis/lock_check.py; tools/parsec_lint.py runs it):
+#: the copy map is read by worker, comm, and device threads while
+#: stage-in/eviction/writeback mutate it — every touch goes through
+#: Data._lock (construction and refcount-zero teardown are exempt)
+_GUARDED_BY = {
+    "Data._copies": "_lock",
+}
+
 
 def is_device_array(x: Any) -> bool:
     """A jax array (device-resident payload): stays on device through
@@ -255,6 +264,6 @@ def data_new_with_payload(payload: Any, device_id: int = 0, key: Any = None) -> 
     c = DataCopy(d, device_id, payload=payload)
     c.coherency = Coherency.OWNED
     c.version = 1
-    d._copies[device_id] = c
+    d.attach_copy(c)
     d.owner_device = device_id
     return d

@@ -38,6 +38,22 @@ from .device import Device
 
 _log = plog.device_stream
 
+#: declared lock discipline, enforced by the concurrency lint
+#: (parsec_tpu/analysis/lock_check.py): HBM accounting + both LRU lists
+#: belong to the memory lock (any worker stages in / prefetches while
+#: the manager evicts); the in-flight/window records belong to the
+#: manager lock (one manager at a time — the CAS-owner acquire in
+#: ``progress``; helpers on that path carry ``# holds:`` annotations)
+_GUARDED_BY = {
+    "JaxDevice.mem_used": "_mem_lock",
+    "JaxDevice.mem_highwater": "_mem_lock",
+    "JaxDevice._lru_clean": "_mem_lock",
+    "JaxDevice._lru_owned": "_mem_lock",
+    "JaxDevice._inflight": "_manager_lock",
+    "JaxDevice._window": "_manager_lock",
+    "JaxDevice._eager_done": "_manager_lock",
+}
+
 
 def _arr_device(arr: Any):
     """The single device committing ``arr``, or None (host / sharded)."""
@@ -358,7 +374,7 @@ class JaxDevice(Device):
             f"{len(out_flows)} written flows")
         self._finish_submit(es, task, est, list(outputs), out_flows)
 
-    def _finish_submit(self, es, task: Task, est: float,
+    def _finish_submit(self, es, task: Task, est: float,  # holds: self._manager_lock
                        outputs: List[Any], out_flows: List[int]) -> None:
         rec = _InFlight(task, outputs, out_flows, est)
         self.stats["tasks"] += 1
@@ -760,7 +776,7 @@ class JaxDevice(Device):
                 if not self._evict(copy, writeback=True):
                     self._lru_owned[key] = copy
 
-    def _evict(self, copy: DataCopy, writeback: bool) -> bool:
+    def _evict(self, copy: DataCopy, writeback: bool) -> bool:  # holds: self._mem_lock
         """Returns True when the copy was evicted (False: keep it listed)."""
         if copy.payload is None or copy.data is None:
             return True
@@ -848,7 +864,7 @@ class JaxDevice(Device):
         elif advice == "preferred_device":
             data.preferred_device = self.device_index
 
-    def fini(self) -> None:
+    def fini(self) -> None:  # lock: exempt(teardown: workers joined, managers quiesced)
         assert not self._inflight, "device finalized with in-flight tasks"
         for rec in self._window:
             self._retire(rec)  # teardown: must finalize every device

@@ -33,19 +33,40 @@ def c2py(expr: str) -> str:
 
 
 class Expr:
-    """One compiled expression evaluated against {globals+locals}."""
+    """One compiled expression evaluated against {globals+locals}.
 
-    __slots__ = ("src", "_code")
+    ``origin`` is the source context the parser threads through
+    (``file:line task.flow``); it becomes the compile filename, so both
+    compile-time SyntaxErrors and runtime tracebacks point at the JDF
+    line that wrote the expression instead of a truncated ``<jdf:...>``
+    tag."""
 
-    def __init__(self, src: str) -> None:
+    __slots__ = ("src", "origin", "_code")
+
+    def __init__(self, src: str, origin: Optional[str] = None) -> None:
         self.src = c2py(src)
+        self.origin = origin
         try:
-            self._code = compile(self.src, f"<jdf:{self.src[:40]}>", "eval")
+            self._code = compile(self.src, origin or f"<jdf:{self.src[:40]}>",
+                                 "eval")
         except SyntaxError as e:
-            raise SyntaxError(f"bad JDF expression {src!r}: {e}") from None
+            where = f"{origin}: " if origin else ""
+            raise SyntaxError(
+                f"{where}bad JDF expression {src!r}: {e}") from None
 
     def __call__(self, env: Dict[str, Any]) -> Any:
-        return eval(self._code, {"__builtins__": _SAFE_BUILTINS}, env)
+        try:
+            return eval(self._code, {"__builtins__": _SAFE_BUILTINS}, env)
+        except NameError as e:
+            # rewrap only when the name is missing from the expression's
+            # own eval frame (tb: __call__ -> eval'd code, nothing
+            # deeper); a NameError raised inside a helper the expression
+            # calls keeps its real traceback pointing at the helper
+            tb = e.__traceback__
+            if self.origin is None or tb is None or tb.tb_next is None \
+                    or tb.tb_next.tb_next is not None:
+                raise
+            raise NameError(f"{self.origin}: {e} in {self.src!r}") from None
 
     def __repr__(self) -> str:  # pragma: no cover
         return f"Expr({self.src!r})"
@@ -92,15 +113,16 @@ class RangeExpr:
         return range(lo, hi + (1 if st > 0 else -1), st)
 
     @staticmethod
-    def parse(src: str) -> "RangeExpr | Expr":
+    def parse(src: str, origin: Optional[str] = None) -> "RangeExpr | Expr":
         parts = split_top(src, "..")
         if len(parts) == 1:
-            return Expr(src)
+            return Expr(src, origin)
         if len(parts) == 2:
-            return RangeExpr(Expr(parts[0]), Expr(parts[1]))
+            return RangeExpr(Expr(parts[0], origin), Expr(parts[1], origin))
         if len(parts) == 3:
-            return RangeExpr(Expr(parts[0]), Expr(parts[1]), Expr(parts[2]))
-        raise SyntaxError(f"bad range: {src!r}")
+            return RangeExpr(Expr(parts[0], origin), Expr(parts[1], origin),
+                             Expr(parts[2], origin))
+        raise SyntaxError(f"{origin + ': ' if origin else ''}bad range: {src!r}")
 
 
 @dataclass
@@ -178,6 +200,9 @@ class BodyAST:
     properties: Dict[str, str] = field(default_factory=dict)
     # compiled lazily by the runtime
     _compiled: Any = None
+    # 1-based source line of the BODY keyword (0 = unknown): threaded by
+    # the parser so body lints report real spec lines
+    line: int = 0
 
     @property
     def device_type(self) -> str:

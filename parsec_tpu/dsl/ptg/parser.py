@@ -42,7 +42,11 @@ class JDFParseError(SyntaxError):
 
 
 def _strip_comments(text: str) -> str:
-    text = re.sub(r"/\*.*?\*/", "", text, flags=re.S)
+    # newline-preserving on block comments: indices into splitlines()
+    # stay 1:1 with the source, so Expr.origin and parse errors keep
+    # reporting true line numbers past a multi-line /* ... */
+    text = re.sub(r"/\*.*?\*/", lambda m: "\n" * m.group(0).count("\n"),
+                  text, flags=re.S)
     out = []
     for line in text.splitlines():
         # '//' comments (avoid cutting inside strings - JDF rarely has them)
@@ -60,7 +64,9 @@ def parse_jdf(text: str, name: str = "jdf") -> JDFFile:
     #    epilogue), in source order
     externs: List[Tuple[int, str]] = [(m.start(), m.group(1))
                                       for m in _RE_EXTERN.finditer(text)]
-    body_text = _RE_EXTERN.sub("", text)
+    # blank externs out line-preservingly: indices into ``lines`` stay
+    # 1:1 with the source text, so diagnostics report true line numbers
+    body_text = _RE_EXTERN.sub(lambda m: "\n" * m.group(0).count("\n"), text)
     body_text = _strip_comments(body_text)
 
     lines = body_text.splitlines()
@@ -114,7 +120,7 @@ def parse_jdf(text: str, name: str = "jdf") -> JDFFile:
             properties=parse_properties(m.group(3) or ""))
         jdf.task_classes.append(tc)
         i += 1
-        i = _parse_task_body(lines, i, tc)
+        i = _parse_task_body(lines, i, tc, name)
 
     _check(jdf)
     return jdf
@@ -133,7 +139,8 @@ def _looks_like_task_start(lines: List[str], i: int) -> bool:
     return False
 
 
-def _parse_task_body(lines: List[str], i: int, tc: TaskClassAST) -> int:
+def _parse_task_body(lines: List[str], i: int, tc: TaskClassAST,
+                     fname: str = "jdf") -> int:
     n = len(lines)
     seen_affinity = False
     while i < n:
@@ -146,6 +153,7 @@ def _parse_task_body(lines: List[str], i: int, tc: TaskClassAST) -> int:
         if line == "BODY" or (line.startswith("BODY") and
                               line[4:].lstrip().startswith("[")):
             props = parse_properties(line[4:]) if len(line) > 4 else {}
+            body_line = i + 1
             i += 1
             code_lines: List[str] = []
             while i < n and lines[i].strip() != "END":
@@ -156,7 +164,7 @@ def _parse_task_body(lines: List[str], i: int, tc: TaskClassAST) -> int:
                 raise JDFParseError(f"{tc.name}: BODY without END")
             i += 1  # consume END
             tc.bodies.append(BodyAST(code=_strip_braces("\n".join(code_lines)),
-                                     properties=props))
+                                     properties=props, line=body_line))
             # after the (last) body, the class may end; another header or
             # body may follow — loop handles both
             if i < n and _is_next_task_header(lines, i):
@@ -171,13 +179,15 @@ def _parse_task_body(lines: List[str], i: int, tc: TaskClassAST) -> int:
             if not m:
                 raise JDFParseError(f"{tc.name}: bad affinity {line!r}")
             tc.affinity_collection = m.group(1)
-            tc.affinity_args = [Expr(a) for a in split_top(m.group(2), ",") if a.strip()]
+            origin = f"{fname}:{i+1} {tc.name}"
+            tc.affinity_args = [Expr(a, origin)
+                                for a in split_top(m.group(2), ",") if a.strip()]
             seen_affinity = True
             i += 1
             continue
         # priority annotation ``; expr``
         if line.startswith(";"):
-            tc.priority = Expr(line[1:])
+            tc.priority = Expr(line[1:], f"{fname}:{i+1} {tc.name}")
             i += 1
             continue
         # flow (may span lines: continuation lines start with <- or ->)
@@ -186,25 +196,26 @@ def _parse_task_body(lines: List[str], i: int, tc: TaskClassAST) -> int:
             flow = FlowAST(name=fm.group(2), access=fm.group(1))
             tc.flows.append(flow)
             rest = fm.group(3).strip()
-            dep_srcs: List[str] = []
+            dep_srcs: List[Tuple[str, int]] = []
             if rest:
-                dep_srcs.extend(_split_deps(rest))
+                dep_srcs.extend((d, i + 1) for d in _split_deps(rest))
             i += 1
             while i < n:
                 nxt = lines[i].strip()
                 if nxt.startswith("<-") or nxt.startswith("->"):
-                    dep_srcs.extend(_split_deps(nxt))
+                    dep_srcs.extend((d, i + 1) for d in _split_deps(nxt))
                     i += 1
                 else:
                     break
-            for ds in dep_srcs:
-                flow.deps.append(_parse_dep(ds, tc))
+            for ds, ln in dep_srcs:
+                flow.deps.append(_parse_dep(
+                    ds, tc, f"{fname}:{ln} {tc.name}.{flow.name}"))
             continue
         # local definition (range or derived)
         lm = _RE_LOCAL.match(line)
         if lm and not seen_affinity and not tc.flows:
             name, rhs = lm.group(1), lm.group(2).strip()
-            rng = RangeExpr.parse(rhs)
+            rng = RangeExpr.parse(rhs, f"{fname}:{i+1} {tc.name}")
             if isinstance(rng, RangeExpr):
                 tc.locals.append(LocalDef(name, rng))
             else:
@@ -237,7 +248,8 @@ def _split_deps(src: str) -> List[str]:
     return [c.strip() for c in out if c.strip() not in ("<-", "->")]
 
 
-def _parse_dep(src: str, tc: TaskClassAST) -> DepAST:
+def _parse_dep(src: str, tc: TaskClassAST,
+               origin: Optional[str] = None) -> DepAST:
     direction = "in" if src.startswith("<-") else "out"
     body = src[2:].strip()
     # trailing property list [type=...]; quoted values may contain
@@ -252,21 +264,22 @@ def _parse_dep(src: str, tc: TaskClassAST) -> DepAST:
     alt = None
     qparts = split_top(body, "?")
     if len(qparts) == 2:
-        guard = Expr(qparts[0])
+        guard = Expr(qparts[0], origin)
         rest = qparts[1]
         cparts = split_top(rest, ":")
         if len(cparts) == 2:
-            target = _parse_target(cparts[0], tc)
-            alt = _parse_target(cparts[1], tc)
+            target = _parse_target(cparts[0], tc, origin)
+            alt = _parse_target(cparts[1], tc, origin)
         else:
-            target = _parse_target(rest, tc)
+            target = _parse_target(rest, tc, origin)
     else:
-        target = _parse_target(body, tc)
+        target = _parse_target(body, tc, origin)
     return DepAST(direction=direction, guard=guard, target=target,
                   alt_target=alt, properties=props)
 
 
-def _parse_target(src: str, tc: TaskClassAST) -> DepTarget:
+def _parse_target(src: str, tc: TaskClassAST,
+                  origin: Optional[str] = None) -> DepTarget:
     src = src.strip()
     if src.upper() == "NULL":
         return DepTarget(kind="null")
@@ -275,14 +288,17 @@ def _parse_target(src: str, tc: TaskClassAST) -> DepTarget:
     # ``FLOW Class( args )`` (task) or ``collection( args )`` (memory)
     m = re.match(r"^([A-Za-z_]\w*)\s+([A-Za-z_]\w*)\s*\((.*)\)\s*$", src, re.S)
     if m:
-        args = [RangeExpr.parse(a) for a in split_top(m.group(3), ",") if a.strip()]
+        args = [RangeExpr.parse(a, origin)
+                for a in split_top(m.group(3), ",") if a.strip()]
         return DepTarget(kind="task", flow=m.group(1), task_class=m.group(2),
                          args=args)
     m = re.match(r"^([A-Za-z_]\w*)\s*\((.*)\)\s*$", src, re.S)
     if m:
-        args = [RangeExpr.parse(a) for a in split_top(m.group(2), ",") if a.strip()]
+        args = [RangeExpr.parse(a, origin)
+                for a in split_top(m.group(2), ",") if a.strip()]
         return DepTarget(kind="memory", collection=m.group(1), args=args)
-    raise JDFParseError(f"{tc.name}: bad dependency target {src!r}")
+    raise JDFParseError(
+        f"{origin or tc.name}: bad dependency target {src!r}")
 
 
 def _strip_braces(code: str) -> str:

@@ -26,7 +26,6 @@ descA [ type="collection" ]
 MT [ type="int" ]
 NT [ type="int" ]
 KT [ type="int" ]
-NB [ type="int" ]
 
 GEQRT(k)
 
@@ -134,7 +133,7 @@ def dgeqrf_taskpool(A: TiledMatrix, rank: int = 0, nb_ranks: int = 1):
         raise ValueError(
             f"dgeqrf needs square diagonal tiles; got mb={A.mb} nb={A.nb}, "
             f"trailing diagonal tile {last_rows}x{last_cols}")
-    tp = dgeqrf_factory().new(descA=A, MT=A.mt, NT=A.nt, KT=kt, NB=A.nb,
+    tp = dgeqrf_factory().new(descA=A, MT=A.mt, NT=A.nt, KT=kt,
                               rank=rank, nb_ranks=nb_ranks)
     tp.global_env["ops"] = ops_module
     return tp

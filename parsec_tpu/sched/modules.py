@@ -27,6 +27,16 @@ from ..core.hbbuffer import HBBuffer
 from ..core.lists import Dequeue, Lifo, OrderedList
 from .base import SchedulerModule
 
+#: declared lock discipline, enforced by the concurrency lint
+#: (parsec_tpu/analysis/lock_check.py): rnd's global list is the one
+#: bare-Python shared queue here (every other policy rides the
+#: internally-synchronized containers from core/lists.py) — schedule,
+#: select, and the obs pending_tasks gauge all mutate/read it under the
+#: module's lock
+_GUARDED_BY = {
+    "RNDScheduler._items": "_lock",
+}
+
 
 def _prio(t) -> int:
     return t.priority
@@ -350,7 +360,7 @@ class RNDScheduler(SchedulerModule):
 
     def install(self, context) -> None:
         super().install(context)
-        self._items: List = []
+        self._items: List = []   # lock: install runs before workers start
         import threading
         self._lock = threading.Lock()
 

@@ -19,6 +19,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import pytest  # noqa: E402
 
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 quick run (-m 'not slow')")
+
 # the axon plugin shadows JAX_PLATFORMS=cpu: pin eager computation to the
 # virtual CPU devices and full matmul precision so references match
 import jax  # noqa: E402

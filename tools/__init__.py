@@ -1,0 +1,3 @@
+"""Repo tooling scripts.  This package marker makes them importable
+(``from tools import dagenum``) when the repo root is on sys.path — the
+static verifier's cycle pass reuses dagenum's enumeration core."""

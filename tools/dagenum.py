@@ -52,6 +52,32 @@ class _DummyCollection(DataCollection):
         raise RuntimeError("dagenum never materializes data")
 
 
+def enumerate_factory(factory, env: dict, mt: int = 4, nt: int = 4):
+    """Enumerate a compiled JDF factory's instance DAG without executing
+    it: bind ``env`` globals (declared collection globals not in ``env``
+    get dummy mt x nt holders), instantiate, and run the capture
+    planner's symbolic dep resolution.  Returns ``(tp, order)`` where
+    ``order`` is the topologically-sorted instance list (each with
+    resolved ``preds``).  Raises ``CaptureError`` on a dependency cycle
+    — the importable core behind this script, reused by the static
+    verifier's cycle pass (parsec_tpu/analysis/ptg_check.py)."""
+    env = dict(env)
+    # bind every declared collection global not supplied to a dummy
+    for g in factory.jdf.globals:
+        if g.name not in env and g.properties.get("type") == "collection":
+            env[g.name] = _DummyCollection(mt, nt)
+    tp = factory.new(**env)
+    from parsec_tpu.dsl.ptg.capture import plan
+    return tp, plan(tp)
+
+
+def enumerate_text(text: str, env: dict, mt: int = 4, nt: int = 4,
+                   name: str = "jdf"):
+    """``enumerate_factory`` over raw JDF source text."""
+    from parsec_tpu.dsl import ptg
+    return enumerate_factory(ptg.compile_jdf(text, name=name), env, mt, nt)
+
+
 def enumerate_dag(jdf_path: str, globals_kv, mt: int, nt: int):
     from parsec_tpu.dsl import ptg
 
@@ -62,13 +88,7 @@ def enumerate_dag(jdf_path: str, globals_kv, mt: int, nt: int):
             env[name] = int(val)
         except ValueError:
             env[name] = val
-    # bind every declared collection global to a dummy
-    for g in factory.jdf.globals:
-        if g.properties.get("type") == "collection" and g.name not in env:
-            env[g.name] = _DummyCollection(mt, nt)
-    tp = factory.new(**env)
-    from parsec_tpu.dsl.ptg.capture import plan
-    return tp, plan(tp)
+    return enumerate_factory(factory, env, mt, nt)
 
 
 def main(argv=None) -> int:

@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""parsec_lint — static analysis over JDF specs, DTD bodies, and the
+parsec_tpu runtime source (the parsec_ptgpp sanity-check battery, run
+as a linter; see parsec_tpu/analysis/).
+
+Three passes:
+
+1. PTG/JDF dataflow verification (PTG1xx) over every ``*_JDF`` string
+   constant found in the target files — endpoint existence/direction,
+   arity, dependency reciprocity, unused globals/locals, unsatisfiable
+   guards, and cycle detection by enumerating a small concrete
+   instantiation (tools/dagenum.py).
+2. Batch/donation-safety lint (BDY2xx) over the same specs' accelerator
+   BODY code — predicts the device layer's per-class trace-time
+   downgrades (this_task, untraceable constructs, nondeterminism,
+   aliased tiles) before the first run.
+3. Concurrency lint (LCK3xx) over modules declaring a ``_GUARDED_BY``
+   map — guarded fields only under their lock, no blocking calls while
+   holding an engine/data lock.
+
+Default targets: parsec_tpu/ops, examples/ (spec passes) and
+parsec_tpu/ (concurrency pass).  ``--strict`` exits non-zero on any
+error/warn finding — the tier-1 self-lint gate (tests/test_analysis.py)
+runs exactly that over the repo.
+
+    python tools/parsec_lint.py --strict
+    python tools/parsec_lint.py path/to/specs.py --no-cycles
+"""
+from __future__ import annotations
+
+import argparse
+import ast as pyast
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+from parsec_tpu.analysis import Finding, gate  # noqa: E402
+from parsec_tpu.analysis import body_check, lock_check, ptg_check  # noqa: E402
+
+
+def find_jdf_specs(path: str) -> List[Tuple[str, int, str]]:
+    """Module-level ``NAME_JDF = \"...\"`` string constants in a .py
+    file: [(spec_name, assign_lineno, text)]."""
+    with open(path) as fh:
+        src = fh.read()
+    try:
+        tree = pyast.parse(src)
+    except SyntaxError:
+        return []
+    out = []
+    for node in tree.body:
+        if not isinstance(node, pyast.Assign) or len(node.targets) != 1:
+            continue
+        t = node.targets[0]
+        if not (isinstance(t, pyast.Name) and t.id.endswith("_JDF")):
+            continue
+        if isinstance(node.value, pyast.Constant) and \
+                isinstance(node.value.value, str):
+            out.append((t.id, node.value.lineno, node.value.value))
+    return out
+
+
+def lint_spec_text(text: str, name: str,
+                   enum_env: Optional[Dict[str, Any]] = None,
+                   cycles: bool = True) -> List[Finding]:
+    """All spec passes over one JDF text: dataflow verification, body
+    lint, cycle enumeration.  The text is parsed once and the AST shared
+    across every pass."""
+    from parsec_tpu.dsl.ptg.parser import JDFParseError, parse_jdf
+    try:
+        jdf = parse_jdf(text, name=name)
+    except (JDFParseError, SyntaxError):
+        # unparseable: verify_jdf_text re-parses only to classify the
+        # failure into a PTG100/PTG101 finding (rare error path)
+        return ptg_check.verify_jdf_text(text, name=name,
+                                         enum_env=enum_env, cycles=cycles)
+    findings = ptg_check.verify_jdf_text(text, name=name, enum_env=enum_env,
+                                         cycles=cycles, jdf=jdf)
+    findings.extend(body_check.check_jdf_bodies(jdf, name=name))
+    return findings
+
+
+def lint_spec_file(path: str, cycles: bool = True) -> List[Finding]:
+    findings: List[Finding] = []
+    rel = os.path.relpath(path, _ROOT)
+    for spec_name, lineno, text in find_jdf_specs(path):
+        # pad so Expr origins ("file:line task.flow") carry TRUE file
+        # line numbers: string line k sits at file line (lineno - 1 + k)
+        padded = "\n" * (lineno - 1) + text
+        findings.extend(lint_spec_text(padded, name=rel, cycles=cycles))
+    return findings
+
+
+#: default spec targets relative to the repo root
+SPEC_DIRS = (os.path.join("parsec_tpu", "ops"), "examples")
+#: default concurrency-lint target
+SOURCE_DIR = "parsec_tpu"
+
+
+def default_spec_files() -> List[str]:
+    files: List[str] = []
+    for d in SPEC_DIRS:
+        full = os.path.join(_ROOT, d)
+        if not os.path.isdir(full):
+            continue
+        for fn in sorted(os.listdir(full)):
+            if fn.endswith(".py"):
+                files.append(os.path.join(full, fn))
+    return files
+
+
+def run(paths: List[str], cycles: bool = True,
+        locks: bool = True) -> List[Finding]:
+    findings: List[Finding] = []
+    spec_files: List[str] = []
+    lock_targets: List[str] = []
+    if paths:
+        for p in paths:
+            if os.path.isdir(p):
+                lock_targets.append(p)
+                for dirpath, dirnames, filenames in os.walk(p):
+                    dirnames[:] = [d for d in dirnames
+                                   if d != "__pycache__"]
+                    spec_files.extend(os.path.join(dirpath, f)
+                                      for f in sorted(filenames)
+                                      if f.endswith(".py"))
+            else:
+                spec_files.append(p)
+                lock_targets.append(p)
+    else:
+        spec_files = default_spec_files()
+        lock_targets = [os.path.join(_ROOT, SOURCE_DIR)]
+    for f in spec_files:
+        findings.extend(lint_spec_file(f, cycles=cycles))
+    if locks:
+        for t in lock_targets:
+            if os.path.isdir(t):
+                findings.extend(lock_check.lint_tree(t))
+            elif t.endswith(".py"):
+                lf = lock_check.lint_file(t)
+                # avoid double-reporting files passed once
+                findings.extend(x for x in lf if x not in findings)
+    return findings
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="static analysis over JDF specs and parsec_tpu source")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: shipped specs, "
+                         "examples, and parsec_tpu/)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit non-zero on any error/warn finding")
+    ap.add_argument("--no-cycles", action="store_true",
+                    help="skip the (slower) cycle-enumeration pass")
+    ap.add_argument("--no-locks", action="store_true",
+                    help="skip the concurrency lint")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="findings only, no summary")
+    args = ap.parse_args(argv)
+
+    findings = run(args.paths, cycles=not args.no_cycles,
+                   locks=not args.no_locks)
+    for f in findings:
+        print(f)
+    gating = gate(findings)
+    if not args.quiet:
+        notes = len(findings) - len(gating)
+        print(f"parsec_lint: {len(gating)} finding(s)"
+              + (f", {notes} note(s)" if notes else "")
+              + (" [strict]" if args.strict else ""))
+    return 1 if (args.strict and gating) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
