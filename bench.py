@@ -38,6 +38,10 @@ Execution modes (BENCH_MODE):
   remote-GET prefetch + critical-path priorities) vs OFF — reports
   each leg's wall, the live OVERLAP_FRACTION gauge, and bit-exactness
   across legs.
+- ``elastic``: elastic grid recovery — cross-grid reshard-restore
+  throughput (4-writer snapshot onto a 2-rank grid), and the 3-rank
+  kill-mid-dpotrf shrink-recovery wall vs the failure-free run
+  (detection + agreement + reshard + replay, no operator in the loop).
 
 Knobs (env): BENCH_N (default 8192), BENCH_NB (2048), BENCH_DTYPE
 (float32), BENCH_REPS (3, best-of), BENCH_CORES (runtime mode worker
@@ -1007,6 +1011,107 @@ def bench_ft(reps=3, interval=0.01, timeout=0.15):
     return out
 
 
+def bench_elastic(reps=3, n=512, nb=64):
+    """Elastic grid recovery (ISSUE 9). Two probes.
+
+    (1) Reshard throughput: a 4-writer snapshot reshard-restored onto a
+    2-rank in-process grid through ``collections/redistribute`` — the
+    cross-grid restore wall and MB/s, best of ``reps``.
+    (2) Shrink recovery: the ex13 scenario inline — 3-rank checkpointed
+    dpotrf, rank 2 chaos-killed, ``ft_elastic=shrink`` — total wall vs
+    the failure-free run on the same grid; the delta is detection +
+    agreement + reshard + replay, the price of losing a rank with no
+    operator in the loop."""
+    import tempfile
+
+    import parsec_tpu
+    from parsec_tpu.collections import TwoDimBlockCyclic
+    from parsec_tpu.comm import RemoteDepEngine
+    from parsec_tpu.utils import checkpoint as ckpt
+    from parsec_tpu.utils.params import params as _params
+    from parsec_tpu.utils.spmd import spmd_threads
+
+    out = {}
+    M = np.arange(n * n, dtype=np.float32).reshape(n, n) / n
+
+    def dist(rank, nodes, P, Q):
+        d = TwoDimBlockCyclic(n, n, nb, nb, P=P, Q=Q, nodes=nodes,
+                              rank=rank, dtype=np.float32)
+        d.name = "descA"
+        for (i, j) in d.local_tiles():
+            np.copyto(d.tile(i, j),
+                      M[i * nb:(i + 1) * nb, j * nb:(j + 1) * nb])
+        return d
+
+    with tempfile.TemporaryDirectory() as td:
+        prefix = os.path.join(td, "snap.c0")
+        res, _ = spmd_threads(
+            4, lambda r, f: bool(ckpt.save_collection(dist(r, 4, 4, 1),
+                                                      prefix)))
+        assert all(res)
+
+        def restore_rank(rank, fabric):
+            eng = RemoteDepEngine(fabric.engine(rank))
+            ctx = parsec_tpu.Context(nb_cores=1, comm=eng,
+                                     enable_tpu=False)
+            try:
+                d = TwoDimBlockCyclic(n, n, nb, nb, P=2, Q=1, nodes=2,
+                                      rank=rank, dtype=np.float32)
+                d.name = "descA"
+                t0 = time.perf_counter()
+                ckpt.restore_collection(d, prefix, reshard=True,
+                                        context=ctx)
+                return time.perf_counter() - t0
+            finally:
+                ctx.fini()
+
+        best = None
+        for _ in range(reps):
+            res, _ = spmd_threads(2, restore_rank)
+            wall = max(res)
+            best = wall if best is None else min(best, wall)
+        out["elastic_reshard_wall_ms"] = round(best * 1e3, 2)
+        out["elastic_reshard_mb_s"] = round(
+            n * n * 4 / best / 1e6, 1)
+
+    # shrink recovery: the ex13 scenario inline, chaos vs failure-free
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "examples"))
+    import ex13_elastic_shrink as ex13
+
+    def scenario(inject):
+        with tempfile.TemporaryDirectory() as td:
+            t0 = time.perf_counter()
+            results, _ = spmd_threads(
+                ex13.NB_RANKS,
+                lambda r, f: ex13.run_rank(
+                    r, f, ex13.make_spd(ex13.N), os.path.join(td, "ck")),
+                timeout=600)
+            wall = time.perf_counter() - t0
+        ok = [r for r, o in enumerate(results) if o[0] == "ok"]
+        es = results[ok[0]][3]
+        return wall, ok, results[ok[0]][2], es
+
+    _params.set_cmdline("ft_heartbeat_interval", "0.05")
+    _params.set_cmdline("ft_heartbeat_timeout", "3.0")
+    _params.set_cmdline("ft_elastic", "shrink")
+    try:
+        _params.set_cmdline("ft_inject", "")
+        t_clean, ok, _, _ = scenario(False)
+        assert ok == [0, 1, 2], ok
+        _params.set_cmdline("ft_inject", "kill:rank=2:after=4")
+        t_chaos, ok, stats, es = scenario(True)
+        assert ok == [0, 1] and stats["grid"] == (0, 1), (ok, stats)
+        assert es["elastic_resizes"] == 1 and es["reshard_bytes"] > 0, es
+    finally:
+        _params.reset()
+    out["elastic_dpotrf_clean_s"] = round(t_clean, 3)
+    out["elastic_dpotrf_shrink_s"] = round(t_chaos, 3)
+    out["elastic_shrink_recovery_s"] = round(t_chaos - t_clean, 3)
+    out["elastic_reshard_bytes"] = es["reshard_bytes"]
+    return out
+
+
 def bench_mesh_inner(burst=64, nb=96, reps=3, shape="2x2") -> dict:
     """Sharded vs single-chip batched dispatch (ISSUE 6): the same
     same-class DTD burst through the classic runtime's device module,
@@ -1451,6 +1556,13 @@ def main() -> None:
             "metric": "ft_detection_latency_ms(loopback_tcp,hb_10ms)",
             "value": extras["ft_detection_latency_ms"],
             "unit": "ms", "extras": extras}))
+        return
+    if mode == "elastic":
+        extras = bench_elastic(reps=reps)
+        print(json.dumps({
+            "metric": "elastic_shrink_recovery_s(3-rank_dpotrf,kill)",
+            "value": extras["elastic_shrink_recovery_s"],
+            "unit": "s", "extras": extras}))
         return
     if mode == "mesh":
         extras = bench_mesh(

@@ -1,0 +1,155 @@
+"""Ex13: elastic grid recovery — 3-rank checkpointed dpotrf that
+SURVIVES losing a rank (ISSUE 9).
+
+Three in-process ranks factor an SPD matrix under
+``ft.run_with_restart`` with snapshots every stage. Run it under
+``tools/chaos_run.py --inject "kill:rank=2:after=4"`` with
+``--mca ft_elastic shrink`` (env ``PARSEC_MCA_ft_elastic=shrink``) and
+the survivors agree on a 2-rank grid, reshard the last snapshot onto it
+over the DTD data plane, and finish the factorization — no operator in
+the loop. Without ``ft_elastic`` the same kill keeps today's fail-fast
+contract: every survivor aborts with ``RankFailedError`` and the
+process exits non-zero on a consistent snapshot set.
+
+Exit status: 0 = the factor verified against numpy on whatever grid the
+run ended with; non-zero = aborted (the strict path, or an injected
+fault elastic mode could not absorb).
+
+Run::
+
+    # elastic: completes on the shrunk grid, exit 0
+    PARSEC_MCA_ft_elastic=shrink python tools/chaos_run.py \\
+        --inject "kill:rank=2:after=4" --heartbeat 0.05 --timeout 2 -- \\
+        examples/ex13_elastic_shrink.py
+
+    # strict: same kill dead-ends loudly, exit 1
+    python tools/chaos_run.py --inject "kill:rank=2:after=4" \\
+        --heartbeat 0.05 --timeout 2 -- examples/ex13_elastic_shrink.py
+"""
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import parsec_tpu  # noqa: E402
+from parsec_tpu.comm import RemoteDepEngine  # noqa: E402
+from parsec_tpu.ft import (ElasticPolicy, RestartPolicy,  # noqa: E402
+                           run_with_restart)
+from parsec_tpu.ft.elastic import GridSpec, plan_grid  # noqa: E402
+from parsec_tpu.ops import dpotrf_taskpool, make_spd  # noqa: E402
+from parsec_tpu.utils.spmd import spmd_threads  # noqa: E402
+
+NB_RANKS, N, NB = 3, 256, 32
+
+
+def _establish_all(ctx, eng, nb_ranks, rank):
+    """Heartbeat contact with every peer before the workload (the
+    steady state a long-running job is in when a rank dies)."""
+    det = ctx._ft_detector
+    if det is None:
+        return
+    deadline = time.monotonic() + 30.0
+    while any(not det.is_established(p)
+              for p in range(nb_ranks) if p != rank):
+        assert time.monotonic() < deadline, "heartbeat never established"
+        eng.ce.progress()
+        time.sleep(0.002)
+    eng.ce.sync()
+
+
+def run_rank(rank, fabric, M, prefix):
+    eng = RemoteDepEngine(fabric.engine(rank))
+    ctx = parsec_tpu.Context(nb_cores=1, comm=eng, enable_tpu=False)
+    try:
+        def rebuild(grid: GridSpec):
+            A = grid.collection(N, N, NB, NB, dtype=np.float32)
+            A.name = "descA"
+            for (i, j) in A.local_tiles():
+                np.copyto(A.tile(i, j),
+                          M[i * NB:(i + 1) * NB, j * NB:(j + 1) * NB])
+            stages = [lambda: dpotrf_taskpool(A, rank=rank,
+                                              nb_ranks=NB_RANKS)]
+            return stages, [A]
+
+        _establish_all(ctx, eng, NB_RANKS, rank)
+        policy = RestartPolicy("restart", retries=1, every=1)
+        pol = ElasticPolicy(rebuild)
+        try:
+            if pol.mode:
+                stats = run_with_restart(ctx, None, None, prefix,
+                                         policy=policy, elastic=pol)
+                grid = plan_grid(stats["grid"], NB_RANKS, rank)
+                _, (A,) = rebuild(grid)  # same layout the run ended on
+                # rebuild reinitialized tiles: pull the FINAL state back
+                from parsec_tpu.utils import checkpoint as ckpt
+                ckpt.restore_collection(
+                    A, f"{prefix}.stage{stats['stages']}.c0",
+                    reshard=True, context=ctx)
+            else:
+                stages, (A,) = rebuild(plan_grid(
+                    tuple(range(NB_RANKS)), NB_RANKS, rank))
+                stats = run_with_restart(ctx, stages, [A], prefix,
+                                         policy=policy)
+            local = {t: np.array(A.tile(*t)) for t in A.local_tiles()
+                     if A.rank_of(*t) == rank}
+            return ("ok", local, stats, dict(eng.ce.elastic_stats))
+        except RuntimeError as e:
+            root = e.__cause__ or e
+            return (type(root).__name__, None, None,
+                    dict(eng.ce.elastic_stats))
+    finally:
+        ctx.clear_task_errors()
+        ctx.fini()
+
+
+def main() -> int:
+    M = make_spd(N)
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "ck")
+        results, _ = spmd_threads(
+            NB_RANKS, lambda r, f: run_rank(r, f, M, prefix), timeout=600)
+
+    ok = [r for r, out in enumerate(results) if out[0] == "ok"]
+    dead = [r for r, out in enumerate(results) if out[0] != "ok"]
+    for r, out in enumerate(results):
+        es = out[3] if out[3] else {}
+        print(f"rank {r}: {out[0]} stats={out[2]} "
+              f"ELASTIC_RESIZES={es.get('elastic_resizes', 0)} "
+              f"RESHARD_BYTES={es.get('reshard_bytes', 0)}")
+    if not ok:
+        print("ex13: every rank aborted")
+        return 1
+
+    # the completed ranks must agree on the final grid and hold ALL
+    # tiles of a verifiable Cholesky factor between them
+    grids = {results[r][2]["grid"] for r in ok}
+    if len(grids) != 1:
+        print(f"ex13: completed ranks disagree on the final grid: {grids}")
+        return 1
+    (grid,) = grids
+    if grid is None:               # strict path reports no grid
+        grid = tuple(range(NB_RANKS))
+    if set(grid) != set(ok):
+        print(f"ex13: final grid {grid} != completed ranks {ok}")
+        return 1
+    L = np.zeros_like(M)
+    for r in ok:
+        for (i, j), tile in results[r][1].items():
+            L[i * NB:(i + 1) * NB, j * NB:(j + 1) * NB] = tile
+    L = np.tril(L)
+    resid = (np.abs(L @ L.T - M).max()
+             / (np.abs(M).max() * N))
+    print(f"ex13: dpotrf n={N} nb={NB} finished on grid {grid} "
+          f"(lost: {dead}); residual {resid:.2e}")
+    if resid >= 1e-5:
+        print("ex13: residual above the dpotrf gate")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -64,13 +64,31 @@ def _copy_tile(es, task) -> None:
     tgt[:, :] = src
 
 
+def _whole_matrix_applicable(source: TiledMatrix, target: TiledMatrix,
+                             size_row: int, size_col: int,
+                             disi_Y: int, disj_Y: int,
+                             disi_T: int, disj_T: int) -> bool:
+    """Whole-matrix same-tile-grid case: zero offsets, full extent,
+    identical tiling on both ends. Unlike the aligned-subregion
+    precondition above, this holds even with ragged edge tiles
+    (``lm % mb != 0``) — every target tile still maps 1:1 to one
+    equal-shape source tile, so it rides the reshuffle path.  This is
+    the cross-grid checkpoint-reshard shape (ft/elastic.py): geometry
+    is immutable across snapshots, only the distribution moves."""
+    return (source.mb == target.mb and source.nb == target.nb
+            and source.lm == target.lm and source.ln == target.ln
+            and disi_Y == disj_Y == disi_T == disj_T == 0
+            and size_row == source.lm and size_col == source.ln)
+
+
 def redistribute(source: TiledMatrix, target: TiledMatrix,
                  size_row: int, size_col: int,
                  disi_Y: int = 0, disj_Y: int = 0,
                  disi_T: int = 0, disj_T: int = 0,
                  context: Any = None,
                  taskpool: Optional[Any] = None,
-                 allow_reshuffle: bool = True) -> Any:
+                 allow_reshuffle: bool = True,
+                 tiles: Optional[Any] = None) -> Any:
     """Copy source[disi_Y:disi_Y+size_row, disj_Y:disj_Y+size_col] into
     target[disi_T:..., disj_T:...] across distributions.
 
@@ -91,6 +109,16 @@ def redistribute(source: TiledMatrix, target: TiledMatrix,
     1:1 permutation structure, which the static :func:`redistribute_ptg`
     graph builds on. ``allow_reshuffle=False`` forces the general
     fragment path (used by the equivalence tests).
+
+    ``tiles`` (an iterable of target (m, n) coords) restricts the walk
+    to an explicit tile set — required for triangular-storage
+    collections whose off-storage tiles must never be touched, and only
+    supported on the whole-matrix same-grid reshuffle shape (the
+    checkpoint-reshard path, ft/elastic.py). The built taskpool is
+    stamped with ``redist_bytes`` — the GLOBAL payload volume of the
+    inserted plan (identical on every rank: insertion is SPMD) — an
+    observable distinct from the per-rank landed bytes the
+    ``FT::RESHARD_BYTES`` gauge reports.
     """
     assert disi_Y + size_row <= source.lm and disj_Y + size_col <= source.ln, \
         "source region out of bounds"
@@ -117,6 +145,31 @@ def redistribute(source: TiledMatrix, target: TiledMatrix,
         target.name = f"redist{seq}_T"
     assert source.name != target.name, \
         "source and target collections need distinct .name values"
+    if not hasattr(tp, "redist_bytes"):
+        tp.redist_bytes = 0
+    itemsize = np.dtype(target.dtype).itemsize
+
+    if allow_reshuffle and _whole_matrix_applicable(
+            source, target, size_row, size_col,
+            disi_Y, disj_Y, disi_T, disj_T):
+        for (m, n) in (tiles if tiles is not None else target.tiles()):
+            tm, tn = target.tile_shape(m, n)
+            tp.insert_task(
+                _copy_tile,
+                (tp.tile_of(target, (m, n)), INOUT | AFFINITY),
+                (tp.tile_of(source, (m, n)), INPUT),
+                name=f"reshuffle({m},{n})<-({m},{n})")
+            tp.redist_bytes += tm * tn * itemsize
+        if own:
+            tp.data_flush_all()
+            if context is not None:
+                tp.wait()
+        return tp
+    if tiles is not None:
+        raise ValueError(
+            "redistribute(tiles=...) restricts the whole-matrix "
+            "same-grid reshuffle walk only; the sub-region and "
+            "fragment paths derive their tile sets from the region")
 
     if allow_reshuffle and _reshuffle_applicable(
             source, target, size_row, size_col,
@@ -131,6 +184,7 @@ def redistribute(source: TiledMatrix, target: TiledMatrix,
                      INOUT | AFFINITY),
                     (tp.tile_of(source, (sm, sn)), INPUT),
                     name=f"reshuffle({sm + dm},{sn + dn})<-({sm},{sn})")
+                tp.redist_bytes += mb * nb * itemsize
         if own:
             tp.data_flush_all()
             if context is not None:
@@ -167,6 +221,7 @@ def redistribute(source: TiledMatrix, target: TiledMatrix,
                         _copy_frag, (ttile, INOUT | AFFINITY),
                         (frag, VALUE), (tp.tile_of(source, (sm, sn)), INPUT),
                         name=f"redist({tm},{tn})<-({sm},{sn})")
+                    tp.redist_bytes += (r1 - r0) * (c1 - c0) * itemsize
     if own:
         tp.data_flush_all()
         if context is not None:

@@ -105,6 +105,17 @@ class CommEngine:
         self.on_peer_failure: Optional[Callable[[int, str], None]] = None
         #: HeartbeatDetector when one is installed (ft/detector.py)
         self.ft_detector: Optional[Any] = None
+        #: ElasticCoordinator when one is attached (ft/elastic.py);
+        #: TAG_ELASTIC traffic arriving before the attach is buffered
+        #: (a joiner may announce while the incumbents are mid-stage)
+        self.ft_elastic: Optional[Any] = None
+        self._elastic_buf: List[Tuple[int, Any]] = []
+        #: elastic-recovery counters (ft/elastic.py + ft/restart.py);
+        #: polled by obs.register_engine_gauges as the FT::ELASTIC_* /
+        #: FT::RESHARD_* gauges — plain dict, nothing on any hot path
+        self.elastic_stats: Dict[str, int] = {
+            "elastic_resizes": 0, "reshard_bytes": 0, "reshard_us": 0,
+            "elastic_joins": 0}
         #: injected-kill flag: the engine has gone dark (drops all
         #: traffic, answers no heartbeats) — simulates a crashed process
         self._ft_silenced = False
@@ -120,6 +131,9 @@ class CommEngine:
         # progress loop, detector installed or not — liveness proof
         # must not depend on the *local* configuration
         self.tag_register(TAG_HEARTBEAT, self._on_heartbeat)
+        # elastic membership traffic (ft/elastic.py) is likewise always
+        # receivable: a coordinator may attach later and drain the buffer
+        self.tag_register(TAG_ELASTIC, self._on_elastic)
 
     def _notify_arrival(self) -> None:
         cb = self.on_arrival
@@ -212,6 +226,12 @@ class CommEngine:
         cb = self.on_peer_failure
         if cb is not None:
             cb(peer, reason)
+        # a membership change invalidates any in-flight resize
+        # agreement: wake the elastic coordinator so it re-proposes
+        # from the reduced survivor set instead of waiting out its tick
+        co = self.ft_elastic
+        if co is not None:
+            co.membership_changed()
 
     def peer_finished(self, peer: int) -> bool:
         """True when ``peer`` shut down CLEANLY (it finished its work
@@ -259,6 +279,41 @@ class CommEngine:
         except Exception:  # noqa: BLE001 - a probe must never propagate
             return False
         return True
+
+    MAX_ELASTIC_BUF = 256
+
+    def ft_elastic_send(self, peer: int, payload: Any) -> bool:
+        """Send one elastic membership frame toward ``peer``; True when
+        it actually left. Mixed-version gated like ``ft_ping``: the
+        base path rides a TAG_ELASTIC active message (in-process
+        fabrics introspect the peer's handler); the TCP engine
+        overrides with a wire-level K_ELASTIC frame delivered by the
+        peer's receiver thread, gated on the HELLO ``el`` capability —
+        a pre-elastic peer is never part of a resize agreement."""
+        if self._ft_silenced or peer in self.dead_peers \
+                or self.peer_finished(peer):
+            return False
+        try:
+            self.send_am(peer, TAG_ELASTIC, dict(payload))
+        except Exception:  # noqa: BLE001 - a proposal must never propagate
+            return False
+        return True
+
+    def _on_elastic(self, src: int, payload: Any) -> None:
+        """TAG_ELASTIC / K_ELASTIC arrival (progress drain or, on TCP,
+        the receiver thread): hand to the attached coordinator, or
+        buffer until one attaches (ElasticCoordinator.__init__ drains
+        under the same lock, so no message can slip between the
+        attach-check and the buffer append)."""
+        if self._ft_silenced:
+            return
+        with self._deferred_lock:
+            co = self.ft_elastic
+            if co is None:
+                if len(self._elastic_buf) < self.MAX_ELASTIC_BUF:
+                    self._elastic_buf.append((src, payload))
+                return
+        co.deliver(src, payload)
 
     def _on_heartbeat(self, src: int, payload: Any) -> None:
         if self._ft_silenced:
@@ -329,4 +384,5 @@ TAG_TERMDET = 5
 TAG_DTD_DATA = 6
 TAG_MEM_PUT = 7
 TAG_HEARTBEAT = 8   # ft/ liveness probes (ping/pong AMs; tcp rides K_PING)
+TAG_ELASTIC = 9     # ft/ elastic membership (grid resize / join; K_ELASTIC)
 TAG_USER_BASE = 16

@@ -151,7 +151,7 @@ class _Peer:
 
     __slots__ = ("rank", "sock", "ctrl", "bulk", "cond", "writer",
                  "goodbye", "bw_mbps", "codec", "engaged", "frames",
-                 "probe_ratio", "done", "queued_bytes", "hb_ok")
+                 "probe_ratio", "done", "queued_bytes", "hb_ok", "el_ok")
 
     def __init__(self, rank: int, sock: socket.socket) -> None:
         self.rank = rank
@@ -169,6 +169,7 @@ class _Peer:
         self.frames = 0                        # frames sent (probe clock)
         self.probe_ratio: Optional[float] = None
         self.hb_ok = False         # HELLO advertised heartbeat support
+        self.el_ok = False         # HELLO advertised elastic membership
 
 
 class TCPCommEngine(LocalCommEngine):
@@ -301,7 +302,8 @@ class TCPCommEngine(LocalCommEngine):
         hello = wire.pack_hello({"ver": wire.WIRE_VERSION,
                                  "rank": self.rank,
                                  "codecs": self._codecs,
-                                 "hb": True})
+                                 "hb": True,
+                                 "el": True})
         with p.cond:
             p.ctrl.append(("frame", hello))
             p.queued_bytes += len(hello)
@@ -380,6 +382,30 @@ class TCPCommEngine(LocalCommEngine):
             for _ in range(copies):
                 p.ctrl.append(("frame", frame))
                 p.queued_bytes += len(frame)
+            p.cond.notify()
+        return True
+
+    def ft_elastic_send(self, peer: int, payload) -> bool:
+        """Wire-level elastic membership frame (K_ELASTIC): like
+        ``ft_ping``, enqueued on the ctrl lane and delivered by the
+        peer's receiver thread — a resize proposal or join
+        announcement lands even while every worker is wedged in a long
+        kernel. Gated on the HELLO ``el`` capability: a pre-elastic
+        peer is never drawn into an agreement it cannot answer.
+        Exempt from the chaos layer (control plane, like heartbeats
+        without ``hb=1``); the coordinator's resend tick covers real
+        frame loss."""
+        if self._ft_silenced or peer in self.dead_peers \
+                or peer in self.finished_peers:
+            return False
+        with self._conn_cond:
+            p = self._peers.get(peer)
+        if p is None or not p.el_ok or p.done:
+            return False
+        frame = wire.pack_elastic(dict(payload))
+        with p.cond:
+            p.ctrl.append(("frame", frame))
+            p.queued_bytes += len(frame)
             p.cond.notify()
         return True
 
@@ -777,6 +803,7 @@ class TCPCommEngine(LocalCommEngine):
                 p.codec = wire.negotiate_codec(
                     self._codecs, info.get("codecs", ()))
                 p.hb_ok = bool(info.get("hb"))
+                p.el_ok = bool(info.get("el"))
         elif kind == wire.K_PING:
             # answered HERE, on the receiver thread (like K_HELLO): a
             # rank whose workers are all stuck in a long kernel still
@@ -800,6 +827,12 @@ class TCPCommEngine(LocalCommEngine):
             if det is not None:
                 det.note_alive(peer,
                                rtt=(time.monotonic_ns() - t_ns) / 1e9)
+        elif kind == wire.K_ELASTIC:
+            # delivered HERE, on the receiver thread (like K_PING): a
+            # resize proposal or join announcement must reach the
+            # coordinator even while every worker is wedged in a long
+            # kernel — elastic agreement is progress-cadence-free on TCP
+            self._on_elastic(peer, wire.parse_elastic(body))
         elif kind == wire.K_COMP:
             self._dispatch_body(peer, memoryview(
                 wire.decompress_body(body)), xfers)

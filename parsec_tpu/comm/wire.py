@@ -32,6 +32,13 @@ body follows). Otherwise the body's first byte is a *kind*:
 - ``K_COMP``: a compressed *body* (kind byte included) of any of the
   above: ``<u8 codec_id> <u64 raw_len> <compressed>``. Only emitted
   toward peers that advertised the codec.
+- ``K_ELASTIC``: one elastic-membership message (ft/elastic.py — grid
+  resize views, join announcements, welcomes) as a pickled dict.
+  Handled directly by the receiver THREAD like ``K_PING``: a joiner's
+  announcement or a resize proposal must land even while every worker
+  is stuck in a long kernel. Only sent toward peers whose HELLO
+  advertised ``"el"`` — a pre-elastic peer is never drawn into a
+  resize agreement it cannot answer.
 - ``K_PING`` / ``K_PONG``: heartbeat probe and its echo
   (``<u32 seq> <u64 t_ns>``, the sender's monotonic clock — the pong
   echoes it back so the sender computes the round trip). Handled
@@ -60,6 +67,7 @@ K_HELLO = 3
 K_COMP = 4
 K_PING = 5
 K_PONG = 6
+K_ELASTIC = 7
 
 WIRE_VERSION = 2
 
@@ -255,6 +263,16 @@ def parse_ping(body: memoryview) -> Tuple[int, int]:
     """-> (seq, t_ns); same layout for K_PING and K_PONG."""
     _kind, seq, t_ns = _PING.unpack_from(body, 0)
     return seq, t_ns
+
+
+# -- elastic membership (ft/elastic.py) ---------------------------------
+def pack_elastic(payload: Dict[str, Any]) -> bytes:
+    """One membership frame (view / join / welcome dict)."""
+    return bytes([K_ELASTIC]) + pickle.dumps(payload, protocol=4)
+
+
+def parse_elastic(body: memoryview) -> Dict[str, Any]:
+    return pickle.loads(body[1:])
 
 
 # -- hello / compression ------------------------------------------------

@@ -26,14 +26,26 @@ pillars:
   ``utils/checkpoint`` — snapshot every K taskpools; on failure either
   abort cleanly or roll back to the last snapshot and re-run with
   bounded, backed-off retries.
+- :mod:`ft.elastic` — **elastic grid recovery** (the fourth pillar):
+  cross-grid checkpoint reshard (``reshard_restore`` — a snapshot
+  written on any rank count / process grid lands on the current one
+  via ``collections/redistribute``), and in-world grid RESIZE — with
+  ``ft_elastic=shrink`` the survivors of a rank loss agree on a
+  reduced grid over ``TAG_ELASTIC``/``K_ELASTIC`` membership frames,
+  rebuild, reshard, and replay from the last snapshot; with ``grow``
+  late-arriving ranks are folded in at stage boundaries.
 
 Knobs: ``ft_heartbeat_interval``, ``ft_heartbeat_timeout``,
-``ft_detector_mode``, ``ft_inject``, ``ft_restart_policy`` (see
+``ft_detector_mode``, ``ft_inject``, ``ft_restart_policy``,
+``ft_elastic``, ``ft_elastic_grow_min``, ``ft_elastic_timeout`` (see
 docs/guide.md §"Fault tolerance").
 """
 from __future__ import annotations
 
 from .detector import HeartbeatDetector, maybe_install_detector
+from .elastic import (ElasticBlockCyclic, ElasticCoordinator, ElasticError,
+                      ElasticPolicy, GridSpec, maybe_install_elastic,
+                      plan_grid, reshard_restore)
 from .inject import (FaultInjector, FTInjectModule, InjectedKill,
                      InjectedTaskFault)
 from .restart import RestartPolicy, run_with_restart
@@ -42,4 +54,7 @@ __all__ = [
     "HeartbeatDetector", "maybe_install_detector",
     "FaultInjector", "FTInjectModule", "InjectedKill", "InjectedTaskFault",
     "RestartPolicy", "run_with_restart",
+    "ElasticBlockCyclic", "ElasticCoordinator", "ElasticError",
+    "ElasticPolicy", "GridSpec", "maybe_install_elastic", "plan_grid",
+    "reshard_restore",
 ]

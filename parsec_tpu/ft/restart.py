@@ -34,6 +34,7 @@ always snapshotted).
 """
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Callable, Dict, Optional, Sequence
 
@@ -99,36 +100,171 @@ def _save(collections: Sequence[Any], prefix: str, stage: int,
                              context=context)
 
 
-def _restore(collections: Sequence[Any], prefix: str, stage: int) -> None:
+def _restore(collections: Sequence[Any], prefix: str, stage: int,
+             context: Any = None, reshard: bool = False) -> None:
     for i, coll in enumerate(collections):
-        ckpt.restore_collection(coll, f"{_stage_prefix(prefix, stage)}.c{i}")
+        ckpt.restore_collection(coll, f"{_stage_prefix(prefix, stage)}.c{i}",
+                                reshard=reshard, context=context)
 
 
-def run_with_restart(ctx: Any, stages: Sequence[Callable[[], Any]],
-                     collections: Sequence[Any], prefix: str,
+def _restore_fallback(collections: Sequence[Any], prefix: str, stage: int,
+                      context: Any = None, reshard: bool = False) -> int:
+    """Restore the stage-``stage`` snapshot set, falling back to the
+    previous COMPLETE snapshot when a shard is torn/corrupt (a rank
+    that crashed mid-write before atomic saves, or truncating storage,
+    must not dead-end the whole recovery). Walks one stage at a time
+    so skipped cadence stages (``every > 1``) are stepped over; the
+    requested stage itself must at least exist. Returns the stage
+    actually restored."""
+    s = stage
+    while True:
+        try:
+            _restore(collections, prefix, s, context=context,
+                     reshard=reshard)
+            return s
+        except ckpt.CheckpointCorruptError as exc:
+            if s <= 0:
+                raise
+            plog.warning(
+                "ft.restart: snapshot at stage %d is torn/corrupt (%s); "
+                "falling back toward the previous complete snapshot", s, exc)
+            s -= 1
+        except FileNotFoundError:
+            if s <= 0 or s == stage:
+                raise
+            s -= 1   # not a snapshot boundary (every > 1): keep walking
+
+
+def _complete_stage(ncolls: int, prefix: str, stage: int) -> int:
+    """Latest stage <= ``stage`` whose FULL writer shard set is on disk
+    for every collection — the stage this rank can safely VOTE in a
+    shrink round. A rank killed after its stage completed but before
+    its atomic save PUBLISHED leaves the newest snapshot one shard
+    short; a reshard restore of it would dead-end mid-collective. Disk
+    state is shared, so every survivor probing the same snapshot set
+    computes the same answer (the SPMD consistency the vote needs)."""
+    from .elastic import _participants
+
+    def complete(s: int) -> bool:
+        for i in range(ncolls):
+            p = f"{_stage_prefix(prefix, s)}.c{i}"
+            try:
+                man = ckpt.find_manifest(p)
+            except (FileNotFoundError, ckpt.CheckpointCorruptError):
+                return False
+            if not all(os.path.exists(ckpt.checkpoint_path(p, w))
+                       for w in _participants(man)):
+                return False
+        return True
+
+    s = stage
+    while s > 0 and not complete(s):
+        s -= 1   # skipped cadence stages (every > 1) also walk through
+    return s
+
+
+def run_with_restart(ctx: Any, stages: Optional[Sequence[Callable[[], Any]]],
+                     collections: Optional[Sequence[Any]], prefix: str,
                      policy: Optional[RestartPolicy] = None,
-                     resume_from: Optional[int] = None) -> Dict[str, Any]:
+                     resume_from: Optional[int] = None,
+                     elastic: Optional[Any] = None) -> Dict[str, Any]:
     """Run ``stages`` (zero-arg factories, each returning a FRESH
     taskpool — a taskpool object cannot be re-enqueued) under the
     snapshot/rollback policy. ``collections`` is the application state
     the stages mutate; ``prefix`` names the snapshot files
     (``<prefix>.stage<k>.c<i>.rank<r>.npz``).
 
-    Returns ``{"stages", "retries", "snapshots", "last_snapshot"}``.
-    ``resume_from=k`` skips the initial snapshot, restores the stage-k
-    snapshot set, and continues with stage k — the fresh-incarnation
-    entry point after a hard rank loss.
+    Returns ``{"stages", "retries", "snapshots", "last_snapshot",
+    "resizes", "grid"}``. ``resume_from=k`` skips the initial
+    snapshot, restores the stage-k snapshot set, and continues with
+    stage k — the fresh-incarnation entry point after a hard rank
+    loss.
+
+    ``elastic`` (an :class:`ft.elastic.ElasticPolicy`) turns hard rank
+    loss from a dead end into a resize: on a ``RankFailedError`` with
+    shrink enabled the survivors agree on a reduced grid, rebuild the
+    run via ``elastic.rebuild(grid)``, reshard-restore the last
+    snapshot onto it, and replay from ``last_snap``; with grow
+    enabled, announced joiners are folded in at stage boundaries
+    (fresh-snapshot quiescent points), gated by
+    ``elastic.grow_min``. With ``elastic`` the ``stages``/
+    ``collections`` arguments may be ``None`` — ``rebuild`` is then
+    the single source of truth for the initial grid too. Strict runs
+    (no ``elastic``, or ``ft_elastic`` unset) keep today's fail-fast
+    behavior exactly.
     """
+    # a coordinator this call creates is detached on exit: leaving it
+    # attached would carry pending joins/views into a LATER run on the
+    # same context (phantom grow rounds holding every boundary). One
+    # installed by Context (maybe_install_elastic) outlives the call.
+    co_made = None
+    if (elastic is not None and elastic.mode and ctx.comm is not None
+            and ctx.nb_ranks >= 2):
+        ce = getattr(ctx.comm, "ce", ctx.comm)
+        if ce.ft_elastic is None:
+            from .elastic import ElasticCoordinator
+            co_made = ElasticCoordinator(ce)
+    try:
+        return _run_with_restart(ctx, stages, collections, prefix,
+                                 policy, resume_from, elastic)
+    finally:
+        if co_made is not None:
+            co_made.detach()
+
+
+def _run_with_restart(ctx, stages, collections, prefix, policy,
+                      resume_from, elastic) -> Dict[str, Any]:
     policy = policy or RestartPolicy.from_params()
+    co = grid = ce = None
+    joined_at: Optional[int] = None
+    if elastic is not None and not elastic.mode:
+        elastic = None   # knob off: strict contract, bit for bit
+    if elastic is not None:
+        if ctx.comm is None or ctx.nb_ranks < 2:
+            raise ValueError(
+                "elastic recovery needs a multi-rank comm world")
+        from .elastic import ElasticCoordinator, plan_grid
+        ce = getattr(ctx.comm, "ce", ctx.comm)
+        co = ce.ft_elastic or ElasticCoordinator(ce)
+        members = elastic.members or tuple(range(ctx.nb_ranks))
+        grid = plan_grid(members, ctx.nb_ranks, ctx.rank)
+        if elastic.join:
+            # late joiner: announce, learn the member set + resume
+            # stage from the welcome, reshard into the grown grid
+            welcome = co.announce_join(deadline_s=elastic.timeout)
+            if welcome.get("tp_base") is not None:
+                # align taskpool WIRE ids with the incumbents (DTD
+                # traffic is keyed by registration order, and they
+                # registered pools for every stage we never ran)
+                ctx.comm.sync_tp_ids(int(welcome["tp_base"]))
+            grid = plan_grid(tuple(welcome["members"]), ctx.nb_ranks,
+                             ctx.rank)
+            stages, collections = elastic.rebuild(grid)
+            joined_at = int(welcome["stage"])
+            _restore(collections, prefix, joined_at, context=ctx,
+                     reshard=True)
+            ce.elastic_stats["elastic_resizes"] += 1
+            ce.elastic_stats["elastic_joins"] += 1
+            plog.inform("ft.restart: rank %d joined grid %dx%d (members "
+                        "%s) at stage %d", ctx.rank, grid.P, grid.Q,
+                        grid.members, joined_at)
+        elif stages is None:
+            stages, collections = elastic.rebuild(grid)
+    assert stages is not None and collections is not None, \
+        "stages/collections may only be omitted with an elastic policy"
     n = len(stages)
-    retries_total = snapshots = 0
-    if resume_from is None:
+    retries_total = snapshots = resizes = 0
+    if joined_at is not None:
+        i = last_snap = joined_at
+        resizes = 1   # the join itself resized this rank's grid
+    elif resume_from is None:
         _save(collections, prefix, 0, ctx)
         snapshots += 1
         i = last_snap = 0
     else:
-        _restore(collections, prefix, resume_from)
-        i = last_snap = resume_from
+        i = last_snap = _restore_fallback(
+            collections, prefix, resume_from, context=ctx,
+            reshard=elastic is not None)
     # per-STAGE attempt counters: with every>1 a rollback replays
     # earlier (succeeding) stages, and a single shared counter reset on
     # their completion would let a persistently failing stage retry
@@ -148,6 +284,80 @@ def run_with_restart(ctx: Any, stages: Sequence[Callable[[], Any]],
             # its engine is permanently dark; retrying a stage on it
             # would hang termdet, the exact failure ft/ exists to stop)
             hard = isinstance(root, (RankFailedError, InjectedKill))
+            # elastic shrink: a PEER's loss is recoverable in-world —
+            # the survivors agree on a reduced grid and reshard the
+            # last snapshot onto it. Our OWN kill (InjectedKill) is
+            # not: this engine is dark — and neither is a silenced
+            # (kill-injected) engine whose own detector evicted every
+            # peer it stopped hearing: a dead rank must never "win" a
+            # phantom agreement with itself. Bounded by the world size
+            # so a cascade of losses cannot loop forever.
+            if (co is not None and elastic.allows_shrink
+                    and isinstance(root, RankFailedError)
+                    and not isinstance(root, InjectedKill)
+                    and not getattr(ce, "_ft_silenced", False)
+                    and resizes < ctx.nb_ranks):
+                from .elastic import plan_grid
+                recovered = False
+                tries = 0
+                # another rank can die DURING the agreement or the
+                # reshard itself — re-enter with the further-reduced
+                # survivor set; bounded by the world size
+                while resizes + tries < ctx.nb_ranks:
+                    try:
+                        ctx.clear_task_errors()
+                        # vote a snapshot this rank can PROVE complete:
+                        # the dead rank may have died between finishing
+                        # the stage and publishing its shard
+                        safe = _complete_stage(len(collections), prefix,
+                                               last_snap)
+                        decision = co.agree(
+                            "shrink", grid.members, safe,
+                            deadline_s=elastic.timeout,
+                            tp_next=getattr(ctx.comm, "next_tp_id", None))
+                        if decision["tp_base"] is not None:
+                            # survivors can diverge by one registration
+                            # at a mid-stage failure: align wire ids
+                            # before the reshard pool registers
+                            ctx.comm.sync_tp_ids(decision["tp_base"])
+                        grid = plan_grid(decision["members"],
+                                         ctx.nb_ranks, ctx.rank)
+                        stages, collections = elastic.rebuild(grid)
+                        assert len(stages) == n, \
+                            "elastic rebuild changed the stage count"
+                        # the COMMITTED stage (min over votes — peers a
+                        # snapshot behind us reconcile the round there;
+                        # every voter provably wrote that snapshot)
+                        last_snap = int(decision["stage"])
+                        _restore(collections, prefix, last_snap,
+                                 context=ctx, reshard=True)
+                        ce.elastic_stats["elastic_resizes"] += 1
+                        resizes += 1
+                        recovered = True
+                        break
+                    except Exception as eexc:  # noqa: BLE001 - triaged below
+                        nested = eexc.__cause__ or eexc
+                        if isinstance(nested, RankFailedError) \
+                                and not isinstance(nested, InjectedKill):
+                            tries += 1
+                            plog.warning(
+                                "ft.restart: rank failure during elastic "
+                                "shrink (%s) — re-agreeing on the reduced "
+                                "survivor set", nested)
+                            continue
+                        plog.warning(
+                            "ft.restart: elastic shrink failed (%s: %s) — "
+                            "falling back to the strict abort path",
+                            type(eexc).__name__, eexc)
+                        break
+                if recovered:
+                    plog.warning(
+                        "ft.restart: elastic shrink -> %dx%d over members "
+                        "%s after %s; resharded snapshot %d, replaying",
+                        grid.P, grid.Q, grid.members,
+                        type(root).__name__, last_snap)
+                    i = last_snap
+                    continue
             # in-world rollback is a LOCAL act: on a multi-rank run the
             # peers saw no error and keep waiting on the original
             # taskpool (whose wire id a lone re-registration would
@@ -167,7 +377,7 @@ def run_with_restart(ctx: Any, stages: Sequence[Callable[[], Any]],
                 # ON-DISK snapshot set is the hard guarantee (a failed
                 # restore must not mask the original error)
                 try:
-                    _restore(collections, prefix, last_snap)
+                    _restore_fallback(collections, prefix, last_snap)
                 except Exception:  # noqa: BLE001  pragma: no cover
                     plog.warning("ft.restart: in-memory rollback to "
                                  "snapshot %d failed; on-disk snapshots "
@@ -191,13 +401,67 @@ def run_with_restart(ctx: Any, stages: Sequence[Callable[[], Any]],
             retries_total += 1
             time.sleep(delay)
             ctx.clear_task_errors()
-            _restore(collections, prefix, last_snap)
-            i = last_snap
+            i = last_snap = _restore_fallback(collections, prefix, last_snap)
             continue
         i += 1
         if (i - last_snap) >= policy.every or i == n:
             _save(collections, prefix, i, ctx)
             snapshots += 1
             last_snap = i
+        # elastic grow: fold announced joiners in at a quiescent point
+        # that has a FRESH snapshot (the joiner reshards from it). The
+        # round is optional — the leader holds the boundary only
+        # ``grow_window`` seconds, so a straggling incumbent defers
+        # the resize to the next boundary instead of stalling the run.
+        if (co is not None and elastic.allows_grow and i < n
+                and last_snap == i):
+            # a fast, purely-local stage can complete without one comm
+            # progress cycle: drain the engine HERE or a join sitting in
+            # the inbox is invisible at exactly the boundary it targets
+            ce.progress()
+            joins = co.pending_joins(grid.members)
+            if len(joins) >= elastic.grow_min:
+                from .elastic import ElasticError, plan_grid
+                try:
+                    decision = co.agree(
+                        "grow", grid.members, last_snap,
+                        deadline_s=elastic.timeout,
+                        window_s=elastic.grow_window,
+                        tp_next=getattr(ctx.comm, "next_tp_id", None))
+                except ElasticError as eexc:
+                    # the round is OPTIONAL: a non-converging agreement
+                    # (e.g. a peer saw the join only after passing its
+                    # own boundary check, so it never voted) must not
+                    # abort a healthy run — release the boundary, the
+                    # joiner stays pending for the next one
+                    plog.warning(
+                        "ft.restart: grow round at stage %d released "
+                        "(%s); joiners stay pending", last_snap, eexc)
+                    decision = None
+                if decision is not None:
+                    committed = decision["members"]
+                    if decision["tp_base"] is not None:
+                        ctx.comm.sync_tp_ids(decision["tp_base"])
+                    new = [r for r in committed if r not in grid.members]
+                    grid = plan_grid(committed, ctx.nb_ranks, ctx.rank)
+                    stages, collections = elastic.rebuild(grid)
+                    assert len(stages) == n, \
+                        "elastic rebuild changed the stage count"
+                    # adopt the COMMITTED stage: an incumbent a boundary
+                    # ahead of the slowest voter replays from the common
+                    # snapshot so every member (joiner included) runs
+                    # the same remaining stage sequence in lockstep
+                    i = last_snap = int(decision["stage"])
+                    _restore(collections, prefix, last_snap, context=ctx,
+                             reshard=True)
+                    ce.elastic_stats["elastic_resizes"] += 1
+                    ce.elastic_stats["elastic_joins"] += len(new)
+                    resizes += 1
+                    plog.inform(
+                        "ft.restart: elastic grow -> %dx%d over members "
+                        "%s (+%s); resharded snapshot %d",
+                        grid.P, grid.Q, grid.members, new, last_snap)
     return {"stages": n, "retries": retries_total,
-            "snapshots": snapshots, "last_snapshot": last_snap}
+            "snapshots": snapshots, "last_snapshot": last_snap,
+            "resizes": resizes,
+            "grid": grid.members if grid is not None else None}

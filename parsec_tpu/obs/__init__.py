@@ -34,7 +34,8 @@ from .spans import (COMM_ACTIVE_TRANSFERS, COMM_BYTES_RECEIVED,
                     COMM_COMPRESS_RATIO, COMM_LINK_BW_PREFIX,
                     COMM_MSGS_RECEIVED, COMM_MSGS_SENT,
                     COMM_PENDING_MESSAGES, CommObs, DeviceObs,
-                    FT_HB_RTT_PREFIX, FT_PEER_ALIVE,
+                    FT_ELASTIC_JOINS, FT_ELASTIC_RESIZES, FT_HB_RTT_PREFIX,
+                    FT_PEER_ALIVE, FT_RESHARD_BYTES, FT_RESHARD_US,
                     OBS_EXPOSED_COMM_US, OBS_OVERLAP_FRACTION,
                     OverlapTracker, payload_nbytes, register_device_gauges)
 
@@ -45,6 +46,8 @@ __all__ = [
     "COMM_MSGS_RECEIVED", "COMM_ACTIVE_TRANSFERS", "COMM_PENDING_MESSAGES",
     "COMM_COALESCED", "COMM_CHUNKS_INFLIGHT", "COMM_COMPRESS_RATIO",
     "COMM_LINK_BW_PREFIX", "FT_PEER_ALIVE", "FT_HB_RTT_PREFIX",
+    "FT_ELASTIC_RESIZES", "FT_ELASTIC_JOINS", "FT_RESHARD_BYTES",
+    "FT_RESHARD_US",
     "OBS_OVERLAP_FRACTION", "OBS_EXPOSED_COMM_US",
     "TASK_EXEC_SECONDS", "COMM_XFER_SECONDS",
     "render", "parse_exposition", "sanitize_name", "fleet_to_prometheus",

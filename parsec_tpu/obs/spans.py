@@ -50,6 +50,14 @@ COMM_LINK_BW_PREFIX = "PARSEC::COMM::LINK_BW"
 # (PARSEC::FT::HB_RTT::R<peer>, 0 until measured)
 FT_PEER_ALIVE = "PARSEC::FT::PEER_ALIVE"
 FT_HB_RTT_PREFIX = "PARSEC::FT::HB_RTT"
+# elastic recovery telemetry (ft/elastic.py): completed grid resizes
+# (shrink + grow) on this rank, joiners folded in, and the cross-grid
+# reshard volume/wall landed here — engine-owned counters
+# (ce.elastic_stats), polled like every other engine gauge
+FT_ELASTIC_RESIZES = "PARSEC::FT::ELASTIC_RESIZES"
+FT_ELASTIC_JOINS = "PARSEC::FT::ELASTIC_JOINS"
+FT_RESHARD_BYTES = "PARSEC::FT::RESHARD_BYTES"
+FT_RESHARD_US = "PARSEC::FT::RESHARD_US"
 # LIVE T3-style overlap telemetry (ISSUE 7): the fraction of this
 # rank's communication time (comm spans + host<->device transfers)
 # hidden under task execution, and the exposed remainder in us — the
@@ -327,6 +335,16 @@ class CommObs:
                     lambda c=ce, p=peer: (lambda b: 0.0 if b is None
                                           else round(b, 3))(
                         c.link_bw_mbps(p)))
+        es = getattr(ce, "elastic_stats", None)
+        if es is not None:
+            sde.register_poll(FT_ELASTIC_RESIZES,
+                              lambda s=es: s["elastic_resizes"])
+            sde.register_poll(FT_ELASTIC_JOINS,
+                              lambda s=es: s["elastic_joins"])
+            sde.register_poll(FT_RESHARD_BYTES,
+                              lambda s=es: s["reshard_bytes"])
+            sde.register_poll(FT_RESHARD_US,
+                              lambda s=es: s["reshard_us"])
         det = getattr(ce, "ft_detector", None)
         if det is not None:
             sde.register_poll(FT_PEER_ALIVE, det.alive_count)

@@ -128,9 +128,17 @@ class Context:
         # kill/taskfail directives
         self._ft_detector = None
         self._ft_pins = None
+        self._ft_elastic = None
         if self.comm is not None:
             from ..ft.detector import maybe_install_detector
             self._ft_detector = maybe_install_detector(self)
+            # elastic membership coordinator (ft/elastic.py) when the
+            # ft_elastic knob is set — attached AFTER the detector (its
+            # evictions wake pending agreements) so a joiner announcing
+            # mid-stage reaches a live coordinator, not the engine
+            # buffer; ft.run_with_restart reuses this instance
+            from ..ft.elastic import maybe_install_elastic
+            self._ft_elastic = maybe_install_elastic(self)
         ft_inj = None
         if self.comm is not None:
             ft_inj = getattr(getattr(self.comm, "ce", self.comm),
@@ -606,6 +614,8 @@ class Context:
         self._finalized = True
         if self._ft_detector is not None:
             self._ft_detector.stop()   # before the engine dies under it
+        if self._ft_elastic is not None:
+            self._ft_elastic.detach()
         if self._ft_pins is not None:
             self._ft_pins.disable()
         with self._work_cond:

@@ -383,6 +383,20 @@ def register_core_params() -> None:
                       "restart policy for ft.restart.run_with_restart: "
                       "\"abort\" or "
                       "\"restart:retries=N:backoff=S:every=K\"")
+    params.reg_string("ft_elastic", "",
+                      "elastic grid recovery (ft/elastic.py): \"shrink\" "
+                      "(survivors of a rank loss agree on a reduced grid, "
+                      "reshard the last snapshot onto it, and replay), "
+                      "\"grow\" (fold announced joiners in at stage "
+                      "boundaries), \"both\", or empty (default) for "
+                      "today's fail-fast abort")
+    params.reg_int("ft_elastic_grow_min", 1,
+                   "minimum announced joiners worth a grid resize at a "
+                   "stage boundary (grow mode)")
+    params.reg_string("ft_elastic_timeout", "",
+                      "membership-agreement deadline in seconds "
+                      "(default 30); on expiry the run falls back to the "
+                      "strict abort path with consistent snapshots")
     # multi-process deployment (tools/launch.py sets these per rank —
     # the mpiexec analog; ref: parsec_remote_dep_set_ctx runtime.h:221)
     params.reg_string("comm_transport", "",

@@ -170,3 +170,206 @@ def test_loose_array_roundtrip(tmp_path):
     back = ckpt.load_arrays(prefix)
     assert back["step"] == 7
     np.testing.assert_array_equal(back["w"], np.arange(6.0).reshape(2, 3))
+
+
+# --------------------------------------------------------------------- #
+# durability: atomic writes, torn-file detection (ISSUE 9 satellite)    #
+# --------------------------------------------------------------------- #
+def test_manifest_records_format_version(tmp_path):
+    A = TwoDimBlockCyclic(64, 64, 32, 32, dtype=np.float32).from_numpy(
+        np.ones((64, 64), np.float32))
+    prefix = str(tmp_path / "ver")
+    path = ckpt.save_collection(A, prefix)
+    assert ckpt.read_manifest(path)["version"] == ckpt.CHECKPOINT_VERSION
+
+
+def test_atomic_save_survives_midwrite_crash(tmp_path, monkeypatch):
+    """A crash mid-``np.savez`` must leave the PUBLISHED path holding
+    the previous complete snapshot, never a torn mix — the crashing
+    rank's next incarnation recovers from it."""
+    M0 = np.full((64, 64), 7.0, np.float32)
+    A = TwoDimBlockCyclic(64, 64, 32, 32, dtype=np.float32).from_numpy(M0)
+    prefix = str(tmp_path / "atomic")
+    path = ckpt.save_collection(A, prefix)
+
+    real_savez = np.savez
+
+    def dying_savez(f, **arrays):
+        real_savez(f, **{k: arrays[k] for k in list(arrays)[:1]})
+        raise KeyboardInterrupt("rank killed mid-snapshot")
+
+    A2 = TwoDimBlockCyclic(64, 64, 32, 32, dtype=np.float32).from_numpy(
+        np.zeros((64, 64), np.float32))
+    monkeypatch.setattr(np, "savez", dying_savez)
+    with pytest.raises(KeyboardInterrupt):
+        ckpt.save_collection(A2, prefix)
+    monkeypatch.undo()
+
+    # published file: still the OLD complete snapshot; no .tmp debris
+    B = TwoDimBlockCyclic(64, 64, 32, 32, dtype=np.float32)
+    assert ckpt.restore_collection(B, prefix) == 4
+    np.testing.assert_array_equal(B.to_numpy(), M0)
+    import glob as _glob
+    assert not _glob.glob(str(tmp_path / "*.tmp.*"))
+
+
+def test_torn_snapshot_raises_corrupt_not_mismatch(tmp_path):
+    """A truncated .npz surfaces as CheckpointCorruptError (skippable:
+    fall back to the previous snapshot), distinct from both a manifest
+    mismatch and a missing file."""
+    A = TwoDimBlockCyclic(64, 64, 32, 32, dtype=np.float32).from_numpy(
+        np.ones((64, 64), np.float32))
+    prefix = str(tmp_path / "torn")
+    path = ckpt.save_collection(A, prefix)
+    raw = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(raw[:len(raw) // 3])   # the torn tail of a dead writer
+    B = TwoDimBlockCyclic(64, 64, 32, 32, dtype=np.float32)
+    with pytest.raises(ckpt.CheckpointCorruptError, match="torn"):
+        ckpt.restore_collection(B, prefix)
+    with pytest.raises(FileNotFoundError):
+        ckpt.restore_collection(B, str(tmp_path / "never_written"))
+
+
+def test_mismatch_error_names_reshard_escape_hatch(tmp_path):
+    """Distribution-only mismatches (grid/rank keys) point the operator
+    at reshard=True / ft_elastic; geometry mismatches must NOT (a tile
+    size change is unrecoverable by resharding)."""
+    nb_ranks, n, nb = 4, 128, 32
+    prefix = str(tmp_path / "hatch")
+
+    def save_rank(rank, fabric):
+        d = TwoDimBlockCyclic(n, n, nb, nb, P=2, Q=2, nodes=nb_ranks,
+                              rank=rank, dtype=np.float32)
+        return ckpt.save_collection(d, prefix)
+
+    spmd(nb_ranks, save_rank)
+    wrong_grid = TwoDimBlockCyclic(n, n, nb, nb, P=2, Q=1, nodes=2,
+                                   rank=0, dtype=np.float32)
+    with pytest.raises(ckpt.CheckpointMismatchError) as ei:
+        ckpt.restore_collection(wrong_grid, prefix)
+    assert "reshard=True" in str(ei.value)
+    assert "ft_elastic" in str(ei.value)
+
+    wrong_geom = TwoDimBlockCyclic(n, n, 16, 16, P=2, Q=2, nodes=4,
+                                   rank=0, dtype=np.float32)
+    with pytest.raises(ckpt.CheckpointMismatchError) as ei:
+        ckpt.restore_collection(wrong_geom, prefix)
+    assert "reshard=True" not in str(ei.value)
+
+
+# --------------------------------------------------------------------- #
+# cross-grid reshard restore (ISSUE 9 tentpole)                         #
+# --------------------------------------------------------------------- #
+def _write_grid_snapshot(tmp_path, M, n, nb, nb_ranks, P, Q, name):
+    prefix = str(tmp_path / name)
+
+    def save_rank(rank, fabric):
+        d = TwoDimBlockCyclic(n, n, nb, nb, P=P, Q=Q, nodes=nb_ranks,
+                              rank=rank, dtype=np.float32)
+        d.name = "descA"
+        for (i, j) in d.local_tiles():
+            np.copyto(d.tile(i, j),
+                      M[i * nb:(i + 1) * nb, j * nb:(j + 1) * nb])
+        return ckpt.save_collection(d, prefix)
+
+    spmd(nb_ranks, save_rank)
+    return prefix
+
+
+def _reshard_onto(prefix, M, n, nb, nb_ranks, P, Q):
+    """Restore with reshard=True on a fresh grid; golden-check every
+    landed tile against the source matrix."""
+    from parsec_tpu.comm import RemoteDepEngine
+
+    def restore_rank(rank, fabric):
+        eng = RemoteDepEngine(fabric.engine(rank))
+        ctx = parsec_tpu.Context(nb_cores=1, comm=eng, enable_tpu=False)
+        try:
+            d = TwoDimBlockCyclic(n, n, nb, nb, P=P, Q=Q, nodes=nb_ranks,
+                                  rank=rank, dtype=np.float32)
+            d.name = "descA"
+            got = ckpt.restore_collection(d, prefix, reshard=True,
+                                          context=ctx)
+            local = {t: np.array(d.tile(*t)) for t in d.local_tiles()}
+            return got, local, dict(eng.ce.elastic_stats)
+        finally:
+            ctx.fini()
+
+    results, _ = spmd(nb_ranks, restore_rank)
+    merged = {}
+    for got, local, stats in results:
+        assert got == len(local)
+        assert stats["reshard_bytes"] > 0   # the reshard path really ran
+        merged.update(local)
+    assert len(merged) == (n // nb) ** 2
+    for (i, j), arr in merged.items():
+        np.testing.assert_array_equal(
+            arr, M[i * nb:(i + 1) * nb, j * nb:(j + 1) * nb])
+
+
+def test_reshard_restore_4rank_to_2rank(tmp_path):
+    """The shrink shape: a 4-rank snapshot lands bit-identical on a
+    2-rank grid (each survivor loads the writer shards folded onto it
+    and the redistribution moves tiles to their new owners)."""
+    n, nb = 128, 32
+    rng = np.random.RandomState(11)
+    M = rng.rand(n, n).astype(np.float32)
+    prefix = _write_grid_snapshot(tmp_path, M, n, nb, 4, 4, 1, "s42")
+    _reshard_onto(prefix, M, n, nb, 2, 2, 1)
+
+
+def test_reshard_restore_1x4_to_2x2(tmp_path):
+    """Grid-SHAPE change at the same rank count: 1x4 -> 2x2 is a pure
+    ownership permutation and must also be bit-identical."""
+    n, nb = 128, 32
+    rng = np.random.RandomState(12)
+    M = rng.rand(n, n).astype(np.float32)
+    prefix = _write_grid_snapshot(tmp_path, M, n, nb, 4, 1, 4, "s14")
+    _reshard_onto(prefix, M, n, nb, 4, 2, 2)
+
+
+def test_reshard_restore_to_single_rank(tmp_path):
+    """A 4-rank snapshot folds onto ONE process with no comm machinery
+    (the operator's salvage path: pull a dead job's state anywhere)."""
+    n, nb = 128, 32
+    rng = np.random.RandomState(13)
+    M = rng.rand(n, n).astype(np.float32)
+    prefix = _write_grid_snapshot(tmp_path, M, n, nb, 4, 2, 2, "s41")
+    d = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float32)
+    d.name = "descA"
+    assert ckpt.restore_collection(d, prefix, reshard=True) == 16
+    np.testing.assert_array_equal(d.to_numpy(), M)
+
+
+def test_reshard_rejects_geometry_mismatch(tmp_path):
+    """reshard=True relaxes the DISTRIBUTION only: a tile-size change
+    still hard-fails (resharding moves tiles, it cannot re-tile
+    bytes)."""
+    n = 128
+    rng = np.random.RandomState(14)
+    M = rng.rand(n, n).astype(np.float32)
+    prefix = _write_grid_snapshot(tmp_path, M, n, 32, 4, 4, 1, "sgm")
+    wrong = TwoDimBlockCyclic(n, n, 16, 16, dtype=np.float32)
+    wrong.name = "descA"
+    with pytest.raises(ckpt.CheckpointMismatchError, match="GEOMETRY"):
+        ckpt.restore_collection(wrong, prefix, reshard=True)
+
+
+def test_reshard_rejects_mixed_stale_shards(tmp_path):
+    """A stale shard from a DIFFERENT grid sitting beside a newer save
+    must be rejected, not silently blended into the restore."""
+    n, nb = 128, 32
+    rng = np.random.RandomState(15)
+    M = rng.rand(n, n).astype(np.float32)
+    prefix = _write_grid_snapshot(tmp_path, M, n, nb, 2, 2, 1, "mix")
+    # rank 1's shard clobbered by a leftover from an older 4-rank
+    # incarnation of the same job (same prefix, different grid)
+    stale = TwoDimBlockCyclic(n, n, nb, nb, P=4, Q=1, nodes=4, rank=1,
+                              dtype=np.float32)
+    stale.name = "descA"
+    ckpt.save_collection(stale, prefix)
+    d = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float32)
+    d.name = "descA"
+    with pytest.raises(ckpt.CheckpointCorruptError, match="stale"):
+        ckpt.restore_collection(d, prefix, reshard=True)

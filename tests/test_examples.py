@@ -20,6 +20,7 @@ import pytest
     "examples.ex10_dposv_multiprocess",
     "examples.ex11_wave_distributed",
     "examples.ex12_turbo_dispatch",
+    "examples.ex13_elastic_shrink",
 ])
 def test_example_runs(mod):
     m = importlib.import_module(mod)

@@ -601,3 +601,291 @@ def test_ft_gauges_registered():
 
     counts, _ = spmd(2, rank_fn, fabric=fab)
     assert counts == [1, 1]
+
+
+# --------------------------------------------------------------------- #
+# elastic grid recovery (ISSUE 9): shrink, grow, agreement, fallback    #
+# --------------------------------------------------------------------- #
+SCALE_JDF = """
+descA [ type="collection" ]
+MT [ type="int" ]
+NT [ type="int" ]
+
+Scale(m, n)
+
+m = 0 .. MT
+n = 0 .. NT
+
+: descA( m, n )
+
+RW A <- descA( m, n )
+     -> descA( m, n )
+
+BODY
+{
+    A *= 2.0
+    A += 1.0
+}
+END
+"""
+
+
+def test_elastic_policy_validation():
+    from parsec_tpu.ft import ElasticPolicy
+
+    with pytest.raises(ValueError, match="ft_elastic"):
+        ElasticPolicy(lambda g: ([], []), mode="sideways")
+    pol = ElasticPolicy(lambda g: ([], []), mode="both", grow_min=2)
+    assert pol.allows_shrink and pol.allows_grow and pol.grow_min == 2
+    # knob unset -> mode "" -> strict (run_with_restart nulls it)
+    assert ElasticPolicy(lambda g: ([], [])).mode == ""
+
+
+def test_plan_grid_deterministic_most_square():
+    from parsec_tpu.ft import plan_grid
+
+    g4 = plan_grid((0, 1, 2, 3), 4, 0)
+    assert (g4.P, g4.Q) == (2, 2)
+    g3 = plan_grid((2, 0, 5), 6, 5)
+    assert (g3.P, g3.Q) == (3, 1)
+    assert g3.members == (0, 2, 5)      # sorted, world ranks preserved
+    # every member derives the identical layout — the agreement shortcut
+    assert plan_grid((2, 5, 0), 6, 0).members == g3.members
+
+
+def test_elastic_agreement_reconciles_divergent_votes():
+    """Coordinator-level: two survivors enter a shrink round ONE
+    SNAPSHOT APART with different taskpool wire-id counters; the commit
+    must carry the min stage (both provably wrote it) and the max
+    tp_next (so the laggard skips the ids it never assigned)."""
+    from parsec_tpu.ft.elastic import ElasticCoordinator
+
+    fab = LocalFabric(2)
+    e0, e1 = fab.engine(0), fab.engine(1)
+    c0, c1 = ElasticCoordinator(e0), ElasticCoordinator(e1)
+    out = [None, None]
+
+    def voter(co, eng, stage, tp_next, slot):
+        out[slot] = co.agree("shrink", (0, 1), stage, deadline_s=10.0,
+                             tp_next=tp_next)
+
+    import threading
+    ts = [threading.Thread(target=voter, args=(c0, e0, 3, 7, 0)),
+          threading.Thread(target=voter, args=(c1, e1, 2, 9, 1))]
+    for t in ts:
+        t.start()
+    deadline = time.monotonic() + 10.0
+    while any(t.is_alive() for t in ts) and time.monotonic() < deadline:
+        e0.progress()
+        e1.progress()
+        time.sleep(0.001)
+    for t in ts:
+        t.join(1.0)
+        assert not t.is_alive(), "agreement did not converge"
+    for got in out:
+        assert got["members"] == (0, 1)
+        assert got["stage"] == 2        # min over the divergent votes
+        assert got["tp_base"] == 9      # max over the wire-id counters
+    e0.fini()
+    e1.fini()
+
+
+def test_restart_falls_back_past_torn_snapshot(tmp_path):
+    """ISSUE 9 satellite: a snapshot torn by a rank dying mid-write
+    must not poison the next recovery — resume_from walks back to the
+    previous COMPLETE snapshot and replays from there."""
+    from parsec_tpu.dsl import ptg
+    from parsec_tpu.utils import checkpoint as ckpt
+
+    n, nb = 64, 32
+    M0 = np.arange(n * n, dtype=np.float32).reshape(n, n) / (n * n)
+    factory = ptg.compile_jdf(SCALE_JDF, name="scale_fb")
+    prefix = str(tmp_path / "fb")
+
+    def run(resume_from=None):
+        ctx = parsec_tpu.init(nb_cores=1, enable_tpu=False)
+        try:
+            A = TwoDimBlockCyclic(n, n, nb, nb,
+                                  dtype=np.float32).from_numpy(M0)
+            A.name = "descA"
+            mk = lambda: factory.new(descA=A, MT=A.mt - 1, NT=A.nt - 1)
+            return run_with_restart(
+                ctx, [mk, mk, mk], [A], prefix,
+                policy=RestartPolicy("restart", retries=0, every=1),
+                resume_from=resume_from), A.to_numpy()
+        finally:
+            ctx.fini()
+
+    stats, final = run()
+    assert stats["last_snapshot"] == 3
+    # tear the stage-2 snapshot the way a dying writer would
+    path = ckpt.checkpoint_path(f"{prefix}.stage2.c0", 0)
+    raw = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(raw[: len(raw) // 3])
+    stats2, final2 = run(resume_from=2)
+    np.testing.assert_array_equal(final2, final)   # replayed 1->3
+    assert stats2["last_snapshot"] == 3
+
+
+def test_elastic_shrink_3rank_dpotrf_recovers(tmp_path):
+    """The ISSUE 9 acceptance scenario: 3-rank checkpointed dpotrf,
+    rank 2 chaos-killed mid-factorization, ft_elastic=shrink. The
+    survivors agree on the 2-rank grid, reshard the last snapshot over
+    the DTD data plane, replay, and produce a verifiable factor — no
+    operator in the loop. Exactly one resize, reshard bytes > 0."""
+    from parsec_tpu.ft import ElasticPolicy
+    from parsec_tpu.ft.elastic import GridSpec
+    from parsec_tpu.ops import dpotrf_taskpool, make_spd
+
+    nb_ranks, n, nb = 3, 256, 32
+    M = make_spd(n)
+    prefix = str(tmp_path / "es")
+
+    def run_rank(rank, fabric):
+        eng = RemoteDepEngine(fabric.engine(rank))
+        ctx = parsec_tpu.Context(nb_cores=1, comm=eng, enable_tpu=False)
+        try:
+            def rebuild(grid: GridSpec):
+                A = grid.collection(n, n, nb, nb, dtype=np.float32)
+                A.name = "descA"
+                for (i, j) in A.local_tiles():
+                    np.copyto(A.tile(i, j),
+                              M[i * nb:(i + 1) * nb, j * nb:(j + 1) * nb])
+                return [lambda: dpotrf_taskpool(
+                    A, rank=rank, nb_ranks=nb_ranks)], [A]
+
+            _establish_all(ctx, eng, nb_ranks, rank)
+            pol = ElasticPolicy(rebuild, timeout=30.0)
+            try:
+                stats = run_with_restart(
+                    ctx, None, None, prefix,
+                    policy=RestartPolicy("restart", retries=1),
+                    elastic=pol)
+            except RuntimeError as e:
+                return (type(e.__cause__ or e).__name__, None, None, None)
+            grid = stats["grid"]
+            from parsec_tpu.ft.elastic import plan_grid
+            A = rebuild(plan_grid(grid, nb_ranks, rank))[1][0]
+            from parsec_tpu.utils import checkpoint as ckpt
+            ckpt.restore_collection(A, f"{prefix}.stage1.c0",
+                                    reshard=True, context=ctx)
+            local = {t: np.array(A.tile(*t)) for t in A.local_tiles()}
+            return ("ok", local, stats, dict(eng.ce.elastic_stats))
+        finally:
+            ctx.clear_task_errors()
+            ctx.fini()
+
+    params.set_cmdline("ft_heartbeat_interval", "0.05")
+    params.set_cmdline("ft_heartbeat_timeout", "4.0")
+    params.set_cmdline("ft_inject", "kill:rank=2:after=4")
+    params.set_cmdline("ft_elastic", "shrink")
+    results, _ = spmd(nb_ranks, run_rank, timeout=300)
+
+    assert results[2][0] in ("InjectedKill", "RankFailedError")
+    L = np.zeros_like(M)
+    for r in (0, 1):
+        st, local, stats, es = results[r]
+        assert st == "ok", results[r]
+        assert stats["grid"] == (0, 1)
+        assert stats["resizes"] == 1
+        assert es["elastic_resizes"] == 1
+        assert es["reshard_bytes"] > 0
+        for (i, j), tile in local.items():
+            L[i * nb:(i + 1) * nb, j * nb:(j + 1) * nb] = tile
+    L = np.tril(L)
+    resid = np.abs(L @ L.T - M).max() / (np.abs(M).max() * n)
+    assert resid < 1e-5, f"shrunk-grid factor residual {resid:.2e}"
+
+
+def test_elastic_grow_folds_in_late_joiner(tmp_path):
+    """Grow: two incumbents run staged scaling while rank 2 announces
+    late; at a stage boundary the grid grows to 3, the joiner reshards
+    the fresh snapshot, and the final state is bit-identical to the
+    sequential reference."""
+    from parsec_tpu.dsl import ptg
+    from parsec_tpu.ft import ElasticPolicy
+    from parsec_tpu.ft.elastic import GridSpec
+    from parsec_tpu.utils import checkpoint as ckpt
+
+    world, n, nb, nstages = 3, 96, 16, 6
+    M = np.arange(n * n, dtype=np.float32).reshape(n, n) / (n * n)
+    factory = ptg.compile_jdf(SCALE_JDF, name="scale_grow")
+    prefix = str(tmp_path / "eg")
+
+    def run_rank(rank, fabric):
+        eng = RemoteDepEngine(fabric.engine(rank))
+        ctx = parsec_tpu.Context(nb_cores=1, comm=eng, enable_tpu=False)
+        try:
+            def rebuild(grid: GridSpec):
+                A = grid.collection(n, n, nb, nb, dtype=np.float32)
+                A.name = "descA"
+                for (i, j) in A.local_tiles():
+                    np.copyto(A.tile(i, j),
+                              M[i * nb:(i + 1) * nb, j * nb:(j + 1) * nb])
+                mk = lambda: factory.new(descA=A, MT=A.mt - 1,
+                                         NT=A.nt - 1, rank=rank,
+                                         nb_ranks=world)
+                return [mk] * nstages, [A]
+
+            _establish_all(ctx, eng, world, rank)
+            pol = ElasticPolicy(rebuild, mode="grow", members=(0, 1),
+                                timeout=30.0, join=(rank == 2))
+            stats = run_with_restart(
+                ctx, None, None, prefix,
+                policy=RestartPolicy("restart", retries=0, every=1),
+                elastic=pol)
+            return ("ok", stats, dict(eng.ce.elastic_stats))
+        finally:
+            ctx.clear_task_errors()
+            ctx.fini()
+
+    params.set_cmdline("ft_heartbeat_interval", "0.05")
+    params.set_cmdline("ft_heartbeat_timeout", "15")
+    results, _ = spmd(world, run_rank, timeout=300)
+
+    for r in range(world):
+        st, stats, es = results[r]
+        assert st == "ok", results[r]
+        assert stats["grid"] == (0, 1, 2)
+        assert stats["resizes"] >= 1
+        assert es["elastic_joins"] >= 1
+        assert es["reshard_bytes"] > 0
+    # the joiner really joined (not a fresh full run)
+    assert results[2][1]["snapshots"] < results[0][1]["snapshots"]
+
+    ref = M.copy()
+    for _ in range(nstages):
+        ref = ref * 2.0 + 1.0
+    d = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float32)
+    d.name = "descA"
+    ckpt.restore_collection(d, f"{prefix}.stage{nstages}.c0",
+                            reshard=True)
+    np.testing.assert_allclose(d.to_numpy(), ref, rtol=1e-6)
+
+
+def test_elastic_gauges_registered():
+    """FT::ELASTIC_RESIZES / ELASTIC_JOINS / RESHARD_BYTES / RESHARD_US
+    ride the engine gauge registration like every other FT gauge."""
+    from parsec_tpu.obs import (FT_ELASTIC_JOINS, FT_ELASTIC_RESIZES,
+                                FT_RESHARD_BYTES, FT_RESHARD_US)
+
+    fab = LocalFabric(2)
+
+    def rank_fn(rank, fabric):
+        eng = RemoteDepEngine(fabric.engine(rank))
+        ctx = parsec_tpu.Context(nb_cores=1, comm=eng, enable_tpu=False)
+        try:
+            snap = ctx.sde.snapshot()
+            for name in (FT_ELASTIC_RESIZES, FT_ELASTIC_JOINS,
+                         FT_RESHARD_BYTES, FT_RESHARD_US):
+                assert name in snap and snap[name] == 0
+            # the gauge is LIVE against the engine counter, not a copy
+            eng.ce.elastic_stats["reshard_bytes"] += 4096
+            assert ctx.sde.snapshot()[FT_RESHARD_BYTES] == 4096
+            return True
+        finally:
+            ctx.fini()
+
+    oks, _ = spmd(2, rank_fn, fabric=fab)
+    assert oks == [True, True]

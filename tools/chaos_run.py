@@ -19,15 +19,31 @@ without editing it::
         --restart "restart:retries=2:backoff=0.1" -- \\
         examples/ex08_dposv_checkpoint.py
 
+    # sustained-load chaos: re-run the elastic recovery scenario in a
+    # loop for 10 minutes; first hang or corruption exits non-zero
+    python tools/chaos_run.py --soak 600 \\
+        --inject "kill:rank=2:after=4" --heartbeat 0.05 --timeout 2 -- \\
+        examples/ex13_elastic_shrink.py
+
 Everything after ``--`` is the script and ITS argv. Exit status: the
 script's (an uncaught injected failure exits non-zero — which is the
 point: chaos_run makes "does it fail loudly instead of hanging?"
 a one-liner).
+
+``--soak SECS`` wraps the whole thing in a sustained-load loop: the
+target is re-executed (fresh subprocess per iteration, so a leaked
+thread or wedged engine cannot carry over) until the budget is spent.
+Every iteration prints its recovery latency; the FIRST failed exit is
+corruption and the first iteration exceeding ``--soak-timeout`` is a
+hang — both stop the loop with a non-zero exit immediately, which is
+what a CI chaos gate wants from "run it under load until it breaks".
 """
 import argparse
 import os
 import runpy
+import subprocess
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -48,6 +64,16 @@ def main(argv=None) -> int:
     ap.add_argument("--restart", default="", metavar="POLICY",
                     help="ft_restart_policy, e.g. "
                          "'restart:retries=2:backoff=0.25:every=1'")
+    ap.add_argument("--soak", type=float, default=0.0, metavar="SECS",
+                    help="sustained-load mode: re-run the target in a "
+                         "loop under injection until SECS of wall time "
+                         "are spent; exit non-zero on the FIRST hang or "
+                         "failed (corrupted) iteration, print "
+                         "per-iteration recovery latency")
+    ap.add_argument("--soak-timeout", type=float, default=300.0,
+                    metavar="SECS",
+                    help="per-iteration hang deadline in soak mode "
+                         "(default 300)")
     ap.add_argument("script", help="python script to run")
     ap.add_argument("args", nargs=argparse.REMAINDER,
                     help="argv for the script (prefix with --)")
@@ -81,9 +107,65 @@ def main(argv=None) -> int:
     # drop only the LEADING separator: a later "--" belongs to the
     # target script's own argv
     args = ns.args[1:] if ns.args[:1] == ["--"] else ns.args
+
+    if ns.soak > 0:
+        return _soak(ns, script, args)
+
     sys.argv = [script] + args
     sys.path.insert(0, os.path.dirname(script))
     runpy.run_path(script, run_name="__main__")
+    return 0
+
+
+def _soak(ns, script: str, args) -> int:
+    """Sustained-load loop: one fresh subprocess per iteration (the MCA
+    env is already exported above, and re-execing chaos_run itself
+    keeps the single-run and soak paths identical). Stops at the first
+    hang (iteration over --soak-timeout) or corruption (non-zero
+    iteration), which exits non-zero right away."""
+    base = [sys.executable, os.path.abspath(__file__)]
+    if ns.inject:
+        base += ["--inject", ns.inject]
+    if ns.heartbeat > 0:
+        base += ["--heartbeat", str(ns.heartbeat)]
+    if ns.timeout > 0:
+        base += ["--timeout", str(ns.timeout)]
+    if ns.restart:
+        base += ["--restart", str(ns.restart)]
+    base += [script, "--"] + list(args)
+
+    t_end = time.monotonic() + ns.soak
+    it = 0
+    lat = []
+    while time.monotonic() < t_end:
+        it += 1
+        t0 = time.monotonic()
+        try:
+            proc = subprocess.run(
+                base, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, timeout=ns.soak_timeout)
+        except subprocess.TimeoutExpired as e:
+            out = e.stdout or ""
+            if isinstance(out, bytes):  # pragma: no cover - py<3.12 quirk
+                out = out.decode(errors="replace")
+            sys.stdout.write(out[-4000:])
+            print(f"soak: iteration {it} HUNG (> {ns.soak_timeout:.0f}s) "
+                  f"— output tail above", flush=True)
+            return 2
+        dt = time.monotonic() - t0
+        if proc.returncode != 0:
+            sys.stdout.write(proc.stdout[-4000:])
+            print(f"soak: iteration {it} FAILED rc={proc.returncode} "
+                  f"after {dt:.2f}s — output tail above", flush=True)
+            return proc.returncode
+        lat.append(dt)
+        print(f"soak: iteration {it} recovered in {dt:.2f}s", flush=True)
+    if not lat:
+        print("soak: budget too small for a single iteration", flush=True)
+        return 2
+    print(f"soak: {it} iteration(s) in {ns.soak:.0f}s budget, recovery "
+          f"latency min/mean/max = {min(lat):.2f}/"
+          f"{sum(lat) / len(lat):.2f}/{max(lat):.2f}s", flush=True)
     return 0
 
 
