@@ -914,6 +914,97 @@ def bench_comm(n_msgs=4000, bulk_mb=8, reps=2):
 
 
 # ---------------------------------------------------------------------- #
+# reliable-session microbenchmark (ISSUE 10): reconnect latency after a   #
+# link flap, replay volume, and the seq/ack envelope's throughput cost    #
+# ---------------------------------------------------------------------- #
+def bench_linkchaos(reps=3, n_msgs=2000):
+    """BENCH_MODE=linkchaos: the reliable-session layer measured three
+    ways over loopback TCP — (a) small-AM throughput with sessions ON
+    vs OFF (the K_SEQ envelope + replay-window retention overhead on
+    the fault-free fast path), (b) flap-to-recovered latency: the wall
+    from a hard link tear to the first post-fault delivery (reconnect
+    handshake + replay included), and (c) the replay/dedup volume the
+    faults actually exercised."""
+    import socket as _socket
+
+    def msgs_per_s(**knobs):
+        e0, e1 = _tcp_pair(**knobs)
+        try:
+            got = []
+            e1.tag_register(100, lambda src, p: got.append(p))
+            best = None
+            for _ in range(reps):
+                got.clear()
+                t0 = time.perf_counter()
+                for i in range(n_msgs):
+                    e0.send_am(1, 100, {"i": i})
+                deadline = time.time() + 60
+                while len(got) < n_msgs and time.time() < deadline:
+                    if not e1.progress():
+                        time.sleep(0.0002)
+                dt = time.perf_counter() - t0
+                if len(got) != n_msgs:
+                    raise RuntimeError(
+                        f"only {len(got)}/{n_msgs} messages arrived")
+                best = dt if best is None else min(best, dt)
+            return n_msgs / best
+        finally:
+            e0.fini()
+            e1.fini()
+
+    out = {}
+    base = msgs_per_s()
+    sess = msgs_per_s(reconnect_timeout=10.0)
+    out["linkchaos_msgs_per_s_session_off"] = round(base)
+    out["linkchaos_msgs_per_s_session_on"] = round(sess)
+    out["linkchaos_session_overhead_pct"] = round((base / sess - 1) * 100, 1)
+
+    # flap-to-recovered latency: tear the established socket, then time
+    # until a fresh message crosses the resumed session (reconnect
+    # handshake + gap replay are both inside the measured wall)
+    e0, e1 = _tcp_pair(reconnect_timeout=10.0, reconnect_backoff=0.02)
+    try:
+        got = []
+        e1.tag_register(100, lambda src, p: got.append(p["i"]))
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            with e0._conn_cond:
+                p01 = e0._peers.get(1)
+            if p01 is not None and p01.rs_ok:
+                break
+            time.sleep(0.005)
+        lats = []
+        seq = 0
+        for _ in range(reps):
+            # a burst in flight when the link tears -> real replay work
+            for _ in range(50):
+                e0.send_am(1, 100, {"i": seq})
+                seq += 1
+            t0 = time.perf_counter()
+            p01.sock.shutdown(_socket.SHUT_RDWR)
+            e0.send_am(1, 100, {"i": seq})
+            seq += 1
+            deadline = time.time() + 30
+            while len(got) < seq and time.time() < deadline:
+                if not e1.progress():
+                    time.sleep(0.0002)
+            if len(got) != seq:
+                raise RuntimeError(
+                    f"only {len(got)}/{seq} messages after the flap")
+            lats.append((time.perf_counter() - t0) * 1e3)
+        assert got == list(range(seq)), "delivery not exactly-once/ordered"
+        out["linkchaos_reconnect_ms"] = round(min(lats), 2)
+        out["linkchaos_reconnect_ms_max"] = round(max(lats), 2)
+        out["linkchaos_reconnects"] = e0.wire_stats["reconnects"]
+        out["linkchaos_replayed_frames"] = e0.wire_stats["replayed_frames"]
+        out["linkchaos_dup_dropped"] = e1.wire_stats["dup_dropped"]
+    finally:
+        e0.fini()
+        e1.fini()
+    return out
+
+
+# ---------------------------------------------------------------------- #
 # fault-tolerance microbenchmark (ISSUE 4): heartbeat detection latency   #
 # over loopback TCP + snapshot/rollback overhead of the restart driver    #
 # ---------------------------------------------------------------------- #
@@ -1555,6 +1646,13 @@ def main() -> None:
         print(json.dumps({
             "metric": "ft_detection_latency_ms(loopback_tcp,hb_10ms)",
             "value": extras["ft_detection_latency_ms"],
+            "unit": "ms", "extras": extras}))
+        return
+    if mode == "linkchaos":
+        extras = bench_linkchaos(reps=reps)
+        print(json.dumps({
+            "metric": "linkchaos_reconnect_ms(loopback_tcp,flap+replay)",
+            "value": extras["linkchaos_reconnect_ms"],
             "unit": "ms", "extras": extras}))
         return
     if mode == "elastic":

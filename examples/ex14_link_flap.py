@@ -1,0 +1,168 @@
+"""Ex14: link-fault resilience — 3-rank checkpointed dpotrf over REAL
+TCP sockets that survives a link flap WITHOUT any grid resize
+(ISSUE 10).
+
+The same scenario as ex13 (three ranks, ``ft.run_with_restart``,
+snapshots every stage) but the ranks talk over the TCP comm engine on
+localhost, so the reliable-session layer has an actual wire to tear.
+Run it under ``tools/chaos_run.py``:
+
+- a ``flap:`` inside the ``--reconnect`` budget is ABSORBED: the torn
+  link goes SUSPECT, reconnects, replays the unacked frames, and the
+  factorization completes on the FULL grid with zero evictions and
+  zero elastic resizes (``RECONNECTS >= 1``, ``REPLAYED > 0``);
+- a ``disconnect:`` (the link never comes back) exhausts the budget
+  and escalates through the ordinary rank-failure path: with
+  ``ft_elastic=shrink`` the majority side reshards onto the reduced
+  grid (the PR 9 machinery), while the isolated minority rank refuses
+  a split-brain resize and aborts.
+
+Run::
+
+    # transient: completes on the full grid, exit 0, no resizes
+    PARSEC_MCA_ft_elastic=shrink python tools/chaos_run.py \\
+        --reconnect 10 --inject "flap:rank=2:nth=8:duration=0.2" \\
+        --heartbeat 0.05 --timeout 3 -- examples/ex14_link_flap.py
+
+    # permanent: survivors shrink to (0, 1), rank 2 aborts, exit 0
+    PARSEC_MCA_ft_elastic=shrink python tools/chaos_run.py \\
+        --reconnect 1.5 --inject "disconnect:rank=2:nth=8" \\
+        --heartbeat 0.05 --timeout 3 -- examples/ex14_link_flap.py
+"""
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import parsec_tpu  # noqa: E402
+from parsec_tpu.comm import RemoteDepEngine  # noqa: E402
+from parsec_tpu.comm.tcp import TCPCommEngine, free_ports  # noqa: E402
+from parsec_tpu.ft import (ElasticPolicy, RestartPolicy,  # noqa: E402
+                           run_with_restart)
+from parsec_tpu.ft.elastic import GridSpec, plan_grid  # noqa: E402
+from parsec_tpu.ops import dpotrf_taskpool, make_spd  # noqa: E402
+from parsec_tpu.utils.spmd import spmd_threads  # noqa: E402
+
+NB_RANKS, N, NB = 3, 256, 32
+
+
+def _establish_all(ctx, eng, nb_ranks, rank):
+    """Heartbeat contact with every peer before the workload (the
+    steady state a long-running job is in when a link tears)."""
+    det = ctx._ft_detector
+    if det is None:
+        return
+    deadline = time.monotonic() + 30.0
+    while any(not det.is_established(p)
+              for p in range(nb_ranks) if p != rank):
+        assert time.monotonic() < deadline, "heartbeat never established"
+        eng.ce.progress()
+        time.sleep(0.002)
+    eng.ce.sync()
+
+
+def run_rank(rank, eps, M, prefix):
+    ce = TCPCommEngine(rank, eps)
+    eng = RemoteDepEngine(ce)
+    ctx = parsec_tpu.Context(nb_cores=1, comm=eng, enable_tpu=False)
+    try:
+        def rebuild(grid: GridSpec):
+            A = grid.collection(N, N, NB, NB, dtype=np.float32)
+            A.name = "descA"
+            for (i, j) in A.local_tiles():
+                np.copyto(A.tile(i, j),
+                          M[i * NB:(i + 1) * NB, j * NB:(j + 1) * NB])
+            stages = [lambda: dpotrf_taskpool(A, rank=rank,
+                                              nb_ranks=NB_RANKS)]
+            return stages, [A]
+
+        _establish_all(ctx, eng, NB_RANKS, rank)
+        policy = RestartPolicy("restart", retries=1, every=1)
+        pol = ElasticPolicy(rebuild)
+        try:
+            if pol.mode:
+                stats = run_with_restart(ctx, None, None, prefix,
+                                         policy=policy, elastic=pol)
+                grid = plan_grid(stats["grid"], NB_RANKS, rank)
+                _, (A,) = rebuild(grid)  # same layout the run ended on
+                # rebuild reinitialized tiles: pull the FINAL state back
+                from parsec_tpu.utils import checkpoint as ckpt
+                ckpt.restore_collection(
+                    A, f"{prefix}.stage{stats['stages']}.c0",
+                    reshard=True, context=ctx)
+            else:
+                stages, (A,) = rebuild(plan_grid(
+                    tuple(range(NB_RANKS)), NB_RANKS, rank))
+                stats = run_with_restart(ctx, stages, [A], prefix,
+                                         policy=policy)
+            local = {t: np.array(A.tile(*t)) for t in A.local_tiles()
+                     if A.rank_of(*t) == rank}
+            return ("ok", local, stats, dict(ce.elastic_stats),
+                    dict(ce.wire_stats))
+        except RuntimeError as e:
+            root = e.__cause__ or e
+            return (type(root).__name__, None, None,
+                    dict(ce.elastic_stats), dict(ce.wire_stats))
+    finally:
+        ctx.clear_task_errors()
+        ctx.fini()
+
+
+def main() -> int:
+    M = make_spd(N)
+    ports = free_ports(NB_RANKS)
+    eps = [("127.0.0.1", p) for p in ports]
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "ck")
+        results, _ = spmd_threads(
+            NB_RANKS, lambda r, f: run_rank(r, eps, M, prefix),
+            timeout=600)
+
+    ok = [r for r, out in enumerate(results) if out[0] == "ok"]
+    dead = [r for r, out in enumerate(results) if out[0] != "ok"]
+    for r, out in enumerate(results):
+        es = out[3] or {}
+        ws = out[4] or {}
+        print(f"rank {r}: {out[0]} stats={out[2]} "
+              f"ELASTIC_RESIZES={es.get('elastic_resizes', 0)} "
+              f"RESHARD_BYTES={es.get('reshard_bytes', 0)} "
+              f"RECONNECTS={ws.get('reconnects', 0)} "
+              f"REPLAYED={ws.get('replayed_frames', 0)} "
+              f"DUP_DROPPED={ws.get('dup_dropped', 0)}")
+    if not ok:
+        print("ex14: every rank aborted")
+        return 1
+
+    # the completed ranks must agree on the final grid and hold ALL
+    # tiles of a verifiable Cholesky factor between them
+    grids = {results[r][2]["grid"] for r in ok}
+    if len(grids) != 1:
+        print(f"ex14: completed ranks disagree on the final grid: {grids}")
+        return 1
+    (grid,) = grids
+    if grid is None:               # strict path reports no grid
+        grid = tuple(range(NB_RANKS))
+    if set(grid) != set(ok):
+        print(f"ex14: final grid {grid} != completed ranks {ok}")
+        return 1
+    L = np.zeros_like(M)
+    for r in ok:
+        for (i, j), tile in results[r][1].items():
+            L[i * NB:(i + 1) * NB, j * NB:(j + 1) * NB] = tile
+    L = np.tril(L)
+    resid = (np.abs(L @ L.T - M).max()
+             / (np.abs(M).max() * N))
+    print(f"ex14: dpotrf n={N} nb={NB} finished on grid {grid} "
+          f"(lost: {dead}); residual {resid:.2e}")
+    if resid >= 1e-5:
+        print("ex14: residual above the dpotrf gate")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

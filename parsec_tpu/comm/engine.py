@@ -240,6 +240,22 @@ class CommEngine:
         override this."""
         return False
 
+    def peer_suspect(self, peer: int) -> bool:
+        """True while ``peer``'s link is torn but a reliable session is
+        still reconnecting inside its budget (comm/tcp.py, ISSUE 10) —
+        a TRANSIENT fault, not a death. Consumers park instead of
+        escalating: the heartbeat detector defers its verdict (probes
+        cannot cross a torn link, so the silence proves nothing) and
+        remote_dep skips prefetching from the peer. Transports without
+        sessions never suspect."""
+        return False
+
+    def ft_link_fault(self, peer: int) -> None:
+        """Chaos hook (ft/inject.py ``flap:``/``disconnect:``): tear
+        this rank's link(s) toward ``peer`` without killing anything.
+        Only socket transports have a link to tear; the in-process
+        fabrics ignore it."""
+
     def ft_silence(self) -> None:
         """Injected kill (ft/inject.py): the engine goes dark — drops
         all inbound and outbound traffic and answers no heartbeats,
@@ -262,6 +278,13 @@ class CommEngine:
         verdict = ft.on_send(dst, tag)
         if verdict == "drop":
             return 0
+        if verdict == "flap":
+            # the injector marked the link down: hard-close the
+            # socket(s) FIRST, so this frame is accepted-but-unsent —
+            # under a session it parks and replays, without one the
+            # loss is loud (lost_sends), exactly like a real link fault
+            self.ft_link_fault(dst)
+            return 1
         return 2 if verdict == "dup" else 1
 
     def ft_ping(self, peer: int, seq: int, t_ns: int) -> bool:

@@ -469,6 +469,12 @@ class RemoteDepEngine:
             return None
         if not msg["edges"].get(self.rank):
             return None   # pure-forwarding hop: children fetch themselves
+        if self.ce.peer_suspect(msg["data_rank"]):
+            # the producer's link is flapping (reliable-session SUSPECT,
+            # comm/tcp.py): a prefetched GET would just pin one of the
+            # bounded in-flight slots on a parked reply — let the
+            # ordinary delivery path fetch once the session resumes
+            return None
         key = (msg["data_rank"], msg["handle"])
         if key in self._prefetched_gets \
                 or self._prefetch_inflight >= self._prefetch_budget:

@@ -32,7 +32,26 @@ The wire fast path (framing in comm/wire.py):
   0 = never) AND a sample probe shows the traffic compresses; a peer
   that never advertises codecs (HELLO missing or no common codec)
   stays uncompressed. The v2 framing itself is a breaking wire change:
-  every rank of a job must run the same framing version.
+  every rank of a job must run the same framing version;
+- RELIABLE SESSIONS (``comm_reconnect_timeout`` > 0, HELLO ``"rs"``
+  capability): each peer link is a session — data frames carry a
+  per-direction ``seq`` (wire.K_SEQ envelope), the writer retains a
+  bounded replay window of sent-but-unacked frames
+  (``comm_replay_window_bytes``; the retained bytes also count against
+  the ``comm_send_buffer_bytes`` backpressure budget), and the
+  receiver acks cumulatively (K_ACK) and discards duplicates by seq.
+  A socket error then marks the peer SUSPECT instead of dead: senders
+  park on the bounded send buffer, in-flight GETs/rendezvous wait, and
+  a reconnector re-dials with exponential backoff + jitter under the
+  ``comm_reconnect_timeout`` budget. The reconnect handshake
+  (K_RESUME) exchanges the session epoch and last-delivered seq both
+  ways — the sender replays the gap (byte-level resume of a frame
+  truncated mid-body, K_FRAG), the receiver dedups, and no active
+  message is lost or delivered twice. Only budget exhaustion — or the
+  heartbeat detector's independent verdict once the session is live
+  again — escalates to the ``_peer_died`` → elastic/fail-fast path. A
+  mixed-version peer (no ``"rs"`` in its HELLO) or an unset knob keeps
+  today's fail-fast behavior bit for bit.
 
 This is the DCN control-plane story of SURVEY.md §5.8 made concrete: on
 a multi-host TPU deployment the small latency-bound messages travel this
@@ -78,6 +97,19 @@ _MAX_BATCH_MSGS = 256
 #: chunks waiting, one chunk is interleaved regardless — a sustained
 #: control stream must not stall an in-flight bulk transfer forever
 _CTRL_STREAK_MAX = 8
+#: reliable sessions: how long a writer holds DATA frames waiting for
+#: the peer's HELLO before assuming a mixed-version (session-less) peer
+#: — frames sent before capabilities are known cannot ride the replay
+#: window, so with sessions enabled locally the first data frame waits
+#: for the capability exchange (every current build HELLOs first-thing,
+#: so this only delays traffic toward true pre-HELLO builds)
+_HELLO_GRACE = 5.0
+#: receiver ack cadence: a cumulative K_ACK at latest every this many
+#: delivered data frames (the byte threshold adapts to the window cap)
+_ACK_EVERY_FRAMES = 16
+#: reconnect backoff ceiling (seconds; doubles from the configured
+#: initial value, with multiplicative jitter against thundering herds)
+_RECONNECT_BACKOFF_MAX = 2.0
 
 #: declared lock discipline, enforced by the concurrency lint
 #: (parsec_tpu/analysis/lock_check.py): per-peer send queues belong to
@@ -90,10 +122,26 @@ _GUARDED_BY = {
     "_Peer.ctrl": "cond",
     "_Peer.bulk": "cond",
     "_Peer.queued_bytes": "cond",
+    # reliable-session state (ISSUE 10): suspect flag, send/receive seq
+    # counters, the replay window + its byte accounting, the pending
+    # replay list and the receiver's partial-frame resume buffer are
+    # shared between the writer thread, the receiver thread, every
+    # sender parked in backpressure, and the reconnector — all under
+    # the peer's condition (resume swaps threads only after the old
+    # generation has exited, but the STATE handoff itself is locked)
+    "_Peer.suspect": "cond",
+    "_Peer.rs_epoch": "cond",
+    "_Peer.rs_tx_seq": "cond",
+    "_Peer.rs_rx_seq": "cond",
+    "_Peer.rs_window": "cond",
+    "_Peer.rs_window_bytes": "cond",
+    "_Peer.rs_replay": "cond",
+    "_Peer.rs_rx_partial": "cond",
     "TCPCommEngine._peers": "_conn_cond",
     "TCPCommEngine.wire_stats": "_stat_lock",
     "TCPCommEngine._rx_pending": "_stat_lock",
     "TCPCommEngine._xfer_iter": "_stat_lock",
+    "TCPCommEngine._suspect_ms_total": "_stat_lock",
     "TCPCommEngine._barrier_arrived": "_barrier_lock",
     "TCPCommEngine._barrier_release": "_barrier_lock",
 }
@@ -151,7 +199,13 @@ class _Peer:
 
     __slots__ = ("rank", "sock", "ctrl", "bulk", "cond", "writer",
                  "goodbye", "bw_mbps", "codec", "engaged", "frames",
-                 "probe_ratio", "done", "queued_bytes", "hb_ok", "el_ok")
+                 "probe_ratio", "done", "queued_bytes", "hb_ok", "el_ok",
+                 "rs_ok", "hello_seen", "connected_at", "conn_gen",
+                 "suspect", "suspect_since", "rs_epoch", "rs_tx_seq",
+                 "rs_rx_seq", "rs_window", "rs_window_bytes", "rs_replay",
+                 "rs_rx_unacked_frames", "rs_rx_unacked_bytes",
+                 "rs_rx_partial", "rx_xfers", "recv_thread", "rs_dup_next",
+                 "rs_resuming")
 
     def __init__(self, rank: int, sock: socket.socket) -> None:
         self.rank = rank
@@ -170,6 +224,36 @@ class _Peer:
         self.probe_ratio: Optional[float] = None
         self.hb_ok = False         # HELLO advertised heartbeat support
         self.el_ok = False         # HELLO advertised elastic membership
+        # -- reliable session (ISSUE 10) --------------------------------
+        self.rs_ok = False         # both ends advertised "rs"
+        self.hello_seen = False    # the peer's HELLO was processed
+        self.connected_at = time.monotonic()
+        self.conn_gen = 0          # bumped at each resume: stale-thread guard
+        self.suspect = False       # link torn, reconnect in progress
+        self.suspect_since = 0.0
+        self.rs_epoch = 0          # bumped at each successful resume
+        self.rs_tx_seq = 0         # last seq assigned to a sent data frame
+        self.rs_rx_seq = 0         # last seq DELIVERED from the peer
+        self.rs_window: deque = deque()   # (seq, frame pieces, nbytes)
+        self.rs_window_bytes = 0
+        self.rs_replay: list = []  # resume backlog the new writer sends first
+        self.rs_rx_unacked_frames = 0      # receiver-side ack cadence
+        self.rs_rx_unacked_bytes = 0
+        # (total body size, bytes received so far) of a frame the link
+        # tore mid-body — fed to K_RESUME as the byte-level resume claim
+        self.rs_rx_partial: Optional[Tuple[int, bytearray]] = None
+        # receive-side chunked-transfer reassembly lives on the PEER so
+        # a transfer half-landed when the link flapped completes from
+        # the replayed chunks after the resume
+        self.rx_xfers: Dict[int, wire.RxXfer] = {}
+        self.recv_thread: Optional[threading.Thread] = None
+        # chaos (ft_inject dup): duplicate the next data frame at the
+        # WIRE level — same seq, so the receiver's dedup is what keeps
+        # the active message exactly-once
+        self.rs_dup_next = False
+        # accept-side resume in flight (handshakes run on their own
+        # threads now; a duplicate concurrent dial must not race one)
+        self.rs_resuming = False
 
 
 class TCPCommEngine(LocalCommEngine):
@@ -182,7 +266,10 @@ class TCPCommEngine(LocalCommEngine):
                  connect_timeout: float = 30.0,
                  coalesce_max_bytes: Optional[int] = None,
                  chunk_bytes: Optional[int] = None,
-                 compress_threshold_mbps: Optional[float] = None) -> None:
+                 compress_threshold_mbps: Optional[float] = None,
+                 reconnect_timeout: Optional[float] = None,
+                 reconnect_backoff: Optional[float] = None,
+                 replay_window_bytes: Optional[int] = None) -> None:
         from ..utils.params import params
         self._inbox: Fifo = Fifo()
         self._peers: Dict[int, _Peer] = {}
@@ -212,6 +299,26 @@ class TCPCommEngine(LocalCommEngine):
             else params.get_or("comm_compress_threshold_mbps", "int", 0))
         self.send_buffer_bytes = max(
             1, params.get_or("comm_send_buffer_bytes", "sizet", 1 << 26))
+        # reliable sessions (ISSUE 10): a torn link becomes a SUSPECT
+        # peer with reconnect + seq-numbered replay while the knob's
+        # budget lasts; 0/unset keeps today's fail-fast bit for bit
+        if reconnect_timeout is None:
+            raw = str(params.get("comm_reconnect_timeout") or "").strip()
+            reconnect_timeout = float(raw) if raw else 0.0
+        self.reconnect_timeout = max(0.0, float(reconnect_timeout))
+        self._rs_enabled = self.reconnect_timeout > 0
+        if reconnect_backoff is None:
+            raw = str(params.get("comm_reconnect_backoff") or "").strip()
+            reconnect_backoff = float(raw) if raw else 0.05
+        self.reconnect_backoff = max(1e-3, float(reconnect_backoff))
+        self.replay_window_bytes = max(
+            1, replay_window_bytes if replay_window_bytes is not None
+            else params.get_or("comm_replay_window_bytes", "sizet", 1 << 24))
+        #: ack at latest every _ACK_EVERY_FRAMES delivered data frames
+        #: or this many delivered bytes, whichever first — sized so the
+        #: sender's replay window drains well before it fills
+        self._ack_bytes = max(1, min(1 << 18, self.replay_window_bytes // 4))
+        self._suspect_ms_total = 0.0
         self._codecs = wire.available_codecs()
         #: wire fast-path counters (plain dict: obs polls it when
         #: telemetry is on, nothing on the hot path otherwise)
@@ -220,6 +327,9 @@ class TCPCommEngine(LocalCommEngine):
             "batches": 0, "chunks_sent": 0, "chunk_bytes_sent": 0,
             "frames_compressed": 0, "bytes_precompress": 0,
             "bytes_postcompress": 0, "msgs_chunked": 0,
+            # reliable-session counters (RECONNECTS / REPLAYED_FRAMES /
+            # DUP_DROPPED gauges ride these)
+            "reconnects": 0, "replayed_frames": 0, "dup_dropped": 0,
         }
         super().__init__(_FabricShim(len(endpoints)), rank)
         self.endpoints = endpoints
@@ -273,11 +383,23 @@ class TCPCommEngine(LocalCommEngine):
                 sock.settimeout(None)
                 (peer,) = struct.unpack("<I", hdr)
                 with self._conn_cond:
-                    known = peer in self._peers
-                if peer >= self.nb_ranks or peer == self.rank or known:
-                    # stray/duplicate connection: never displace a real
-                    # peer's socket
+                    known = self._peers.get(peer)
+                if peer >= self.nb_ranks or peer == self.rank:
                     sock.close()
+                    continue
+                if known is not None:
+                    # a re-dial from a known peer: a session resume when
+                    # both ends negotiated "rs" (the peer may have seen
+                    # the link fault before we did), else a stray
+                    # duplicate that must never displace a real socket.
+                    # Handled OFF the accept thread: one peer's slow
+                    # handshake (or the thread joins inside the resume)
+                    # must not stall every other peer's reconnect past
+                    # its budget.
+                    threading.Thread(
+                        target=self._accept_resume, args=(known, sock),
+                        daemon=True,
+                        name=f"tcp-resume-r{self.rank}p{peer}").start()
                     continue
                 self._register_conn(peer, sock)
         except OSError:
@@ -289,21 +411,26 @@ class TCPCommEngine(LocalCommEngine):
             self._peers[peer] = p
             self._conn_cond.notify_all()
         p.writer = threading.Thread(
-            target=self._writer_loop, args=(p,), daemon=True,
+            target=self._writer_loop, args=(p, 0), daemon=True,
             name=f"tcp-send-r{self.rank}p{peer}")
         p.writer.start()
-        t = threading.Thread(target=self._recv_loop, args=(peer, sock),
+        t = threading.Thread(target=self._recv_loop, args=(p, sock, 0),
                              daemon=True, name=f"tcp-recv-r{self.rank}p{peer}")
+        p.recv_thread = t
         t.start()
-        self._recv_threads.append(t)
+        with self._conn_cond:
+            self._recv_threads.append(t)
         # capability advertisement: the receiving end only ever
         # compresses toward us after seeing this (mixed-version peers
-        # never send one and stay on the uncompressed path)
+        # never send one and stay on the uncompressed path); "rs" is
+        # advertised only when reconnect sessions are enabled locally,
+        # so a peer with the knob unset keeps fail-fast on BOTH ends
         hello = wire.pack_hello({"ver": wire.WIRE_VERSION,
                                  "rank": self.rank,
                                  "codecs": self._codecs,
                                  "hb": True,
-                                 "el": True})
+                                 "el": True,
+                                 "rs": self._rs_enabled})
         with p.cond:
             p.ctrl.append(("frame", hello))
             p.queued_bytes += len(hello)
@@ -356,6 +483,359 @@ class TCPCommEngine(LocalCommEngine):
             post = self.wire_stats["bytes_postcompress"]
         return (post / pre) if pre else None
 
+    # -- reliable sessions (ISSUE 10) -----------------------------------
+    def peer_suspect(self, peer: int) -> bool:
+        """True while ``peer``'s link is torn but its session is still
+        inside the reconnect budget — the transient-vs-permanent
+        distinction consumers park on (detector deferral, prefetch
+        throttling) instead of treating every socket error as death."""
+        with self._conn_cond:
+            p = self._peers.get(peer)
+        if p is None:
+            return False
+        with p.cond:
+            return p.suspect
+
+    def suspect_ms(self) -> float:
+        """Cumulative milliseconds peers of this rank have spent in
+        SUSPECT (completed episodes plus any live one) — the
+        COMM::SUSPECT_MS gauge."""
+        with self._stat_lock:
+            total = self._suspect_ms_total
+        now = time.monotonic()
+        with self._conn_cond:
+            peers = list(self._peers.values())
+        for p in peers:
+            with p.cond:
+                if p.suspect:
+                    total += (now - p.suspect_since) * 1e3
+        return round(total, 3)
+
+    def _session_suspect(self, p: _Peer, gen: int, reason: str) -> bool:
+        """A writer/receiver of connection generation ``gen`` hit a
+        socket fault. Returns True when the fault is ABSORBED by the
+        session layer (peer parked as SUSPECT, reconnector running —
+        or the fault belongs to an already-replaced generation); False
+        means no session covers this link and the caller must take the
+        fail-fast ``_peer_died`` path."""
+        if not self._rs_enabled:
+            return False
+        with p.cond:
+            if p.conn_gen != gen:
+                return True   # stale thread of a resumed connection
+            if not p.rs_ok or p.done:
+                return False
+        if self._closing or self._ft_silenced \
+                or p.rank in self.dead_peers \
+                or p.rank in self.finished_peers:
+            return False
+        first = False
+        with p.cond:
+            if not p.suspect:
+                p.suspect = True
+                p.suspect_since = time.monotonic()
+                first = True
+            p.cond.notify_all()
+        if first:
+            # kick the other thread of this generation out of its
+            # blocking socket call so both land here (idempotent)
+            try:
+                p.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                p.sock.close()
+            except OSError:
+                pass
+            plog.warning(
+                "tcp rank %d: peer %d SUSPECT (%s) — reconnecting for "
+                "up to %.1fs", self.rank, p.rank, reason,
+                self.reconnect_timeout)
+            threading.Thread(
+                target=self._reconnector, args=(p, gen), daemon=True,
+                name=f"tcp-reconnect-r{self.rank}p{p.rank}").start()
+        return True
+
+    def _reconnector(self, p: _Peer, gen: int) -> None:
+        """Drive one SUSPECT episode: the side that originally dialed
+        (the higher rank) re-dials with exponential backoff + jitter;
+        the accepting side waits passively (``_accept_resume`` does the
+        work when the peer's dial lands). Either way the episode is
+        bounded by ``comm_reconnect_timeout``: expiry escalates to the
+        fail-fast path with ``lost_sends`` (the replay window holds
+        accepted frames that will now never be delivered)."""
+        import random
+        with p.cond:
+            deadline = p.suspect_since + self.reconnect_timeout
+        delay = self.reconnect_backoff
+        rng = random.Random((self.rank << 16) ^ p.rank ^ id(p))
+        while True:
+            if self._closing or self._ft_silenced \
+                    or p.rank in self.dead_peers \
+                    or p.rank in self.finished_peers:
+                return
+            with p.cond:
+                if not p.suspect or p.conn_gen != gen or p.done:
+                    return   # resumed (or escalated elsewhere)
+            now = time.monotonic()
+            if now >= deadline:
+                with p.cond:
+                    if not p.suspect or p.conn_gen != gen or p.done:
+                        return
+                    p.done = True   # tombstone: no late resume may land
+                    p.suspect = False
+                    dur_ms = (now - p.suspect_since) * 1e3
+                with self._stat_lock:
+                    self._suspect_ms_total += dur_ms
+                self._peer_died(
+                    p.rank,
+                    f"reconnect budget exhausted "
+                    f"({self.reconnect_timeout:.1f}s)", lost_sends=True)
+                return
+            ft = self._ft
+            link_down = ft is not None and ft.link_down(p.rank)
+            if self.rank > p.rank and not link_down:
+                try:
+                    self._dial_resume(p, gen)
+                    return
+                except (OSError, ValueError):
+                    pass   # next attempt after backoff
+            time.sleep(min(delay, max(0.0, deadline - time.monotonic())))
+            delay = min(delay * 2.0, _RECONNECT_BACKOFF_MAX) \
+                * (1.0 + 0.25 * rng.random())
+
+    def _send_frame_direct(self, sock: socket.socket, body: bytes) -> None:
+        sock.sendall(struct.pack("<Q", len(body)) + body)
+
+    def _recv_frame_direct(self, sock: socket.socket) -> memoryview:
+        hdr = self._recv_exact(sock, 8)
+        if hdr is None:
+            raise OSError("connection closed during session resume")
+        (size,) = struct.unpack("<Q", hdr)
+        if size > (1 << 20):
+            raise ValueError(f"oversized resume frame ({size} bytes)")
+        body = self._recv_exact(sock, size)
+        if body is None:
+            raise OSError("connection closed during session resume")
+        return memoryview(body)
+
+    def _partial_claim_locked(self, p: _Peer) -> Optional[Dict[str, int]]:
+        # holds: p.cond
+        """The byte-level resume claim for K_RESUME: only a partial
+        body that provably is the NEXT expected data frame (a complete
+        K_SEQ header with seq == last delivered + 1) can resume
+        mid-frame; anything else (truncated header, a torn session-less
+        frame) is discarded and the sender replays whole frames."""
+        part = p.rs_rx_partial
+        if part is None:
+            return None
+        size, buf = part
+        pref = wire.parse_seq_prefix(buf)
+        if pref is not None and pref[1] == p.rs_rx_seq + 1 \
+                and 0 < len(buf) < size:
+            return {"seq": pref[1], "off": len(buf)}
+        p.rs_rx_partial = None
+        return None
+
+    def _dial_resume(self, p: _Peer, gen: int) -> None:
+        """One reconnect attempt from the dialing side; raises
+        OSError/ValueError on any failure (the reconnector retries)."""
+        host, port = self.endpoints[p.rank]
+        sock = socket.create_connection((host, port), timeout=2.0)
+        ok = False
+        try:
+            sock.settimeout(5.0)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock.sendall(struct.pack("<I", self.rank))
+            with p.cond:
+                epoch = p.rs_epoch + 1
+                info = {"rank": self.rank, "epoch": epoch,
+                        "ack": p.rs_rx_seq,
+                        "partial": self._partial_claim_locked(p)}
+            self._send_frame_direct(sock, wire.pack_resume(info))
+            body = self._recv_frame_direct(sock)
+            if body[0] != wire.K_RESUME:
+                raise ValueError("peer did not answer the session resume")
+            reply = wire.parse_resume(body)
+            if int(reply.get("epoch", -1)) != epoch:
+                raise ValueError("session epoch mismatch at resume")
+            sock.settimeout(None)
+            self._session_resume(p, sock, epoch, int(reply["ack"]),
+                                 reply.get("partial"))
+            ok = True
+        finally:
+            if not ok:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+    def _accept_resume(self, p: _Peer, sock: socket.socket) -> None:
+        """The accepting half of a session resume (a known peer
+        re-dialed us). Anything short of a valid K_RESUME from a
+        session-capable peer is a stray duplicate connection and is
+        closed, exactly as before."""
+        ft = self._ft
+        if not (self._rs_enabled and not self._closing) \
+                or p.rank in self.dead_peers \
+                or p.rank in self.finished_peers \
+                or (ft is not None and ft.link_down(p.rank)):
+            sock.close()
+            return
+        with p.cond:
+            rs_ok = p.rs_ok and not p.done and not p.rs_resuming
+            if rs_ok:
+                p.rs_resuming = True
+        if not rs_ok:
+            sock.close()
+            return
+        try:
+            sock.settimeout(5.0)
+            body = self._recv_frame_direct(sock)
+            if body[0] != wire.K_RESUME:
+                raise ValueError("known peer re-dialed without K_RESUME")
+            info = wire.parse_resume(body)
+            epoch = int(info["epoch"])
+            with p.cond:
+                gen = p.conn_gen
+                # equal epochs are RESUMABLE, not stale: if our side
+                # committed epoch N but the dialer's half of that
+                # handshake failed (link tore again around the reply),
+                # its retries keep proposing N — rejecting them would
+                # dead-end a healthy link until the budget expires.
+                # Only a strictly OLDER epoch is a stray duplicate.
+                if epoch < p.rs_epoch:
+                    raise ValueError("stale session epoch at resume")
+            # the peer noticed the fault first: tear our half down too
+            # so the old generation's threads exit before the handoff
+            if not self._session_suspect(p, gen,
+                                         "peer initiated session resume"):
+                raise ValueError("session no longer resumable")
+            with p.cond:
+                reply = {"rank": self.rank, "epoch": epoch,
+                         "ack": p.rs_rx_seq,
+                         "partial": self._partial_claim_locked(p)}
+            self._send_frame_direct(sock, wire.pack_resume(reply))
+            sock.settimeout(None)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._session_resume(p, sock, epoch, int(info["ack"]),
+                                 info.get("partial"))
+        except (OSError, ValueError) as exc:
+            plog.debug.verbose(
+                1, "tcp rank %d: resume from peer %d rejected (%s)",
+                self.rank, p.rank, exc)
+            try:
+                sock.close()
+            except OSError:
+                pass
+        finally:
+            with p.cond:
+                p.rs_resuming = False
+
+    def _session_resume(self, p: _Peer, sock: socket.socket, epoch: int,
+                        their_ack: int,
+                        their_partial: Optional[Dict[str, int]]) -> None:
+        """Install a re-established connection: trim the replay window
+        to the peer's cumulative ack, stage the unacked gap (byte-level
+        frag of a mid-frame truncation first, then whole frames) for
+        the new writer, bump the generation so stale threads stand
+        down, and start fresh writer/receiver threads."""
+        # the old generation's threads saw their socket die when the
+        # suspect transition closed it; wait for them so no stale
+        # writer can interleave on the NEW socket (thread joins are
+        # blocking — strictly outside every lock)
+        old_writer, old_recv = p.writer, p.recv_thread
+        for t in (old_writer, old_recv):
+            if t is not None and t is not threading.current_thread():
+                t.join(timeout=10.0)
+                if t.is_alive():  # pragma: no cover - wedged handler
+                    raise ValueError("previous connection generation "
+                                     "did not exit; resume aborted")
+        with p.cond:
+            if p.done or p.rank in self.dead_peers:
+                raise ValueError("session escalated before resume landed")
+            while p.rs_window and p.rs_window[0][0] <= their_ack:
+                _seq, _pieces, nb = p.rs_window.popleft()
+                p.rs_window_bytes -= nb
+            replay: list = []
+            entries = list(p.rs_window)
+            if entries and their_partial:
+                seq0, pieces0, _nb0 = entries[0]
+                off = int(their_partial.get("off", 0))
+                if int(their_partial.get("seq", -1)) == seq0:
+                    body0 = b"".join(bytes(x) for x in pieces0)
+                    if 0 < off < len(body0):
+                        replay.append([wire.pack_frag(epoch, seq0, off),
+                                       body0[off:]])
+                        entries = entries[1:]
+            for _seq, pieces, _nb in entries:
+                replay.append(list(pieces))
+            p.rs_replay = replay
+            p.rs_epoch = epoch
+            p.conn_gen += 1
+            gen = p.conn_gen
+            p.sock = sock
+            p.suspect = False
+            dur_ms = (time.monotonic() - p.suspect_since) * 1e3
+            nreplay = len(replay)
+            p.cond.notify_all()
+        with self._stat_lock:
+            self.wire_stats["reconnects"] += 1
+            self.wire_stats["replayed_frames"] += nreplay
+            self._suspect_ms_total += dur_ms
+        # a completed resume handshake is proof of life: reset the
+        # heartbeat silence baseline so the detector does not evict the
+        # peer in the race between the resume and its first fresh pong
+        det = self.ft_detector
+        if det is not None:
+            det.note_alive(p.rank)
+        plog.warning(
+            "tcp rank %d: session to peer %d RESUMED after %.0f ms "
+            "(epoch %d, replaying %d frame(s))", self.rank, p.rank,
+            dur_ms, epoch, nreplay)
+        p.writer = threading.Thread(
+            target=self._writer_loop, args=(p, gen), daemon=True,
+            name=f"tcp-send-r{self.rank}p{p.rank}g{gen}")
+        p.writer.start()
+        t = threading.Thread(
+            target=self._recv_loop, args=(p, sock, gen), daemon=True,
+            name=f"tcp-recv-r{self.rank}p{p.rank}g{gen}")
+        p.recv_thread = t
+        t.start()
+        # prune dead generations while appending (under the connection
+        # lock: concurrent resumes of DIFFERENT peers rebuild this list
+        # too): a long soak of flaps must not grow it without bound
+        with self._conn_cond:
+            self._recv_threads = [x for x in self._recv_threads
+                                  if x.is_alive()] + [t]
+
+    def ft_link_fault(self, dst: int) -> None:
+        """Chaos hook (ft/inject.py ``flap:``/``disconnect:``): tear
+        this rank's socket(s) to every peer the injector marked
+        link-down (always including ``dst``, the triggering send's
+        target) WITHOUT killing the process — both ends see a torn
+        connection, which is a SUSPECT transition under a session and
+        instant death without one.
+
+        The tear is a WRITE-half shutdown, not a close: the next local
+        write fails at once (the triggering frame — enqueued right
+        after this hook — is picked up by the writer, retained in the
+        replay window, and its send fails, so a session flap provably
+        exercises the replay path), the peer sees EOF and parks its own
+        half, and the suspect/death transition closes the socket
+        fully."""
+        ft = self._ft
+        with self._conn_cond:
+            peers = list(self._peers.values())
+        for p in peers:
+            if p.rank != dst and not (ft is not None
+                                      and ft.link_down(p.rank)):
+                continue
+            try:
+                p.sock.shutdown(socket.SHUT_WR)
+            except OSError:
+                pass
+
     # -- fault tolerance ------------------------------------------------
     def ft_ping(self, peer: int, seq: int, t_ns: int) -> bool:
         """Wire-level heartbeat probe (K_PING): enqueued straight onto
@@ -370,6 +850,12 @@ class TCPCommEngine(LocalCommEngine):
             p = self._peers.get(peer)
         if p is None or not p.hb_ok or p.done:
             return False
+        with p.cond:
+            if p.suspect:
+                # the link is torn and the session layer owns the
+                # verdict: a probe could not leave anyway, and the
+                # detector must not count this interval as silence
+                return False
         # probe frames bypass _transport_post, so consult the chaos
         # layer here too — ft_inject directives with hb=1 must be able
         # to drop/duplicate heartbeats on this transport as well
@@ -446,8 +932,28 @@ class TCPCommEngine(LocalCommEngine):
         obs.am_sent(self.rank, dst, tag, payload, t0)
 
     def _transport_post(self, dst: int, src: int, tag: int, payload: Any) -> None:
-        for _ in range(self.ft_outbound(dst, tag)):
-            self._transport_post_live(dst, src, tag, payload)
+        copies = self.ft_outbound(dst, tag)
+        if copies <= 0:
+            return
+        self._transport_post_live(dst, src, tag, payload)
+        if copies <= 1:
+            return
+        # injected duplicate: on a session link the duplicate happens
+        # at the WIRE level (same frame, same seq — the receiver's
+        # seq dedup must keep the AM exactly-once); without a session
+        # it stays a double post, the historical deliver-twice chaos
+        rs = False
+        if dst != self.rank:
+            with self._conn_cond:
+                p = self._peers.get(dst)
+            if p is not None:
+                with p.cond:
+                    rs = p.rs_ok
+                    if rs:
+                        p.rs_dup_next = True
+        if not rs:
+            for _ in range(copies - 1):
+                self._transport_post_live(dst, src, tag, payload)
 
     def _transport_post_live(self, dst: int, src: int, tag: int,
                              payload: Any) -> None:
@@ -541,8 +1047,14 @@ class TCPCommEngine(LocalCommEngine):
         is admitted alone into an empty queue. Aborts with
         RankFailedError when the peer dies while we wait."""
         limit = self.send_buffer_bytes
+        # the replay window's retained (sent-but-unacked) bytes count
+        # against the same budget: a flapping link's unacked backlog
+        # spills into backpressure instead of unbounded RAM. The escape
+        # for an oversized message keys on UNSENT bytes only, so a
+        # residue of lazily-acked frames cannot park a producer forever.
         while peer.queued_bytes > 0 \
-                and peer.queued_bytes + nbytes > limit:
+                and peer.queued_bytes + peer.rs_window_bytes \
+                + nbytes > limit:
             self._check_live(dst)
             if peer.done:
                 raise RankFailedError(dst, "send to failed rank")
@@ -550,86 +1062,190 @@ class TCPCommEngine(LocalCommEngine):
         self._check_live(dst)
 
     # -- writer thread --------------------------------------------------
-    def _writer_loop(self, peer: _Peer) -> None:
+    def _writer_can_data_locked(self, peer: _Peer) -> bool:
+        # holds: peer.cond
+        """May a DATA frame (batch / transfer header / chunk) leave
+        right now? Not before capabilities are known when sessions are
+        enabled locally (an unwrapped frame could never be replayed),
+        and not while the replay window is at its byte cap (the window
+        drains as the peer's cumulative acks arrive)."""
+        if self._rs_enabled and not peer.hello_seen \
+                and time.monotonic() - peer.connected_at < _HELLO_GRACE:
+            return False
+        if peer.rs_ok and peer.rs_window_bytes > 0 \
+                and peer.rs_window_bytes >= self.replay_window_bytes:
+            return False
+        return True
+
+    def _writer_ready_locked(self, peer: _Peer, gen: int) -> bool:
+        # holds: peer.cond
+        if peer.conn_gen != gen or peer.suspect:
+            return True
+        if peer.rank in self.dead_peers or self._ft_silenced:
+            return True
+        if peer.rs_replay:
+            return True
+        # session-less control frames (hello, pong, ack, elastic) stay
+        # sendable even while data is gated — an ack-starved window on
+        # BOTH ends would otherwise deadlock waiting for each other's
+        # acks to drain through the blocked data lane
+        if any(it[0] == "frame" for it in peer.ctrl):
+            return True
+        if (peer.ctrl or peer.bulk) and self._writer_can_data_locked(peer):
+            return True
+        return bool(peer.goodbye and not peer.ctrl and not peer.bulk)
+
+    def _writer_loop(self, peer: _Peer, gen: int) -> None:
         """Drain one peer's queues: coalesce ctrl messages into batch
         frames (one syscall each), interleave one bulk chunk whenever
-        the ctrl lane is idle, send the GOODBYE sentinel last."""
+        the ctrl lane is idle, send the GOODBYE sentinel last. With a
+        negotiated session, data frames are wrapped in a K_SEQ envelope
+        and retained in the replay window until the peer acks them; a
+        resume stages the unacked gap in ``rs_replay``, which the next
+        writer generation sends before anything new."""
         coalesce = self.coalesce_max_bytes
         ctrl_streak = 0
+        handoff = False   # SUSPECT/stale exit: queues + window survive
         try:
             while True:
                 pieces: Optional[List[Any]] = None
                 nmsgs = 0
                 deq_bytes = 0
                 is_goodbye = False
+                sequenced = False
+                replaying = False
                 with peer.cond:
-                    while not peer.ctrl and not peer.bulk \
-                            and not peer.goodbye \
-                            and not self._ft_silenced \
-                            and peer.rank not in self.dead_peers:
-                        peer.cond.wait()
+                    while not self._writer_ready_locked(peer, gen):
+                        # timed wait ONLY while a clock-based gate is
+                        # live (the pre-HELLO grace); every other gate
+                        # change (enqueue, ack, suspect, resume, death)
+                        # notifies — the default config keeps the
+                        # wake-on-notify idle behavior
+                        peer.cond.wait(
+                            0.1 if self._rs_enabled
+                            and not peer.hello_seen else None)
+                    if peer.conn_gen != gen or peer.suspect:
+                        handoff = True
+                        return   # a resume (or the receiver's fault)
+                        #          replaced this generation: the state
+                        #          lives on for the next writer
                     if peer.rank in self.dead_peers or self._ft_silenced:
                         return   # _peer_died/ft_silence notified us:
                         #          stop (finally drops whatever is
                         #          still queued — a crash sends nothing)
-                    take_ctrl = bool(peer.ctrl) and (
-                        not peer.bulk or ctrl_streak < _CTRL_STREAK_MAX)
-                    if take_ctrl:
-                        kind = peer.ctrl[0][0]
-                        if kind == "msg":
-                            segs = [peer.ctrl.popleft()[1]]
-                            total = len(segs[0])
-                            while (peer.ctrl
-                                   and peer.ctrl[0][0] == "msg"
-                                   and len(segs) < _MAX_BATCH_MSGS
-                                   and total + len(peer.ctrl[0][1])
-                                   <= coalesce):
-                                seg = peer.ctrl.popleft()[1]
-                                segs.append(seg)
-                                total += len(seg)
-                            pieces = wire.pack_batch(segs)
-                            nmsgs = len(segs)
-                            deq_bytes = total
-                        else:  # standalone frame (hello)
-                            body = peer.ctrl.popleft()[1]
+                    if peer.rs_replay:
+                        pieces = peer.rs_replay.pop(0)
+                        replaying = True
+                    elif peer.goodbye and not peer.ctrl and not peer.bulk:
+                        # handled BEFORE the data gate: the sentinel is
+                        # not a data frame, and waiting out a closed
+                        # gate here would spin the thread hot
+                        is_goodbye = True
+                    else:
+                        can_data = self._writer_can_data_locked(peer)
+                        if not can_data:
+                            idx = next((i for i, it in enumerate(peer.ctrl)
+                                        if it[0] == "frame"), None)
+                            if idx is None:
+                                continue   # raced the gate: re-wait
+                            body = peer.ctrl[idx][1]
+                            del peer.ctrl[idx]
                             pieces = [body]
                             deq_bytes = len(body)
-                        # the streak only counts ctrl frames sent WHILE
-                        # bulk was waiting (the starvation being bounded)
-                        ctrl_streak = ctrl_streak + 1 if peer.bulk else 0
-                    elif peer.bulk:
-                        item = peer.bulk.popleft()
-                        ctrl_streak = 0
-                        if item[0] == "frame":  # chunked-transfer header
-                            pieces = [item[1]]
-                            deq_bytes = len(item[1])
+                            ctrl_streak = (ctrl_streak + 1
+                                           if peer.bulk else 0)
                         else:
-                            _k, xid, bidx, off, view = item
-                            pieces = [wire.pack_chunk_hdr(xid, bidx, off),
-                                      view]
-                            deq_bytes = view.nbytes
-                            with self._stat_lock:
-                                self.wire_stats["chunks_sent"] += 1
-                                self.wire_stats["chunk_bytes_sent"] += \
-                                    view.nbytes
-                    else:  # goodbye, and both queues drained
-                        is_goodbye = True
+                            take_ctrl = bool(peer.ctrl) and (
+                                not peer.bulk
+                                or ctrl_streak < _CTRL_STREAK_MAX)
+                            if take_ctrl:
+                                kind = peer.ctrl[0][0]
+                                if kind == "msg":
+                                    segs = [peer.ctrl.popleft()[1]]
+                                    total = len(segs[0])
+                                    while (peer.ctrl
+                                           and peer.ctrl[0][0] == "msg"
+                                           and len(segs) < _MAX_BATCH_MSGS
+                                           and total + len(peer.ctrl[0][1])
+                                           <= coalesce):
+                                        seg = peer.ctrl.popleft()[1]
+                                        segs.append(seg)
+                                        total += len(seg)
+                                    pieces = wire.pack_batch(segs)
+                                    nmsgs = len(segs)
+                                    deq_bytes = total
+                                    sequenced = peer.rs_ok
+                                else:  # standalone frame (hello, pong)
+                                    body = peer.ctrl.popleft()[1]
+                                    pieces = [body]
+                                    deq_bytes = len(body)
+                                # the streak only counts ctrl frames sent
+                                # WHILE bulk was waiting (the starvation
+                                # being bounded)
+                                ctrl_streak = (ctrl_streak + 1
+                                               if peer.bulk else 0)
+                            elif peer.bulk:
+                                item = peer.bulk.popleft()
+                                ctrl_streak = 0
+                                sequenced = peer.rs_ok
+                                if item[0] == "frame":  # chunked-xfer hdr
+                                    pieces = [item[1]]
+                                    deq_bytes = len(item[1])
+                                else:
+                                    _k, xid, bidx, off, view = item
+                                    pieces = [wire.pack_chunk_hdr(
+                                        xid, bidx, off), view]
+                                    deq_bytes = view.nbytes
+                                    with self._stat_lock:
+                                        self.wire_stats["chunks_sent"] += 1
+                                        self.wire_stats[
+                                            "chunk_bytes_sent"] += \
+                                            view.nbytes
+                            else:  # raced both queues away: re-wait
+                                continue
                 if is_goodbye:
                     try:
                         peer.sock.sendall(struct.pack("<Q", GOODBYE))
                     except OSError:
                         pass
                     return
-                pieces = self._maybe_compress(peer, pieces)
+                if not replaying:
+                    pieces = self._maybe_compress(peer, pieces)
+                    # release the backpressure budget BEFORE the send:
+                    # a sequenced frame's bytes move to the replay-
+                    # window accounting (still backpressure-counted via
+                    # rs_window_bytes), and a send that FAILS into the
+                    # SUSPECT path must not strand its bytes in
+                    # queued_bytes forever (the replay re-sends with
+                    # deq_bytes already released)
+                    with peer.cond:
+                        if sequenced:
+                            # number the frame and retain it (post-
+                            # compression, so a replay is byte-
+                            # identical) until the peer's cumulative
+                            # ack releases it
+                            peer.rs_tx_seq += 1
+                            pieces = [wire.pack_seq(peer.rs_epoch,
+                                                    peer.rs_tx_seq)] \
+                                + list(pieces)
+                            peer.rs_window.append(
+                                (peer.rs_tx_seq, pieces, deq_bytes))
+                            peer.rs_window_bytes += deq_bytes
+                        peer.queued_bytes -= deq_bytes
+                        peer.cond.notify_all()
                 body_len = sum(len(p) if isinstance(p, (bytes, bytearray))
                                else p.nbytes for p in pieces)
                 t0 = time.monotonic()
                 _sendall_vec(peer.sock,
                              [struct.pack("<Q", body_len)] + pieces)
                 dt = time.monotonic() - t0
-                with peer.cond:  # release the backpressure budget
-                    peer.queued_bytes -= deq_bytes
-                    peer.cond.notify_all()
+                if sequenced:
+                    with peer.cond:
+                        dup = peer.rs_dup_next
+                        peer.rs_dup_next = False
+                    if dup:  # injected wire-level duplicate (same seq)
+                        _sendall_vec(peer.sock,
+                                     [struct.pack("<Q", body_len)] + pieces)
                 if body_len >= _BW_SAMPLE_MIN and dt > 0:
                     inst = body_len / dt / 1e6
                     peer.bw_mbps = (inst if peer.bw_mbps is None else
@@ -644,28 +1260,40 @@ class TCPCommEngine(LocalCommEngine):
                         if nmsgs > 1:
                             self.wire_stats["coalesced_msgs"] += nmsgs
         except OSError as exc:
-            # the send side can see the crash before the receiver thread
-            # does — later sends raise RankFailedError via dead_peers.
-            # send_am already returned for the frame that just failed
-            # (and anything still queued): an ACCEPTED send was LOST, so
-            # the death is reported to the runtime unconditionally
-            # (lost_sends) — the v1 path raised RankFailedError to the
-            # caller here, and a silent drop would trade that loud abort
-            # for a termdet hang.
+            # with a negotiated session the fault is TRANSIENT until
+            # proven otherwise: park the peer as SUSPECT (queues and
+            # replay window intact — the frame that just failed is
+            # unacked and will be replayed) and let the reconnector
+            # decide. Without one, the send side can see the crash
+            # before the receiver thread does — later sends raise
+            # RankFailedError via dead_peers. send_am already returned
+            # for the frame that just failed (and anything still
+            # queued): an ACCEPTED send was LOST, so the death is
+            # reported to the runtime unconditionally (lost_sends) —
+            # the v1 path raised RankFailedError to the caller here, and
+            # a silent drop would trade that loud abort for a termdet
+            # hang.
+            if self._session_suspect(peer, gen, f"send failed: {exc}"):
+                handoff = True
+                return
             self._peer_died(peer.rank, f"send failed: {exc}",
                             lost_sends=True)
         finally:
-            peer.done = True
-            with peer.cond:
-                dropped = len(peer.ctrl) + len(peer.bulk)
-                peer.ctrl.clear()
-                peer.bulk.clear()
-                peer.queued_bytes = 0
-                peer.cond.notify_all()
-            if dropped and not self._closing and not self._ft_silenced:
-                plog.warning(
-                    "tcp rank %d: dropped %d queued frame(s)/chunk(s) "
-                    "to dead peer %d", self.rank, dropped, peer.rank)
+            if not handoff:
+                peer.done = True
+                with peer.cond:
+                    dropped = len(peer.ctrl) + len(peer.bulk)
+                    peer.ctrl.clear()
+                    peer.bulk.clear()
+                    peer.queued_bytes = 0
+                    peer.rs_window.clear()
+                    peer.rs_window_bytes = 0
+                    peer.rs_replay = []
+                    peer.cond.notify_all()
+                if dropped and not self._closing and not self._ft_silenced:
+                    plog.warning(
+                        "tcp rank %d: dropped %d queued frame(s)/chunk(s) "
+                        "to dead peer %d", self.rank, dropped, peer.rank)
 
     def _maybe_compress(self, peer: _Peer, pieces: List[Any]) -> List[Any]:
         """Engage per-link compression when (a) the peer advertised a
@@ -718,50 +1346,91 @@ class TCPCommEngine(LocalCommEngine):
             buf += chunk
         return buf
 
-    def _recv_loop(self, peer: int, sock: socket.socket) -> None:
-        xfers: Dict[int, wire.RxXfer] = {}  # this connection's partials
+    @staticmethod
+    def _recv_body(sock: socket.socket, n: int) -> Tuple[bytearray, bool]:
+        """Read one frame body, KEEPING whatever landed when the
+        connection tears mid-frame: (bytes so far, complete?). The
+        partial body seeds the session layer's byte-level resume claim
+        instead of being discarded (a torn multi-MB chunk resumes at
+        the truncation offset, not from byte 0)."""
+        buf = bytearray()
+        try:
+            while len(buf) < n:
+                chunk = sock.recv(n - len(buf))
+                if not chunk:
+                    return buf, False
+                buf += chunk
+        except OSError:
+            return buf, False
+        return buf, True
+
+    def _recv_fault(self, p: _Peer, gen: int, reason: str) -> None:
+        """A receiver-side connection fault: absorbed as SUSPECT when a
+        session covers the link, fail-fast ``_peer_died`` otherwise."""
+        if self._session_suspect(p, gen, reason):
+            return   # rx state (seq, partial, half-landed transfers)
+            #          survives for the resume
+        self._peer_died(p.rank, reason)
+        with self._stat_lock:
+            self._rx_pending.pop(p.rank, None)
+
+    def _recv_loop(self, p: _Peer, sock: socket.socket, gen: int) -> None:
+        peer = p.rank
         try:
             while True:
                 hdr = self._recv_exact(sock, 8)
                 if hdr is None:
-                    self._peer_died(peer, "peer closed the connection")
+                    self._recv_fault(p, gen, "peer closed the connection")
                     return
                 (size,) = struct.unpack("<Q", hdr)
                 if size == GOODBYE:
                     with self._lock:
                         owes_us = peer in self._get_srcs.values()
-                    if owes_us or xfers:
+                    with p.cond:
+                        owes_us = owes_us or bool(p.rx_xfers)
+                    if owes_us:
                         # "clean" exit while owing rendezvous data or
                         # mid-chunked-transfer is a protocol violation —
                         # treat as a failure
                         self._peer_died(
                             peer, "shut down owing rendezvous data")
+                        with self._stat_lock:
+                            self._rx_pending.pop(peer, None)
                         return
                     # orderly shutdown: the peer fini'd after completing
                     # its work — not a failure, no scary warnings
                     self.finished_peers.add(peer)
                     return
-                body = self._recv_exact(sock, size)
-                if body is None:
-                    self._peer_died(peer, "connection truncated mid-frame")
+                buf, complete = self._recv_body(sock, size)
+                if not complete:
+                    with p.cond:
+                        # record WHERE the truncation happened — the
+                        # resume claim lets the sender continue this
+                        # frame from the landed offset (K_FRAG)
+                        p.rs_rx_partial = (size, buf) if buf else None
+                    self._recv_fault(
+                        p, gen, f"connection truncated mid-frame "
+                                f"({len(buf)}/{size} bytes)")
                     return
-                self._dispatch_body(peer, memoryview(body), xfers)
+                # read-only view, zero copy: reconstructed arrays alias
+                # the received body and must not be host-mutable
+                self._dispatch_body(p, memoryview(buf).toreadonly())
         except OSError as exc:
-            self._peer_died(peer, f"socket error: {exc}")
+            self._recv_fault(p, gen, f"socket error: {exc}")
             return
         except Exception as exc:  # frame desync / unpickle failure: a
             # silent receiver death would hang both ranks — make it loud
+            # (never SUSPECT: a protocol violation is not transient)
             self._peer_died(peer, f"receiver died: {exc!r}")
+            with self._stat_lock:
+                self._rx_pending.pop(peer, None)
             return
-        finally:
-            if xfers:
-                with self._stat_lock:
-                    self._rx_pending.pop(peer, None)
 
-    def _dispatch_body(self, peer: int, body: memoryview,
-                       xfers: Dict[int, wire.RxXfer]) -> None:
+    def _dispatch_body(self, p: _Peer, body: memoryview) -> None:
         if self._ft_silenced:
             return   # injected kill: inbound traffic is never delivered
+        peer = p.rank
+        xfers = p.rx_xfers
         kind = body[0]
         if kind == wire.K_BATCH:
             for frame, bufs in wire.parse_batch(body):
@@ -797,13 +1466,83 @@ class TCPCommEngine(LocalCommEngine):
                 self._notify_arrival()
         elif kind == wire.K_HELLO:
             info = wire.parse_hello(body)
-            with self._conn_cond:
-                p = self._peers.get(peer)
-            if p is not None:
-                p.codec = wire.negotiate_codec(
-                    self._codecs, info.get("codecs", ()))
-                p.hb_ok = bool(info.get("hb"))
-                p.el_ok = bool(info.get("el"))
+            p.codec = wire.negotiate_codec(
+                self._codecs, info.get("codecs", ()))
+            p.hb_ok = bool(info.get("hb"))
+            p.el_ok = bool(info.get("el"))
+            with p.cond:
+                # session capability is SYMMETRIC: both ends must run
+                # with the knob set, or neither retains/replays
+                p.rs_ok = bool(info.get("rs")) and self._rs_enabled
+                p.hello_seen = True
+                p.cond.notify_all()   # the writer may be holding data
+        elif kind == wire.K_SEQ:
+            # session data frame: deliver IN ORDER exactly once — a
+            # replayed frame the old connection already delivered is
+            # dropped here by seq, so no active message ever runs twice
+            _epoch, seq, inner = wire.parse_seq(body)
+            deliver = False
+            with p.cond:
+                if seq <= p.rs_rx_seq:
+                    pass   # duplicate from a replay overlap
+                elif seq != p.rs_rx_seq + 1:
+                    raise ValueError(
+                        f"session desync: frame seq {seq} after "
+                        f"{p.rs_rx_seq}")
+                else:
+                    p.rs_rx_seq = seq
+                    p.rs_rx_partial = None
+                    deliver = True
+                    p.rs_rx_unacked_frames += 1
+                    p.rs_rx_unacked_bytes += len(body)
+                    if p.rs_rx_unacked_frames >= _ACK_EVERY_FRAMES \
+                            or p.rs_rx_unacked_bytes >= self._ack_bytes:
+                        ack = wire.pack_ack(p.rs_epoch, seq)
+                        p.rs_rx_unacked_frames = 0
+                        p.rs_rx_unacked_bytes = 0
+                        p.ctrl.append(("frame", ack))
+                        p.queued_bytes += len(ack)
+                        p.cond.notify()
+            if not deliver:
+                with self._stat_lock:
+                    self.wire_stats["dup_dropped"] += 1
+                return
+            self._dispatch_body(p, inner)
+        elif kind == wire.K_ACK:
+            # cumulative delivery ack: release the replay window (and
+            # the backpressure budget the retained bytes counted
+            # against) up to the acked seq
+            _epoch, seq = wire.parse_ack(body)
+            with p.cond:
+                while p.rs_window and p.rs_window[0][0] <= seq:
+                    _seq, _pieces, nb = p.rs_window.popleft()
+                    p.rs_window_bytes -= nb
+                p.cond.notify_all()
+        elif kind == wire.K_FRAG:
+            # byte-level resume of the frame the link tore mid-body:
+            # stitch our kept partial + the sender's remainder, then
+            # dispatch the whole as the K_SEQ frame it always was
+            _epoch, seq, offset, data = wire.parse_frag(body)
+            with p.cond:
+                part = p.rs_rx_partial
+                if seq <= p.rs_rx_seq:
+                    part = None   # somehow already delivered: dup
+                elif part is None or len(part[1]) != offset:
+                    raise ValueError(
+                        f"frag resume mismatch: offset {offset}, held "
+                        f"{len(part[1]) if part else 'no'} partial bytes")
+                else:
+                    full = bytes(part[1]) + bytes(data)
+                    if len(full) != part[0]:
+                        raise ValueError(
+                            f"frag resume size mismatch: {len(full)} != "
+                            f"{part[0]}")
+                    p.rs_rx_partial = None
+            if part is None:
+                with self._stat_lock:
+                    self.wire_stats["dup_dropped"] += 1
+                return
+            self._dispatch_body(p, memoryview(full))
         elif kind == wire.K_PING:
             # answered HERE, on the receiver thread (like K_HELLO): a
             # rank whose workers are all stuck in a long kernel still
@@ -813,9 +1552,7 @@ class TCPCommEngine(LocalCommEngine):
             det = self.ft_detector
             if det is not None:
                 det.note_alive(peer)
-            with self._conn_cond:
-                p = self._peers.get(peer)
-            if p is not None and not p.done:
+            if not p.done:
                 pong = wire.pack_ping(seq, t_ns, pong=True)
                 with p.cond:
                     p.ctrl.append(("frame", pong))
@@ -834,8 +1571,8 @@ class TCPCommEngine(LocalCommEngine):
             # kernel — elastic agreement is progress-cadence-free on TCP
             self._on_elastic(peer, wire.parse_elastic(body))
         elif kind == wire.K_COMP:
-            self._dispatch_body(peer, memoryview(
-                wire.decompress_body(body)), xfers)
+            self._dispatch_body(p, memoryview(
+                wire.decompress_body(body)))
         else:
             raise ValueError(f"unknown frame kind {kind}")
 
@@ -861,8 +1598,18 @@ class TCPCommEngine(LocalCommEngine):
         with self._conn_cond:
             p = self._peers.get(peer)
         if p is not None:
+            dur_ms = 0.0
             with p.cond:  # unblock anything parked on the writer
+                if p.suspect:
+                    # a SUSPECT episode ends in escalation: close its
+                    # accounting and stand the reconnector down
+                    p.suspect = False
+                    p.done = True
+                    dur_ms = (time.monotonic() - p.suspect_since) * 1e3
                 p.cond.notify_all()
+            if dur_ms:
+                with self._stat_lock:
+                    self._suspect_ms_total += dur_ms
         plog.warning("tcp rank %d: peer %d presumed FAILED (%s)",
                      self.rank, peer, reason)
         cb = self.on_peer_failure

@@ -49,6 +49,33 @@ body follows). Otherwise the body's first byte is a *kind*:
   mixed-version peer is never probed and therefore never declared dead
   by the proactive detector.
 
+Reliable-session framing (the ``"rs"`` HELLO capability — transient
+link faults recover by reconnect + replay instead of rank eviction,
+comm/tcp.py):
+
+- ``K_SEQ``: envelope around any DATA frame body (``<u32 epoch>
+  <u64 seq> <inner body>``). Each direction numbers its data frames
+  (batches, transfer headers, chunks) with a per-link monotonically
+  increasing ``seq``; the receiver delivers in order exactly once —
+  a replayed frame it already delivered is dropped by seq (idempotent
+  re-delivery: no active message ever runs twice). Session-less
+  control frames (hello, ping/pong, ack, resume, elastic) are never
+  wrapped: they are regenerated, not replayed.
+- ``K_ACK``: cumulative delivery acknowledgment (``<u32 epoch>
+  <u64 seq>``) — everything up to ``seq`` landed, so the sender may
+  drop those frames from its bounded replay window.
+- ``K_RESUME``: reconnect handshake (a pickled dict), sent right
+  after the rank-identifying handshake on a RE-dialed connection:
+  carries the proposed session ``epoch``, the last-delivered ``ack``
+  both ways, and optionally a ``partial`` claim — how many bytes of
+  the next expected frame already landed before the link tore, so the
+  sender resumes that frame mid-body instead of resending it.
+- ``K_FRAG``: the byte-level resume of one torn frame
+  (``<u32 epoch> <u64 seq> <u64 offset> <bytes>``): the remainder of
+  the frame the receiver holds a partial body of; receiver stitches
+  partial + remainder and dispatches the whole as a normal K_SEQ
+  frame.
+
 All integers little-endian, matching the v1 framing.
 """
 from __future__ import annotations
@@ -68,6 +95,10 @@ K_COMP = 4
 K_PING = 5
 K_PONG = 6
 K_ELASTIC = 7
+K_SEQ = 8
+K_ACK = 9
+K_RESUME = 10
+K_FRAG = 11
 
 WIRE_VERSION = 2
 
@@ -80,6 +111,8 @@ _BUFSPEC = struct.Struct("<BQ")      # chunked?, size
 _CHUNK = struct.Struct("<BQIQ")      # kind, xfer_id, buf_index, offset
 _COMP = struct.Struct("<BBQ")        # kind, codec_id, raw_len
 _PING = struct.Struct("<BIQ")        # kind, seq, t_ns (sender monotonic)
+_SEQHDR = struct.Struct("<BIQ")      # kind, epoch, seq (K_SEQ / K_ACK)
+_FRAGHDR = struct.Struct("<BIQQ")    # kind, epoch, seq, byte offset
 
 
 # -- codecs -------------------------------------------------------------
@@ -263,6 +296,59 @@ def parse_ping(body: memoryview) -> Tuple[int, int]:
     """-> (seq, t_ns); same layout for K_PING and K_PONG."""
     _kind, seq, t_ns = _PING.unpack_from(body, 0)
     return seq, t_ns
+
+
+# -- reliable session (comm/tcp.py "rs" capability) ---------------------
+SEQ_HDR_LEN = _SEQHDR.size
+
+
+def pack_seq(epoch: int, seq: int) -> bytes:
+    """Envelope header prepended to one data frame body."""
+    return _SEQHDR.pack(K_SEQ, epoch & 0xFFFFFFFF, seq)
+
+
+def parse_seq(body: memoryview) -> Tuple[int, int, memoryview]:
+    """-> (epoch, seq, inner body)."""
+    _kind, epoch, seq = _SEQHDR.unpack_from(body, 0)
+    return epoch, seq, body[_SEQHDR.size:]
+
+
+def parse_seq_prefix(buf) -> Optional[Tuple[int, int]]:
+    """(epoch, seq) when ``buf`` begins with a complete K_SEQ header
+    (the partial-frame resume claim), else None."""
+    if len(buf) < _SEQHDR.size or buf[0] != K_SEQ:
+        return None
+    _kind, epoch, seq = _SEQHDR.unpack_from(buf, 0)
+    return epoch, seq
+
+
+def pack_ack(epoch: int, seq: int) -> bytes:
+    """Cumulative ack: every seq up to ``seq`` was delivered."""
+    return _SEQHDR.pack(K_ACK, epoch & 0xFFFFFFFF, seq)
+
+
+def parse_ack(body: memoryview) -> Tuple[int, int]:
+    _kind, epoch, seq = _SEQHDR.unpack_from(body, 0)
+    return epoch, seq
+
+
+def pack_resume(info: Dict[str, Any]) -> bytes:
+    """Reconnect handshake frame ({"rank", "epoch", "ack", "partial"})."""
+    return bytes([K_RESUME]) + pickle.dumps(info, protocol=4)
+
+
+def parse_resume(body: memoryview) -> Dict[str, Any]:
+    return pickle.loads(body[1:])
+
+
+def pack_frag(epoch: int, seq: int, offset: int) -> bytes:
+    """Header of a byte-level frame resume (remainder bytes follow)."""
+    return _FRAGHDR.pack(K_FRAG, epoch & 0xFFFFFFFF, seq, offset)
+
+
+def parse_frag(body: memoryview) -> Tuple[int, int, int, memoryview]:
+    _kind, epoch, seq, offset = _FRAGHDR.unpack_from(body, 0)
+    return epoch, seq, offset, body[_FRAGHDR.size:]
 
 
 # -- elastic membership (ft/elastic.py) ---------------------------------

@@ -56,6 +56,13 @@ Safety rules (the acceptance bar for never evicting a healthy peer):
   bar;
 - a peer that shut down CLEANLY (TCP GOODBYE / local-fabric finish
   mark) is skipped: finishing early is not failing;
+- a peer whose link is SUSPECT under a reliable session
+  (``comm_reconnect_timeout``, comm/tcp.py) is not judged while the
+  reconnect budget lasts: probes cannot cross a torn link, so the
+  silence proves nothing about the process. A completed resume resets
+  the silence baseline; a peer that reconnects but still never
+  answers is evicted at the next tick — the detector keeps final say
+  over live-but-silent peers, the session only over torn links;
 - ``ft_detector_mode=phi`` scales the deadline by the observed
   inter-arrival EWMA (a phi-accrual-style accrual: slow-but-steady
   links earn longer deadlines), never below ``ft_heartbeat_timeout``.
@@ -202,6 +209,18 @@ class HeartbeatDetector:
             now = time.monotonic()
             for peer, st in self._peers.items():
                 if peer in ce.dead_peers or ce.peer_finished(peer):
+                    continue
+                if getattr(ce, "peer_suspect", None) is not None \
+                        and ce.peer_suspect(peer):
+                    # the link is torn but its reliable session is
+                    # still inside the reconnect budget (comm/tcp.py):
+                    # probes cannot cross, so the silence proves
+                    # nothing — the session layer owns the verdict
+                    # until it either resumes (a completed resume
+                    # resets the silence baseline, and a zombie that
+                    # reconnects but never answers is evicted at the
+                    # next tick: the detector keeps final say) or
+                    # escalates on budget exhaustion
                     continue
                 sent = False
                 try:

@@ -26,6 +26,12 @@ each ``op:key=val:key=val``::
     dup:pct=1:seed=7           # duplicate 1% of frames
     delay:pct=5:ms=2:seed=7    # delay 5% of frames by 2 ms
     failsend:rank=0:nth=10     # rank 0's 10th send raises RankFailedError
+    flap:rank=2:nth=30:duration=0.3   # rank 2's 30th send hard-closes
+                               # the socket(s); the link stays DOWN
+                               # (reconnects rejected) for 0.3 s
+    disconnect:rank=2:nth=30   # like flap, but the link never comes
+                               # back — a permanent fault that must
+                               # exhaust the reconnect budget
 
 ``rank`` selects which rank's engine acts (default ``*`` = every
 rank); ``seed`` makes percentage draws reproducible (the stream is
@@ -34,6 +40,16 @@ deterministically). Wire directives never touch heartbeat traffic
 unless ``hb=1`` — chaos under test must not blind the detector that
 the test is asserting on. ``kill``/``taskfail``/``failsend`` are
 one-shot; percentage directives apply for the engine's lifetime.
+
+``flap``/``disconnect`` tear the LINK, not the process (the
+transient-vs-permanent distinction the reliable session layer exists
+for, comm/tcp.py): the socket(s) toward the directive's ``peer``
+filter (default every peer) hard-close with nothing flushed, and the
+engine's reconnect attempts — dialing out or accepting the peer's
+re-dial — are rejected while the link is down. With sessions enabled
+a flap is absorbed by reconnect + replay; a disconnect (or a flap
+longer than ``comm_reconnect_timeout``) escalates to the ordinary
+rank-failure path. On transports without sockets both are no-ops.
 """
 from __future__ import annotations
 
@@ -63,7 +79,7 @@ class InjectedTaskFault(RuntimeError):
     """A transient injected task failure (survives a retry)."""
 
 
-_WIRE_OPS = ("drop", "dup", "delay", "failsend")
+_WIRE_OPS = ("drop", "dup", "delay", "failsend", "flap", "disconnect")
 _TASK_OPS = ("kill", "taskfail")
 
 
@@ -84,7 +100,8 @@ def parse_inject_spec(spec: str) -> List[Dict[str, Any]]:
                 f"(have {', '.join(_WIRE_OPS + _TASK_OPS)})")
         d: Dict[str, Any] = {"op": op, "rank": "*", "peer": "*",
                              "pct": 0.0, "nth": 0, "seed": 0,
-                             "after": 1, "ms": 1.0, "hb": False}
+                             "after": 1, "ms": 1.0, "hb": False,
+                             "duration": 0.0}
         for kv in parts[1:]:
             if "=" not in kv:
                 raise ValueError(f"ft_inject: expected key=val, got {kv!r}")
@@ -95,7 +112,7 @@ def parse_inject_spec(spec: str) -> List[Dict[str, Any]]:
                     f"ft_inject: unknown key {k!r} for op {op!r}")
             if k in ("rank", "peer"):
                 d[k] = "*" if v.strip() == "*" else int(v)
-            elif k in ("pct", "ms"):
+            elif k in ("pct", "ms", "duration"):
                 d[k] = float(v)
             elif k == "hb":
                 d[k] = v.strip().lower() in ("1", "true", "yes", "on")
@@ -130,8 +147,22 @@ class FaultInjector:
             self._dirs.append(ent)
         self.has_task_actions = any(
             d["op"] in _TASK_OPS for d in self._dirs)
+        # link-down intervals from flap/disconnect directives:
+        # peer (or "*") -> monotonic deadline (inf = disconnect).
+        # Consulted by the transport's reconnect machinery — dial
+        # attempts and accepted resumes both fail while down.
+        self._link_down: Dict[Any, float] = {}
         self.stats = {"dropped": 0, "duplicated": 0, "delayed": 0,
-                      "failed_sends": 0, "kills": 0, "task_faults": 0}
+                      "failed_sends": 0, "kills": 0, "task_faults": 0,
+                      "flaps": 0}
+
+    def link_down(self, peer: int) -> bool:
+        """Is the (virtual) link toward ``peer`` currently torn by a
+        flap/disconnect directive?"""
+        with self._lock:
+            until = max(self._link_down.get(peer, 0.0),
+                        self._link_down.get("*", 0.0))
+        return time.monotonic() < until
 
     @classmethod
     def from_spec(cls, spec: str, rank: int) -> "FaultInjector":
@@ -174,6 +205,15 @@ class FaultInjector:
                     self.stats["delayed"] += 1
                     delay_s = d["ms"] / 1e3
                     break   # sleep outside the lock
+                if op in ("flap", "disconnect"):
+                    self.stats["flaps"] += 1
+                    until = (float("inf") if op == "disconnect"
+                             else time.monotonic() + max(0.0,
+                                                         d["duration"]))
+                    key = d["peer"] if d["peer"] != "*" else "*"
+                    self._link_down[key] = max(
+                        self._link_down.get(key, 0.0), until)
+                    return "flap"
                 # failsend
                 self.stats["failed_sends"] += 1
                 raise RankFailedError(

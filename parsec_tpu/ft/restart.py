@@ -292,12 +292,32 @@ def _run_with_restart(ctx, stages, collections, prefix, policy,
             # peer it stopped hearing: a dead rank must never "win" a
             # phantom agreement with itself. Bounded by the world size
             # so a cascade of losses cannot loop forever.
-            if (co is not None and elastic.allows_shrink
+            # split-brain guard (ISSUE 10): a LINK fault partitions the
+            # grid without killing anyone — both sides of the partition
+            # would otherwise shrink to themselves and double-complete.
+            # Only the side still seeing a STRICT MAJORITY of the
+            # current members may resize; a minority partition takes
+            # the strict abort (its snapshots stay consistent, and a
+            # fresh incarnation can resume). Kill-based losses on >= 3
+            # ranks are unaffected: the survivors ARE the majority.
+            majority = True
+            if (co is not None and grid is not None
+                    and isinstance(root, RankFailedError)):
+                reachable = [m for m in grid.members
+                             if m == ctx.rank or m not in ce.dead_peers]
+                majority = 2 * len(reachable) > len(grid.members)
+                if not majority and elastic.allows_shrink:
+                    plog.warning(
+                        "ft.restart: only %d of %d members reachable — "
+                        "a minority partition must not shrink (split-"
+                        "brain); falling back to the strict abort path",
+                        len(reachable), len(grid.members))
+            if (co is not None and elastic.allows_shrink and majority
                     and isinstance(root, RankFailedError)
                     and not isinstance(root, InjectedKill)
                     and not getattr(ce, "_ft_silenced", False)
                     and resizes < ctx.nb_ranks):
-                from .elastic import plan_grid
+                from .elastic import ElasticError, plan_grid
                 recovered = False
                 tries = 0
                 # another rank can die DURING the agreement or the
@@ -315,6 +335,18 @@ def _run_with_restart(ctx, stages, collections, prefix, policy,
                             "shrink", grid.members, safe,
                             deadline_s=elastic.timeout,
                             tp_next=getattr(ctx.comm, "next_tp_id", None))
+                        if 2 * len(decision["members"]) \
+                                <= len(grid.members):
+                            # deaths DURING the round can shrink the
+                            # committed set below a majority (down to
+                            # this rank alone on a full partition):
+                            # re-validate the decision, not just the
+                            # entry view — the minority side must abort
+                            raise ElasticError(
+                                f"committed members "
+                                f"{tuple(decision['members'])} are a "
+                                f"minority of {grid.members} — refusing "
+                                f"a split-brain resize")
                         if decision["tp_base"] is not None:
                             # survivors can diverge by one registration
                             # at a mid-stage failure: align wire ids

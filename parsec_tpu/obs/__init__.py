@@ -31,9 +31,12 @@ from .prometheus import (fleet_to_prometheus, parse_exposition, render,
                          sanitize_name)
 from .spans import (COMM_ACTIVE_TRANSFERS, COMM_BYTES_RECEIVED,
                     COMM_BYTES_SENT, COMM_CHUNKS_INFLIGHT, COMM_COALESCED,
-                    COMM_COMPRESS_RATIO, COMM_LINK_BW_PREFIX,
+                    COMM_COMPRESS_RATIO, COMM_DUP_DROPPED,
+                    COMM_LINK_BW_PREFIX,
                     COMM_MSGS_RECEIVED, COMM_MSGS_SENT,
-                    COMM_PENDING_MESSAGES, CommObs, DeviceObs,
+                    COMM_PENDING_MESSAGES, COMM_RECONNECTS,
+                    COMM_REPLAYED_FRAMES, COMM_SUSPECT_MS,
+                    CommObs, DeviceObs,
                     FT_ELASTIC_JOINS, FT_ELASTIC_RESIZES, FT_HB_RTT_PREFIX,
                     FT_PEER_ALIVE, FT_RESHARD_BYTES, FT_RESHARD_US,
                     OBS_EXPOSED_COMM_US, OBS_OVERLAP_FRACTION,
@@ -45,7 +48,9 @@ __all__ = [
     "COMM_BYTES_SENT", "COMM_BYTES_RECEIVED", "COMM_MSGS_SENT",
     "COMM_MSGS_RECEIVED", "COMM_ACTIVE_TRANSFERS", "COMM_PENDING_MESSAGES",
     "COMM_COALESCED", "COMM_CHUNKS_INFLIGHT", "COMM_COMPRESS_RATIO",
-    "COMM_LINK_BW_PREFIX", "FT_PEER_ALIVE", "FT_HB_RTT_PREFIX",
+    "COMM_LINK_BW_PREFIX", "COMM_RECONNECTS", "COMM_REPLAYED_FRAMES",
+    "COMM_DUP_DROPPED", "COMM_SUSPECT_MS",
+    "FT_PEER_ALIVE", "FT_HB_RTT_PREFIX",
     "FT_ELASTIC_RESIZES", "FT_ELASTIC_JOINS", "FT_RESHARD_BYTES",
     "FT_RESHARD_US",
     "OBS_OVERLAP_FRACTION", "OBS_EXPOSED_COMM_US",

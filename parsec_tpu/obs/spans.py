@@ -27,6 +27,8 @@ __all__ = ["CommObs", "DeviceObs", "OverlapTracker",
            "COMM_ACTIVE_TRANSFERS", "COMM_PENDING_MESSAGES",
            "COMM_COALESCED", "COMM_CHUNKS_INFLIGHT",
            "COMM_COMPRESS_RATIO", "COMM_LINK_BW_PREFIX",
+           "COMM_RECONNECTS", "COMM_REPLAYED_FRAMES",
+           "COMM_DUP_DROPPED", "COMM_SUSPECT_MS",
            "FT_PEER_ALIVE", "FT_HB_RTT_PREFIX",
            "OBS_OVERLAP_FRACTION", "OBS_EXPOSED_COMM_US",
            "payload_nbytes"]
@@ -45,6 +47,14 @@ COMM_COALESCED = "PARSEC::COMM::COALESCED"
 COMM_CHUNKS_INFLIGHT = "PARSEC::COMM::CHUNKS_INFLIGHT"
 COMM_COMPRESS_RATIO = "PARSEC::COMM::COMPRESS_RATIO"
 COMM_LINK_BW_PREFIX = "PARSEC::COMM::LINK_BW"
+# reliable-session telemetry (comm/tcp.py, ISSUE 10): completed link
+# reconnects, frames replayed from the window after a resume,
+# duplicate frames the receiver dropped by seq, and cumulative
+# milliseconds peers spent in SUSPECT (live episode included)
+COMM_RECONNECTS = "PARSEC::COMM::RECONNECTS"
+COMM_REPLAYED_FRAMES = "PARSEC::COMM::REPLAYED_FRAMES"
+COMM_DUP_DROPPED = "PARSEC::COMM::DUP_DROPPED"
+COMM_SUSPECT_MS = "PARSEC::COMM::SUSPECT_MS"
 # fault-tolerance telemetry (ft/detector.py): peers currently confirmed
 # alive, and the per-peer heartbeat round-trip EWMA in milliseconds
 # (PARSEC::FT::HB_RTT::R<peer>, 0 until measured)
@@ -319,6 +329,15 @@ class CommObs:
         if ws is not None:
             sde.register_poll(COMM_COALESCED,
                               lambda w=ws: w["coalesced_msgs"])
+        if ws is not None and "reconnects" in ws:
+            sde.register_poll(COMM_RECONNECTS,
+                              lambda w=ws: w["reconnects"])
+            sde.register_poll(COMM_REPLAYED_FRAMES,
+                              lambda w=ws: w["replayed_frames"])
+            sde.register_poll(COMM_DUP_DROPPED,
+                              lambda w=ws: w["dup_dropped"])
+        if hasattr(ce, "suspect_ms"):
+            sde.register_poll(COMM_SUSPECT_MS, ce.suspect_ms)
         if hasattr(ce, "chunks_inflight"):
             sde.register_poll(COMM_CHUNKS_INFLIGHT, ce.chunks_inflight)
         if hasattr(ce, "compress_ratio"):

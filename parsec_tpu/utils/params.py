@@ -262,6 +262,23 @@ def register_core_params() -> None:
                      "per-peer bounded send buffer: send_am blocks "
                      "while this many bytes are queued ahead of it "
                      "(backpressure toward slow links)")
+    params.reg_string("comm_reconnect_timeout", "",
+                      "reliable TCP sessions: keep a torn peer link in "
+                      "SUSPECT and retry reconnecting (with seq-"
+                      "numbered frame replay) for up to this many "
+                      "seconds before escalating to rank failure; "
+                      "empty/0 = off (every socket error is fail-fast, "
+                      "the pre-session behavior)")
+    params.reg_string("comm_reconnect_backoff", "",
+                      "initial reconnect backoff in seconds (default "
+                      "0.05), doubling with jitter up to a 2 s ceiling "
+                      "while the reconnect budget lasts")
+    params.reg_sizet("comm_replay_window_bytes", 1 << 24,
+                     "per-peer replay window: sent-but-unacked session "
+                     "frames retained for replay after a reconnect; at "
+                     "the cap the writer pauses data frames until the "
+                     "peer's cumulative acks drain it (retained bytes "
+                     "also count against comm_send_buffer_bytes)")
     params.reg_int("arena_max_used", -1, "cap on arena allocated buffers (-1 off)")
     params.reg_int("arena_max_cached", -1, "cap on arena cached buffers (-1 off)")
     params.reg_int("task_startup_iter", 64, "startup enumerator chunk iterations")

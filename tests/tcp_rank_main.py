@@ -380,7 +380,10 @@ def main() -> int:
             eng.sync()
             out = {"rank": rank, "max_err": err,
                    "msgs": eng.fabric.msg_count,
-                   "bytes": eng.fabric.bytes_count}
+                   "bytes": eng.fabric.bytes_count,
+                   "wire": {k: eng.wire_stats[k] for k in
+                            ("reconnects", "replayed_frames",
+                             "dup_dropped")}}
             if xstats is not None:
                 out["xfer"] = xstats
             print(json.dumps(out), flush=True)

@@ -21,6 +21,7 @@ import pytest
     "examples.ex11_wave_distributed",
     "examples.ex12_turbo_dispatch",
     "examples.ex13_elastic_shrink",
+    "examples.ex14_link_flap",
 ])
 def test_example_runs(mod):
     m = importlib.import_module(mod)

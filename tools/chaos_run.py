@@ -25,6 +25,18 @@ without editing it::
         --inject "kill:rank=2:after=4" --heartbeat 0.05 --timeout 2 -- \\
         examples/ex13_elastic_shrink.py
 
+    # link flap absorbed by the reliable session layer (reconnect +
+    # replay, zero evictions); a disconnect: past --reconnect's budget
+    # escalates to the elastic path instead
+    python tools/chaos_run.py --reconnect 10 \\
+        --inject "flap:rank=2:nth=30:duration=0.3" \\
+        --heartbeat 0.05 --timeout 3 -- examples/ex14_link_flap.py
+
+    # soak can mix link flaps with kills
+    python tools/chaos_run.py --soak 600 --reconnect 10 \\
+        --inject "flap:rank=1:nth=20:duration=0.2,kill:rank=2:after=40" \\
+        --heartbeat 0.05 --timeout 3 -- examples/ex14_link_flap.py
+
 Everything after ``--`` is the script and ITS argv. Exit status: the
 script's (an uncaught injected failure exits non-zero — which is the
 point: chaos_run makes "does it fail loudly instead of hanging?"
@@ -64,6 +76,10 @@ def main(argv=None) -> int:
     ap.add_argument("--restart", default="", metavar="POLICY",
                     help="ft_restart_policy, e.g. "
                          "'restart:retries=2:backoff=0.25:every=1'")
+    ap.add_argument("--reconnect", type=float, default=0.0, metavar="SECS",
+                    help="comm_reconnect_timeout: absorb torn TCP links "
+                         "by reconnect + session replay for up to SECS "
+                         "before escalating to rank failure (0 = off)")
     ap.add_argument("--soak", type=float, default=0.0, metavar="SECS",
                     help="sustained-load mode: re-run the target in a "
                          "loop under injection until SECS of wall time "
@@ -102,6 +118,8 @@ def main(argv=None) -> int:
         from parsec_tpu.ft.restart import RestartPolicy
         RestartPolicy.parse(ns.restart)
         os.environ["PARSEC_MCA_ft_restart_policy"] = ns.restart
+    if ns.reconnect > 0:
+        os.environ["PARSEC_MCA_comm_reconnect_timeout"] = str(ns.reconnect)
 
     script = os.path.abspath(ns.script)
     # drop only the LEADING separator: a later "--" belongs to the
@@ -132,6 +150,8 @@ def _soak(ns, script: str, args) -> int:
         base += ["--timeout", str(ns.timeout)]
     if ns.restart:
         base += ["--restart", str(ns.restart)]
+    if ns.reconnect > 0:
+        base += ["--reconnect", str(ns.reconnect)]
     base += [script, "--"] + list(args)
 
     t_end = time.monotonic() + ns.soak
