@@ -42,6 +42,17 @@ Execution modes (BENCH_MODE):
   throughput (4-writer snapshot onto a 2-rank grid), and the 3-rank
   kill-mid-dpotrf shrink-recovery wall vs the failure-free run
   (detection + agreement + reshard + replay, no operator in the loop).
+- ``stagec``: whole-stage DAG->XLA compilation (ISSUE 12) — the SAME
+  classic-runtime dpotrf at the SAME N/NB interpreted vs lowered into
+  fused jitted stages (scrubbed CPU subprocess, prestaged tiles,
+  bit-exactness gated); reports both GFLOP/s and the speedup.
+- ``geqrf``: the second workload — runtime-path tile QR (dgeqrf) with
+  the ``R^T R == A^T A`` residual, so it stops rotting silently.
+
+Every record carries ``schema_version`` + stable ``metric_id``/``mode``
+/``n``/``nb``/``dtype`` fields (schema 2): r01-r05 changed metric
+definitions, so cross-run ``vs_baseline`` is only comparable at equal
+(schema_version, metric_id, n, nb, dtype).
 
 Knobs (env): BENCH_N (default 8192), BENCH_NB (2048), BENCH_DTYPE
 (float32), BENCH_REPS (3, best-of), BENCH_CORES (runtime mode worker
@@ -287,23 +298,45 @@ def bench_capture_chain(n, nb, reps, dtype, chain_k):
     return best / chain_k, float(err)
 
 
+#: BENCH record schema (ISSUE 12 satellite): r01-r05 changed metric
+#: definitions (capture vs wave vs capture_chain), so the legacy
+#: "metric" string is NOT comparable across runs.  From schema 2 every
+#: record carries STABLE fields — ``schema_version``, ``metric_id``
+#: (mode-stable, e.g. "dpotrf_gflops/runtime"), ``mode``, ``n``,
+#: ``nb``, ``dtype`` — and cross-run ``vs_baseline`` comparisons must
+#: key on (schema_version, metric_id) at equal (n, nb, dtype).
+BENCH_SCHEMA_VERSION = 2
+
+
+def emit_json(rec: dict) -> None:
+    """Every BENCH json line goes through here: stamps the schema
+    version so downstream diffing can refuse to compare records whose
+    metric definitions differ."""
+    rec.setdefault("schema_version", BENCH_SCHEMA_VERSION)
+    print(json.dumps(rec))
+
+
 def emit_line(n, nb, dtype, mode, gflops, extras=None):
     line = {
         "metric": f"dpotrf_gflops(N={n},NB={nb},{dtype.name},1chip,{mode})",
+        "metric_id": f"dpotrf_gflops/{mode}",
+        "mode": mode, "n": n, "nb": nb, "dtype": dtype.name,
         "value": round(gflops, 2),
         "unit": "GFLOP/s",
         "vs_baseline": round(gflops / BASELINE_GFLOPS, 4),
     }
     if extras:
         line["extras"] = extras
-    print(json.dumps(line))
+    emit_json(line)
 
 
 def emit(n, nb, dtype, mode, best, err, extras=None):
     if err > NUMERICS_TOL:
-        print(json.dumps({"metric": "dpotrf_gflops", "value": 0.0,
-                          "unit": "GFLOP/s", "vs_baseline": 0.0,
-                          "error": f"numerics failed: {err}"}))
+        emit_json({"metric": "dpotrf_gflops",
+                   "metric_id": f"dpotrf_gflops/{mode}", "mode": mode,
+                   "n": n, "nb": nb, "dtype": dtype.name,
+                   "value": 0.0, "unit": "GFLOP/s", "vs_baseline": 0.0,
+                   "error": f"numerics failed: {err}"})
         return
     emit_line(n, nb, dtype, mode, dpotrf_flops(n) / best / 1e9, extras)
 
@@ -698,11 +731,32 @@ def bench_all(n, nb, reps, cores, dtype):
         ov = _try("overlap", lambda: bench_overlap())
         if ov is not None:
             extras.update(ov)
+    # compiled-stage vs interpreted runtime (ISSUE 12): scrubbed CPU
+    # subprocess, link-independent — rides every record
+    if os.environ.get("BENCH_STAGEC", "1") != "0":
+        sc = _try("stagec", lambda: bench_stagec(reps=2))
+        if sc is not None:
+            extras.update(sc)
+    # the second workload (dgeqrf) so it stops rotting silently
+    if os.environ.get("BENCH_GEQRF", "1") != "0":
+        gq = _try("geqrf", lambda: bench_geqrf(
+            n=int(os.environ.get("BENCH_GEQRF_N", "1024")),
+            nb=int(os.environ.get("BENCH_GEQRF_NB", "128")),
+            reps=2, cores=cores, dtype=dtype))
+        if gq is not None:
+            best_g, err_g, gex = gq
+            extras.update(gex)
+            if err_g < NUMERICS_TOL:
+                extras["geqrf_gflops"] = round(
+                    dgeqrf_flops(int(os.environ.get("BENCH_GEQRF_N",
+                                                    "1024")))
+                    / best_g / 1e9, 2)
     if not candidates:
-        print(json.dumps({"metric": "dpotrf_gflops", "value": 0.0,
-                          "unit": "GFLOP/s", "vs_baseline": 0.0,
-                          "error": "no mode passed numerics",
-                          "extras": extras}))
+        emit_json({"metric": "dpotrf_gflops",
+                   "metric_id": "dpotrf_gflops/none", "mode": "all",
+                   "value": 0.0, "unit": "GFLOP/s", "vs_baseline": 0.0,
+                   "error": "no mode passed numerics",
+                   "extras": extras})
         return
     mode, n_used, nb_used, gf = max(candidates, key=lambda c: c[3])
     # tunnel_degraded compares the trusted chip peak against the
@@ -1626,6 +1680,172 @@ def bench_overlap(n=768, nb=64, ranks=2, delay_ms=8) -> dict:
         return {"overlap_error": repr(exc)[:200]}
 
 
+# ---------------------------------------------------------------------- #
+# stage-compile benchmark (ISSUE 12): classic-runtime dpotrf through     #
+# compiled stages vs the interpreted per-task/batched dispatch           #
+# ---------------------------------------------------------------------- #
+def bench_stagec_inner(n=768, nb=64, reps=3, cores=1) -> dict:
+    """BENCH_MODE=stagec payload: the SAME classic-runtime dpotrf at
+    the SAME N/NB, interpreted (``stage_compile`` unset — the exact
+    pre-stagec path) vs stage-compiled (stagec/ lowers the verified
+    DAG into fused jitted stages executed as single chores).  Tiles are
+    prestaged into device memory outside the clock on BOTH legs (the
+    bench_runtime steady-state methodology), walls are best-of-reps
+    with the compile warm (the AOT stage cache persists across
+    taskpools by design), and the factors must be BIT-EXACT across
+    legs — the compiled program unrolls the identical per-task
+    subgraphs the interpreter dispatches one by one."""
+    import parsec_tpu
+    from parsec_tpu.collections import TwoDimBlockCyclic
+    from parsec_tpu.ops import dpotrf_taskpool
+    from parsec_tpu.utils.params import params as _params
+
+    M = make_input(n, np.float32)
+
+    def leg(stagec):
+        from contextlib import ExitStack
+        with ExitStack() as st:
+            if stagec:
+                st.enter_context(
+                    _params.cmdline_override("stage_compile", "1"))
+                st.enter_context(_params.cmdline_override(
+                    "stage_compile_max_tasks",
+                    os.environ.get("BENCH_STAGEC_MAX_TASKS", "4096")))
+            ctx = parsec_tpu.init(nb_cores=cores)
+            try:
+                import jax
+                devs = [d for d in ctx.devices if d.device_type == "tpu"]
+                if not devs:
+                    return None
+                dev = devs[0]
+                best = None
+                A = None
+                for _ in range(max(2, reps)):   # rep 1 pays the compile
+                    A = TwoDimBlockCyclic(n, n, nb, nb,
+                                          dtype=np.float32
+                                          ).from_numpy(M.copy())
+                    for co in A.tiles():
+                        dev.data_advise(A.data_of(*co), "prefetch")
+                    jax.block_until_ready(
+                        [A.data_of(*co).get_copy(dev.device_index).payload
+                         for co in A.tiles()])
+                    t0 = time.perf_counter()
+                    ctx.add_taskpool(dpotrf_taskpool(A))
+                    ctx.wait()
+                    pend = [A.data_of(*co).newest_copy().payload
+                            for co in A.tiles()]
+                    sync_device([p for p in pend
+                                 if hasattr(p, "block_until_ready")])
+                    dt = time.perf_counter() - t0
+                    best = dt if best is None else min(best, dt)
+                return best, np.tril(A.to_numpy()), dict(ctx.stage_stats)
+            finally:
+                ctx.fini()
+
+    interp = leg(False)
+    staged = leg(True)
+    out = {"stagec_n": n, "stagec_nb": nb}
+    if interp is None or staged is None:
+        out["error"] = "no XLA device attached"
+        return out
+    (ti, Li, _si), (ts, Ls, ss) = interp, staged
+    fl = dpotrf_flops(n)
+    out["interpreted_gflops"] = round(fl / ti / 1e9, 2)
+    out["stagec_gflops"] = round(fl / ts / 1e9, 2)
+    out["stagec_speedup"] = round(ti / ts, 2)
+    out["stagec_bit_exact_vs_interpreted"] = bool(np.array_equal(Li, Ls))
+    resid = float(np.abs(Ls.astype(np.float64)
+                         @ Ls.astype(np.float64).T - M).max()
+                  / np.abs(M).max())
+    out["stagec_residual"] = resid
+    out.update({f"stagec_{k}": v for k, v in ss.items()
+                if k != "stage_compile_ns"})
+    out["stagec_compile_ms"] = round(ss["stage_compile_ns"] / 1e6, 1)
+    return out
+
+
+_STAGEC_DRIVER = r"""
+import json, os, sys
+sys.path.insert(0, os.environ["BENCH_REPO"])
+import bench
+
+print(json.dumps(bench.bench_stagec_inner(
+    n=int(os.environ.get("BENCH_STAGEC_N", "768")),
+    nb=int(os.environ.get("BENCH_STAGEC_NB", "64")),
+    reps=int(os.environ.get("BENCH_REPS", "3")))))
+"""
+
+
+def bench_stagec(n=768, nb=64, reps=3) -> dict:
+    """BENCH_MODE=stagec: the compiled-stage vs interpreted runtime
+    comparison in a scrubbed CPU subprocess (bench_mesh pattern — the
+    ratio is a host-dispatch measurement and must not depend on the
+    tunnel session's TPU plugin or link health)."""
+    import subprocess
+    import sys as _sys
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    keep = ("PATH", "HOME", "LANG", "LC_ALL", "TMPDIR", "USER")
+    env = {k: os.environ[k] for k in keep if k in os.environ}
+    env.update(JAX_PLATFORMS="cpu", PYTHONPATH=repo, BENCH_REPO=repo,
+               PARSEC_MCA_device_tpu_platform="cpu",
+               BENCH_STAGEC_N=str(n), BENCH_STAGEC_NB=str(nb),
+               BENCH_REPS=str(reps))
+    try:
+        p = subprocess.run([_sys.executable, "-c", _STAGEC_DRIVER],
+                           env=env, capture_output=True, text=True,
+                           timeout=1200)
+        if p.returncode != 0:
+            return {"stagec_error": p.stdout[-200:] + p.stderr[-200:]}
+        return json.loads(p.stdout.strip().splitlines()[-1])
+    except Exception as exc:  # noqa: BLE001
+        return {"stagec_error": repr(exc)[:200]}
+
+
+def dgeqrf_flops(n: int, m: int = None) -> float:
+    """LAPACK dgeqrf flop model (2mn^2 - 2n^3/3 for m >= n)."""
+    m = n if m is None else m
+    return 2.0 * m * n * n - 2.0 * n ** 3 / 3.0
+
+
+def bench_geqrf(n=1024, nb=128, reps=3, cores=1, dtype=None):
+    """BENCH_MODE=geqrf (ISSUE 12 satellite): the second workload —
+    tile QR through the classic runtime — measured and residual-gated
+    like dpotrf's runtime leg so it stops rotting silently.  Residual:
+    ``||R^T R - A^T A|| / ||A^T A||`` (Q is discarded by design, so
+    the normal-equations identity is the factor check)."""
+    import parsec_tpu
+    from parsec_tpu.collections import TwoDimBlockCyclic
+    from parsec_tpu.ops import dgeqrf_taskpool
+
+    dtype = np.dtype(dtype or np.float32)
+    rng = np.random.RandomState(7)
+    M = rng.rand(n, n).astype(dtype)
+    ctx = parsec_tpu.init(nb_cores=cores)
+    try:
+        best = None
+        A = None
+        for _ in range(max(2, reps)):
+            A = TwoDimBlockCyclic(n, n, nb, nb, dtype=dtype
+                                  ).from_numpy(M.copy())
+            t0 = time.perf_counter()
+            ctx.add_taskpool(dgeqrf_taskpool(A))
+            ctx.wait()
+            pend = [A.data_of(*co).newest_copy().payload
+                    for co in A.tiles()]
+            sync_device([p for p in pend
+                         if hasattr(p, "block_until_ready")])
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        R = np.triu(A.to_numpy()).astype(np.float64)
+        G = M.astype(np.float64).T @ M.astype(np.float64)
+        err = float(np.abs(R.T @ R - G).max() / np.abs(G).max())
+        return best, err, {"geqrf_n": n, "geqrf_nb": nb,
+                           "geqrf_residual": err}
+    finally:
+        ctx.fini()
+
+
 def main() -> None:
     n = int(os.environ.get("BENCH_N", "8192"))
     nb = int(os.environ.get("BENCH_NB", "2048"))
@@ -1636,31 +1856,35 @@ def main() -> None:
 
     if mode == "comm":
         extras = bench_comm()
-        print(json.dumps({
+        emit_json({
             "metric": "comm_small_am_msgs_per_s(loopback_tcp,coalesced)",
+            "metric_id": "comm_small_am_msgs_per_s", "mode": mode,
             "value": extras["comm_tcp_small_msgs_per_s"],
-            "unit": "msgs/s", "extras": extras}))
+            "unit": "msgs/s", "extras": extras})
         return
     if mode == "ft":
         extras = bench_ft(reps=reps)
-        print(json.dumps({
+        emit_json({
             "metric": "ft_detection_latency_ms(loopback_tcp,hb_10ms)",
+            "metric_id": "ft_detection_latency_ms", "mode": mode,
             "value": extras["ft_detection_latency_ms"],
-            "unit": "ms", "extras": extras}))
+            "unit": "ms", "extras": extras})
         return
     if mode == "linkchaos":
         extras = bench_linkchaos(reps=reps)
-        print(json.dumps({
+        emit_json({
             "metric": "linkchaos_reconnect_ms(loopback_tcp,flap+replay)",
+            "metric_id": "linkchaos_reconnect_ms", "mode": mode,
             "value": extras["linkchaos_reconnect_ms"],
-            "unit": "ms", "extras": extras}))
+            "unit": "ms", "extras": extras})
         return
     if mode == "elastic":
         extras = bench_elastic(reps=reps)
-        print(json.dumps({
+        emit_json({
             "metric": "elastic_shrink_recovery_s(3-rank_dpotrf,kill)",
+            "metric_id": "elastic_shrink_recovery_s", "mode": mode,
             "value": extras["elastic_shrink_recovery_s"],
-            "unit": "s", "extras": extras}))
+            "unit": "s", "extras": extras})
         return
     if mode == "mesh":
         extras = bench_mesh(
@@ -1668,10 +1892,11 @@ def main() -> None:
             nb=int(os.environ.get("BENCH_MESH_NB", "96")),
             reps=reps,
             shape=os.environ.get("BENCH_MESH_SHAPE", "2x2"))
-        print(json.dumps({
+        emit_json({
             "metric": "mesh_wall_us_per_task(sharded,2x2,64-burst)",
+            "metric_id": "mesh_wall_us_per_task", "mode": mode,
             "value": extras.get("mesh_wall_us_per_task", -1.0),
-            "unit": "us/task", "extras": extras}))
+            "unit": "us/task", "extras": extras})
         return
     if mode == "overlap":
         extras = bench_overlap(
@@ -1679,20 +1904,46 @@ def main() -> None:
             nb=int(os.environ.get("BENCH_OVERLAP_NB", "64")),
             ranks=int(os.environ.get("BENCH_OVERLAP_RANKS", "2")),
             delay_ms=int(os.environ.get("BENCH_OVERLAP_DELAY_MS", "8")))
-        print(json.dumps({
+        emit_json({
             "metric": "overlap_fraction_gain(throttled_link,on_vs_off)",
+            "metric_id": "overlap_fraction_gain", "mode": mode,
             "value": extras.get("overlap_gain", -1.0),
-            "unit": "fraction", "extras": extras}))
+            "unit": "fraction", "extras": extras})
         return
     if mode == "dispatch":
         extras = bench_dispatch(
             burst=int(os.environ.get("BENCH_DISPATCH_BURST", "64")),
             nb=int(os.environ.get("BENCH_DISPATCH_NB", "96")),
             reps=reps)
-        print(json.dumps({
+        emit_json({
             "metric": "device_dispatch_us_per_task(batched,64-burst)",
+            "metric_id": "device_dispatch_us_per_task", "mode": mode,
             "value": extras.get("batched_dispatch_us_per_task", -1.0),
-            "unit": "us/task", "extras": extras}))
+            "unit": "us/task", "extras": extras})
+        return
+    if mode == "stagec":
+        extras = bench_stagec(
+            n=int(os.environ.get("BENCH_STAGEC_N", "768")),
+            nb=int(os.environ.get("BENCH_STAGEC_NB", "64")),
+            reps=reps)
+        emit_json({
+            "metric": "stagec_gflops(runtime_dpotrf,compiled_stages)",
+            "metric_id": "stagec_gflops", "mode": mode,
+            "value": extras.get("stagec_gflops", -1.0),
+            "unit": "GFLOP/s", "extras": extras})
+        return
+    if mode == "geqrf":
+        best, err, extras = bench_geqrf(
+            n=int(os.environ.get("BENCH_GEQRF_N", "1024")),
+            nb=int(os.environ.get("BENCH_GEQRF_NB", "128")),
+            reps=reps, cores=cores, dtype=dtype)
+        gf = dgeqrf_flops(n=int(os.environ.get("BENCH_GEQRF_N", "1024"))
+                          ) / best / 1e9
+        emit_json({
+            "metric": "dgeqrf_gflops(runtime)",
+            "metric_id": "dgeqrf_gflops/runtime", "mode": mode,
+            "value": round(gf, 2) if err < NUMERICS_TOL else 0.0,
+            "unit": "GFLOP/s", "residual": err, "extras": extras})
         return
     if mode == "all":
         bench_all(n, nb, reps, cores, dtype)

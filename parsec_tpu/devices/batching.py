@@ -53,7 +53,8 @@ from typing import Any, Callable, Dict, Optional, Tuple
 __all__ = ["DeviceBatchSpec", "bucket_size", "segment_plan",
            "stacked_callable_key",
            "build_stacked_callable", "cached_stacked_callable",
-           "build_sharded_callable", "cached_sharded_callable"]
+           "build_sharded_callable", "cached_sharded_callable",
+           "cached_stage_callable"]
 
 
 class DeviceBatchSpec:
@@ -144,6 +145,27 @@ def stacked_callable_key(n: int, nargs: int, static: Any,
 #: process-wide stacked-callable cache for specs with a ``cache_token``
 #: (taskpool-independent bodies): token -> key -> jitted callable
 _shared_cache: Dict[Any, Dict[Any, Any]] = {}
+
+#: process-wide stage-callable cache (stagec/, ISSUE 12), living
+#: alongside the bucket cache above: token -> key -> fused jitted
+#: callable (or the stagec failure sentinel).  The token embeds the
+#: parsed spec object + scalar globals + collection geometry, so a
+#: fresh taskpool over the same (spec, NB, dtype) hits already-traced
+#: stages — the PTG analog of the DTD ``cache_token`` steady state.
+_stage_cache: Dict[Any, Dict[Any, Any]] = {}
+
+
+def cached_stage_callable(token: Any, key: Any, build: Callable) -> Any:
+    """Fetch-or-build one stage's lowered callable.  ``build`` runs at
+    most once per (token, key); whatever it returns (including a
+    failure sentinel recorded by the stage compiler) is returned to
+    every later caller."""
+    cache = _stage_cache.setdefault(token, {})
+    fn = cache.get(key)
+    if fn is None:
+        fn = build()
+        cache[key] = fn
+    return fn
 
 
 def cached_stacked_callable(spec: DeviceBatchSpec, n: int, nargs: int,

@@ -449,6 +449,17 @@ class PTGTaskClass(TaskClass):
     def activate(self, locals_: Tuple, flow_name: str, copy) -> Optional[Task]:
         """One input of instance ``locals_`` became available; spawn the task
         when the dynamic dep counter reaches its goal."""
+        sc = self.tp._stagec
+        if sc is not None:
+            # stage-compile seam (stagec/, ISSUE 12): activations for
+            # instances fused into a compiled stage count toward the
+            # STAGE's external goal instead; local residue, other
+            # stages, and remote ranks all arrive through this one
+            # funnel, so no wire/protocol change is needed.  Downgraded
+            # stages pass through to the dynamic table below.
+            handled, task = sc.on_activate(self, locals_, flow_name, copy)
+            if handled:
+                return task
         key = locals_
         self.dep_table.lock_bucket(key)
         try:
@@ -703,6 +714,7 @@ class PTGTaskpool(Taskpool):
         self._dag = None      # LoweredDAG when static dep management is on
         self._turbo = None    # TurboRunner when the native loop took it
         self._engine = None   # NativeDAG / PyDAG ready-tracking engine
+        self._stagec = None   # StageCompiler when stage_compile is on
 
     def class_by_name(self, name: str) -> PTGTaskClass:
         return self._classes[name]
@@ -711,7 +723,16 @@ class PTGTaskpool(Taskpool):
     # startup (ref: generated startup enumerator jdf2c.c:2975-3385)       #
     # ------------------------------------------------------------------ #
     def _startup(self, context, tp) -> List[Task]:
-        if (params.get("ptg_dep_management") == "static"
+        if params.get("stage_compile") and not grapher.enabled:
+            # whole-stage DAG->XLA compilation (stagec/, ISSUE 12):
+            # compilable stages execute as single fused chores, the
+            # residue stays on the interpreted path below.  Takes
+            # precedence over the static/turbo engines — the compiled
+            # stage IS the static fast path here.
+            from ...stagec.runtime import try_install
+            self._stagec = try_install(self, context)
+        if (self._stagec is None
+                and params.get("ptg_dep_management") == "static"
                 and self.nb_ranks == 1 and not grapher.enabled
                 and not self._has_out_edge_types()):
             turbo = self._startup_turbo(context)
@@ -720,6 +741,7 @@ class PTGTaskpool(Taskpool):
             return self._startup_static()
         total = 0
         startup: List[Task] = []
+        sc = self._stagec
         count_foreign = self.nb_ranks > 1 and self.comm is not None
         expected_mem_puts = 0
         for tc in self._classes.values():
@@ -733,8 +755,15 @@ class PTGTaskpool(Taskpool):
                             tc, env)
                     continue
                 total += 1
+                if sc is not None and sc.is_member(tc.ast.name, locals_):
+                    continue   # spawns through its compiled stage
                 if tc.goal_of(locals_, env) == 0:
                     startup.append(tc.make_task(locals_, None))
+        if sc is not None:
+            # stages with no external task inputs start the DAG (their
+            # members were counted above; a stage completion retires
+            # every member's count)
+            startup.extend(sc.startup_tasks())
         # counts FIRST, delivery second: activations/puts released by
         # counts_ready may schedule tasks that complete on a worker
         # thread immediately — nb_tasks must already hold the total or

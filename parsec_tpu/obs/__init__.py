@@ -86,6 +86,19 @@ class ContextObs:
             self.overlap = OverlapTracker()
             ctx.sde.register_poll(OBS_OVERLAP_FRACTION, self.overlap.fraction)
             ctx.sde.register_poll(OBS_EXPOSED_COMM_US, self.overlap.exposed_us)
+        # stage-compile gauges (stagec/, ISSUE 12; guide §9.1):
+        # poll-only over the context's stage counters
+        ss = getattr(ctx, "stage_stats", None)
+        if isinstance(ss, dict):
+            ctx.sde.register_poll("PARSEC::STAGEC::STAGE_COMPILES",
+                                  lambda s=ss: s["stage_compiles"])
+            ctx.sde.register_poll("PARSEC::STAGEC::STAGE_TASKS",
+                                  lambda s=ss: s["stage_tasks"])
+            ctx.sde.register_poll("PARSEC::STAGEC::STAGE_FALLBACKS",
+                                  lambda s=ss: s["stage_fallbacks"])
+            ctx.sde.register_poll(
+                "PARSEC::STAGEC::STAGE_COMPILE_US",
+                lambda s=ss: round(s["stage_compile_ns"] / 1e3, 1))
         # device pull gauges always (poll-only, no hot-path cost); the
         # span/histogram sink only when telemetry is on
         for dev in ctx.devices:

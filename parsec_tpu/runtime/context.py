@@ -206,6 +206,13 @@ class Context:
             (d.mesh for d in self.devices
              if getattr(d, "mesh", None) is not None), None)
 
+        # stage-compile telemetry (stagec/, ISSUE 12): per-rank
+        # counters every StageCompiler on this context accumulates
+        # into; exposed as PARSEC::STAGEC::* gauges by ContextObs
+        self.stage_stats = {"stage_compiles": 0, "stage_tasks": 0,
+                            "stage_fallbacks": 0, "stage_compile_ns": 0,
+                            "stage_dispatches": 0, "stage_sharded": 0}
+
         # online critical-path class profile (ISSUE 7): duration-
         # weighted per-class EWMAs + upward-rank boosts the priority
         # schedulers consume (runtime/profile.py); None = static

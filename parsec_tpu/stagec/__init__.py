@@ -1,0 +1,22 @@
+"""stagec/ — whole-stage DAG→XLA compilation (ISSUE 12).
+
+Lower verified PTG stages into fused jitted programs: the lowerability
+pass (:mod:`.plan`) partitions the instantiated DAG into compilable
+stages vs interpreted residue using the analysis/ verdicts; the
+lowering pass (:mod:`.lower`) emits one traced function per stage
+(AOT-cached per spec/NB/dtype/stage shape); sharded variants
+(:mod:`.sharded`) compile wave fronts through shard_map over the
+rank's chip mesh; and the runtime integration (:mod:`.runtime`)
+executes compiled stages as single chores interleaved with the
+interpreted residue behind the ``stage_compile`` MCA knob.
+"""
+from .plan import (ClassVerdict, Stage, StagePlan, class_verdicts,
+                   lower_report, plan_stages)
+from .lower import StageLayout, build_layout, build_stage_fn, spec_token
+from .runtime import StageCompiler, try_install
+
+__all__ = [
+    "ClassVerdict", "Stage", "StagePlan", "class_verdicts",
+    "lower_report", "plan_stages", "StageLayout", "build_layout",
+    "build_stage_fn", "spec_token", "StageCompiler", "try_install",
+]

@@ -1,0 +1,517 @@
+"""Runtime integration: execute compiled stages as single chores
+interleaved with the interpreted residue (ISSUE 12 tentpole, part 4).
+
+A :class:`StageCompiler` attaches to a ``PTGTaskpool`` at startup when
+the ``stage_compile`` MCA knob is on.  Each compilable stage becomes
+ONE synthetic task on the ordinary runtime: its flows are the stage's
+packed buffer slots, its chore is the fused jitted callable (or the
+shard_map-compiled wave-front variant on a mesh device), and it rides
+the untouched scheduler / device-module / eager-completion machinery —
+stage-in, HBM accounting, donation guards, priority stamping and the
+PR 7 eager-release window all apply to a stage exactly as they do to a
+single task, which is what lets a compiled stage's cross-rank sends
+overlap its own execution.
+
+Dynamic dependency tracking for stages piggybacks on the existing
+activation protocol: ``PTGTaskClass.activate`` consults the compiler
+first (``on_activate``), so activations from local residue tasks,
+other stages, AND remote ranks all count toward a stage's external
+goal without any wire-format change; when the counter hits zero the
+stage task spawns (its fused callable AOT-validated right there) and
+is scheduled like any ready task.  On completion the stage's release
+walk reuses each member's untouched ``_release_deps`` — remote
+activations batch per rank, memory writebacks ride the device epilog —
+with intra-stage edges swallowed by the same ``on_activate`` seam.
+
+Fallback ladder (semantics are never at risk):
+
+1. a class the lowerability pass rejects stays interpreted (residue);
+2. a stage whose fused trace fails at spawn DOWNGRADES — its buffered
+   activations replay through the normal dynamic path and its members
+   execute via the PR 5/7 batched dispatch, permanently but only for
+   that stage (the failure is cached, other stages keep compiling);
+3. a sharded (mesh) build/dispatch failure falls back to the fused
+   single-chip callable for that stage;
+4. ``stage_compile`` unset: ``tp._stagec`` is None and behavior is
+   bit-for-bit the pre-stagec runtime.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..data.data import Coherency, Data, DataCopy, FlowAccess
+from ..runtime.taskpool import (ACTION_RELEASE_ALL, Chore, Flow, Task,
+                                TaskClass)
+from ..utils import logging as plog
+from ..utils.params import params
+from .lower import (StageLayout, build_layout, build_stage_fn,
+                    spec_token, stage_signature)
+from .plan import StagePlan, plan_stages
+
+#: declared lock discipline (analysis/lock_check.py): a stage record's
+#: dependency counter, buffered activation events, and lifecycle status
+#: are mutated from worker threads AND the comm delivery path — every
+#: access goes through the record's own lock.  ``edge_copies`` is
+#: single-owner by lifecycle (written by the dispatching manager, read
+#: by the completing worker's release walk, ordered by the task
+#: lifecycle) and deliberately unregistered.
+_GUARDED_BY = {
+    "_StageRec.remaining": "_lock",
+    "_StageRec.events": "_lock",
+    "_StageRec.status": "_lock",
+}
+
+# _StageRec lifecycle
+_PENDING, _SPAWNED, _DONE, _DOWNGRADED = range(4)
+
+#: cache sentinel: a stage signature whose build already failed —
+#: the next taskpool over the same spec downgrades instantly instead
+#: of re-tracing the known failure ("permanent, but only for that
+#: stage")
+_FAILED = object()
+
+
+class _StageRec:
+    """One stage's dynamic state on one taskpool."""
+
+    def __init__(self, stage, layout: StageLayout, priority: int) -> None:
+        self.stage = stage
+        self.layout = layout
+        self.priority = priority
+        self._lock = threading.Lock()
+        self.remaining = layout.goal
+        self.events: List[Tuple] = []   # (member_key, flow, copy) buffered
+        self.status = _PENDING
+        self.fn = None                  # fused jitted callable
+        self.sharded = None             # (fn, sharding, info) or None
+        self.task: Optional[Task] = None
+        self.edge_copies: Dict[Tuple, Any] = {}
+        self.shapes: Tuple = ()
+        self.donate: Tuple = ()
+
+
+class StageTaskClass(TaskClass):
+    """The synthetic task class of ONE compiled stage: flows are the
+    stage's packed buffer slots.  Never registered on the taskpool's
+    ``task_classes`` (remote activation ids index that list), so the
+    wire protocol is untouched."""
+
+    def __init__(self, compiler: "StageCompiler", rec: _StageRec) -> None:
+        lay = rec.layout
+        flows: List[Flow] = []
+        for i, ((coll, coords), access) in enumerate(lay.mem_slots):
+            flows.append(Flow(f"{coll}{coords}", access, i))
+        base = len(lay.mem_slots)
+        for j, (mkey, fname) in enumerate(lay.act_slots):
+            flows.append(Flow(f"{mkey[0]}{mkey[1]}.{fname}",
+                              FlowAccess.READ, base + j))
+        super().__init__(f"STAGE{rec.stage.index}[{compiler.tp.name}]",
+                         -1 - rec.stage.index, len(flows), flows=flows)
+        from ..devices.tpu import tpu_chore_hook
+        self.incarnations = [Chore("tpu", tpu_chore_hook(),
+                                   dyld_fn=compiler._make_dyld(rec))]
+        self.release_deps = \
+            lambda es, task, mask, c=compiler, r=rec: c._release(es, r)
+        # one stage completion retires every member task's count (the
+        # final unit comes from complete_execution's own decrement)
+        n = rec.stage.n_tasks
+        if n > 1:
+            self.complete_execution = \
+                lambda es, task, tp=compiler.tp: tp.task_completed(n - 1)
+
+
+class StageCompiler:
+    """Per-taskpool stage-compile engine (``tp._stagec``)."""
+
+    def __init__(self, tp, context, plan: StagePlan) -> None:
+        self.tp = tp
+        self.context = context
+        self.plan = plan
+        self.stats = context.stage_stats
+        from ..dsl.ptg.capture import _pick_body
+        self._codes = {
+            tc.ast.name: compile(_pick_body(tc.ast).code,
+                                 f"<jdf:{tc.ast.name}:BODY[stagec]>",
+                                 "exec")
+            for tc in tp.task_classes}
+        self._token = spec_token(tp)
+        self._donate_on = bool(params.get("device_donate"))
+        # the mesh device, when this rank's accelerator is one (PR 6):
+        # wave-front stages then compile through shard_map over it
+        self._mesh_dev = next(
+            (d for d in context.devices
+             if d.device_type == "tpu" and getattr(d, "mesh", None)
+             is not None and len(getattr(d, "chips", ())) > 1), None)
+        self._recs: List[_StageRec] = []
+        self._member_rec: Dict[Tuple, _StageRec] = {}
+        for stage, layout, prio in plan.prepared:
+            rec = _StageRec(stage, layout, prio)
+            self._recs.append(rec)
+            for m in stage.members:
+                self._member_rec[m.key] = rec
+
+    def _tc(self, inst):
+        """The LIVE taskpool's class for a (possibly cached-plan)
+        instance: plans are cached per spec token across taskpools, so
+        ``inst.tc`` may belong to an earlier pool — every runtime
+        action rebinds by name."""
+        return self.tp.class_by_name(inst.tc.ast.name)
+
+    # ------------------------------------------------------------------ #
+    # dependency tracking: the activate redirect                         #
+    # ------------------------------------------------------------------ #
+    def on_activate(self, tc, locals_: Tuple, flow_name: str,
+                    copy) -> Tuple[bool, Optional[Task]]:
+        """Called by ``PTGTaskClass.activate`` before its own dynamic
+        dep table.  Returns ``(handled, ready_task)``; handled=False
+        passes through to the interpreted path (non-members and
+        downgraded stages)."""
+        rec = self._member_rec.get((tc.ast.name, locals_))
+        if rec is None:
+            return False, None
+        spawn = False
+        with rec._lock:
+            if rec.status == _DOWNGRADED:
+                return False, None
+            if rec.status != _PENDING:
+                # an intra-stage edge emitted by the release walk of
+                # this very stage: already computed inside the fused
+                # program — swallow
+                return True, None
+            rec.events.append(((tc.ast.name, locals_), flow_name, copy))
+            rec.remaining -= 1
+            assert rec.remaining >= 0, \
+                f"{tc.ast.name}{locals_}: stage overshoot"
+            if rec.remaining == 0:
+                rec.status = _SPAWNED   # claim; build outside the lock
+                spawn = True
+        if not spawn:
+            return True, None
+        tasks = self._spawn(rec)
+        if not tasks:
+            return True, None
+        if len(tasks) > 1:
+            from ..runtime.scheduling import schedule
+            schedule(self.context.execution_streams[0], tasks[1:])
+        return True, tasks[0]
+
+    def startup_tasks(self) -> List[Task]:
+        """Stages with no external task inputs are startup tasks."""
+        out: List[Task] = []
+        for rec in self._recs:
+            with rec._lock:
+                if rec.status != _PENDING or rec.remaining > 0:
+                    continue
+                rec.status = _SPAWNED
+            out.extend(self._spawn(rec))
+        return out
+
+    def is_member(self, class_name: str, locals_: Tuple) -> bool:
+        rec = self._member_rec.get((class_name, locals_))
+        if rec is None:
+            return False
+        with rec._lock:
+            return rec.status != _DOWNGRADED
+
+    # ------------------------------------------------------------------ #
+    # spawn: AOT-validate the fused callable, bind slots, emit the task  #
+    # ------------------------------------------------------------------ #
+    def _spawn(self, rec: _StageRec) -> List[Task]:
+        try:
+            return [self._make_stage_task(rec)]
+        except Exception as exc:  # noqa: BLE001 - any failure interprets
+            plog.warning(
+                "stagec: stage %d of %s failed to lower (%s: %s); its %d "
+                "member task(s) run interpreted",
+                rec.stage.index, self.tp.name, type(exc).__name__,
+                str(exc)[:200], rec.stage.n_tasks)
+            return self._downgrade(rec)
+
+    def _slot_shapes(self, rec: _StageRec, bindings: Dict) -> Tuple:
+        shapes = []
+        for (coll_name, coords), _access in rec.layout.mem_slots:
+            coll = self.tp.global_env[coll_name]
+            data = coll.data_of(*coords)
+            newest = data.newest_copy()
+            if newest is not None and newest.payload is not None:
+                shapes.append((tuple(newest.payload.shape),
+                               str(newest.payload.dtype)))
+            else:
+                shapes.append((tuple(coll.tile_shape(*coords)),
+                               str(np.dtype(coll.dtype))))
+        for ak in rec.layout.act_slots:
+            cp = bindings.get(ak)
+            if cp is None or cp.payload is None:
+                raise RuntimeError(
+                    f"activation slot {ak} bound no payload")
+            shapes.append((tuple(cp.payload.shape),
+                           str(cp.payload.dtype)))
+        return tuple(shapes)
+
+    def _lowered(self, rec: _StageRec, donate: Tuple) -> Any:
+        """The AOT-cached fused callable for this stage signature —
+        alongside the bucket cache (devices/batching.py); a repeat
+        taskpool over the same spec/NB/dtype hits it without
+        re-tracing.  A cached failure re-raises instantly."""
+        import jax
+        from ..devices.batching import cached_stage_callable
+
+        key = stage_signature(rec.stage, rec.shapes) + (donate, "fused")
+
+        def build():
+            t0 = time.perf_counter_ns()
+            run = build_stage_fn(self.tp, rec.stage, rec.layout,
+                                 self._codes)
+            fn = jax.jit(run, donate_argnums=donate)
+            # force the trace NOW: untraceable bodies must downgrade at
+            # spawn, not poison the device dispatch path
+            avals = tuple(jax.ShapeDtypeStruct(s, np.dtype(d))
+                          for (s, d) in rec.shapes)
+            jax.eval_shape(run, *avals)
+            dt = time.perf_counter_ns() - t0
+            self.stats["stage_compiles"] += 1
+            self.stats["stage_compile_ns"] += dt
+            return fn
+
+        fn = cached_stage_callable(self._token, key, build)
+        if fn is _FAILED:
+            raise RuntimeError("stage lowering previously failed "
+                               "(cached verdict)")
+        return fn
+
+    def _make_stage_task(self, rec: _StageRec) -> Task:
+        with rec._lock:
+            events = list(rec.events)
+        bindings: Dict[Tuple, Any] = {}
+        for (mkey, fname, copy) in events:
+            if copy is not None:
+                bindings[(mkey, fname)] = copy
+        rec.shapes = self._slot_shapes(rec, bindings)
+        rec.donate = tuple(
+            i for i, (_k, acc) in enumerate(rec.layout.mem_slots)
+            if self._donate_on and (acc & FlowAccess.WRITE))
+        from ..devices.batching import cached_stage_callable
+        try:
+            rec.fn = self._lowered(rec, rec.donate)
+        except Exception:
+            # record the verdict so the next taskpool over the same
+            # spec downgrades this stage instantly (permanent, but
+            # only for this stage)
+            cached_stage_callable(
+                self._token,
+                stage_signature(rec.stage, rec.shapes)
+                + (rec.donate, "fused"),
+                lambda: _FAILED)
+            raise
+        if self._mesh_dev is not None \
+                and params.get("stage_compile_shard"):
+            rec.sharded = self._try_sharded(rec)
+        tc = StageTaskClass(self, rec)
+        task = Task(self.tp, tc, locals_=(rec.stage.index,),
+                    priority=rec.priority)
+        task.user = rec
+        for i, ((coll_name, coords), _a) in enumerate(rec.layout.mem_slots):
+            coll = self.tp.global_env[coll_name]
+            task.data[i].data_in = coll.data_of(*coords).host_copy()
+            task.data[i].fulfilled = True
+        base = len(rec.layout.mem_slots)
+        for j, ak in enumerate(rec.layout.act_slots):
+            task.data[base + j].data_in = bindings[ak]
+            task.data[base + j].fulfilled = True
+        rec.task = task
+        return task
+
+    def _try_sharded(self, rec: _StageRec):
+        """Wave-front stages on a mesh rank compile through shard_map
+        over the rank's chips (stagec/sharded.py); any failure keeps
+        the fused single-chip callable."""
+        from .sharded import build_wavefront_callable, wavefront_info
+        dev = self._mesh_dev
+        k = len(dev.chips)
+        n = rec.stage.n_tasks
+        if n < k or n % k:
+            return None
+        try:
+            info = wavefront_info(self.tp, rec.stage, rec.layout,
+                                  self._codes)
+            if info is None:
+                return None
+            row_shapes = tuple(
+                rec.shapes[info.arg_slots[0][j]] for j in range(info.nargs))
+            from ..devices.batching import cached_stage_callable
+            key = stage_signature(rec.stage, rec.shapes) + \
+                ("sharded", dev.mesh)
+
+            def build():
+                t0 = time.perf_counter_ns()
+                fn_sh = build_wavefront_callable(dev.mesh, info,
+                                                 self.tp.rank, row_shapes)
+                self.stats["stage_compiles"] += 1
+                self.stats["stage_compile_ns"] += \
+                    time.perf_counter_ns() - t0
+                return fn_sh
+
+            fn, sharding = cached_stage_callable(self._token, key, build)
+            return (fn, sharding, info)
+        except Exception as exc:  # noqa: BLE001 - fused path stands by
+            plog.debug.verbose(
+                2, "stagec: sharded lowering of stage %d declined (%s); "
+                "fused single-chip callable", rec.stage.index, exc)
+            return None
+
+    # ------------------------------------------------------------------ #
+    # downgrade: replay into the interpreted dynamic path                #
+    # ------------------------------------------------------------------ #
+    def _downgrade(self, rec: _StageRec) -> List[Task]:
+        """Transparent per-stage fallback: buffered external
+        activations replay through the normal per-class dep tables and
+        the members execute via the interpreted (batched, PR 5/7)
+        dispatch.  Permanent only for this stage — other stages keep
+        their compiled path."""
+        with rec._lock:
+            rec.status = _DOWNGRADED
+            events, rec.events = rec.events, []
+        self.stats["stage_fallbacks"] += 1
+        ready: List[Task] = []
+        for inst in rec.stage.members:
+            tc = self._tc(inst)
+            if tc.goal_of(inst.locals) == 0:
+                ready.append(tc.make_task(inst.locals, None))
+        for (mkey, fname, copy) in events:
+            tc = self.tp.class_by_name(mkey[0])
+            t = tc.activate(mkey[1], fname, copy)
+            if t is not None:
+                ready.append(t)
+        return ready
+
+    # ------------------------------------------------------------------ #
+    # execution: the stage chore                                         #
+    # ------------------------------------------------------------------ #
+    def _make_dyld(self, rec: _StageRec):
+        def dyld(task: Task, arrays: List[Any]):
+            return self._execute_stage(task, rec, arrays)
+        return dyld
+
+    def _execute_stage(self, task: Task, rec: _StageRec,
+                       arrays: List[Any]):
+        lay = rec.layout
+        tile_outs = edge_outs = None
+        if rec.sharded is not None:
+            from .sharded import dispatch_sharded
+            fn, sharding, info = rec.sharded
+            try:
+                tile_outs, edge_outs = dispatch_sharded(
+                    self._mesh_dev, fn, sharding, info, arrays)
+                self.stats["stage_sharded"] += 1
+            except Exception as exc:  # noqa: BLE001 - fused fallback
+                plog.warning(
+                    "stagec: sharded dispatch of stage %d failed (%s); "
+                    "fused single-chip dispatch", rec.stage.index, exc)
+                rec.sharded = None
+                tile_outs = None
+        if tile_outs is None:
+            fn = rec.fn
+            if rec.donate and len({id(a) for a in arrays}) != len(arrays):
+                # the same buffer at two slots: donation would trip
+                # XLA's aliasing rule — use the undonated variant
+                fn = self._lowered(rec, ())
+            outs = fn(*arrays)
+            ntile = len(lay.out_mem)
+            tile_outs, edge_outs = list(outs[:ntile]), list(outs[ntile:])
+        dev = task.selected_device
+        for ek, arr in zip(lay.edge_outs, edge_outs):
+            if arr is None:
+                continue   # a NULL-forwarded flow: successors bind None
+            rec.edge_copies[ek] = _edge_copy(arr)
+        self.stats["stage_dispatches"] += 1
+        self.stats["stage_tasks"] += rec.stage.n_tasks
+        if dev is not None:
+            dev.stats["tasks"] += rec.stage.n_tasks - 1  # +1 from epilog
+        return tuple(tile_outs)
+
+    # ------------------------------------------------------------------ #
+    # release: each member's untouched _release_deps over the stash      #
+    # ------------------------------------------------------------------ #
+    def _release(self, es, rec: _StageRec) -> List[Task]:
+        with rec._lock:
+            rec.status = _DONE
+        ready: List[Task] = []
+        for inst in rec.stage.members:
+            if inst.key not in rec.layout.release_members:
+                continue   # every successor is fused into this stage
+            tc = self._tc(inst)
+            shim = Task(self.tp, tc, inst.locals)
+            for i, f in enumerate(tc.ast.flows):
+                cp = rec.edge_copies.get((inst.key, f.name))
+                if cp is not None:
+                    shim.data[i].data_out = cp
+            ready.extend(tc._release_deps(
+                es, shim, ACTION_RELEASE_ALL) or [])
+        rec.edge_copies.clear()
+        return ready
+
+
+def _edge_copy(arr) -> DataCopy:
+    """Wrap a stage live-out device array as a deliverable DataCopy
+    (the shape _deliver_activation builds for remote arrivals): a
+    detached Data whose newest copy holds the (possibly still
+    in-flight) device buffer — consumers chain on it like on any
+    eager-completed task output."""
+    d = Data(nb_elts=int(getattr(arr, "size", 0)))
+    cp = DataCopy(d, 0, payload=arr)
+    cp.version = 1
+    cp.coherency = Coherency.OWNED
+    d.attach_copy(cp)
+    return cp
+
+
+def try_install(tp, context) -> Optional[StageCompiler]:
+    """Build a StageCompiler for ``tp`` when the stage_compile knob is
+    on and the pool is eligible; None keeps the interpreted runtime
+    bit-for-bit (the knob's off-contract).  The plan + layouts are a
+    pure function of (spec, globals, geometry, distribution, rank), so
+    they cache under the spec token — a repeat taskpool skips the whole
+    enumeration/partition walk, not just the retrace."""
+    if not any(d.device_type == "tpu" for d in context.devices):
+        return None
+    wavefront = any(
+        d.device_type == "tpu" and getattr(d, "mesh", None) is not None
+        and len(getattr(d, "chips", ())) > 1 for d in context.devices)
+    max_tasks = int(params.get("stage_compile_max_tasks"))
+
+    def build_plan():
+        plan = plan_stages(tp, rank=tp.rank, max_tasks=max_tasks,
+                           wavefront=wavefront)
+        for stage in plan.stages:
+            layout = build_layout(tp, plan, stage)
+            # the max over the members' TRUE priorities (negative
+            # included — a spec that deprioritizes a class must not
+            # see its compiled stage boosted to 0)
+            prios = [int(m.tc.ast.priority(m.env))
+                     for m in stage.members
+                     if m.tc.ast.priority is not None]
+            plan.prepared.append((stage, layout,
+                                  max(prios) if prios else 0))
+        return plan
+
+    try:
+        from ..devices.batching import cached_stage_callable
+        plan = cached_stage_callable(
+            spec_token(tp), ("stageplan", wavefront, max_tasks),
+            build_plan)
+    except Exception as exc:  # noqa: BLE001 - unenumerable: interpret
+        plog.debug.verbose(
+            2, "stagec: %s not plannable (%s: %s); interpreted path",
+            tp.name, type(exc).__name__, exc)
+        return None
+    if not plan.stages:
+        return None
+    plog.debug.verbose(
+        3, "stagec: %s rank %d -> %d stage(s) covering %d/%d local "
+        "task(s), %d residue", tp.name, tp.rank, len(plan.stages),
+        plan.n_staged, plan.n_local, plan.n_residue)
+    return StageCompiler(tp, context, plan)

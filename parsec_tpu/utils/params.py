@@ -345,6 +345,23 @@ def register_core_params() -> None:
                    "while the rest of the batch is still executing "
                    "(<=1 = whole-batch flush, the pre-overlap behavior; "
                    "segments never shrink below 2 tasks)")
+    params.reg_bool("stage_compile", False,
+                    "whole-stage DAG->XLA compilation (stagec/, ISSUE "
+                    "12): lower verified PTG stages into fused jitted "
+                    "programs executed as single chores, with the "
+                    "interpreted batched dispatch as the residue/"
+                    "fallback path; off (default) keeps the per-task "
+                    "runtime bit-for-bit")
+    params.reg_int("stage_compile_max_tasks", 1024,
+                   "max task instances fused into one compiled stage "
+                   "(bounds trace size / compile time; larger stages "
+                   "amortize dispatch further — cross-stage boundaries "
+                   "pay an interpreted release walk per boundary task)")
+    params.reg_bool("stage_compile_shard", True,
+                    "compile eligible wave-front stages through "
+                    "shard_map over the rank's chip mesh "
+                    "(device_mesh_shape) so one compiled stage spans "
+                    "chips; off forces the fused single-chip callable")
     params.reg_int("comm_prefetch_inflight", 8,
                    "max rendezvous GETs prefetched for activations that "
                    "arrived ahead of their taskpool's registration/"

@@ -36,6 +36,22 @@ def test_dgeqrf_rtr_identity(ctx, m, n, nb):
         R.T @ R, M.astype(np.float64).T @ M.astype(np.float64), atol=2e-3)
 
 
+def test_dgeqrf_residual_gate(ctx):
+    """The dgeqrf RESIDUAL gate (ISSUE 12 satellite): the second
+    workload holds a strict relative residual bound at a bench-like
+    sizing, mirroring bench.py's BENCH_MODE=geqrf check — the absolute
+    tolerances above pass long after relative accuracy rots."""
+    n, nb = 256, 64
+    rng = np.random.RandomState(7)
+    M = rng.rand(n, n).astype(np.float32)
+    A = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float32).from_numpy(M)
+    _run(ctx, dgeqrf_taskpool(A))
+    R = np.triu(A.to_numpy()).astype(np.float64)
+    G = M.astype(np.float64).T @ M.astype(np.float64)
+    resid = np.abs(R.T @ R - G).max() / np.abs(G).max()
+    assert resid < 1e-5, f"dgeqrf relative residual {resid:.2e}"
+
+
 def test_dgeqrf_below_diagonal_zeroed(ctx):
     rng = np.random.RandomState(3)
     M = (rng.rand(96, 96) - 0.5).astype(np.float32)

@@ -1,0 +1,430 @@
+"""stagec/ — whole-stage DAG->XLA compilation (ISSUE 12).
+
+Differential tests: a stage-compiled run must be BIT-EXACT vs the
+fully interpreted runtime (the compiled program unrolls the identical
+per-task subgraphs), the DTD burst path must reject into the
+interpreted fallback untouched, an injected trace failure must
+downgrade transparently and permanently ONLY for its stage, and with
+``stage_compile`` unset nothing changes at all.
+"""
+import numpy as np
+import pytest
+
+import parsec_tpu
+from conftest import spmd
+from parsec_tpu.collections import TwoDimBlockCyclic
+from parsec_tpu.ops import dpotrf_taskpool, make_spd
+from parsec_tpu.utils.params import params
+
+
+def _clear_stage_cache():
+    from parsec_tpu.devices.batching import _stage_cache
+    _stage_cache.clear()
+
+
+def _run_dpotrf(n, nb, stagec, dtype=np.float32, mesh=None,
+                max_tasks=None, nb_cores=2):
+    from contextlib import ExitStack
+    M = make_spd(n).astype(dtype)
+    with ExitStack() as st:
+        if stagec:
+            st.enter_context(params.cmdline_override("stage_compile", "1"))
+        if mesh:
+            st.enter_context(
+                params.cmdline_override("device_mesh_shape", mesh))
+        if max_tasks is not None:
+            st.enter_context(params.cmdline_override(
+                "stage_compile_max_tasks", str(max_tasks)))
+        ctx = parsec_tpu.init(nb_cores=nb_cores)
+        try:
+            A = TwoDimBlockCyclic(n, n, nb, nb,
+                                  dtype=dtype).from_numpy(M.copy())
+            tp = dpotrf_taskpool(A)
+            ctx.add_taskpool(tp)
+            ctx.wait()
+            return (np.tril(A.to_numpy()), dict(ctx.stage_stats),
+                    tp._stagec, M)
+        finally:
+            ctx.fini()
+
+
+@pytest.mark.parametrize("n,nb,dtype", [
+    (128, 32, np.float32),     # uniform
+    (100, 32, np.float32),     # ragged edge tiles
+    (96, 32, np.float64),      # second dtype
+    (128, 64, np.float32),     # second NB
+])
+def test_stagec_dpotrf_bit_exact_vs_interpreted(n, nb, dtype):
+    """The acceptance contract: compiled stages produce the BIT-EXACT
+    factor the interpreted per-task/batched dispatch produces, across
+    NB and dtype, and the compiled path really engages."""
+    L0, s0, sc0, M = _run_dpotrf(n, nb, stagec=False, dtype=dtype)
+    L1, s1, sc1, _ = _run_dpotrf(n, nb, stagec=True, dtype=dtype)
+    assert sc0 is None and s0["stage_tasks"] == 0
+    assert sc1 is not None
+    nt = (n + nb - 1) // nb
+    n_tasks = nt + 2 * (nt * (nt - 1) // 2) + \
+        (nt * (nt - 1) * (nt - 2) // 6)
+    assert s1["stage_tasks"] == n_tasks, s1
+    assert s1["stage_fallbacks"] == 0, s1
+    np.testing.assert_array_equal(L1, L0)
+    resid = np.abs(L1.astype(np.float64) @ L1.astype(np.float64).T
+                   - M).max() / np.abs(M).max()
+    assert resid < 1e-5, f"residual {resid:.2e}"
+
+
+def test_stagec_off_is_inert():
+    """stage_compile unset: no compiler attaches, no counter moves —
+    the pre-stagec runtime bit for bit."""
+    L, stats, sc, _ = _run_dpotrf(96, 32, stagec=False)
+    assert sc is None
+    assert all(v == 0 for v in stats.values()), stats
+
+
+def test_stagec_aot_cache_hits_across_taskpools():
+    """A fresh taskpool over the same (spec, NB, dtype) must hit the
+    AOT stage cache: no second trace/compile (the DTD cache_token
+    steady-state, for PTG stages)."""
+    _clear_stage_cache()
+    with params.cmdline_override("stage_compile", "1"):
+        ctx = parsec_tpu.init(nb_cores=2)
+        try:
+            M = make_spd(128)
+            for rep in range(2):
+                A = TwoDimBlockCyclic(128, 32, 32, 32, dtype=np.float32)
+                A = TwoDimBlockCyclic(128, 128, 32, 32,
+                                      dtype=np.float32).from_numpy(M.copy())
+                ctx.add_taskpool(dpotrf_taskpool(A))
+                ctx.wait()
+                if rep == 0:
+                    compiles0 = ctx.stage_stats["stage_compiles"]
+                    assert compiles0 > 0
+            assert ctx.stage_stats["stage_compiles"] == compiles0, \
+                ctx.stage_stats
+            assert ctx.stage_stats["stage_dispatches"] == 2 * (
+                ctx.stage_stats["stage_dispatches"] // 2)
+        finally:
+            ctx.fini()
+
+
+def test_stagec_residue_interleaves_with_compiled_stages():
+    """A pool mixing compilable device classes with host-only classes
+    (dtrsm's FWD spec: RDIAG/RPANEL are cpu BODYs, TRSM/GEMM are
+    device BODYs) runs the stages compiled and the residue interpreted
+    — same answer as fully interpreted, with STAGE_TASKS covering only
+    the compilable part."""
+    from parsec_tpu.ops import dtrsm_lower_taskpool
+
+    n, nb, nrhs = 128, 32, 8
+    M = make_spd(n)
+    rng = np.random.RandomState(5)
+    B0 = rng.rand(n, nrhs).astype(np.float32)
+    Lnp = np.linalg.cholesky(M.astype(np.float64)).astype(np.float32)
+
+    def run(stagec):
+        from contextlib import ExitStack
+        with ExitStack() as st:
+            if stagec:
+                st.enter_context(
+                    params.cmdline_override("stage_compile", "1"))
+            ctx = parsec_tpu.init(nb_cores=2)
+            try:
+                L = TwoDimBlockCyclic(n, n, nb, nb,
+                                      dtype=np.float32).from_numpy(
+                    np.tril(Lnp).copy())
+                B = TwoDimBlockCyclic(n, nrhs, nb, nrhs,
+                                      dtype=np.float32).from_numpy(
+                    B0.copy())
+                ctx.add_taskpool(dtrsm_lower_taskpool(L, B))
+                ctx.wait()
+                return B.to_numpy(), dict(ctx.stage_stats)
+            finally:
+                ctx.fini()
+
+    Y0, s0 = run(False)
+    Y1, s1 = run(True)
+    np.testing.assert_array_equal(Y1, Y0)
+    assert s1["stage_tasks"] > 0, s1
+    # RDIAG/RPANEL instances are residue: staged coverage is partial
+    from parsec_tpu.stagec import class_verdicts
+    from parsec_tpu.ops.dtrsm import _factories
+    verdicts = class_verdicts(_factories()[0].jdf)
+    assert not verdicts["RDIAG"].ok and verdicts["RDIAG"].code == "STG300"
+    assert verdicts["TRSM"].ok and verdicts["GEMM"].ok
+
+
+def test_stagec_dtd_burst_rejects_into_fallback():
+    """DTD taskpools have no static spec to lower: with stage_compile
+    ON a DTD burst must run exactly as before (the batched dispatch
+    path) and no stage counter may move."""
+    import jax
+    import jax.numpy as jnp
+    from parsec_tpu import dtd
+    from parsec_tpu.dsl.dtd import INOUT, INPUT
+
+    kern = jax.jit(lambda c, a, b: c - jnp.dot(a, b.T))
+    rng = np.random.RandomState(11)
+    mats = [[rng.rand(16, 16).astype(np.float32) for _ in range(3)]
+            for _ in range(8)]
+
+    def run(stagec):
+        from contextlib import ExitStack
+        with ExitStack() as st:
+            if stagec:
+                st.enter_context(
+                    params.cmdline_override("stage_compile", "1"))
+            ctx = parsec_tpu.init(nb_cores=2)
+            try:
+                tp = dtd.taskpool_new()
+                ctx.add_taskpool(tp)
+
+                def body(es, task):
+                    c, a, b = dtd.unpack_args(task)
+                    c -= a @ b.T
+
+                boot = tp.tile_of_array(np.zeros((16, 16), np.float32))
+                tp.insert_task(body, (boot, INOUT), (boot, INPUT),
+                               (boot, INPUT))
+                tp.add_chore(body, "tpu", kern)
+                tiles = [[tp.tile_of_array(m.copy()) for m in row]
+                         for row in mats]
+                for c, a, b in tiles:
+                    tp.insert_task(body, (c, INOUT), (a, INPUT),
+                                   (b, INPUT))
+                tp.wait()
+                outs = [np.asarray(row[0].data.sync_to_host().payload)
+                        for row in tiles]
+                return outs, dict(ctx.stage_stats)
+            finally:
+                ctx.fini()
+
+    out0, s0 = run(False)
+    out1, s1 = run(True)
+    assert s1["stage_tasks"] == 0 and s1["stage_compiles"] == 0, s1
+    for a, b in zip(out0, out1):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_stagec_trace_failure_downgrades_one_stage(monkeypatch):
+    """An injected lowering failure on ONE stage must (a) fall that
+    stage back to the interpreted path transparently (same factor,
+    bit-exact), (b) leave the OTHER stages compiled, and (c) be
+    permanent only for that stage — a repeat taskpool re-downgrades
+    from the cached verdict without re-tracing."""
+    import parsec_tpu.stagec.runtime as srt
+
+    _clear_stage_cache()
+    real_build = srt.build_stage_fn
+    calls = {"n": 0, "fail": 0}
+
+    def failing_build(tp, stage, layout, codes):
+        calls["n"] += 1
+        if stage.index == 0:
+            calls["fail"] += 1
+            raise RuntimeError("injected stage-lowering failure")
+        return real_build(tp, stage, layout, codes)
+
+    monkeypatch.setattr(srt, "build_stage_fn", failing_build)
+    # small max_tasks so the DAG splits into several stages
+    L1, s1, _sc, M = _run_dpotrf(160, 32, stagec=True, max_tasks=6)
+    assert calls["fail"] == 1
+    assert s1["stage_fallbacks"] == 1, s1
+    assert s1["stage_compiles"] >= 1, s1           # other stages compiled
+    assert s1["stage_tasks"] > 0, s1
+    L0, _s0, _sc0, _ = _run_dpotrf(160, 32, stagec=False)
+    np.testing.assert_array_equal(L1, L0)
+
+    # permanence, scoped to the stage: a fresh taskpool re-downgrades
+    # instantly from the cached _FAILED verdict (no new build call for
+    # stage 0) while other stages hit their cached callables
+    before = dict(calls)
+    L2, s2, _sc2, _ = _run_dpotrf(160, 32, stagec=True, max_tasks=6)
+    assert calls["fail"] == before["fail"], calls
+    assert s2["stage_fallbacks"] == 1, s2
+    np.testing.assert_array_equal(L2, L0)
+
+
+def test_stagec_mesh_sharded_bit_exact():
+    """On a mesh rank (device_mesh_shape) eligible wave-front stages
+    compile through shard_map and span chips — still bit-exact vs the
+    single-chip interpreted path (ISSUE 12 sharded variant)."""
+    from parsec_tpu.parallel.mesh import has_shard_map
+
+    if not has_shard_map():
+        pytest.skip("no shard_map spelling in this jax build")
+    # NT=5: the k=0 SYRK wave has 4 members = the 2x2 chip count
+    L0, s0, _x, M = _run_dpotrf(160, 32, stagec=False)
+    L1, s1, _y, _ = _run_dpotrf(160, 32, stagec=True, mesh="2x2")
+    assert s1["stage_tasks"] > 0, s1
+    assert s1["stage_sharded"] >= 1, s1
+    np.testing.assert_array_equal(L1, L0)
+
+
+def test_stagec_multirank_engages_per_rank():
+    """2-rank classic runtime over the in-process fabric: each rank
+    compiles its local stages (STAGE_TASKS > 0 on every rank), the
+    cross-rank activations ride the untouched protocol, and the
+    distributed factor is bit-exact vs the interpreted run."""
+    from parsec_tpu.comm import RemoteDepEngine
+
+    n, nb, nr = 128, 32, 2
+    M = make_spd(n)
+
+    def run(stagec):
+        from contextlib import ExitStack
+
+        def rank_fn(rank, fabric):
+            with ExitStack() as st:
+                if stagec:
+                    st.enter_context(
+                        params.cmdline_override("stage_compile", "1"))
+                eng = RemoteDepEngine(fabric.engine(rank))
+                ctx = parsec_tpu.Context(nb_cores=2, comm=eng)
+                try:
+                    A = TwoDimBlockCyclic(
+                        n, n, nb, nb, P=2, Q=1, nodes=nr, rank=rank,
+                        dtype=np.float32).from_numpy(M.copy())
+                    A.name = "descA"
+                    tp = dpotrf_taskpool(A, rank=rank, nb_ranks=nr)
+                    ctx.add_taskpool(tp)
+                    ctx.wait()
+                    owned = {c: np.asarray(
+                        A.data_of(*c).sync_to_host().payload)
+                        for c in A.tiles() if A.rank_of(*c) == rank}
+                    return owned, dict(ctx.stage_stats)
+                finally:
+                    ctx.fini()
+
+        results, _f = spmd(nr, rank_fn, timeout=300)
+        L = np.zeros((n, n), np.float32)
+        stats = []
+        for owned, st_ in results:
+            stats.append(st_)
+            for (m, k), t in owned.items():
+                L[m * nb:m * nb + t.shape[0],
+                  k * nb:k * nb + t.shape[1]] = t
+        return np.tril(L), stats
+
+    L0, s0 = run(False)
+    L1, s1 = run(True)
+    assert all(s["stage_tasks"] > 0 for s in s1), s1
+    np.testing.assert_array_equal(L1, L0)
+
+
+def test_stagec_lowerability_verdicts():
+    """class_verdicts reuses the analysis/ findings: this_task bodies
+    come back BDY201, numpy bodies BDY202, host-only classes STG300,
+    clean device specs fully compilable."""
+    from parsec_tpu.dsl.ptg.parser import parse_jdf
+    from parsec_tpu.ops.dpotrf import DPOTRF_L_JDF
+    from parsec_tpu.stagec import class_verdicts, lower_report
+
+    v = class_verdicts(parse_jdf(DPOTRF_L_JDF, name="dpotrf"))
+    assert all(x.ok for x in v.values()), v
+
+    mixed = """
+descA [ type="collection" ]
+
+Gen(k)
+k = 0 .. 3
+: descA( k, 0 )
+RW A <- descA( k, 0 )
+     -> A Peek( k )
+     -> descA( k, 0 )
+BODY [type=tpu]
+{
+    A = A + 1.0
+}
+END
+
+Peek(k)
+k = 0 .. 3
+: descA( k, 0 )
+READ A <- A Gen( k )
+BODY [type=tpu]
+{
+    A = A * (1 if this_task is None else 1)
+}
+END
+"""
+    v = class_verdicts(parse_jdf(mixed, name="mixed"))
+    assert v["Gen"].ok
+    assert not v["Peek"].ok and v["Peek"].code == "BDY201", v["Peek"]
+    report = "\n".join(lower_report(parse_jdf(mixed, name="mixed")))
+    assert "Peek: fallback [BDY201]" in report
+    assert "Gen: compilable" in report
+
+
+def test_stagec_gauges_in_exposition():
+    """The STAGE_COMPILES / STAGE_TASKS / STAGE_FALLBACKS /
+    STAGE_COMPILE_US gauges (guide §9.1) surface live in the Prometheus
+    exposition after a stage-compiled run."""
+    from parsec_tpu.obs import parse_exposition
+
+    with params.cmdline_override("stage_compile", "1"):
+        ctx = parsec_tpu.Context(nb_cores=2)
+        try:
+            M = make_spd(128)
+            A = TwoDimBlockCyclic(128, 128, 32, 32,
+                                  dtype=np.float32).from_numpy(M)
+            ctx.add_taskpool(dpotrf_taskpool(A))
+            ctx.wait()
+            text = ctx.obs.render_prometheus(labels={"rank": "0"})
+        finally:
+            ctx.fini()
+    samples = parse_exposition(text)
+    vals = {n: v for (n, _l), v in samples.items()
+            if n.startswith("parsec_stagec_")}
+    assert vals.get("parsec_stagec_stage_tasks", 0) > 0, sorted(vals)
+    assert vals.get("parsec_stagec_stage_compiles", 0) > 0, vals
+    assert vals.get("parsec_stagec_stage_fallbacks", -1) == 0, vals
+    assert vals.get("parsec_stagec_stage_compile_us", 0) > 0, vals
+
+
+def test_stagec_lock_discipline_enforced():
+    """stagec/runtime.py opts into the concurrency lint with a
+    populated _GUARDED_BY map: the shipped module is clean, and an
+    injected unguarded access IS caught (the map really governs — the
+    ISSUE 9 injected-violation convention)."""
+    import os
+
+    from parsec_tpu.analysis import lock_check
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "parsec_tpu", "stagec", "runtime.py")
+    clean = [f for f in lock_check.lint_file(path)
+             if f.severity in ("error", "warn")]
+    assert not clean, clean
+    src = open(path).read()
+    bad = src + (
+        "\n\ndef _unguarded_poke(rec):\n"
+        "    rec.remaining -= 1\n")
+    findings = lock_check.lint_source(bad, filename="runtime.py")
+    assert any(f.code == "LCK301" and "remaining" in f.message
+               for f in findings), findings
+
+
+def test_stagec_lint_lower_report_cli():
+    """tools/parsec_lint.py --lower-report prints the per-class
+    verdicts for shipped specs and exits 0 (informational)."""
+    import importlib.util
+    import io
+    import os
+    import sys
+    from contextlib import redirect_stdout
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "_parsec_lint_test", os.path.join(root, "tools", "parsec_lint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["_parsec_lint_test"] = mod
+    spec.loader.exec_module(mod)
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = mod.main(["--lower-report",
+                       os.path.join(root, "parsec_tpu", "ops",
+                                    "dpotrf.py"), "-q"])
+    out = buf.getvalue()
+    assert rc == 0
+    assert "POTRF: compilable" in out and "GEMM: compilable" in out

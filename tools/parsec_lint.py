@@ -112,9 +112,11 @@ def default_spec_files() -> List[str]:
     return files
 
 
-def run(paths: List[str], cycles: bool = True,
-        locks: bool = True) -> List[Finding]:
-    findings: List[Finding] = []
+def collect_spec_files(paths: List[str]) -> Tuple[List[str], List[str]]:
+    """Resolve CLI targets into ``(spec_files, lock_targets)`` — the
+    one walker every pass (and ``--lower-report``) shares, so they can
+    never disagree about which files a target covers.  No ``paths``
+    means the shipped defaults."""
     spec_files: List[str] = []
     lock_targets: List[str] = []
     if paths:
@@ -133,6 +135,13 @@ def run(paths: List[str], cycles: bool = True,
     else:
         spec_files = default_spec_files()
         lock_targets = [os.path.join(_ROOT, SOURCE_DIR)]
+    return spec_files, lock_targets
+
+
+def run(paths: List[str], cycles: bool = True,
+        locks: bool = True) -> List[Finding]:
+    findings: List[Finding] = []
+    spec_files, lock_targets = collect_spec_files(paths)
     for f in spec_files:
         findings.extend(lint_spec_file(f, cycles=cycles))
     if locks:
@@ -144,6 +153,35 @@ def run(paths: List[str], cycles: bool = True,
                 # avoid double-reporting files passed once
                 findings.extend(x for x in lf if x not in findings)
     return findings
+
+
+def lower_report_main(paths: List[str], quiet: bool = False) -> int:
+    """``--lower-report``: the stage compiler's per-task-class
+    lowerability verdicts (stagec/plan.class_verdicts — the SAME pass
+    the runtime partitions with, so what this prints is what
+    ``stage_compile`` will and won't fuse) over every ``*_JDF`` spec in
+    the targets.  Exit 0 always: the report is informational — residue
+    classes run interpreted, they are not an error."""
+    from parsec_tpu.dsl.ptg.parser import JDFParseError, parse_jdf
+    from parsec_tpu.stagec.plan import lower_report
+
+    files, _lock_targets = collect_spec_files(paths)
+    n_specs = 0
+    for path in files:
+        rel = os.path.relpath(path, _ROOT) if path.startswith(_ROOT) \
+            else path
+        for spec_name, _lineno, text in find_jdf_specs(path):
+            n_specs += 1
+            try:
+                jdf = parse_jdf(text, name=f"{rel}:{spec_name}")
+            except (JDFParseError, SyntaxError) as exc:
+                print(f"{rel}:{spec_name}: unparseable ({exc})")
+                continue
+            for line in lower_report(jdf):
+                print(line)
+    if not quiet:
+        print(f"parsec_lint --lower-report: {n_specs} spec(s)")
+    return 0
 
 
 def main(argv=None) -> int:
@@ -158,10 +196,17 @@ def main(argv=None) -> int:
                     help="skip the (slower) cycle-enumeration pass")
     ap.add_argument("--no-locks", action="store_true",
                     help="skip the concurrency lint")
+    ap.add_argument("--lower-report", action="store_true",
+                    help="per-task-class stage-compile lowerability "
+                         "report (stagec/, ISSUE 12): compilable / "
+                         "fallback + the BDY2xx/PTG1xx/STG3xx reason "
+                         "a class won't fuse")
     ap.add_argument("-q", "--quiet", action="store_true",
                     help="findings only, no summary")
     args = ap.parse_args(argv)
 
+    if args.lower_report:
+        return lower_report_main(args.paths, quiet=args.quiet)
     findings = run(args.paths, cycles=not args.no_cycles,
                    locks=not args.no_locks)
     for f in findings:
