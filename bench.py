@@ -1694,11 +1694,23 @@ def bench_stagec_inner(n=768, nb=64, reps=3, cores=1) -> dict:
     with the compile warm (the AOT stage cache persists across
     taskpools by design), and the factors must be BIT-EXACT across
     legs — the compiled program unrolls the identical per-task
-    subgraphs the interpreter dispatches one by one."""
+    subgraphs the interpreter dispatches one by one.
+
+    The ISSUE 13 legs (chained dposv, residue-heavy dtrsm) run FIRST:
+    their per-task deltas are tens of us and the big dpotrf leg leaves
+    the process measurably noisier (heap pressure) than a fresh one."""
     import parsec_tpu
     from parsec_tpu.collections import TwoDimBlockCyclic
     from parsec_tpu.ops import dpotrf_taskpool
     from parsec_tpu.utils.params import params as _params
+
+    out = {}
+    out.update(bench_stagec_chain_inner(
+        n=int(os.environ.get("BENCH_STAGEC_CHAIN_N", "192")),
+        nb=64, reps=max(4, reps), cores=cores))
+    out.update(bench_stagec_residue_inner(
+        n=int(os.environ.get("BENCH_STAGEC_RES_N", "512")),
+        nb=32, reps=reps, cores=cores))
 
     M = make_input(n, np.float32)
 
@@ -1744,7 +1756,7 @@ def bench_stagec_inner(n=768, nb=64, reps=3, cores=1) -> dict:
 
     interp = leg(False)
     staged = leg(True)
-    out = {"stagec_n": n, "stagec_nb": nb}
+    out.update({"stagec_n": n, "stagec_nb": nb})
     if interp is None or staged is None:
         out["error"] = "no XLA device attached"
         return out
@@ -1761,6 +1773,216 @@ def bench_stagec_inner(n=768, nb=64, reps=3, cores=1) -> dict:
     out.update({f"stagec_{k}": v for k, v in ss.items()
                 if k != "stage_compile_ns"})
     out["stagec_compile_ms"] = round(ss["stage_compile_ns"] / 1e6, 1)
+    return out
+
+
+def bench_stagec_chain_inner(n=192, nb=64, reps=4, cores=1) -> dict:
+    """Chained dposv leg (ISSUE 13): the SAME 3-pool composition
+    (dpotrf ; trsm_fwd ; trsm_bwd, one RHS panel) four ways —
+    interpreted (stage_compile unset), the PR 12 per-pool compiled
+    path reproduced exactly (reader classes excluded from lowering via
+    ``stage_compile_exclude``, which is what PR 12's STG300 verdict
+    did: one fused program per pool, interpreted reader residue, host
+    flush between pools), today's relaxed per-pool path (readers fuse,
+    chaining off), and CHAINED (stagec/chain.py: both boundaries
+    fused, ONE program for the whole solve).
+
+    Methodology: taskpools are constructed OUTSIDE the clock (the
+    bench_runtime prestage-outside-the-clock convention — spec->class
+    construction is identical across legs and amortizable); the clock
+    covers submission to completion, including ``declare_chain`` on
+    the chained leg (chain-specific work must pay its way).  Walls are
+    best-of-reps with the AOT caches warm; the chained solution must
+    be BIT-EXACT vs interpreted.  The headline is
+    chain_speedup_vs_pr12_perpool — what cross-pool chaining buys over
+    PR 12's per-pool compiled path."""
+    import parsec_tpu
+    from parsec_tpu.collections import TwoDimBlockCyclic
+    from parsec_tpu.ops import (dpotrf_taskpool, dtrsm_lower_taskpool,
+                                dtrsm_lower_trans_taskpool)
+    from parsec_tpu.stagec.chain import declare_chain
+    from parsec_tpu.utils.params import params as _params
+
+    M = make_input(n, np.float32)
+    rng = np.random.RandomState(23)
+    B0 = rng.rand(n, nb).astype(np.float32)
+
+    def leg(stagec, chain, exclude=""):
+        from contextlib import ExitStack
+        with ExitStack() as st:
+            if stagec:
+                st.enter_context(
+                    _params.cmdline_override("stage_compile", "1"))
+                st.enter_context(_params.cmdline_override(
+                    "stage_compile_max_tasks",
+                    os.environ.get("BENCH_STAGEC_MAX_TASKS", "4096")))
+            if exclude:
+                st.enter_context(_params.cmdline_override(
+                    "stage_compile_exclude", exclude))
+            if not chain:
+                st.enter_context(
+                    _params.cmdline_override("stage_compile_chain", "0"))
+            ctx = parsec_tpu.init(nb_cores=cores)
+            try:
+                if not any(d.device_type == "tpu" for d in ctx.devices):
+                    return None
+                # a 4-6 ms single solve is below this host's timing
+                # noise floor: each timed rep clocks `iters`
+                # back-to-back solves (pools pre-built OUTSIDE the
+                # clock) and reports the mean
+                iters = int(os.environ.get("BENCH_STAGEC_CHAIN_ITERS",
+                                           "6"))
+                best = X = stats0 = None
+                for rep in range(1 + max(2, reps)):  # rep 0: compile
+                    batch = []
+                    for _ in range(1 if rep == 0 else iters):
+                        A = TwoDimBlockCyclic(
+                            n, n, nb, nb, dtype=np.float32
+                            ).from_numpy(M.copy())
+                        B = TwoDimBlockCyclic(
+                            n, nb, nb, nb, dtype=np.float32
+                            ).from_numpy(B0.copy())
+                        batch.append((B, [
+                            dpotrf_taskpool(A),
+                            dtrsm_lower_taskpool(A, B),
+                            dtrsm_lower_trans_taskpool(A, B)]))
+                    stats0 = dict(ctx.stage_stats)
+                    t0 = time.perf_counter()
+                    for B, pools in batch:
+                        if chain:
+                            declare_chain(ctx, pools)
+                        for tp_ in pools:
+                            ctx.add_taskpool(tp_)
+                            ctx.wait()
+                        pend = [B.data_of(*co).newest_copy().payload
+                                for co in B.tiles()]
+                        sync_device([p for p in pend
+                                     if hasattr(p, "block_until_ready")])
+                    dt = (time.perf_counter() - t0) / len(batch)
+                    if rep > 0:
+                        best = dt if best is None else min(best, dt)
+                    X = batch[-1][0].to_numpy()
+                delta = {k: (ctx.stage_stats[k] - stats0[k])
+                         // len(batch) for k in ctx.stage_stats}
+                return best, X, delta
+            finally:
+                ctx.fini()
+
+    out = {"chain_n": n, "chain_nb": nb}
+    interp = leg(False, False)
+    pr12 = leg(True, False, exclude="RDIAG,RPANEL")
+    perpool = leg(True, False)
+    chained = leg(True, True)
+    if None in (interp, pr12, perpool, chained):
+        out["chain_error"] = "no XLA device attached"
+        return out
+    (ti, Xi, _si), (t12, X12, _s12) = interp, pr12
+    (tp_, Xp, _sp), (tc, Xc, sc) = perpool, chained
+    out["chain_interpreted_wall_s"] = round(ti, 4)
+    out["chain_pr12_perpool_wall_s"] = round(t12, 4)
+    out["chain_perpool_wall_s"] = round(tp_, 4)
+    out["chain_chained_wall_s"] = round(tc, 4)
+    out["chain_speedup_vs_pr12_perpool"] = round(t12 / tc, 2)
+    out["chain_speedup_vs_perpool"] = round(tp_ / tc, 2)
+    out["chain_speedup_vs_interpreted"] = round(ti / tc, 2)
+    out["chain_links"] = sc["chain_links"]           # final-rep delta
+    out["chain_fallbacks"] = sc["chain_fallbacks"]
+    out["chain_dispatches"] = sc["stage_dispatches"]
+    out["chain_bit_exact_vs_interpreted"] = bool(np.array_equal(Xi, Xc))
+    out["chain_perpool_bit_exact"] = bool(
+        np.array_equal(Xi, Xp) and np.array_equal(Xi, X12))
+    return out
+
+
+def bench_stagec_residue_inner(n=512, nb=64, reps=3, cores=1) -> dict:
+    """Residue-heavy leg (ISSUE 13): the mixed host+device dtrsm
+    forward-solve spec (host-owned reader classes, device TRSM/GEMM)
+    with GEMM operator-excluded from stage lowering
+    (``stage_compile_exclude`` — verdict STG306), so the bulk of the
+    DAG runs as device residue BETWEEN compiled TRSM stages.  Measured
+    with the compiled residue schedule OFF (PR 12: every residue task
+    pays the scheduler round-trip) vs ON (pre-planned per-(level,
+    class) groups ride the batched dispatch as one burst) — the
+    headline is the us/task drop across the whole solve, residue
+    dispatch isolated from fused-stage gains (both legs compile the
+    same stages)."""
+    import parsec_tpu
+    from parsec_tpu.collections import TwoDimBlockCyclic
+    from parsec_tpu.ops import dtrsm_lower_taskpool
+    from parsec_tpu.utils.params import params as _params
+
+    M = make_input(n, np.float32)
+    Lnp = np.tril(np.linalg.cholesky(M.astype(np.float64))
+                  ).astype(np.float32)
+    rng = np.random.RandomState(29)
+    B0 = rng.rand(n, nb).astype(np.float32)
+    nt = (n + nb - 1) // nb
+    # RDIAG(nt) + RPANEL(nt(nt-1)/2) + TRSM(nt) + GEMM(nt(nt-1)/2)
+    n_tasks = 2 * nt + nt * (nt - 1)
+
+    def leg(residue_batch):
+        from contextlib import ExitStack
+        with ExitStack() as st:
+            st.enter_context(
+                _params.cmdline_override("stage_compile", "1"))
+            st.enter_context(_params.cmdline_override(
+                "stage_compile_exclude", "GEMM"))
+            if not residue_batch:
+                st.enter_context(_params.cmdline_override(
+                    "stage_residue_batch", "0"))
+            ctx = parsec_tpu.init(nb_cores=cores)
+            try:
+                if not any(d.device_type == "tpu" for d in ctx.devices):
+                    return None
+                # the per-task delta is tens of us: each timed rep
+                # clocks `iters` back-to-back solves (pools pre-built
+                # outside the clock, the chain-leg methodology)
+                iters = int(os.environ.get("BENCH_STAGEC_RES_ITERS",
+                                           "4"))
+                best = Y = stats0 = None
+                for rep in range(1 + max(2, reps)):  # rep 0: compile
+                    batch = []
+                    for _ in range(1 if rep == 0 else iters):
+                        L = TwoDimBlockCyclic(
+                            n, n, nb, nb, dtype=np.float32
+                            ).from_numpy(Lnp.copy())
+                        B = TwoDimBlockCyclic(
+                            n, nb, nb, nb, dtype=np.float32
+                            ).from_numpy(B0.copy())
+                        batch.append((B, dtrsm_lower_taskpool(L, B)))
+                    stats0 = dict(ctx.stage_stats)
+                    t0 = time.perf_counter()
+                    for B, tp_ in batch:
+                        ctx.add_taskpool(tp_)
+                        ctx.wait()
+                        pend = [B.data_of(*co).newest_copy().payload
+                                for co in B.tiles()]
+                        sync_device([p for p in pend
+                                     if hasattr(p, "block_until_ready")])
+                    dt = (time.perf_counter() - t0) / len(batch)
+                    if rep > 0:
+                        best = dt if best is None else min(best, dt)
+                    Y = batch[-1][0].to_numpy()
+                delta = {k: (ctx.stage_stats[k] - stats0[k])
+                         // len(batch) for k in ctx.stage_stats}
+                return best, Y, delta
+            finally:
+                ctx.fini()
+
+    out = {"residue_n": n, "residue_nb": nb, "residue_tasks": n_tasks}
+    off = leg(False)
+    on = leg(True)
+    if off is None or on is None:
+        out["residue_error"] = "no XLA device attached"
+        return out
+    (t_off, Y_off, s_off), (t_on, Y_on, s_on) = off, on
+    out["residue_sched_off_us_per_task"] = round(t_off / n_tasks * 1e6, 1)
+    out["residue_sched_on_us_per_task"] = round(t_on / n_tasks * 1e6, 1)
+    out["residue_speedup"] = round(t_off / t_on, 2)
+    out["residue_batches"] = s_on["residue_batches"]
+    out["residue_batch_tasks"] = s_on["residue_batch_tasks"]
+    out["residue_batches_off_leg"] = s_off["residue_batches"]
+    out["residue_bit_exact_on_vs_off"] = bool(np.array_equal(Y_on, Y_off))
     return out
 
 

@@ -578,52 +578,134 @@ class JaxDevice(Device):
         Runs on the submitting worker while the manager executes the
         previous batch, so every check re-validates under the data lock
         before committing (a racing stage-in must win)."""
-        import jax
+        target = self._stage_target(task)
         for flow in task.task_class.flows:
             if flow.ctl:
                 continue
             ref = task.data[flow.flow_index]
             if ref.data_in is None or ref.data_in.data is None:
                 continue
-            data = ref.data_in.data
+            self.prestage_data(ref.data_in.data, dtt=ref.data_in.dtt,
+                               target=target)
+
+    def prestage_data(self, data: Data, dtt=None, target=None) -> bool:
+        """Stage one Data's newest host payload onto this device EARLY
+        — the per-tile half of the §6.1 prefetcher, shared with the
+        stage compiler's prestager (ISSUE 13: stage N+1's packed-buffer
+        H2D overlaps stage N's execution / lowering).  Every check
+        re-validates under the data lock before committing, so a
+        racing stage-in always wins.  Returns True when a payload was
+        committed (the later stage-in will be a prefetch HIT)."""
+        import jax
+        if target is None:
+            target = self.jax_device
+        with data._lock:
+            copy = data.get_copy(self.device_index)
+            newest = data.newest_version()
+            if copy is not None and copy.coherency != Coherency.INVALID \
+                    and copy.version >= newest:
+                return False   # already device-resident and current
+            src = data.newest_copy(exclude_device=self.device_index)
+            # snapshot the version WITH the payload decision: the
+            # commit below must stamp the version these bytes had,
+            # not whatever the source advanced to meanwhile (an
+            # eviction writeback bumping the host copy between our
+            # device_put and the commit must not get its new
+            # version pinned onto old bytes)
+            src_version = src.version if src is not None else -1
+        from ..data.data import is_device_array
+        if src is None or src.payload is None \
+                or is_device_array(src.payload):
+            return False   # nothing to pull, or source is device-side
+        nbytes = getattr(src.payload, "nbytes", 0)
+        self._reserve(nbytes)
+        obs = self._obs
+        t0 = time.monotonic_ns() if obs is not None else 0
+        buf = jax.device_put(src.payload, self._placement(data, target))
+        committed = False
+        old = 0
+        with data._lock:
+            if copy is None:
+                copy = data.get_copy(self.device_index)
+            if copy is None:
+                copy = DataCopy(data, self.device_index, payload=None,
+                                dtt=dtt)
+                data.attach_copy(copy)
+            # commit only if a concurrent stage-in did not get there
+            # first (it owns the coherency transition; clobbering an
+            # OWNED copy or an in-use reader would corrupt state)
+            if copy.readers == 0 and copy.coherency != Coherency.OWNED \
+                    and (copy.coherency == Coherency.INVALID
+                         or copy.version < src_version):
+                old = getattr(copy.payload, "nbytes", 0)
+                copy.payload = buf
+                copy.version = src_version
+                copy.coherency = Coherency.SHARED
+                self._prefetched[id(copy)] = src_version
+                committed = True
+        if committed:
+            self._account(-old)
+            self._lru_touch(copy, owned=False)
+            if obs is not None:
+                obs.xfer("in", nbytes, t0)
+            self.stats["prefetch_issued"] += 1
+            self.stats["stage_in_bytes"] += nbytes
+        else:
+            self._account(-nbytes)   # lost the race: undo the hold
+        return committed
+
+    def prestage_many(self, datas: List[Data],
+                      target=None) -> List[Data]:
+        """Batched ``prestage_data``: ONE ``jax.device_put`` call moves
+        every eligible payload (eager per-tile device_put costs ~0.2 ms
+        of dispatch each on CPU jax; batching amortizes it — the same
+        lesson as the mesh stack/unbind kernels).  Same per-copy
+        re-validation under the data lock; returns the Datas whose
+        payloads actually committed (already-resident tiles and lost
+        races are excluded, so the caller's hit accounting is exact)."""
+        import jax
+        from ..data.data import is_device_array
+        if target is None:
+            target = self.jax_device
+        plan = []   # (data, copy-or-None, src payload, src_version)
+        for data in datas:
             with data._lock:
                 copy = data.get_copy(self.device_index)
                 newest = data.newest_version()
-                if copy is not None and copy.coherency != Coherency.INVALID \
+                if copy is not None \
+                        and copy.coherency != Coherency.INVALID \
                         and copy.version >= newest:
-                    continue   # already device-resident and current
+                    continue
                 src = data.newest_copy(exclude_device=self.device_index)
-                # snapshot the version WITH the payload decision: the
-                # commit below must stamp the version these bytes had,
-                # not whatever the source advanced to meanwhile (an
-                # eviction writeback bumping the host copy between our
-                # device_put and the commit must not get its new
-                # version pinned onto old bytes)
                 src_version = src.version if src is not None else -1
-            from ..data.data import is_device_array
             if src is None or src.payload is None \
                     or is_device_array(src.payload):
-                continue   # nothing to pull, or source is device-side
-            nbytes = getattr(src.payload, "nbytes", 0)
-            self._reserve(nbytes)
-            obs = self._obs
-            t0 = time.monotonic_ns() if obs is not None else 0
-            buf = jax.device_put(
-                src.payload,
-                self._placement(data, self._stage_target(task)))
+                continue
+            plan.append((data, copy, src, src_version))
+        if not plan:
+            return []
+        nbytes = sum(getattr(s.payload, "nbytes", 0)
+                     for _d, _c, s, _v in plan)
+        self._reserve(nbytes)
+        obs = self._obs
+        t0 = time.monotonic_ns() if obs is not None else 0
+        bufs = jax.device_put(
+            [s.payload for _d, _c, s, _v in plan],
+            [self._placement(d, target) for d, _c, _s, _v in plan])
+        committed_datas: List[Data] = []
+        undo = 0
+        for (data, copy, src, src_version), buf in zip(plan, bufs):
             committed = False
             old = 0
             with data._lock:
                 if copy is None:
                     copy = data.get_copy(self.device_index)
                 if copy is None:
-                    copy = DataCopy(data, self.device_index, payload=None,
-                                    dtt=ref.data_in.dtt)
+                    copy = DataCopy(data, self.device_index,
+                                    payload=None, dtt=src.dtt)
                     data.attach_copy(copy)
-                # commit only if a concurrent stage-in did not get there
-                # first (it owns the coherency transition; clobbering an
-                # OWNED copy or an in-use reader would corrupt state)
-                if copy.readers == 0 and copy.coherency != Coherency.OWNED \
+                if copy.readers == 0 \
+                        and copy.coherency != Coherency.OWNED \
                         and (copy.coherency == Coherency.INVALID
                              or copy.version < src_version):
                     old = getattr(copy.payload, "nbytes", 0)
@@ -635,12 +717,51 @@ class JaxDevice(Device):
             if committed:
                 self._account(-old)
                 self._lru_touch(copy, owned=False)
-                if obs is not None:
-                    obs.xfer("in", nbytes, t0)
-                self.stats["prefetch_issued"] += 1
-                self.stats["stage_in_bytes"] += nbytes
-            else:
-                self._account(-nbytes)   # lost the race: undo the hold
+                committed_datas.append(data)
+            else:   # lost the race: undo this entry's hold
+                undo += getattr(src.payload, "nbytes", 0)
+        if undo:
+            self._account(-undo)
+        if obs is not None:
+            obs.xfer("in", nbytes, t0)
+        self.stats["prefetch_issued"] += len(committed_datas)
+        self.stats["stage_in_bytes"] += nbytes - undo
+        return committed_datas
+
+    def prestaged_current(self, data: Data) -> bool:
+        """Is this Data's device copy one WE prestaged and still the
+        newest version?  The stage compiler's PRESTAGE_HITS accounting
+        (a hit = the fused stage's stage-in will find the buffer
+        already resident instead of paying a serial H2D)."""
+        with data._lock:
+            copy = data.get_copy(self.device_index)
+            return (copy is not None
+                    and id(copy) in self._prefetched
+                    and copy.coherency != Coherency.INVALID
+                    and copy.version >= data.newest_version())
+
+    def adopt_output(self, data: Data, arr: Any) -> None:
+        """Adopt a device array as ``data``'s newest DEVICE copy — the
+        epilog's writeback half without a task (the chain-consume path,
+        stagec/chain.py: a rider stage's outputs computed inside an
+        earlier pool's chained program land here, staying
+        device-resident instead of flushing through host).  The whole
+        lookup-attach-commit runs under the data lock (a comm-thread
+        prestage of the same tile must not interleave), and the
+        adopted copy leaves the prestage set — it was never a
+        prefetch, so it must not read as one."""
+        with data._lock:
+            copy = data.get_copy(self.device_index)
+            if copy is None:
+                copy = DataCopy(data, self.device_index, payload=None)
+                data.attach_copy(copy)
+            old = getattr(copy.payload, "nbytes", 0)
+            copy.payload = arr
+            data.version_bump(self.device_index)
+            self._prefetched.pop(id(copy), None)
+        self._account(-old)
+        self._reserve(getattr(arr, "nbytes", 0))
+        self._lru_touch(copy, owned=True)
 
     def drain(self, context=None) -> None:
         """Retire every remaining window entry (called at wait()-exit:

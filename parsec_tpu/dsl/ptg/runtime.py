@@ -461,6 +461,7 @@ class PTGTaskClass(TaskClass):
             if handled:
                 return task
         key = locals_
+        task = None
         self.dep_table.lock_bucket(key)
         try:
             entry = self.dep_table.nolock_find(key)
@@ -475,10 +476,16 @@ class PTGTaskClass(TaskClass):
             if entry.remaining == 0 and not entry.spawned:
                 entry.spawned = True
                 self.dep_table.nolock_remove(key)
-                return self.make_task(locals_, entry)
-            return None
+                task = self.make_task(locals_, entry)
         finally:
             self.dep_table.unlock_bucket(key)
+        if task is not None and sc is not None:
+            # compiled residue schedule (stagec/, ISSUE 13): a ready
+            # task of a pre-planned residue group buffers with the
+            # compiler and dispatches with its whole group as one
+            # device burst — returns None here (routed, not lost)
+            task = sc.on_residue_ready(task)
+        return task
 
     # ------------------------------------------------------------------ #
     # bodies → chores                                                    #
@@ -744,26 +751,38 @@ class PTGTaskpool(Taskpool):
         sc = self._stagec
         count_foreign = self.nb_ranks > 1 and self.comm is not None
         expected_mem_puts = 0
-        for tc in self._classes.values():
-            for locals_ in tc.iter_space():
-                env = tc.env_of(locals_)
-                if tc.rank_of_instance(env) != self.rank:
-                    if count_foreign:
-                        # a foreign task whose out-dep targets MY memory
-                        # will ship a writeback: hold termination for it
-                        expected_mem_puts += self._count_mem_puts_to_me(
-                            tc, env)
-                    continue
-                total += 1
-                if sc is not None and sc.is_member(tc.ast.name, locals_):
-                    continue   # spawns through its compiled stage
-                if tc.goal_of(locals_, env) == 0:
-                    startup.append(tc.make_task(locals_, None))
         if sc is not None:
+            # plan-cached startup enumeration (ISSUE 13): the stage
+            # plan already walked the full instance space — local
+            # totals, goal-0 residue, and the foreign mem-put
+            # expectation are pure functions of its identity, so a
+            # repeat pool skips the per-instance iteration-space walk
+            total = sc.plan.n_local
+            expected_mem_puts = sc.plan.startup_mem_puts
+            for (name, locals_) in sc.plan.startup_goal0:
+                t = self.class_by_name(name).make_task(locals_, None)
+                t = sc.on_residue_ready(t)
+                if t is not None:
+                    startup.append(t)
             # stages with no external task inputs start the DAG (their
-            # members were counted above; a stage completion retires
-            # every member's count)
+            # members are counted in n_local; a stage completion
+            # retires every member's count)
             startup.extend(sc.startup_tasks())
+        else:
+            for tc in self._classes.values():
+                for locals_ in tc.iter_space():
+                    env = tc.env_of(locals_)
+                    if tc.rank_of_instance(env) != self.rank:
+                        if count_foreign:
+                            # a foreign task whose out-dep targets MY
+                            # memory will ship a writeback: hold
+                            # termination for it
+                            expected_mem_puts += \
+                                self._count_mem_puts_to_me(tc, env)
+                        continue
+                    total += 1
+                    if tc.goal_of(locals_, env) == 0:
+                        startup.append(tc.make_task(locals_, None))
         # counts FIRST, delivery second: activations/puts released by
         # counts_ready may schedule tasks that complete on a worker
         # thread immediately — nb_tasks must already hold the total or
@@ -775,6 +794,14 @@ class PTGTaskpool(Taskpool):
         if count_foreign:
             # expectations credited: buffered early arrivals may deliver
             self.comm.counts_ready(self)
+        if sc is not None:
+            # cross-pool chaining (stagec/chain.py, ISSUE 13): when an
+            # earlier pool's chained program pre-computed this pool's
+            # first stage, adopt its stashed outputs now — AFTER the
+            # counts above, so the members' completions cannot go
+            # negative.  Successors it releases join the startup set.
+            startup.extend(sc.consume_chain(
+                context.execution_streams[0]))
         plog.debug.verbose(4, "ptg %s: %d local tasks, %d startup",
                            self.name, total, len(startup))
         return startup

@@ -99,6 +99,21 @@ class ContextObs:
             ctx.sde.register_poll(
                 "PARSEC::STAGEC::STAGE_COMPILE_US",
                 lambda s=ss: round(s["stage_compile_ns"] / 1e3, 1))
+            # ISSUE 13 gauges: prestage/execute overlap, cross-pool
+            # chaining, compiled residue schedule (guide §9.1)
+            ctx.sde.register_poll("PARSEC::STAGEC::PRESTAGE_ISSUED",
+                                  lambda s=ss: s["prestage_issued"])
+            ctx.sde.register_poll("PARSEC::STAGEC::PRESTAGE_HITS",
+                                  lambda s=ss: s["prestage_hits"])
+            ctx.sde.register_poll("PARSEC::STAGEC::CHAIN_LINKS",
+                                  lambda s=ss: s["chain_links"])
+            ctx.sde.register_poll("PARSEC::STAGEC::CHAIN_FALLBACKS",
+                                  lambda s=ss: s["chain_fallbacks"])
+            ctx.sde.register_poll("PARSEC::STAGEC::RESIDUE_BATCHES",
+                                  lambda s=ss: s["residue_batches"])
+            ctx.sde.register_poll(
+                "PARSEC::STAGEC::RESIDUE_BATCH_TASKS",
+                lambda s=ss: s["residue_batch_tasks"])
         # device pull gauges always (poll-only, no hot-path cost); the
         # span/histogram sink only when telemetry is on
         for dev in ctx.devices:

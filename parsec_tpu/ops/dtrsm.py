@@ -211,11 +211,24 @@ def dtrsm_lower_trans_taskpool(L, B, rank=0, nb_ranks=1):
 def dposv(context, A: TiledMatrix, B: TiledMatrix,
           rank: int = 0, nb_ranks: int = 1) -> None:
     """Solve A X = B for SPD A: Cholesky factor in place in A, then
-    forward + backward substitution in place in B."""
+    forward + backward substitution in place in B.
+
+    With ``stage_compile`` (+ ``stage_compile_chain``) on, the three
+    pools are declared as a chained sequence first (stagec/chain.py):
+    fusable pool boundaries — provably memory-fed first stages whose
+    every input writer is fused — then execute inside ONE chained
+    program instead of flushing to host between pools.  Ineligible
+    boundaries (multirank dataflow, residue writers) simply run
+    unchained; the add/wait composition below is unchanged either way."""
+    from ..utils.params import params
     from .dpotrf import dpotrf_taskpool
-    for tp in (dpotrf_taskpool(A, rank=rank, nb_ranks=nb_ranks),
-               dtrsm_lower_taskpool(A, B, rank=rank, nb_ranks=nb_ranks),
-               dtrsm_lower_trans_taskpool(A, B, rank=rank,
-                                          nb_ranks=nb_ranks)):
+    pools = [dpotrf_taskpool(A, rank=rank, nb_ranks=nb_ranks),
+             dtrsm_lower_taskpool(A, B, rank=rank, nb_ranks=nb_ranks),
+             dtrsm_lower_trans_taskpool(A, B, rank=rank,
+                                        nb_ranks=nb_ranks)]
+    if params.get("stage_compile") and params.get("stage_compile_chain"):
+        from ..stagec.chain import declare_chain
+        declare_chain(context, pools)
+    for tp in pools:
         context.add_taskpool(tp)
         context.wait()

@@ -206,12 +206,21 @@ class Context:
             (d.mesh for d in self.devices
              if getattr(d, "mesh", None) is not None), None)
 
-        # stage-compile telemetry (stagec/, ISSUE 12): per-rank
+        # stage-compile telemetry (stagec/, ISSUE 12/13): per-rank
         # counters every StageCompiler on this context accumulates
         # into; exposed as PARSEC::STAGEC::* gauges by ContextObs
         self.stage_stats = {"stage_compiles": 0, "stage_tasks": 0,
                             "stage_fallbacks": 0, "stage_compile_ns": 0,
-                            "stage_dispatches": 0, "stage_sharded": 0}
+                            "stage_dispatches": 0, "stage_sharded": 0,
+                            # ISSUE 13: prestage/execute overlap,
+                            # cross-pool chaining, residue schedule
+                            "prestage_issued": 0, "prestage_hits": 0,
+                            "chain_links": 0, "chain_fallbacks": 0,
+                            "residue_batches": 0,
+                            "residue_batch_tasks": 0}
+        # cross-pool stage chain registry (stagec/chain.declare_chain
+        # attaches a ChainState when a pool sequence is declared)
+        self._stage_chain = None
 
         # online critical-path class profile (ISSUE 7): duration-
         # weighted per-class EWMAs + upward-rank boosts the priority

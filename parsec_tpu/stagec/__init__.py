@@ -11,12 +11,15 @@ executes compiled stages as single chores interleaved with the
 interpreted residue behind the ``stage_compile`` MCA knob.
 """
 from .plan import (ClassVerdict, Stage, StagePlan, class_verdicts,
-                   lower_report, plan_stages)
+                   lower_report, plan_stages, stage_report)
 from .lower import StageLayout, build_layout, build_stage_fn, spec_token
-from .runtime import StageCompiler, try_install
+from .runtime import StageCompiler, prepared_plan, try_install
+from .chain import ChainState, boundary_verdict, declare_chain
 
 __all__ = [
     "ClassVerdict", "Stage", "StagePlan", "class_verdicts",
-    "lower_report", "plan_stages", "StageLayout", "build_layout",
-    "build_stage_fn", "spec_token", "StageCompiler", "try_install",
+    "lower_report", "plan_stages", "stage_report", "StageLayout",
+    "build_layout", "build_stage_fn", "spec_token", "StageCompiler",
+    "prepared_plan", "try_install", "ChainState", "boundary_verdict",
+    "declare_chain",
 ]

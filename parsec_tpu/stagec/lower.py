@@ -29,7 +29,32 @@ from ..data.data import FlowAccess
 from ..dsl.ptg.runtime import _expand_args, f_prop, scratch_shape
 
 __all__ = ["StageLayout", "build_layout", "build_stage_fn",
-           "stage_signature", "spec_token"]
+           "stage_signature", "spec_token", "spec_codes"]
+
+#: compiled BODY code per parsed-spec identity (the verdict-memo
+#: pattern, plan.IdKey): stage compilers and chain links come and go
+#: per taskpool — the bodies must not recompile every time
+_code_memo: Dict[Any, Dict[str, Any]] = {}
+_CODE_MEMO_MAX = 64
+
+
+def spec_codes(tp) -> Dict[str, Any]:
+    """The compiled accelerator-BODY code objects of a taskpool's
+    classes, memoized per parsed-spec identity."""
+    from ..dsl.ptg.capture import _pick_body
+    from .plan import IdKey
+    key = IdKey(tp.jdf)
+    codes = _code_memo.get(key)
+    if codes is None:
+        codes = {
+            tc.ast.name: compile(_pick_body(tc.ast).code,
+                                 f"<jdf:{tc.ast.name}:BODY[stagec]>",
+                                 "exec")
+            for tc in tp.task_classes}
+        while len(_code_memo) >= _CODE_MEMO_MAX:
+            _code_memo.pop(next(iter(_code_memo)))
+        _code_memo[key] = codes
+    return codes
 
 
 class StageLayout:

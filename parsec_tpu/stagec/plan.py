@@ -39,9 +39,30 @@ adds STG3xx for conditions that only matter to the stage compiler:
   (multi-producer binding is arrival-order-defined — not traceable).
 - ``STG305`` new-without-shape: a NEW input has no evaluable
   ``[shape=...]`` property, so the trace cannot allocate it.
+- ``STG306`` operator-excluded: the class is named in the
+  ``stage_compile_exclude`` MCA param — a debugging / measurement knob
+  (the residue-heavy bench leg rides it).
+
+ISSUE 13 relaxation: a host-only class whose body is a NO-OP (``pass``
+— the reader/broadcast classes dtrsm places on tile owners) is
+lowerable after all: inside a fused trace the class contributes
+nothing but dataflow (its flow values forward untouched), which is
+exactly what the interpreted cpu hook does for a ``pass`` body.  Only
+pure forwarders qualify (every non-CTL flow READ): a no-op body behind
+a WRITE flow still version-bumps through the interpreted path and is
+left alone.
+
+The pass also pre-plans the **residue schedule** (ISSUE 13): residue
+instances with an accelerator body are grouped per (dependence level,
+class) at plan time, so the runtime can hand each group to the device
+batching pipeline as one burst with zero per-task scheduler
+round-trips (see stagec/runtime.StageCompiler.on_residue_ready).
+Level-1 (startup) residue keeps the chunked startup hand-off — it is
+already scheduled as one burst.
 """
 from __future__ import annotations
 
+import ast as pyast
 import dataclasses
 from typing import Any, Dict, List, Optional, Set, Tuple
 
@@ -56,15 +77,19 @@ _BDY_DISQUALIFYING = ("BDY200", "BDY201", "BDY202", "BDY203")
 
 @dataclasses.dataclass
 class ClassVerdict:
-    """Per-task-class lowerability: ``ok`` or the finding that blocks."""
+    """Per-task-class lowerability: ``ok`` or the finding that blocks.
+    ``note`` annotates an ok verdict (e.g. the no-op forwarder
+    relaxation) without changing it."""
     name: str
     ok: bool
     code: Optional[str] = None
     reason: Optional[str] = None
+    note: Optional[str] = None
 
     def __str__(self) -> str:
         if self.ok:
-            return f"{self.name}: compilable"
+            return (f"{self.name}: compilable"
+                    + (f" ({self.note})" if self.note else ""))
         return f"{self.name}: fallback [{self.code}] {self.reason}"
 
 
@@ -100,7 +125,10 @@ class StagePlan:
     """The lowerability pass's output for one instantiated taskpool."""
 
     __slots__ = ("order", "stages", "member_stage", "verdicts",
-                 "inst_by_key", "n_local", "n_residue", "prepared")
+                 "inst_by_key", "n_local", "n_residue", "prepared",
+                 "levels", "residue_groups",
+                 "mem_writers", "local_keys", "startup_goal0",
+                 "startup_mem_puts")
 
     def __init__(self, order, stages, member_stage, verdicts,
                  n_local: int, n_residue: int) -> None:
@@ -115,6 +143,28 @@ class StagePlan:
         self.inst_by_key = {i.key: i for i in order}
         self.n_local = n_local
         self.n_residue = n_residue
+        #: instance key -> dependence level (1 = no task preds)
+        self.levels: Dict[Tuple, int] = {}
+        #: compiled residue schedule (ISSUE 13): pre-planned
+        #: per-(level, class) groups of LOCAL residue instance keys at
+        #: levels >= 2 — the runtime buffers each group's ready tasks
+        #: and hands the complete group to the device batching pipeline
+        #: as one burst (zero per-task scheduler round-trips)
+        self.residue_groups: List[List[Tuple]] = []
+        #: (collection name, coords) -> ordered instance keys with a
+        #: memory out-dep landing on that tile, over the FULL (all-rank)
+        #: instance order — the chain planner's dataflow proof and the
+        #: prestager's final-value check both read it
+        self.mem_writers: Dict[Tuple, List[Tuple]] = {}
+        #: instance keys local to this rank (plan_stages' rank_of walk)
+        self.local_keys: Set[Tuple] = set()
+        #: plan-cached startup enumeration (ISSUE 13): the goal-0 LOCAL
+        #: residue instances and the foreign mem-put expectation, so a
+        #: stagec _startup skips the per-instance iteration-space walk
+        #: (a pure function of the plan identity — filled by
+        #: stagec/runtime.prepared_plan)
+        self.startup_goal0: List[Tuple] = []
+        self.startup_mem_puts = 0
 
     @property
     def n_staged(self) -> int:
@@ -162,6 +212,31 @@ def _class_ranged_data_input(tc) -> bool:
     return False
 
 
+def _noop_forwarder(tc) -> bool:
+    """ISSUE 13 STG300 relaxation: a host-only class whose body is a
+    no-op (``pass`` / docstring only) and whose non-CTL flows are all
+    READ forwards its inputs untouched — inside a fused trace it is
+    pure dataflow, identical to what the interpreted cpu hook does."""
+    if any(f.access != "READ" for f in tc.flows if not f.is_ctl):
+        return False
+    body = tc.bodies[0]
+    try:
+        tree = pyast.parse(body.code)
+    except SyntaxError:
+        return False
+    return all(isinstance(node, pyast.Pass)
+               or (isinstance(node, pyast.Expr)
+                   and isinstance(node.value, pyast.Constant))
+               for node in tree.body)
+
+
+def _excluded_classes() -> Tuple[str, ...]:
+    """Operator-excluded classes (``stage_compile_exclude``)."""
+    from ..utils.params import params
+    raw = str(params.get_or("stage_compile_exclude", "string", "") or "")
+    return tuple(sorted(s.strip() for s in raw.split(",") if s.strip()))
+
+
 class IdKey:
     """Hashable identity wrapper: keys a cache by object IDENTITY while
     holding a strong reference, so a recycled id can never alias a dead
@@ -180,11 +255,13 @@ class IdKey:
         return isinstance(other, IdKey) and other.obj is self.obj
 
 
-#: verdict memo per parsed-spec identity (verdicts are a pure function
-#: of the AST; re-deriving them per taskpool would tax every repeat
-#: run's startup).  Bounded: a long-lived process parsing specs
-#: dynamically must not pin every dead AST forever.
-_verdict_memo: Dict[IdKey, Dict[str, ClassVerdict]] = {}
+#: verdict memo per (parsed-spec identity, exclusion set) — verdicts
+#: are a pure function of the AST plus the ``stage_compile_exclude``
+#: knob (a knob change must never hit a stale verdict); re-deriving
+#: them per taskpool would tax every repeat run's startup.  Bounded: a
+#: long-lived process parsing specs dynamically must not pin every
+#: dead AST forever.
+_verdict_memo: Dict[Tuple, Dict[str, ClassVerdict]] = {}
 _VERDICT_MEMO_MAX = 64
 
 
@@ -194,7 +271,9 @@ def class_verdicts(jdf: JDFFile) -> Dict[str, ClassVerdict]:
     spec (an unsound graph is not worth fusing), BDY2xx trace-safety
     findings disqualify their class, and the STG3xx structural checks
     cover what only the stage compiler cares about."""
-    memo = _verdict_memo.get(IdKey(jdf))
+    excluded = _excluded_classes()
+    memo_key = (IdKey(jdf), excluded)
+    memo = _verdict_memo.get(memo_key)
     if memo is not None:
         return memo
     out: Dict[str, ClassVerdict] = {}
@@ -210,17 +289,27 @@ def class_verdicts(jdf: JDFFile) -> Dict[str, ClassVerdict]:
             f = ptg_findings[0]
             out[tc.name] = ClassVerdict(tc.name, False, f.code, f.message)
             continue
+        if tc.name in excluded:
+            out[tc.name] = ClassVerdict(
+                tc.name, False, "STG306",
+                f"{tc.name}: excluded by the stage_compile_exclude knob")
+            continue
         bf = by_class.get(tc.name)
         if bf is not None:
             out[tc.name] = ClassVerdict(tc.name, False, bf.code, bf.message)
             continue
+        forwarder = False
         if not any(b.device_type not in ("cpu", "recursive")
                    for b in tc.bodies):
-            out[tc.name] = ClassVerdict(
-                tc.name, False, "STG300",
-                f"{tc.name}: no accelerator BODY — the host interpreter "
-                f"owns this class")
-            continue
+            if not _noop_forwarder(tc):
+                out[tc.name] = ClassVerdict(
+                    tc.name, False, "STG300",
+                    f"{tc.name}: no accelerator BODY — the host "
+                    f"interpreter owns this class")
+                continue
+            # no-op forwarder (reader/broadcast class): pure dataflow
+            # inside a fused trace — lowerable despite the cpu BODY
+            forwarder = True
         if _class_edge_reshape(tc):
             out[tc.name] = ClassVerdict(
                 tc.name, False, "STG302",
@@ -240,10 +329,12 @@ def class_verdicts(jdf: JDFFile) -> Dict[str, ClassVerdict]:
                 f"{tc.name}: a data flow's in-dep expands a range — "
                 f"multi-producer bindings are arrival-order-defined")
             continue
-        out[tc.name] = ClassVerdict(tc.name, True)
+        out[tc.name] = ClassVerdict(
+            tc.name, True,
+            note="no-op forwarder body" if forwarder else None)
     while len(_verdict_memo) >= _VERDICT_MEMO_MAX:
         _verdict_memo.pop(next(iter(_verdict_memo)))
-    _verdict_memo[IdKey(jdf)] = out
+    _verdict_memo[memo_key] = out
     return out
 
 
@@ -355,8 +446,44 @@ def plan_stages(tp, rank: int = 0, max_tasks: int = 256,
         close(cur)
 
     n_residue = len(local) - len(member_stage)
-    return StagePlan(order, stages, member_stage, verdicts,
+    plan = StagePlan(order, stages, member_stage, verdicts,
                      n_local=len(local), n_residue=n_residue)
+    plan.levels = level
+    plan.local_keys = local
+
+    # memory-writeback map over the FULL order (chain proof + prestage
+    # final-value checks): tile -> ordered writer instance keys
+    for inst in order:
+        env = inst.env
+        for f in inst.tc.ast.flows:
+            if f.is_ctl:
+                continue
+            for d in f.deps_out():
+                t = d.resolve(env)
+                if t is not None and t.kind == "memory":
+                    coords = tuple(int(a(env)) for a in t.args)
+                    plan.mem_writers.setdefault(
+                        (t.collection, coords), []).append(inst.key)
+
+    # compiled residue schedule (ISSUE 13): pre-plan per-(level, class)
+    # groups of device-bodied local residue at levels >= 2 (level-1
+    # residue is startup — already handed off as one chunked burst).
+    # Groups of one save nothing; they keep the per-task path.
+    device_cls = {tc.ast.name for tc in tp.task_classes
+                  if any(b.device_type not in ("cpu", "recursive")
+                         for b in tc.ast.bodies)}
+    per_group: Dict[Tuple, List[Tuple]] = {}
+    for inst in order:
+        k = inst.key
+        if k not in local or k in member_stage \
+                or level[k] < 2 or k[0] not in device_cls:
+            continue
+        per_group.setdefault((level[k], k[0]), []).append(k)
+    for gk in sorted(per_group):
+        keys = per_group[gk]
+        if len(keys) >= 2:
+            plan.residue_groups.append(keys)
+    return plan
 
 
 def lower_report(jdf: JDFFile) -> List[str]:
@@ -370,4 +497,34 @@ def lower_report(jdf: JDFFile) -> List[str]:
         lines.append(f"  {verdicts[tc.name]}")
     n_ok = sum(1 for v in verdicts.values() if v.ok)
     lines.append(f"  -- {n_ok}/{len(verdicts)} class(es) compilable")
+    return lines
+
+
+def stage_report(tp, rank: int = 0, max_tasks: int = 256,
+                 wavefront: bool = False,
+                 plan: Optional[StagePlan] = None) -> List[str]:
+    """Per-STAGE verdicts over an instantiated taskpool (the
+    ``parsec_lint --lower-report`` per-stage payload, ISSUE 13): how
+    the partition actually falls — each stage's size, level span, and
+    class mix, plus the residue split and the pre-planned residue
+    groups the compiled residue schedule will ride.  ``plan`` reuses
+    an already-computed partition (the lint plans each spec once for
+    both this report and the chain verdicts)."""
+    if plan is None:
+        plan = plan_stages(tp, rank=rank, max_tasks=max_tasks,
+                           wavefront=wavefront)
+    lines: List[str] = []
+    for st in plan.stages:
+        per_cls: Dict[str, int] = {}
+        for m in st.members:
+            per_cls[m.tc.ast.name] = per_cls.get(m.tc.ast.name, 0) + 1
+        mix = ", ".join(f"{c} x{n}" for c, n in sorted(per_cls.items()))
+        lines.append(f"  stage#{st.index}: {st.n_tasks} task(s), "
+                     f"levels {st.level_lo}..{st.level_hi} [{mix}]")
+    n_grouped = sum(len(g) for g in plan.residue_groups)
+    lines.append(
+        f"  -- {len(plan.stages)} stage(s) covering {plan.n_staged}/"
+        f"{plan.n_local} local task(s), {plan.n_residue} residue"
+        + (f" ({len(plan.residue_groups)} residue group(s) pre-planned "
+           f"over {n_grouped} task(s))" if plan.residue_groups else ""))
     return lines

@@ -23,6 +23,30 @@ walk reuses each member's untouched ``_release_deps`` — remote
 activations batch per rank, memory writebacks ride the device epilog —
 with intra-stage edges swallowed by the same ``on_activate`` seam.
 
+ISSUE 13 grew three fronts onto this engine, all behind the same knob:
+
+- **Cross-pool chaining** (stagec/chain.py): when the context carries
+  a declared chain, the host pool's final stage lowers into the
+  CHAINED program (host stage + rider stages of later pools) and the
+  rider pools CONSUME their pre-computed first-stage outputs at
+  startup (``consume_chain``) — zero dispatch, tiles stay
+  device-resident.  A chained build failure falls back to the plain
+  host-only callable (``CHAIN_FALLBACKS``), and a rider whose stash
+  never filled spawns its stage normally.
+- **Compiled residue schedule**: residue tasks in a pre-planned
+  per-(level, class) group (``plan.residue_groups``) are BUFFERED as
+  they become ready and handed to the device batching pipeline as one
+  contiguous burst when the group completes — no per-task scheduler
+  round-trip, and the burst is guaranteed to flush as stacked calls.
+- **Prestage/execute overlap**: buffered activation payloads H2D-stage
+  at ARRIVAL (while the producing stage still executes or the wire
+  still delivers), a spawning stage's own host-resident tiles stage
+  under its trace/compile, and completed stages prestage the next
+  pending stages' final-valued tiles — all through the §6.1
+  prefetcher's device seam (``JaxDevice.prestage_data``), bounded by
+  ``device_prefetch_depth``, counted in ``PRESTAGE_ISSUED``/
+  ``PRESTAGE_HITS`` and visible to the live overlap gauge.
+
 Fallback ladder (semantics are never at risk):
 
 1. a class the lowerability pass rejects stays interpreted (residue);
@@ -30,9 +54,11 @@ Fallback ladder (semantics are never at risk):
    activations replay through the normal dynamic path and its members
    execute via the PR 5/7 batched dispatch, permanently but only for
    that stage (the failure is cached, other stages keep compiling);
-3. a sharded (mesh) build/dispatch failure falls back to the fused
+3. a chained program that fails to lower falls back to the host-only
+   fused callable (riders spawn normally from their own pools);
+4. a sharded (mesh) build/dispatch failure falls back to the fused
    single-chip callable for that stage;
-4. ``stage_compile`` unset: ``tp._stagec`` is None and behavior is
+5. ``stage_compile`` unset: ``tp._stagec`` is None and behavior is
    bit-for-bit the pre-stagec runtime.
 """
 from __future__ import annotations
@@ -63,6 +89,8 @@ _GUARDED_BY = {
     "_StageRec.remaining": "_lock",
     "_StageRec.events": "_lock",
     "_StageRec.status": "_lock",
+    "StageCompiler._rg_left": "_rg_lock",
+    "StageCompiler._rg_buf": "_rg_lock",
 }
 
 # _StageRec lifecycle
@@ -73,6 +101,11 @@ _PENDING, _SPAWNED, _DONE, _DOWNGRADED = range(4)
 #: of re-tracing the known failure ("permanent, but only for that
 #: stage")
 _FAILED = object()
+
+#: consume_chain sentinel: no stash entry AT ALL (the host program
+#: never ran) — distinct from a None marker (the host fell back and
+#: already counted the fallback)
+_NO_STASH = object()
 
 
 class _StageRec:
@@ -92,6 +125,10 @@ class _StageRec:
         self.edge_copies: Dict[Tuple, Any] = {}
         self.shapes: Tuple = ()
         self.donate: Tuple = ()
+        self.chain = None               # HostChain when this rec hosts
+        #: Data objects prestaged for this stage and not yet counted
+        #: (single-owner by lifecycle: the buffering/spawn path)
+        self.prestaged: List[Any] = []
 
 
 class StageTaskClass(TaskClass):
@@ -109,6 +146,14 @@ class StageTaskClass(TaskClass):
         for j, (mkey, fname) in enumerate(lay.act_slots):
             flows.append(Flow(f"{mkey[0]}{mkey[1]}.{fname}",
                               FlowAccess.READ, base + j))
+        if rec.chain is not None:
+            # chained host stage (ISSUE 13): the riders' extra tiles
+            # join the packed buffer as READ flows after the act slots
+            base = len(flows)
+            for j, (coll, coords) in enumerate(rec.chain.extra):
+                flows.append(Flow(
+                    f"chain:{getattr(coll, 'name', 'tile')}{coords}",
+                    FlowAccess.READ, base + j))
         super().__init__(f"STAGE{rec.stage.index}[{compiler.tp.name}]",
                          -1 - rec.stage.index, len(flows), flows=flows)
         from ..devices.tpu import tpu_chore_hook
@@ -132,12 +177,8 @@ class StageCompiler:
         self.context = context
         self.plan = plan
         self.stats = context.stage_stats
-        from ..dsl.ptg.capture import _pick_body
-        self._codes = {
-            tc.ast.name: compile(_pick_body(tc.ast).code,
-                                 f"<jdf:{tc.ast.name}:BODY[stagec]>",
-                                 "exec")
-            for tc in tp.task_classes}
+        from .lower import spec_codes
+        self._codes = spec_codes(tp)
         self._token = spec_token(tp)
         self._donate_on = bool(params.get("device_donate"))
         # the mesh device, when this rank's accelerator is one (PR 6):
@@ -146,13 +187,63 @@ class StageCompiler:
             (d for d in context.devices
              if d.device_type == "tpu" and getattr(d, "mesh", None)
              is not None and len(getattr(d, "chips", ())) > 1), None)
+        self._dev = next(d for d in context.devices
+                         if d.device_type == "tpu")
         self._recs: List[_StageRec] = []
         self._member_rec: Dict[Tuple, _StageRec] = {}
+        self._rec_by_index: Dict[int, _StageRec] = {}
         for stage, layout, prio in plan.prepared:
             rec = _StageRec(stage, layout, prio)
             self._recs.append(rec)
+            self._rec_by_index[stage.index] = rec
             for m in stage.members:
                 self._member_rec[m.key] = rec
+
+        # cross-pool chaining (ISSUE 13, stagec/chain.py): does this
+        # pool HOST a chained program, or CONSUME a stash?
+        self._consume_rec: Optional[_StageRec] = None
+        chain_state = getattr(context, "_stage_chain", None)
+        if chain_state is not None:
+            # pop: the HostChain moves onto the rec, so the registry
+            # entry (and eventually the pool's strong ref) can retire
+            hc = chain_state.hosts.pop(id(tp), None)
+            if hc is not None:
+                host_rec = self._rec_by_index.get(hc.host_stage_index)
+                if host_rec is not None:
+                    host_rec.chain = hc
+            link = chain_state.consumes.get(id(tp))
+            if link is not None:
+                rec0 = self._rec_by_index.get(link.stage.index)
+                if rec0 is not None and rec0.stage is link.stage:
+                    self._consume_rec = rec0
+
+        # compiled residue schedule (ISSUE 13): per-(level, class)
+        # groups pre-planned by the lowerability pass — ready members
+        # buffer here and dispatch as ONE device burst when complete
+        self._rg_lock = threading.Lock()
+        self._rg_of: Dict[Tuple, int] = {}
+        self._rg_left: List[int] = []
+        self._rg_buf: List[List[Task]] = []
+        if params.get("stage_residue_batch") and plan.residue_groups:
+            eligible = {
+                tc.ast.name for tc in tp.task_classes
+                if any(c.device_type == "tpu" and c.dyld_fn is not None
+                       for c in tc.incarnations)}
+            for keys in plan.residue_groups:
+                if keys[0][0] not in eligible:
+                    continue
+                gi = len(self._rg_left)
+                self._rg_left.append(len(keys))
+                self._rg_buf.append([])
+                for k in keys:
+                    self._rg_of[k] = gi
+
+        # prestage/execute overlap (ISSUE 13): early H2D of stage
+        # inputs through the §6.1 prefetcher's device seam, bounded by
+        # device_prefetch_depth stages with outstanding prestages
+        self._prestage_depth = int(getattr(self._dev, "prefetch_depth",
+                                           0))
+        self._prestage_recs: set = set()
 
     def _tc(self, inst):
         """The LIVE taskpool's class for a (possibly cached-plan)
@@ -190,6 +281,10 @@ class StageCompiler:
                 rec.status = _SPAWNED   # claim; build outside the lock
                 spawn = True
         if not spawn:
+            # prestage the buffered payload NOW (ISSUE 13): the stage
+            # still awaits other inputs, so its H2D overlaps whatever
+            # is producing them (the executing stage / the wire)
+            self._prestage_activation(rec, copy)
             return True, None
         tasks = self._spawn(rec)
         if not tasks:
@@ -200,9 +295,14 @@ class StageCompiler:
         return True, tasks[0]
 
     def startup_tasks(self) -> List[Task]:
-        """Stages with no external task inputs are startup tasks."""
+        """Stages with no external task inputs are startup tasks.  A
+        stage another pool's chained program pre-computes stays PENDING
+        here — ``consume_chain`` finalizes (or falls back) after the
+        taskpool's counts are credited."""
         out: List[Task] = []
         for rec in self._recs:
+            if rec is self._consume_rec:
+                continue
             with rec._lock:
                 if rec.status != _PENDING or rec.remaining > 0:
                     continue
@@ -216,6 +316,197 @@ class StageCompiler:
             return False
         with rec._lock:
             return rec.status != _DOWNGRADED
+
+    # ------------------------------------------------------------------ #
+    # cross-pool chaining: consume a stashed rider stage (ISSUE 13)      #
+    # ------------------------------------------------------------------ #
+    def consume_chain(self, es) -> List[Task]:
+        """Finalize this pool's chained-in first stage: adopt the
+        stashed device outputs as the newest tile copies, run the
+        stage's release walk, retire its members' counts.  Called by
+        ``PTGTaskpool._startup`` AFTER the task counts are credited (a
+        completion before ``set_nb_tasks`` would go negative).  A
+        missing stash (the host program downgraded, or never ran)
+        falls back to spawning the stage normally."""
+        rec = self._consume_rec
+        if rec is None:
+            return []
+        self._consume_rec = None
+        st = getattr(self.context, "_stage_chain", None)
+        stash = st.stash.pop(id(self.tp), _NO_STASH) if st is not None \
+            else _NO_STASH
+        if st is not None:
+            st.consumes.pop(id(self.tp), None)
+        if stash is None or stash is _NO_STASH:
+            if stash is _NO_STASH:
+                # the host program never ran at all (downgrade, knob
+                # change); a None marker means the host already fell
+                # back — and already counted the fallback
+                self.stats["chain_fallbacks"] += 1
+            plog.debug.verbose(
+                2, "stagec chain: %s found no stash for stage %d; "
+                "dispatching it normally", self.tp.name,
+                rec.stage.index)
+            with rec._lock:
+                if rec.status != _PENDING or rec.remaining > 0:
+                    return []
+                rec.status = _SPAWNED
+            return self._spawn(rec)
+        lay = rec.layout
+        for arr, si in zip(stash["tiles"], lay.out_mem):
+            (coll_name, coords), _a = lay.mem_slots[si]
+            data = self.tp.global_env[coll_name].data_of(*coords)
+            self._dev.adopt_output(data, arr)
+        for ek, arr in zip(lay.edge_outs, stash["edges"]):
+            if arr is not None:
+                rec.edge_copies[ek] = _edge_copy(arr)
+        n = rec.stage.n_tasks
+        self.stats["chain_links"] += 1
+        self.stats["stage_tasks"] += n
+        self._dev.stats["tasks"] += n
+        with rec._lock:
+            rec.status = _SPAWNED
+        ready = self._release(es, rec)
+        self.tp.task_completed(n)
+        plog.debug.verbose(
+            3, "stagec chain: %s consumed stage %d (%d task(s)) from "
+            "the chained program", self.tp.name, rec.stage.index, n)
+        return ready
+
+    # ------------------------------------------------------------------ #
+    # compiled residue schedule (ISSUE 13)                               #
+    # ------------------------------------------------------------------ #
+    def on_residue_ready(self, task: Task) -> Optional[Task]:
+        """A residue task just became ready (``PTGTaskClass.activate``
+        routes every non-member spawn here).  Members of a pre-planned
+        residue group BUFFER; the completed group is handed to the
+        device batching pipeline as one contiguous burst — no per-task
+        scheduler round-trip, and the burst flushes as stacked calls.
+        Non-grouped tasks pass through untouched."""
+        gi = self._rg_of.get((task.task_class.ast.name, task.locals))
+        if gi is None:
+            return task
+        with self._rg_lock:
+            self._rg_buf[gi].append(task)
+            self._rg_left[gi] -= 1
+            if self._rg_left[gi] > 0:
+                return None
+            group, self._rg_buf[gi] = self._rg_buf[gi], []
+        self._dispatch_residue_group(group)
+        return None
+
+    def _dispatch_residue_group(self, tasks: List[Task]) -> None:
+        """Hand one complete residue group straight to the device:
+        inputs bound (prepare_input), device chore selected, every
+        task pushed onto the device queue back to back — the next
+        manager flush drains them as ONE accumulated burst through the
+        PR 5 stacked dispatch.  No scheduler enqueue/select per task."""
+        es0 = self.context.execution_streams[0]
+        dev = self._dev
+        self.stats["residue_batches"] += 1
+        self.stats["residue_batch_tasks"] += len(tasks)
+        for task in tasks:
+            tc = task.task_class
+            if tc.prepare_input is not None:
+                tc.prepare_input(es0, task)
+            task.selected_chore = next(
+                i for i, c in enumerate(tc.incarnations)
+                if c.device_type == "tpu")
+            task.selected_device = dev
+            est = (tc.time_estimate(task, dev) if tc.time_estimate
+                   else dev.time_estimate_default)
+            dev.load_add(est)
+            task.es_hint = es0.th_id
+            dev.pending.push_back((task, est))
+        # no inline progress: the next idle worker's manager cycle
+        # drains the whole burst with ITS execution stream
+        self.context.wake_workers(len(tasks))
+
+    # ------------------------------------------------------------------ #
+    # prestage/execute overlap (ISSUE 13)                                #
+    # ------------------------------------------------------------------ #
+    def _prestage_activation(self, rec: _StageRec, copy) -> None:
+        """Early H2D of a buffered activation payload: the stage still
+        awaits other inputs, so this transfer hides under whatever is
+        producing them.  Budgeted: at most ``device_prefetch_depth``
+        pending stages hold outstanding prestages at once."""
+        if self._prestage_depth <= 0 or copy is None \
+                or copy.data is None:
+            return
+        if id(rec) not in self._prestage_recs \
+                and len(self._prestage_recs) >= self._prestage_depth:
+            return
+        if self._dev.prestage_data(copy.data, dtt=copy.dtt):
+            self._prestage_recs.add(id(rec))
+            rec.prestaged.append(copy.data)
+            self.stats["prestage_issued"] += 1
+
+    def _prestage_own_tiles(self, rec: _StageRec) -> None:
+        """H2D the spawning stage's host-resident tiles NOW, so the
+        transfers run under the stage's trace/compile below instead of
+        serializing ahead of its dispatch.  Safe: the stage's
+        activation goal is met, so every tile it reads holds its final
+        value (memory ordering between tasks is dataflow-carried)."""
+        if self._prestage_depth <= 0:
+            return
+        tiles = [self.tp.global_env[name].data_of(*coords)
+                 for (name, coords), _a in rec.layout.mem_slots]
+        if rec.chain is not None:
+            tiles.extend(coll.data_of(*coords)
+                         for coll, coords in rec.chain.extra)
+        committed = self._dev.prestage_many(tiles)
+        if committed:
+            rec.prestaged.extend(committed)
+            self.stats["prestage_issued"] += len(committed)
+
+    def _prestage_lookahead(self) -> None:
+        """A stage just completed: prestage the next PENDING stages'
+        tiles whose writers are all retired (their host values are
+        final), up to the device_prefetch_depth stage budget — stage
+        N+1's packed-buffer stage-in overlaps what still executes."""
+        if self._prestage_depth <= 0:
+            return
+        budget = self._prestage_depth
+        writers = self.plan.mem_writers
+        member_stage = self.plan.member_stage
+        for rec in self._recs:
+            if budget <= 0:
+                break
+            with rec._lock:
+                if rec.status != _PENDING:
+                    continue
+            budget -= 1
+            for (coll_name, coords), _access in rec.layout.mem_slots:
+                final = True
+                for wk in writers.get((coll_name, coords), ()):
+                    wsi = member_stage.get(wk)
+                    wrec = (self._rec_by_index.get(wsi)
+                            if wsi is not None else None)
+                    if wrec is None:
+                        final = False   # residue or foreign writer
+                        break
+                    with wrec._lock:
+                        if wrec.status != _DONE:
+                            final = False   # value not yet final
+                    if not final:
+                        break
+                if not final:
+                    continue
+                data = self.tp.global_env[coll_name].data_of(*coords)
+                if self._dev.prestage_data(data):
+                    self._prestage_recs.add(id(rec))
+                    rec.prestaged.append(data)
+                    self.stats["prestage_issued"] += 1
+
+    def _count_prestage_hits(self, rec: _StageRec) -> None:
+        """At spawn: every prestaged Data whose device copy is still
+        current is a HIT — the fused stage's stage-in finds the buffer
+        resident instead of paying a serial H2D."""
+        for data in rec.prestaged:
+            if self._dev.prestaged_current(data):
+                self.stats["prestage_hits"] += 1
+        rec.prestaged = []
+        self._prestage_recs.discard(id(rec))
 
     # ------------------------------------------------------------------ #
     # spawn: AOT-validate the fused callable, bind slots, emit the task  #
@@ -283,6 +574,53 @@ class StageCompiler:
                                "(cached verdict)")
         return fn
 
+    def _extra_shapes(self, rec: _StageRec) -> Tuple:
+        shapes = []
+        for coll, coords in rec.chain.extra:
+            data = coll.data_of(*coords)
+            newest = data.newest_copy()
+            if newest is not None and newest.payload is not None:
+                shapes.append((tuple(newest.payload.shape),
+                               str(newest.payload.dtype)))
+            else:
+                shapes.append((tuple(coll.tile_shape(*coords)),
+                               str(np.dtype(coll.dtype))))
+        return tuple(shapes)
+
+    def _lowered_chain(self, rec: _StageRec, donate: Tuple) -> Any:
+        """The AOT-cached CHAINED program of a host stage (stagec/
+        chain.py): host stage + rider stages of later pools, cached
+        under the host pool's spec token.  A cached failure re-raises
+        instantly (the caller falls back to the host-only callable)."""
+        import jax
+        from ..devices.batching import cached_stage_callable
+        from .chain import build_chain_run, chain_signature
+
+        key = chain_signature(rec.shapes, rec.stage, rec.chain, donate)
+
+        def build():
+            t0 = time.perf_counter_ns()
+            try:
+                run = build_chain_run(self.tp, rec.stage, rec.layout,
+                                      self._codes, rec.chain)
+                fn = jax.jit(run, donate_argnums=donate)
+                avals = tuple(jax.ShapeDtypeStruct(s, np.dtype(d))
+                              for (s, d) in rec.shapes)
+                jax.eval_shape(run, *avals)
+            except Exception:
+                cached_stage_callable(self._token, key, lambda: _FAILED)
+                raise
+            self.stats["stage_compiles"] += 1
+            self.stats["stage_compile_ns"] += \
+                time.perf_counter_ns() - t0
+            return fn
+
+        fn = cached_stage_callable(self._token, key, build)
+        if fn is _FAILED:
+            raise RuntimeError("chained lowering previously failed "
+                               "(cached verdict)")
+        return fn
+
     def _make_stage_task(self, rec: _StageRec) -> Task:
         with rec._lock:
             events = list(rec.events)
@@ -290,13 +628,40 @@ class StageCompiler:
         for (mkey, fname, copy) in events:
             if copy is not None:
                 bindings[(mkey, fname)] = copy
+        # prestage the stage's host-resident tiles: their H2D runs
+        # under the trace/compile below (ISSUE 13 overlap)
+        self._prestage_own_tiles(rec)
         rec.shapes = self._slot_shapes(rec, bindings)
+        if rec.chain is not None:
+            rec.shapes = rec.shapes + self._extra_shapes(rec)
         rec.donate = tuple(
             i for i, (_k, acc) in enumerate(rec.layout.mem_slots)
             if self._donate_on and (acc & FlowAccess.WRITE))
         from ..devices.batching import cached_stage_callable
         try:
-            rec.fn = self._lowered(rec, rec.donate)
+            if rec.chain is not None:
+                try:
+                    rec.fn = self._lowered_chain(rec, rec.donate)
+                except Exception as exc:  # noqa: BLE001 - host stands by
+                    self.stats["chain_fallbacks"] += 1
+                    plog.warning(
+                        "stagec chain: chained program of %s stage %d "
+                        "failed to lower (%s: %s); host-only callable "
+                        "(riders dispatch from their own pools)",
+                        self.tp.name, rec.stage.index,
+                        type(exc).__name__, str(exc)[:200])
+                    st = getattr(self.context, "_stage_chain", None)
+                    if st is not None:
+                        # a None stash tells each rider "the host fell
+                        # back, spawn normally" — counted HERE once,
+                        # not once more per rider
+                        for link in rec.chain.riders:
+                            st.stash[id(link.tp)] = None
+                    rec.chain = None
+                    rec.shapes = self._slot_shapes(rec, bindings)
+                    rec.fn = self._lowered(rec, rec.donate)
+            else:
+                rec.fn = self._lowered(rec, rec.donate)
         except Exception:
             # record the verdict so the next taskpool over the same
             # spec downgrades this stage instantly (permanent, but
@@ -307,9 +672,10 @@ class StageCompiler:
                 + (rec.donate, "fused"),
                 lambda: _FAILED)
             raise
-        if self._mesh_dev is not None \
+        if self._mesh_dev is not None and rec.chain is None \
                 and params.get("stage_compile_shard"):
             rec.sharded = self._try_sharded(rec)
+        self._count_prestage_hits(rec)
         tc = StageTaskClass(self, rec)
         task = Task(self.tp, tc, locals_=(rec.stage.index,),
                     priority=rec.priority)
@@ -322,6 +688,12 @@ class StageCompiler:
         for j, ak in enumerate(rec.layout.act_slots):
             task.data[base + j].data_in = bindings[ak]
             task.data[base + j].fulfilled = True
+        if rec.chain is not None:
+            base += len(rec.layout.act_slots)
+            for j, (coll, coords) in enumerate(rec.chain.extra):
+                task.data[base + j].data_in = \
+                    coll.data_of(*coords).host_copy()
+                task.data[base + j].fulfilled = True
         rec.task = task
         return task
 
@@ -375,6 +747,8 @@ class StageCompiler:
         with rec._lock:
             rec.status = _DOWNGRADED
             events, rec.events = rec.events, []
+        rec.prestaged = []
+        self._prestage_recs.discard(id(rec))
         self.stats["stage_fallbacks"] += 1
         ready: List[Task] = []
         for inst in rec.stage.members:
@@ -418,10 +792,25 @@ class StageCompiler:
             if rec.donate and len({id(a) for a in arrays}) != len(arrays):
                 # the same buffer at two slots: donation would trip
                 # XLA's aliasing rule — use the undonated variant
-                fn = self._lowered(rec, ())
+                fn = (self._lowered_chain(rec, ())
+                      if rec.chain is not None else self._lowered(rec, ()))
             outs = fn(*arrays)
             ntile = len(lay.out_mem)
-            tile_outs, edge_outs = list(outs[:ntile]), list(outs[ntile:])
+            nhost = ntile + len(lay.edge_outs)
+            tile_outs = list(outs[:ntile])
+            edge_outs = list(outs[ntile:nhost])
+            if rec.chain is not None:
+                # stash each rider stage's outputs for its pool's
+                # consume_chain (stagec/chain.py): tiles + edge
+                # live-outs, still (possibly in-flight) device arrays
+                st = getattr(self.context, "_stage_chain", None)
+                rest = list(outs[nhost:])
+                for link in rec.chain.riders:
+                    nt = len(link.layout.out_mem)
+                    part, rest = rest[:link.n_out], rest[link.n_out:]
+                    if st is not None:
+                        st.stash[id(link.tp)] = {"tiles": part[:nt],
+                                                 "edges": part[nt:]}
         dev = task.selected_device
         for ek, arr in zip(lay.edge_outs, edge_outs):
             if arr is None:
@@ -439,6 +828,9 @@ class StageCompiler:
     def _release(self, es, rec: _StageRec) -> List[Task]:
         with rec._lock:
             rec.status = _DONE
+        # this stage's written tiles are final: prestage the next
+        # pending stages' inputs (ISSUE 13 overlap)
+        self._prestage_lookahead()
         ready: List[Task] = []
         for inst in rec.stage.members:
             if inst.key not in rec.layout.release_members:
@@ -469,15 +861,16 @@ def _edge_copy(arr) -> DataCopy:
     return cp
 
 
-def try_install(tp, context) -> Optional[StageCompiler]:
-    """Build a StageCompiler for ``tp`` when the stage_compile knob is
-    on and the pool is eligible; None keeps the interpreted runtime
-    bit-for-bit (the knob's off-contract).  The plan + layouts are a
-    pure function of (spec, globals, geometry, distribution, rank), so
-    they cache under the spec token — a repeat taskpool skips the whole
-    enumeration/partition walk, not just the retrace."""
-    if not any(d.device_type == "tpu" for d in context.devices):
-        return None
+def prepared_plan(tp, context) -> StagePlan:
+    """The cached, layout-prepared StagePlan of one taskpool under the
+    current knobs.  The plan + layouts are a pure function of (spec,
+    globals, geometry, distribution, rank) AND the partition knobs —
+    max_tasks, wavefront mode, and the exclusion set all join the
+    cache key, so a knob change can never hit a stale plan.  Shared by
+    ``try_install`` and the chain planner (stagec/chain.declare_chain),
+    which therefore always agree on stage identity."""
+    from ..devices.batching import cached_stage_callable
+    from .plan import _excluded_classes
     wavefront = any(
         d.device_type == "tpu" and getattr(d, "mesh", None) is not None
         and len(getattr(d, "chips", ())) > 1 for d in context.devices)
@@ -496,13 +889,38 @@ def try_install(tp, context) -> Optional[StageCompiler]:
                      if m.tc.ast.priority is not None]
             plan.prepared.append((stage, layout,
                                   max(prios) if prios else 0))
+        # plan-cached startup enumeration (ISSUE 13): goal-0 local
+        # residue + the foreign mem-put expectation are pure functions
+        # of the plan identity — a stagec _startup skips the whole
+        # per-instance iteration-space walk on repeat pools
+        for inst in plan.order:
+            k = inst.key
+            if k in plan.local_keys:
+                if k not in plan.member_stage \
+                        and inst.tc.goal_of(inst.locals, inst.env) == 0:
+                    plan.startup_goal0.append(k)
+            else:
+                plan.startup_mem_puts += tp._count_mem_puts_to_me(
+                    tp.class_by_name(k[0]), inst.env)
         return plan
 
+    return cached_stage_callable(
+        spec_token(tp),
+        ("stageplan", wavefront, max_tasks, _excluded_classes()),
+        build_plan)
+
+
+def try_install(tp, context) -> Optional[StageCompiler]:
+    """Build a StageCompiler for ``tp`` when the stage_compile knob is
+    on and the pool is eligible; None keeps the interpreted runtime
+    bit-for-bit (the knob's off-contract).  The plan + layouts are a
+    pure function of (spec, globals, geometry, distribution, rank), so
+    they cache under the spec token — a repeat taskpool skips the whole
+    enumeration/partition walk, not just the retrace."""
+    if not any(d.device_type == "tpu" for d in context.devices):
+        return None
     try:
-        from ..devices.batching import cached_stage_callable
-        plan = cached_stage_callable(
-            spec_token(tp), ("stageplan", wavefront, max_tasks),
-            build_plan)
+        plan = prepared_plan(tp, context)
     except Exception as exc:  # noqa: BLE001 - unenumerable: interpret
         plog.debug.verbose(
             2, "stagec: %s not plannable (%s: %s); interpreted path",

@@ -11,12 +11,18 @@ same bit-exactness argument as ``devices/batching.build_sharded_callable``
 — identical per-example graphs, one chip or many).
 
 Eligibility (checked here, not at plan time — it needs concrete
-shapes): single class, a body that reads no declared locals (every row
-must run the identical traced code), every member flow bound to its
-own exclusive packed slot (no shared tiles, no NEW/NULL bindings), and
-a member count divisible by the chip count.  Ineligible stages — and
-any failure while assembling or tracing the sharded call — fall back
-to the fused single-chip callable transparently.
+shapes): single class, every member flow bound to its own exclusive
+packed slot (no shared tiles, no NEW/NULL bindings), and a member
+count divisible by the chip count.  A body that reads declared LOCALS
+no longer rejects (ISSUE 13 STG relaxation): the referenced locals'
+per-member values ride an extra ``(n, L)`` int32 argument sharded
+over the member axis, and each row's body sees them as TRACED scalars
+— so e.g. a wave whose body scales by ``k`` still compiles as one
+shard_map call.  A body that uses a local in Python control flow
+fails the forced trace and falls back like any other trace failure.
+Ineligible stages — and any failure while assembling or tracing the
+sharded call — fall back to the fused single-chip callable
+transparently.
 """
 from __future__ import annotations
 
@@ -33,12 +39,15 @@ class WavefrontInfo:
     feeds each (member, flow) and where each output row lands."""
 
     __slots__ = ("class_name", "flow_names", "arg_slots", "code",
-                 "rep_env", "out_mem_map", "edge_map", "n", "nargs")
+                 "rep_env", "out_mem_map", "edge_map", "n", "nargs",
+                 "local_names", "local_vals")
 
     def __init__(self, class_name: str, flow_names: List[str],
                  arg_slots: List[List[int]], code: Any, rep_env: Dict,
                  out_mem_map: List[Tuple[int, int]],
-                 edge_map: List[Tuple[int, int]]) -> None:
+                 edge_map: List[Tuple[int, int]],
+                 local_names: Tuple[str, ...] = (),
+                 local_vals: Optional[List[Tuple[int, ...]]] = None) -> None:
         self.class_name = class_name
         self.flow_names = flow_names
         self.arg_slots = arg_slots        # [member][flow] -> slot index
@@ -50,6 +59,10 @@ class WavefrontInfo:
         self.edge_map = edge_map
         self.n = len(arg_slots)
         self.nargs = len(flow_names)
+        #: locals the body READS (co_names ∩ declared locals): their
+        #: per-member values ship as one (n, L) int32 traced argument
+        self.local_names = local_names
+        self.local_vals = local_vals or []
 
 
 def wavefront_info(tp, stage, layout, codes) -> Optional[WavefrontInfo]:
@@ -63,8 +76,18 @@ def wavefront_info(tp, stage, layout, codes) -> Optional[WavefrontInfo]:
     tc_ast = members[0].tc.ast
     code = codes[cls]
     names = set(code.co_names)
-    if any(ld.name in names for ld in tc_ast.locals):
-        return None   # body reads locals: rows are not identical code
+    # a body reading locals shards anyway (ISSUE 13): the referenced
+    # locals become per-row traced scalars instead of rejecting
+    local_names = tuple(ld.name for ld in tc_ast.locals
+                        if ld.name in names)
+    local_vals: List[Tuple[int, ...]] = []
+    if local_names:
+        try:
+            local_vals = [
+                tuple(int(m.env[nm]) for nm in local_names)
+                for m in members]
+        except (KeyError, TypeError, ValueError):
+            return None   # non-integer local: not shippable as scalars
     nonctl = [f for f in tc_ast.flows if not f.is_ctl]
     from .lower import _producer_locals
     class_ast = {tc.ast.name: tc.ast for tc in tp.task_classes}
@@ -127,7 +150,8 @@ def wavefront_info(tp, stage, layout, codes) -> Optional[WavefrontInfo]:
     edge_map = [(mindex[mk], flow_pos[fn])
                 for (mk, fn) in layout.edge_outs]
     return WavefrontInfo(cls, [f.name for f in nonctl], arg_slots, code,
-                         dict(members[0].env), out_mem_map, edge_map)
+                         dict(members[0].env), out_mem_map, edge_map,
+                         local_names, local_vals)
 
 
 def build_wavefront_callable(mesh, info: WavefrontInfo, rank: int,
@@ -150,6 +174,8 @@ def build_wavefront_callable(mesh, info: WavefrontInfo, rank: int,
     axes = tuple(mesh.axis_names)
     batch = PartitionSpec(axes)
     code, rep_env, flow_names = info.code, info.rep_env, info.flow_names
+    local_names = info.local_names
+    n_in = nargs + (1 if local_names else 0)
 
     def local_fn(*blocks):
         rows = []
@@ -157,6 +183,11 @@ def build_wavefront_callable(mesh, info: WavefrontInfo, rank: int,
             env = dict(rep_env)
             for j, fname in enumerate(flow_names):
                 env[fname] = blocks[j][r]
+            # per-row locals as traced scalars (ISSUE 13 relaxation):
+            # blocks[nargs] is this chip's (per, L) slice of the
+            # member-major locals array
+            for li, nm in enumerate(local_names):
+                env[nm] = blocks[nargs][r, li]
             env["np"] = np
             env["jnp"] = jnp
             env["es_rank"] = rank
@@ -167,14 +198,17 @@ def build_wavefront_callable(mesh, info: WavefrontInfo, rank: int,
                      for o in range(len(flow_names)))
 
     sharded = shard_map_fwd(local_fn, mesh,
-                            in_specs=(batch,) * nargs,
+                            in_specs=(batch,) * n_in,
                             out_specs=(batch,) * len(flow_names))
     sh = NamedSharding(mesh, batch)
-    fn = jax.jit(sharded, in_shardings=(sh,) * nargs,
+    fn = jax.jit(sharded, in_shardings=(sh,) * n_in,
                  out_shardings=(sh,) * len(flow_names))
     # force the trace NOW so eligibility failures downgrade at build
     # time, not mid-dispatch
-    avals = tuple(jax.ShapeDtypeStruct((n,) + s, d) for (s, d) in shapes)
+    avals = [jax.ShapeDtypeStruct((n,) + s, d) for (s, d) in shapes]
+    if local_names:
+        avals.append(jax.ShapeDtypeStruct((n, len(local_names)),
+                                          np.int32))
     fn.lower(*avals)
     return fn, sh
 
@@ -207,6 +241,13 @@ def dispatch_sharded(device, fn, sharding, info: WavefrontInfo,
     gargs = [jax.make_array_from_single_device_arrays(
         (n,) + shapes[j], sharding, [blocks[c][j] for c in range(k)])
         for j in range(nargs)]
+    if info.local_names:
+        # member-major locals array, one (per, L) int32 shard per chip
+        loc = np.asarray(info.local_vals, dtype=np.int32)
+        loc_shards = [jax.device_put(loc[c * per:(c + 1) * per], chip)
+                      for c, chip in enumerate(chips)]
+        gargs.append(jax.make_array_from_single_device_arrays(
+            loc.shape, sharding, loc_shards))
     outs = fn(*gargs)
     pos = {d: i for i, d in enumerate(chips)}
     shards = [sorted(o.addressable_shards, key=lambda s: pos[s.device])

@@ -362,6 +362,27 @@ def register_core_params() -> None:
                     "shard_map over the rank's chip mesh "
                     "(device_mesh_shape) so one compiled stage spans "
                     "chips; off forces the fused single-chip callable")
+    params.reg_bool("stage_compile_chain", True,
+                    "cross-pool stage chaining (stagec/chain.py, ISSUE "
+                    "13): when a taskpool sequence is declared "
+                    "(stagec.chain.declare_chain / ops.dposv), fuse the "
+                    "final stage of pool K with the first stage of pool "
+                    "K+1 into one chained program when the inter-pool "
+                    "dataflow is provable; off runs each pool's stages "
+                    "separately (the PR 12 per-pool behavior)")
+    params.reg_bool("stage_residue_batch", True,
+                    "compiled residue schedule (ISSUE 13): dispatch "
+                    "per-(level, class) residue groups pre-planned at "
+                    "stage-plan time straight onto the device batching "
+                    "pipeline, skipping the per-task scheduler "
+                    "round-trip; off keeps the PR 12 per-task residue "
+                    "dispatch")
+    params.reg_string("stage_compile_exclude", "",
+                      "comma-separated task-class names excluded from "
+                      "stage lowering (verdict STG306): their instances "
+                      "run as interpreted residue — a debugging / "
+                      "measurement knob (the residue-heavy bench leg "
+                      "rides it)")
     params.reg_int("comm_prefetch_inflight", 8,
                    "max rendezvous GETs prefetched for activations that "
                    "arrived ahead of their taskpool's registration/"

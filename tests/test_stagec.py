@@ -107,12 +107,12 @@ def test_stagec_aot_cache_hits_across_taskpools():
             ctx.fini()
 
 
-def test_stagec_residue_interleaves_with_compiled_stages():
-    """A pool mixing compilable device classes with host-only classes
-    (dtrsm's FWD spec: RDIAG/RPANEL are cpu BODYs, TRSM/GEMM are
-    device BODYs) runs the stages compiled and the residue interpreted
-    — same answer as fully interpreted, with STAGE_TASKS covering only
-    the compilable part."""
+def test_stagec_noop_readers_lower_as_forwarders():
+    """dtrsm's FWD spec mixes device classes with no-op reader classes
+    (RDIAG/RPANEL broadcast L tiles through ``pass`` cpu BODYs): the
+    ISSUE 13 relaxation lowers the readers as pure dataflow, so the
+    WHOLE pool compiles — same answer as fully interpreted, with
+    STAGE_TASKS covering every task."""
     from parsec_tpu.ops import dtrsm_lower_taskpool
 
     n, nb, nrhs = 128, 32, 8
@@ -145,10 +145,123 @@ def test_stagec_residue_interleaves_with_compiled_stages():
     Y1, s1 = run(True)
     np.testing.assert_array_equal(Y1, Y0)
     assert s1["stage_tasks"] > 0, s1
-    # RDIAG/RPANEL instances are residue: staged coverage is partial
     from parsec_tpu.stagec import class_verdicts
     from parsec_tpu.ops.dtrsm import _factories
     verdicts = class_verdicts(_factories()[0].jdf)
+    assert verdicts["RDIAG"].ok and verdicts["RDIAG"].note, verdicts
+    assert verdicts["RPANEL"].ok
+    assert verdicts["TRSM"].ok and verdicts["GEMM"].ok
+
+
+# a dtrsm-fwd variant whose reader classes carry REAL host bodies (a
+# host-side checksum) — they must stay residue (STG300 is NOT relaxed
+# for bodies that do work), interleaving with the compiled stages
+MIXED_FWD_JDF = """
+descL [ type="collection" ]
+descB [ type="collection" ]
+MT [ type="int" ]
+NT [ type="int" ]
+
+RDIAG(k)
+
+k = 0 .. MT-1
+
+: descL( k, k )
+
+READ T <- descL( k, k )
+       -> T TRSM( k, 0 .. NT-1 )
+
+BODY
+{
+    _chk = float(np.sum(np.asarray(T)))
+}
+END
+
+TRSM(k, n)
+
+k = 0 .. MT-1
+n = 0 .. NT-1
+
+: descB( k, n )
+
+READ T <- T RDIAG( k )
+RW   X <- (k == 0) ? descB( k, n ) : C GEMM( k-1, k, n )
+       -> descB( k, n )
+       -> B GEMM( k, k+1 .. MT-1, n )
+
+BODY [type=tpu]
+{
+    X = ops.trsm_lower(T, X)
+}
+END
+
+GEMM(k, m, n)
+
+k = 0 .. MT-2
+m = k+1 .. MT-1
+n = 0 .. NT-1
+
+: descB( m, n )
+
+READ A <- descL( m, k )
+READ B <- X TRSM( k, n )
+RW   C <- (k == 0) ? descB( m, n ) : C GEMM( k-1, m, n )
+       -> (m == k+1) ? X TRSM( m, n ) : C GEMM( k+1, m, n )
+
+BODY [type=tpu]
+{
+    C = ops.gemm_nn_sub(C, A, B)
+}
+END
+"""
+
+
+def _run_mixed_fwd(stagec, n=128, nb=32, nrhs=8, residue_batch=True):
+    from contextlib import ExitStack
+
+    from parsec_tpu import ops as ops_module
+    from parsec_tpu.dsl import ptg
+
+    M = make_spd(n)
+    rng = np.random.RandomState(5)
+    B0 = rng.rand(n, nrhs).astype(np.float32)
+    Lnp = np.tril(np.linalg.cholesky(
+        M.astype(np.float64)).astype(np.float32))
+    with ExitStack() as st:
+        if stagec:
+            st.enter_context(params.cmdline_override("stage_compile", "1"))
+        if not residue_batch:
+            st.enter_context(
+                params.cmdline_override("stage_residue_batch", "0"))
+        ctx = parsec_tpu.init(nb_cores=2)
+        try:
+            L = TwoDimBlockCyclic(n, n, nb, nb,
+                                  dtype=np.float32).from_numpy(Lnp.copy())
+            B = TwoDimBlockCyclic(n, nrhs, nb, nrhs,
+                                  dtype=np.float32).from_numpy(B0.copy())
+            tp = ptg.compile_jdf(MIXED_FWD_JDF, name="mixed_fwd").new(
+                descL=L, descB=B, MT=B.mt, NT=B.nt)
+            tp.global_env["ops"] = ops_module
+            ctx.add_taskpool(tp)
+            ctx.wait()
+            return B.to_numpy(), dict(ctx.stage_stats)
+        finally:
+            ctx.fini()
+
+
+def test_stagec_residue_interleaves_with_compiled_stages():
+    """A pool mixing compilable device classes with REAL host bodies
+    (MIXED_FWD: RDIAG does host-side work) runs the stages compiled
+    and the residue interpreted — same answer as fully interpreted,
+    with STAGE_TASKS covering only the compilable part."""
+    from parsec_tpu.dsl.ptg.parser import parse_jdf
+    from parsec_tpu.stagec import class_verdicts
+
+    Y0, s0 = _run_mixed_fwd(False)
+    Y1, s1 = _run_mixed_fwd(True)
+    np.testing.assert_array_equal(Y1, Y0)
+    assert s1["stage_tasks"] > 0, s1
+    verdicts = class_verdicts(parse_jdf(MIXED_FWD_JDF, name="mixed_fwd"))
     assert not verdicts["RDIAG"].ok and verdicts["RDIAG"].code == "STG300"
     assert verdicts["TRSM"].ok and verdicts["GEMM"].ok
 
@@ -242,6 +355,360 @@ def test_stagec_trace_failure_downgrades_one_stage(monkeypatch):
     assert calls["fail"] == before["fail"], calls
     assert s2["stage_fallbacks"] == 1, s2
     np.testing.assert_array_equal(L2, L0)
+
+
+def test_stagec_cache_token_covers_donate_and_max_tasks():
+    """Regression (ISSUE 13 satellite): the AOT stage-cache key must
+    cover the donate mask AND stage_compile_max_tasks — flipping either
+    knob between otherwise identical runs must trigger fresh
+    compilation (a stale hit would dispatch a program built for the
+    wrong donation/partition), at unchanged numerics."""
+    _clear_stage_cache()
+    L0, s0, _x, M = _run_dpotrf(128, 32, stagec=False)
+
+    with params.cmdline_override("stage_compile", "1"):
+        ctx = parsec_tpu.init(nb_cores=2)
+        try:
+            def one(donate=None, max_tasks=None):
+                from contextlib import ExitStack
+                with ExitStack() as st:
+                    if donate:
+                        st.enter_context(
+                            params.cmdline_override("device_donate", "1"))
+                    if max_tasks is not None:
+                        st.enter_context(params.cmdline_override(
+                            "stage_compile_max_tasks", str(max_tasks)))
+                    A = TwoDimBlockCyclic(
+                        128, 128, 32, 32,
+                        dtype=np.float32).from_numpy(M.copy())
+                    ctx.add_taskpool(dpotrf_taskpool(A))
+                    ctx.wait()
+                    return np.tril(A.to_numpy())
+
+            base = one()
+            c1 = ctx.stage_stats["stage_compiles"]
+            assert c1 > 0
+            # same knobs again: pure cache hit, no new compile
+            again = one()
+            assert ctx.stage_stats["stage_compiles"] == c1
+            # donate flip: the mask is part of the key -> fresh compile
+            don = one(donate=True)
+            c2 = ctx.stage_stats["stage_compiles"]
+            assert c2 > c1, "donate-mask change hit a stale stage"
+            # max_tasks flip: the plan key changes -> fresh plan+compile
+            split = one(max_tasks=6)
+            c3 = ctx.stage_stats["stage_compiles"]
+            assert c3 > c2, "max_tasks change hit a stale plan/stage"
+            for got in (base, again, don, split):
+                np.testing.assert_array_equal(got, L0)
+        finally:
+            ctx.fini()
+
+
+def test_stagec_donate_downgrade_replays_clean(monkeypatch):
+    """stage_compile + device_donate interaction (ISSUE 13 satellite):
+    with donation ON, an injected lowering failure downgrades one
+    stage MID-RUN — its buffered activations must replay into the
+    dynamic path and the donated packed buffers of the OTHER (still
+    compiled, donating) stages must retire clean: bit-exact factor, no
+    async errors, exactly one fallback."""
+    import parsec_tpu.stagec.runtime as srt
+
+    _clear_stage_cache()
+    real_build = srt.build_stage_fn
+    calls = {"fail": 0}
+
+    def failing_build(tp, stage, layout, codes):
+        if stage.index == 1:
+            calls["fail"] += 1
+            raise RuntimeError("injected mid-run lowering failure")
+        return real_build(tp, stage, layout, codes)
+
+    monkeypatch.setattr(srt, "build_stage_fn", failing_build)
+    M = make_spd(160)
+    from contextlib import ExitStack
+    with ExitStack() as st:
+        st.enter_context(params.cmdline_override("stage_compile", "1"))
+        st.enter_context(params.cmdline_override("device_donate", "1"))
+        st.enter_context(
+            params.cmdline_override("stage_compile_max_tasks", "6"))
+        ctx = parsec_tpu.init(nb_cores=2)
+        try:
+            A = TwoDimBlockCyclic(160, 160, 32, 32,
+                                  dtype=np.float32).from_numpy(M.copy())
+            ctx.add_taskpool(dpotrf_taskpool(A))
+            ctx.wait()
+            L1 = np.tril(A.to_numpy())
+            s1 = dict(ctx.stage_stats)
+        finally:
+            ctx.fini()
+    assert calls["fail"] >= 1
+    assert s1["stage_fallbacks"] == 1, s1
+    assert s1["stage_compiles"] >= 1, s1
+    _clear_stage_cache()
+    L0, _s0, _sc, _ = _run_dpotrf(160, 32, stagec=False)
+    np.testing.assert_array_equal(L1, L0)
+
+
+def _run_dposv(stagec, chain=True, n=128, nb=32, nrhs=32):
+    from contextlib import ExitStack
+
+    from parsec_tpu.ops import dposv
+
+    M = make_spd(n)
+    rng = np.random.RandomState(7)
+    B0 = rng.rand(n, nrhs).astype(np.float32)
+    with ExitStack() as st:
+        if stagec:
+            st.enter_context(params.cmdline_override("stage_compile", "1"))
+        if not chain:
+            st.enter_context(
+                params.cmdline_override("stage_compile_chain", "0"))
+        ctx = parsec_tpu.init(nb_cores=2)
+        try:
+            A = TwoDimBlockCyclic(n, n, nb, nb,
+                                  dtype=np.float32).from_numpy(M.copy())
+            B = TwoDimBlockCyclic(n, nrhs, nb, nrhs,
+                                  dtype=np.float32).from_numpy(B0.copy())
+            dposv(ctx, A, B)
+            rejects = (list(ctx._stage_chain.rejects)
+                       if ctx._stage_chain is not None else None)
+            return B.to_numpy(), dict(ctx.stage_stats), rejects
+        finally:
+            ctx.fini()
+
+
+def test_stagec_chain_dposv_one_program():
+    """Cross-pool chaining (ISSUE 13 tentpole): single-rank dposv's
+    three pools fuse into ONE chained program — both boundaries link
+    (CHAIN_LINKS == 2), exactly one stage dispatch runs all three
+    pools, zero fallbacks/rejects, and the solution is BIT-EXACT vs
+    the fully interpreted composition."""
+    X0, s0, _r = _run_dposv(False)
+    Xc, sc, rejects = _run_dposv(True, chain=True)
+    assert sc["chain_links"] == 2, sc
+    assert sc["chain_fallbacks"] == 0, sc
+    assert sc["stage_dispatches"] == 1, sc
+    assert rejects == [], rejects
+    np.testing.assert_array_equal(Xc, X0)
+    # chain off: same numerics through three per-pool programs
+    Xp, sp, _r2 = _run_dposv(True, chain=False)
+    assert sp["chain_links"] == 0 and sp["stage_dispatches"] == 3, sp
+    np.testing.assert_array_equal(Xp, X0)
+
+
+def test_stagec_chain_host_failure_falls_back(monkeypatch):
+    """A chained program that fails to lower must fall back to the
+    host-only callable, and the rider pools — finding no stash — must
+    dispatch their stages normally: bit-exact result, CHAIN_FALLBACKS
+    counted, nothing hangs."""
+    import parsec_tpu.stagec.runtime as srt
+
+    _clear_stage_cache()
+
+    def failing_chain_run(*a, **k):
+        raise RuntimeError("injected chained-lowering failure")
+
+    import parsec_tpu.stagec.chain as chain_mod
+    monkeypatch.setattr(chain_mod, "build_chain_run", failing_chain_run)
+    X0, _s0, _r = _run_dposv(False)
+    Xc, sc, _rej = _run_dposv(True, chain=True)
+    assert sc["chain_links"] == 0, sc
+    assert sc["chain_fallbacks"] >= 1, sc
+    assert sc["stage_dispatches"] == 3, sc     # every pool dispatched
+    np.testing.assert_array_equal(Xc, X0)
+    _clear_stage_cache()   # drop the cached injected failure
+
+
+def test_stagec_chain_rejects_multirank_dataflow():
+    """2-rank dposv: cross-rank dataflow is not fusable — the chain
+    planner must REJECT the boundaries (reason recorded, no fallback
+    counted) and the distributed composition must still be bit-exact
+    vs interpreted."""
+    from parsec_tpu.comm import RemoteDepEngine
+    from parsec_tpu.ops import dposv
+
+    n, nb, nr = 128, 32, 2
+    M = make_spd(n)
+    B0 = np.random.RandomState(9).rand(n, nb).astype(np.float32)
+
+    def run(stagec):
+        from contextlib import ExitStack
+
+        def rank_fn(rank, fabric):
+            with ExitStack() as st:
+                if stagec:
+                    st.enter_context(
+                        params.cmdline_override("stage_compile", "1"))
+                eng = RemoteDepEngine(fabric.engine(rank))
+                ctx = parsec_tpu.Context(nb_cores=2, comm=eng)
+                try:
+                    A = TwoDimBlockCyclic(
+                        n, n, nb, nb, P=nr, Q=1, nodes=nr, rank=rank,
+                        dtype=np.float32).from_numpy(M.copy())
+                    A.name = "descA"
+                    B = TwoDimBlockCyclic(
+                        n, nb, nb, nb, P=nr, Q=1, nodes=nr, rank=rank,
+                        dtype=np.float32).from_numpy(B0.copy())
+                    B.name = "descB"
+                    dposv(ctx, A, B, rank=rank, nb_ranks=nr)
+                    owned = {c: np.asarray(
+                        B.data_of(*c).sync_to_host().payload)
+                        for c in B.tiles() if B.rank_of(*c) == rank}
+                    rejects = (list(ctx._stage_chain.rejects)
+                               if ctx._stage_chain is not None else None)
+                    return owned, dict(ctx.stage_stats), rejects
+                finally:
+                    ctx.fini()
+
+        results, _f = spmd(nr, rank_fn, timeout=300)
+        X = np.zeros((n, nb), np.float32)
+        stats, rejects = [], []
+        for owned, st_, rej in results:
+            stats.append(st_)
+            rejects.append(rej)
+            for (m, k), t in owned.items():
+                X[m * nb:m * nb + t.shape[0], :t.shape[1]] = t
+        return X, stats, rejects
+
+    X0, _s0, _r0 = run(False)
+    X1, s1, r1 = run(True)
+    for s, rej in zip(s1, r1):
+        assert s["chain_links"] == 0, s
+        assert s["chain_fallbacks"] == 0, s     # rejected, not failed
+        assert rej, "no chain-rejection reason was recorded"
+    np.testing.assert_array_equal(X1, X0)
+
+
+def test_stagec_residue_schedule_batches_groups():
+    """Compiled residue schedule (ISSUE 13 tentpole): with GEMM
+    operator-excluded (STG306), its instances run as device residue
+    between compiled stages — pre-planned per-(level, class) groups
+    must dispatch as bursts (RESIDUE_BATCHES > 0) with the knob on and
+    stay per-task with it off, bit-exact either way."""
+    from contextlib import ExitStack
+
+    n, nb = 160, 32
+    M = make_spd(n)
+    L0, _s, _sc, _m = _run_dpotrf(n, nb, stagec=False)
+
+    def leg(residue_batch):
+        with ExitStack() as st:
+            st.enter_context(
+                params.cmdline_override("stage_compile", "1"))
+            st.enter_context(params.cmdline_override(
+                "stage_compile_exclude", "GEMM"))
+            if not residue_batch:
+                st.enter_context(params.cmdline_override(
+                    "stage_residue_batch", "0"))
+            ctx = parsec_tpu.init(nb_cores=2)
+            try:
+                A = TwoDimBlockCyclic(n, n, nb, nb,
+                                      dtype=np.float32
+                                      ).from_numpy(M.copy())
+                ctx.add_taskpool(dpotrf_taskpool(A))
+                ctx.wait()
+                return np.tril(A.to_numpy()), dict(ctx.stage_stats)
+            finally:
+                ctx.fini()
+
+    L_on, s_on = leg(True)
+    L_off, s_off = leg(False)
+    assert s_on["residue_batches"] > 0, s_on
+    assert s_on["residue_batch_tasks"] >= 2 * s_on["residue_batches"]
+    assert s_off["residue_batches"] == 0, s_off
+    np.testing.assert_array_equal(L_on, L0)
+    np.testing.assert_array_equal(L_off, L0)
+    # the exclusion really is the STG306 verdict
+    from parsec_tpu.dsl.ptg.parser import parse_jdf
+    from parsec_tpu.ops.dpotrf import DPOTRF_L_JDF
+    from parsec_tpu.stagec import class_verdicts
+    with params.cmdline_override("stage_compile_exclude", "GEMM"):
+        v = class_verdicts(parse_jdf(DPOTRF_L_JDF, name="dpotrf"))
+    assert not v["GEMM"].ok and v["GEMM"].code == "STG306", v["GEMM"]
+    assert v["POTRF"].ok
+
+
+def test_stagec_prestage_issues_and_hits():
+    """Prestage/execute overlap (ISSUE 13 tentpole): a stage-compiled
+    run prestages its packed-buffer tiles (H2D under lowering /
+    execution) and the spawn-time accounting sees them land —
+    PRESTAGE_ISSUED and PRESTAGE_HITS both move."""
+    _clear_stage_cache()
+    _l, s1, _sc, _m = _run_dpotrf(128, 32, stagec=True)
+    assert s1["prestage_issued"] > 0, s1
+    assert s1["prestage_hits"] > 0, s1
+    assert s1["prestage_hits"] <= s1["prestage_issued"], s1
+
+
+def test_stagec_sharded_locals_as_traced_scalars():
+    """The ISSUE 13 sharded relaxation: a wave-front class whose body
+    READS a declared local (``A = A * (m + 2)``) still compiles
+    through shard_map on a mesh rank — the locals ride an (n, L) int32
+    traced argument — and stays bit-exact vs the interpreted path."""
+    from parsec_tpu.parallel.mesh import has_shard_map
+
+    if not has_shard_map():
+        pytest.skip("no shard_map spelling in this jax build")
+    from contextlib import ExitStack
+
+    from parsec_tpu.dsl import ptg
+
+    spec = """
+descA [ type="collection" ]
+NT [ type="int" ]
+
+Gen(m)
+m = 0 .. NT-1
+: descA( m, 0 )
+RW A <- descA( m, 0 )
+     -> A Scale( m )
+BODY [type=tpu]
+{
+    A = A + 1.0
+}
+END
+
+Scale(m)
+m = 0 .. NT-1
+: descA( m, 0 )
+RW A <- A Gen( m )
+     -> descA( m, 0 )
+BODY [type=tpu]
+{
+    A = A * (m + 2)
+}
+END
+"""
+    nb, nt = 8, 4
+    A0 = np.random.RandomState(3).rand(nt * nb, nb).astype(np.float32)
+
+    def run(stagec, mesh=None):
+        with ExitStack() as st:
+            if stagec:
+                st.enter_context(
+                    params.cmdline_override("stage_compile", "1"))
+            if mesh:
+                st.enter_context(
+                    params.cmdline_override("device_mesh_shape", mesh))
+            ctx = parsec_tpu.init(nb_cores=2)
+            try:
+                A = TwoDimBlockCyclic(nt * nb, nb, nb, nb,
+                                      dtype=np.float32
+                                      ).from_numpy(A0.copy())
+                tp = ptg.compile_jdf(spec, name="scalewave").new(
+                    descA=A, NT=nt)
+                ctx.add_taskpool(tp)
+                ctx.wait()
+                return A.to_numpy(), dict(ctx.stage_stats)
+            finally:
+                ctx.fini()
+
+    R0, _s0 = run(False)
+    R1, s1 = run(True, mesh="2x2")
+    assert s1["stage_sharded"] >= 1, s1    # the locals-reader sharded
+    assert s1["stage_fallbacks"] == 0, s1
+    np.testing.assert_array_equal(R1, R0)
 
 
 def test_stagec_mesh_sharded_bit_exact():
@@ -361,6 +828,7 @@ def test_stagec_gauges_in_exposition():
     exposition after a stage-compiled run."""
     from parsec_tpu.obs import parse_exposition
 
+    _clear_stage_cache()   # a warm AOT cache would leave compiles at 0
     with params.cmdline_override("stage_compile", "1"):
         ctx = parsec_tpu.Context(nb_cores=2)
         try:
@@ -379,6 +847,11 @@ def test_stagec_gauges_in_exposition():
     assert vals.get("parsec_stagec_stage_compiles", 0) > 0, vals
     assert vals.get("parsec_stagec_stage_fallbacks", -1) == 0, vals
     assert vals.get("parsec_stagec_stage_compile_us", 0) > 0, vals
+    # ISSUE 13 gauges ride the same registry
+    assert vals.get("parsec_stagec_prestage_hits", -1) >= 0, vals
+    assert vals.get("parsec_stagec_chain_links", -1) == 0, vals
+    assert vals.get("parsec_stagec_chain_fallbacks", -1) == 0, vals
+    assert vals.get("parsec_stagec_residue_batches", -1) == 0, vals
 
 
 def test_stagec_lock_discipline_enforced():
@@ -407,7 +880,9 @@ def test_stagec_lock_discipline_enforced():
 
 def test_stagec_lint_lower_report_cli():
     """tools/parsec_lint.py --lower-report prints the per-class
-    verdicts for shipped specs and exits 0 (informational)."""
+    verdicts, the per-STAGE partition, and — for multi-spec files —
+    the chain verdicts for shipped specs, and exits 0
+    (informational)."""
     import importlib.util
     import io
     import os
@@ -428,3 +903,96 @@ def test_stagec_lint_lower_report_cli():
     out = buf.getvalue()
     assert rc == 0
     assert "POTRF: compilable" in out and "GEMM: compilable" in out
+    # per-stage verdicts (ISSUE 13): the partition of a toy instance
+    assert "stage#0:" in out, out
+    assert "stage(s) covering" in out, out
+
+    # a multi-spec file additionally gets chain verdicts: dtrsm's
+    # FWD ; BWD is fully fusable (shared descL/descB, memory-fed
+    # first stage)
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = mod.main(["--lower-report",
+                       os.path.join(root, "parsec_tpu", "ops",
+                                    "dtrsm.py"), "-q"])
+    out = buf.getvalue()
+    assert rc == 0
+    assert "chain FWD_JDF -> BWD_JDF: fusable" in out, out
+
+
+def test_stagec_lint_lower_report_chain_rejection_reason():
+    """--lower-report prints the chain-rejection REASON when two pools
+    fail to fuse (ISSUE 13 satellite): a second spec whose first stage
+    awaits task activations (its compilable class is fed by a
+    host-bodied producer) cannot chain."""
+    import importlib.util
+    import io
+    import os
+    import sys
+    from contextlib import redirect_stdout
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "_parsec_lint_test2",
+        os.path.join(root, "tools", "parsec_lint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["_parsec_lint_test2"] = mod
+    spec.loader.exec_module(mod)
+
+    unfusable = '''
+A_JDF = """
+descA [ type="collection" ]
+
+Gen(k)
+k = 0 .. 3
+: descA( k, 0 )
+RW A <- descA( k, 0 )
+     -> descA( k, 0 )
+BODY [type=tpu]
+{
+    A = A + 1.0
+}
+END
+"""
+
+B_JDF = """
+descA [ type="collection" ]
+
+Host(k)
+k = 0 .. 3
+: descA( k, 0 )
+RW A <- descA( k, 0 )
+     -> A Use( k )
+     -> descA( k, 0 )
+BODY
+{
+    A[...] = np.asarray(A) * 2.0
+}
+END
+
+Use(k)
+k = 0 .. 3
+: descA( k, 0 )
+READ A <- A Host( k )
+BODY [type=tpu]
+{
+    A = A * 1.0
+}
+END
+"""
+'''
+    import tempfile
+    with tempfile.NamedTemporaryFile("w", suffix=".py",
+                                     delete=False) as fh:
+        fh.write(unfusable)
+        path = fh.name
+    try:
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            rc = mod.main(["--lower-report", path, "-q"])
+        out = buf.getvalue()
+        assert rc == 0
+        assert "chain A_JDF -> B_JDF: rejected" in out, out
+        assert "awaits" in out and "activation" in out, out
+    finally:
+        os.unlink(path)

@@ -155,21 +155,54 @@ def run(paths: List[str], cycles: bool = True,
     return findings
 
 
+def _toy_taskpool(text: str, name: str, shared_colls: Dict[str, Any]):
+    """A small concrete instantiation of one spec for per-stage
+    planning (the dagenum enumeration env): int globals bind to the
+    tile count, collection globals bind to dummy 4x4 holders SHARED by
+    name across the file's specs — the chain planner proves dataflow
+    by collection IDENTITY, exactly as sequential pools share real
+    collections (dpotrf's descA is dtrsm's descL)."""
+    from parsec_tpu.analysis.ptg_check import (_load_dagenum,
+                                               default_enum_env)
+    from parsec_tpu.dsl import ptg
+    dagenum = _load_dagenum()
+    factory = ptg.compile_jdf(text, name=name)
+    env = default_enum_env(factory.jdf)
+    for g in factory.jdf.globals:
+        if g.properties.get("type") == "collection":
+            env[g.name] = shared_colls.setdefault(
+                g.name, dagenum._DummyCollection(4, 4))
+    return factory.new(**env)
+
+
 def lower_report_main(paths: List[str], quiet: bool = False) -> int:
-    """``--lower-report``: the stage compiler's per-task-class
-    lowerability verdicts (stagec/plan.class_verdicts — the SAME pass
-    the runtime partitions with, so what this prints is what
-    ``stage_compile`` will and won't fuse) over every ``*_JDF`` spec in
-    the targets.  Exit 0 always: the report is informational — residue
-    classes run interpreted, they are not an error."""
+    """``--lower-report``: the stage compiler's verdicts (stagec/plan —
+    the SAME passes the runtime partitions with, so what this prints is
+    what ``stage_compile`` will and won't fuse) over every ``*_JDF``
+    spec in the targets:
+
+    - per-CLASS lowerability (compilable / fallback + the reason);
+    - per-STAGE partition of a small concrete instantiation (stage
+      sizes, level spans, class mix, residue split + pre-planned
+      residue groups);
+    - for files holding several specs, the CHAIN verdict of each
+      consecutive pair — fusable, or the chain-rejection reason two
+      pools fail to fuse for (stagec/chain.boundary_verdict).
+
+    Exit 0 always: the report is informational — residue classes run
+    interpreted, unchained pools flush between stages; neither is an
+    error."""
     from parsec_tpu.dsl.ptg.parser import JDFParseError, parse_jdf
-    from parsec_tpu.stagec.plan import lower_report
+    from parsec_tpu.stagec.plan import lower_report, plan_stages, \
+        stage_report
 
     files, _lock_targets = collect_spec_files(paths)
     n_specs = 0
     for path in files:
         rel = os.path.relpath(path, _ROOT) if path.startswith(_ROOT) \
             else path
+        shared_colls: Dict[str, Any] = {}
+        planned = []   # [(spec_name, tp, StagePlan)] for chain verdicts
         for spec_name, _lineno, text in find_jdf_specs(path):
             n_specs += 1
             try:
@@ -179,9 +212,56 @@ def lower_report_main(paths: List[str], quiet: bool = False) -> int:
                 continue
             for line in lower_report(jdf):
                 print(line)
+            try:
+                tp = _toy_taskpool(text, spec_name, shared_colls)
+                plan = _prepared_toy_plan(tp)
+                for line in stage_report(tp, plan=plan):
+                    print(line)
+                planned.append((spec_name, tp, plan))
+            except Exception as exc:  # noqa: BLE001 - informational
+                print(f"  (stage partition not enumerable: "
+                      f"{type(exc).__name__}: {exc})")
+        # chain verdicts over consecutive specs of the same file (the
+        # declared-sequence analog: dtrsm.py's FWD ; BWD), walking the
+        # SAME cumulative segments declare_chain builds — a boundary is
+        # proven against every pool already fused into the segment, so
+        # the report cannot claim a cascade the runtime would reject
+        from parsec_tpu.stagec.chain import boundary_verdict
+        seg = []   # [(tp, plan, in-program stage)], host first
+        for (na, tpa, pa), (nb_, tpb, pb) in zip(planned, planned[1:]):
+            if not seg:
+                if pa is None or not pa.stages:
+                    print(f"  chain {na} -> {nb_}: rejected — no "
+                          f"compilable final stage in the earlier pool")
+                    continue
+                seg = [(tpa, pa, pa.stages[-1])]
+            reason = boundary_verdict(seg, tpb, pb)
+            if reason is None:
+                print(f"  chain {na} -> {nb_}: fusable "
+                      f"(one chained program)")
+                if len(pb.stages) == 1:
+                    seg.append((tpb, pb, pb.stages[0]))
+                else:
+                    seg = []   # segment ends; next pool hosts anew
+            else:
+                print(f"  chain {na} -> {nb_}: rejected — {reason}")
+                seg = []
     if not quiet:
         print(f"parsec_lint --lower-report: {n_specs} spec(s)")
     return 0
+
+
+def _prepared_toy_plan(tp):
+    """plan_stages + layouts for the chain verdict (mirrors the
+    runtime's prepared_plan without the process-wide cache — toy pools
+    are throwaway)."""
+    from parsec_tpu.stagec.lower import build_layout
+    from parsec_tpu.stagec.plan import plan_stages
+    plan = plan_stages(tp)
+    for stage in plan.stages:
+        layout = build_layout(tp, plan, stage)
+        plan.prepared.append((stage, layout, 0))
+    return plan
 
 
 def main(argv=None) -> int:
