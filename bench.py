@@ -48,6 +48,12 @@ Execution modes (BENCH_MODE):
   bit-exactness gated); reports both GFLOP/s and the speedup.
 - ``geqrf``: the second workload — runtime-path tile QR (dgeqrf) with
   the ``R^T R == A^T A`` residual, so it stops rotting silently.
+- ``qwire``: quantized wire codecs (ISSUE 14) — the SAME 2-rank
+  classic-runtime dpotrf over real loopback TCP on a throttled link,
+  lossless vs blockwise-bf16 vs int8-with-scale (scrubbed CPU
+  subprocess); reports wall, payload bytes on the wire, per-link
+  labeled reduction ratios, residual per leg, and the knob-unset
+  bit-identity differential.
 
 Every record carries ``schema_version`` + stable ``metric_id``/``mode``
 /``n``/``nb``/``dtype`` fields (schema 2): r01-r05 changed metric
@@ -731,6 +737,12 @@ def bench_all(n, nb, reps, cores, dtype):
         ov = _try("overlap", lambda: bench_overlap())
         if ov is not None:
             extras.update(ov)
+    # quantized wire codecs (ISSUE 14): throttled-TCP dpotrf, lossless
+    # vs bf16 vs int8 — scrubbed CPU subprocess, link-independent
+    if os.environ.get("BENCH_QWIRE", "1") != "0":
+        qw = _try("qwire", lambda: bench_qwire())
+        if qw is not None:
+            extras.update(qw)
     # compiled-stage vs interpreted runtime (ISSUE 12): scrubbed CPU
     # subprocess, link-independent — rides every record
     if os.environ.get("BENCH_STAGEC", "1") != "0":
@@ -802,6 +814,26 @@ print(json.dumps({"turbo_s": float(turbo_s), "classic_s": float(classic_s),
 """
 
 
+def _scrubbed_bench_env(n_devices=None, **extra) -> dict:
+    """Whitelist-constructed env for a scrubbed CPU bench subprocess:
+    only the XLA host platform exists, whatever jax/plugin state the
+    calling process carries (pre-imported jax, initialized axon
+    backend, JAX_PLATFORMS=axon). ONE copy — every subprocess bench
+    rides it, so a scrub-policy change lands everywhere at once.
+    ``n_devices`` sets the virtual CPU mesh size; ``extra`` entries
+    (stringified) ride on top."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    keep = ("PATH", "HOME", "LANG", "LC_ALL", "TMPDIR", "USER")
+    env = {k: os.environ[k] for k in keep if k in os.environ}
+    env.update(JAX_PLATFORMS="cpu", PYTHONPATH=repo, BENCH_REPO=repo,
+               PARSEC_MCA_device_tpu_platform="cpu")
+    if n_devices is not None:
+        env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count="
+                            f"{n_devices}")
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
 def bench_engine_cpu() -> dict:
     """Link-free engine comparison: turbo vs classic per-task dispatch
     on the XLA host (CPU) backend in a scrubbed subprocess — the same
@@ -817,11 +849,7 @@ def bench_engine_cpu() -> dict:
 
     if os.environ.get("BENCH_ENGINE_CPU", "1") == "0":
         return {}
-    repo = os.path.dirname(os.path.abspath(__file__))
-    keep = ("PATH", "HOME", "LANG", "LC_ALL", "TMPDIR", "USER")
-    env = {k: os.environ[k] for k in keep if k in os.environ}
-    env.update(JAX_PLATFORMS="cpu", PYTHONPATH=repo, BENCH_REPO=repo,
-               PARSEC_MCA_device_tpu_platform="cpu")
+    env = _scrubbed_bench_env()
     try:
         p = subprocess.run([_sys.executable, "-c", _ENGINE_CPU_DRIVER],
                            env=env, capture_output=True, text=True,
@@ -1384,15 +1412,10 @@ def bench_mesh(burst=64, nb=96, reps=3, shape="2x2") -> dict:
 
     gp, gq = (int(x) for x in (shape.split("x") if "x" in shape
                                else ("1", shape)))
-    repo = os.path.dirname(os.path.abspath(__file__))
-    keep = ("PATH", "HOME", "LANG", "LC_ALL", "TMPDIR", "USER")
-    env = {k: os.environ[k] for k in keep if k in os.environ}
-    env.update(JAX_PLATFORMS="cpu", PYTHONPATH=repo, BENCH_REPO=repo,
-               PARSEC_MCA_device_tpu_platform="cpu",
-               XLA_FLAGS="--xla_force_host_platform_device_count="
-                         f"{max(8, gp * gq)}",
-               BENCH_MESH_BURST=str(burst), BENCH_MESH_NB=str(nb),
-               BENCH_REPS=str(reps), BENCH_MESH_SHAPE=shape)
+    env = _scrubbed_bench_env(
+        n_devices=max(8, gp * gq),
+        BENCH_MESH_BURST=burst, BENCH_MESH_NB=nb,
+        BENCH_REPS=reps, BENCH_MESH_SHAPE=shape)
     try:
         p = subprocess.run([_sys.executable, "-c", _MESH_DRIVER],
                            env=env, capture_output=True, text=True,
@@ -1660,15 +1683,10 @@ def bench_overlap(n=768, nb=64, ranks=2, delay_ms=8) -> dict:
     import subprocess
     import sys as _sys
 
-    repo = os.path.dirname(os.path.abspath(__file__))
-    keep = ("PATH", "HOME", "LANG", "LC_ALL", "TMPDIR", "USER")
-    env = {k: os.environ[k] for k in keep if k in os.environ}
-    env.update(JAX_PLATFORMS="cpu", PYTHONPATH=repo, BENCH_REPO=repo,
-               PARSEC_MCA_device_tpu_platform="cpu",
-               XLA_FLAGS="--xla_force_host_platform_device_count=2",
-               BENCH_OVERLAP_N=str(n), BENCH_OVERLAP_NB=str(nb),
-               BENCH_OVERLAP_RANKS=str(ranks),
-               BENCH_OVERLAP_DELAY_MS=str(delay_ms))
+    env = _scrubbed_bench_env(
+        n_devices=2,
+        BENCH_OVERLAP_N=n, BENCH_OVERLAP_NB=nb,
+        BENCH_OVERLAP_RANKS=ranks, BENCH_OVERLAP_DELAY_MS=delay_ms)
     try:
         p = subprocess.run([_sys.executable, "-c", _OVERLAP_DRIVER],
                            env=env, capture_output=True, text=True,
@@ -1678,6 +1696,161 @@ def bench_overlap(n=768, nb=64, ranks=2, delay_ms=8) -> dict:
         return json.loads(p.stdout.strip().splitlines()[-1])
     except Exception as exc:  # noqa: BLE001
         return {"overlap_error": repr(exc)[:200]}
+
+
+# ---------------------------------------------------------------------- #
+# quantized-wire benchmark (ISSUE 14): throttled-link dpotrf over REAL   #
+# TCP sockets, lossless vs bf16 vs int8 wire codecs                      #
+# ---------------------------------------------------------------------- #
+def bench_qwire_inner(n=256, nb=64, delay_ms=2, chunk_bytes=8192) -> dict:
+    """BENCH_MODE=qwire payload: the SAME 2-rank classic-runtime dpotrf
+    over REAL loopback TCP sockets on a throttled link (every message
+    pays an injected ``delay_ms`` sleep), once per wire codec leg —
+    lossless (``comm_quantize`` unset), blockwise bf16, and
+    int8-with-per-block-scale. Reports per leg: wall, payload bytes on
+    the wire (chunked bulk bytes — what the codec shrinks), the
+    per-link labeled reduction ratio, and the factor's relative
+    residual vs numpy. The lossless leg runs TWICE and its tiles are
+    compared BIT-FOR-BIT — the knob-unset differential the acceptance
+    gate rides (quantization off must change nothing)."""
+    import concurrent.futures as cf
+    from contextlib import ExitStack
+
+    import parsec_tpu
+    from parsec_tpu.collections import TwoDimBlockCyclic
+    from parsec_tpu.comm import RemoteDepEngine
+    from parsec_tpu.comm.tcp import TCPCommEngine, free_ports
+    from parsec_tpu.ops import dpotrf_taskpool, make_spd
+    from parsec_tpu.utils.params import params as _params
+
+    ranks = 2
+    M = make_spd(n, dtype=np.float32)
+
+    def run_once(codec):
+        overrides = {
+            "comm_chunk_bytes": str(chunk_bytes),
+            "comm_quantize": codec,
+            "comm_mesh_local": "0",   # payloads must ride the wire
+            "ft_inject": f"delay:pct=100:ms={delay_ms}",
+        }
+        ports = free_ports(ranks)
+        eps = [("127.0.0.1", p) for p in ports]
+        with ExitStack() as st:
+            for k, v in overrides.items():
+                st.enter_context(_params.cmdline_override(k, v))
+
+            def rank_fn(r):
+                ce = TCPCommEngine(r, eps)
+                eng = RemoteDepEngine(ce)
+                ctx = parsec_tpu.Context(nb_cores=1, comm=eng)
+                try:
+                    t0 = time.perf_counter()
+                    coll = TwoDimBlockCyclic(
+                        n, n, nb, nb, dtype=np.float32,
+                        P=ranks, Q=1, nodes=ranks, rank=r)
+                    coll.name = "descA"
+                    coll.from_numpy(M.copy())
+                    tp = dpotrf_taskpool(coll, rank=r, nb_ranks=ranks)
+                    ctx.add_taskpool(tp)
+                    ctx.wait()
+                    wall = time.perf_counter() - t0
+                    peer = (r + 1) % ranks
+                    stats = {
+                        "wall": wall,
+                        "chunk_bytes": ce.wire_stats["chunk_bytes_sent"],
+                        "bytes_prequant":
+                            ce.wire_stats["bytes_prequant"],
+                        "bytes_postquant":
+                            ce.wire_stats["bytes_postquant"],
+                        "bufs_quantized":
+                            ce.wire_stats["bufs_quantized"],
+                        "codec_ratio": (
+                            ce.codec_ratio(peer, "q" + codec)
+                            if codec else 1.0),
+                    }
+                    owned = {c: np.asarray(
+                        coll.data_of(*c).sync_to_host().payload)
+                        for c in coll.tiles() if coll.rank_of(*c) == r}
+                    return stats, owned
+                finally:
+                    ctx.fini()
+
+            with cf.ThreadPoolExecutor(ranks) as ex:
+                results = list(ex.map(rank_fn, range(ranks)))
+        tiles = {}
+        for (_s, owned) in results:
+            tiles.update(owned)
+        L = np.zeros((n, n), np.float32)
+        for (tm, tk), t in tiles.items():
+            L[tm * nb:tm * nb + t.shape[0],
+              tk * nb:tk * nb + t.shape[1]] = t
+        Lt = np.tril(L).astype(np.float64)
+        resid = float(np.abs(Lt @ Lt.T - M).max() / np.abs(M).max())
+        agg = {
+            "wall_s": round(max(s["wall"] for s, _t in results), 3),
+            "wire_payload_bytes": sum(s["chunk_bytes"]
+                                      for s, _t in results),
+            "bytes_prequant": sum(s["bytes_prequant"]
+                                  for s, _t in results),
+            "bytes_postquant": sum(s["bytes_postquant"]
+                                   for s, _t in results),
+            "bufs_quantized": sum(s["bufs_quantized"]
+                                  for s, _t in results),
+            "codec_ratios": [s["codec_ratio"] for s, _t in results],
+            "residual": resid,
+        }
+        return agg, tiles
+
+    out = {"qwire_n": n, "qwire_nb": nb, "qwire_ranks": ranks,
+           "qwire_link_delay_ms": delay_ms,
+           "qwire_chunk_bytes": chunk_bytes}
+    base, tiles_a = run_once("")
+    _base2, tiles_b = run_once("")
+    out["qwire_unset_bit_identical"] = bool(
+        set(tiles_a) == set(tiles_b)
+        and all((tiles_a[c] == tiles_b[c]).all() for c in tiles_a))
+    out.update({f"lossless_{k}": v for k, v in base.items()})
+    for codec in ("bf16", "int8"):
+        leg, _tiles = run_once(codec)
+        out.update({f"{codec}_{k}": v for k, v in leg.items()})
+        out[f"{codec}_bytes_vs_lossless"] = round(
+            leg["wire_payload_bytes"]
+            / max(1, base["wire_payload_bytes"]), 4)
+    return out
+
+
+_QWIRE_DRIVER = r"""
+import json, os, sys
+sys.path.insert(0, os.environ["BENCH_REPO"])
+import bench
+
+print(json.dumps(bench.bench_qwire_inner(
+    n=int(os.environ.get("BENCH_QWIRE_N", "256")),
+    nb=int(os.environ.get("BENCH_QWIRE_NB", "64")),
+    delay_ms=int(os.environ.get("BENCH_QWIRE_DELAY_MS", "2")))))
+"""
+
+
+def bench_qwire(n=256, nb=64, delay_ms=2) -> dict:
+    """BENCH_MODE=qwire: the quantized-wire legs in a scrubbed CPU
+    subprocess (same pattern as bench_overlap: numbers must not depend
+    on the tunnel session's TPU plugin)."""
+    import subprocess
+    import sys as _sys
+
+    env = _scrubbed_bench_env(
+        n_devices=2,
+        BENCH_QWIRE_N=n, BENCH_QWIRE_NB=nb,
+        BENCH_QWIRE_DELAY_MS=delay_ms)
+    try:
+        p = subprocess.run([_sys.executable, "-c", _QWIRE_DRIVER],
+                           env=env, capture_output=True, text=True,
+                           timeout=1200)
+        if p.returncode != 0:
+            return {"qwire_error": p.stdout[-200:] + p.stderr[-200:]}
+        return json.loads(p.stdout.strip().splitlines()[-1])
+    except Exception as exc:  # noqa: BLE001
+        return {"qwire_error": repr(exc)[:200]}
 
 
 # ---------------------------------------------------------------------- #
@@ -2006,13 +2179,8 @@ def bench_stagec(n=768, nb=64, reps=3) -> dict:
     import subprocess
     import sys as _sys
 
-    repo = os.path.dirname(os.path.abspath(__file__))
-    keep = ("PATH", "HOME", "LANG", "LC_ALL", "TMPDIR", "USER")
-    env = {k: os.environ[k] for k in keep if k in os.environ}
-    env.update(JAX_PLATFORMS="cpu", PYTHONPATH=repo, BENCH_REPO=repo,
-               PARSEC_MCA_device_tpu_platform="cpu",
-               BENCH_STAGEC_N=str(n), BENCH_STAGEC_NB=str(nb),
-               BENCH_REPS=str(reps))
+    env = _scrubbed_bench_env(
+        BENCH_STAGEC_N=n, BENCH_STAGEC_NB=nb, BENCH_REPS=reps)
     try:
         p = subprocess.run([_sys.executable, "-c", _STAGEC_DRIVER],
                            env=env, capture_output=True, text=True,
@@ -2130,6 +2298,17 @@ def main() -> None:
             "metric": "overlap_fraction_gain(throttled_link,on_vs_off)",
             "metric_id": "overlap_fraction_gain", "mode": mode,
             "value": extras.get("overlap_gain", -1.0),
+            "unit": "fraction", "extras": extras})
+        return
+    if mode == "qwire":
+        extras = bench_qwire(
+            n=int(os.environ.get("BENCH_QWIRE_N", "256")),
+            nb=int(os.environ.get("BENCH_QWIRE_NB", "64")),
+            delay_ms=int(os.environ.get("BENCH_QWIRE_DELAY_MS", "2")))
+        emit_json({
+            "metric": "qwire_int8_bytes_vs_lossless(throttled_tcp_dpotrf)",
+            "metric_id": "qwire_int8_bytes_vs_lossless", "mode": mode,
+            "value": extras.get("int8_bytes_vs_lossless", -1.0),
             "unit": "fraction", "extras": extras})
         return
     if mode == "dispatch":

@@ -130,6 +130,11 @@ def redistribute(source: TiledMatrix, target: TiledMatrix,
             "or an existing taskpool to compose into")
     tp = taskpool if taskpool is not None else dtd.taskpool_new(
         name=f"redistribute_{source.lm}x{source.ln}")
+    # redistribution is pure data MOVEMENT — checkpoint-reshard restores
+    # (ft/elastic.py) ride it and must land bit-identical, so its wire
+    # traffic is never eligible for the lossy quantized codecs
+    # (comm/remote_dep.py consults this mark per flow)
+    tp.wire_lossless = True
     own = taskpool is None
     if own and context is not None:
         context.add_taskpool(tp)

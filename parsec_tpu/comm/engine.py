@@ -48,17 +48,26 @@ class RankFailedError(RuntimeError):
 
 class MemHandle:
     """Registered memory region handle (ref: parsec_ce_mem_reg_handle_t —
-    wraps {ptr, count, datatype}); here it wraps a host array + metadata."""
+    wraps {ptr, count, datatype}); here it wraps a host array + metadata.
+
+    ``quantize_ok`` is the registrant's per-flow eligibility mark for
+    the lossy quantized wire codecs (ISSUE 14): True only for device-
+    array TILE payloads (PTG/DTD rendezvous snapshots); checkpoint
+    shards and anything else stay lossless. The GET reply propagates it
+    so the transport may quantize the bulk buffer toward peers that
+    negotiated a codec."""
 
     _iter = 0
     _lock = threading.Lock()
 
-    def __init__(self, array: Any, meta: Any = None) -> None:
+    def __init__(self, array: Any, meta: Any = None,
+                 quantize_ok: bool = False) -> None:
         with MemHandle._lock:
             MemHandle._iter += 1
             self.handle_id = MemHandle._iter
         self.array = array
         self.meta = meta
+        self.quantize_ok = bool(quantize_ok)
 
 
 class CommEngine:
@@ -361,8 +370,9 @@ class CommEngine:
                     src, rtt=(time.monotonic_ns() - payload["t"]) / 1e9)
 
     # -- registered memory + one-sided emulation ----------------------------
-    def mem_register(self, array: Any, meta: Any = None) -> MemHandle:
-        h = MemHandle(array, meta)
+    def mem_register(self, array: Any, meta: Any = None,
+                     quantize_ok: bool = False) -> MemHandle:
+        h = MemHandle(array, meta, quantize_ok=quantize_ok)
         self._mem[h.handle_id] = h
         return h
 

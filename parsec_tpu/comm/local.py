@@ -171,14 +171,22 @@ class LocalCommEngine(CommEngine):
     def _on_get_req(self, src: int, payload: Any) -> None:
         req = payload["requester"]
         items = []
+        quantize_ok = True
         for handle_id, token in payload["gets"]:
             h = self._mem.get(handle_id)
             assert h is not None, f"GET for unknown mem handle {handle_id}"
+            quantize_ok = quantize_ok and getattr(h, "quantize_ok", False)
             items.append({"token": token,
                           "data": self._serve_get(req, h),
                           "meta": h.meta})
-        # every same-cycle GET from one requester rides ONE reply frame
-        self.send_am(req, TAG_GET_DATA, {"items": items})
+        # every same-cycle GET from one requester rides ONE reply frame;
+        # the reply is quantize-eligible (ISSUE 14) only when EVERY
+        # served handle was registered as a tile payload — one lossless
+        # shard in the batch keeps the whole frame lossless
+        msg = {"items": items}
+        if items and quantize_ok:
+            msg["_qz_ok"] = True
+        self.send_am(req, TAG_GET_DATA, msg)
         if self.on_get_served is not None:
             for handle_id, _token in payload["gets"]:
                 self.on_get_served(handle_id)

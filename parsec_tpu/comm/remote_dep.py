@@ -220,6 +220,25 @@ class RemoteDepEngine:
         self.ce.fini()
 
     # ------------------------------------------------------------------ #
+    # quantized-wire eligibility (ISSUE 14)                              #
+    # ------------------------------------------------------------------ #
+    @staticmethod
+    def _quantize_eligible(tp, arr) -> bool:
+        """Per-flow eligibility for the lossy quantized wire codecs:
+        only FLOAT tile payloads of pools that did not declare
+        themselves lossless (``tp.wire_lossless`` — set by the
+        checkpoint-reshard redistribute pools, whose shards must land
+        bit-identical). Control AMs never reach this; non-float data
+        is excluded at the transport too (belt and braces)."""
+        if arr is None or getattr(tp, "wire_lossless", False):
+            return False
+        dt = getattr(arr, "dtype", None)
+        try:
+            return dt is not None and np.dtype(dt).kind == "f"
+        except TypeError:  # pragma: no cover - exotic dtype object
+            return False
+
+    # ------------------------------------------------------------------ #
     # adaptive eager/rendezvous cutoff                                   #
     # ------------------------------------------------------------------ #
     _RTT_ALPHA = 0.2
@@ -297,6 +316,11 @@ class RemoteDepEngine:
                 "src_task": getattr(task, "locals", None),
                 "dtt": (flow_dtts or {}).get(out_idx),
             }
+            if self._quantize_eligible(tp, payload_arr):
+                # tile payload: the transport MAY lossily quantize its
+                # bulk buffers toward peers that negotiated a codec
+                # (comm_quantize; the mark also rides bcast forwards)
+                msg["_qz_ok"] = True
             plane = getattr(self.ce, "device_plane", None)
             # the message reaches every participant: the cutoff must be
             # agreeable to all of them — take the most conservative
@@ -340,7 +364,9 @@ class RemoteDepEngine:
                 # chunked path may send it zero-copy.
                 snap = np.array(payload_arr)
                 snap.setflags(write=False)
-                handle = self.ce.mem_register(snap)
+                handle = self.ce.mem_register(
+                    snap, quantize_ok=self._quantize_eligible(
+                        tp, payload_arr))
                 # every non-root participant eventually GETs from the root
                 tp.add_pending_action(1)
                 self._pending_handles[handle.handle_id] = (tp, len(ranks), handle)
@@ -634,10 +660,12 @@ class RemoteDepEngine:
         """arr=None is a release-only notification: the owner counted
         this edge but the producing flow carried no data copy — retire
         the pending action without writing."""
-        self.ce.send_am(dst, TAG_MEM_PUT,
-                        {"tp_id": tp.comm_tp_id, "coll": coll_name,
-                         "args": tuple(args),
-                         "data": None if arr is None else np.asarray(arr)})
+        msg = {"tp_id": tp.comm_tp_id, "coll": coll_name,
+               "args": tuple(args),
+               "data": None if arr is None else np.asarray(arr)}
+        if self._quantize_eligible(tp, arr):
+            msg["_qz_ok"] = True   # tile writeback: may quantize
+        self.ce.send_am(dst, TAG_MEM_PUT, msg)
         self.stats["mem_puts_sent"] += 1
 
     def counts_ready(self, tp) -> None:
@@ -686,6 +714,8 @@ class RemoteDepEngine:
         obs = self.ce._obs
         t0 = time.monotonic_ns() if obs is not None else 0
         msg = {"tp_id": tp.comm_tp_id, "tile": tile_key, "seq": seq}
+        if self._quantize_eligible(tp, arr):
+            msg["_qz_ok"] = True   # DTD tile payload: may quantize
         nbytes = getattr(arr, "nbytes", 0)
         mesh_local = (self._mesh_local and _is_device_array(arr)
                       and self.ce.mesh_local_with(dst))
@@ -706,7 +736,8 @@ class RemoteDepEngine:
                 snap.setflags(write=False)
             else:
                 snap = arr
-            handle = self.ce.mem_register(snap)
+            handle = self.ce.mem_register(
+                snap, quantize_ok=self._quantize_eligible(tp, arr))
             tp.add_pending_action(1)
             with self._lock:
                 self._pending_handles[handle.handle_id] = (tp, 1, handle)

@@ -129,6 +129,16 @@ _GUARDED_BY = {
     # sender parked in backpressure, and the reconnector — all under
     # the peer's condition (resume swaps threads only after the old
     # generation has exited, but the STATE handoff itself is locked)
+    # quantized wire codecs (ISSUE 14): the negotiated lossy codec and
+    # the per-peer per-codec byte accounting feeding the labeled
+    # COMPRESS_RATIO gauges — written by the enqueuing sender thread
+    # (quantize) and the writer thread (compress), read by the obs
+    # poll, all under the peer's condition
+    "_Peer.qz_codec": "cond",
+    "_Peer.q_pre": "cond",
+    "_Peer.q_post": "cond",
+    "_Peer.comp_pre": "cond",
+    "_Peer.comp_post": "cond",
     "_Peer.suspect": "cond",
     "_Peer.rs_epoch": "cond",
     "_Peer.rs_tx_seq": "cond",
@@ -205,7 +215,8 @@ class _Peer:
                  "rs_rx_seq", "rs_window", "rs_window_bytes", "rs_replay",
                  "rs_rx_unacked_frames", "rs_rx_unacked_bytes",
                  "rs_rx_partial", "rx_xfers", "recv_thread", "rs_dup_next",
-                 "rs_resuming")
+                 "rs_resuming", "qz_codec", "q_pre", "q_post",
+                 "comp_pre", "comp_post")
 
     def __init__(self, rank: int, sock: socket.socket) -> None:
         self.rank = rank
@@ -222,6 +233,12 @@ class _Peer:
         self.engaged = False                   # compression live now
         self.frames = 0                        # frames sent (probe clock)
         self.probe_ratio: Optional[float] = None
+        # -- quantized wire codec (ISSUE 14) ----------------------------
+        self.qz_codec: Optional[str] = None    # negotiated at HELLO
+        self.q_pre = 0             # raw bytes of quantized buffers
+        self.q_post = 0            # encoded bytes actually queued
+        self.comp_pre = 0          # per-peer lossless codec accounting
+        self.comp_post = 0
         self.hb_ok = False         # HELLO advertised heartbeat support
         self.el_ok = False         # HELLO advertised elastic membership
         # -- reliable session (ISSUE 10) --------------------------------
@@ -269,7 +286,9 @@ class TCPCommEngine(LocalCommEngine):
                  compress_threshold_mbps: Optional[float] = None,
                  reconnect_timeout: Optional[float] = None,
                  reconnect_backoff: Optional[float] = None,
-                 replay_window_bytes: Optional[int] = None) -> None:
+                 replay_window_bytes: Optional[int] = None,
+                 quantize: Optional[str] = None,
+                 quantize_threshold_mbps: Optional[float] = None) -> None:
         from ..utils.params import params
         self._inbox: Fifo = Fifo()
         self._peers: Dict[int, _Peer] = {}
@@ -319,6 +338,19 @@ class TCPCommEngine(LocalCommEngine):
         #: sender's replay window drains well before it fills
         self._ack_bytes = max(1, min(1 << 18, self.replay_window_bytes // 4))
         self._suspect_ms_total = 0.0
+        # quantized wire codecs (ISSUE 14): lossy blockwise encodings
+        # for bulk float tile payloads the sender layer marked eligible
+        # (per-flow ``_qz_ok``) — engaged per link only toward peers
+        # whose HELLO advertised the codec under "qz" (both ends must
+        # set the knob; the advertisement itself is gated so an unset
+        # knob leaves every wire byte, HELLO included, unchanged)
+        if quantize is None:
+            quantize = str(params.get("comm_quantize") or "")
+        self._quantize = wire.normalize_quant_codec(quantize)
+        if quantize_threshold_mbps is None:
+            quantize_threshold_mbps = params.get_or(
+                "comm_quantize_threshold_mbps", "int", 0)
+        self.quantize_threshold_mbps = float(quantize_threshold_mbps or 0)
         self._codecs = wire.available_codecs()
         #: wire fast-path counters (plain dict: obs polls it when
         #: telemetry is on, nothing on the hot path otherwise)
@@ -327,6 +359,10 @@ class TCPCommEngine(LocalCommEngine):
             "batches": 0, "chunks_sent": 0, "chunk_bytes_sent": 0,
             "frames_compressed": 0, "bytes_precompress": 0,
             "bytes_postcompress": 0, "msgs_chunked": 0,
+            # quantized-codec counters (ISSUE 14): raw vs encoded bytes
+            # of lossy-encoded bulk buffers (the labeled COMPRESS_RATIO
+            # gauges ride the per-peer twins of these)
+            "bufs_quantized": 0, "bytes_prequant": 0, "bytes_postquant": 0,
             # reliable-session counters (RECONNECTS / REPLAYED_FRAMES /
             # DUP_DROPPED gauges ride these)
             "reconnects": 0, "replayed_frames": 0, "dup_dropped": 0,
@@ -425,12 +461,19 @@ class TCPCommEngine(LocalCommEngine):
         # never send one and stay on the uncompressed path); "rs" is
         # advertised only when reconnect sessions are enabled locally,
         # so a peer with the knob unset keeps fail-fast on BOTH ends
-        hello = wire.pack_hello({"ver": wire.WIRE_VERSION,
-                                 "rank": self.rank,
-                                 "codecs": self._codecs,
-                                 "hb": True,
-                                 "el": True,
-                                 "rs": self._rs_enabled})
+        info = {"ver": wire.WIRE_VERSION,
+                "rank": self.rank,
+                "codecs": self._codecs,
+                "hb": True,
+                "el": True,
+                "rs": self._rs_enabled}
+        if self._quantize is not None:
+            # quantized codecs are advertised ONLY when the local knob
+            # is set — symmetric like "rs", so a knob-unset build keeps
+            # every wire byte (this HELLO included) bit-for-bit, and a
+            # mixed-version peer (no "qz") negotiates down to lossless
+            info["qz"] = wire.available_quant_codecs()
+        hello = wire.pack_hello(info)
         with p.cond:
             p.ctrl.append(("frame", hello))
             p.queued_bytes += len(hello)
@@ -482,6 +525,58 @@ class TCPCommEngine(LocalCommEngine):
             pre = self.wire_stats["bytes_precompress"]
             post = self.wire_stats["bytes_postcompress"]
         return (post / pre) if pre else None
+
+    # -- quantized wire codecs (ISSUE 14) -------------------------------
+    def _quant_codec_for(self, peer: _Peer) -> Optional[str]:
+        """The quantized codec to apply toward ``peer`` right now:
+        None unless the HELLO negotiation succeeded (both knobs set,
+        codec common) AND the link sits below the bandwidth-EWMA
+        threshold (``comm_quantize_threshold_mbps``; 0 = engage
+        whenever the knob is set — the same per-link EWMA policy the
+        lossless compressor uses, with an always-on default because
+        the knob itself is the lossy opt-in)."""
+        with peer.cond:
+            codec = peer.qz_codec
+        if codec is None:
+            return None
+        thr = self.quantize_threshold_mbps
+        if thr:
+            bw = peer.bw_mbps
+            if bw is None or bw >= thr:
+                return None
+        return codec
+
+    def quantize_ratio(self) -> Optional[float]:
+        """Cumulative raw/encoded byte RATIO of quantized buffers
+        (> 1 = the wire moved fewer bytes; None: nothing quantized)."""
+        with self._stat_lock:
+            pre = self.wire_stats["bytes_prequant"]
+            post = self.wire_stats["bytes_postquant"]
+        return (pre / post) if post else None
+
+    def wire_codec_names(self):
+        """Every registered codec name (lossless + quantized) — the
+        label set of the per-peer COMPRESS_RATIO gauges."""
+        return sorted(wire.CODECS)
+
+    def codec_ratio(self, peer: int, codec: str) -> float:
+        """Per-link per-codec byte-reduction factor raw/encoded (the
+        labeled ``COMPRESS_RATIO::R<peer>::<codec>`` gauge): > 1 once
+        that codec engaged on the link, 1.0 while it has not (not
+        negotiated, threshold not crossed, or nothing sent yet)."""
+        with self._conn_cond:
+            p = self._peers.get(peer)
+        if p is None:
+            return 1.0
+        ent = wire.CODECS.get(codec)
+        with p.cond:
+            if ent is not None and not ent.lossless:
+                pre, post = ((p.q_pre, p.q_post)
+                             if p.qz_codec == codec else (0, 0))
+            else:
+                pre, post = ((p.comp_pre, p.comp_post)
+                             if p.codec == codec else (0, 0))
+        return round(pre / post, 4) if post else 1.0
 
     # -- reliable sessions (ISSUE 10) -----------------------------------
     def peer_suspect(self, peer: int) -> bool:
@@ -1009,17 +1104,56 @@ class TCPCommEngine(LocalCommEngine):
             self._xfer_iter += 1
             xid = (self.rank << 40) | self._xfer_iter
             self.wire_stats["msgs_chunked"] += 1
-        views = [v if v.nbytes < chunk or v.readonly
-                 else memoryview(bytes(v))  # snapshot mutable bulk now
-                 for v in views]
-        specs = [(v.nbytes >= chunk, v.nbytes,
-                  None if v.nbytes >= chunk else v) for v in views]
+        # quantized wire codec (ISSUE 14): a bulk FLOAT buffer of a
+        # message the sender layer marked eligible (``_qz_ok`` on the
+        # payload dict — tile payloads only; control AMs and lossless
+        # flows never carry the mark) encodes lossily HERE, at enqueue
+        # — before the K_SEQ envelope, so the replay window retains the
+        # encoded bytes and a post-flap replay stays bit-identical.
+        q_codec = self._quant_codec_for(peer) if (
+            isinstance(payload, dict) and payload.get("_qz_ok")) else None
+        qfmts = [None] * len(raw_bufs)
+        if q_codec is not None:
+            for i, b in enumerate(raw_bufs):
+                try:
+                    qfmts[i] = memoryview(b).format
+                except (BufferError, TypeError):  # pragma: no cover
+                    qfmts[i] = None
+        specs: list = []
+        chunked_views: Dict[int, Any] = {}
+        q_pre = q_post = q_bufs = 0
+        for bidx, v in enumerate(views):
+            if v.nbytes < chunk:
+                specs.append((0, v.nbytes, v))
+                continue
+            if q_codec is not None and qfmts[bidx] in ("d", "f"):
+                # fresh encoded bytes: immutable by construction, no
+                # snapshot needed whatever the source's writability
+                enc = memoryview(wire.quantize_buffer(
+                    v, qfmts[bidx], q_codec))
+                q_pre += v.nbytes
+                q_post += enc.nbytes
+                q_bufs += 1
+                specs.append((wire.BUF_CHUNKED | wire.BUF_QUANT,
+                              enc.nbytes, None))
+                chunked_views[bidx] = enc
+                continue
+            if not v.readonly:
+                v = memoryview(bytes(v))   # snapshot mutable bulk now
+            specs.append((wire.BUF_CHUNKED, v.nbytes, None))
+            chunked_views[bidx] = v
+        if q_pre:
+            with self._stat_lock:
+                self.wire_stats["bufs_quantized"] += q_bufs
+                self.wire_stats["bytes_prequant"] += q_pre
+                self.wire_stats["bytes_postquant"] += q_post
+            with peer.cond:
+                peer.q_pre += q_pre
+                peer.q_post += q_post
         hdr = wire.pack_xfer_hdr(xid, frame, specs)
         items = [("frame", hdr)]
         qbytes = len(hdr)
-        for bidx, v in enumerate(views):
-            if v.nbytes < chunk:
-                continue
+        for bidx, v in sorted(chunked_views.items()):
             for off in range(0, v.nbytes, chunk):
                 items.append(("chunk", xid, bidx, off,
                               v[off:off + chunk]))
@@ -1328,11 +1462,14 @@ class TCPCommEngine(LocalCommEngine):
                 return pieces
         if out is None:
             return pieces
+        post = sum(len(p) for p in out)
         with self._stat_lock:
             self.wire_stats["frames_compressed"] += 1
             self.wire_stats["bytes_precompress"] += len(body)
-            self.wire_stats["bytes_postcompress"] += \
-                sum(len(p) for p in out)
+            self.wire_stats["bytes_postcompress"] += post
+        with peer.cond:   # per-peer twin: the labeled ratio gauge
+            peer.comp_pre += len(body)
+            peer.comp_post += post
         return out
 
     # -- receive path ---------------------------------------------------
@@ -1471,6 +1608,11 @@ class TCPCommEngine(LocalCommEngine):
             p.hb_ok = bool(info.get("hb"))
             p.el_ok = bool(info.get("el"))
             with p.cond:
+                # quantize capability is symmetric like "rs": only a
+                # peer that advertised the requested codec under "qz"
+                # ever receives quantized buffers
+                p.qz_codec = wire.negotiate_quant_codec(
+                    self._quantize, info.get("qz", ()))
                 # session capability is SYMMETRIC: both ends must run
                 # with the knob set, or neither retains/replays
                 p.rs_ok = bool(info.get("rs")) and self._rs_enabled

@@ -32,6 +32,32 @@ body follows). Otherwise the body's first byte is a *kind*:
 - ``K_COMP``: a compressed *body* (kind byte included) of any of the
   above: ``<u8 codec_id> <u64 raw_len> <compressed>``. Only emitted
   toward peers that advertised the codec.
+
+Quantized tile codecs (the ``"qz"`` HELLO capability — ISSUE 14):
+LOSSY blockwise encodings for bulk float tile payloads, registered in
+the same ``CODECS`` table as the lossless byte codecs but with
+``lossless=False`` — they never ride ``K_COMP`` (a lossy transform of
+a pickled body would corrupt it). Instead they apply per BUFFER on the
+chunk lane: a pickle-5 out-of-band float buffer >= the chunk threshold
+may be encoded before it is announced in the transfer header, its
+bufspec flag gains the ``BUF_QUANT`` bit, the encoded bytes stream as
+normal ``K_CHUNK`` frames, and the receiver dequantizes the
+reassembled buffer back to the original dtype/length before the
+message unpickles — transparent to every handler. Because the
+encoding happens at ENQUEUE (before the K_SEQ envelope), the reliable
+session's replay window retains the encoded bytes and a post-flap
+replay is bit-identical for free. Only ever emitted toward peers whose
+HELLO advertised the codec under ``"qz"`` (both ends must enable
+``comm_quantize``); a mixed-version peer stays lossless.
+
+- ``qbf16``: round-to-nearest-even bfloat16 (f64 narrows through f32)
+  — 2 bytes/element, ~2x (f32) / 4x (f64) fewer payload bytes.
+- ``qint8``: int8 with one f32 scale per ``QUANT_BLOCK``-element block
+  (``scale = absmax/127``) — ~4x/8x fewer payload bytes.
+
+Encoded buffer layout: ``<u8 codec_id> <u8 dtype_code> <u64 raw_len>
+<u32 block_elems>`` then the codec payload (qbf16: u16 little-endian
+elements; qint8: f32 scales[nblocks] + i8 elements).
 - ``K_ELASTIC``: one elastic-membership message (ft/elastic.py — grid
   resize views, join announcements, welcomes) as a pickled dict.
   Handled directly by the receiver THREAD like ``K_PING``: a joiner's
@@ -83,7 +109,10 @@ from __future__ import annotations
 import pickle
 import struct
 import zlib
-from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+from typing import (Any, Dict, Iterator, List, NamedTuple, Optional,
+                    Sequence, Tuple)
+
+import numpy as np
 
 GOODBYE = (1 << 64) - 1  # frame-size sentinel: clean shutdown, not a crash
 
@@ -124,24 +153,45 @@ def _lz4_mod():
         return None
 
 
-#: name -> (wire id, compress, decompress); lz4 is optional — absent
-#: installs simply don't advertise it at the handshake
-CODECS: Dict[str, Tuple[int, Any, Any]] = {
-    "zlib": (1, lambda b: zlib.compress(b, 1), zlib.decompress),
-}
-if _lz4_mod() is not None:  # pragma: no cover - env without lz4
-    _l = _lz4_mod()
-    CODECS["lz4"] = (2, _l.compress, _l.decompress)
+class Codec(NamedTuple):
+    """One registered wire codec. ``lossless`` entries are byte codecs
+    (``comp``/``dec`` map bytes to bytes, the K_COMP path); lossy
+    entries are QUANTIZED tile codecs applied per float buffer on the
+    chunk lane (see the module docstring) and are excluded from the
+    lossless negotiation paths by construction."""
 
-_CODEC_BY_ID = {cid: (name, comp, dec)
-                for name, (cid, comp, dec) in CODECS.items()}
+    cid: int
+    comp: Any
+    dec: Any
+    lossless: bool = True
+
+
+#: name -> Codec; lz4 is optional — absent installs simply don't
+#: advertise it at the handshake. The quantized (lossy) tile codecs
+#: live in the same table under distinct wire ids; the HELLO
+#: advertises them separately (``"qz"`` vs ``"codecs"``), so the two
+#: families can never cross-negotiate.
+CODECS: Dict[str, Codec] = {
+    "zlib": Codec(1, lambda b: zlib.compress(b, 1), zlib.decompress),
+}
+if _lz4_mod() is not None:
+    _l = _lz4_mod()
+    CODECS["lz4"] = Codec(2, _l.compress, _l.decompress)
+
+_CODEC_BY_ID = {c.cid: (name, c) for name, c in CODECS.items()}
 
 #: preference order when both ends support several
 _CODEC_PREF = ("lz4", "zlib")
 
 
 def available_codecs() -> List[str]:
-    return sorted(CODECS)
+    """Lossless byte codecs (the HELLO ``"codecs"`` capability)."""
+    return sorted(n for n, c in CODECS.items() if c.lossless)
+
+
+def available_quant_codecs() -> List[str]:
+    """Quantized tile codecs (the HELLO ``"qz"`` capability)."""
+    return sorted(n for n, c in CODECS.items() if not c.lossless)
 
 
 def negotiate_codec(mine: Sequence[str],
@@ -153,6 +203,33 @@ def negotiate_codec(mine: Sequence[str],
         if name in common:
             return name
     return sorted(common)[0] if common else None
+
+
+def normalize_quant_codec(name: str) -> Optional[str]:
+    """Map a ``comm_quantize`` knob value to a registered quantized
+    codec name (``bf16``/``int8`` shorthands accepted); None when the
+    knob is empty. Raises on an unknown or lossless codec name."""
+    name = (name or "").strip().lower()
+    if not name or name in ("0", "off", "none"):
+        return None
+    if not name.startswith("q"):
+        name = "q" + name
+    ent = CODECS.get(name)
+    if ent is None or ent.lossless:
+        raise ValueError(
+            f"comm_quantize={name!r}: not a registered quantized codec "
+            f"(have {available_quant_codecs()})")
+    return name
+
+
+def negotiate_quant_codec(requested: Optional[str],
+                          theirs: Sequence[str]) -> Optional[str]:
+    """The quantized codec to use toward a peer: the locally requested
+    one when the peer's HELLO advertised it under ``"qz"``, else None
+    (mixed-version or knob-unset peers negotiate down to lossless)."""
+    if requested is None or requested not in (theirs or ()):
+        return None
+    return requested
 
 
 # -- message segments (K_BATCH) -----------------------------------------
@@ -198,33 +275,38 @@ def parse_batch(body: memoryview) -> Iterator[Tuple[memoryview,
 
 # -- chunked transfers (K_XFER_HDR / K_CHUNK) ---------------------------
 def pack_xfer_hdr(xfer_id: int, frame: bytes,
-                  bufspecs: Sequence[Tuple[bool, int, Optional[Any]]]
+                  bufspecs: Sequence[Tuple[int, int, Optional[Any]]]
                   ) -> bytes:
     """Header of a chunked message. ``bufspecs``: per pickle-5 buffer,
-    (chunked, size, inline_bytes-or-None) in buffer order; chunked
-    buffers announce size only, their bytes follow as K_CHUNK frames."""
+    (flags, size, inline_bytes-or-None) in buffer order; ``flags`` is
+    a BUF_CHUNKED|BUF_QUANT bitmask (plain bools read as BUF_CHUNKED,
+    the pre-quantization spelling). Chunked buffers announce size
+    only, their bytes follow as K_CHUNK frames; a BUF_QUANT size is
+    the ENCODED byte count (the self-describing raw length travels
+    inside the encoding)."""
     parts = [_XFER.pack(K_XFER_HDR, xfer_id, len(frame), len(bufspecs))]
-    parts += [_BUFSPEC.pack(1 if chunked else 0, size)
-              for (chunked, size, _b) in bufspecs]
+    parts += [_BUFSPEC.pack(int(flags), size)
+              for (flags, size, _b) in bufspecs]
     parts.append(frame)
-    parts += [bytes(b) for (chunked, _s, b) in bufspecs if not chunked]
+    parts += [bytes(b) for (flags, _s, b) in bufspecs
+              if not int(flags) & BUF_CHUNKED]
     return b"".join(parts)
 
 
 def parse_xfer_hdr(body: memoryview) -> Tuple[int, memoryview,
-                                              List[Tuple[bool, int,
+                                              List[Tuple[int, int,
                                                          Optional[memoryview]]]]:
     _kind, xfer_id, flen, nbufs = _XFER.unpack_from(body, 0)
     off = _XFER.size
     specs = []
     for i in range(nbufs):
-        chunked, size = _BUFSPEC.unpack_from(body, off)
-        specs.append([bool(chunked), size, None])
+        flags, size = _BUFSPEC.unpack_from(body, off)
+        specs.append([int(flags), size, None])
         off += _BUFSPEC.size
     frame = body[off:off + flen]
     off += flen
     for spec in specs:
-        if not spec[0]:
+        if not spec[0] & BUF_CHUNKED:
             spec[2] = body[off:off + spec[1]]
             off += spec[1]
     if off != len(body):
@@ -245,19 +327,21 @@ def parse_chunk(body: memoryview) -> Tuple[int, int, int, memoryview]:
 class RxXfer:
     """Receive-side reassembly of one chunked message."""
 
-    __slots__ = ("frame", "bufs", "remaining", "nbytes")
+    __slots__ = ("frame", "bufs", "remaining", "nbytes", "quant")
 
     def __init__(self, frame: memoryview,
-                 bufspecs: Sequence[Tuple[bool, int, Optional[memoryview]]]
+                 bufspecs: Sequence[Tuple[int, int, Optional[memoryview]]]
                  ) -> None:
         # the pickle frame must outlive the enclosing frame body
         self.frame = bytes(frame)
         self.bufs: List[Any] = []
+        self.quant: List[bool] = []     # buffer needs dequantization
         self.remaining = 0
         self.nbytes = len(self.frame)
-        for (chunked, size, inline) in bufspecs:
+        for (flags, size, inline) in bufspecs:
             self.nbytes += size
-            if chunked:
+            self.quant.append(bool(int(flags) & BUF_QUANT))
+            if int(flags) & BUF_CHUNKED:
                 self.bufs.append(bytearray(size))
                 self.remaining += size
             else:
@@ -278,7 +362,9 @@ class RxXfer:
         return self.remaining <= 0
 
     def message(self) -> Any:
-        return pickle.loads(self.frame, buffers=self.bufs)
+        bufs = [dequantize_buffer(b) if q else b
+                for b, q in zip(self.bufs, self.quant)]
+        return pickle.loads(self.frame, buffers=bufs)
 
 
 def load_message(frame: memoryview, bufs: Sequence[Any]) -> Any:
@@ -373,20 +459,134 @@ def parse_hello(body: memoryview) -> Dict[str, Any]:
 def compress_body(body: bytes, codec: str) -> Optional[List[bytes]]:
     """K_COMP pieces for ``body``, or None when compression does not
     pay (the compressed form is not smaller)."""
-    cid, comp, _dec = CODECS[codec]
-    out = comp(body)
+    ent = CODECS[codec]
+    if not ent.lossless:
+        raise ValueError(
+            f"{codec}: quantized codecs never compress frame BODIES "
+            f"(a lossy transform of a pickled body would corrupt it)")
+    out = ent.comp(body)
     if len(out) + _COMP.size >= len(body):
         return None
-    return [_COMP.pack(K_COMP, cid, len(body)), out]
+    return [_COMP.pack(K_COMP, ent.cid, len(body)), out]
 
 
 def decompress_body(body: memoryview) -> bytes:
     _kind, cid, raw_len = _COMP.unpack_from(body, 0)
     ent = _CODEC_BY_ID.get(cid)
-    if ent is None:
+    if ent is None or not ent[1].lossless:
         raise ValueError(f"unknown compression codec id {cid}")
-    out = ent[2](bytes(body[_COMP.size:]))
+    out = ent[1].dec(bytes(body[_COMP.size:]))
     if len(out) != raw_len:
         raise ValueError(
             f"decompressed length {len(out)} != announced {raw_len}")
     return out
+
+
+# -- quantized tile codecs (lossy; the "qz" HELLO capability) -----------
+#: elements per int8 scale block (one f32 scale each); a pure function
+#: of the codec version — both ends derive block counts from it
+QUANT_BLOCK = 512
+
+#: flags of a transfer-header bufspec (``pack_xfer_hdr``): bit 0 = the
+#: buffer's bytes follow as K_CHUNK frames, bit 1 = the announced bytes
+#: are a quantized encoding the receiver must decode before unpickling
+BUF_CHUNKED = 1
+BUF_QUANT = 2
+
+_QHDR = struct.Struct("<BBQI")   # codec_id, dtype_code, raw_len, block
+_QDTYPES = {"d": (0, np.float64), "f": (1, np.float32)}
+_QDTYPE_BY_CODE = {0: np.float64, 1: np.float32}
+
+
+def _enc_bf16(x: np.ndarray) -> bytes:
+    """Round-to-nearest-even bfloat16 of a float array (f64 narrows
+    through f32 first, like an XLA bf16 cast would)."""
+    u = np.ascontiguousarray(x, np.float32).view(np.uint32)
+    # RNE: add 0x7FFF + the current LSB of the kept half, then truncate
+    return (((u + np.uint32(0x7FFF) + ((u >> np.uint32(16))
+                                       & np.uint32(1)))
+             >> np.uint32(16)).astype(np.uint16)).tobytes()
+
+
+def _dec_bf16(payload: memoryview, n: int, dt) -> bytes:
+    u16 = np.frombuffer(payload, np.uint16, count=n)
+    f32 = (u16.astype(np.uint32) << np.uint32(16)).view(np.float32)
+    return np.ascontiguousarray(f32, dt).tobytes()
+
+
+def _enc_int8(x: np.ndarray) -> bytes:
+    """Blockwise int8: per QUANT_BLOCK-element block one f32 scale
+    (absmax/127); values quantize to round(x/scale) in [-127, 127]."""
+    n = x.size
+    nblocks = max(1, (n + QUANT_BLOCK - 1) // QUANT_BLOCK)
+    xp = np.zeros(nblocks * QUANT_BLOCK, np.float32)
+    xp[:n] = np.ascontiguousarray(x, np.float32)
+    xb = xp.reshape(nblocks, QUANT_BLOCK)
+    scales = (np.abs(xb).max(axis=1) / 127.0).astype(np.float32)
+    inv = np.zeros_like(scales)
+    np.divide(1.0, scales, out=inv, where=scales > 0)
+    q = np.clip(np.rint(xb * inv[:, None]), -127, 127).astype(np.int8)
+    return scales.tobytes() + q.reshape(-1)[:n].tobytes()
+
+
+def _dec_int8(payload: memoryview, n: int, dt) -> bytes:
+    nblocks = max(1, (n + QUANT_BLOCK - 1) // QUANT_BLOCK)
+    scales = np.frombuffer(payload, np.float32, count=nblocks)
+    q = np.frombuffer(payload, np.int8, count=n, offset=4 * nblocks)
+    xp = np.zeros(nblocks * QUANT_BLOCK, np.float32)
+    xp[:n] = q
+    out = (xp.reshape(nblocks, QUANT_BLOCK)
+           * scales[:, None]).reshape(-1)[:n]
+    return np.ascontiguousarray(out, dt).tobytes()
+
+
+CODECS["qbf16"] = Codec(16, _enc_bf16, _dec_bf16, lossless=False)
+CODECS["qint8"] = Codec(17, _enc_int8, _dec_int8, lossless=False)
+_CODEC_BY_ID = {c.cid: (name, c) for name, c in CODECS.items()}
+
+
+def quantize_buffer(view: Any, fmt: str, codec: str) -> bytes:
+    """Encode one flat float buffer (``fmt`` = 'd'/'f', the buffer
+    protocol format of the ORIGINAL array) with a quantized codec.
+    The returned bytes are self-describing (``_QHDR`` leads them)."""
+    ent = CODECS[codec]
+    dcode, dt = _QDTYPES[fmt]
+    x = np.frombuffer(view, dtype=dt)
+    return _QHDR.pack(ent.cid, dcode, x.nbytes, QUANT_BLOCK) \
+        + ent.comp(x)
+
+
+def dequantize_buffer(buf: Any) -> bytes:
+    """Decode one quantized buffer back to the exact raw bytes of the
+    original dtype/length (lossy in VALUE, exact in layout — the
+    unpickler reconstructs the array over them unchanged)."""
+    mv = memoryview(buf)
+    cid, dcode, raw_len, block = _QHDR.unpack_from(mv, 0)
+    ent = _CODEC_BY_ID.get(cid)
+    if ent is None or ent[1].lossless:
+        raise ValueError(f"unknown quantized codec id {cid}")
+    if block != QUANT_BLOCK:
+        raise ValueError(
+            f"quantized block size {block} != local {QUANT_BLOCK}")
+    dt = _QDTYPE_BY_CODE.get(dcode)
+    if dt is None:
+        raise ValueError(f"unknown quantized dtype code {dcode}")
+    n = raw_len // np.dtype(dt).itemsize
+    out = ent[1].dec(mv[_QHDR.size:], n, dt)
+    if len(out) != raw_len:
+        raise ValueError(
+            f"dequantized length {len(out)} != announced {raw_len}")
+    return out
+
+
+def qdq_array(arr: np.ndarray, codec: str) -> np.ndarray:
+    """Quantize-dequantize round trip of an array: exactly the values
+    a quantized wire transfer would deliver (shared by the reduced-
+    precision collective lane so wire and lane quantize identically)."""
+    a = np.ascontiguousarray(arr)
+    fmt = {"float64": "d", "float32": "f"}.get(a.dtype.name)
+    if fmt is None:
+        return arr
+    raw = dequantize_buffer(
+        quantize_buffer(memoryview(a).cast("B"), fmt, codec))
+    return np.frombuffer(raw, dtype=a.dtype).reshape(a.shape).copy()

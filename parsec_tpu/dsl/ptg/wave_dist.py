@@ -282,13 +282,30 @@ class _CollectiveLane:
 
     def __init__(self, mode: str, nb_ranks: int, rank: int,
                  rendezvous=None, timeout: float = 120.0,
-                 dead_fn=None, devices=None) -> None:
+                 dead_fn=None, devices=None,
+                 reduce_dtype: Optional[str] = None) -> None:
         import jax
 
         self.mode = mode
         self.nb_ranks = nb_ranks
         self.rank = rank
         self.timeout = timeout
+        # reduced-precision lane (ISSUE 14, ``wave_reduce_dtype``):
+        # each rank's contribution quantizes AT THE BOUNDARY (blockwise
+        # bf16/int8 — the exact wire codecs, wire.qdq_array) before the
+        # deposit; the sum itself stays full precision. A pure function
+        # of params, so every SPMD rank quantizes identically. Error
+        # feedback (parallel/mesh.ErrorFeedback) engages only for
+        # callers that pass a stable ``fb_key`` naming a recurring
+        # logical buffer — the broadcast-by-sum wave steps carry
+        # DIFFERENT tiles every wave, so feeding one wave's residual
+        # into the next would corrupt unrelated data; iterative
+        # all-reduce users (and the EF tests) name their buffers.
+        from ...comm import wire as _wire
+        from ...parallel.mesh import ErrorFeedback
+        self._qcodec = _wire.normalize_quant_codec(reduce_dtype or "")
+        self._efb = ErrorFeedback()
+        self.quantized_reduces = 0
         # liveness probe for the rendezvous wait (ft/): a callable
         # returning the CE's dead_peers so an evicted member aborts the
         # collective NOW instead of burning the whole timeout
@@ -332,16 +349,41 @@ class _CollectiveLane:
             self._group_sh[members] = ent
         return ent
 
+    def _quantize_contrib(self, contrib, fb_key):
+        """Quantize one contribution at the reduction boundary (host-
+        side, through the shared wire codec so lane and wire round
+        identically); dtype and shape are preserved — the compiled
+        sum and the rendezvous bookkeeping see no difference."""
+        from ...comm import wire as _wire
+        arr = np.asarray(contrib)
+        if arr.dtype.name not in ("float32", "float64"):
+            # int/bool/f16 pools stay exact — and must not count as
+            # quantized (qdq_array would pass them through unchanged)
+            return contrib
+        if fb_key is not None:
+            out = self._efb.compensate(fb_key, arr, self._qcodec,
+                                       _wire.qdq_array)
+        else:
+            out = _wire.qdq_array(arr, self._qcodec)
+        self.quantized_reduces += 1
+        return out
+
     def reduce(self, key: Tuple, contrib,
-               members: Optional[Tuple[int, ...]] = None) -> Any:
+               members: Optional[Tuple[int, ...]] = None,
+               fb_key=None) -> Any:
         """All-reduce one padded contribution stack; returns the
         replicated result's shard on this rank's lane device.
 
         ``members``: sorted tuple of participating ranks for a PARTIAL
         group (in-process substrate only — a multi-controller
-        computation needs every process); None = all ranks."""
+        computation needs every process); None = all ranks.
+        ``fb_key``: stable name of a RECURRING logical buffer — opts
+        this contribution into error-feedback accumulation under the
+        reduced-precision lane (see __init__; None = quantize-only)."""
         import jax
 
+        if self._qcodec is not None:
+            contrib = self._quantize_contrib(contrib, fb_key)
         full = members is None or len(members) == self.nb_ranks
         parts = tuple(range(self.nb_ranks)) if full else members
         in_sh, sum_fn = ((self._in_sh, self._sum) if full
@@ -509,12 +551,22 @@ class DistWaveRunner(WaveRunner):
         mode = str(params.get_or("wave_dist_collective", "string", "auto"))
         if mode == "off" or self.nb_ranks < 2:
             return
+        # reduced-precision lane (ISSUE 14): a pure function of params,
+        # so every SPMD rank derives the same codec (the multiproc
+        # uniformity hash covers it too). Validated HERE, before the
+        # swallowing try below: a typo'd knob must fail loudly, not
+        # silently disable the whole lane under mode=auto
+        reduce_dtype = str(params.get_or(
+            "wave_reduce_dtype", "string", ""))
+        from ...comm import wire as _wire
+        _wire.normalize_quant_codec(reduce_dtype)   # raises on typos
         try:
             import jax
             if jax.process_count() == self.nb_ranks:
                 self._lane = _CollectiveLane(
                     "multiproc", self.nb_ranks, self.rank,
-                    timeout=self.comm_timeout)
+                    timeout=self.comm_timeout,
+                    reduce_dtype=reduce_dtype)
             elif mode == "on" and jax.process_count() == 1 and \
                     len(_lane_local_devices(self.nb_ranks)) >= self.nb_ranks:
                 fab = getattr(self.ce, "fabric", None) or self.ce
@@ -528,7 +580,8 @@ class DistWaveRunner(WaveRunner):
                     timeout=self.comm_timeout,
                     dead_fn=lambda ce=self.ce: getattr(
                         ce, "dead_peers", ()),
-                    devices=_lane_device_pool(self.nb_ranks))
+                    devices=_lane_device_pool(self.nb_ranks),
+                    reduce_dtype=reduce_dtype)
         except Exception:
             if mode == "on":
                 raise
@@ -554,8 +607,12 @@ class DistWaveRunner(WaveRunner):
         mode = str(params.get_or("wave_dist_collective", "string", "auto"))
         min_pct = int(params.get_or(
             "wave_dist_collective_min_pct", "int", 50))
+        # the reduce dtype rides the digest too: a process quantizing
+        # its lane contributions while a peer does not silently skews
+        # results — better a loud setup failure
+        rdt = str(params.get_or("wave_reduce_dtype", "string", ""))
         digest = hashlib.sha1(
-            repr((mode, min_pct)).encode()).hexdigest()
+            repr((mode, min_pct, rdt)).encode()).hexdigest()
         check_lane_schedule_uniformity(
             self.ce, digest, timeout=min(30.0, self.comm_timeout))
 
@@ -984,6 +1041,12 @@ class DistWaveRunner(WaveRunner):
                 "collective_calls": self._lane_calls,
                 "collective_joins": self._lane_joins,
                 "collective_tiles": self._lane_tiles,
+                "collective_reduce_dtype": (
+                    self._lane._qcodec if self._lane is not None
+                    else None),
+                "collective_quantized": (
+                    self._lane.quantized_reduces
+                    if self._lane is not None else 0),
                 "device_plane": (getattr(self.ce, "device_plane",
                                          None) is not None
                                  and self._plane_ok),
@@ -1164,9 +1227,14 @@ class DistWaveRunner(WaveRunner):
                             pass   # foreign-base view: already safe
                         colls.append((cid, idxs, payload))
                     self._sent_tiles += len(idxs)
+                # tile payload message: eligible for the lossy
+                # quantized wire codecs (ISSUE 14) — the transport
+                # quantizes the bulk float stacks toward peers that
+                # negotiated one; descriptors/control stay exact
                 self.ce.send_am(dst, TAG_WAVE,
                                 {"pool": pool_name, "epoch": epoch,
-                                 "wave": w, "gen": g, "colls": colls})
+                                 "wave": w, "gen": g, "colls": colls,
+                                 "_qz_ok": True})
             for src in recv_gens.get(g, ()):
                 msg = self._await_msg(src, w, g)
                 for cid, idxs, payload in msg["colls"]:

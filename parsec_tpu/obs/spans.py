@@ -345,6 +345,19 @@ class CommObs:
                 COMM_COMPRESS_RATIO,
                 lambda c=ce: (lambda r: 1.0 if r is None else r)(
                     c.compress_ratio()))
+        if hasattr(ce, "codec_ratio") and hasattr(ce, "wire_codec_names"):
+            # per-link, CODEC-LABELED reduction ratios (ISSUE 14):
+            # COMPRESS_RATIO::R<peer>::<codec> is raw/encoded (> 1 =
+            # that codec engaged and shrank the wire; 1.0 = inactive),
+            # so lossless-vs-quantized engagement is distinguishable
+            # per link in /metrics
+            for peer in range(ce.nb_ranks):
+                if peer == ce.rank:
+                    continue
+                for cname in ce.wire_codec_names():
+                    sde.register_poll(
+                        f"{COMM_COMPRESS_RATIO}::R{peer}::{cname}",
+                        lambda c=ce, p=peer, n=cname: c.codec_ratio(p, n))
         if hasattr(ce, "link_bw_mbps"):
             for peer in range(ce.nb_ranks):
                 if peer == ce.rank:

@@ -4,14 +4,33 @@ TPU-native scaling model (SURVEY.md §5.8): pick a mesh, annotate shardings,
 let XLA insert collectives over ICI. Axes: dp (data), pp (pipeline stages),
 tp (tensor/heads), sp (sequence/context), ep (experts). Any axis may be
 size 1 — the sharding code paths stay identical.
+
+Reduced-precision collectives (ISSUE 14, the EQuARX recipe — arxiv
+2506.17615): :class:`ErrorFeedback` + :func:`reduced_precision_sum` /
+:func:`two_level_allreduce` quantize each contribution AT THE REDUCTION
+BOUNDARY (blockwise bf16 or int8-with-per-block-scale, sharing the wire
+codecs in comm/wire.py so lane and wire round identically) and carry
+the residual of each quantized send into the next contribution of the
+same logical buffer — iterative workloads don't drift: the quantization
+error is fed back, not discarded. The wave collective lane
+(dsl/ptg/wave_dist.py, ``wave_reduce_dtype``) rides these helpers.
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Tuple
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 AXES = ("dp", "pp", "tp", "sp", "ep")
+
+#: declared lock discipline (analysis/lock_check.py): the error-feedback
+#: accumulator is per-instance mutable state shared between whichever
+#: threads drive the reduction (SPMD rank threads deposit concurrently
+#: into one lane) — residuals live under the instance lock
+_GUARDED_BY = {
+    "ErrorFeedback._resid": "_lock",
+}
 
 
 def _factor(n: int, order: Sequence[str]) -> Dict[str, int]:
@@ -80,6 +99,114 @@ def spec(*axes) -> "object":
     """PartitionSpec shorthand."""
     from jax.sharding import PartitionSpec as P
     return P(*axes)
+
+
+# -- reduced-precision collectives with error feedback (ISSUE 14) -------
+class ErrorFeedback:
+    """Per-boundary error-feedback accumulator (EQuARX): for each
+    logical buffer (caller-chosen ``key``) the residual of the last
+    quantized send is retained and folded into the NEXT contribution
+    before it quantizes, so repeated reductions of the same buffer
+    converge to the full-precision result instead of accumulating
+    bias. A key whose contribution shape changes starts fresh (it is a
+    different buffer). Thread-safe: SPMD rank threads share one lane."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._resid: Dict[Any, np.ndarray] = {}
+
+    def compensate(self, key: Any, arr: np.ndarray, codec: str,
+                   qdq) -> np.ndarray:
+        """Quantize ``arr`` through ``qdq(x, codec)`` with feedback:
+        returns the quantized-dequantized values that should travel,
+        retaining (folded contribution - sent values) for next time."""
+        arr = np.asarray(arr)
+        with self._lock:
+            prev = self._resid.get(key)
+            folded = (arr + prev if prev is not None
+                      and prev.shape == arr.shape
+                      and prev.dtype == arr.dtype else arr)
+            out = qdq(folded, codec)
+            self._resid[key] = folded - out
+        return out
+
+    def reset(self, key: Any = None) -> None:
+        with self._lock:
+            if key is None:
+                self._resid.clear()
+            else:
+                self._resid.pop(key, None)
+
+    def keys(self) -> List[Any]:
+        with self._lock:
+            return list(self._resid)
+
+
+def _quant_codec_of(reduce_dtype: Optional[str]) -> Optional[str]:
+    """Map a ``wave_reduce_dtype`` knob value to a registered quantized
+    wire codec name (None = full precision)."""
+    from ..comm import wire
+    return wire.normalize_quant_codec(reduce_dtype or "")
+
+
+def reduced_precision_sum(contribs: Sequence[np.ndarray],
+                          reduce_dtype: Optional[str] = None,
+                          feedback: Optional[ErrorFeedback] = None,
+                          keys: Optional[Sequence[Any]] = None
+                          ) -> np.ndarray:
+    """Sum of per-participant contributions with quantize-at-the-
+    boundary: each contribution is quantized (bf16 / int8 blockwise,
+    exactly the wire codecs) before it enters the reduction —
+    modelling what a reduced-precision all-reduce would move — and the
+    accumulation itself stays full precision. ``feedback``/``keys``
+    enable per-contributor error feedback (``keys[i]`` names
+    contributor i's logical buffer). ``reduce_dtype`` None/"" keeps the
+    exact full-precision sum (bit-for-bit the naive sum)."""
+    from ..comm import wire
+    codec = _quant_codec_of(reduce_dtype)
+    if codec is None:
+        out = np.zeros_like(np.asarray(contribs[0]))
+        for c in contribs:
+            out = out + np.asarray(c)
+        return out
+    out = None
+    for i, c in enumerate(contribs):
+        c = np.asarray(c)
+        if feedback is not None and keys is not None:
+            q = feedback.compensate(keys[i], c, codec, wire.qdq_array)
+        else:
+            q = wire.qdq_array(c, codec)
+        out = q if out is None else out + q
+    return out
+
+
+def two_level_allreduce(shards: Sequence[np.ndarray],
+                        group_size: int,
+                        reduce_dtype: Optional[str] = None,
+                        feedback: Optional[ErrorFeedback] = None,
+                        key: Any = None) -> np.ndarray:
+    """Hierarchical all-reduce: contributions reduce FULL-precision
+    inside each ``group_size``-wide group (level 1 — the intra-mesh
+    XLA psum over ICI, where bandwidth is plentiful), each group's
+    partial sum quantizes at the group boundary (level 2 — the
+    inter-rank hop over the wire, where it is not), and the quantized
+    partials sum to the replicated result. With ``feedback`` set, each
+    group's boundary residual is carried into its next partial under
+    ``(key, group index)`` — the EQuARX error-feedback recipe. With
+    ``reduce_dtype`` None/"" this is exactly the flat sum."""
+    n = len(shards)
+    groups = [list(range(g, min(g + group_size, n)))
+              for g in range(0, n, group_size)]
+    partials = []
+    for gi, members in enumerate(groups):
+        part = np.asarray(shards[members[0]]).copy()
+        for m in members[1:]:
+            part += np.asarray(shards[m])
+        partials.append(part)
+    keys = [(key, gi) for gi in range(len(groups))] \
+        if feedback is not None else None
+    return reduced_precision_sum(partials, reduce_dtype,
+                                 feedback=feedback, keys=keys)
 
 
 def sync_axes(leaf_spec, mesh_axes: Sequence[str] = AXES) -> Tuple[str, ...]:

@@ -258,6 +258,19 @@ def register_core_params() -> None:
                    "measured send bandwidth EWMA drops below this many "
                    "MB/s and a sample probe shows the traffic "
                    "compresses (0 = never)")
+    params.reg_string("comm_quantize", "",
+                      "lossy quantized wire codec for bulk float tile "
+                      "payloads (bf16 | int8): engaged per link toward "
+                      "peers that advertised it at the HELLO (both ends "
+                      "must set the knob); control AMs, checkpoint "
+                      "shards and non-float buffers always stay "
+                      "lossless. Empty = off, bit-for-bit unchanged "
+                      "wire")
+    params.reg_int("comm_quantize_threshold_mbps", 0,
+                   "engage the quantized codec only when the send-"
+                   "bandwidth EWMA toward the peer is below this many "
+                   "MB/s (0 = whenever comm_quantize is set — the "
+                   "knob itself is the lossy opt-in)")
     params.reg_sizet("comm_send_buffer_bytes", 1 << 26,
                      "per-peer bounded send buffer: send_am blocks "
                      "while this many bytes are queued ahead of it "

@@ -262,6 +262,80 @@ def test_chunked_transfer_survives_flap():
         e1.fini()
 
 
+def test_quantized_transfer_replays_bit_identical_after_flap():
+    """Session-layer x quantized-codec interplay (ISSUE 14): the lossy
+    encoding happens at ENQUEUE, before the K_SEQ envelope, so the
+    replay window retains the ENCODED bytes — a flap mid-stream
+    replays them and the receiver observes byte-for-byte the same
+    quantized values a failure-free quantized run delivers (asserted
+    against wire.qdq_array, which IS that value by construction)."""
+    e0, e1 = _engines(2, reconnect_timeout=10.0, chunk_bytes=1 << 12,
+                      quantize="int8")
+    got = []
+    e1.tag_register(TAG, lambda src, p: got.append(
+        (p["i"], np.array(p["arr"]))))
+    rng = np.random.RandomState(21)
+    payloads = [rng.rand(8192).astype(np.float64) for _ in range(16)]
+    try:
+        _wait_session(e0, e1)
+        p0 = _peer_obj(e0, 1)
+        assert _wait(lambda: (lambda: p0.qz_codec == "qint8")()), \
+            "quantized codec never negotiated"
+
+        def sender():
+            for i, a in enumerate(payloads):
+                e0.send_am(1, TAG, {"i": i, "arr": a, "_qz_ok": True})
+
+        t = threading.Thread(target=sender, daemon=True)
+        t.start()
+        time.sleep(0.002)   # land the tear somewhere inside the stream
+        _peer_obj(e1, 0).sock.shutdown(socket.SHUT_RDWR)
+        t.join(10)
+        assert not t.is_alive()
+        assert _wait(lambda: (e1.progress(), len(got) >= 16)[1], 20.0)
+        assert [i for i, _ in got] == list(range(16))
+        for i, arr in got:
+            np.testing.assert_array_equal(
+                arr, wire.qdq_array(payloads[i], "qint8"))
+        assert e0.wire_stats["reconnects"] >= 1
+        assert e0.wire_stats["bufs_quantized"] == 16
+        assert not e0.dead_peers and not e1.dead_peers
+    finally:
+        e0.fini()
+        e1.fini()
+
+
+def test_quantize_mixed_version_peer_negotiates_down_to_lossless():
+    """A peer whose HELLO carries no "qz" capability (mixed version /
+    knob unset on its side) must NEVER receive quantized buffers —
+    the link silently stays lossless, bit for bit."""
+    # e0 wants int8; e1 runs with the knob unset and advertises no "qz"
+    ports = free_ports(2)
+    eps = [("127.0.0.1", p) for p in ports]
+    import concurrent.futures as cf
+    with cf.ThreadPoolExecutor(2) as ex:
+        e0, e1 = list(ex.map(
+            lambda r: TCPCommEngine(
+                r, eps, reconnect_timeout=10.0, chunk_bytes=1 << 12,
+                quantize="int8" if r == 0 else ""),
+            range(2)))
+    got = []
+    e1.tag_register(TAG, lambda src, p: got.append(np.array(p["arr"])))
+    try:
+        _wait_session(e0, e1)
+        p = _peer_obj(e0, 1)
+        with p.cond:
+            assert p.qz_codec is None   # negotiated down
+        arr = np.random.RandomState(23).rand(8192)
+        e0.send_am(1, TAG, {"arr": arr, "_qz_ok": True})
+        assert _wait(lambda: (e1.progress(), got)[1], 15.0)
+        np.testing.assert_array_equal(got[0], arr)   # bit-exact
+        assert e0.wire_stats["bufs_quantized"] == 0
+    finally:
+        e0.fini()
+        e1.fini()
+
+
 def test_partial_frame_resume_claim():
     """The receiver's byte-level resume claim (satellite: `_recv_exact`
     truncation offset feeds the session instead of being discarded):

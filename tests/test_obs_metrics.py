@@ -279,6 +279,72 @@ def test_mesh_gauges_in_exposition():
     assert max(vals("collective_bytes")) > 0.0
 
 
+def test_per_codec_compress_ratio_gauges_in_exposition():
+    """ISSUE 14 satellite: COMPRESS_RATIO is labeled per codec and per
+    link — ``PARSEC::COMM::COMPRESS_RATIO::R<peer>::<codec>`` — so
+    lossless-vs-quantized engagement is distinguishable in /metrics.
+    Both families must be LIVE on one link: the zlib row moves below
+    raw bytes when compression engages, the qint8 row moves above 1
+    when quantization does; codecs that never engaged read 1.0."""
+    import concurrent.futures as cf
+    import time as _time
+
+    from parsec_tpu.obs import CommObs
+    from parsec_tpu.comm.tcp import TCPCommEngine, free_ports
+
+    ports = free_ports(2)
+    eps = [("127.0.0.1", p) for p in ports]
+    with cf.ThreadPoolExecutor(2) as ex:
+        e0, e1 = list(ex.map(
+            lambda r: TCPCommEngine(
+                r, eps, chunk_bytes=1 << 16, quantize="int8",
+                compress_threshold_mbps=10 ** 7),
+            range(2)))
+    try:
+        m = MetricsRegistry()
+        obs = CommObs(m)
+        obs.register_engine_gauges(e0)
+        got = []
+        e1.tag_register(900, lambda src, p: got.append(p))
+        peer = e0._peer_to(1)
+        deadline = _time.time() + 10
+        while _time.time() < deadline:
+            with peer.cond:
+                if peer.qz_codec and peer.codec:
+                    break
+            _time.sleep(0.005)
+        # quantized leg: bulk float marked eligible
+        arr = np.random.RandomState(17).rand(1 << 15)
+        e0.send_am(1, 900, {"arr": arr, "_qz_ok": True})
+        # lossless-compression leg: compressible ctrl payload repeated
+        # (rep 1 samples the bandwidth EWMA, later reps compress)
+        z = np.zeros(1 << 15)
+        for rep in range(3):
+            e0.send_am(1, 900, {"z": z, "rep": rep})
+        deadline = _time.time() + 30
+        while len(got) < 4 and _time.time() < deadline:
+            if not e1.progress():
+                _time.sleep(0.0005)
+        assert len(got) == 4
+        text = render(m, labels={"rank": "0"})
+    finally:
+        e0.fini()
+        e1.fini()
+    samples = parse_exposition(text)
+
+    def val(name):
+        hits = [v for (n, _l), v in samples.items() if n == name]
+        assert hits, (name, sorted(n for (n, _l) in samples
+                                   if "compress" in n))
+        return hits[0]
+
+    # both families live on the SAME link, distinguishable by label
+    assert val("parsec_comm_compress_ratio_r1_qint8") > 1.0
+    assert val("parsec_comm_compress_ratio_r1_zlib") > 1.0
+    # a codec that never engaged reads the 1.0 idle value
+    assert val("parsec_comm_compress_ratio_r1_qbf16") == 1.0
+
+
 def test_overlap_gauges_in_exposition():
     """ISSUE 7 acceptance: the live OVERLAP_FRACTION / EXPOSED_COMM_US
     gauges and the prefetch/segment counters must surface in the
