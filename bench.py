@@ -54,6 +54,15 @@ Execution modes (BENCH_MODE):
   subprocess); reports wall, payload bytes on the wire, per-link
   labeled reduction ratios, residual per leg, and the knob-unset
   bit-identity differential.
+- ``trace``: cross-rank flow tracing (ISSUE 15) — the SAME 2-rank
+  classic-runtime dpotrf over real loopback TCP on a throttled link,
+  ``obs_flow`` off vs on; reports the µs/task delta, the added wire
+  bytes per message (the pickled trace context), the stitched
+  cross-rank edge counts per direction, the min offset-corrected
+  send→recv lag, and the knob-unset wire byte-capture differential
+  (a scripted deterministic exchange captured at the frame level must
+  be BIT-IDENTICAL with the knob unset, and toward a peer that never
+  advertised "tr").
 
 Every record carries ``schema_version`` + stable ``metric_id``/``mode``
 /``n``/``nb``/``dtype`` fields (schema 2): r01-r05 changed metric
@@ -743,6 +752,12 @@ def bench_all(n, nb, reps, cores, dtype):
         qw = _try("qwire", lambda: bench_qwire())
         if qw is not None:
             extras.update(qw)
+    # cross-rank flow tracing (ISSUE 15): throttled-TCP dpotrf, flow
+    # off vs on — scrubbed CPU subprocess, link-independent
+    if os.environ.get("BENCH_TRACE", "1") != "0":
+        tr = _try("trace", lambda: bench_trace())
+        if tr is not None:
+            extras.update(tr)
     # compiled-stage vs interpreted runtime (ISSUE 12): scrubbed CPU
     # subprocess, link-independent — rides every record
     if os.environ.get("BENCH_STAGEC", "1") != "0":
@@ -1854,6 +1869,288 @@ def bench_qwire(n=256, nb=64, delay_ms=2) -> dict:
 
 
 # ---------------------------------------------------------------------- #
+# cross-rank flow tracing benchmark (ISSUE 15): throttled-TCP dpotrf,    #
+# obs_flow off vs on + the knob-unset wire byte-capture differential     #
+# ---------------------------------------------------------------------- #
+def _dpotrf_task_count(nt: int) -> int:
+    """POTRF + TRSM + SYRK + GEMM instance count of a tiled dpotrf."""
+    return (nt + nt * (nt - 1)            # potrf + trsm&syrk (pairs)
+            + nt * (nt - 1) * (nt - 2) // 6)
+
+
+def bench_trace_capture_identity() -> dict:
+    """The knob-unset wire differential of ISSUE 15's acceptance gate:
+    a SCRIPTED deterministic message exchange (sequential sends, one
+    frame per message, drained between sends so frame order is
+    enqueue order) between two fresh TCP engines, with every outbound
+    frame captured at the ``_sendall_vec`` seam.  Three legs:
+
+    - A/B: ``obs_flow`` unset twice — the captured DATA frame streams
+      must be BYTE-IDENTICAL (the knob-unset wire is deterministic and
+      carries no trace bytes);
+    - C: ``obs_flow`` SET on rank 0 only — rank 1 (knob unset) never
+      advertises ``"tr"``, so rank 0 negotiates DOWN and its data
+      frames stay byte-identical to the unset legs (the mixed-version
+      contract).  HELLO frames differ by the advertisement (the same
+      precedent as the "rs"/"qz" capabilities) and are excluded.
+    """
+    import threading as _threading
+    from contextlib import ExitStack
+
+    from parsec_tpu.comm import tcp as tcpmod
+    from parsec_tpu.comm.engine import (TAG_ACTIVATE, TAG_DTD_DATA,
+                                        TAG_MEM_PUT)
+    from parsec_tpu.comm.tcp import TCPCommEngine, free_ports
+    from parsec_tpu.utils.params import params as _params
+
+    chunk = 4096
+
+    def leg(flow_r0):
+        captured = {}
+        orig = tcpmod._sendall_vec
+
+        def capturing(sock, pieces):
+            body = b"".join(bytes(p) for p in pieces)
+            captured.setdefault(
+                _threading.current_thread().name, []).append(body)
+            orig(sock, pieces)
+
+        ports = free_ports(2)
+        eps = [("127.0.0.1", p) for p in ports]
+        with ExitStack() as st:
+            st.enter_context(_params.cmdline_override(
+                "comm_coalesce_max_bytes", "0"))   # one frame/message
+            st.enter_context(_params.cmdline_override(
+                "comm_chunk_bytes", str(chunk)))
+            tcpmod._sendall_vec = capturing
+            try:
+                engines = [None, None]
+
+                def boot(r):
+                    engines[r] = TCPCommEngine(
+                        r, eps, obs_flow=(flow_r0 and r == 0))
+                ts = [_threading.Thread(target=boot, args=(r,))
+                      for r in (0, 1)]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join(30)
+                e0, e1 = engines
+                # the flow allocator would be armed by the obs wiring;
+                # arm it directly here (no Context in this scripted leg)
+                if flow_r0:
+                    from parsec_tpu.comm.engine import FlowIds
+                    e0._flow = FlowIds(0)
+
+                    class _NullObs:
+                        def am_sent(self, *a):
+                            pass
+
+                        def flow_sent(self, *a):
+                            pass
+                    e0._obs = _NullObs()
+                rng = np.random.RandomState(7)
+                small = rng.rand(16, 16)
+                big = rng.rand(64, 64)        # > chunk: rides the bulk lane
+
+                def drained(eng, peer):
+                    p = eng._peer_to(peer)
+                    deadline = time.time() + 10
+                    while time.time() < deadline:
+                        with p.cond:
+                            if not p.ctrl and not p.bulk:
+                                return
+                        time.sleep(0.002)
+                    raise TimeoutError("send queue never drained")
+
+                msgs = [
+                    (TAG_ACTIVATE, {"tp_id": 0, "root": 0, "ranks": [1],
+                                    "edges": {1: []}, "data": small}),
+                    (TAG_DTD_DATA, {"tp_id": 0, "tile": (0, 0), "seq": 1,
+                                    "data": small * 2}),
+                    (TAG_MEM_PUT, {"tp_id": 0, "coll": "descA",
+                                   "args": (1, 0), "data": big}),
+                    (TAG_ACTIVATE, {"tp_id": 0, "root": 0, "ranks": [1],
+                                    "edges": {1: []}, "data": big + 1}),
+                ]
+                for tag, payload in msgs:
+                    e0.send_am(1, tag, payload)
+                    drained(e0, 1)
+                # frames rank 0's writer actually put on the wire,
+                # HELLO (the capability advertisement) excluded
+                frames = []
+                for name, bodies in captured.items():
+                    if "tcp-send-r0" in name:
+                        frames.extend(
+                            b for b in bodies
+                            if not (len(b) > 8 and b[8] == 3))  # K_HELLO
+                e0.fini()
+                e1.fini()
+                return frames
+            finally:
+                tcpmod._sendall_vec = orig
+
+    a = leg(False)
+    b = leg(False)
+    c = leg(True)
+    return {
+        "trace_frames_captured": len(a),
+        "trace_unset_bit_identical": bool(a and a == b),
+        "trace_mixed_version_bit_identical": bool(a and a == c),
+    }
+
+
+def bench_trace_inner(n=256, nb=64, delay_ms=3, chunk_bytes=8192) -> dict:
+    """BENCH_MODE=trace payload: the SAME 2-rank classic-runtime dpotrf
+    over REAL loopback TCP sockets on a throttled link (every data
+    message pays an injected ``delay_ms`` sleep; heartbeat/clock pings
+    stay sharp), flow tracing OFF vs ON.  The ON leg profiles, merges
+    the two rank traces onto one offset-corrected timeline, and
+    stitches the cross-rank flow edges; reported deltas are the cost
+    of the tracing itself (µs/task, wire bytes per message).  The
+    scripted byte-capture differential (``obs_flow`` unset / mixed-
+    version peer => bit-identical data frames) rides along."""
+    import concurrent.futures as cf
+    import tempfile
+    from contextlib import ExitStack
+
+    import parsec_tpu
+    from parsec_tpu.collections import TwoDimBlockCyclic
+    from parsec_tpu.comm import RemoteDepEngine
+    from parsec_tpu.comm.tcp import TCPCommEngine, free_ports
+    from parsec_tpu.obs import analyze, merge_trace_docs
+    from parsec_tpu.ops import dpotrf_taskpool, make_spd
+    from parsec_tpu.utils.params import params as _params
+
+    ranks = 2
+    M = make_spd(n, dtype=np.float32)
+    ntasks = _dpotrf_task_count((n + nb - 1) // nb)
+
+    def run_once(flow, prefix=None):
+        overrides = {
+            "comm_chunk_bytes": str(chunk_bytes),
+            "comm_mesh_local": "0",   # payloads must ride the wire
+            "ft_inject": f"delay:pct=100:ms={delay_ms}",
+            "obs_flow": "1" if flow else "0",
+        }
+        if prefix is not None:
+            overrides["profile"] = prefix
+        ports = free_ports(ranks)
+        eps = [("127.0.0.1", p) for p in ports]
+        with ExitStack() as st:
+            for k, v in overrides.items():
+                st.enter_context(_params.cmdline_override(k, v))
+
+            def rank_fn(r):
+                ce = TCPCommEngine(r, eps)
+                eng = RemoteDepEngine(ce)
+                ctx = parsec_tpu.Context(nb_cores=1, comm=eng)
+                try:
+                    t0 = time.perf_counter()
+                    coll = TwoDimBlockCyclic(
+                        n, n, nb, nb, dtype=np.float32,
+                        P=ranks, Q=1, nodes=ranks, rank=r)
+                    coll.name = "descA"
+                    coll.from_numpy(M.copy())
+                    tp = dpotrf_taskpool(coll, rank=r, nb_ranks=ranks)
+                    ctx.add_taskpool(tp)
+                    ctx.wait()
+                    wall = time.perf_counter() - t0
+                    if flow:
+                        # a breath for the clock sampler's last pongs,
+                        # so the exported offsets rest on several
+                        # midpoint samples
+                        time.sleep(0.3)
+                    stats = {
+                        "wall": wall,
+                        "msgs": ce.fabric.msg_count,
+                        "bytes": ce.fabric.bytes_count,
+                        "offsets": dict(ce.clock_offsets_us()),
+                    }
+                    return stats
+                finally:
+                    ctx.fini()
+
+            with cf.ThreadPoolExecutor(ranks) as ex:
+                return list(ex.map(rank_fn, range(ranks)))
+
+    out = {"trace_n": n, "trace_nb": nb, "trace_ranks": ranks,
+           "trace_link_delay_ms": delay_ms, "trace_tasks": ntasks}
+    run_once(False)   # warmup: kernel compiles
+    off = run_once(False)
+    with tempfile.TemporaryDirectory() as td:
+        prefix = os.path.join(td, "trace_bench")
+        on = run_once(True, prefix=prefix)
+        docs = []
+        for r in range(ranks):
+            with open(f"{prefix}.rank{r}.trace.json") as fh:
+                docs.append(json.load(fh))
+        merged = merge_trace_docs(docs)
+        report = analyze([merged])
+    cr = report.get("cross_rank") or {}
+    out["trace_off_wall_s"] = round(max(s["wall"] for s in off), 3)
+    out["trace_on_wall_s"] = round(max(s["wall"] for s in on), 3)
+    out["trace_us_per_task_off"] = round(
+        out["trace_off_wall_s"] / ntasks * 1e6, 2)
+    out["trace_us_per_task_on"] = round(
+        out["trace_on_wall_s"] / ntasks * 1e6, 2)
+    out["trace_us_per_task_delta"] = round(
+        out["trace_us_per_task_on"] - out["trace_us_per_task_off"], 2)
+    bpm_off = (sum(s["bytes"] for s in off)
+               / max(1, sum(s["msgs"] for s in off)))
+    bpm_on = (sum(s["bytes"] for s in on)
+              / max(1, sum(s["msgs"] for s in on)))
+    out["trace_wire_bytes_per_msg_off"] = round(bpm_off, 1)
+    out["trace_wire_bytes_per_msg_on"] = round(bpm_on, 1)
+    out["trace_added_wire_bytes_per_msg"] = round(bpm_on - bpm_off, 1)
+    out["trace_flow_edges"] = cr.get("flow_edges", 0)
+    out["trace_edges_per_link"] = cr.get("edges_per_link", {})
+    out["trace_unmatched_flows"] = cr.get("unmatched_flows", -1)
+    out["trace_min_lag_us"] = cr.get("min_lag_us")
+    out["trace_negative_lag_edges"] = cr.get("negative_lag_edges", -1)
+    dcp = cr.get("critical_path") or {}
+    out["trace_critpath_cross_edges"] = dcp.get("cross_edges", 0)
+    out["trace_per_link_exposed_us"] = cr.get("per_link_exposed_us", {})
+    out["trace_clock_offsets_us"] = [s["offsets"] for s in on]
+    out.update(bench_trace_capture_identity())
+    return out
+
+
+_TRACE_DRIVER = r"""
+import json, os, sys
+sys.path.insert(0, os.environ["BENCH_REPO"])
+import bench
+
+print(json.dumps(bench.bench_trace_inner(
+    n=int(os.environ.get("BENCH_TRACE_N", "256")),
+    nb=int(os.environ.get("BENCH_TRACE_NB", "64")),
+    delay_ms=int(os.environ.get("BENCH_TRACE_DELAY_MS", "3")))))
+"""
+
+
+def bench_trace(n=256, nb=64, delay_ms=3) -> dict:
+    """BENCH_MODE=trace: the flow-tracing off/on legs in a scrubbed CPU
+    subprocess (same pattern as bench_qwire: numbers must not depend on
+    the tunnel session's TPU plugin)."""
+    import subprocess
+    import sys as _sys
+
+    env = _scrubbed_bench_env(
+        n_devices=2,
+        BENCH_TRACE_N=n, BENCH_TRACE_NB=nb,
+        BENCH_TRACE_DELAY_MS=delay_ms)
+    try:
+        p = subprocess.run([_sys.executable, "-c", _TRACE_DRIVER],
+                           env=env, capture_output=True, text=True,
+                           timeout=1200)
+        if p.returncode != 0:
+            return {"trace_error": p.stdout[-200:] + p.stderr[-200:]}
+        return json.loads(p.stdout.strip().splitlines()[-1])
+    except Exception as exc:  # noqa: BLE001
+        return {"trace_error": repr(exc)[:200]}
+
+
+# ---------------------------------------------------------------------- #
 # stage-compile benchmark (ISSUE 12): classic-runtime dpotrf through     #
 # compiled stages vs the interpreted per-task/batched dispatch           #
 # ---------------------------------------------------------------------- #
@@ -2310,6 +2607,18 @@ def main() -> None:
             "metric_id": "qwire_int8_bytes_vs_lossless", "mode": mode,
             "value": extras.get("int8_bytes_vs_lossless", -1.0),
             "unit": "fraction", "extras": extras})
+        return
+    if mode == "trace":
+        extras = bench_trace(
+            n=int(os.environ.get("BENCH_TRACE_N", "256")),
+            nb=int(os.environ.get("BENCH_TRACE_NB", "64")),
+            delay_ms=int(os.environ.get("BENCH_TRACE_DELAY_MS", "3")))
+        emit_json({
+            "metric": "trace_us_per_task_delta(throttled_tcp_dpotrf,"
+                      "obs_flow_on_vs_off)",
+            "metric_id": "trace_us_per_task_delta", "mode": mode,
+            "value": extras.get("trace_us_per_task_delta", -1.0),
+            "unit": "us/task", "extras": extras})
         return
     if mode == "dispatch":
         extras = bench_dispatch(

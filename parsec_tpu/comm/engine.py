@@ -21,6 +21,36 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 
+class FlowIds:
+    """Per-rank allocator of wire trace contexts (ISSUE 15): a context
+    is the compact ``(origin_rank, span_id)`` pair stamped on data-plane
+    messages under the ``obs_flow`` knob, shared by the sender's and the
+    receiver's flow events so the fleet merge can stitch the edge.
+    Installed as ``ce._flow`` by the obs wiring — None keeps every send
+    on the one-attribute-check fast path."""
+
+    __slots__ = ("rank", "_next", "_lock")
+
+    def __init__(self, rank: int) -> None:
+        self.rank = rank
+        self._next = 0
+        self._lock = threading.Lock()
+
+    def next_ctx(self) -> Tuple[int, int]:
+        with self._lock:
+            self._next += 1
+            return (self.rank, self._next)
+
+
+#: data-plane tags that carry a wire trace context when flow tracing is
+#: negotiated: activations, GET request/reply, one-sided puts, DTD tile
+#: traffic, and memory writebacks — every payload is a dict, so the
+#: context rides a ``"_tr"`` key inside the pickled body (chunked
+#: transfers inherit it for free).  Control traffic (termdet, barrier,
+#: heartbeat, elastic) is never stamped.
+_FLOW_TAGS = frozenset((1, 2, 3, 4, 6, 7))  # values asserted below
+
+
 class Capabilities:
     def __init__(self, sided: int = 1, noncontig: bool = True,
                  multithread: bool = False) -> None:
@@ -104,6 +134,10 @@ class CommEngine:
         # instrumented site on the one-attribute-check fast path
         # (the PINS ``_active == 0`` pattern)
         self._obs: Optional[Any] = None
+        # cross-rank flow tracing (ISSUE 15): a FlowIds allocator when
+        # the ``obs_flow`` knob is on AND telemetry is wired — the same
+        # None-is-off pattern as ``_obs``
+        self._flow: Optional[FlowIds] = None
         # -- fault tolerance (ft/) -------------------------------------
         # uniform failure surface across ALL transports: the TCP engine
         # used to be the only one carrying these, forcing hasattr guards
@@ -179,6 +213,17 @@ class CommEngine:
             # counted at ARRIVAL (deferred or not) so sent/received
             # totals balance across ranks
             obs.am_arrived(src, tag, payload)
+            if tag in _FLOW_TAGS and isinstance(payload, dict):
+                # the sender's wire trace context (ISSUE 15): record the
+                # receive half of the flow edge at arrival — exactly
+                # once per message even when the tag defers, so every
+                # ``ph:"s"`` has its ``ph:"f"`` and the merged timeline
+                # stitches sender and receiver spans by one id.  Only
+                # data-plane tags: a USER payload's "_tr" key is the
+                # application's business, never interpreted
+                ctx = payload.get("_tr")
+                if ctx is not None:
+                    obs.flow_recv(src, tag, ctx)
         with self._deferred_lock:
             cb = self._tag_cbs.get(tag)
             if cb is None:
@@ -210,6 +255,44 @@ class CommEngine:
 
     def send_am(self, dst: int, tag: int, payload: Any) -> None:
         raise NotImplementedError
+
+    # -- cross-rank flow tracing (ISSUE 15) ---------------------------------
+    def flow_to(self, dst: int) -> bool:
+        """May a wire trace context travel toward ``dst``?  In-process
+        fabrics share this build (always True); the TCP engine gates on
+        the peer's HELLO ``"tr"`` capability, so a mixed-version peer's
+        wire bytes stay exactly what a knob-unset build would send."""
+        return True
+
+    def _flow_stamp(self, dst: int, tag: int,
+                    payload: Any) -> Tuple[Any, Optional[Tuple[int, int]]]:
+        """Stamp one outbound data-plane message with a fresh trace
+        context: returns ``(payload', ctx)`` where ``payload'`` is a
+        SHALLOW copy carrying ``"_tr": (origin_rank, span_id)`` — the
+        caller's dict is never mutated (one activation dict fans out to
+        several bcast children; each hop is its own flow edge).  ctx is
+        None for self-sends, control tags, non-dict payloads, and peers
+        the capability negotiation excluded — and on THAT path any
+        inbound ``"_tr"`` a re-forwarded message still carries is
+        STRIPPED (again on a copy): a bcast hop re-sends the received
+        dict, and the upstream context must neither leak to a
+        mixed-version peer (whose wire bytes are contractually
+        knob-unset-identical) nor fake a second receive half of the
+        upstream edge."""
+        if tag not in _FLOW_TAGS or not isinstance(payload, dict):
+            # control/user tags pass through UNTOUCHED — an application
+            # payload's "_tr" key is never ours to strip
+            return payload, None
+        fl = self._flow
+        if fl is None or dst == self.rank or not self.flow_to(dst):
+            if "_tr" in payload:
+                payload = dict(payload)
+                del payload["_tr"]
+            return payload, None
+        ctx = fl.next_ctx()
+        payload = dict(payload)
+        payload["_tr"] = ctx
+        return payload, ctx
 
     def mesh_local_with(self, peer: int) -> bool:
         """True when ``peer`` shares this process's XLA client, so a
@@ -419,3 +502,8 @@ TAG_MEM_PUT = 7
 TAG_HEARTBEAT = 8   # ft/ liveness probes (ping/pong AMs; tcp rides K_PING)
 TAG_ELASTIC = 9     # ft/ elastic membership (grid resize / join; K_ELASTIC)
 TAG_USER_BASE = 16
+
+# the flow-traced data-plane tag set is spelled with literals above
+# (the tags are defined after the class body); keep the two in sync
+assert _FLOW_TAGS == {TAG_ACTIVATE, TAG_GET_REQ, TAG_GET_DATA,
+                      TAG_PUT_DATA, TAG_DTD_DATA, TAG_MEM_PUT}

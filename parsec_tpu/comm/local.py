@@ -117,9 +117,14 @@ class LocalCommEngine(CommEngine):
         if obs is None:
             self._transport_post(dst, self.rank, tag, _wire_copy(payload))
             return
+        ctx = None
+        if self._flow is not None:
+            payload, ctx = self._flow_stamp(dst, tag, payload)
         t0 = time.monotonic_ns()
         self._transport_post(dst, self.rank, tag, _wire_copy(payload))
         obs.am_sent(self.rank, dst, tag, payload, t0)
+        if ctx is not None:
+            obs.flow_sent(dst, tag, ctx, t0)
 
     # -- one-sided emulation (GET-req AM + data reply) ----------------------
     def get(self, src_rank: int, remote_handle_id: int,
@@ -268,6 +273,15 @@ class LocalCommEngine(CommEngine):
         are directly addressable on every peer (the test-fabric analog
         of two ranks whose chips sit on one mesh/slice)."""
         return 0 <= peer < self.nb_ranks
+
+    def clock_offset_us(self, peer: int) -> float:
+        """In-process ranks share ONE monotonic clock: the cross-rank
+        trace offset (ISSUE 15) is identically zero — the estimator
+        only exists on cross-process transports (comm/tcp.py)."""
+        return 0.0
+
+    def clock_offsets_us(self) -> Dict[int, float]:
+        return {p: 0.0 for p in range(self.nb_ranks) if p != self.rank}
 
     def sync(self) -> None:
         self.fabric.barrier.wait()

@@ -451,16 +451,18 @@ class RemoteDepEngine:
                     return
                 raise
             self.ce.send_am(xf["src"], TAG_XFER_ACK, {"uuid": uuid})
-            self._deliver_activation(tp, my_edges, arr, msg.get("dtt"))
+            self._deliver_activation(tp, my_edges, arr, msg.get("dtt"),
+                                     tr=msg.get("_tr"))
             return
         if "data" in msg or msg.get("handle") is None:
             self._deliver_activation(tp, my_edges, msg.get("data"),
-                                     msg.get("dtt"))
+                                     msg.get("dtt"), tr=msg.get("_tr"))
         else:
             # rendezvous: GET the payload from the data holder — unless
             # a prefetched GET already fetched (or is fetching) it
             def on_data(arr):
-                self._deliver_activation(tp, my_edges, arr, msg.get("dtt"))
+                self._deliver_activation(tp, my_edges, arr, msg.get("dtt"),
+                                         tr=msg.get("_tr"))
             key = (msg["data_rank"], msg["handle"])
             rec = None
             with self._lock:
@@ -569,9 +571,16 @@ class RemoteDepEngine:
             self.stats["prefetch_cancels"] += dropped
 
     def _deliver_activation(self, tp, edges: List[Tuple], arr,
-                            dtt=None) -> None:
+                            dtt=None, tr=None) -> None:
         """Incoming data releases local successors
-        (ref: remote_dep_release_incoming, remote_dep_mpi.c:997)."""
+        (ref: remote_dep_release_incoming, remote_dep_mpi.c:997).
+
+        ``tr`` is the activation's wire trace context (ISSUE 15, None
+        when flow tracing is off): published thread-locally around the
+        activation walk so a compiled stage (stagec/runtime.py) can
+        record which wire flows fed it — covering the synchronous
+        delivery, the counts_ready replay, AND the rendezvous-GET
+        callback, none of which share a call signature."""
         copy = None
         if arr is not None:
             d = Data(nb_elts=arr.size)
@@ -582,12 +591,20 @@ class RemoteDepEngine:
             copy.version = 1
             copy.coherency = Coherency.OWNED
             d.attach_copy(copy)
+        if tr is not None:
+            from ..obs.spans import set_inbound_flow_ctx
+            set_inbound_flow_ctx(tuple(tr))
         ready = []
-        for (succ_tc_id, succ_locals, flow_name, _out) in edges:
-            tc = tp.task_classes[succ_tc_id]
-            t = tc.activate(tuple(succ_locals), flow_name, copy)
-            if t is not None:
-                ready.append(t)
+        try:
+            for (succ_tc_id, succ_locals, flow_name, _out) in edges:
+                tc = tp.task_classes[succ_tc_id]
+                t = tc.activate(tuple(succ_locals), flow_name, copy)
+                if t is not None:
+                    ready.append(t)
+        finally:
+            if tr is not None:
+                from ..obs.spans import set_inbound_flow_ctx
+                set_inbound_flow_ctx(None)
         if ready and self.context is not None:
             es0 = self.context.execution_streams[0]
             schedule(es0, ready)

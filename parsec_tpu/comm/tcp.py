@@ -149,6 +149,11 @@ _GUARDED_BY = {
     "_Peer.rs_rx_partial": "cond",
     "TCPCommEngine._peers": "_conn_cond",
     "TCPCommEngine.wire_stats": "_stat_lock",
+    # clock alignment (ISSUE 15): the per-peer offset EWMA + sample
+    # counts — written by the receiver thread (pong arrivals), read by
+    # the obs poll and the trace-metadata export
+    "TCPCommEngine._clock": "_stat_lock",
+    "TCPCommEngine._clock_n": "_stat_lock",
     "TCPCommEngine._rx_pending": "_stat_lock",
     "TCPCommEngine._xfer_iter": "_stat_lock",
     "TCPCommEngine._suspect_ms_total": "_stat_lock",
@@ -210,6 +215,7 @@ class _Peer:
     __slots__ = ("rank", "sock", "ctrl", "bulk", "cond", "writer",
                  "goodbye", "bw_mbps", "codec", "engaged", "frames",
                  "probe_ratio", "done", "queued_bytes", "hb_ok", "el_ok",
+                 "tr_ok",
                  "rs_ok", "hello_seen", "connected_at", "conn_gen",
                  "suspect", "suspect_since", "rs_epoch", "rs_tx_seq",
                  "rs_rx_seq", "rs_window", "rs_window_bytes", "rs_replay",
@@ -241,6 +247,7 @@ class _Peer:
         self.comp_post = 0
         self.hb_ok = False         # HELLO advertised heartbeat support
         self.el_ok = False         # HELLO advertised elastic membership
+        self.tr_ok = False         # HELLO advertised flow tracing ("tr")
         # -- reliable session (ISSUE 10) --------------------------------
         self.rs_ok = False         # both ends advertised "rs"
         self.hello_seen = False    # the peer's HELLO was processed
@@ -288,7 +295,8 @@ class TCPCommEngine(LocalCommEngine):
                  reconnect_backoff: Optional[float] = None,
                  replay_window_bytes: Optional[int] = None,
                  quantize: Optional[str] = None,
-                 quantize_threshold_mbps: Optional[float] = None) -> None:
+                 quantize_threshold_mbps: Optional[float] = None,
+                 obs_flow: Optional[bool] = None) -> None:
         from ..utils.params import params
         self._inbox: Fifo = Fifo()
         self._peers: Dict[int, _Peer] = {}
@@ -352,6 +360,23 @@ class TCPCommEngine(LocalCommEngine):
                 "comm_quantize_threshold_mbps", "int", 0)
         self.quantize_threshold_mbps = float(quantize_threshold_mbps or 0)
         self._codecs = wire.available_codecs()
+        # cross-rank flow tracing + clock alignment (ISSUE 15): when the
+        # ``obs_flow`` knob is set, the HELLO advertises a "tr"
+        # capability (symmetric like "rs"/"qz": an unset knob leaves
+        # every wire byte, HELLO included, bit-for-bit unchanged), data
+        # AMs toward tr-peers carry a (origin, span) trace context
+        # inside their pickled payload, and heartbeat pings toward
+        # tr-peers grow a trailing clock word — the pong echoes the
+        # responder's monotonic clock, feeding an NTP-style midpoint
+        # offset estimate per peer (EWMA, exported to the trace
+        # metadata so the fleet merge can fuse rank timelines)
+        if obs_flow is None:
+            obs_flow = bool(params.get_or("obs_flow", "bool", False))
+        self._flow_enabled = bool(obs_flow)
+        self._clock: Dict[int, float] = {}      # peer -> offset EWMA us
+        self._clock_n: Dict[int, int] = {}      # peer -> sample count
+        self._clock_stop = threading.Event()
+        self._clock_thread: Optional[threading.Thread] = None
         #: wire fast-path counters (plain dict: obs polls it when
         #: telemetry is on, nothing on the hot path otherwise)
         self.wire_stats = {
@@ -382,6 +407,15 @@ class TCPCommEngine(LocalCommEngine):
         deadline = time.time() + connect_timeout
         for peer in range(rank):
             self._dial(peer, deadline)
+        if self._flow_enabled and self.nb_ranks > 1:
+            # clock-alignment sampler (ISSUE 15): periodic extended
+            # pings toward tr-peers so offsets exist even when the
+            # heartbeat detector is not installed; the detector's own
+            # probes contribute extra samples for free
+            self._clock_thread = threading.Thread(
+                target=self._clock_loop, daemon=True,
+                name=f"tcp-clock-r{rank}")
+            self._clock_thread.start()
 
     # -- connection management ------------------------------------------
     def _dial(self, peer: int, deadline: float) -> None:
@@ -467,6 +501,13 @@ class TCPCommEngine(LocalCommEngine):
                 "hb": True,
                 "el": True,
                 "rs": self._rs_enabled}
+        if self._flow_enabled:
+            # flow tracing is advertised ONLY when the local knob is
+            # set (symmetric like "qz"): a knob-unset build keeps every
+            # wire byte — this HELLO included — bit-for-bit, and a
+            # mixed-version peer simply never negotiates, so neither
+            # trace contexts nor extended pings travel toward it
+            info["tr"] = True
         if self._quantize is not None:
             # quantized codecs are advertised ONLY when the local knob
             # is set — symmetric like "rs", so a knob-unset build keeps
@@ -577,6 +618,72 @@ class TCPCommEngine(LocalCommEngine):
                 pre, post = ((p.comp_pre, p.comp_post)
                              if p.codec == codec else (0, 0))
         return round(pre / post, 4) if post else 1.0
+
+    # -- clock alignment + flow tracing (ISSUE 15) ----------------------
+    #: EWMA smoothing of the per-peer offset estimate, and the sampler
+    #: thread's cadence: a quick burst for fresh links (offsets exist
+    #: within ~a second of the HELLO), then a slow steady trickle
+    _CLOCK_ALPHA = 0.25
+    _CLOCK_BURST = 4
+    _CLOCK_BURST_INTERVAL = 0.05
+    _CLOCK_INTERVAL = 0.25
+
+    def _note_clock(self, peer: int, offset_us: float) -> None:
+        with self._stat_lock:
+            cur = self._clock.get(peer)
+            self._clock[peer] = (offset_us if cur is None else
+                                 (1 - self._CLOCK_ALPHA) * cur
+                                 + self._CLOCK_ALPHA * offset_us)
+            self._clock_n[peer] = self._clock_n.get(peer, 0) + 1
+
+    def clock_offset_us(self, peer: int) -> Optional[float]:
+        """NTP-style estimate of ``peer_clock - my_clock`` in µs (the
+        ``PARSEC::OBS::CLOCK_OFFSET_US::R<peer>`` gauge; None until a
+        clock-extended pong has been measured)."""
+        with self._stat_lock:
+            off = self._clock.get(peer)
+        return None if off is None else round(off, 3)
+
+    def clock_offsets_us(self) -> Dict[int, float]:
+        """Every measured per-peer offset — stamped into the trace
+        metadata at export so tools/obs_trace_merge.py can fuse the
+        rank timelines onto one reference clock."""
+        with self._stat_lock:
+            return {p: round(v, 3) for p, v in self._clock.items()}
+
+    def _clock_loop(self) -> None:
+        """Dedicated sampler: one extended ping per tr-peer per tick.
+        Rides ``ft_ping`` (ctrl lane, receiver-thread pong), so the
+        chaos layer's ``hb=1`` directives shape these probes exactly
+        like detector probes — the clock-error-under-asymmetric-delay
+        tests inject through the same seam."""
+        seq = 1 << 24   # distinct range from the detector's seqs
+        rounds = 0
+        while not self._clock_stop.wait(
+                self._CLOCK_BURST_INTERVAL if rounds < self._CLOCK_BURST
+                else self._CLOCK_INTERVAL):
+            if self._closing or self._ft_silenced:
+                return
+            rounds += 1
+            with self._conn_cond:
+                peers = list(self._peers.values())
+            for p in peers:
+                if not p.tr_ok or p.done or p.rank in self.dead_peers \
+                        or p.rank in self.finished_peers:
+                    continue
+                seq += 1
+                try:
+                    self.ft_ping(p.rank, seq, time.monotonic_ns())
+                except Exception:  # noqa: BLE001 - sampling must not die
+                    pass
+
+    def flow_to(self, dst: int) -> bool:
+        """Trace contexts travel only toward peers whose HELLO
+        advertised ``"tr"`` — a mixed-version (or knob-unset) peer
+        receives byte-identical data-plane traffic."""
+        with self._conn_cond:
+            p = self._peers.get(dst)
+        return p is not None and p.tr_ok
 
     # -- reliable sessions (ISSUE 10) -----------------------------------
     def peer_suspect(self, peer: int) -> bool:
@@ -958,7 +1065,11 @@ class TCPCommEngine(LocalCommEngine):
         copies = self.ft_outbound(peer, TAG_HEARTBEAT)
         if copies == 0:
             return False
-        frame = wire.pack_ping(seq, t_ns)
+        # clock-alignment extension (ISSUE 15): extended pings only
+        # toward peers that negotiated "tr" — the responding pong
+        # carries the peer's clock, the midpoint-method sample
+        frame = wire.pack_ping(
+            seq, t_ns, clock_ns=0 if p.tr_ok else None)
         with p.cond:
             for _ in range(copies):
                 p.ctrl.append(("frame", frame))
@@ -1019,12 +1130,21 @@ class TCPCommEngine(LocalCommEngine):
         if dst == self.rank:
             payload = _wire_copy(payload)
         obs = self._obs
+        ctx = None
+        if self._flow is not None or self._flow_enabled:
+            # _flow_enabled without an armed allocator (knob on,
+            # telemetry off): the stamp declines but still STRIPS a
+            # re-forwarded inbound "_tr" — this rank advertised "tr",
+            # so upstream contexts reach it and must not leak onward
+            payload, ctx = self._flow_stamp(dst, tag, payload)
         if obs is None:
             self._transport_post(dst, self.rank, tag, payload)
             return
         t0 = time.monotonic_ns()
         self._transport_post(dst, self.rank, tag, payload)
         obs.am_sent(self.rank, dst, tag, payload, t0)
+        if ctx is not None:
+            obs.flow_sent(dst, tag, ctx, t0)
 
     def _transport_post(self, dst: int, src: int, tag: int, payload: Any) -> None:
         copies = self.ft_outbound(dst, tag)
@@ -1607,6 +1727,9 @@ class TCPCommEngine(LocalCommEngine):
                 self._codecs, info.get("codecs", ()))
             p.hb_ok = bool(info.get("hb"))
             p.el_ok = bool(info.get("el"))
+            # flow tracing negotiates SYMMETRICALLY like "rs": both
+            # ends must run with obs_flow set or neither stamps
+            p.tr_ok = bool(info.get("tr")) and self._flow_enabled
             with p.cond:
                 # quantize capability is symmetric like "rs": only a
                 # peer that advertised the requested codec under "qz"
@@ -1695,17 +1818,35 @@ class TCPCommEngine(LocalCommEngine):
             if det is not None:
                 det.note_alive(peer)
             if not p.done:
-                pong = wire.pack_ping(seq, t_ns, pong=True)
+                # an EXTENDED ping requests clock alignment (ISSUE 15):
+                # the pong echoes (seq, t_ns) and stamps THIS rank's
+                # monotonic clock in the trailing word — only ever in
+                # answer to an extension only tr-enabled peers send, so
+                # pongs toward mixed-version/knob-unset peers stay
+                # byte-identical
+                ext = wire.ping_clock(body)
+                pong = wire.pack_ping(
+                    seq, t_ns, pong=True,
+                    clock_ns=(time.monotonic_ns()
+                              if ext is not None else None))
                 with p.cond:
                     p.ctrl.append(("frame", pong))
                     p.queued_bytes += len(pong)
                     p.cond.notify()
         elif kind == wire.K_PONG:
             seq, t_ns = wire.parse_ping(body)
+            now_ns = time.monotonic_ns()
+            t_peer = wire.ping_clock(body)
+            if t_peer:
+                # midpoint method: the responder stamped its clock
+                # mid-round-trip — offset = peer_clock - my_clock
+                # assuming symmetric legs (error bounded by half the
+                # path asymmetry), folded into a per-peer EWMA
+                self._note_clock(
+                    peer, (t_peer - (t_ns + now_ns) / 2.0) / 1e3)
             det = self.ft_detector
             if det is not None:
-                det.note_alive(peer,
-                               rtt=(time.monotonic_ns() - t_ns) / 1e9)
+                det.note_alive(peer, rtt=(now_ns - t_ns) / 1e9)
         elif kind == wire.K_ELASTIC:
             # delivered HERE, on the receiver thread (like K_PING): a
             # resize proposal or join announcement must reach the
@@ -1841,6 +1982,10 @@ class TCPCommEngine(LocalCommEngine):
 
     def fini(self) -> None:
         self._closing = True
+        self._clock_stop.set()   # stand the clock sampler down first
+        t = self._clock_thread
+        if t is not None:
+            t.join(timeout=2.0)
         if self._ft_silenced:
             # injected kill: die WITHOUT a goodbye and WITHOUT flushing
             # — peers must learn of the death proactively (heartbeat) or

@@ -24,7 +24,10 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional
 
-from .critpath import analyze, critical_path, format_report, parse_dot
+from .critpath import (analyze, critical_path, distributed_critical_path,
+                       format_report, load_flow_events, merge_trace_docs,
+                       parse_dot, per_link_exposed_wait, rank_clock_shifts,
+                       stitch_flows)
 from .metrics import (COMM_XFER_SECONDS, TASK_EXEC_SECONDS, Histogram,
                       MetricsRegistry, MetricsTaskModule)
 from .prometheus import (fleet_to_prometheus, parse_exposition, render,
@@ -39,8 +42,10 @@ from .spans import (COMM_ACTIVE_TRANSFERS, COMM_BYTES_RECEIVED,
                     CommObs, DeviceObs,
                     FT_ELASTIC_JOINS, FT_ELASTIC_RESIZES, FT_HB_RTT_PREFIX,
                     FT_PEER_ALIVE, FT_RESHARD_BYTES, FT_RESHARD_US,
-                    OBS_EXPOSED_COMM_US, OBS_OVERLAP_FRACTION,
-                    OverlapTracker, payload_nbytes, register_device_gauges)
+                    OBS_CLOCK_OFFSET_PREFIX, OBS_EXPOSED_COMM_US,
+                    OBS_FLOW_RECV, OBS_FLOW_SENT, OBS_OVERLAP_FRACTION,
+                    OverlapTracker, flow_event_id, inbound_flow_ctx,
+                    payload_nbytes, register_device_gauges)
 
 __all__ = [
     "MetricsRegistry", "Histogram", "MetricsTaskModule", "ContextObs",
@@ -54,9 +59,14 @@ __all__ = [
     "FT_ELASTIC_RESIZES", "FT_ELASTIC_JOINS", "FT_RESHARD_BYTES",
     "FT_RESHARD_US",
     "OBS_OVERLAP_FRACTION", "OBS_EXPOSED_COMM_US",
+    "OBS_FLOW_SENT", "OBS_FLOW_RECV", "OBS_CLOCK_OFFSET_PREFIX",
+    "flow_event_id", "inbound_flow_ctx",
     "TASK_EXEC_SECONDS", "COMM_XFER_SECONDS",
     "render", "parse_exposition", "sanitize_name", "fleet_to_prometheus",
     "analyze", "critical_path", "format_report", "parse_dot",
+    "merge_trace_docs", "rank_clock_shifts", "stitch_flows",
+    "load_flow_events", "distributed_critical_path",
+    "per_link_exposed_wait",
     "validate_chrome_trace",
 ]
 
@@ -131,6 +141,20 @@ class ContextObs:
             if self.enabled:
                 ce._obs = comm_obs
                 self._engines.append(ce)
+                # cross-rank flow tracing (ISSUE 15): arm the wire
+                # trace-context allocator — sends toward negotiated
+                # peers stamp a (origin, span) context and emit the
+                # "s" half of the flow edge; deliver_message emits the
+                # "f" half on the receiver.  A transport that resolved
+                # the knob itself (TCPCommEngine's obs_flow ctor
+                # override) is the source of truth — it already
+                # advertised (or withheld) the "tr" capability
+                flow_on = getattr(ce, "_flow_enabled", None)
+                if flow_on is None:
+                    flow_on = _flow_param()
+                if flow_on:
+                    from ..comm.engine import FlowIds
+                    ce._flow = FlowIds(ce.rank)
             # remote-dep protocol counters as pull gauges
             stats = getattr(ctx.comm, "stats", None)
             if isinstance(stats, dict):
@@ -166,6 +190,7 @@ class ContextObs:
             self._profiler_with_hist = None
         for ce in self._engines:
             ce._obs = None
+            ce._flow = None
         self._engines.clear()
         for dev in self._devices:
             dev._obs = None
@@ -186,19 +211,32 @@ def _metrics_param() -> bool:
         return False
 
 
+def _flow_param() -> bool:
+    from ..utils.params import params
+    return bool(params.get_or("obs_flow", "bool", False))
+
+
 # ---------------------------------------------------------------------- #
 # minimal Chrome-trace schema check (used by the CI smoke test)          #
 # ---------------------------------------------------------------------- #
 def validate_chrome_trace(doc: Any) -> Dict[str, int]:
     """Validate the exported trace against the minimal schema Perfetto
     needs: a ``traceEvents`` list of dicts, each with a string ``name``
-    and ``ph``, numeric ``ts`` for non-metadata events, and — per
-    (pid, tid, name) — matched B/E counts. Returns summary counts;
-    raises ValueError on any violation."""
+    and ``ph``, numeric ``ts`` for non-metadata events, per
+    (pid, tid, name) matched B/E counts, and — for flow events
+    (``ph:"s"``/``"f"``, ISSUE 15) — a flow ``id`` per event with
+    start/finish PAIRING accounted order-independently (the receiver
+    half of an edge may precede the sender half in a merged list).
+    Returns summary counts including matched ``flows`` and the
+    ``unmatched_flows`` remainder (one-sided edges are a lost-message
+    or truncated-trace signal, not a schema violation); raises
+    ValueError on any violation."""
     if not isinstance(doc, dict) or not isinstance(
             doc.get("traceEvents"), list):
         raise ValueError("trace must be an object with a traceEvents list")
     opens: Dict[tuple, int] = {}
+    flow_s: Dict[Any, int] = {}
+    flow_f: Dict[Any, int] = {}
     n_spans = n_meta = n_counter = 0
     for i, ev in enumerate(doc["traceEvents"]):
         if not isinstance(ev, dict):
@@ -227,8 +265,17 @@ def validate_chrome_trace(doc: Any) -> Dict[str, int]:
             n_spans += 1
         elif ph == "C":
             n_counter += 1
+        elif ph in ("s", "f"):
+            if not isinstance(ev.get("id"), (int, str)):
+                raise ValueError(
+                    f"event {i} ({ev['name']}): flow event missing id")
+            side = flow_s if ph == "s" else flow_f
+            side[ev["id"]] = side.get(ev["id"], 0) + 1
     unclosed = {k: v for k, v in opens.items() if v}
     if unclosed:
         raise ValueError(f"unclosed spans: {sorted(unclosed)[:5]}")
+    matched = sum(min(n, flow_f.get(fid, 0)) for fid, n in flow_s.items())
+    total_flow_ev = sum(flow_s.values()) + sum(flow_f.values())
     return {"spans": n_spans, "metadata": n_meta, "counters": n_counter,
+            "flows": matched, "unmatched_flows": total_flow_ev - 2 * matched,
             "events": len(doc["traceEvents"])}

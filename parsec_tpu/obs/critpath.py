@@ -11,17 +11,32 @@ plus (optionally) the executed-DAG DOT written by the grapher
   class per rank (where the time went);
 - **compute/comm overlap fraction per rank** — the T3-style metric
   (arXiv:2401.16677): the fraction of communication time hidden under
-  task execution. 1.0 = perfectly overlapped, 0.0 = fully exposed.
+  task execution. 1.0 = perfectly overlapped, 0.0 = fully exposed;
+- **cross-rank section** (ISSUE 15, when the traces carry ``obs_flow``
+  flow events): stitched send→recv wire edges, a DISTRIBUTED critical
+  path that follows the binding constraint backwards across rank
+  boundaries, and a per-link exposed-wait attribution table — which
+  peer/link each rank's un-hidden comm time was spent waiting on.
 
-The CLI front end is ``tools/obs_report.py``.
+Rank traces from different processes sit on different monotonic clocks;
+:func:`rank_clock_shifts` aligns them from the ``trace_t0_ns`` +
+``clock_offsets_us`` metadata the context stamps at export (the
+ping/pong midpoint estimates, comm/tcp.py), and
+:func:`merge_trace_docs` fuses N per-rank documents into ONE
+offset-corrected Perfetto timeline (CLI: ``tools/obs_trace_merge.py``).
+
+The report CLI front end is ``tools/obs_report.py``.
 """
 from __future__ import annotations
 
 import re
 from typing import Any, Dict, List, Optional, Tuple
 
-__all__ = ["load_trace_intervals", "parse_dot", "critical_path",
-           "merge_intervals", "overlap_us", "analyze", "format_report"]
+__all__ = ["load_trace_intervals", "load_flow_events", "parse_dot",
+           "critical_path", "merge_intervals", "overlap_us",
+           "subtract_intervals", "rank_clock_shifts", "merge_trace_docs",
+           "stitch_flows", "distributed_critical_path",
+           "per_link_exposed_wait", "analyze", "format_report"]
 
 
 class Interval:
@@ -36,19 +51,22 @@ class Interval:
         return self.end - self.begin
 
 
-def load_trace_intervals(doc: Dict[str, Any]) -> List[Interval]:
+def load_trace_intervals(doc: Dict[str, Any],
+                         shift_us: float = 0.0) -> List[Interval]:
     """Intervals from complete ("X", ts+dur) events and from B/E pairs
     (matched per (pid, tid, name), LIFO — the same matching
     ``Profile.to_dataframe`` applies). Timestamps are the export's
-    microseconds."""
-    events = doc.get("traceEvents", doc if isinstance(doc, list) else [])
+    microseconds, plus ``shift_us`` (the per-rank clock correction
+    :func:`rank_clock_shifts` computes)."""
+    events = _doc_events(doc)
     out: List[Interval] = []
     # complete events carry their own duration — no pairing needed
     for e in events:
         if e.get("ph") == "X":
             out.append(Interval(e.get("pid", 0), e.get("tid", 0),
-                                e.get("name", ""), e["ts"],
-                                e["ts"] + e.get("dur", 0.0), e.get("args")))
+                                e.get("name", ""), e["ts"] + shift_us,
+                                e["ts"] + e.get("dur", 0.0) + shift_us,
+                                e.get("args")))
     # B/E events may interleave streams out of order in the list
     be = sorted(
         (e for e in events if e.get("ph") in ("B", "E")),
@@ -62,7 +80,341 @@ def load_trace_intervals(doc: Dict[str, Any]) -> List[Interval]:
             stack = open_ev.get(key)
             if stack:
                 ts0, args = stack.pop()
-                out.append(Interval(key[0], key[1], key[2], ts0, e["ts"], args))
+                out.append(Interval(key[0], key[1], key[2], ts0 + shift_us,
+                                    e["ts"] + shift_us, args))
+    return out
+
+
+def load_flow_events(doc: Dict[str, Any],
+                     shift_us: float = 0.0) -> List[Dict[str, Any]]:
+    """Flow-pair halves (``ph:"s"``/``"f"``, ISSUE 15) as plain dicts:
+    ``{"phase", "id", "pid", "tid", "name", "ts", "args"}`` with the
+    per-rank clock correction applied."""
+    events = _doc_events(doc)
+    out: List[Dict[str, Any]] = []
+    for e in events:
+        if e.get("ph") in ("s", "f"):
+            out.append({"phase": e["ph"], "id": e.get("id", 0),
+                        "pid": e.get("pid", 0), "tid": e.get("tid", 0),
+                        "name": e.get("name", ""),
+                        "ts": e.get("ts", 0.0) + shift_us,
+                        "args": e.get("args")})
+    return out
+
+
+# ---------------------------------------------------------------------- #
+# fleet merge: N per-rank traces onto one reference clock                #
+# ---------------------------------------------------------------------- #
+def _doc_events(doc: Any) -> List[Any]:
+    """The event list of a Chrome trace in either accepted form: an
+    object with ``traceEvents`` or a bare JSON array (the same duality
+    ``load_trace_intervals`` supports)."""
+    if isinstance(doc, list):
+        return doc
+    return doc.get("traceEvents", []) if isinstance(doc, dict) else []
+
+
+def _doc_meta(doc: Any) -> Dict[str, Any]:
+    meta = doc.get("metadata") if isinstance(doc, dict) else None
+    return meta if isinstance(meta, dict) else {}
+
+
+def _doc_rank(doc: Any) -> Optional[int]:
+    meta = _doc_meta(doc)
+    try:
+        return int(meta["rank"])
+    except (KeyError, TypeError, ValueError):
+        # fall back to the dominant pid of the events (pid == rank in
+        # every Profile export)
+        pids = [e.get("pid") for e in _doc_events(doc)
+                if isinstance(e, dict) and e.get("pid") is not None]
+        return pids[0] if pids else None
+
+
+def _doc_offsets(doc: Dict[str, Any]) -> Dict[int, float]:
+    """Per-peer clock offsets (peer_clock - this_rank_clock, µs) the
+    context stamped into the trace metadata at export."""
+    import json as _json
+    raw = _doc_meta(doc).get("clock_offsets_us")
+    if isinstance(raw, str):
+        try:
+            raw = _json.loads(raw)
+        except ValueError:
+            return {}
+    if not isinstance(raw, dict):
+        return {}
+    out = {}
+    for k, v in raw.items():
+        try:
+            out[int(k)] = float(v)
+        except (TypeError, ValueError):
+            continue
+    return out
+
+
+def rank_clock_shifts(docs: List[Dict[str, Any]]) -> Dict[int, float]:
+    """Per-document timestamp shift (µs, keyed by list index) that puts
+    every rank's events onto the REFERENCE rank's clock (the
+    lowest-numbered rank present).
+
+    A rank-r timestamp ``ts`` maps to monotonic ``t0_r + ts`` on rank
+    r's clock; the reference clock reads that instant as
+    ``t0_r + ts - off`` where ``off = clock_r - clock_ref`` — the
+    ping/pong midpoint estimate. The reference's own measurement of r
+    is preferred; r's measurement of the reference (negated) is the
+    fallback; 0 (same clock, e.g. in-process fabrics or a pre-merge
+    document without metadata) otherwise."""
+    ranks = [_doc_rank(d) for d in docs]
+    known = [r for r in ranks if r is not None]
+    if not known:
+        return {i: 0.0 for i in range(len(docs))}
+    ref_rank = min(known)
+    ref_i = ranks.index(ref_rank)
+    ref_meta = _doc_meta(docs[ref_i])
+    ref_t0 = float(ref_meta.get("trace_t0_ns", 0.0))
+    ref_offs = _doc_offsets(docs[ref_i])
+    shifts: Dict[int, float] = {}
+    for i, doc in enumerate(docs):
+        r = ranks[i]
+        if i == ref_i or r is None:
+            shifts[i] = 0.0
+            continue
+        meta = _doc_meta(doc)
+        t0 = float(meta.get("trace_t0_ns", ref_t0))
+        if r in ref_offs:
+            off = ref_offs[r]
+        else:
+            back = _doc_offsets(doc).get(ref_rank)
+            off = -back if back is not None else 0.0
+        shifts[i] = (t0 - ref_t0) / 1e3 - off
+    return shifts
+
+
+def merge_trace_docs(docs: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fuse N per-rank Chrome-trace documents into ONE offset-corrected
+    timeline: every event keeps its pid (= rank row in Perfetto), its
+    ``ts``/``dur`` shifted onto the reference rank's clock; flow pairs
+    (same id on an "s" in one rank row and an "f" in another) become
+    arrows CROSSING rank rows. The merged metadata records the applied
+    shifts — and no ``trace_t0_ns``, so re-merging is a no-op shift."""
+    shifts = rank_clock_shifts(docs)
+    events: List[Dict[str, Any]] = []
+    ranks: List[int] = []
+    applied: Dict[str, float] = {}
+    for i, doc in enumerate(docs):
+        r = _doc_rank(doc)
+        if r is not None:
+            ranks.append(r)
+            applied[str(r)] = round(shifts[i], 3)
+        sh = shifts[i]
+        for e in _doc_events(doc):
+            if not isinstance(e, dict):
+                continue
+            e = dict(e)
+            if isinstance(e.get("ts"), (int, float)):
+                e["ts"] = e["ts"] + sh
+            events.append(e)
+    return {"traceEvents": events,
+            "metadata": {"merged_ranks": sorted(set(ranks)),
+                         "clock_shifts_us": applied}}
+
+
+# ---------------------------------------------------------------------- #
+# cross-rank edge stitching + distributed critical path (ISSUE 15)       #
+# ---------------------------------------------------------------------- #
+def stitch_flows(flow_events: List[Dict[str, Any]]
+                 ) -> Tuple[List[Dict[str, Any]], int]:
+    """Pair "s"/"f" halves by flow id into send→recv edges:
+    ``{"id", "name", "src", "dst", "send_ts", "recv_ts", "lag_us"}``.
+    Returns (edges, unmatched_count) — a one-sided id is a truncated
+    trace or a lost message, counted but never fabricated into an
+    edge."""
+    sends: Dict[Any, Dict[str, Any]] = {}
+    recvs: Dict[Any, Dict[str, Any]] = {}
+    unmatched = 0
+    for ev in flow_events:
+        side = sends if ev["phase"] == "s" else recvs
+        if ev["id"] in side:
+            unmatched += 1   # duplicate half: keep the first
+            continue
+        side[ev["id"]] = ev
+    edges = []
+    for fid, s in sends.items():
+        f = recvs.pop(fid, None)
+        if f is None:
+            unmatched += 1
+            continue
+        edges.append({"id": fid, "name": s["name"],
+                      "src": s["pid"], "dst": f["pid"],
+                      "send_ts": s["ts"], "recv_ts": f["ts"],
+                      "lag_us": f["ts"] - s["ts"]})
+    unmatched += len(recvs)
+    edges.sort(key=lambda e: e["send_ts"])
+    return edges, unmatched
+
+
+#: slack for "happened at/just before" comparisons: clock-correction
+#: residue must not hide a genuinely-binding edge (µs)
+_CP_EPS = 1.0
+
+
+def distributed_critical_path(intervals: List[Interval],
+                              edges: List[Dict[str, Any]]
+                              ) -> Dict[str, Any]:
+    """The cross-rank critical path: a backward walk from the globally
+    last-finishing exec interval, at each step following whichever
+    constraint BOUND the current node's start — the latest preceding
+    exec interval on the same rank, or the latest inbound wire edge
+    (then the walk jumps to the sending rank at the send instant).
+    The standard last-gap-wins heuristic over distributed traces: it
+    needs no DAG capture, only the stitched flow edges."""
+    from bisect import bisect_right
+
+    by_end: Dict[int, List[Interval]] = {}
+    for iv in intervals:
+        if iv.name.startswith("exec:"):
+            by_end.setdefault(iv.pid, []).append(iv)
+    if not by_end:
+        return {"chain": [], "length_us": 0.0, "cross_edges": 0,
+                "ranks_visited": []}
+    # per rank, two sorted views + their key arrays so every backward
+    # step is a bisect, not a scan (merged fleet traces hold 10^5+
+    # intervals and the chain can run thousands of steps)
+    ends: Dict[int, List[float]] = {}
+    by_begin: Dict[int, List[Interval]] = {}
+    begins: Dict[int, List[float]] = {}
+    for pid, ivs in by_end.items():
+        ivs.sort(key=lambda iv: iv.end)
+        ends[pid] = [iv.end for iv in ivs]
+        bb = sorted(ivs, key=lambda iv: iv.begin)
+        by_begin[pid] = bb
+        begins[pid] = [iv.begin for iv in bb]
+    in_edges: Dict[int, List[Dict[str, Any]]] = {}
+    recv_keys: Dict[int, List[float]] = {}
+    for e in edges:
+        in_edges.setdefault(e["dst"], []).append(e)
+    for pid, evs in in_edges.items():
+        evs.sort(key=lambda e: e["recv_ts"])
+        recv_keys[pid] = [e["recv_ts"] for e in evs]
+
+    def _latest_before(pid: int, t: float,
+                       exclude: Optional[Interval]) -> Optional[Interval]:
+        ivs = by_end.get(pid, ())
+        i = bisect_right(ends.get(pid, ()), t + _CP_EPS) - 1
+        while i >= 0 and ivs[i] is exclude:
+            i -= 1
+        return ivs[i] if i >= 0 else None
+
+    def _containing(pid: int, t: float) -> Optional[Interval]:
+        """The interval covering (or most recently started before) t —
+        where the sending rank WAS when the edge left."""
+        i = bisect_right(begins.get(pid, ()), t + _CP_EPS) - 1
+        return by_begin[pid][i] if i >= 0 else None
+
+    cur = max((iv for ivs in by_end.values() for iv in ivs),
+              key=lambda iv: iv.end)
+    end_ts = cur.end
+    chain: List[Dict[str, Any]] = []
+    visited = set()
+    cross = 0
+    while cur is not None and id(cur) not in visited:
+        visited.add(id(cur))
+        node = {"rank": cur.pid, "name": cur.name,
+                "begin_us": cur.begin, "end_us": cur.end,
+                "dur_us": cur.duration}
+        if isinstance(cur.args, dict) and "task" in cur.args:
+            node["task"] = cur.args["task"]
+        chain.append(node)
+        t = cur.begin
+        prev = _latest_before(cur.pid, t, cur)
+        edge = None
+        evs = in_edges.get(cur.pid, ())
+        i = bisect_right(recv_keys.get(cur.pid, ()), t + _CP_EPS) - 1
+        if i >= 0:
+            edge = evs[i]
+        if edge is not None and (prev is None
+                                 or edge["recv_ts"] > prev.end):
+            # the inbound message is the binding constraint: cross to
+            # the sender's timeline at the send instant
+            cross += 1
+            chain.append({"edge": edge["name"],
+                          "link": f"R{edge['src']}->R{edge['dst']}",
+                          "send_ts_us": edge["send_ts"],
+                          "recv_ts_us": edge["recv_ts"],
+                          "lag_us": round(edge["lag_us"], 1)})
+            cur = _containing(edge["src"], edge["send_ts"])
+            if cur is not None and id(cur) in visited:
+                cur = None   # revisit guard: the edge stays as the
+                #              chain's (wire-arrival) head
+        else:
+            cur = prev
+    chain.reverse()
+    # the path may legitimately BEGIN with a wire edge (no producer
+    # interval known at/before the send instant): the send instant is
+    # then the path start, so the edge's lag counts toward the length
+    start_ts = next((n.get("begin_us", n.get("send_ts_us"))
+                     for n in chain), end_ts)
+    return {"chain": chain,
+            "length_us": end_ts - start_ts,
+            "cross_edges": cross,
+            "ranks_visited": sorted({n["rank"] for n in chain
+                                     if "rank" in n})}
+
+
+def subtract_intervals(a: List[Tuple[float, float]],
+                       b: List[Tuple[float, float]]
+                       ) -> List[Tuple[float, float]]:
+    """``a \\ b`` for MERGED interval lists: the parts of ``a`` no
+    interval of ``b`` covers (the exposed remainder)."""
+    out: List[Tuple[float, float]] = []
+    j = 0
+    for lo, hi in a:
+        cur = lo
+        while j < len(b) and b[j][1] <= cur:
+            j += 1
+        k = j
+        while k < len(b) and b[k][0] < hi:
+            if b[k][0] > cur:
+                out.append((cur, b[k][0]))
+            cur = max(cur, b[k][1])
+            if cur >= hi:
+                break
+            k += 1
+        if cur < hi:
+            out.append((cur, hi))
+    return out
+
+
+def per_link_exposed_wait(intervals: List[Interval]
+                          ) -> Dict[int, Dict[str, float]]:
+    """Per-rank attribution of EXPOSED comm time to named links: each
+    comm span whose args carry a peer (``src`` = inbound wait,
+    ``dst`` = outbound send) contributes the part of itself no compute
+    hid, summed per ``R<src>->R<dst>`` — "rank 2's exposed comm is 78%
+    waiting on R0->R2 activations" becomes a table lookup."""
+    by_rank: Dict[int, List[Interval]] = {}
+    for iv in intervals:
+        by_rank.setdefault(iv.pid, []).append(iv)
+    out: Dict[int, Dict[str, float]] = {}
+    for rank, ivs in by_rank.items():
+        compute = merge_intervals([(iv.begin, iv.end) for iv in ivs
+                                   if _is_compute(iv)])
+        links: Dict[str, float] = {}
+        for iv in ivs:
+            if not _is_comm(iv) or not isinstance(iv.args, dict):
+                continue
+            if "src" in iv.args and iv.args["src"] != rank:
+                link = f"R{iv.args['src']}->R{rank}"
+            elif "dst" in iv.args and iv.args["dst"] != rank:
+                link = f"R{rank}->R{iv.args['dst']}"
+            else:
+                continue
+            exposed = iv.duration - overlap_us([(iv.begin, iv.end)],
+                                               compute)
+            if exposed > 0:
+                links[link] = links.get(link, 0.0) + exposed
+        out[rank] = {k: round(v, 1) for k, v in
+                     sorted(links.items(), key=lambda kv: -kv[1])}
     return out
 
 
@@ -183,10 +535,16 @@ def _is_comm(iv: Interval) -> bool:
 def analyze(trace_docs: List[Dict[str, Any]],
             dot_text: Optional[str] = None) -> Dict[str, Any]:
     """Build the full report from one or more rank trace documents
-    (already-parsed Chrome JSON) and an optional grapher DOT."""
+    (already-parsed Chrome JSON) and an optional grapher DOT. Multiple
+    per-rank documents are clock-aligned first (``trace_t0_ns`` +
+    ``clock_offsets_us`` metadata, 0-shift when absent) so cross-rank
+    flow edges stitch on one timeline."""
+    shifts = rank_clock_shifts(trace_docs)
     intervals: List[Interval] = []
-    for doc in trace_docs:
-        intervals.extend(load_trace_intervals(doc))
+    flow_events: List[Dict[str, Any]] = []
+    for i, doc in enumerate(trace_docs):
+        intervals.extend(load_trace_intervals(doc, shifts[i]))
+        flow_events.extend(load_flow_events(doc, shifts[i]))
 
     # per-task-class breakdown per rank
     by_class: Dict[int, Dict[str, Dict[str, float]]] = {}
@@ -247,6 +605,33 @@ def analyze(trace_docs: List[Dict[str, Any]],
         "overlap": overlap,
     }
 
+    if flow_events:
+        # cross-rank causal section (ISSUE 15): stitched wire edges,
+        # the distributed critical path over them, and the per-link
+        # exposed-wait attribution
+        edges, unmatched = stitch_flows(flow_events)
+        cross = [e for e in edges if e["src"] != e["dst"]]
+        by_dir: Dict[str, int] = {}
+        neg = 0
+        min_lag = None
+        for e in cross:
+            key = f"R{e['src']}->R{e['dst']}"
+            by_dir[key] = by_dir.get(key, 0) + 1
+            if e["lag_us"] < 0:
+                neg += 1
+            min_lag = e["lag_us"] if min_lag is None \
+                else min(min_lag, e["lag_us"])
+        report["cross_rank"] = {
+            "flow_edges": len(cross),
+            "edges_per_link": by_dir,
+            "unmatched_flows": unmatched,
+            "negative_lag_edges": neg,
+            "min_lag_us": round(min_lag, 1) if min_lag is not None
+            else None,
+            "critical_path": distributed_critical_path(intervals, cross),
+            "per_link_exposed_us": per_link_exposed_wait(intervals),
+        }
+
     if dot_text:
         _labels, edges = parse_dot(dot_text)
         length, path = critical_path(task_durations, edges)
@@ -291,4 +676,38 @@ def format_report(report: Dict[str, Any]) -> str:
                    f"exposed={ov.get('exposed_comm_us', 0.0) / 1e3:.3f} ms "
                    f"({ov.get('exposed_share_of_makespan', 0.0):.1%} of "
                    f"makespan)")
+    cr = report.get("cross_rank")
+    if cr is not None:
+        out.append(f"cross-rank flow edges: {cr['flow_edges']} "
+                   f"({cr['unmatched_flows']} unmatched, "
+                   f"{cr['negative_lag_edges']} negative-lag) per link: "
+                   + (", ".join(f"{k}={v}" for k, v in
+                                sorted(cr["edges_per_link"].items()))
+                      or "none"))
+        dcp = cr["critical_path"]
+        out.append(f"distributed critical path: "
+                   f"{dcp['length_us'] / 1e3:.3f} ms crossing "
+                   f"{dcp['cross_edges']} wire edge(s) over ranks "
+                   f"{dcp['ranks_visited']}")
+        steps = []
+        for n in dcp["chain"][:12]:
+            if "link" in n:
+                steps.append(f"={n['link']}=>")
+            else:
+                steps.append(n.get("task") or n["name"])
+        if steps:
+            out.append("  chain: " + " ".join(steps)
+                       + (" ..." if len(dcp["chain"]) > 12 else ""))
+        out.append("exposed wait per link (µs of un-hidden comm, "
+                   "by rank):")
+        for rank in sorted(cr["per_link_exposed_us"]):
+            links = cr["per_link_exposed_us"][rank]
+            total = sum(links.values())
+            if not links:
+                out.append(f"  rank {rank}: none")
+                continue
+            parts = ", ".join(
+                f"{lk}={us:.0f} ({us / total:.0%})"
+                for lk, us in links.items())
+            out.append(f"  rank {rank}: {parts}")
     return "\n".join(out)

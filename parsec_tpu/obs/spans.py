@@ -31,6 +31,8 @@ __all__ = ["CommObs", "DeviceObs", "OverlapTracker",
            "COMM_DUP_DROPPED", "COMM_SUSPECT_MS",
            "FT_PEER_ALIVE", "FT_HB_RTT_PREFIX",
            "OBS_OVERLAP_FRACTION", "OBS_EXPOSED_COMM_US",
+           "OBS_FLOW_SENT", "OBS_FLOW_RECV", "OBS_CLOCK_OFFSET_PREFIX",
+           "flow_event_id", "inbound_flow_ctx", "set_inbound_flow_ctx",
            "payload_nbytes"]
 
 COMM_BYTES_SENT = "PARSEC::COMM::BYTES_SENT"
@@ -76,6 +78,38 @@ FT_RESHARD_US = "PARSEC::FT::RESHARD_US"
 # zero-comm rank (nothing to hide = nothing exposed).
 OBS_OVERLAP_FRACTION = "PARSEC::OBS::OVERLAP_FRACTION"
 OBS_EXPOSED_COMM_US = "PARSEC::OBS::EXPOSED_COMM_US"
+# cross-rank flow tracing (ISSUE 15): wire trace contexts stamped on
+# data-plane messages under the ``obs_flow`` knob — FLOW_SENT counts
+# the sender halves ("s" flow events), FLOW_RECV the receiver halves
+# ("f"); and the NTP-style per-peer clock-offset estimate in µs
+# (PARSEC::OBS::CLOCK_OFFSET_US::R<peer>, peer_clock - my_clock, 0
+# until measured; identically 0 on same-clock in-process fabrics)
+OBS_FLOW_SENT = "PARSEC::OBS::FLOW_SENT"
+OBS_FLOW_RECV = "PARSEC::OBS::FLOW_RECV"
+OBS_CLOCK_OFFSET_PREFIX = "PARSEC::OBS::CLOCK_OFFSET_US"
+
+
+def flow_event_id(ctx: Tuple[int, int]) -> int:
+    """The Chrome-trace flow id of one wire trace context: the span id
+    with the origin rank in the high bits, so ids from every rank's
+    allocator stay globally unique in a merged timeline."""
+    origin, span = ctx
+    return (int(origin) << 40) | (int(span) & ((1 << 40) - 1))
+
+
+#: inbound trace context of the message currently being delivered on
+#: this thread (remote_dep sets it around the activation walk) — how a
+#: compiled stage task learns which wire flows fed it without any
+#: signature change through the activate chain (stagec/runtime.py)
+_INBOUND_TLS = threading.local()
+
+
+def inbound_flow_ctx() -> Optional[Tuple[int, int]]:
+    return getattr(_INBOUND_TLS, "ctx", None)
+
+
+def set_inbound_flow_ctx(ctx: Optional[Tuple[int, int]]) -> None:
+    _INBOUND_TLS.ctx = ctx
 
 #: trace stream ids (outside any plausible worker th_id range)
 COMM_STREAM_TID = 1 << 20
@@ -260,6 +294,26 @@ class CommObs:
             st.span(f"comm:deliver:{_tag_name(tag)}", t0_ns,
                     time.monotonic_ns(), {"src": src, "dst": me, "tag": tag})
 
+    # -- cross-rank flow edges (ISSUE 15) ------------------------------------
+    def flow_sent(self, dst: int, tag: int, ctx: Any, t0_ns: int) -> None:
+        """The sender half of one wire flow edge: the message left with
+        trace context ``ctx`` stamped on it at enqueue time ``t0_ns``."""
+        self.metrics.sde.inc(OBS_FLOW_SENT)
+        st = self.stream
+        if st is not None:
+            st.flow(f"flow:{_tag_name(tag)}", flow_event_id(ctx), "s",
+                    t0_ns, {"dst": dst})
+
+    def flow_recv(self, src: int, tag: int, ctx: Any) -> None:
+        """The receiver half: a message carrying ``ctx`` arrived —
+        recorded once per message at arrival (deferred or not), so the
+        merged timeline stitches exactly one edge per wire hop."""
+        self.metrics.sde.inc(OBS_FLOW_RECV)
+        st = self.stream
+        if st is not None:
+            st.flow(f"flow:{_tag_name(tag)}", flow_event_id(ctx), "f",
+                    time.monotonic_ns(), {"src": src})
+
     # -- one-sided transfers -------------------------------------------------
     def get_begin(self, token: int, src_rank: int) -> None:
         self._open_gets[token] = time.monotonic_ns()
@@ -367,6 +421,24 @@ class CommObs:
                     lambda c=ce, p=peer: (lambda b: 0.0 if b is None
                                           else round(b, 3))(
                         c.link_bw_mbps(p)))
+        flow_on = getattr(ce, "_flow_enabled", None)
+        if flow_on is None:
+            from ..utils.params import params
+            flow_on = bool(params.get_or("obs_flow", "bool", False))
+        if flow_on and hasattr(ce, "clock_offset_us"):
+            # per-peer clock-offset estimate (ISSUE 15): peer_clock -
+            # my_clock in µs, 0 until a clock-extended pong landed (and
+            # identically 0 on same-clock in-process fabrics).  Only
+            # under the knob: a big fleet with metrics on must not pay
+            # nb_ranks-1 lock-taking polls per sample for a feature
+            # that is off
+            for peer in range(ce.nb_ranks):
+                if peer == ce.rank:
+                    continue
+                sde.register_poll(
+                    f"{OBS_CLOCK_OFFSET_PREFIX}::R{peer}",
+                    lambda c=ce, p=peer: (lambda o: 0.0 if o is None
+                                          else o)(c.clock_offset_us(p)))
         es = getattr(ce, "elastic_stats", None)
         if es is not None:
             sde.register_poll(FT_ELASTIC_RESIZES,

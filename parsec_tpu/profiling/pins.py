@@ -117,8 +117,16 @@ class TaskProfilerModule(PinsModule):
         if event in (PinsEvent.EXEC_BEGIN,):
             if self.exec_timer is not None:
                 self.exec_timer.begin(es.th_id)
-            stream.begin("exec:" + name,
-                         info={"task": payload.snprintf()} if payload is not None else None)
+            info = {"task": payload.snprintf()} if payload is not None else None
+            # a task class may pin extra span context (stagec/runtime:
+            # a compiled stage's member list + the wire trace contexts
+            # that fed it, so the merged timeline can attribute the
+            # fused span to its cross-rank inputs)
+            extra = getattr(payload.task_class, "trace_info", None) \
+                if payload is not None else None
+            if extra:
+                info = {**(info or {}), **extra}
+            stream.begin("exec:" + name, info=info)
         elif event in (PinsEvent.EXEC_END,):
             stream.end("exec:" + name)
             if self.exec_timer is not None:

@@ -78,6 +78,19 @@ class ThreadStream:
     def counter(self, key: str, value: float) -> None:
         self.events.append((time.monotonic_ns(), "C", key, value))
 
+    def flow(self, key: str, flow_id: int, phase: str, ts_ns: int,
+             info: Any = None) -> None:
+        """Append one half of a Chrome-trace FLOW pair (ISSUE 15):
+        ``phase`` is ``"s"`` (start, the sender's enqueue) or ``"f"``
+        (finish, the receiver's delivery).  The two halves share
+        ``flow_id`` — Perfetto draws an arrow from the slice enclosing
+        the start to the slice enclosing the finish, which for comm
+        spans means an arrow crossing rank rows in a merged timeline."""
+        assert phase in ("s", "f"), phase
+        info = dict(info) if isinstance(info, dict) else {}
+        info["flow_id"] = flow_id
+        self.events.append((ts_ns, phase, key, info))
+
 
 class Profile:
     """One trace per rank (ref: parsec_profiling_dbp_start, parsec.c:706-726)."""
@@ -137,13 +150,33 @@ class Profile:
                 elif ph == "C":
                     ev["ph"] = "C"
                     ev["args"] = {key: info}
+                elif ph in ("s", "f"):
+                    # flow pair halves (ISSUE 15): same id on the "s"
+                    # (sender) and "f" (receiver) events = one arrow
+                    # between the enclosing slices in Perfetto
+                    ev["ph"] = ph
+                    ev["cat"] = "flow"
+                    ev["id"] = (info or {}).get("flow_id", 0)
+                    if ph == "f":
+                        ev["bp"] = "e"   # bind to the ENCLOSING slice
+                    args = {k: v for k, v in (info or {}).items()
+                            if k != "flow_id"}
+                    if args:
+                        ev["args"] = args
                 else:
                     ev["ph"] = "i"
                     ev["s"] = "t"
                 if info is not None and ph == "B":
                     ev["args"] = info if isinstance(info, dict) else {"info": info}
                 events.append(ev)
-        return {"traceEvents": events, "metadata": self.info}
+        # rank + the monotonic origin of this profile's normalized
+        # timestamps: what tools/obs_trace_merge.py needs to put N rank
+        # traces back onto ONE clock (offset-corrected via the
+        # "clock_offsets_us" metadata the context stamps at export)
+        meta = dict(self.info)
+        meta.setdefault("rank", self.rank)
+        meta.setdefault("trace_t0_ns", self._t0)
+        return {"traceEvents": events, "metadata": meta}
 
     def dump(self, path: str) -> str:
         """Write the Chrome trace JSON; returns the path written."""

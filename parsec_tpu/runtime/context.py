@@ -160,6 +160,7 @@ class Context:
         self.profile: Optional[Profile] = None
         self._prof_prefix = None
         self._task_profiler = None
+        self._forensics_dumped = False
         if profile or prof_prefix:
             self.profile = Profile(rank=rank)
             # files written at fini only when a prefix was configured;
@@ -412,6 +413,12 @@ class Context:
                 pools = list(self.taskpools.values())
             for tp in pools:
                 tp.abort()
+            # failure forensics (ISSUE 15): under an active file-backed
+            # profile, a rank-failure abort flight-records its trace
+            # NOW — fini may never run cleanly on an aborting fleet,
+            # and a chaos-gate failure should leave a mergeable
+            # post-mortem per rank (tools/chaos_run.py collects them)
+            self.dump_forensics(reason=repr(exc))
         # no count argument: nb_cores is not yet set when a transport
         # thread reports a dead peer during comm.attach() in __init__
         # (the same init-race window as the arrival wakeup fix), and
@@ -464,6 +471,48 @@ class Context:
             plog.debug.verbose(2, "ft: dropped %d stale ready task(s) "
                                "from the aborted DAG", drained)
         return errors
+
+    def _stamp_profile_meta(self) -> None:
+        """Trace metadata for the fleet merge (ISSUE 15): the rank and
+        the measured per-peer clock offsets (µs) land in the profile's
+        info dict, which ``to_chrome_trace`` exports as metadata next
+        to ``trace_t0_ns`` — everything ``tools/obs_trace_merge.py``
+        needs to fuse N rank timelines onto one clock."""
+        if self.profile is None:
+            return
+        import json as _json
+        self.profile.add_information("rank", self.rank)
+        ce = getattr(self.comm, "ce", self.comm) \
+            if self.comm is not None else None
+        fn = getattr(ce, "clock_offsets_us", None)
+        if callable(fn):
+            try:
+                offs = fn()
+            except Exception:  # noqa: BLE001 - metadata must not abort
+                offs = {}
+            if offs:
+                self.profile.add_information(
+                    "clock_offsets_us",
+                    _json.dumps({str(k): v for k, v in offs.items()}))
+
+    def dump_forensics(self, reason: str = "taskpool abort") -> str:
+        """Flight-recorder export: write the live profile's trace to
+        ``<profile prefix>.forensics.rank<r>.trace.json`` (once per
+        context; no-op without a file-backed profile). Returns the
+        path written, or ""."""
+        if self.profile is None or not self._prof_prefix \
+                or self._forensics_dumped:
+            return ""
+        self._forensics_dumped = True
+        try:
+            self._stamp_profile_meta()
+            self.sample_sde_counters()
+            path = self.profile.dump(f"{self._prof_prefix}.forensics")
+        except Exception as exc:  # noqa: BLE001 - must not mask the abort
+            plog.warning("forensics trace export failed: %r", exc)
+            return ""
+        plog.warning("forensics trace written to %s (%s)", path, reason)
+        return path
 
     def raise_pending_error(self) -> None:
         if self._task_errors:
@@ -660,6 +709,7 @@ class Context:
             debug_history.disable()  # refcounted across live contexts
             self._debug_history_on = False
         if self.profile is not None and self._prof_prefix:
+            self._stamp_profile_meta()
             self.sample_sde_counters()
             path = self.profile.dump(self._prof_prefix)
             bpath = self.profile.dump_binary(self._prof_prefix)

@@ -70,6 +70,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..data.data import Coherency, Data, DataCopy, FlowAccess
+from ..obs.spans import inbound_flow_ctx
 from ..runtime.taskpool import (ACTION_RELEASE_ALL, Chore, Flow, Task,
                                 TaskClass)
 from ..utils import logging as plog
@@ -89,6 +90,7 @@ _GUARDED_BY = {
     "_StageRec.remaining": "_lock",
     "_StageRec.events": "_lock",
     "_StageRec.status": "_lock",
+    "_StageRec.flow_ctxs": "_lock",
     "StageCompiler._rg_left": "_rg_lock",
     "StageCompiler._rg_buf": "_rg_lock",
 }
@@ -118,6 +120,12 @@ class _StageRec:
         self._lock = threading.Lock()
         self.remaining = layout.goal
         self.events: List[Tuple] = []   # (member_key, flow, copy) buffered
+        # wire trace contexts of the remote activations that fed this
+        # stage (ISSUE 15; only collected while a profile is live) —
+        # stamped onto the stage task's exec span so the merged
+        # timeline can attribute the fused span to its cross-rank
+        # inputs
+        self.flow_ctxs: List[Tuple] = []
         self.status = _PENDING
         self.fn = None                  # fused jitted callable
         self.sharded = None             # (fn, sharding, info) or None
@@ -177,6 +185,9 @@ class StageCompiler:
         self.context = context
         self.plan = plan
         self.stats = context.stage_stats
+        # span-context collection (ISSUE 15) only while a profile is
+        # live: the activate redirect is a hot path
+        self._trace_on = context.profile is not None
         from .lower import spec_codes
         self._codes = spec_codes(tp)
         self._token = spec_token(tp)
@@ -274,6 +285,13 @@ class StageCompiler:
                 # program — swallow
                 return True, None
             rec.events.append(((tc.ast.name, locals_), flow_name, copy))
+            if self._trace_on:
+                # which wire flow (if any) delivered this activation:
+                # remote_dep publishes the inbound context thread-
+                # locally around the activation walk (obs/spans.py)
+                fctx = inbound_flow_ctx()
+                if fctx is not None:
+                    rec.flow_ctxs.append(fctx)
             rec.remaining -= 1
             assert rec.remaining >= 0, \
                 f"{tc.ast.name}{locals_}: stage overshoot"
@@ -677,6 +695,19 @@ class StageCompiler:
             rec.sharded = self._try_sharded(rec)
         self._count_prestage_hits(rec)
         tc = StageTaskClass(self, rec)
+        if self._trace_on:
+            # stage-task spans carry member contexts (ISSUE 15): the
+            # fused exec span lists its member tasks and the wire flow
+            # ids that fed it, so the merged timeline can tie one
+            # stage slice to its cross-rank inputs
+            with rec._lock:
+                ctxs = list(rec.flow_ctxs)
+            tc.trace_info = {
+                "stage_members": rec.stage.n_tasks,
+                "member_tasks": [f"{m.key[0]}{tuple(m.key[1])}"
+                                 for m in rec.stage.members[:16]],
+                "wire_flows": [f"R{o}:{s}" for (o, s) in ctxs[:32]],
+            }
         task = Task(self.tp, tc, locals_=(rec.stage.index,),
                     priority=rec.priority)
         task.user = rec

@@ -306,6 +306,15 @@ def register_core_params() -> None:
                     "collect runtime metrics (latency histograms + comm/"
                     "device counters) without full trace capture; "
                     "exposition via obs.prometheus / the aggregator")
+    params.reg_bool("obs_flow", False,
+                    "cross-rank flow tracing (ISSUE 15): stamp data-"
+                    "plane messages with a (origin, span) trace "
+                    "context negotiated via the HELLO \"tr\" "
+                    "capability, estimate per-peer clock offsets from "
+                    "extended ping/pong exchanges, and emit Chrome-"
+                    "trace flow events so tools/obs_trace_merge.py "
+                    "can fuse rank timelines; off (default) keeps "
+                    "every wire byte bit-for-bit unchanged")
     params.reg_string("profiling_dot", "",
                       "capture the executed DAG; path prefix for DOT files "
                       "(ref: --parsec_dot)")

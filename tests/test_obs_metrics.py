@@ -394,3 +394,52 @@ def test_overlap_gauges_in_exposition():
     # never prefetches — the live >0 case rides test_overlap_pipeline)
     for suffix in ("gets", "hits", "misses", "cancels"):
         assert val(f"parsec_comm_prefetch_{suffix}") == 0.0
+
+
+def test_flow_and_clock_gauges_in_exposition():
+    """ISSUE 15 acceptance: the FLOW_SENT/FLOW_RECV counters and the
+    per-peer CLOCK_OFFSET_US gauges surface in the Prometheus
+    exposition during a flow-traced 2-rank run."""
+    from parsec_tpu.collections import TwoDimBlockCyclic
+    from parsec_tpu.comm import LocalFabric, RemoteDepEngine
+    from parsec_tpu.ops import dpotrf_taskpool, make_spd
+    from parsec_tpu.utils.params import params
+    from tests.conftest import spmd
+
+    n, nb, ranks = 128, 32, 2
+    M = make_spd(n, dtype=np.float32)
+    with params.cmdline_override("metrics", "1"), \
+            params.cmdline_override("obs_flow", "1"), \
+            params.cmdline_override("comm_mesh_local", "0"):
+        def rank_fn(r, fab):
+            eng = RemoteDepEngine(fab.engine(r))
+            ctx = parsec_tpu.Context(nb_cores=1, comm=eng)
+            try:
+                coll = TwoDimBlockCyclic(n, n, nb, nb, dtype=np.float32,
+                                         P=ranks, Q=1, nodes=ranks,
+                                         rank=r)
+                coll.name = "descA"
+                coll.from_numpy(M.copy())
+                ctx.add_taskpool(dpotrf_taskpool(coll, rank=r,
+                                                 nb_ranks=ranks))
+                ctx.wait()
+                return ctx.obs.render_prometheus(
+                    labels={"rank": str(r)})
+            finally:
+                ctx.fini()
+        texts, _fab = spmd(ranks, rank_fn)
+    total_sent = total_recv = 0.0
+    for r, text in enumerate(texts):
+        samples = parse_exposition(text)
+
+        def val(name, samples=samples):
+            got = [v for (n_, _l), v in samples.items() if n_ == name]
+            assert got, name
+            return got[0]
+
+        total_sent += val("parsec_obs_flow_sent")
+        total_recv += val("parsec_obs_flow_recv")
+        # the per-peer clock gauge exists (same-clock fabric: 0.0)
+        assert val(f"parsec_obs_clock_offset_us_r{1 - r}") == 0.0
+    assert total_sent > 0, "flow tracing never stamped a message"
+    assert total_sent == total_recv, (total_sent, total_recv)

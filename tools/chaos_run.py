@@ -90,6 +90,15 @@ def main(argv=None) -> int:
                     metavar="SECS",
                     help="per-iteration hang deadline in soak mode "
                          "(default 300)")
+    ap.add_argument("--forensics", default="", metavar="PREFIX",
+                    help="activate profiling at PREFIX so every rank "
+                         "flight-records its trace on a RankFailedError "
+                         "abort (Context.dump_forensics); after the run "
+                         "the collected per-rank post-mortems are "
+                         "merged into PREFIX.forensics.merged.json "
+                         "(tools/obs_trace_merge.py) — every chaos-gate "
+                         "failure yields ONE mergeable timeline instead "
+                         "of nothing")
     ap.add_argument("script", help="python script to run")
     ap.add_argument("args", nargs=argparse.REMAINDER,
                     help="argv for the script (prefix with --)")
@@ -120,6 +129,11 @@ def main(argv=None) -> int:
         os.environ["PARSEC_MCA_ft_restart_policy"] = ns.restart
     if ns.reconnect > 0:
         os.environ["PARSEC_MCA_comm_reconnect_timeout"] = str(ns.reconnect)
+    if ns.forensics:
+        # file-backed profiling is the forensics precondition: the
+        # context only flight-records under an ACTIVE profile with a
+        # dump destination
+        os.environ["PARSEC_MCA_profile"] = ns.forensics
 
     script = os.path.abspath(ns.script)
     # drop only the LEADING separator: a later "--" belongs to the
@@ -131,8 +145,51 @@ def main(argv=None) -> int:
 
     sys.argv = [script] + args
     sys.path.insert(0, os.path.dirname(script))
-    runpy.run_path(script, run_name="__main__")
-    return 0
+    try:
+        runpy.run_path(script, run_name="__main__")
+        rc = 0
+    except SystemExit as exc:
+        if exc.code is None or isinstance(exc.code, int):
+            rc = int(exc.code or 0)
+        else:
+            print(exc.code, file=sys.stderr)
+            rc = 1
+    except BaseException:
+        if ns.forensics:
+            _collect_forensics(ns.forensics)
+        raise
+    if ns.forensics:
+        _collect_forensics(ns.forensics)
+    return rc
+
+
+def _collect_forensics(prefix: str) -> None:
+    """Gather the per-rank flight-recorder traces the aborting ranks
+    wrote (``<prefix>.forensics.rank<r>.trace.json``) and fuse them
+    into ONE offset-corrected post-mortem timeline."""
+    import glob
+    import json
+
+    paths = sorted(glob.glob(f"{prefix}.forensics.rank*.trace.json"))
+    if not paths:
+        return
+    from parsec_tpu.obs import merge_trace_docs
+    docs = []
+    for p in paths:
+        try:
+            with open(p) as fh:
+                docs.append(json.load(fh))
+        except (OSError, ValueError):
+            print(f"chaos_run: unreadable forensics trace {p}",
+                  flush=True)
+    out = f"{prefix}.forensics.merged.json"
+    if docs:
+        with open(out, "w") as fh:
+            json.dump(merge_trace_docs(docs), fh)
+    print(f"chaos_run: collected {len(paths)} forensics trace(s) "
+          f"({', '.join(os.path.basename(p) for p in paths)})"
+          + (f" -> merged post-mortem {out}" if docs else ""),
+          flush=True)
 
 
 def _soak(ns, script: str, args) -> int:
@@ -152,6 +209,8 @@ def _soak(ns, script: str, args) -> int:
         base += ["--restart", str(ns.restart)]
     if ns.reconnect > 0:
         base += ["--reconnect", str(ns.reconnect)]
+    if ns.forensics:
+        base += ["--forensics", ns.forensics]
     base += [script, "--"] + list(args)
 
     t_end = time.monotonic() + ns.soak
