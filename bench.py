@@ -63,6 +63,13 @@ Execution modes (BENCH_MODE):
   (a scripted deterministic exchange captured at the frame level must
   be BIT-IDENTICAL with the knob unset, and toward a peer that never
   advertised "tr").
+- ``health``: streaming health monitor (ISSUE 16) — the SAME 2-rank
+  throttled-TCP dpotrf, ``obs_live`` off vs on (µs/task overhead of
+  the online span folding + window ticks), plus detector latency: one
+  clean dpotrf warms the baselines, then rank 1's fault injector is
+  swapped mid-run to a 4x send delay and the time until rank 0's
+  straggler/degraded-link detector fires on the inbound link is
+  reported (kind, link, suspect ride along).
 
 Every record carries ``schema_version`` + stable ``metric_id``/``mode``
 /``n``/``nb``/``dtype`` fields (schema 2): r01-r05 changed metric
@@ -758,6 +765,13 @@ def bench_all(n, nb, reps, cores, dtype):
         tr = _try("trace", lambda: bench_trace())
         if tr is not None:
             extras.update(tr)
+    # streaming health monitor (ISSUE 16): throttled-TCP dpotrf,
+    # obs_live off vs on + mid-run straggler detector latency —
+    # scrubbed CPU subprocess, link-independent
+    if os.environ.get("BENCH_HEALTH", "1") != "0":
+        hl = _try("health", lambda: bench_health())
+        if hl is not None:
+            extras.update(hl)
     # compiled-stage vs interpreted runtime (ISSUE 12): scrubbed CPU
     # subprocess, link-independent — rides every record
     if os.environ.get("BENCH_STAGEC", "1") != "0":
@@ -1893,6 +1907,11 @@ def bench_trace_capture_identity() -> dict:
       frames stay byte-identical to the unset legs (the mixed-version
       contract).  HELLO frames differ by the advertisement (the same
       precedent as the "rs"/"qz" capabilities) and are excluded.
+    - D (ISSUE 16): ``obs_live`` SET on rank 0 only — the same
+      contract for the streaming health monitor's knob: rank 1 never
+      advertises ``"lv"`` (nor ``"tr"``), so neither plain nor
+      EXTENDED trace contexts travel and rank 0's data frames stay
+      byte-identical to the unset legs.
     """
     import threading as _threading
     from contextlib import ExitStack
@@ -1905,7 +1924,7 @@ def bench_trace_capture_identity() -> dict:
 
     chunk = 4096
 
-    def leg(flow_r0):
+    def leg(flow_r0, live_r0=False):
         captured = {}
         orig = tcpmod._sendall_vec
 
@@ -1928,7 +1947,8 @@ def bench_trace_capture_identity() -> dict:
 
                 def boot(r):
                     engines[r] = TCPCommEngine(
-                        r, eps, obs_flow=(flow_r0 and r == 0))
+                        r, eps, obs_flow=(flow_r0 and r == 0),
+                        obs_live=(live_r0 and r == 0))
                 ts = [_threading.Thread(target=boot, args=(r,))
                       for r in (0, 1)]
                 for t in ts:
@@ -1938,9 +1958,10 @@ def bench_trace_capture_identity() -> dict:
                 e0, e1 = engines
                 # the flow allocator would be armed by the obs wiring;
                 # arm it directly here (no Context in this scripted leg)
-                if flow_r0:
+                if flow_r0 or live_r0:
                     from parsec_tpu.comm.engine import FlowIds
                     e0._flow = FlowIds(0)
+                    e0._flow.live = live_r0
 
                     class _NullObs:
                         def am_sent(self, *a):
@@ -1993,10 +2014,12 @@ def bench_trace_capture_identity() -> dict:
     a = leg(False)
     b = leg(False)
     c = leg(True)
+    d = leg(False, live_r0=True)
     return {
         "trace_frames_captured": len(a),
         "trace_unset_bit_identical": bool(a and a == b),
         "trace_mixed_version_bit_identical": bool(a and a == c),
+        "live_mixed_version_bit_identical": bool(a and a == d),
     }
 
 
@@ -2148,6 +2171,168 @@ def bench_trace(n=256, nb=64, delay_ms=3) -> dict:
         return json.loads(p.stdout.strip().splitlines()[-1])
     except Exception as exc:  # noqa: BLE001
         return {"trace_error": repr(exc)[:200]}
+
+
+def bench_health_inner(n=256, nb=64, delay_ms=3, chunk_bytes=8192) -> dict:
+    """BENCH_MODE=health payload (ISSUE 16): the SAME 2-rank throttled-
+    TCP dpotrf as the trace bench, streaming health monitor OFF vs ON —
+    the reported delta is the us/task cost of obs_live itself (span
+    folding, window ticks, flow-lag stitching).  A third leg measures
+    DETECTOR LATENCY: run one clean dpotrf to warm the baselines, then
+    swap rank 1's fault injector mid-run so its sends suddenly pay a
+    4x delay, and report how long until rank 0's monitor fires on the
+    inbound link."""
+    import concurrent.futures as cf
+    import threading as _threading
+    from contextlib import ExitStack
+
+    import parsec_tpu
+    from parsec_tpu.collections import TwoDimBlockCyclic
+    from parsec_tpu.comm import RemoteDepEngine
+    from parsec_tpu.comm.tcp import TCPCommEngine, free_ports
+    from parsec_tpu.ft.inject import FaultInjector
+    from parsec_tpu.ops import dpotrf_taskpool, make_spd
+    from parsec_tpu.utils.params import params as _params
+
+    ranks = 2
+    M = make_spd(n, dtype=np.float32)
+    ntasks = _dpotrf_task_count((n + nb - 1) // nb)
+
+    def run_once(live, detector=False):
+        overrides = {
+            "comm_chunk_bytes": str(chunk_bytes),
+            "comm_mesh_local": "0",   # payloads must ride the wire
+            "obs_live": "1" if live else "0",
+        }
+        if detector:
+            # fast windows so the latency reflects the detector, not
+            # the sampling cadence; the straggler is injected mid-run
+            overrides["obs_live_window_ms"] = "50"
+        else:
+            overrides["ft_inject"] = f"delay:pct=100:ms={delay_ms}"
+        ports = free_ports(ranks)
+        eps = [("127.0.0.1", p) for p in ports]
+        barrier = _threading.Barrier(ranks)
+        onset = [0.0]
+        with ExitStack() as st:
+            for k, v in overrides.items():
+                st.enter_context(_params.cmdline_override(k, v))
+
+            def rank_fn(r):
+                ce = TCPCommEngine(r, eps)
+                eng = RemoteDepEngine(ce)
+                ctx = parsec_tpu.Context(nb_cores=1, comm=eng)
+                try:
+                    def rep(name):
+                        coll = TwoDimBlockCyclic(
+                            n, n, nb, nb, dtype=np.float32,
+                            P=ranks, Q=1, nodes=ranks, rank=r)
+                        coll.name = name
+                        coll.from_numpy(M.copy())
+                        tp = dpotrf_taskpool(coll, rank=r, nb_ranks=ranks)
+                        ctx.add_taskpool(tp)
+                        ctx.wait()
+
+                    t0 = time.perf_counter()
+                    rep("descA")
+                    wall = time.perf_counter() - t0
+                    # nobody finis while the peer is mid-DAG: a fast
+                    # rank's GOODBYE while the slow one still owes
+                    # rendezvous GETs reads as a rank failure
+                    barrier.wait(timeout=120)
+                    firing = None
+                    if detector:
+                        # quiet windows after descA converge the per-
+                        # link baselines (warmup_windows) so the descB
+                        # spike is judged against a warm EWMA — on a
+                        # fast host descA alone spans too few windows
+                        time.sleep(0.7)
+                        if r == 1:
+                            # mid-run regression: rank 1's data sends
+                            # suddenly pay a 4x delay — rank 0's inbound
+                            # exposed-wait baseline (warmed by descA)
+                            # should blow past its z threshold
+                            ce._ft = FaultInjector.from_spec(
+                                f"delay:pct=100:ms={delay_ms * 4}", rank=1)
+                        else:
+                            onset[0] = time.time()
+                        barrier.wait(timeout=120)
+                        rep("descB")
+                        barrier.wait(timeout=120)
+                        time.sleep(0.4)  # a few detector windows
+                        if r == 0 and ctx.obs.live is not None:
+                            snap = ctx.obs.live.snapshot()
+                            for f in snap.get("firings", []):
+                                if f.get("ts", 0.0) >= onset[0]:
+                                    firing = f
+                                    break
+                    return {"wall": wall, "firing": firing,
+                            "onset": onset[0]}
+                finally:
+                    ctx.fini()
+
+            with cf.ThreadPoolExecutor(ranks) as ex:
+                return list(ex.map(rank_fn, range(ranks)))
+
+    out = {"health_n": n, "health_nb": nb, "health_ranks": ranks,
+           "health_link_delay_ms": delay_ms, "health_tasks": ntasks}
+    run_once(False)   # warmup: kernel compiles
+    off = run_once(False)
+    on = run_once(True)
+    out["health_off_wall_s"] = round(max(s["wall"] for s in off), 3)
+    out["health_on_wall_s"] = round(max(s["wall"] for s in on), 3)
+    out["health_us_per_task_off"] = round(
+        out["health_off_wall_s"] / ntasks * 1e6, 2)
+    out["health_us_per_task_on"] = round(
+        out["health_on_wall_s"] / ntasks * 1e6, 2)
+    out["health_us_per_task_delta"] = round(
+        out["health_us_per_task_on"] - out["health_us_per_task_off"], 2)
+    det = run_once(True, detector=True)
+    firing = det[0].get("firing")
+    if firing is not None:
+        out["health_detector_latency_s"] = round(
+            firing["ts"] - det[0]["onset"], 3)
+        out["health_detector_kind"] = firing.get("kind")
+        out["health_detector_link"] = firing.get("link")
+        out["health_detector_suspect"] = firing.get("suspect")
+    else:
+        out["health_detector_latency_s"] = -1.0
+        out["health_detector_kind"] = None
+    return out
+
+
+_HEALTH_DRIVER = r"""
+import json, os, sys
+sys.path.insert(0, os.environ["BENCH_REPO"])
+import bench
+
+print(json.dumps(bench.bench_health_inner(
+    n=int(os.environ.get("BENCH_HEALTH_N", "256")),
+    nb=int(os.environ.get("BENCH_HEALTH_NB", "64")),
+    delay_ms=int(os.environ.get("BENCH_HEALTH_DELAY_MS", "3")))))
+"""
+
+
+def bench_health(n=256, nb=64, delay_ms=3) -> dict:
+    """BENCH_MODE=health: the obs_live off/on legs in a scrubbed CPU
+    subprocess (same pattern as bench_trace: numbers must not depend on
+    the tunnel session's TPU plugin)."""
+    import subprocess
+    import sys as _sys
+
+    env = _scrubbed_bench_env(
+        n_devices=2,
+        BENCH_HEALTH_N=n, BENCH_HEALTH_NB=nb,
+        BENCH_HEALTH_DELAY_MS=delay_ms)
+    try:
+        p = subprocess.run([_sys.executable, "-c", _HEALTH_DRIVER],
+                           env=env, capture_output=True, text=True,
+                           timeout=1200)
+        if p.returncode != 0:
+            return {"health_error": p.stdout[-200:] + p.stderr[-200:]}
+        return json.loads(p.stdout.strip().splitlines()[-1])
+    except Exception as exc:  # noqa: BLE001
+        return {"health_error": repr(exc)[:200]}
 
 
 # ---------------------------------------------------------------------- #
@@ -2618,6 +2803,18 @@ def main() -> None:
                       "obs_flow_on_vs_off)",
             "metric_id": "trace_us_per_task_delta", "mode": mode,
             "value": extras.get("trace_us_per_task_delta", -1.0),
+            "unit": "us/task", "extras": extras})
+        return
+    if mode == "health":
+        extras = bench_health(
+            n=int(os.environ.get("BENCH_HEALTH_N", "256")),
+            nb=int(os.environ.get("BENCH_HEALTH_NB", "64")),
+            delay_ms=int(os.environ.get("BENCH_HEALTH_DELAY_MS", "3")))
+        emit_json({
+            "metric": "health_us_per_task_delta(throttled_tcp_dpotrf,"
+                      "obs_live_on_vs_off)",
+            "metric_id": "health_us_per_task_delta", "mode": mode,
+            "value": extras.get("health_us_per_task_delta", -1.0),
             "unit": "us/task", "extras": extras})
         return
     if mode == "dispatch":

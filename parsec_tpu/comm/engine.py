@@ -27,14 +27,22 @@ class FlowIds:
     messages under the ``obs_flow`` knob, shared by the sender's and the
     receiver's flow events so the fleet merge can stitch the edge.
     Installed as ``ce._flow`` by the obs wiring — None keeps every send
-    on the one-attribute-check fast path."""
+    on the one-attribute-check fast path.
 
-    __slots__ = ("rank", "_next", "_lock")
+    ``live`` (obs_live, ISSUE 16) widens the stamped context to
+    ``(origin, span, pool_tp_id, t_send_ns)`` — the taskpool wire id
+    for per-pool attribution and the sender's monotonic send instant
+    for live flow-lag — but ONLY toward peers whose ``live_to``
+    capability negotiated it, so a plain obs_flow receiver keeps seeing
+    the 2-tuple its ``origin, span = ctx`` unpacking expects."""
+
+    __slots__ = ("rank", "_next", "_lock", "live")
 
     def __init__(self, rank: int) -> None:
         self.rank = rank
         self._next = 0
         self._lock = threading.Lock()
+        self.live = False
 
     def next_ctx(self) -> Tuple[int, int]:
         with self._lock:
@@ -264,6 +272,13 @@ class CommEngine:
         wire bytes stay exactly what a knob-unset build would send."""
         return True
 
+    def live_to(self, dst: int) -> bool:
+        """May the EXTENDED obs_live context (pool id + send instant)
+        travel toward ``dst``?  Same-build in-process fabrics: yes; the
+        TCP engine gates on the peer's HELLO ``"lv"`` capability so an
+        obs_flow-only receiver never sees a 4-tuple."""
+        return True
+
     def _flow_stamp(self, dst: int, tag: int,
                     payload: Any) -> Tuple[Any, Optional[Tuple[int, int]]]:
         """Stamp one outbound data-plane message with a fresh trace
@@ -290,6 +305,13 @@ class CommEngine:
                 del payload["_tr"]
             return payload, None
         ctx = fl.next_ctx()
+        if fl.live and self.live_to(dst):
+            # obs_live extension: taskpool wire id (per-pool
+            # attribution — the data-plane dicts already carry
+            # "tp_id"; GET traffic does not, and attributes to None)
+            # and the sender's monotonic send instant (flow lag)
+            ctx = (ctx[0], ctx[1], payload.get("tp_id"),
+                   time.monotonic_ns())
         payload = dict(payload)
         payload["_tr"] = ctx
         return payload, ctx

@@ -215,7 +215,7 @@ class _Peer:
     __slots__ = ("rank", "sock", "ctrl", "bulk", "cond", "writer",
                  "goodbye", "bw_mbps", "codec", "engaged", "frames",
                  "probe_ratio", "done", "queued_bytes", "hb_ok", "el_ok",
-                 "tr_ok",
+                 "tr_ok", "lv_ok",
                  "rs_ok", "hello_seen", "connected_at", "conn_gen",
                  "suspect", "suspect_since", "rs_epoch", "rs_tx_seq",
                  "rs_rx_seq", "rs_window", "rs_window_bytes", "rs_replay",
@@ -248,6 +248,7 @@ class _Peer:
         self.hb_ok = False         # HELLO advertised heartbeat support
         self.el_ok = False         # HELLO advertised elastic membership
         self.tr_ok = False         # HELLO advertised flow tracing ("tr")
+        self.lv_ok = False         # HELLO advertised obs_live ("lv")
         # -- reliable session (ISSUE 10) --------------------------------
         self.rs_ok = False         # both ends advertised "rs"
         self.hello_seen = False    # the peer's HELLO was processed
@@ -296,7 +297,8 @@ class TCPCommEngine(LocalCommEngine):
                  replay_window_bytes: Optional[int] = None,
                  quantize: Optional[str] = None,
                  quantize_threshold_mbps: Optional[float] = None,
-                 obs_flow: Optional[bool] = None) -> None:
+                 obs_flow: Optional[bool] = None,
+                 obs_live: Optional[bool] = None) -> None:
         from ..utils.params import params
         self._inbox: Fifo = Fifo()
         self._peers: Dict[int, _Peer] = {}
@@ -372,7 +374,17 @@ class TCPCommEngine(LocalCommEngine):
         # metadata so the fleet merge can fuse rank timelines)
         if obs_flow is None:
             obs_flow = bool(params.get_or("obs_flow", "bool", False))
-        self._flow_enabled = bool(obs_flow)
+        # obs_live (ISSUE 16) rides the same machinery and adds its own
+        # symmetric "lv" capability: toward lv-peers the stamped context
+        # widens to (origin, span, pool, t_send_ns).  The knob implies
+        # the obs_flow wire behavior (contexts + clock words) without
+        # requiring both knobs; either knob unset on EITHER end keeps
+        # that end's incoming wire bytes exactly what the unset build
+        # would produce.
+        if obs_live is None:
+            obs_live = bool(params.get_or("obs_live", "bool", False))
+        self._live_enabled = bool(obs_live)
+        self._flow_enabled = bool(obs_flow) or self._live_enabled
         self._clock: Dict[int, float] = {}      # peer -> offset EWMA us
         self._clock_n: Dict[int, int] = {}      # peer -> sample count
         self._clock_stop = threading.Event()
@@ -508,6 +520,11 @@ class TCPCommEngine(LocalCommEngine):
             # mixed-version peer simply never negotiates, so neither
             # trace contexts nor extended pings travel toward it
             info["tr"] = True
+        if self._live_enabled:
+            # obs_live (ISSUE 16): extended (pool, send-instant) flow
+            # contexts — gated like "tr", so an unset knob's HELLO is
+            # bit-identical and obs_flow-only peers keep 2-tuples
+            info["lv"] = True
         if self._quantize is not None:
             # quantized codecs are advertised ONLY when the local knob
             # is set — symmetric like "rs", so a knob-unset build keeps
@@ -684,6 +701,14 @@ class TCPCommEngine(LocalCommEngine):
         with self._conn_cond:
             p = self._peers.get(dst)
         return p is not None and p.tr_ok
+
+    def live_to(self, dst: int) -> bool:
+        """Extended obs_live contexts travel only toward peers whose
+        HELLO advertised ``"lv"`` — an obs_flow-only (or older) peer
+        keeps receiving the plain 2-tuple its unpacking expects."""
+        with self._conn_cond:
+            p = self._peers.get(dst)
+        return p is not None and p.lv_ok
 
     # -- reliable sessions (ISSUE 10) -----------------------------------
     def peer_suspect(self, peer: int) -> bool:
@@ -1730,6 +1755,10 @@ class TCPCommEngine(LocalCommEngine):
             # flow tracing negotiates SYMMETRICALLY like "rs": both
             # ends must run with obs_flow set or neither stamps
             p.tr_ok = bool(info.get("tr")) and self._flow_enabled
+            # obs_live's extended contexts are symmetric the same way:
+            # both ends must run with obs_live set or senders keep the
+            # plain (origin, span) pair
+            p.lv_ok = bool(info.get("lv")) and self._live_enabled
             with p.cond:
                 # quantize capability is symmetric like "rs": only a
                 # peer that advertised the requested codec under "qz"

@@ -28,6 +28,8 @@ from .critpath import (analyze, critical_path, distributed_critical_path,
                        format_report, load_flow_events, merge_trace_docs,
                        parse_dot, per_link_exposed_wait, rank_clock_shifts,
                        stitch_flows)
+from .live import (LiveHealth, RollingStat, fleet_health, format_health,
+                   register_health_gauges)
 from .metrics import (COMM_XFER_SECONDS, TASK_EXEC_SECONDS, Histogram,
                       MetricsRegistry, MetricsTaskModule)
 from .prometheus import (fleet_to_prometheus, parse_exposition, render,
@@ -39,11 +41,15 @@ from .spans import (COMM_ACTIVE_TRANSFERS, COMM_BYTES_RECEIVED,
                     COMM_MSGS_RECEIVED, COMM_MSGS_SENT,
                     COMM_PENDING_MESSAGES, COMM_RECONNECTS,
                     COMM_REPLAYED_FRAMES, COMM_SUSPECT_MS,
-                    CommObs, DeviceObs,
+                    CommObs, DeviceObs, HEALTH_STREAM_TID,
                     FT_ELASTIC_JOINS, FT_ELASTIC_RESIZES, FT_HB_RTT_PREFIX,
                     FT_PEER_ALIVE, FT_RESHARD_BYTES, FT_RESHARD_US,
                     OBS_CLOCK_OFFSET_PREFIX, OBS_EXPOSED_COMM_US,
-                    OBS_FLOW_RECV, OBS_FLOW_SENT, OBS_OVERLAP_FRACTION,
+                    OBS_FLOW_RECV, OBS_FLOW_SENT,
+                    OBS_HEALTH_DEGRADED, OBS_HEALTH_FIRINGS,
+                    OBS_HEALTH_STATUS, OBS_HEALTH_STRAGGLER,
+                    OBS_HEALTH_STUCK, OBS_HEALTH_WINDOWS,
+                    OBS_HEALTH_WORST_LINK_US, OBS_OVERLAP_FRACTION,
                     OverlapTracker, flow_event_id, inbound_flow_ctx,
                     payload_nbytes, register_device_gauges)
 
@@ -60,6 +66,11 @@ __all__ = [
     "FT_RESHARD_US",
     "OBS_OVERLAP_FRACTION", "OBS_EXPOSED_COMM_US",
     "OBS_FLOW_SENT", "OBS_FLOW_RECV", "OBS_CLOCK_OFFSET_PREFIX",
+    "OBS_HEALTH_STATUS", "OBS_HEALTH_WINDOWS", "OBS_HEALTH_FIRINGS",
+    "OBS_HEALTH_STRAGGLER", "OBS_HEALTH_DEGRADED", "OBS_HEALTH_STUCK",
+    "OBS_HEALTH_WORST_LINK_US",
+    "LiveHealth", "RollingStat", "fleet_health", "format_health",
+    "register_health_gauges",
     "flow_event_id", "inbound_flow_ctx",
     "TASK_EXEC_SECONDS", "COMM_XFER_SECONDS",
     "render", "parse_exposition", "sanitize_name", "fleet_to_prometheus",
@@ -83,11 +94,30 @@ class ContextObs:
 
     def __init__(self, ctx: Any) -> None:
         self.metrics = MetricsRegistry(ctx.sde)
-        self.enabled = bool(ctx.profile is not None or _metrics_param())
+        live_on = _live_param()
+        # obs_live (ISSUE 16) implies the span sinks: the streaming
+        # monitor's feeds ARE the comm/device/exec hooks, so the knob
+        # alone turns telemetry on even without profile= or metrics
+        self.enabled = bool(ctx.profile is not None or _metrics_param()
+                            or live_on)
         self._engines: List[Any] = []
         self._devices: List[Any] = []
         self._task_module: Optional[MetricsTaskModule] = None
         self._profiler_with_hist: Optional[Any] = None
+        # streaming health monitor (obs/live.py): rolling per-link
+        # exposure / overlap / lag + anomaly detectors, constructed
+        # ONLY under the knob — unset means no object, no thread, no
+        # gauges (the inertness contract)
+        self.live: Optional[LiveHealth] = None
+        if live_on:
+            from ..utils.params import params
+            self.live = LiveHealth(
+                ctx.rank,
+                window_ms=params.get_or("obs_live_window_ms", "int", 250),
+                stream=(ctx.profile.stream(HEALTH_STREAM_TID, "health")
+                        if ctx.profile is not None else None),
+                pending_fn=getattr(ctx, "_pending_gauge", None))
+            register_health_gauges(ctx.sde, self.live)
         # live T3 overlap gauge (ISSUE 7): compute/comm interval
         # accumulator behind PARSEC::OBS::OVERLAP_FRACTION — only with
         # telemetry on (its feeds are the span sinks below)
@@ -130,13 +160,14 @@ class ContextObs:
             register_device_gauges(ctx.sde, dev)
             if self.enabled:
                 dev._obs = DeviceObs(self.metrics, dev, profile=ctx.profile,
-                                     tracker=self.overlap)
+                                     tracker=self.overlap, live=self.live)
                 self._devices.append(dev)
         ce = getattr(ctx.comm, "ce", ctx.comm) if ctx.comm is not None else None
         if ce is not None:
             comm_obs = CommObs(self.metrics,
                                profile=ctx.profile if self.enabled else None,
-                               tracker=self.overlap if self.enabled else None)
+                               tracker=self.overlap if self.enabled else None,
+                               live=self.live)
             comm_obs.register_engine_gauges(ce)
             if self.enabled:
                 ce._obs = comm_obs
@@ -151,10 +182,21 @@ class ContextObs:
                 # advertised (or withheld) the "tr" capability
                 flow_on = getattr(ce, "_flow_enabled", None)
                 if flow_on is None:
-                    flow_on = _flow_param()
+                    # in-process fabrics: either knob arms the
+                    # allocator (obs_live rides the flow machinery)
+                    flow_on = _flow_param() or self.live is not None
                 if flow_on:
                     from ..comm.engine import FlowIds
                     ce._flow = FlowIds(ce.rank)
+                    if self.live is not None:
+                        # obs_live: widen stamped contexts toward
+                        # lv-negotiated peers with (pool, t_send_ns)
+                        ce._flow.live = True
+            if self.live is not None:
+                # late-bind the transport's live estimators: clock
+                # offsets (flow-lag conversion) + link-bandwidth EWMA
+                # (the degraded-link detector's second signal)
+                self.live.bind_engine(ce)
             # remote-dep protocol counters as pull gauges
             stats = getattr(ctx.comm, "stats", None)
             if isinstance(stats, dict):
@@ -171,17 +213,24 @@ class ContextObs:
                 from .metrics import ExecTimer
                 profiler.exec_timer = ExecTimer(
                     self.metrics.histogram(TASK_EXEC_SECONDS),
-                    tracker=self.overlap)
+                    tracker=self.overlap, live=self.live)
                 self._profiler_with_hist = profiler
             else:
                 self._task_module = MetricsTaskModule(self.metrics,
                                                       context=ctx,
-                                                      tracker=self.overlap)
+                                                      tracker=self.overlap,
+                                                      live=self.live)
                 self._task_module.enable()
+        if self.live is not None:
+            # the rolling-window monitor thread (detectors + window
+            # folds) — the last thing started, so every feed is wired
+            self.live.start()
 
     def fini(self) -> None:
         """Unhook from global PINS sites and the engine/device sinks (a
         later context must not feed this context's histograms)."""
+        if self.live is not None:
+            self.live.stop()
         if self._task_module is not None:
             self._task_module.disable()
             self._task_module = None
@@ -214,6 +263,11 @@ def _metrics_param() -> bool:
 def _flow_param() -> bool:
     from ..utils.params import params
     return bool(params.get_or("obs_flow", "bool", False))
+
+
+def _live_param() -> bool:
+    from ..utils.params import params
+    return bool(params.get_or("obs_live", "bool", False))
 
 
 # ---------------------------------------------------------------------- #

@@ -131,14 +131,18 @@ class ExecTimer:
     When an ``OverlapTracker`` is attached the same intervals also feed
     the live overlap gauge's COMPUTE channel (obs/spans.py)."""
 
-    __slots__ = ("hist", "_open", "_time", "tracker")
+    __slots__ = ("hist", "_open", "_time", "tracker", "live")
 
-    def __init__(self, hist: Histogram, tracker: Any = None) -> None:
+    def __init__(self, hist: Histogram, tracker: Any = None,
+                 live: Any = None) -> None:
         import time
         self._time = time
         self.hist = hist
         self._open: Dict[int, int] = {}
         self.tracker = tracker
+        # obs_live (ISSUE 16): the same closed exec intervals also feed
+        # the streaming health monitor's compute channel
+        self.live = live
 
     def begin(self, th_id: int) -> None:
         self._open[th_id] = self._time.monotonic_ns()
@@ -150,6 +154,8 @@ class ExecTimer:
             self.hist.observe((t1 - t0) / 1e9)
             if self.tracker is not None:
                 self.tracker.note("compute", t0, t1)
+            if self.live is not None:
+                self.live.note_compute(t0, t1)
 
 
 class MetricsTaskModule(PinsModule):
@@ -161,14 +167,14 @@ class MetricsTaskModule(PinsModule):
     events = [PinsEvent.EXEC_BEGIN, PinsEvent.EXEC_END]
 
     def __init__(self, metrics: MetricsRegistry, context: Any = None,
-                 tracker: Any = None) -> None:
+                 tracker: Any = None, live: Any = None) -> None:
         self.metrics = metrics
         # context filter: several in-process SPMD ranks share the global
         # PINS sites, but each rank's histogram must only see its own
         # tasks (same isolation as the per-context SDE registry)
         self.context = context
         self.timer = ExecTimer(metrics.histogram(TASK_EXEC_SECONDS),
-                               tracker=tracker)
+                               tracker=tracker, live=live)
 
     def callback(self, es: Any, event: PinsEvent, payload: Any) -> None:
         if self.context is not None and es.context is not self.context:

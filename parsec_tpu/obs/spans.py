@@ -32,6 +32,10 @@ __all__ = ["CommObs", "DeviceObs", "OverlapTracker",
            "FT_PEER_ALIVE", "FT_HB_RTT_PREFIX",
            "OBS_OVERLAP_FRACTION", "OBS_EXPOSED_COMM_US",
            "OBS_FLOW_SENT", "OBS_FLOW_RECV", "OBS_CLOCK_OFFSET_PREFIX",
+           "OBS_HEALTH_STATUS", "OBS_HEALTH_WINDOWS",
+           "OBS_HEALTH_FIRINGS", "OBS_HEALTH_STRAGGLER",
+           "OBS_HEALTH_DEGRADED", "OBS_HEALTH_STUCK",
+           "OBS_HEALTH_WORST_LINK_US",
            "flow_event_id", "inbound_flow_ctx", "set_inbound_flow_ctx",
            "payload_nbytes"]
 
@@ -87,13 +91,28 @@ OBS_EXPOSED_COMM_US = "PARSEC::OBS::EXPOSED_COMM_US"
 OBS_FLOW_SENT = "PARSEC::OBS::FLOW_SENT"
 OBS_FLOW_RECV = "PARSEC::OBS::FLOW_RECV"
 OBS_CLOCK_OFFSET_PREFIX = "PARSEC::OBS::CLOCK_OFFSET_US"
+# streaming health monitor (ISSUE 16, obs/live.py, ``obs_live`` knob):
+# current detector verdict (0 healthy / 1 degraded / 2 stuck), rolling
+# windows folded, total detector firings plus the per-kind breakdown,
+# and the worst link's cumulative exposed-wait in µs.  Registered ONLY
+# when the knob is set — an unset knob adds no gauges at all.
+OBS_HEALTH_STATUS = "PARSEC::OBS::HEALTH::STATUS"
+OBS_HEALTH_WINDOWS = "PARSEC::OBS::HEALTH::WINDOWS"
+OBS_HEALTH_FIRINGS = "PARSEC::OBS::HEALTH::FIRINGS"
+OBS_HEALTH_STRAGGLER = "PARSEC::OBS::HEALTH::STRAGGLER_FIRINGS"
+OBS_HEALTH_DEGRADED = "PARSEC::OBS::HEALTH::DEGRADED_LINK_FIRINGS"
+OBS_HEALTH_STUCK = "PARSEC::OBS::HEALTH::STUCK_FIRINGS"
+OBS_HEALTH_WORST_LINK_US = "PARSEC::OBS::HEALTH::WORST_LINK_EXPOSED_US"
 
 
-def flow_event_id(ctx: Tuple[int, int]) -> int:
+def flow_event_id(ctx: Tuple[int, ...]) -> int:
     """The Chrome-trace flow id of one wire trace context: the span id
     with the origin rank in the high bits, so ids from every rank's
-    allocator stay globally unique in a merged timeline."""
-    origin, span = ctx
+    allocator stay globally unique in a merged timeline.  Tolerates the
+    obs_live EXTENDED context ``(origin, span, pool, t_send_ns)`` — the
+    flow id depends only on the first two fields, so a live-extended
+    edge stitches with a plain one."""
+    origin, span = ctx[0], ctx[1]
     return (int(origin) << 40) | (int(span) & ((1 << 40) - 1))
 
 
@@ -114,6 +133,10 @@ def set_inbound_flow_ctx(ctx: Optional[Tuple[int, int]]) -> None:
 #: trace stream ids (outside any plausible worker th_id range)
 COMM_STREAM_TID = 1 << 20
 DEVICE_STREAM_TID = (1 << 20) + 1
+#: the obs_live monitor's annotation stream (detector firings land as
+#: Chrome-trace instant events so merged timelines show verdicts at
+#: the right instant); must stay above every DEVICE_STREAM_TID + index
+HEALTH_STREAM_TID = (1 << 20) + (1 << 10)
 
 
 _TAG_NAMES: Dict[int, str] = {}
@@ -254,17 +277,24 @@ class CommObs:
     registry and (optionally) its Profile; every hook is safe to call
     from any thread."""
 
-    __slots__ = ("metrics", "stream", "_open_gets", "_hist", "tracker")
+    __slots__ = ("metrics", "stream", "_open_gets", "_hist", "tracker",
+                 "live")
 
     def __init__(self, metrics: MetricsRegistry,
                  profile: Optional[Any] = None,
-                 tracker: Optional[OverlapTracker] = None) -> None:
+                 tracker: Optional[OverlapTracker] = None,
+                 live: Optional[Any] = None) -> None:
         self.metrics = metrics
         self.stream = (profile.stream(COMM_STREAM_TID, "comm")
                        if profile is not None else None)
         self._open_gets: Dict[int, int] = {}  # token -> t0_ns
         self._hist = metrics.histogram(COMM_XFER_SECONDS)
         self.tracker = tracker
+        # obs_live streaming monitor (ISSUE 16): every span the sink
+        # records is ALSO folded into the rolling health channels with
+        # the same src/dst attribution the span args carry, so the live
+        # per-link exposure matches the offline per-link report
+        self.live = live
 
     # -- active messages -----------------------------------------------------
     def am_sent(self, src: int, dst: int, tag: int, payload: Any,
@@ -276,6 +306,8 @@ class CommObs:
         t1 = time.monotonic_ns()
         if self.tracker is not None:
             self.tracker.note("comm", t0_ns, t1)
+        if self.live is not None:
+            self.live.note_comm(t0_ns, t1, src=src, dst=dst)
         st = self.stream
         if st is not None:
             st.span("comm:send", t0_ns, t1,
@@ -289,16 +321,25 @@ class CommObs:
         sde.inc(COMM_BYTES_RECEIVED, payload_nbytes(payload))
 
     def delivered(self, src: int, me: int, tag: int, t0_ns: int) -> None:
+        t1 = time.monotonic_ns()
+        if self.live is not None:
+            # delivers are comm spans offline (critpath._is_comm) but
+            # NOT OverlapTracker channels — the live monitor keeps its
+            # own channels so its numbers parity-match the report
+            self.live.note_comm(t0_ns, t1, src=src, dst=me)
         st = self.stream
         if st is not None:
-            st.span(f"comm:deliver:{_tag_name(tag)}", t0_ns,
-                    time.monotonic_ns(), {"src": src, "dst": me, "tag": tag})
+            st.span(f"comm:deliver:{_tag_name(tag)}", t0_ns, t1,
+                    {"src": src, "dst": me, "tag": tag})
 
     # -- cross-rank flow edges (ISSUE 15) ------------------------------------
     def flow_sent(self, dst: int, tag: int, ctx: Any, t0_ns: int) -> None:
         """The sender half of one wire flow edge: the message left with
         trace context ``ctx`` stamped on it at enqueue time ``t0_ns``."""
         self.metrics.sde.inc(OBS_FLOW_SENT)
+        if self.live is not None and len(ctx) >= 4:
+            # extended live context: field 2 is the taskpool wire id
+            self.live.note_flow_sent(dst, ctx[2])
         st = self.stream
         if st is not None:
             st.flow(f"flow:{_tag_name(tag)}", flow_event_id(ctx), "s",
@@ -309,10 +350,16 @@ class CommObs:
         recorded once per message at arrival (deferred or not), so the
         merged timeline stitches exactly one edge per wire hop."""
         self.metrics.sde.inc(OBS_FLOW_RECV)
+        t1 = time.monotonic_ns()
+        if self.live is not None and len(ctx) >= 4:
+            # extended live context: (origin, span, pool, t_send_ns) —
+            # the sender's monotonic send instant converts to lag via
+            # the live clock-offset estimate inside the monitor
+            self.live.note_flow_recv(src, ctx[2], ctx[3], t1)
         st = self.stream
         if st is not None:
             st.flow(f"flow:{_tag_name(tag)}", flow_event_id(ctx), "f",
-                    time.monotonic_ns(), {"src": src})
+                    t1, {"src": src})
 
     # -- one-sided transfers -------------------------------------------------
     def get_begin(self, token: int, src_rank: int) -> None:
@@ -326,6 +373,8 @@ class CommObs:
         self._hist.observe((t1 - t0) / 1e9)
         if self.tracker is not None:
             self.tracker.note("comm", t0, t1)
+        if self.live is not None:
+            self.live.note_comm(t0, t1, src=src_rank)
         st = self.stream
         if st is not None:
             st.span("comm:get", t0, t1,
@@ -340,6 +389,8 @@ class CommObs:
         t1 = time.monotonic_ns()
         if self.tracker is not None:
             self.tracker.note("comm", t0_ns, t1)
+        if self.live is not None:
+            self.live.note_comm(t0_ns, t1, dst=dst_rank)
         st = self.stream
         if st is not None:
             st.span("comm:put", t0_ns, t1,
@@ -350,6 +401,11 @@ class CommObs:
         t1 = time.monotonic_ns()
         if self.tracker is not None:
             self.tracker.note("comm", t0_ns, t1)
+        if self.live is not None:
+            src = dst = None
+            if isinstance(info, dict):
+                src, dst = info.get("src"), info.get("dst")
+            self.live.note_comm(t0_ns, t1, src=src, dst=dst)
         st = self.stream
         if st is not None:
             st.span(key, t0_ns, t1, info)
@@ -360,10 +416,14 @@ class CommObs:
         message become spans (idle polls would drown the trace)."""
         if handled <= 0:
             return
+        t1 = time.monotonic_ns()
+        if self.live is not None:
+            # progress drains are comm:* offline too (unattributed —
+            # they widen the comm union for the overlap fraction)
+            self.live.note_comm(t0_ns, t1)
         st = self.stream
         if st is not None:
-            st.span("comm:progress", t0_ns, time.monotonic_ns(),
-                    {"handled": handled})
+            st.span("comm:progress", t0_ns, t1, {"handled": handled})
 
     # -- engine gauge wiring -------------------------------------------------
     def register_engine_gauges(self, ce: Any) -> None:
@@ -516,11 +576,12 @@ class DeviceObs:
     keep the one-attribute-check fast path (gauges are registered
     separately via :func:`register_device_gauges`)."""
 
-    __slots__ = ("metrics", "stream", "name", "_hist", "tracker")
+    __slots__ = ("metrics", "stream", "name", "_hist", "tracker", "live")
 
     def __init__(self, metrics: MetricsRegistry, device: Any,
                  profile: Optional[Any] = None,
-                 tracker: Optional[OverlapTracker] = None) -> None:
+                 tracker: Optional[OverlapTracker] = None,
+                 live: Optional[Any] = None) -> None:
         self.metrics = metrics
         self.name = device.name
         self.stream = (profile.stream(DEVICE_STREAM_TID + device.device_index,
@@ -528,6 +589,7 @@ class DeviceObs:
                        if profile is not None else None)
         self._hist = metrics.histogram(COMM_XFER_SECONDS)
         self.tracker = tracker
+        self.live = live
 
     def xfer(self, direction: str, nbytes: int, t0_ns: int) -> None:
         """A host<->device transfer completed (direction: "in"|"out")."""
@@ -537,6 +599,8 @@ class DeviceObs:
             # transfers count as COMM for the overlap gauge — the same
             # classification the offline analyzer applies (dev:xfer*)
             self.tracker.note("comm", t0_ns, t1)
+        if self.live is not None:
+            self.live.note_comm(t0_ns, t1)
         st = self.stream
         if st is not None:
             st.span(f"dev:xfer_{direction}", t0_ns, t1,

@@ -66,6 +66,16 @@ class _Handler(socketserver.StreamRequestHandler):
                     b"Content-Type: text/plain; version=0.0.4\r\n"
                     b"Content-Length: " + str(len(body)).encode() +
                     b"\r\n\r\n")
+        elif path in ("/health", "/timeline"):
+            # obs_live (ISSUE 16): fleet-merged health snapshot /
+            # merged detector-firing timeline, JSON
+            doc = (server.health_fleet() if path == "/health"
+                   else server.timeline())
+            body = json.dumps(doc).encode() + b"\n"
+            head = (b"HTTP/1.0 200 OK\r\n"
+                    b"Content-Type: application/json\r\n"
+                    b"Content-Length: " + str(len(body)).encode() +
+                    b"\r\n\r\n")
         else:
             body = b"not found\n"
             head = (b"HTTP/1.0 404 Not Found\r\n"
@@ -92,6 +102,10 @@ class AggregatorServer:
         self._lock = threading.Lock()
         # {counter: {rank: {"last", "min", "max", "n", "ts"}}}
         self._series: Dict[str, Dict[int, Dict[str, Any]]] = {}
+        # obs_live (ISSUE 16): latest per-rank health snapshot (the
+        # "health" key of a push, present only when the sender runs
+        # with the knob set)
+        self._health: Dict[int, Dict[str, Any]] = {}
         self.nb_pushes = 0
         self._thread: Optional[threading.Thread] = None
 
@@ -115,8 +129,11 @@ class AggregatorServer:
         rank = int(msg.get("rank", 0))
         ts = float(msg.get("ts", time.time()))
         counters = msg.get("counters") or {}
+        health = msg.get("health")
         with self._lock:
             self.nb_pushes += 1
+            if isinstance(health, dict):
+                self._health[rank] = health
             for name, value in counters.items():
                 try:
                     v = float(value)
@@ -154,13 +171,43 @@ class AggregatorServer:
                 }
             return {"counters": out, "nb_pushes": self.nb_pushes}
 
+    def health_fleet(self) -> Dict[str, Any]:
+        """The fleet-merged health document ``GET /health`` serves:
+        per-rank snapshots folded over the comm plane exactly like the
+        counter aggregation (worst status, merged firings, fleet-wide
+        per-link exposure and worst link)."""
+        from ..obs.live import fleet_health
+        with self._lock:
+            per_rank = {r: dict(s) for r, s in self._health.items()}
+        return fleet_health(per_rank)
+
+    def timeline(self) -> Dict[str, Any]:
+        """The merged detector-firing timeline ``GET /timeline``
+        serves: every rank's recent firings on one time axis (wall
+        clock — firings are stamped with time.time() at the source)."""
+        with self._lock:
+            per_rank = {r: list(s.get("firings") or ())
+                        for r, s in self._health.items()}
+        events = [dict(f) for firings in per_rank.values()
+                  for f in firings if isinstance(f, dict)]
+        events.sort(key=lambda f: f.get("ts", 0.0))
+        return {"nb_ranks": len(per_rank), "events": events}
+
+    def clear_health(self) -> None:
+        """Forget every rank's health snapshot — chaos_run --soak calls
+        this between iterations so each JSONL record reflects one
+        iteration's firings only."""
+        with self._lock:
+            self._health.clear()
+
 
 class SDEPusher:
     """Daemon thread sampling an SDERegistry and pushing snapshots to an
     AggregatorServer address (host:port). One per Context (= per rank)."""
 
     def __init__(self, sde, addr: str, rank: int = 0,
-                 interval: float = 1.0, extra_sde=None) -> None:
+                 interval: float = 1.0, extra_sde=None,
+                 health_fn=None) -> None:
         host, sep, port = addr.rpartition(":")
         if not sep or not port.isdigit():
             raise ValueError(
@@ -171,6 +218,10 @@ class SDEPusher:
         # global one: named mempools, contextless user counters); the
         # primary registry wins on name collision
         self._extra_sde = extra_sde
+        # obs_live (ISSUE 16): optional zero-arg callable returning the
+        # rank's health snapshot dict, shipped under "health" with each
+        # push (absent when the knob is unset)
+        self._health_fn = health_fn
         self.rank = rank
         self.interval = interval
         self._stop = threading.Event()
@@ -194,8 +245,13 @@ class SDEPusher:
         merged.update(self._sde.snapshot())
         snap = {k: v for k, v in merged.items()
                 if isinstance(v, (int, float))}
-        msg = json.dumps({"rank": self.rank, "ts": time.time(),
-                          "counters": snap}) + "\n"
+        doc = {"rank": self.rank, "ts": time.time(), "counters": snap}
+        if self._health_fn is not None:
+            try:
+                doc["health"] = self._health_fn()
+            except Exception:  # noqa: BLE001 - best-effort telemetry
+                pass
+        msg = json.dumps(doc) + "\n"
         try:
             if self._sock is None:
                 self._sock = socket.create_connection(self._addr, timeout=2)

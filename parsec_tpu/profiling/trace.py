@@ -166,7 +166,9 @@ class Profile:
                 else:
                     ev["ph"] = "i"
                     ev["s"] = "t"
-                if info is not None and ph == "B":
+                if info is not None and ph in ("B", "i"):
+                    # instant annotations (obs_live detector firings)
+                    # carry their verdict in args like "B" slices do
                     ev["args"] = info if isinstance(info, dict) else {"info": info}
                 events.append(ev)
         # rank + the monotonic origin of this profile's normalized

@@ -265,6 +265,11 @@ class Context:
                     interval=max(0.05,
                                  params.get("sde_push_interval_ms") / 1000.0),
                     extra_sde=_global_sde,
+                    # obs_live (ISSUE 16): ship the rank's health
+                    # snapshot with each push so the aggregator can
+                    # serve a fleet-merged GET /health
+                    health_fn=(self.obs.live.snapshot
+                               if self.obs.live is not None else None),
                 ).start()
             except ValueError as e:
                 # telemetry must never take down the run

@@ -54,6 +54,12 @@ class ParamRegistry:
         self._cmdline: Dict[str, str] = {}
         self._file_values: Dict[str, str] = {}
         self._files_loaded = False
+        # scoped-override bookkeeping (cmdline_override): per name, the
+        # pre-override state plus a stack of live override tokens, so
+        # CONCURRENT overrides of one name from several threads (spmd
+        # rank threads all entering the same context manager) unwind to
+        # the true original instead of each other's values
+        self._overrides: Dict[str, Dict[str, Any]] = {}
 
     # -- registration ------------------------------------------------------
     def register(self, name: str, type: str, default: Any, help: str = "") -> Param:
@@ -104,16 +110,54 @@ class ParamRegistry:
     def cmdline_override(self, name: str, value: str):
         """Scoped cmdline-layer override: sets ``name`` for the body,
         then restores whatever cmdline value (or absence) was there
-        before — safe to nest and exception-safe."""
-        prev = self.get_cmdline(name)
-        self.set_cmdline(name, value)
+        before — safe to nest, exception-safe, and safe under
+        CONCURRENT same-name overrides from several threads.
+
+        The naive save/restore (capture ``get_cmdline`` on enter, put
+        it back on exit) leaks under concurrency: thread B entering
+        while thread A's override is live captures *A's value* as its
+        "previous" state and restores it at exit — permanently, once A
+        has also exited (the test_stagec-before-test_overlap_pipeline
+        ordering flake: spmd rank threads overriding ``stage_compile``
+        concurrently left it set for every later test).  Instead each
+        enter pushes a token onto a per-name stack that remembers the
+        TRUE pre-override state from the first push; each exit removes
+        its own token and re-resolves to the top remaining override or
+        the original, whichever the stack says."""
+        tok = object()
+        with _lock:
+            ent = self._overrides.get(name)
+            if ent is None:
+                ent = {"had": name in self._cmdline,
+                       "orig": self._cmdline.get(name),
+                       "stack": []}
+                self._overrides[name] = ent
+            ent["stack"].append((tok, value))
+            self._cmdline[name] = value
+            p = self._params.get(name)
+            if p is not None:
+                p._resolved = False
         try:
             yield self
         finally:
-            if prev is None:
-                self.unset_cmdline(name)
-            else:
-                self.set_cmdline(name, prev)
+            with _lock:
+                ent = self._overrides.get(name)
+                if ent is not None:
+                    ent["stack"] = [tv for tv in ent["stack"]
+                                    if tv[0] is not tok]
+                    if ent["stack"]:
+                        # LIFO by surviving pushes: the most recent
+                        # still-live override wins (nesting semantics)
+                        self._cmdline[name] = ent["stack"][-1][1]
+                    else:
+                        del self._overrides[name]
+                        if ent["had"]:
+                            self._cmdline[name] = ent["orig"]
+                        else:
+                            self._cmdline.pop(name, None)
+                p = self._params.get(name)
+                if p is not None:
+                    p._resolved = False
 
     def parse_argv(self, argv: List[str]) -> List[str]:
         """Consume ``--mca name value`` / ``--parsec name=value`` pairs.
@@ -315,6 +359,26 @@ def register_core_params() -> None:
                     "trace flow events so tools/obs_trace_merge.py "
                     "can fuse rank timelines; off (default) keeps "
                     "every wire byte bit-for-bit unchanged")
+    params.reg_bool("obs_live", False,
+                    "in-runtime streaming health monitor (ISSUE 16): "
+                    "fold closing comm/device/exec spans and stitched "
+                    "flow pairs into rolling-window per-link exposed-"
+                    "wait, per-rank overlap, per-link flow lag, and "
+                    "per-taskpool attribution (the flow context grows "
+                    "a taskpool wire id + send timestamp toward peers "
+                    "that negotiated the HELLO \"lv\" capability); an "
+                    "anomaly layer fires straggler / degraded-link / "
+                    "stuck-progress detectors against self-calibrated "
+                    "baselines, each firing a trace annotation plus "
+                    "PARSEC::OBS::HEALTH::* gauges; snapshots ride "
+                    "sde_push so the aggregator serves GET /health.  "
+                    "Implies the obs_flow machinery; off (default) is "
+                    "bit-for-bit inert: no threads, no gauges, no "
+                    "wire change")
+    params.reg_int("obs_live_window_ms", 250,
+                   "rolling-window tick of the obs_live monitor: "
+                   "detector baselines fold one sample per window "
+                   "(smaller = faster detection, noisier baselines)")
     params.reg_string("profiling_dot", "",
                       "capture the executed DAG; path prefix for DOT files "
                       "(ref: --parsec_dot)")

@@ -91,6 +91,64 @@ def test_cmdline_override_contextmanager(reg):
     assert reg.get("s") == "outer"
 
 
+def test_cmdline_override_concurrent_same_name(reg):
+    """Regression (ISSUE 16 satellite): overlapping same-name overrides
+    from concurrent threads — the spmd rank-thread pattern every
+    multi-rank test uses — must unwind cleanly.  The old save/restore
+    implementation captured the OTHER thread's in-flight value as its
+    "previous" layer and re-published it on exit, leaking a stale
+    cmdline override into whichever test ran next (the test_stagec →
+    test_overlap_pipeline ordering flake)."""
+    import threading
+
+    reg.reg_string("s", "default")
+    start = threading.Barrier(8)
+    errs = []
+
+    def worker(i):
+        try:
+            start.wait(timeout=30)
+            for j in range(200):
+                with reg.cmdline_override("s", f"t{i}.{j}"):
+                    # any thread's in-flight value is legal here; the
+                    # invariant under test is the unwind below
+                    assert reg.get("s") != "default"
+        except Exception as exc:  # noqa: BLE001
+            errs.append(exc)
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(60)
+    assert not errs
+    # every layer unwound: no override survives the stampede
+    assert reg.get_cmdline("s") is None
+    assert reg.get("s") == "default"
+
+
+def test_stagec_then_overlap_pipeline_ordering():
+    """Regression (ISSUE 16 satellite): the historical failing order —
+    ``test_stagec.py`` before ``test_overlap_pipeline.py`` in ONE
+    interpreter — must stay green.  The flake was a stale cmdline
+    override leaked by concurrent same-name ``cmdline_override`` exits
+    (see test_cmdline_override_concurrent_same_name); a file pair in a
+    fresh subprocess pins the end-to-end symptom, not just the
+    mechanism."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.run(
+        [sys.executable, "-m", "pytest", "-q", "-x",
+         "-p", "no:cacheprovider", "-p", "no:randomly",
+         os.path.join("tests", "test_stagec.py"),
+         os.path.join("tests", "test_overlap_pipeline.py")],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=600)
+    assert p.returncode == 0, p.stdout[-2000:] + p.stderr[-500:]
+
+
 def test_file_values(reg, tmp_path, monkeypatch):
     conf = tmp_path / "mca.conf"
     conf.write_text("# comment\nfoo = 13\n")

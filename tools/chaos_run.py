@@ -90,6 +90,14 @@ def main(argv=None) -> int:
                     metavar="SECS",
                     help="per-iteration hang deadline in soak mode "
                          "(default 300)")
+    ap.add_argument("--health", default="", metavar="JSONL",
+                    help="soak mode only: run an in-process aggregator, "
+                         "point every iteration at it (exports "
+                         "PARSEC_MCA_obs_live=1 + sde_push), scrape the "
+                         "fleet /health document after each iteration "
+                         "and append one machine-readable JSONL record "
+                         "per iteration (detector firings, worst link, "
+                         "recovery latency) to this path")
     ap.add_argument("--forensics", default="", metavar="PREFIX",
                     help="activate profiling at PREFIX so every rank "
                          "flight-records its trace on a RankFailedError "
@@ -140,6 +148,9 @@ def main(argv=None) -> int:
     # target script's own argv
     args = ns.args[1:] if ns.args[:1] == ["--"] else ns.args
 
+    if ns.health and ns.soak <= 0:
+        ap.error("--health requires --soak (per-iteration health "
+                 "records only exist in the sustained-load loop)")
     if ns.soak > 0:
         return _soak(ns, script, args)
 
@@ -192,12 +203,54 @@ def _collect_forensics(prefix: str) -> None:
           flush=True)
 
 
+def _append_health(path: str, srv, iteration: int, recovery_s: float,
+                   rc: int) -> None:
+    """One soak iteration's machine-readable health record: the fleet
+    /health document condensed to the fields a soak report needs, then
+    the server's snapshots cleared so the next record is per-iteration."""
+    import json
+
+    fleet = srv.health_fleet()
+    counts = fleet.get("counts", {})
+    rec = {"iteration": iteration,
+           "rc": rc,
+           "recovery_s": round(recovery_s, 3),
+           "status": fleet.get("status", 0),
+           "nb_ranks": fleet.get("nb_ranks", 0),
+           "firings": counts.get("firings", 0),
+           "straggler": counts.get("straggler", 0),
+           "degraded_link": counts.get("degraded_link", 0),
+           "stuck": counts.get("stuck", 0),
+           "worst_link": fleet.get("worst_link"),
+           "firing_events": fleet.get("firings", [])}
+    srv.clear_health()
+    with open(path, "a") as fh:
+        fh.write(json.dumps(rec) + "\n")
+
+
 def _soak(ns, script: str, args) -> int:
     """Sustained-load loop: one fresh subprocess per iteration (the MCA
     env is already exported above, and re-execing chaos_run itself
     keeps the single-run and soak paths identical). Stops at the first
     hang (iteration over --soak-timeout) or corruption (non-zero
-    iteration), which exits non-zero right away."""
+    iteration), which exits non-zero right away.
+
+    With ``--health JSONL`` an in-process AggregatorServer collects
+    each iteration's obs_live pushes (the env exported here is
+    inherited by every child), and one machine-readable record per
+    iteration — detector firings, worst link, recovery latency — is
+    appended to the JSONL, replacing post-hoc trace digging."""
+    health_srv = None
+    if ns.health:
+        from parsec_tpu.profiling.aggregator import AggregatorServer
+        health_srv = AggregatorServer().start()
+        os.environ["PARSEC_MCA_obs_live"] = "1"
+        os.environ["PARSEC_MCA_sde_push"] = health_srv.address
+        os.environ.setdefault("PARSEC_MCA_sde_push_interval_ms", "100")
+        print(f"soak: health aggregator at {health_srv.address}, "
+              f"appending per-iteration records to {ns.health}",
+              flush=True)
+
     base = [sys.executable, os.path.abspath(__file__)]
     if ns.inject:
         base += ["--inject", ns.inject]
@@ -232,6 +285,9 @@ def _soak(ns, script: str, args) -> int:
                   f"— output tail above", flush=True)
             return 2
         dt = time.monotonic() - t0
+        if health_srv is not None:
+            _append_health(ns.health, health_srv, it, dt,
+                           proc.returncode)
         if proc.returncode != 0:
             sys.stdout.write(proc.stdout[-4000:])
             print(f"soak: iteration {it} FAILED rc={proc.returncode} "

@@ -11,6 +11,11 @@ path, the executed-DAG DOT (``profiling_dot=<prefix>``):
     python tools/obs_report.py run.rank*.trace.json --json
 
 Multiple rank traces merge into one report (ranks keyed by pid).
+
+``--live SRC`` renders an obs_live health document instead — SRC is
+either a running aggregator's URL (``http://host:port/health``) or a
+saved snapshot JSON (per-rank or fleet) — through the same text/
+``--json`` formatter, so online and offline reports stay one code path.
 """
 import argparse
 import json
@@ -19,13 +24,17 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from parsec_tpu.obs import analyze, format_report  # noqa: E402
+from parsec_tpu.obs import analyze, format_health, format_report  # noqa: E402
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("traces", nargs="+",
+    ap.add_argument("traces", nargs="*",
                     help="Chrome-trace JSON file(s), one per rank")
+    ap.add_argument("--live", default=None, metavar="URL|SNAPSHOT",
+                    help="render a live health document instead of "
+                         "traces: an aggregator /health URL or a saved "
+                         "snapshot JSON file")
     ap.add_argument("--dot", default=None,
                     help="executed-DAG DOT from the grapher "
                          "(enables the critical-path section)")
@@ -38,6 +47,26 @@ def main(argv=None) -> int:
                          "ranks report 1.0 and never trip the gate) — "
                          "the CI hook for the T3 overlap target")
     args = ap.parse_args(argv)
+
+    if args.live is not None:
+        if args.live.startswith("http"):
+            import urllib.request
+            url = args.live
+            if not url.rstrip("/").endswith(("/health", "/timeline")):
+                url = url.rstrip("/") + "/health"
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                doc = json.loads(resp.read().decode())
+        else:
+            with open(args.live) as fh:
+                doc = json.load(fh)
+        if args.json:
+            json.dump(doc, sys.stdout, indent=2)
+            print()
+        else:
+            print(format_health(doc))
+        return 0
+    if not args.traces:
+        ap.error("either trace files or --live is required")
 
     docs = []
     for path in args.traces:

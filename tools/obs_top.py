@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""obs_top — live terminal view of a running fleet's health.
+
+Polls the aggregator's ``GET /health`` endpoint (the fleet-merged
+obs_live snapshot: per-rank overlap fraction, per-link exposed-wait,
+detector firings) and renders it through the SAME formatter the
+offline report uses (``obs/live.format_health``), so what you read
+live is what ``tools/obs_report.py --live`` prints after the run::
+
+    # terminal 1: the aggregator (or any run with --mca sde_push)
+    python tools/aggregator_server.py --port 9876
+
+    # terminal 2: the workload, pushing health snapshots
+    PARSEC_MCA_obs_live=1 PARSEC_MCA_sde_push=127.0.0.1:9876 \\
+        python examples/ex05_broadcast.py
+
+    # terminal 3: watch it
+    python tools/obs_top.py http://127.0.0.1:9876/health
+
+``--once`` prints a single snapshot and exits (scripting / CI);
+``--json`` emits the raw fleet document instead of text.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from parsec_tpu.obs import format_health  # noqa: E402
+
+
+def fetch(url: str, timeout: float = 2.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("url",
+                    help="aggregator health endpoint, e.g. "
+                         "http://127.0.0.1:9876/health (a bare "
+                         "host:port gets /health appended)")
+    ap.add_argument("--interval", type=float, default=1.0, metavar="SECS",
+                    help="poll cadence (default 1s)")
+    ap.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the raw fleet JSON instead of text")
+    args = ap.parse_args(argv)
+
+    url = args.url
+    if not url.startswith("http"):
+        url = f"http://{url}"
+    if not url.rstrip("/").endswith("/health"):
+        url = url.rstrip("/") + "/health"
+
+    while True:
+        try:
+            doc = fetch(url)
+        except OSError as e:
+            print(f"obs_top: {url} unreachable: {e}", file=sys.stderr)
+            if args.once:
+                return 1
+            time.sleep(args.interval)
+            continue
+        if args.json:
+            print(json.dumps(doc))
+        else:
+            if not args.once:
+                # clear + home, keeping scrollback for firing history
+                sys.stdout.write("\033[H\033[J")
+            print(time.strftime("%H:%M:%S"), url)
+            print(format_health(doc))
+        sys.stdout.flush()
+        if args.once:
+            return 0
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
