@@ -772,6 +772,13 @@ def bench_all(n, nb, reps, cores, dtype):
         hl = _try("health", lambda: bench_health())
         if hl is not None:
             extras.update(hl)
+    # closed-loop self-tuning (ISSUE 17): throttled asymmetric-link
+    # dpotrf, tuned vs each static setting the controller chose
+    # between — scrubbed CPU subprocess, link-independent
+    if os.environ.get("BENCH_AUTOTUNE", "1") != "0":
+        at = _try("autotune", lambda: bench_autotune())
+        if at is not None:
+            extras.update(at)
     # compiled-stage vs interpreted runtime (ISSUE 12): scrubbed CPU
     # subprocess, link-independent — rides every record
     if os.environ.get("BENCH_STAGEC", "1") != "0":
@@ -1912,6 +1919,11 @@ def bench_trace_capture_identity() -> dict:
       advertises ``"lv"`` (nor ``"tr"``), so neither plain nor
       EXTENDED trace contexts travel and rank 0's data frames stay
       byte-identical to the unset legs.
+    - E (ISSUE 17): ``tune_auto`` SET on rank 0 only — the self-tuning
+      controller's knob: rank 1 never advertises ``"tn"``, so no
+      K_TUNE renegotiation may ever travel and rank 0's data frames
+      stay byte-identical to the unset legs (the tune-on leg proves
+      the UNSET legs carry no tuning bytes either way).
     """
     import threading as _threading
     from contextlib import ExitStack
@@ -1924,7 +1936,7 @@ def bench_trace_capture_identity() -> dict:
 
     chunk = 4096
 
-    def leg(flow_r0, live_r0=False):
+    def leg(flow_r0, live_r0=False, tune_r0=False):
         captured = {}
         orig = tcpmod._sendall_vec
 
@@ -1948,7 +1960,8 @@ def bench_trace_capture_identity() -> dict:
                 def boot(r):
                     engines[r] = TCPCommEngine(
                         r, eps, obs_flow=(flow_r0 and r == 0),
-                        obs_live=(live_r0 and r == 0))
+                        obs_live=(live_r0 and r == 0),
+                        tune_auto=(tune_r0 and r == 0))
                 ts = [_threading.Thread(target=boot, args=(r,))
                       for r in (0, 1)]
                 for t in ts:
@@ -2015,11 +2028,13 @@ def bench_trace_capture_identity() -> dict:
     b = leg(False)
     c = leg(True)
     d = leg(False, live_r0=True)
+    e = leg(False, tune_r0=True)
     return {
         "trace_frames_captured": len(a),
         "trace_unset_bit_identical": bool(a and a == b),
         "trace_mixed_version_bit_identical": bool(a and a == c),
         "live_mixed_version_bit_identical": bool(a and a == d),
+        "tune_mixed_version_bit_identical": bool(a and a == e),
     }
 
 
@@ -2236,10 +2251,6 @@ def bench_health_inner(n=256, nb=64, delay_ms=3, chunk_bytes=8192) -> dict:
                     t0 = time.perf_counter()
                     rep("descA")
                     wall = time.perf_counter() - t0
-                    # nobody finis while the peer is mid-DAG: a fast
-                    # rank's GOODBYE while the slow one still owes
-                    # rendezvous GETs reads as a rank failure
-                    barrier.wait(timeout=120)
                     firing = None
                     if detector:
                         # quiet windows after descA converge the per-
@@ -2333,6 +2344,249 @@ def bench_health(n=256, nb=64, delay_ms=3) -> dict:
         return json.loads(p.stdout.strip().splitlines()[-1])
     except Exception as exc:  # noqa: BLE001
         return {"health_error": repr(exc)[:200]}
+
+
+# ---------------------------------------------------------------------- #
+# closed-loop self-tuning benchmark (ISSUE 17): throttled asymmetric-    #
+# link dpotrf, the tuned run vs each static setting it chose between     #
+# ---------------------------------------------------------------------- #
+def bench_autotune_inner(n=1024, nb=128, link_mbps=1.0,
+                         chunk_bytes=65536, window_ms=20) -> dict:
+    """BENCH_MODE=autotune payload (ISSUE 17): a 2-rank classic-runtime
+    dpotrf on an ASYMMETRIC link — rank 1's writer is paced to
+    ``link_mbps`` (a bytes-proportional sleep around ``_sendall_vec``,
+    the same seam the capture-identity differential taps), rank 0
+    sends at loopback speed.  The tuned leg (``tune_auto``) starts
+    lossless at the default device shape and lets the controller move:
+    the send-bandwidth floor escalates rank 1's wire codec up the
+    ladder within ``tune_residual_budget`` = 1e-1 (lossless -> qbf16 ->
+    qint8), and the occupancy hill-climb reshapes ``batch_max``.
+
+    Every leg runs TWO reps in the same context and the SECOND is the
+    measured one: rep 1 is the adaptation window for the tuned leg and
+    the jit/baseline warmup for every leg, so all legs pay the same
+    per-taskpool compile set and the tuned leg is measured at its
+    SETTLED configuration — the steady state an adaptive controller
+    actually buys, not its first seconds of exploration.
+
+    The static legs are the settings the controller chose between and
+    REJECTED, read back from the tuned run itself: every codec rung it
+    climbed through and left (never the one it settled on) crossed
+    with both device shapes it touched (the default it abandoned and
+    the shape it chose).  The ORACLE leg — the full chosen (codec,
+    shape) pinned statically from the start — is reported separately:
+    an adaptive run cannot beat the config it converged to, so the
+    gate bounds tuned against the oracle (within a few percent) and
+    requires it to strictly beat every rejected static."""
+    import concurrent.futures as cf
+    import threading as _threading
+    from contextlib import ExitStack
+
+    import parsec_tpu
+    from parsec_tpu.collections import TwoDimBlockCyclic
+    from parsec_tpu.comm import RemoteDepEngine
+    from parsec_tpu.comm import tcp as tcpmod
+    from parsec_tpu.comm.tcp import TCPCommEngine, free_ports
+    from parsec_tpu.obs import merge_trace_docs
+    from parsec_tpu.obs.spans import HEALTH_STREAM_TID
+    from parsec_tpu.ops import dpotrf_taskpool, make_spd
+    from parsec_tpu.utils.params import params as _params
+
+    ranks = 2
+    batch_default = 16
+    budget = 1e-1
+    M = make_spd(n, dtype=np.float32)
+    bw_bps = float(link_mbps) * 1e6
+
+    real_sendall = tcpmod._sendall_vec
+
+    def paced_sendall(sock, pieces):
+        nbytes = sum(len(p) if isinstance(p, (bytes, bytearray))
+                     else p.nbytes for p in pieces)
+        real_sendall(sock, pieces)
+        # asymmetric throttle: only rank 1's writer threads pay the
+        # pacing sleep, so its send bandwidth EWMA converges to
+        # link_mbps while rank 0's link stays at loopback speed
+        if _threading.current_thread().name.startswith("tcp-send-r1"):
+            time.sleep(nbytes / bw_bps)
+
+    def run_leg(tune=False, codec="", batch_max=batch_default):
+        overrides = {
+            "comm_chunk_bytes": str(chunk_bytes),
+            "comm_mesh_local": "0",   # payloads must ride the wire
+            "device_batch_max": str(batch_max),
+        }
+        if codec:
+            overrides["comm_quantize"] = codec
+        if tune:
+            overrides.update({
+                "tune_auto": "1",
+                "tune_residual_budget": f"{budget:g}",
+                "obs_live_window_ms": str(window_ms),
+            })
+        ports = free_ports(ranks)
+        eps = [("127.0.0.1", p) for p in ports]
+        traces = {}
+        with ExitStack() as st:
+            for k, v in overrides.items():
+                st.enter_context(_params.cmdline_override(k, v))
+            tcpmod._sendall_vec = paced_sendall
+            try:
+                def rank_fn(r):
+                    ce = TCPCommEngine(r, eps)
+                    eng = RemoteDepEngine(ce)
+                    # every leg pays the profiler so walls compare
+                    # like-for-like; only the tuned leg's trace is kept
+                    ctx = parsec_tpu.Context(nb_cores=1, comm=eng,
+                                             profile=True)
+                    try:
+                        def rep(name):
+                            coll = TwoDimBlockCyclic(
+                                n, n, nb, nb, dtype=np.float32,
+                                P=ranks, Q=1, nodes=ranks, rank=r)
+                            coll.name = name
+                            coll.from_numpy(M.copy())
+                            tp = dpotrf_taskpool(coll, rank=r,
+                                                 nb_ranks=ranks)
+                            ctx.add_taskpool(tp)
+                            ctx.wait()
+                            return coll
+
+                        rep("descA")      # adapt (tuned) / warm (all)
+                        sent1 = ce.wire_stats["chunk_bytes_sent"]
+                        t0 = time.perf_counter()
+                        coll = rep("descB")   # the measured rep
+                        wall = time.perf_counter() - t0
+                        peer = (r + 1) % ranks
+                        d = {"wall": wall,
+                             "rep2_bytes":
+                                 ce.wire_stats["chunk_bytes_sent"]
+                                 - sent1,
+                             "active": ce.active_quant_codec(peer)}
+                        tn = getattr(ctx.obs, "tuner", None)
+                        if tn is not None:
+                            d["counts"] = dict(tn.counts)
+                        d["batch_max"] = [
+                            dev.batch_max for dev in ctx.devices
+                            if getattr(dev, "device_type", "") == "tpu"]
+                        if tune:
+                            ctx._stamp_profile_meta()
+                            traces[r] = ctx.profile.to_chrome_trace()
+                        owned = {c: np.asarray(
+                            coll.data_of(*c).sync_to_host().payload)
+                            for c in coll.tiles()
+                            if coll.rank_of(*c) == r}
+                        return d, owned
+                    finally:
+                        ctx.fini()
+
+                with cf.ThreadPoolExecutor(ranks) as ex:
+                    results = list(ex.map(rank_fn, range(ranks)))
+            finally:
+                tcpmod._sendall_vec = real_sendall
+        tiles = {}
+        for _d, owned in results:
+            tiles.update(owned)
+        L = np.zeros((n, n), np.float32)
+        for (tm, tk), t in tiles.items():
+            L[tm * nb:tm * nb + t.shape[0],
+              tk * nb:tk * nb + t.shape[1]] = t
+        Lt = np.tril(L).astype(np.float64)
+        resid = float(np.abs(Lt @ Lt.T - M).max() / np.abs(M).max())
+        leg = {
+            "wall_s": round(max(d["wall"] for d, _t in results), 3),
+            "residual": resid,
+            "r1_rep2_bytes": results[1][0]["rep2_bytes"],
+        }
+        if tune:
+            leg["counts"] = [d.get("counts") for d, _t in results]
+            leg["active_codec"] = results[1][0]["active"]
+            leg["batch_max_final"] = min(
+                min(d["batch_max"]) for d, _t in results
+                if d["batch_max"])
+            merged = merge_trace_docs([traces[0], traces[1]])
+            annos = [e for e in merged["traceEvents"]
+                     if e.get("ph") == "i"
+                     and e.get("tid") == HEALTH_STREAM_TID
+                     and str(e.get("name", "")).startswith("tune:")]
+            leg["timeline_annotations"] = sorted(
+                {e["name"] for e in annos})
+            leg["timeline_annotation_count"] = len(annos)
+        return leg
+
+    out = {"autotune_n": n, "autotune_nb": nb,
+           "autotune_ranks": ranks,
+           "autotune_link_mbps": link_mbps,
+           "autotune_chunk_bytes": chunk_bytes,
+           "autotune_window_ms": window_ms,
+           "autotune_residual_budget": budget,
+           "autotune_batch_default": batch_default}
+
+    tuned = run_leg(tune=True)
+    out.update({f"tuned_{k}": v for k, v in tuned.items()})
+
+    # the choice set, read back from the tuned run: every rung below
+    # the one it settled on, crossed with both shapes it touched
+    ladder = [None, "qbf16", "qint8"]
+    active = tuned.get("active_codec")
+    final_rung = ladder.index(active) if active in ladder else 0
+    rejected_codecs = ladder[:final_rung] or [None]
+    bstar = tuned.get("batch_max_final", batch_default)
+    shapes = sorted({batch_default, bstar})
+    out["autotune_chosen_codec"] = active or "lossless"
+    out["autotune_chosen_batch_max"] = bstar
+
+    static_walls = {}
+    for qc in rejected_codecs:
+        for bm in shapes:
+            label = f"static_{(qc or 'lossless').lstrip('q')}_b{bm}"
+            leg = run_leg(codec=(qc or "").lstrip("q"), batch_max=bm)
+            static_walls[label] = leg["wall_s"]
+            out.update({f"{label}_{k}": v for k, v in leg.items()})
+    oracle = run_leg(codec=(active or "").lstrip("q"), batch_max=bstar)
+    out.update({f"oracle_{k}": v for k, v in oracle.items()})
+
+    best_static = min(static_walls.values()) if static_walls else -1.0
+    out["autotune_best_static_wall_s"] = best_static
+    out["autotune_tuned_vs_best_static"] = round(
+        best_static / max(1e-9, tuned["wall_s"]), 3)
+    out["autotune_tuned_vs_oracle"] = round(
+        tuned["wall_s"] / max(1e-9, oracle["wall_s"]), 3)
+    return out
+
+
+_AUTOTUNE_DRIVER = r"""
+import json, os, sys
+sys.path.insert(0, os.environ["BENCH_REPO"])
+import bench
+
+print(json.dumps(bench.bench_autotune_inner(
+    n=int(os.environ.get("BENCH_AUTOTUNE_N", "1024")),
+    nb=int(os.environ.get("BENCH_AUTOTUNE_NB", "128")),
+    link_mbps=float(os.environ.get("BENCH_AUTOTUNE_LINK_MBPS", "1.0")))))
+"""
+
+
+def bench_autotune(n=1024, nb=128, link_mbps=1.0) -> dict:
+    """BENCH_MODE=autotune: the self-tuning legs in a scrubbed CPU
+    subprocess (same pattern as bench_health: numbers must not depend
+    on the tunnel session's TPU plugin)."""
+    import subprocess
+    import sys as _sys
+
+    env = _scrubbed_bench_env(
+        n_devices=2,
+        BENCH_AUTOTUNE_N=n, BENCH_AUTOTUNE_NB=nb,
+        BENCH_AUTOTUNE_LINK_MBPS=link_mbps)
+    try:
+        p = subprocess.run([_sys.executable, "-c", _AUTOTUNE_DRIVER],
+                           env=env, capture_output=True, text=True,
+                           timeout=1200)
+        if p.returncode != 0:
+            return {"autotune_error": p.stdout[-200:] + p.stderr[-200:]}
+        return json.loads(p.stdout.strip().splitlines()[-1])
+    except Exception as exc:  # noqa: BLE001
+        return {"autotune_error": repr(exc)[:200]}
 
 
 # ---------------------------------------------------------------------- #
@@ -2816,6 +3070,19 @@ def main() -> None:
             "metric_id": "health_us_per_task_delta", "mode": mode,
             "value": extras.get("health_us_per_task_delta", -1.0),
             "unit": "us/task", "extras": extras})
+        return
+    if mode == "autotune":
+        extras = bench_autotune(
+            n=int(os.environ.get("BENCH_AUTOTUNE_N", "1024")),
+            nb=int(os.environ.get("BENCH_AUTOTUNE_NB", "128")),
+            link_mbps=float(os.environ.get("BENCH_AUTOTUNE_LINK_MBPS",
+                                           "1.0")))
+        emit_json({
+            "metric": "autotune_tuned_vs_best_static(throttled_tcp_"
+                      "dpotrf,closed_loop)",
+            "metric_id": "autotune_tuned_vs_best_static", "mode": mode,
+            "value": extras.get("autotune_tuned_vs_best_static", -1.0),
+            "unit": "x", "extras": extras})
         return
     if mode == "dispatch":
         extras = bench_dispatch(

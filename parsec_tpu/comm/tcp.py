@@ -74,7 +74,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..core.lists import Fifo
-from .engine import RankFailedError, TAG_USER_BASE
+from .engine import RankFailedError, TAG_GET_DATA, TAG_USER_BASE
 from ..utils import logging as plog
 from .local import LocalCommEngine, _wire_copy
 from . import wire
@@ -137,6 +137,13 @@ _GUARDED_BY = {
     "_Peer.qz_codec": "cond",
     "_Peer.q_pre": "cond",
     "_Peer.q_post": "cond",
+    # closed-loop tuning (ISSUE 17): receive-side accounting of
+    # quantized buffers that LANDED on this link (raw vs encoded bytes
+    # — the de-escalation evidence the controller on the receiving
+    # rank reads), written by the receiver thread, read by the
+    # controller's window tick
+    "_Peer.qrx_pre": "cond",
+    "_Peer.qrx_post": "cond",
     "_Peer.comp_pre": "cond",
     "_Peer.comp_post": "cond",
     "_Peer.suspect": "cond",
@@ -155,6 +162,10 @@ _GUARDED_BY = {
     "TCPCommEngine._clock": "_stat_lock",
     "TCPCommEngine._clock_n": "_stat_lock",
     "TCPCommEngine._rx_pending": "_stat_lock",
+    # GOODBYE verdict evidence: GET tokens whose reply arrived but has
+    # not been consumed — written by receiver threads, read by the
+    # GOODBYE wait (shares the engine lock that guards _get_cbs/_get_srcs)
+    "TCPCommEngine._rx_get_tokens": "_lock",
     "TCPCommEngine._xfer_iter": "_stat_lock",
     "TCPCommEngine._suspect_ms_total": "_stat_lock",
     "TCPCommEngine._barrier_arrived": "_barrier_lock",
@@ -222,7 +233,7 @@ class _Peer:
                  "rs_rx_unacked_frames", "rs_rx_unacked_bytes",
                  "rs_rx_partial", "rx_xfers", "recv_thread", "rs_dup_next",
                  "rs_resuming", "qz_codec", "q_pre", "q_post",
-                 "comp_pre", "comp_post")
+                 "comp_pre", "comp_post", "tn_ok", "qrx_pre", "qrx_post")
 
     def __init__(self, rank: int, sock: socket.socket) -> None:
         self.rank = rank
@@ -249,6 +260,10 @@ class _Peer:
         self.el_ok = False         # HELLO advertised elastic membership
         self.tr_ok = False         # HELLO advertised flow tracing ("tr")
         self.lv_ok = False         # HELLO advertised obs_live ("lv")
+        self.tn_ok = False         # HELLO advertised runtime tuning ("tn")
+        # -- closed-loop tuning (ISSUE 17) ------------------------------
+        self.qrx_pre = 0           # raw bytes of RECEIVED quantized bufs
+        self.qrx_post = 0          # encoded bytes that landed for them
         # -- reliable session (ISSUE 10) --------------------------------
         self.rs_ok = False         # both ends advertised "rs"
         self.hello_seen = False    # the peer's HELLO was processed
@@ -298,9 +313,15 @@ class TCPCommEngine(LocalCommEngine):
                  quantize: Optional[str] = None,
                  quantize_threshold_mbps: Optional[float] = None,
                  obs_flow: Optional[bool] = None,
-                 obs_live: Optional[bool] = None) -> None:
+                 obs_live: Optional[bool] = None,
+                 tune_auto: Optional[bool] = None) -> None:
         from ..utils.params import params
         self._inbox: Fifo = Fifo()
+        # GET tokens whose reply has ARRIVED (pushed to the inbox by a
+        # receiver thread) but not yet been consumed by a worker — the
+        # GOODBYE verdict uses this to tell delivered-not-consumed
+        # apart from never-sent (guarded by self._lock)
+        self._rx_get_tokens: set = set()
         self._peers: Dict[int, _Peer] = {}
         self._recv_threads: List[threading.Thread] = []
         self._closing = False
@@ -383,7 +404,17 @@ class TCPCommEngine(LocalCommEngine):
         # would produce.
         if obs_live is None:
             obs_live = bool(params.get_or("obs_live", "bool", False))
-        self._live_enabled = bool(obs_live)
+        # closed-loop tuning (ISSUE 17): the controller renegotiates a
+        # link's quantized codec at RUNTIME via K_TUNE frames — only
+        # ever toward peers whose HELLO advertised the symmetric "tn"
+        # capability (a mixed-version or knob-unset peer keeps the
+        # codec its HELLO negotiated, forever).  The knob implies the
+        # obs_live wire behavior: the controller's heartbeat is the
+        # live monitor's window tick.
+        if tune_auto is None:
+            tune_auto = bool(params.get_or("tune_auto", "bool", False))
+        self._tune_enabled = bool(tune_auto)
+        self._live_enabled = bool(obs_live) or self._tune_enabled
         self._flow_enabled = bool(obs_flow) or self._live_enabled
         self._clock: Dict[int, float] = {}      # peer -> offset EWMA us
         self._clock_n: Dict[int, int] = {}      # peer -> sample count
@@ -525,6 +556,12 @@ class TCPCommEngine(LocalCommEngine):
             # contexts — gated like "tr", so an unset knob's HELLO is
             # bit-identical and obs_flow-only peers keep 2-tuples
             info["lv"] = True
+        if self._tune_enabled:
+            # runtime tuning (ISSUE 17): this end accepts K_TUNE codec
+            # renegotiation frames — gated like "tr"/"lv" so an unset
+            # knob's HELLO stays bit-identical and a mixed-version peer
+            # is never renegotiated
+            info["tn"] = True
         if self._quantize is not None:
             # quantized codecs are advertised ONLY when the local knob
             # is set — symmetric like "rs", so a knob-unset build keeps
@@ -1126,6 +1163,107 @@ class TCPCommEngine(LocalCommEngine):
             p.cond.notify()
         return True
 
+    # -- closed-loop tuning (ISSUE 17) ----------------------------------
+    def tune_to(self, dst: int) -> bool:
+        """K_TUNE renegotiation frames travel only toward peers whose
+        HELLO advertised ``"tn"`` — a mixed-version (or knob-unset)
+        peer keeps the codec its HELLO negotiated, forever."""
+        with self._conn_cond:
+            p = self._peers.get(dst)
+        return p is not None and p.tn_ok
+
+    def tune_send(self, peer: int, payload) -> bool:
+        """Wire-level runtime-tuning frame (K_TUNE): like
+        ``ft_elastic_send``, enqueued on the ctrl lane and applied by
+        the peer's receiver thread — a codec renegotiation lands even
+        while the peer's workers are wedged in a long kernel.  Gated on
+        the HELLO ``tn`` capability: a mixed-version peer is never
+        renegotiated.  Exempt from the chaos layer (control plane);
+        the controller re-decides every window, so a lost frame is
+        re-issued by the next tick."""
+        if self._ft_silenced or peer in self.dead_peers \
+                or peer in self.finished_peers:
+            return False
+        with self._conn_cond:
+            p = self._peers.get(peer)
+        if p is None or not p.tn_ok or p.done:
+            return False
+        frame = wire.pack_tune(dict(payload))
+        with p.cond:
+            p.ctrl.append(("frame", frame))
+            p.queued_bytes += len(frame)
+            p.cond.notify()
+        return True
+
+    def set_quant_codec(self, peer: int, codec: Optional[str]) -> bool:
+        """Local half of a codec renegotiation: install ``codec`` (a
+        registered quantized codec name, or None for lossless) as THIS
+        rank's active encoding toward ``peer``, exactly as if the HELLO
+        had negotiated it.  Quantization applies at enqueue, so frames
+        already queued (and the replay window) keep the bytes encoded
+        under the codec active when they were accepted — a replay stays
+        bit-identical across the switch.  Resets the per-codec byte
+        accounting so the COMPRESS_RATIO gauge reflects the NEW codec.
+        Returns False (no change) toward an unknown peer or a codec
+        name that is not registered."""
+        if codec is not None and codec not in wire.available_quant_codecs():
+            return False
+        with self._conn_cond:
+            p = self._peers.get(peer)
+        if p is None:
+            return False
+        with p.cond:
+            if p.qz_codec != codec:
+                p.qz_codec = codec
+                p.q_pre = 0
+                p.q_post = 0
+        return True
+
+    def active_quant_codec(self, peer: int) -> Optional[str]:
+        """The quantized codec THIS rank currently encodes with toward
+        ``peer`` (HELLO-negotiated or runtime-renegotiated)."""
+        with self._conn_cond:
+            p = self._peers.get(peer)
+        if p is None:
+            return None
+        with p.cond:
+            return p.qz_codec
+
+    def rx_quant_ratio(self, peer: int) -> Tuple[int, int]:
+        """Receive-side quantized-buffer accounting for the inbound
+        link from ``peer``: (raw bytes, encoded bytes) of quantized
+        buffers that LANDED here.  The controller on the receiving
+        rank reads the deltas: an escalated link whose encoded count
+        stops moving carries no eligible traffic — the codec shows no
+        win and the ladder steps back down."""
+        with self._conn_cond:
+            p = self._peers.get(peer)
+        if p is None:
+            return (0, 0)
+        with p.cond:
+            return (p.qrx_pre, p.qrx_post)
+
+    def _on_tune(self, p: _Peer, msg: Dict[str, Any]) -> None:
+        """Apply one runtime-tuning directive from the controller on
+        the RECEIVING end of this link (it watches its inbound
+        exposed-wait; we hold the actuator — the send-side codec).
+        Only honored between ends that both advertised "tn"; an
+        unknown op or codec name is dropped, never fatal (the two ends
+        may trail each other by a release)."""
+        if not (self._tune_enabled and p.tn_ok):
+            return
+        if msg.get("op") != "codec":
+            plog.debug.verbose(
+                1, "tcp rank %d: ignoring unknown tune op %r from "
+                "peer %d", self.rank, msg.get("op"), p.rank)
+            return
+        codec = msg.get("codec")
+        if not self.set_quant_codec(p.rank, codec):
+            plog.warning(
+                "tcp rank %d: peer %d requested unknown quantized "
+                "codec %r — keeping %r", self.rank, p.rank, codec,
+                self.active_quant_codec(p.rank))
+
     def report_peer_failure(self, peer: int, reason: str) -> None:
         """Uniform failure funnel (base-class API): a proactive
         (heartbeat) eviction is unconditional — the peer is SILENT, so
@@ -1646,6 +1784,46 @@ class TCPCommEngine(LocalCommEngine):
             return buf, False
         return buf, True
 
+    def _note_get_reply(self, tag: int, payload: Any) -> None:
+        """Receiver-thread bookkeeping for the GOODBYE verdict: record
+        which outstanding GET tokens have their reply ARRIVED (parked
+        in the inbox, waiting for a worker to pump progress()).  A
+        token still owed at GOODBYE with no arrived reply provably
+        never got one — frames are FIFO, the sentinel is the stream's
+        last — so the verdict need not wait for it."""
+        if tag != TAG_GET_DATA:
+            return
+        items = payload.get("items") if isinstance(payload, dict) else None
+        if not items:
+            return
+        with self._lock:
+            for item in items:
+                self._rx_get_tokens.add(item["token"])
+            # consumed tokens left _get_cbs — prune so the set tracks
+            # only the in-flight window, not the engine's lifetime
+            self._rx_get_tokens.intersection_update(self._get_cbs)
+
+    def _await_owed_gets(self, peer: int, timeout: float = 30.0) -> None:
+        """Park this receiver thread (it has nothing left to read —
+        the GOODBYE sentinel is the stream's last frame) until the
+        workers CONSUME every outstanding GET toward ``peer`` whose
+        reply already arrived, or the budget expires.  Returns at once
+        when some owed token has no arrived reply: that reply provably
+        never left the peer (frames are FIFO), so the peer is a
+        definite failure and the verdict must not stall on it.  The
+        arrived replies were pushed with an arrival notification, so a
+        parked worker is already waking to consume them."""
+        deadline = time.monotonic() + timeout
+        while not self._closing and peer not in self.dead_peers:
+            with self._lock:
+                owed = [t for t, s in self._get_srcs.items() if s == peer]
+                arrived = all(t in self._rx_get_tokens for t in owed)
+            if not owed:
+                return
+            if not arrived or time.monotonic() >= deadline:
+                return
+            time.sleep(0.001)
+
     def _recv_fault(self, p: _Peer, gen: int, reason: str) -> None:
         """A receiver-side connection fault: absorbed as SUSPECT when a
         session covers the link, fail-fast ``_peer_died`` otherwise."""
@@ -1666,6 +1844,20 @@ class TCPCommEngine(LocalCommEngine):
                     return
                 (size,) = struct.unpack("<Q", hdr)
                 if size == GOODBYE:
+                    # a clean shutdown is honored only after the
+                    # rendezvous replies the peer already delivered are
+                    # CONSUMED: frames are FIFO, so every reply to a
+                    # served GET precedes this sentinel in this very
+                    # stream and is parked in the inbox — but
+                    # _get_srcs is only cleared when a worker pumps
+                    # progress(), so the verdict below would race the
+                    # delivery it is checking for.  (An incomplete
+                    # chunked transfer is different: its missing bytes
+                    # provably never left, no point waiting.)
+                    with p.cond:
+                        mid_xfer = bool(p.rx_xfers)
+                    if not mid_xfer:
+                        self._await_owed_gets(peer)
                     with self._lock:
                         owes_us = peer in self._get_srcs.values()
                     with p.cond:
@@ -1721,6 +1913,7 @@ class TCPCommEngine(LocalCommEngine):
                 # read-only — host mutators copy-on-write via
                 # Data.materialize_host
                 src, tag, payload = wire.load_message(frame, bufs)
+                self._note_get_reply(tag, payload)
                 self._inbox.push((src, tag, payload))
                 self._notify_arrival()  # wake a parked worker now
         elif kind == wire.K_XFER_HDR:
@@ -1728,6 +1921,7 @@ class TCPCommEngine(LocalCommEngine):
             rx = wire.RxXfer(frame, specs)
             if rx.remaining <= 0:
                 src, tag, payload = rx.message()
+                self._note_get_reply(tag, payload)
                 self._inbox.push((src, tag, payload))
                 self._notify_arrival()
                 return
@@ -1743,7 +1937,20 @@ class TCPCommEngine(LocalCommEngine):
                 del xfers[xid]
                 with self._stat_lock:
                     self._rx_pending[peer] = len(xfers)
+                if any(rx.quant):
+                    # controller evidence (ISSUE 17): how many raw
+                    # bytes this link's quantized buffers stood for vs
+                    # the encoded bytes that actually landed
+                    pre = post = 0
+                    for b, q in zip(rx.bufs, rx.quant):
+                        if q:
+                            pre += wire.quant_raw_len(b)
+                            post += len(b)
+                    with p.cond:
+                        p.qrx_pre += pre
+                        p.qrx_post += post
                 src, tag, payload = rx.message()
+                self._note_get_reply(tag, payload)
                 self._inbox.push((src, tag, payload))
                 self._notify_arrival()
         elif kind == wire.K_HELLO:
@@ -1759,6 +1966,10 @@ class TCPCommEngine(LocalCommEngine):
             # both ends must run with obs_live set or senders keep the
             # plain (origin, span) pair
             p.lv_ok = bool(info.get("lv")) and self._live_enabled
+            # runtime tuning is symmetric too: only a link whose BOTH
+            # ends run with tune_auto ever renegotiates its codec —
+            # a mixed-version peer stays on its HELLO negotiation
+            p.tn_ok = bool(info.get("tn")) and self._tune_enabled
             with p.cond:
                 # quantize capability is symmetric like "rs": only a
                 # peer that advertised the requested codec under "qz"
@@ -1882,6 +2093,12 @@ class TCPCommEngine(LocalCommEngine):
             # coordinator even while every worker is wedged in a long
             # kernel — elastic agreement is progress-cadence-free on TCP
             self._on_elastic(peer, wire.parse_elastic(body))
+        elif kind == wire.K_TUNE:
+            # applied HERE, on the receiver thread (like K_ELASTIC): a
+            # codec renegotiation takes effect at the next enqueue, not
+            # at the next progress pump — the controller's window
+            # cadence stays decoupled from the workers'
+            self._on_tune(p, wire.parse_tune(body))
         elif kind == wire.K_COMP:
             self._dispatch_body(p, memoryview(
                 wire.decompress_body(body)))

@@ -128,6 +128,7 @@ K_SEQ = 8
 K_ACK = 9
 K_RESUME = 10
 K_FRAG = 11
+K_TUNE = 12
 
 WIRE_VERSION = 2
 
@@ -473,6 +474,21 @@ def parse_elastic(body: memoryview) -> Dict[str, Any]:
     return pickle.loads(body[1:])
 
 
+# -- runtime tuning (tune/controller.py; the "tn" HELLO capability) -----
+def pack_tune(payload: Dict[str, Any]) -> bytes:
+    """One runtime-tuning control frame (e.g. a per-link quantized
+    codec renegotiation, ``{"op": "codec", "codec": name-or-None}``).
+    Session-less like K_ELASTIC: handled on the receiver THREAD, never
+    wrapped in K_SEQ (a renegotiation is regenerated, not replayed —
+    and quantization happens at enqueue, so the replay window already
+    holds bytes encoded under the codec active at enqueue time)."""
+    return bytes([K_TUNE]) + pickle.dumps(payload, protocol=4)
+
+
+def parse_tune(body: memoryview) -> Dict[str, Any]:
+    return pickle.loads(body[1:])
+
+
 # -- hello / compression ------------------------------------------------
 def pack_hello(info: Dict[str, Any]) -> bytes:
     return bytes([K_HELLO]) + pickle.dumps(info, protocol=4)
@@ -603,6 +619,12 @@ def dequantize_buffer(buf: Any) -> bytes:
         raise ValueError(
             f"dequantized length {len(out)} != announced {raw_len}")
     return out
+
+
+def quant_raw_len(buf: Any) -> int:
+    """Raw (decoded) byte count a quantized buffer stands for, read
+    from its self-describing header without decoding the payload."""
+    return _QHDR.unpack_from(memoryview(buf), 0)[2]
 
 
 def qdq_array(arr: np.ndarray, codec: str) -> np.ndarray:

@@ -50,7 +50,9 @@ from .spans import (COMM_ACTIVE_TRANSFERS, COMM_BYTES_RECEIVED,
                     OBS_HEALTH_STATUS, OBS_HEALTH_STRAGGLER,
                     OBS_HEALTH_STUCK, OBS_HEALTH_WINDOWS,
                     OBS_HEALTH_WORST_LINK_US, OBS_OVERLAP_FRACTION,
-                    OverlapTracker, flow_event_id, inbound_flow_ctx,
+                    OverlapTracker, TUNE_ACTIVE_CODEC_PREFIX,
+                    TUNE_DECISIONS, TUNE_OBJECTIVE_US, TUNE_REVERTS,
+                    flow_event_id, inbound_flow_ctx,
                     payload_nbytes, register_device_gauges)
 
 __all__ = [
@@ -69,6 +71,8 @@ __all__ = [
     "OBS_HEALTH_STATUS", "OBS_HEALTH_WINDOWS", "OBS_HEALTH_FIRINGS",
     "OBS_HEALTH_STRAGGLER", "OBS_HEALTH_DEGRADED", "OBS_HEALTH_STUCK",
     "OBS_HEALTH_WORST_LINK_US",
+    "TUNE_DECISIONS", "TUNE_REVERTS", "TUNE_ACTIVE_CODEC_PREFIX",
+    "TUNE_OBJECTIVE_US",
     "LiveHealth", "RollingStat", "fleet_health", "format_health",
     "register_health_gauges",
     "flow_event_id", "inbound_flow_ctx",
@@ -94,7 +98,12 @@ class ContextObs:
 
     def __init__(self, ctx: Any) -> None:
         self.metrics = MetricsRegistry(ctx.sde)
-        live_on = _live_param()
+        tune_on = _tune_param()
+        # tune_auto (ISSUE 17) implies obs_live: the controller's only
+        # input is the monitor's window digest, so the knob pulls the
+        # whole monitor up with it (mirroring obs_live implying the
+        # span sinks below)
+        live_on = _live_param() or tune_on
         # obs_live (ISSUE 16) implies the span sinks: the streaming
         # monitor's feeds ARE the comm/device/exec hooks, so the knob
         # alone turns telemetry on even without profile= or metrics
@@ -221,6 +230,33 @@ class ContextObs:
                                                       tracker=self.overlap,
                                                       live=self.live)
                 self._task_module.enable()
+        # closed-loop self-tuning (ISSUE 17, tune/controller.py): the
+        # controller rides the monitor's window-tick subscriber seam —
+        # constructed ONLY under tune_auto, after every actuation
+        # target (transport, devices, overlap tracker) exists, before
+        # the monitor thread starts ticking
+        self.tuner = None
+        if tune_on and self.live is not None:
+            from ..tune import Controller, register_tune_gauges
+            from ..utils.params import params
+            try:
+                budget = float(params.get_or(
+                    "tune_residual_budget", "string", "1e-2") or 0.0)
+            except (TypeError, ValueError):
+                budget = 1e-2
+            self.tuner = Controller(
+                ctx.rank, self.live,
+                engine=ce,
+                devices=tuple(ctx.devices),
+                residual_budget=budget,
+                hysteresis=params.get_or("tune_hysteresis_windows",
+                                         "int", 2),
+                z_thresh=self.live.z_thresh,
+                overlap_fn=(self.overlap.fraction
+                            if self.overlap is not None else None),
+                stage_classes_fn=lambda c=ctx: _compiled_stage_classes(c))
+            register_tune_gauges(ctx.sde, self.tuner)
+            self.live.subscribe(self.tuner.on_window)
         if self.live is not None:
             # the rolling-window monitor thread (detectors + window
             # folds) — the last thing started, so every feed is wired
@@ -268,6 +304,28 @@ def _flow_param() -> bool:
 def _live_param() -> bool:
     from ..utils.params import params
     return bool(params.get_or("obs_live", "bool", False))
+
+
+def _tune_param() -> bool:
+    from ..utils.params import params
+    return bool(params.get_or("tune_auto", "bool", False))
+
+
+def _compiled_stage_classes(ctx: Any) -> List[str]:
+    """Class names with a live compiled stage on this context, in plan
+    order — the stagec-exclusion family's attribution source (best
+    effort: an interpreted-only context returns [])."""
+    names: List[str] = []
+    for tp in list(getattr(ctx, "taskpools", {}).values()):
+        sc = getattr(tp, "_stagec", None)
+        if sc is None:
+            continue
+        for stage in getattr(sc.plan, "stages", ()):
+            for m in stage.members:
+                n = m.tc.name
+                if n not in names:
+                    names.append(n)
+    return names
 
 
 # ---------------------------------------------------------------------- #

@@ -224,6 +224,11 @@ class LiveHealth:
         self.counts = {"windows": 0, "firings": 0, "straggler": 0,
                        "degraded_link": 0, "stuck": 0}
         self.status = 0   # 0 healthy, 1 degraded, 2 stuck
+        # window-tick subscribers (ISSUE 17): each gets the per-window
+        # digest AFTER the detectors ran, outside the lock — the
+        # closed-loop controller rides this seam (append-only list;
+        # callers subscribe before or after start(), both safe)
+        self._subscribers: List[Callable[[Dict[str, Any]], None]] = []
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -237,6 +242,22 @@ class LiveHealth:
         bw = getattr(ce, "link_bw_mbps", None)
         if callable(bw):
             self.link_bw_fn = bw
+
+    def subscribe(self, fn: Callable[[Dict[str, Any]], None]) -> None:
+        """Register a per-window-tick subscriber.  ``fn`` receives the
+        window digest (see :meth:`tick`) after each fold, OUTSIDE the
+        monitor's lock and on the monitor thread — it may call back
+        into the transport or the monitor freely; exceptions are
+        swallowed (a sick subscriber must not kill the heartbeat)."""
+        self._subscribers.append(fn)
+
+    def annotate(self, name: str, args: Dict[str, Any]) -> None:
+        """Emit one instant annotation on the health stream (the same
+        lane the detector firings ride) — no-op without a profile
+        stream, so annotating is always safe to call."""
+        st = self.stream
+        if st is not None:
+            st.trace(name, args, phase="i")
 
     # -- span/flow feeds (any thread) ----------------------------------
     def note_compute(self, t0_ns: int, t1_ns: int) -> None:
@@ -465,20 +486,25 @@ class LiveHealth:
             cum = dict(self._closed_links)
             for link, ivs in self._links.items():
                 cum[link] = cum.get(link, 0.0) + _link_exposed(ivs, comp)
+            dg_links: Dict[str, Dict[str, Any]] = {}
             for link, total in cum.items():
                 delta = total - self._last_exposed.get(link, 0.0)
                 self._last_exposed[link] = total
                 if not link.endswith(f"->R{self.rank}"):
                     continue   # only inbound waits accuse a peer
                 base = self._exposed_base.setdefault(link, RollingStat())
+                z = base.z(delta) if base.n else 0.0
+                dg_links[link] = {"exposed_us": round(delta, 1),
+                                  "z": round(z, 2),
+                                  "warm": base.n >= warm}
                 if (base.n >= warm and delta > self.min_exposed_us
-                        and base.z(delta) > self.z_thresh):
+                        and z > self.z_thresh):
                     src = int(link.split("->")[0][1:])
                     fired.append(self._fire_locked(
                         "straggler", link=link, suspect=src,
                         value=round(delta, 1), window=win,
                         detail=f"window exposed-wait {delta:.0f}us, "
-                               f"z={base.z(delta):.1f} vs "
+                               f"z={z:.1f} vs "
                                f"baseline {base.mean:.0f}us"))
                 base.push(delta)
             # 1b) straggler (self): exec-busy collapse on THIS rank
@@ -497,9 +523,11 @@ class LiveHealth:
                            f"{pending} task(s) pending"))
             bb.push(busy)
             # 2) degraded link: window flow-lag regression vs own EWMA
+            dg_lag: Dict[str, float] = {}
             lag_win, self._lag_win = self._lag_win, {}
             for link, samples in lag_win.items():
                 mean = sum(samples) / len(samples)
+                dg_lag[link] = round(mean, 1)
                 base = self._lag_base.setdefault(link, RollingStat())
                 if (base.n >= warm and mean > self.min_lag_us
                         and base.mean > 0
@@ -553,6 +581,19 @@ class LiveHealth:
                 st.trace(f"health:{f['kind']}",
                          {k: v for k, v in f.items() if v is not None},
                          phase="i")
+        # window digest to subscribers, also outside the lock: the
+        # controller may turn knobs (transport calls, device attrs)
+        # from its callback without deadlock risk
+        if self._subscribers:
+            digest = {"window": win, "rank": self.rank,
+                      "pending": pending, "busy_us": round(busy, 1),
+                      "links": dg_links, "bw": dict(bw_now),
+                      "lag_us": dg_lag, "fired": fired}
+            for fn in list(self._subscribers):
+                try:
+                    fn(digest)
+                except Exception:   # noqa: BLE001 - keep the heartbeat
+                    pass
         return fired
 
     def _fire_locked(self, kind: str, link: Optional[str],

@@ -36,6 +36,8 @@ __all__ = ["CommObs", "DeviceObs", "OverlapTracker",
            "OBS_HEALTH_FIRINGS", "OBS_HEALTH_STRAGGLER",
            "OBS_HEALTH_DEGRADED", "OBS_HEALTH_STUCK",
            "OBS_HEALTH_WORST_LINK_US",
+           "TUNE_DECISIONS", "TUNE_REVERTS",
+           "TUNE_ACTIVE_CODEC_PREFIX", "TUNE_OBJECTIVE_US",
            "flow_event_id", "inbound_flow_ctx", "set_inbound_flow_ctx",
            "payload_nbytes"]
 
@@ -103,6 +105,17 @@ OBS_HEALTH_STRAGGLER = "PARSEC::OBS::HEALTH::STRAGGLER_FIRINGS"
 OBS_HEALTH_DEGRADED = "PARSEC::OBS::HEALTH::DEGRADED_LINK_FIRINGS"
 OBS_HEALTH_STUCK = "PARSEC::OBS::HEALTH::STUCK_FIRINGS"
 OBS_HEALTH_WORST_LINK_US = "PARSEC::OBS::HEALTH::WORST_LINK_EXPOSED_US"
+# closed-loop self-tuning (ISSUE 17, tune/controller.py, ``tune_auto``
+# knob): knob moves the controller committed, moves it rolled back on
+# objective regression, the codec-ladder rung actually active toward a
+# peer (PARSEC::TUNE::ACTIVE_CODEC::R<peer>, 0 lossless / 1 qbf16 /
+# 2 qint8), and the device us/task objective EWMA the pipeline
+# hill-climber steers by.  Registered ONLY under the knob — an unset
+# knob constructs no controller and adds no gauges.
+TUNE_DECISIONS = "PARSEC::TUNE::DECISIONS"
+TUNE_REVERTS = "PARSEC::TUNE::REVERTS"
+TUNE_ACTIVE_CODEC_PREFIX = "PARSEC::TUNE::ACTIVE_CODEC"
+TUNE_OBJECTIVE_US = "PARSEC::TUNE::OBJECTIVE_US"
 
 
 def flow_event_id(ctx: Tuple[int, ...]) -> int:

@@ -149,11 +149,75 @@ def _quant_codec_of(reduce_dtype: Optional[str]) -> Optional[str]:
     return wire.normalize_quant_codec(reduce_dtype or "")
 
 
+# -- jit-native quantize hop (ISSUE 17) ---------------------------------
+def qdq_jax(x: Any, codec: str) -> Any:
+    """Traceable quantize-dequantize: jnp/lax ops only, and BIT-FOR-BIT
+    the values :func:`wire.qdq_array` delivers (asserted by the parity
+    test) — same RNE bf16 arithmetic on the raw uint32 bits, same
+    blockwise absmax/127 f32 scales.  Usable inside a jit/shard_map
+    body, so the reduction-boundary quantize lowers into the compiled
+    collective instead of bouncing through host numpy."""
+    import jax.numpy as jnp
+    from jax import lax
+    from ..comm.wire import QUANT_BLOCK
+    if codec == "qbf16":
+        dt = jnp.asarray(x).dtype
+        u = lax.bitcast_convert_type(jnp.asarray(x, jnp.float32),
+                                     jnp.uint32)
+        # RNE: add 0x7FFF + the LSB of the kept half, then truncate —
+        # the exact _enc_bf16 arithmetic, uint32 wraparound included
+        q = ((u + jnp.uint32(0x7FFF)
+              + ((u >> jnp.uint32(16)) & jnp.uint32(1)))
+             >> jnp.uint32(16)).astype(jnp.uint16)
+        f32 = lax.bitcast_convert_type(
+            q.astype(jnp.uint32) << jnp.uint32(16), jnp.float32)
+        return f32.astype(dt)
+    if codec == "qint8":
+        xa = jnp.asarray(x)
+        n = xa.size
+        nblocks = max(1, (n + QUANT_BLOCK - 1) // QUANT_BLOCK)
+        xp = jnp.zeros(nblocks * QUANT_BLOCK, jnp.float32)
+        xp = xp.at[:n].set(jnp.ravel(jnp.asarray(xa, jnp.float32)))
+        xb = xp.reshape(nblocks, QUANT_BLOCK)
+        # the divisor hides behind an optimization barrier: XLA:CPU
+        # lowers division by a CONSTANT to reciprocal-multiply (1 ulp
+        # off IEEE), which would break bit parity with the numpy codec
+        # — an opaque runtime divisor keeps the correctly-rounded div
+        c127 = lax.optimization_barrier(jnp.float32(127.0))
+        scales = (jnp.abs(xb).max(axis=1) / c127).astype(jnp.float32)
+        inv = jnp.where(scales > 0, 1.0 / scales, 0.0).astype(jnp.float32)
+        q = jnp.clip(jnp.rint(xb * inv[:, None]),
+                     -127, 127).astype(jnp.int8)
+        deq = (q.astype(jnp.float32) * scales[:, None]).reshape(-1)[:n]
+        return deq.reshape(xa.shape).astype(xa.dtype)
+    raise ValueError(f"unknown quantized codec {codec!r}")
+
+
+_QDQ_JIT: Dict[str, Any] = {}
+
+
+def _qdq_native(arr: np.ndarray, codec: str) -> np.ndarray:
+    """Numpy-in/numpy-out wrapper over the jit-compiled ``qdq_jax``
+    (one compiled callable per codec, cached) — the drop-in boundary
+    hop for the collective helpers below."""
+    fn = _QDQ_JIT.get(codec)
+    if fn is None:
+        import jax
+        fn = jax.jit(lambda v, c=codec: qdq_jax(v, c))
+        _QDQ_JIT[codec] = fn
+    a = np.ascontiguousarray(arr)
+    # both codecs narrow through f32 before encoding (exactly what the
+    # wire does) — feed f32 so a disabled-x64 jax cannot silently
+    # truncate, and widen back to the caller's dtype on the way out
+    out = np.asarray(fn(a.astype(np.float32, copy=False)))
+    return out.astype(a.dtype, copy=False).reshape(a.shape)
+
+
 def reduced_precision_sum(contribs: Sequence[np.ndarray],
                           reduce_dtype: Optional[str] = None,
                           feedback: Optional[ErrorFeedback] = None,
-                          keys: Optional[Sequence[Any]] = None
-                          ) -> np.ndarray:
+                          keys: Optional[Sequence[Any]] = None,
+                          native: bool = False) -> np.ndarray:
     """Sum of per-participant contributions with quantize-at-the-
     boundary: each contribution is quantized (bf16 / int8 blockwise,
     exactly the wire codecs) before it enters the reduction —
@@ -161,7 +225,10 @@ def reduced_precision_sum(contribs: Sequence[np.ndarray],
     accumulation itself stays full precision. ``feedback``/``keys``
     enable per-contributor error feedback (``keys[i]`` names
     contributor i's logical buffer). ``reduce_dtype`` None/"" keeps the
-    exact full-precision sum (bit-for-bit the naive sum)."""
+    exact full-precision sum (bit-for-bit the naive sum).  ``native``
+    routes the boundary quantize through the jit-compiled
+    :func:`qdq_jax` hop instead of host numpy — bit-identical values
+    (the parity contract), XLA-lowered arithmetic."""
     from ..comm import wire
     codec = _quant_codec_of(reduce_dtype)
     if codec is None:
@@ -169,13 +236,14 @@ def reduced_precision_sum(contribs: Sequence[np.ndarray],
         for c in contribs:
             out = out + np.asarray(c)
         return out
+    qdq = _qdq_native if native else wire.qdq_array
     out = None
     for i, c in enumerate(contribs):
         c = np.asarray(c)
         if feedback is not None and keys is not None:
-            q = feedback.compensate(keys[i], c, codec, wire.qdq_array)
+            q = feedback.compensate(keys[i], c, codec, qdq)
         else:
-            q = wire.qdq_array(c, codec)
+            q = qdq(c, codec)
         out = q if out is None else out + q
     return out
 
@@ -184,7 +252,8 @@ def two_level_allreduce(shards: Sequence[np.ndarray],
                         group_size: int,
                         reduce_dtype: Optional[str] = None,
                         feedback: Optional[ErrorFeedback] = None,
-                        key: Any = None) -> np.ndarray:
+                        key: Any = None,
+                        native: bool = False) -> np.ndarray:
     """Hierarchical all-reduce: contributions reduce FULL-precision
     inside each ``group_size``-wide group (level 1 — the intra-mesh
     XLA psum over ICI, where bandwidth is plentiful), each group's
@@ -193,7 +262,9 @@ def two_level_allreduce(shards: Sequence[np.ndarray],
     partials sum to the replicated result. With ``feedback`` set, each
     group's boundary residual is carried into its next partial under
     ``(key, group index)`` — the EQuARX error-feedback recipe. With
-    ``reduce_dtype`` None/"" this is exactly the flat sum."""
+    ``reduce_dtype`` None/"" this is exactly the flat sum.  ``native``
+    lowers the boundary quantize through the jit-compiled
+    :func:`qdq_jax` hop (bit-identical values, XLA arithmetic)."""
     n = len(shards)
     groups = [list(range(g, min(g + group_size, n)))
               for g in range(0, n, group_size)]
@@ -206,7 +277,8 @@ def two_level_allreduce(shards: Sequence[np.ndarray],
     keys = [(key, gi) for gi in range(len(groups))] \
         if feedback is not None else None
     return reduced_precision_sum(partials, reduce_dtype,
-                                 feedback=feedback, keys=keys)
+                                 feedback=feedback, keys=keys,
+                                 native=native)
 
 
 def sync_axes(leaf_spec, mesh_axes: Sequence[str] = AXES) -> Tuple[str, ...]:
@@ -251,6 +323,15 @@ def match_vma(x, ref):
         return x
     want = tuple(sorted(want_src - cur))
     return _pcast_varying(x, want) if want else x
+
+
+def axis_size(axis_name):
+    """``lax.axis_size`` where it exists; pre-0.5 jax spells it as the
+    literal-psum idiom (still a trace-time constant)."""
+    from jax import lax
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
 
 
 def vary_on(x, axes, like=None):
@@ -311,8 +392,12 @@ def shard_map_compat(f, mesh, in_specs, out_specs):
     """
     import jax
     try:
-        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_vma=True)
+        sm = jax.shard_map
+    except AttributeError:  # pre-0.5 jax: not yet promoted out
+        from jax.experimental.shard_map import shard_map as sm
+    try:
+        return sm(f, mesh=mesh, in_specs=in_specs,
+                  out_specs=out_specs, check_vma=True)
     except TypeError:  # older jax spelling
-        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_rep=True)
+        return sm(f, mesh=mesh, in_specs=in_specs,
+                  out_specs=out_specs, check_rep=True)

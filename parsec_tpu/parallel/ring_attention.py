@@ -19,6 +19,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .mesh import axis_size
+
 
 def ring_attention(q: Any, k: Any, v: Any, axis_name: str = "sp",
                    causal: bool = True, scale: float | None = None,
@@ -46,7 +48,7 @@ def ring_attention(q: Any, k: Any, v: Any, axis_name: str = "sp",
 
 def _ring_jnp(q: Any, k: Any, v: Any, axis_name: str,
               causal: bool, scale: float | None) -> Any:
-    sp = lax.axis_size(axis_name)
+    sp = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     B, H, Tl, Dh = q.shape
     if scale is None:
@@ -97,7 +99,7 @@ def _ring_flash_fwd_impl(q, k, v, axis_name: str, causal: bool,
     from ..ops.pallas_kernels import _NEG_INF, flash_attention_stats
     from .mesh import match_vma
 
-    sp = lax.axis_size(axis_name)
+    sp = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     B, H, Tl, Dh = q.shape
     perm = [(i, (i + 1) % sp) for i in range(sp)]

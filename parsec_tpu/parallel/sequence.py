@@ -13,6 +13,8 @@ from typing import Any
 
 from jax import lax
 
+from .mesh import axis_size
+
 from .ring_attention import local_attention
 
 
@@ -34,7 +36,7 @@ def ulysses_attention(q: Any, k: Any, v: Any, axis_name: str = "sp",
 
     q/k/v: [B, H, T_local, Dh] (H divisible by the sp axis size).
     """
-    sp = lax.axis_size(axis_name)
+    sp = axis_size(axis_name)
     assert q.shape[1] % sp == 0, \
         f"ulysses needs heads ({q.shape[1]}) divisible by sp ({sp})"
     qg = heads_to_sequence(q, axis_name)

@@ -1,0 +1,29 @@
+"""tune — closed-loop self-tuning (ISSUE 17).
+
+The live health monitor (obs/live.py, ISSUE 16) *watches* the knobs
+this package *turns*: a per-rank :class:`Controller` subscribes to the
+monitor's window ticks and adapts three knob families at runtime —
+
+- per-link quantized wire codec (lossless -> qbf16 -> qint8) within the
+  ``tune_residual_budget``, escalating on bandwidth-bound links and
+  de-escalating when compression shows no win, renegotiated live over
+  the K_TUNE control frame toward "tn"-capable peers;
+- device pipeline shape (``batch_max`` / ``prefetch_depth`` /
+  ``flush_segments``), hill-climbed per device from batch occupancy,
+  prefetch hit rate and the overlap fraction, with hysteresis and
+  revert-on-regress against a us/task dispatch objective;
+- stage-compile exclusion: a class whose compiled stage keeps firing
+  the straggler detector is fed to ``stage_compile_exclude`` so the
+  next taskpool over the same spec replans around it.
+
+Everything lives behind the ``tune_auto`` MCA param: unset constructs
+no controller, starts no subscription, and is bit-for-bit inert on the
+wire (proven by the frame-capture identity differential in bench.py).
+Every adaptation emits a ``tune:*`` instant annotation on the health
+trace stream plus the ``PARSEC::TUNE::*`` gauges.
+"""
+from .controller import (CODEC_COST, CODEC_LADDER, Controller,
+                         register_tune_gauges)
+
+__all__ = ["Controller", "CODEC_LADDER", "CODEC_COST",
+           "register_tune_gauges"]

@@ -379,6 +379,32 @@ def register_core_params() -> None:
                    "rolling-window tick of the obs_live monitor: "
                    "detector baselines fold one sample per window "
                    "(smaller = faster detection, noisier baselines)")
+    params.reg_bool("tune_auto", False,
+                    "closed-loop self-tuning (ISSUE 17): a controller "
+                    "rides the obs_live window tick and adapts per-link "
+                    "quantized codec choice (runtime K_TUNE "
+                    "renegotiation toward peers that advertised the "
+                    "HELLO \"tn\" capability), the device pipeline "
+                    "shape (device_batch_max / device_prefetch_depth / "
+                    "device_flush_segments, hill-climbed with "
+                    "revert-on-regress), and stagec exclude decisions "
+                    "(stage_compile_exclude fed from repeat straggler "
+                    "firings). Every move emits a tune:* annotation on "
+                    "the health stream plus PARSEC::TUNE::* gauges. "
+                    "Implies obs_live; off (default) constructs "
+                    "nothing and is bit-for-bit inert on the wire")
+    params.reg_string("tune_residual_budget", "1e-2",
+                      "max relative residual the codec ladder may "
+                      "spend: qbf16 (~1e-2) needs budget >= 1e-2, "
+                      "qint8 (~1e-1) needs budget >= 1e-1; 0 pins "
+                      "every link lossless (the controller still "
+                      "tunes the device pipeline)")
+    params.reg_int("tune_hysteresis_windows", 2,
+                   "consecutive agreeing health windows required "
+                   "before the controller moves a knob (and the "
+                   "cool-down after any move/revert) — larger = "
+                   "steadier under oscillating signal, slower to "
+                   "react")
     params.reg_string("profiling_dot", "",
                       "capture the executed DAG; path prefix for DOT files "
                       "(ref: --parsec_dot)")

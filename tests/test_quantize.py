@@ -265,3 +265,53 @@ def test_qdq_matches_wire_delivery_layout():
             via_wire = np.frombuffer(raw, dtype=dt)
             np.testing.assert_array_equal(
                 via_wire, wire.qdq_array(arr, codec))
+
+
+def test_native_qdq_bit_parity_with_numpy():
+    """The jit-native quantize hop (ISSUE 17): ``qdq_jax`` lowered
+    through XLA must deliver BIT-FOR-BIT the values the numpy wire
+    codec delivers — every dtype, every shape class (block multiples,
+    remainders, multi-dim), every magnitude, all-zero blocks included
+    (the 1/scale guard).  Without this, a ``native=True`` reduction
+    would round differently from the wire and the lane/wire identity
+    contract of ISSUE 14 would silently break."""
+    pytest.importorskip("jax")
+    from parsec_tpu.parallel.mesh import _qdq_native
+    rng = np.random.RandomState(7)
+    for codec in wire.available_quant_codecs():
+        for dt in (np.float32, np.float64):
+            for shape in ((7,), (512,), (513,), (64, 33), (3, 5, 7)):
+                for scale in (1e-6, 1.0, 1e4):
+                    x = (rng.randn(*shape) * scale).astype(dt)
+                    a = wire.qdq_array(x, codec)
+                    b = _qdq_native(x, codec)
+                    assert a.dtype == b.dtype and a.shape == b.shape
+                    np.testing.assert_array_equal(a, b)
+        z = np.zeros(600, np.float32)   # zero-scale blocks
+        np.testing.assert_array_equal(wire.qdq_array(z, codec),
+                                      _qdq_native(z, codec))
+
+
+def test_native_two_level_allreduce_bit_parity():
+    """two_level_allreduce(native=True) — the XLA-lowered boundary
+    quantize — is bit-identical to the numpy path, with and without
+    error feedback across iterations (the residual carry must see the
+    exact same quantized values, or feedback states diverge)."""
+    pytest.importorskip("jax")
+    rng = np.random.RandomState(8)
+    shards = [rng.randn(300).astype(np.float32) for _ in range(8)]
+    for rd in wire.available_quant_codecs():
+        np.testing.assert_array_equal(
+            two_level_allreduce(shards, 4, rd),
+            two_level_allreduce(shards, 4, rd, native=True))
+        fb_np, fb_jx = ErrorFeedback(), ErrorFeedback()
+        for _ in range(3):
+            r_np = two_level_allreduce(shards, 4, rd,
+                                       feedback=fb_np, key="k")
+            r_jx = two_level_allreduce(shards, 4, rd, feedback=fb_jx,
+                                       key="k", native=True)
+            np.testing.assert_array_equal(r_np, r_jx)
+    # unset knob: native flag must not disturb the exact sum
+    np.testing.assert_array_equal(
+        two_level_allreduce(shards, 4, None),
+        two_level_allreduce(shards, 4, None, native=True))
