@@ -70,6 +70,14 @@ Execution modes (BENCH_MODE):
   swapped mid-run to a 4x send delay and the time until rank 0's
   straggler/degraded-link detector fires on the inbound link is
   reported (kind, link, suspect ride along).
+- ``serve``: multi-tenant serving (ISSUE 18) — a weight-8 latency
+  tenant probing one persistent context a weight-1 bulk tenant
+  saturates, weighted-fair deficit boosts ON vs pure FIFO (scrubbed
+  CPU subprocess); reports per-tenant p50/p99 pool latency for both
+  legs, the weighted/FIFO p99 ratio, and the tenants' completed-pool
+  share.  The serve-knob wire differential (a ``serve``-on rank's data
+  frames toward a knob-unset peer must be bit-identical to the unset
+  legs) rides the ``trace`` capture-identity differential.
 
 Every record carries ``schema_version`` + stable ``metric_id``/``mode``
 /``n``/``nb``/``dtype`` fields (schema 2): r01-r05 changed metric
@@ -772,6 +780,13 @@ def bench_all(n, nb, reps, cores, dtype):
         hl = _try("health", lambda: bench_health())
         if hl is not None:
             extras.update(hl)
+    # multi-tenant serving (ISSUE 18): weighted-fair latency tenant vs
+    # a bulk saturator on one persistent context — scrubbed CPU
+    # subprocess, link-independent
+    if os.environ.get("BENCH_SERVE", "1") != "0":
+        sv = _try("serve", lambda: bench_serve())
+        if sv is not None:
+            extras.update(sv)
     # closed-loop self-tuning (ISSUE 17): throttled asymmetric-link
     # dpotrf, tuned vs each static setting the controller chose
     # between — scrubbed CPU subprocess, link-independent
@@ -1924,6 +1939,11 @@ def bench_trace_capture_identity() -> dict:
       K_TUNE renegotiation may ever travel and rank 0's data frames
       stay byte-identical to the unset legs (the tune-on leg proves
       the UNSET legs carry no tuning bytes either way).
+    - F (ISSUE 18): ``serve`` SET on rank 0 only, with a session
+      server's tenant map armed on the flow allocator — rank 1 never
+      advertises ``"sv"`` (nor ``"lv"``), so neither tenant-extended
+      trace contexts nor serve control frames may travel and rank 0's
+      data frames stay byte-identical to the unset legs.
     """
     import threading as _threading
     from contextlib import ExitStack
@@ -1936,7 +1956,7 @@ def bench_trace_capture_identity() -> dict:
 
     chunk = 4096
 
-    def leg(flow_r0, live_r0=False, tune_r0=False):
+    def leg(flow_r0, live_r0=False, tune_r0=False, serve_r0=False):
         captured = {}
         orig = tcpmod._sendall_vec
 
@@ -1961,7 +1981,8 @@ def bench_trace_capture_identity() -> dict:
                     engines[r] = TCPCommEngine(
                         r, eps, obs_flow=(flow_r0 and r == 0),
                         obs_live=(live_r0 and r == 0),
-                        tune_auto=(tune_r0 and r == 0))
+                        tune_auto=(tune_r0 and r == 0),
+                        serve=(serve_r0 and r == 0))
                 ts = [_threading.Thread(target=boot, args=(r,))
                       for r in (0, 1)]
                 for t in ts:
@@ -1971,10 +1992,15 @@ def bench_trace_capture_identity() -> dict:
                 e0, e1 = engines
                 # the flow allocator would be armed by the obs wiring;
                 # arm it directly here (no Context in this scripted leg)
-                if flow_r0 or live_r0:
+                if flow_r0 or live_r0 or serve_r0:
                     from parsec_tpu.comm.engine import FlowIds
                     e0._flow = FlowIds(0)
-                    e0._flow.live = live_r0
+                    e0._flow.live = live_r0 or serve_r0
+                    if serve_r0:
+                        # what SessionServer installs: a pool the
+                        # server owns — the stamp may only travel on
+                        # a mutually-negotiated "sv" link
+                        e0._flow.tenants = {0: "acme"}
 
                     class _NullObs:
                         def am_sent(self, *a):
@@ -2029,12 +2055,14 @@ def bench_trace_capture_identity() -> dict:
     c = leg(True)
     d = leg(False, live_r0=True)
     e = leg(False, tune_r0=True)
+    f = leg(False, serve_r0=True)
     return {
         "trace_frames_captured": len(a),
         "trace_unset_bit_identical": bool(a and a == b),
         "trace_mixed_version_bit_identical": bool(a and a == c),
         "live_mixed_version_bit_identical": bool(a and a == d),
         "tune_mixed_version_bit_identical": bool(a and a == e),
+        "serve_mixed_version_bit_identical": bool(a and a == f),
     }
 
 
@@ -2186,6 +2214,131 @@ def bench_trace(n=256, nb=64, delay_ms=3) -> dict:
         return json.loads(p.stdout.strip().splitlines()[-1])
     except Exception as exc:  # noqa: BLE001
         return {"trace_error": repr(exc)[:200]}
+
+
+# ---------------------------------------------------------------------- #
+# multi-tenant serving benchmark (ISSUE 18): weighted-fair latency      #
+# tenant vs a bulk saturator on ONE persistent context                  #
+# ---------------------------------------------------------------------- #
+_SERVE_DRIVER = r"""
+import json, os, sys, threading, time
+sys.path.insert(0, os.environ["BENCH_REPO"])
+import parsec_tpu
+from parsec_tpu import dtd
+from parsec_tpu.dsl.dtd import VALUE
+from parsec_tpu.serve import SessionServer
+from parsec_tpu.utils.params import params
+
+POOLS = int(os.environ.get("BENCH_SERVE_POOLS", "32"))
+BULK_TASKS = int(os.environ.get("BENCH_SERVE_BULK_TASKS", "24"))
+LAT_TASKS = int(os.environ.get("BENCH_SERVE_LAT_TASKS", "4"))
+SPIN_S = float(os.environ.get("BENCH_SERVE_SPIN_MS", "1.0")) / 1e3
+
+
+def mk_build(n_tasks):
+    def build():
+        tp = dtd.taskpool_new()
+
+        def body(es, task):
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < SPIN_S:
+                pass
+
+        for k in range(n_tasks):
+            tp.insert_task(body, (k, VALUE))
+        return tp
+    return build
+
+
+def leg(fair):
+    # one persistent context, a weight-1 bulk tenant saturating it, a
+    # weight-8 latency tenant probing it; fair=False disables the
+    # deficit fold (ctx.serve_fairness = None): pure arrival-order
+    # FIFO, the baseline the weighted leg is judged against
+    with params.cmdline_override("serve", "1"):
+        ctx = parsec_tpu.init(nb_cores=2, scheduler="spq",
+                              enable_tpu=False)
+        srv = SessionServer(ctx)
+        if not fair:
+            ctx.serve_fairness = None
+        srv.open_tenant("bulk", weight=1)
+        srv.open_tenant("latency", weight=8)
+        stop = threading.Event()
+        fail = []
+
+        def bulk_pump():
+            try:
+                while not stop.is_set():
+                    subs = [srv.submit("bulk", mk_build(BULK_TASKS),
+                                       ntasks=BULK_TASKS)
+                            for _ in range(4)]
+                    for s in subs:
+                        s.wait(120)
+            except Exception as exc:
+                fail.append(repr(exc))
+
+        th = threading.Thread(target=bulk_pump, daemon=True)
+        th.start()
+        time.sleep(0.3)            # let the backlog build
+        lats = []
+        for _ in range(POOLS):
+            sub = srv.submit("latency", mk_build(LAT_TASKS),
+                             ntasks=LAT_TASKS)
+            if not sub.wait(120):
+                fail.append("latency pool timed out")
+                break
+            lats.append(sub.lat_us)
+        stop.set()
+        th.join(120)
+        st = srv.stats()["tenants"]
+        done = {t: c["pools_done"] for t, c in st.items()}
+        srv.close()
+        ctx.fini()
+        if fail or not lats:
+            raise RuntimeError(f"serve leg failed: {fail[:3]}")
+        lats.sort()
+        p50 = lats[len(lats) // 2]
+        p99 = lats[min(len(lats) - 1, int(round(0.99 * len(lats))))]
+        return p50, p99, done
+
+
+fifo_p50, fifo_p99, fifo_done = leg(fair=False)
+w_p50, w_p99, w_done = leg(fair=True)
+total = max(1, sum(w_done.values()))
+print(json.dumps({
+    "serve_latency_p50_us_fifo": round(fifo_p50, 1),
+    "serve_latency_p99_us_fifo": round(fifo_p99, 1),
+    "serve_latency_p50_us_weighted": round(w_p50, 1),
+    "serve_latency_p99_us_weighted": round(w_p99, 1),
+    "serve_weighted_p99_vs_fifo": round(w_p99 / max(fifo_p99, 1e-9), 3),
+    "serve_bulk_pools_done": w_done.get("bulk", 0),
+    "serve_latency_pools_done": w_done.get("latency", 0),
+    "serve_latency_pool_share": round(
+        w_done.get("latency", 0) / total, 3),
+}))
+"""
+
+
+def bench_serve() -> dict:
+    """BENCH_MODE=serve (ISSUE 18): a weight-8 latency tenant probing
+    one persistent context that a weight-1 bulk tenant saturates, in a
+    scrubbed CPU subprocess.  The FIFO leg (deficit fold disabled) is
+    the baseline; the weighted leg's per-tenant p50/p99 and pool share
+    show what the fairness boost buys the SLO tenant.  Link
+    independent — rides every bench_all record."""
+    import subprocess
+    import sys as _sys
+
+    env = _scrubbed_bench_env(n_devices=2)
+    try:
+        p = subprocess.run([_sys.executable, "-c", _SERVE_DRIVER],
+                           env=env, capture_output=True, text=True,
+                           timeout=1200)
+        if p.returncode != 0:
+            return {"serve_error": p.stdout[-200:] + p.stderr[-200:]}
+        return json.loads(p.stdout.strip().splitlines()[-1])
+    except Exception as exc:  # noqa: BLE001
+        return {"serve_error": repr(exc)[:200]}
 
 
 def bench_health_inner(n=256, nb=64, delay_ms=3, chunk_bytes=8192) -> dict:
@@ -3058,6 +3211,15 @@ def main() -> None:
             "metric_id": "trace_us_per_task_delta", "mode": mode,
             "value": extras.get("trace_us_per_task_delta", -1.0),
             "unit": "us/task", "extras": extras})
+        return
+    if mode == "serve":
+        extras = bench_serve()
+        emit_json({
+            "metric": "serve_weighted_p99_vs_fifo(2-tenant,"
+                      "persistent_ctx)",
+            "metric_id": "serve_weighted_p99_vs_fifo", "mode": mode,
+            "value": extras.get("serve_weighted_p99_vs_fifo", -1.0),
+            "unit": "x", "extras": extras})
         return
     if mode == "health":
         extras = bench_health(

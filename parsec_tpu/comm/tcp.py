@@ -233,7 +233,8 @@ class _Peer:
                  "rs_rx_unacked_frames", "rs_rx_unacked_bytes",
                  "rs_rx_partial", "rx_xfers", "recv_thread", "rs_dup_next",
                  "rs_resuming", "qz_codec", "q_pre", "q_post",
-                 "comp_pre", "comp_post", "tn_ok", "qrx_pre", "qrx_post")
+                 "comp_pre", "comp_post", "tn_ok", "qrx_pre", "qrx_post",
+                 "sv_ok")
 
     def __init__(self, rank: int, sock: socket.socket) -> None:
         self.rank = rank
@@ -261,6 +262,7 @@ class _Peer:
         self.tr_ok = False         # HELLO advertised flow tracing ("tr")
         self.lv_ok = False         # HELLO advertised obs_live ("lv")
         self.tn_ok = False         # HELLO advertised runtime tuning ("tn")
+        self.sv_ok = False         # HELLO advertised serving ("sv")
         # -- closed-loop tuning (ISSUE 17) ------------------------------
         self.qrx_pre = 0           # raw bytes of RECEIVED quantized bufs
         self.qrx_post = 0          # encoded bytes that landed for them
@@ -314,7 +316,8 @@ class TCPCommEngine(LocalCommEngine):
                  quantize_threshold_mbps: Optional[float] = None,
                  obs_flow: Optional[bool] = None,
                  obs_live: Optional[bool] = None,
-                 tune_auto: Optional[bool] = None) -> None:
+                 tune_auto: Optional[bool] = None,
+                 serve: Optional[bool] = None) -> None:
         from ..utils.params import params
         self._inbox: Fifo = Fifo()
         # GET tokens whose reply has ARRIVED (pushed to the inbox by a
@@ -413,8 +416,19 @@ class TCPCommEngine(LocalCommEngine):
         # live monitor's window tick.
         if tune_auto is None:
             tune_auto = bool(params.get_or("tune_auto", "bool", False))
+        # multi-tenant serving (ISSUE 18): SessionServer endpoints ride
+        # a symmetric "sv" capability — toward sv-peers the live flow
+        # context widens once more with the owning tenant's name, and
+        # serve control AMs (TAG_SERVE/_REPLY) are accepted.  The knob
+        # implies the obs_live wire behavior (tenant attribution rides
+        # the extended contexts); unset on EITHER end keeps that end's
+        # wire bytes exactly what the unset build would produce.
+        if serve is None:
+            serve = bool(params.get_or("serve", "bool", False))
+        self._serve_enabled = bool(serve)
         self._tune_enabled = bool(tune_auto)
-        self._live_enabled = bool(obs_live) or self._tune_enabled
+        self._live_enabled = (bool(obs_live) or self._tune_enabled
+                              or self._serve_enabled)
         self._flow_enabled = bool(obs_flow) or self._live_enabled
         self._clock: Dict[int, float] = {}      # peer -> offset EWMA us
         self._clock_n: Dict[int, int] = {}      # peer -> sample count
@@ -562,6 +576,13 @@ class TCPCommEngine(LocalCommEngine):
             # knob's HELLO stays bit-identical and a mixed-version peer
             # is never renegotiated
             info["tn"] = True
+        if self._serve_enabled:
+            # multi-tenant serving (ISSUE 18): this end hosts/uses
+            # SessionServer endpoints and accepts tenant-extended flow
+            # contexts — gated like "tr"/"lv"/"tn" so an unset knob's
+            # HELLO stays bit-identical and a mixed-version peer never
+            # sees a 5-tuple or a serve control frame
+            info["sv"] = True
         if self._quantize is not None:
             # quantized codecs are advertised ONLY when the local knob
             # is set — symmetric like "rs", so a knob-unset build keeps
@@ -746,6 +767,15 @@ class TCPCommEngine(LocalCommEngine):
         with self._conn_cond:
             p = self._peers.get(dst)
         return p is not None and p.lv_ok
+
+    def serve_to(self, dst: int) -> bool:
+        """Tenant-extended serve contexts (and serve control AMs,
+        ISSUE 18) travel only toward peers whose HELLO advertised
+        ``"sv"`` — a live-only (or older) peer keeps receiving the
+        4-tuple its unpacking expects."""
+        with self._conn_cond:
+            p = self._peers.get(dst)
+        return p is not None and p.sv_ok
 
     # -- reliable sessions (ISSUE 10) -----------------------------------
     def peer_suspect(self, peer: int) -> bool:
@@ -1970,6 +2000,10 @@ class TCPCommEngine(LocalCommEngine):
             # ends run with tune_auto ever renegotiates its codec —
             # a mixed-version peer stays on its HELLO negotiation
             p.tn_ok = bool(info.get("tn")) and self._tune_enabled
+            # serving is symmetric the same way: tenant-extended flow
+            # contexts (and serve control AMs) travel only on links
+            # whose BOTH ends run with the serve knob set
+            p.sv_ok = bool(info.get("sv")) and self._serve_enabled
             with p.cond:
                 # quantize capability is symmetric like "rs": only a
                 # peer that advertised the requested codec under "qz"

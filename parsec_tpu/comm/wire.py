@@ -489,6 +489,49 @@ def parse_tune(body: memoryview) -> Dict[str, Any]:
     return pickle.loads(body[1:])
 
 
+# -- multi-tenant serving (serve/; the "sv" HELLO capability) -----------
+#: serve control protocol version — bumped when the envelope grows
+#: fields an old server cannot ignore
+SERVE_PROTO_VERSION = 1
+
+
+def serve_request(op: str, req: int, tenant: Optional[str] = None,
+                  **kw: Any) -> Dict[str, Any]:
+    """Envelope of one serve control request (open/submit/wait/stats).
+    Serve control rides TAG_SERVE active messages — the AM layer
+    already frames and pickles dict payloads, so no new frame kind is
+    needed; the envelope just pins the field names and a version so
+    ServeClient and SessionServer agree across builds."""
+    msg: Dict[str, Any] = {"sv": SERVE_PROTO_VERSION, "op": str(op),
+                           "req": int(req)}
+    if tenant is not None:
+        msg["tenant"] = str(tenant)
+    msg.update(kw)
+    return msg
+
+
+def serve_reply(req: int, ok: bool, **kw: Any) -> Dict[str, Any]:
+    """Envelope of one serve control reply, correlated by ``req``."""
+    msg: Dict[str, Any] = {"sv": SERVE_PROTO_VERSION, "req": int(req),
+                           "ok": bool(ok)}
+    msg.update(kw)
+    return msg
+
+
+def parse_serve(payload: Any) -> Dict[str, Any]:
+    """Validate one serve envelope (either direction); raises
+    ValueError on a malformed dict or an unsupported version so the
+    endpoint can reply with a loud error instead of misbehaving."""
+    if not isinstance(payload, dict) or "sv" not in payload:
+        raise ValueError("not a serve envelope")
+    v = int(payload.get("sv") or 0)
+    if v < 1 or v > SERVE_PROTO_VERSION:
+        raise ValueError(f"unsupported serve protocol version {v}")
+    if "req" not in payload:
+        raise ValueError("serve envelope missing req id")
+    return payload
+
+
 # -- hello / compression ------------------------------------------------
 def pack_hello(info: Dict[str, Any]) -> bytes:
     return bytes([K_HELLO]) + pickle.dumps(info, protocol=4)

@@ -50,7 +50,10 @@ from .spans import (COMM_ACTIVE_TRANSFERS, COMM_BYTES_RECEIVED,
                     OBS_HEALTH_STATUS, OBS_HEALTH_STRAGGLER,
                     OBS_HEALTH_STUCK, OBS_HEALTH_WINDOWS,
                     OBS_HEALTH_WORST_LINK_US, OBS_OVERLAP_FRACTION,
-                    OverlapTracker, TUNE_ACTIVE_CODEC_PREFIX,
+                    OverlapTracker, SERVE_ADMITTED, SERVE_INFLIGHT_PREFIX,
+                    SERVE_P99_LATENCY_PREFIX, SERVE_QUEUED,
+                    SERVE_QUOTA_BYTES_PREFIX, SERVE_REJECTED, SERVE_TENANTS,
+                    TUNE_ACTIVE_CODEC_PREFIX,
                     TUNE_DECISIONS, TUNE_OBJECTIVE_US, TUNE_REVERTS,
                     flow_event_id, inbound_flow_ctx,
                     payload_nbytes, register_device_gauges)
@@ -73,6 +76,9 @@ __all__ = [
     "OBS_HEALTH_WORST_LINK_US",
     "TUNE_DECISIONS", "TUNE_REVERTS", "TUNE_ACTIVE_CODEC_PREFIX",
     "TUNE_OBJECTIVE_US",
+    "SERVE_TENANTS", "SERVE_ADMITTED", "SERVE_REJECTED", "SERVE_QUEUED",
+    "SERVE_INFLIGHT_PREFIX", "SERVE_QUOTA_BYTES_PREFIX",
+    "SERVE_P99_LATENCY_PREFIX",
     "LiveHealth", "RollingStat", "fleet_health", "format_health",
     "register_health_gauges",
     "flow_event_id", "inbound_flow_ctx",
@@ -103,7 +109,10 @@ class ContextObs:
         # input is the monitor's window digest, so the knob pulls the
         # whole monitor up with it (mirroring obs_live implying the
         # span sinks below)
-        live_on = _live_param() or tune_on
+        # serve (ISSUE 18) implies obs_live the same way: per-tenant
+        # SLO attribution lives in the monitor's window digests, so a
+        # serving context always carries the monitor
+        live_on = _live_param() or tune_on or _serve_param()
         # obs_live (ISSUE 16) implies the span sinks: the streaming
         # monitor's feeds ARE the comm/device/exec hooks, so the knob
         # alone turns telemetry on even without profile= or metrics
@@ -309,6 +318,11 @@ def _live_param() -> bool:
 def _tune_param() -> bool:
     from ..utils.params import params
     return bool(params.get_or("tune_auto", "bool", False))
+
+
+def _serve_param() -> bool:
+    from ..utils.params import params
+    return bool(params.get_or("serve", "bool", False))
 
 
 def _compiled_stage_classes(ctx: Any) -> List[str]:

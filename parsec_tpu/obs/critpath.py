@@ -222,6 +222,15 @@ def merge_trace_docs(docs: List[Dict[str, Any]]) -> Dict[str, Any]:
 # ---------------------------------------------------------------------- #
 # cross-rank edge stitching + distributed critical path (ISSUE 15)       #
 # ---------------------------------------------------------------------- #
+def _ev_tenant(ev: Dict[str, Any]) -> Optional[str]:
+    """The tenant a flow half / interval was attributed to, or None."""
+    args = ev.get("args") if isinstance(ev, dict) else None
+    if isinstance(args, dict):
+        t = args.get("tenant")
+        return t if isinstance(t, str) else None
+    return None
+
+
 def stitch_flows(flow_events: List[Dict[str, Any]]
                  ) -> Tuple[List[Dict[str, Any]], int]:
     """Pair "s"/"f" halves by flow id into send→recv edges:
@@ -244,10 +253,17 @@ def stitch_flows(flow_events: List[Dict[str, Any]]
         if f is None:
             unmatched += 1
             continue
-        edges.append({"id": fid, "name": s["name"],
-                      "src": s["pid"], "dst": f["pid"],
-                      "send_ts": s["ts"], "recv_ts": f["ts"],
-                      "lag_us": f["ts"] - s["ts"]})
+        edge = {"id": fid, "name": s["name"],
+                "src": s["pid"], "dst": f["pid"],
+                "send_ts": s["ts"], "recv_ts": f["ts"],
+                "lag_us": f["ts"] - s["ts"]}
+        # serve attribution (ISSUE 18): either half may carry the
+        # submitting tenant in its args — pre-serve traces have
+        # neither, and the key is then simply absent
+        tenant = _ev_tenant(s) or _ev_tenant(f)
+        if tenant is not None:
+            edge["tenant"] = tenant
+        edges.append(edge)
     unmatched += len(recvs)
     edges.sort(key=lambda e: e["send_ts"])
     return edges, unmatched
@@ -533,18 +549,26 @@ def _is_comm(iv: Interval) -> bool:
 
 
 def analyze(trace_docs: List[Dict[str, Any]],
-            dot_text: Optional[str] = None) -> Dict[str, Any]:
+            dot_text: Optional[str] = None,
+            tenant: Optional[str] = None) -> Dict[str, Any]:
     """Build the full report from one or more rank trace documents
     (already-parsed Chrome JSON) and an optional grapher DOT. Multiple
     per-rank documents are clock-aligned first (``trace_t0_ns`` +
     ``clock_offsets_us`` metadata, 0-shift when absent) so cross-rank
-    flow edges stitch on one timeline."""
+    flow edges stitch on one timeline.
+
+    ``tenant`` (ISSUE 18) narrows the cross-rank section to the flow
+    halves a SessionServer attributed to that tenant — the SLO view of
+    one customer's traffic through a shared fleet."""
     shifts = rank_clock_shifts(trace_docs)
     intervals: List[Interval] = []
     flow_events: List[Dict[str, Any]] = []
     for i, doc in enumerate(trace_docs):
         intervals.extend(load_trace_intervals(doc, shifts[i]))
         flow_events.extend(load_flow_events(doc, shifts[i]))
+    if tenant is not None:
+        flow_events = [ev for ev in flow_events
+                       if _ev_tenant(ev) == tenant]
 
     # per-task-class breakdown per rank
     by_class: Dict[int, Dict[str, Dict[str, float]]] = {}
@@ -631,6 +655,12 @@ def analyze(trace_docs: List[Dict[str, Any]],
             "critical_path": distributed_critical_path(intervals, cross),
             "per_link_exposed_us": per_link_exposed_wait(intervals),
         }
+        # per-tenant rollups (ISSUE 18): only when some edge carries an
+        # attribution — pre-serve traces keep the pre-serve report shape
+        tenants = sorted({e["tenant"] for e in edges if "tenant" in e})
+        if tenants:
+            report["cross_rank"]["per_tenant"] = {
+                t: _tenant_rollup(t, intervals, edges) for t in tenants}
 
     if dot_text:
         _labels, edges = parse_dot(dot_text)
@@ -645,6 +675,34 @@ def analyze(trace_docs: List[Dict[str, Any]],
             "parallelism": total_exec / length if length > 0 else 0.0,
         }
     return report
+
+
+def _tenant_rollup(tenant: str, intervals: List[Interval],
+                   edges: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """One tenant's slice of the cross-rank view: its wire edges, the
+    distributed critical path constrained to THOSE edges, and the
+    exposed wait of its attributed comm spans."""
+    own = [e for e in edges if e.get("tenant") == tenant]
+    cross = [e for e in own if e["src"] != e["dst"]]
+    lags = sorted(e["lag_us"] for e in cross)
+    dcp = distributed_critical_path(intervals, cross) if cross else None
+    # exposed wait of this tenant's attributed comm spans only
+    own_comm = [iv for iv in intervals
+                if _ev_tenant({"args": iv.args}) == tenant]
+    exposed = per_link_exposed_wait(
+        own_comm + [iv for iv in intervals if _is_compute(iv)])
+    exposed_us = round(sum(us for links in exposed.values()
+                           for us in links.values()), 1)
+    out: Dict[str, Any] = {
+        "flow_edges": len(cross),
+        "lag_us_mean": round(sum(lags) / len(lags), 1) if lags else 0.0,
+        "lag_us_max": round(lags[-1], 1) if lags else 0.0,
+        "exposed_wait_us": exposed_us,
+    }
+    if dcp is not None:
+        out["critical_path_us"] = round(dcp["length_us"], 1)
+        out["critical_path_cross_edges"] = dcp["cross_edges"]
+    return out
 
 
 def format_report(report: Dict[str, Any]) -> str:
@@ -710,4 +768,19 @@ def format_report(report: Dict[str, Any]) -> str:
                 f"{lk}={us:.0f} ({us / total:.0%})"
                 for lk, us in links.items())
             out.append(f"  rank {rank}: {parts}")
+        tenants = cr.get("per_tenant") or {}
+        if tenants:
+            out.append("per-tenant cross-rank rollup:")
+            for t in sorted(tenants):
+                cell = tenants[t]
+                line = (f"  tenant {t:<10} edges={cell['flow_edges']} "
+                        f"lag mean/max={cell['lag_us_mean']:.0f}/"
+                        f"{cell['lag_us_max']:.0f} us "
+                        f"exposed={cell['exposed_wait_us']:.0f} us")
+                if "critical_path_us" in cell:
+                    cp_ms = cell["critical_path_us"] / 1e3
+                    line += (f" critpath={cp_ms:.3f} ms"
+                             f" ({cell['critical_path_cross_edges']} wire"
+                             f" edge(s))")
+                out.append(line)
     return "\n".join(out)

@@ -72,6 +72,7 @@ _GUARDED_BY = {
     "LiveHealth._last_exposed": "_lock",
     "LiveHealth._last_compute_us": "_lock",
     "LiveHealth._pools": "_lock",
+    "LiveHealth._tenants": "_lock",
     "LiveHealth._activity": "_lock",
     "LiveHealth._last_activity": "_lock",
     "LiveHealth._idle_windows": "_lock",
@@ -140,6 +141,16 @@ class RollingStat:
         xs = sorted(self._ring)
         k = min(len(xs) - 1, max(0, int(round(q * (len(xs) - 1)))))
         return xs[k]
+
+
+def _pct(xs: List[float], q: float) -> float:
+    """Nearest-rank percentile of a small sample list (0 when empty) —
+    the per-tenant latency rollup's one shared helper."""
+    if not xs:
+        return 0.0
+    s = sorted(xs)
+    k = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+    return s[k]
 
 
 def _link_exposed(ivs: List[Tuple[float, float]],
@@ -217,6 +228,12 @@ class LiveHealth:
         # per-taskpool attribution (pool = taskpool wire id, or None
         # for data-plane tags that carry no tp_id)
         self._pools: Dict[Any, Dict[str, float]] = {}
+        # per-tenant attribution (serve/, ISSUE 18): flow traffic of
+        # served pools (tenant rides the 5-tuple context) plus the
+        # taskpool latency samples the SessionServer pushes at pool
+        # completion; empty — and absent from snapshots — without a
+        # server, so pre-serve consumers see the exact old document
+        self._tenants: Dict[str, Dict[str, Any]] = {}
         self._activity = 0
         self._last_activity = 0
         self._idle_windows = 0
@@ -293,14 +310,39 @@ class LiveHealth:
             if len(self._comm) > self.COALESCE_AT:
                 self._compact_locked()
 
-    def note_flow_sent(self, dst: int, pool: Any) -> None:
+    #: per-tenant taskpool-latency samples kept for the p50/p99 rollup
+    TENANT_LAT_RING = 512
+
+    def _tenant_cell_locked(
+            self, tenant: str) -> Dict[str, Any]:  # holds: self._lock
+        return self._tenants.setdefault(
+            tenant, {"sent": 0, "recv": 0, "lag_us_sum": 0.0, "lag_n": 0,
+                     "pools_done": 0,
+                     "lat": deque(maxlen=self.TENANT_LAT_RING)})
+
+    def note_flow_sent(self, dst: int, pool: Any,
+                       tenant: Optional[str] = None) -> None:
         with self._lock:
             cell = self._pools.setdefault(
                 pool, {"sent": 0, "recv": 0, "lag_us_sum": 0.0, "lag_n": 0})
             cell["sent"] += 1
+            if tenant is not None:
+                self._tenant_cell_locked(tenant)["sent"] += 1
+
+    def note_tenant_latency(self, tenant: str, lat_us: float) -> None:
+        """One served taskpool completed for ``tenant`` after ``lat_us``
+        microseconds submit-to-termination — pushed by the
+        SessionServer so health snapshots (and the fleet merge) carry
+        per-tenant SLO percentiles next to the flow attribution."""
+        with self._lock:
+            cell = self._tenant_cell_locked(tenant)
+            cell["pools_done"] += 1
+            cell["lat"].append(float(lat_us))
+            self._activity += 1
 
     def note_flow_recv(self, src: int, pool: Any, t_send_ns: int,
-                       t_recv_ns: int) -> None:
+                       t_recv_ns: int,
+                       tenant: Optional[str] = None) -> None:
         """A stitched flow edge arrived: the sender's monotonic send
         instant rode the extended context; convert it onto this rank's
         clock with the live offset estimate (offset = peer_clock -
@@ -325,6 +367,11 @@ class LiveHealth:
             cell["recv"] += 1
             cell["lag_us_sum"] += lag_us
             cell["lag_n"] += 1
+            if tenant is not None:
+                tc = self._tenant_cell_locked(tenant)
+                tc["recv"] += 1
+                tc["lag_us_sum"] += lag_us
+                tc["lag_n"] += 1
             self._activity += 1
 
     # -- bounded memory ------------------------------------------------
@@ -423,17 +470,34 @@ class LiveHealth:
                                   c["lag_us_sum"] / c["lag_n"], 1)
                               if c["lag_n"] else 0.0}
                      for p, c in self._pools.items()}
-            return {"rank": self.rank,
-                    "ts": time.time(),
-                    "window_ms": self.window_ms,
-                    "windows": self.counts["windows"],
-                    "status": self.status,
-                    "counts": dict(self.counts),
-                    "overlap": ov,
-                    "per_link_exposed_us": exposed,
-                    "per_link_lag_us": lag,
-                    "per_pool": pools,
-                    "firings": list(self._firings)}
+            doc = {"rank": self.rank,
+                   "ts": time.time(),
+                   "window_ms": self.window_ms,
+                   "windows": self.counts["windows"],
+                   "status": self.status,
+                   "counts": dict(self.counts),
+                   "overlap": ov,
+                   "per_link_exposed_us": exposed,
+                   "per_link_lag_us": lag,
+                   "per_pool": pools,
+                   "firings": list(self._firings)}
+            if self._tenants:
+                # serve attribution (ISSUE 18) — the key appears ONLY
+                # when a server fed tenant data, so pre-serve snapshot
+                # consumers keep the exact old document shape
+                doc["per_tenant"] = {
+                    str(t): {"sent": int(c["sent"]),
+                             "recv": int(c["recv"]),
+                             "lag_us_mean": round(
+                                 c["lag_us_sum"] / c["lag_n"], 1)
+                             if c["lag_n"] else 0.0,
+                             "pools_done": int(c["pools_done"]),
+                             "p50_lat_us": round(
+                                 _pct(list(c["lat"]), 0.50), 1),
+                             "p99_lat_us": round(
+                                 _pct(list(c["lat"]), 0.99), 1)}
+                    for t, c in self._tenants.items()}
+            return doc
 
     # -- gauges (registered by the obs wiring) -------------------------
     def _count(self, key: str) -> int:
@@ -647,6 +711,7 @@ def fleet_health(per_rank: Dict[int, Dict[str, Any]]) -> Dict[str, Any]:
               "degraded_link": 0, "stuck": 0}
     links: Dict[str, float] = {}
     pools: Dict[str, Dict[str, float]] = {}
+    tenants: Dict[str, Dict[str, float]] = {}
     firings: List[Dict[str, Any]] = []
     status = 0
     for r, snap in sorted(ranks.items()):
@@ -659,21 +724,39 @@ def fleet_health(per_rank: Dict[int, Dict[str, Any]]) -> Dict[str, Any]:
             agg = pools.setdefault(p, {"sent": 0, "recv": 0})
             agg["sent"] += int(cell.get("sent", 0))
             agg["recv"] += int(cell.get("recv", 0))
+        for t, cell in (snap.get("per_tenant") or {}).items():
+            # serve attribution (ISSUE 18): counters sum; latency
+            # percentiles take the fleet-worst rank (percentiles do
+            # not compose — the conservative bound is what SLO gates
+            # want)
+            agg = tenants.setdefault(
+                t, {"sent": 0, "recv": 0, "pools_done": 0,
+                    "p50_lat_us": 0.0, "p99_lat_us": 0.0})
+            agg["sent"] += int(cell.get("sent", 0))
+            agg["recv"] += int(cell.get("recv", 0))
+            agg["pools_done"] += int(cell.get("pools_done", 0))
+            agg["p50_lat_us"] = max(agg["p50_lat_us"],
+                                    float(cell.get("p50_lat_us", 0.0)))
+            agg["p99_lat_us"] = max(agg["p99_lat_us"],
+                                    float(cell.get("p99_lat_us", 0.0)))
         firings.extend(snap.get("firings") or ())
     firings.sort(key=lambda f: f.get("ts", 0.0))
     worst = max(links.items(), key=lambda kv: kv[1]) if links else None
-    return {"nb_ranks": len(ranks),
-            "status": status,
-            "counts": counts,
-            "per_link_exposed_us": {k: round(v, 1) for k, v in
-                                    sorted(links.items(),
-                                           key=lambda kv: -kv[1])},
-            "worst_link": ({"link": worst[0],
-                            "exposed_us": round(worst[1], 1)}
-                           if worst else None),
-            "per_pool": pools,
-            "firings": firings,
-            "ranks": {str(r): s for r, s in sorted(ranks.items())}}
+    doc = {"nb_ranks": len(ranks),
+           "status": status,
+           "counts": counts,
+           "per_link_exposed_us": {k: round(v, 1) for k, v in
+                                   sorted(links.items(),
+                                          key=lambda kv: -kv[1])},
+           "worst_link": ({"link": worst[0],
+                           "exposed_us": round(worst[1], 1)}
+                          if worst else None),
+           "per_pool": pools,
+           "firings": firings,
+           "ranks": {str(r): s for r, s in sorted(ranks.items())}}
+    if tenants:
+        doc["per_tenant"] = tenants
+    return doc
 
 
 _STATUS = {0: "healthy", 1: "degraded", 2: "stuck"}
@@ -736,6 +819,22 @@ def format_health(doc: Dict[str, Any]) -> str:
                     f"recv={cell.get('recv', 0)}")
             if "lag_us_mean" in cell:
                 line += f" lag_mean={cell['lag_us_mean']:.1f} us"
+            out.append(line)
+    # serve attribution (ISSUE 18): rendered only when a SessionServer
+    # fed tenant data — pre-serve snapshots have no per_tenant key and
+    # keep the exact pre-serve rendering
+    tenants = doc.get("per_tenant") or {}
+    if tenants:
+        out.append("per-tenant attribution:")
+        for t, cell in sorted(tenants.items()):
+            line = (f"  tenant {t:<10} pools_done="
+                    f"{cell.get('pools_done', 0)} "
+                    f"sent={cell.get('sent', 0)} "
+                    f"recv={cell.get('recv', 0)}")
+            p99 = cell.get("p99_lat_us")
+            if p99:
+                line += (f" p50={cell.get('p50_lat_us', 0.0) / 1e3:.3f} ms"
+                         f" p99={float(p99) / 1e3:.3f} ms")
             out.append(line)
     firings = doc.get("firings") or []
     if firings:

@@ -38,6 +38,9 @@ __all__ = ["CommObs", "DeviceObs", "OverlapTracker",
            "OBS_HEALTH_WORST_LINK_US",
            "TUNE_DECISIONS", "TUNE_REVERTS",
            "TUNE_ACTIVE_CODEC_PREFIX", "TUNE_OBJECTIVE_US",
+           "SERVE_TENANTS", "SERVE_ADMITTED", "SERVE_REJECTED",
+           "SERVE_QUEUED", "SERVE_INFLIGHT_PREFIX",
+           "SERVE_QUOTA_BYTES_PREFIX", "SERVE_P99_LATENCY_PREFIX",
            "flow_event_id", "inbound_flow_ctx", "set_inbound_flow_ctx",
            "payload_nbytes"]
 
@@ -116,6 +119,22 @@ TUNE_DECISIONS = "PARSEC::TUNE::DECISIONS"
 TUNE_REVERTS = "PARSEC::TUNE::REVERTS"
 TUNE_ACTIVE_CODEC_PREFIX = "PARSEC::TUNE::ACTIVE_CODEC"
 TUNE_OBJECTIVE_US = "PARSEC::TUNE::OBJECTIVE_US"
+# multi-tenant persistent serving (ISSUE 18, serve/server.py, ``serve``
+# knob family): open tenant sessions, admission outcomes (admitted /
+# rejected / queued submissions across all tenants), and per-tenant
+# gauges registered at open_tenant — in-flight taskpools
+# (PARSEC::SERVE::INFLIGHT::<tenant>), bytes charged against the
+# declared Mempool quota (PARSEC::SERVE::QUOTA_BYTES::<tenant>), and
+# the rolling p99 taskpool latency
+# (PARSEC::SERVE::P99_LATENCY_US::<tenant>).  Registered ONLY when a
+# SessionServer is constructed — no server, no gauges.
+SERVE_TENANTS = "PARSEC::SERVE::TENANTS"
+SERVE_ADMITTED = "PARSEC::SERVE::ADMITTED"
+SERVE_REJECTED = "PARSEC::SERVE::REJECTED"
+SERVE_QUEUED = "PARSEC::SERVE::QUEUED"
+SERVE_INFLIGHT_PREFIX = "PARSEC::SERVE::INFLIGHT"
+SERVE_QUOTA_BYTES_PREFIX = "PARSEC::SERVE::QUOTA_BYTES"
+SERVE_P99_LATENCY_PREFIX = "PARSEC::SERVE::P99_LATENCY_US"
 
 
 def flow_event_id(ctx: Tuple[int, ...]) -> int:
@@ -350,13 +369,20 @@ class CommObs:
         """The sender half of one wire flow edge: the message left with
         trace context ``ctx`` stamped on it at enqueue time ``t0_ns``."""
         self.metrics.sde.inc(OBS_FLOW_SENT)
+        # serve-extended context (ISSUE 18): field 4 is the tenant that
+        # submitted the pool — None on live-only contexts and on serve
+        # traffic of pools no server owns
+        tenant = ctx[4] if len(ctx) >= 5 else None
         if self.live is not None and len(ctx) >= 4:
             # extended live context: field 2 is the taskpool wire id
-            self.live.note_flow_sent(dst, ctx[2])
+            self.live.note_flow_sent(dst, ctx[2], tenant=tenant)
         st = self.stream
         if st is not None:
+            args = {"dst": dst}
+            if tenant is not None:
+                args["tenant"] = tenant
             st.flow(f"flow:{_tag_name(tag)}", flow_event_id(ctx), "s",
-                    t0_ns, {"dst": dst})
+                    t0_ns, args)
 
     def flow_recv(self, src: int, tag: int, ctx: Any) -> None:
         """The receiver half: a message carrying ``ctx`` arrived —
@@ -364,15 +390,20 @@ class CommObs:
         merged timeline stitches exactly one edge per wire hop."""
         self.metrics.sde.inc(OBS_FLOW_RECV)
         t1 = time.monotonic_ns()
+        tenant = ctx[4] if len(ctx) >= 5 else None
         if self.live is not None and len(ctx) >= 4:
             # extended live context: (origin, span, pool, t_send_ns) —
             # the sender's monotonic send instant converts to lag via
             # the live clock-offset estimate inside the monitor
-            self.live.note_flow_recv(src, ctx[2], ctx[3], t1)
+            self.live.note_flow_recv(src, ctx[2], ctx[3], t1,
+                                     tenant=tenant)
         st = self.stream
         if st is not None:
+            args = {"src": src}
+            if tenant is not None:
+                args["tenant"] = tenant
             st.flow(f"flow:{_tag_name(tag)}", flow_event_id(ctx), "f",
-                    t1, {"src": src})
+                    t1, args)
 
     # -- one-sided transfers -------------------------------------------------
     def get_begin(self, token: int, src_rank: int) -> None:

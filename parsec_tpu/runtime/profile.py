@@ -44,12 +44,18 @@ from __future__ import annotations
 import threading
 from typing import Any, Dict, List, Set, Tuple
 
-__all__ = ["ClassProfile"]
+__all__ = ["ClassProfile", "TENANT_PRIO_SCALE"]
 
 #: the static priority rides in the low bits; one boost step dominates
 #: any static value inside the clamp window
 _STATIC_CLAMP = (1 << 21) - 1
 _PRIO_SCALE = 1 << 22
+#: tenant fairness boosts (serve/fairness.py, ISSUE 18) pack ABOVE the
+#: class-profile band: ``effective()`` yields at most boost*2^22+base
+#: with boost < 2^18 in any realistic condensation, so one fairness
+#: step dominates every critical-path boost while the class boost (and
+#: under it the static expression) stays the within-tenant tiebreak
+TENANT_PRIO_SCALE = 1 << 44
 
 
 class ClassProfile:

@@ -581,6 +581,31 @@ def register_core_params() -> None:
                    "process count for jax.distributed.initialize")
     params.reg_int("jax_process_id", -1,
                    "this process's id for jax.distributed.initialize")
+    # multi-tenant persistent serving (serve/, ISSUE 18)
+    params.reg_bool("serve", False,
+                    "multi-tenant persistent serving (serve/): advertise "
+                    "the \"sv\" HELLO capability so SessionServer "
+                    "submission endpoints accept remote tenants, and pull "
+                    "the obs_live monitor up for per-tenant SLO "
+                    "attribution; off (default) constructs nothing and "
+                    "keeps the wire bit-for-bit")
+    params.reg_string("serve_admission", "reject",
+                      "over-quota submission policy: \"reject\" (the "
+                      "submission fails with AdmissionError / an error "
+                      "reply) or \"queue\" (it parks on the tenant's "
+                      "queue and launches when capacity frees)")
+    params.reg_int("serve_max_tenants", 64,
+                   "max named tenant sessions one SessionServer accepts")
+    params.reg_int("serve_default_weight", 1,
+                   "fair-share weight a tenant gets when open_tenant "
+                   "declares none (>= 1; the deficit fairness boost "
+                   "normalizes completed work by this weight)")
+    params.reg_sizet("serve_default_quota_bytes", 0,
+                     "Mempool byte quota a tenant gets when open_tenant "
+                     "declares none (0 = unlimited)")
+    params.reg_int("serve_latency_window", 512,
+                   "per-tenant taskpool-latency samples kept for the "
+                   "P99_LATENCY_US gauge and health snapshots")
 
 
 register_core_params()

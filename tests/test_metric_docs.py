@@ -69,6 +69,7 @@ def test_documented_gauge_rows_use_known_prefixes():
     known_roots = ("PARSEC::COMM", "PARSEC::DEVICE", "PARSEC::FT",
                    "PARSEC::OBS", "PARSEC::STAGEC", "PARSEC::MEMPOOL",
                    "PARSEC::TASK", "PARSEC::SCHEDULER", "PARSEC::TUNE",
+                   "PARSEC::SERVE",
                    "PARSEC::TASKS_ENABLED", "PARSEC::TASKS_RETIRED")
     for m in re.finditer(r"`(PARSEC::[A-Z_:<>a-z]+)`", _section9()):
         assert m.group(1).startswith(known_roots), m.group(1)

@@ -37,6 +37,12 @@ without editing it::
         --inject "flap:rank=1:nth=20:duration=0.2,kill:rank=2:after=40" \\
         --heartbeat 0.05 --timeout 3 -- examples/ex14_link_flap.py
 
+    # multi-tenant serving soak (serve/): 3 weighted tenants hammering
+    # one SessionServer per iteration; each --health record carries the
+    # per-tenant latency attribution from the fleet /health document
+    python tools/chaos_run.py --soak 300 --tenants 3 \\
+        --health /tmp/serve_soak.jsonl
+
 Everything after ``--`` is the script and ITS argv. Exit status: the
 script's (an uncaught injected failure exits non-zero — which is the
 point: chaos_run makes "does it fail loudly instead of hanging?"
@@ -98,6 +104,18 @@ def main(argv=None) -> int:
                          "and append one machine-readable JSONL record "
                          "per iteration (detector firings, worst link, "
                          "recovery latency) to this path")
+    ap.add_argument("--tenants", type=int, default=0, metavar="N",
+                    help="soak mode only: replace the target script "
+                         "with the built-in multi-tenant serving driver "
+                         "— N tenants (weights 1,2,4,...) submitting "
+                         "concurrent taskpools through a SessionServer "
+                         "for the soak budget; with --health each "
+                         "iteration's record carries the per-tenant "
+                         "latency attribution the fleet /health "
+                         "document reports")
+    ap.add_argument("--tenant-pools", type=int, default=4, metavar="P",
+                    help="pools each driver tenant submits per "
+                         "iteration (default 4)")
     ap.add_argument("--forensics", default="", metavar="PREFIX",
                     help="activate profiling at PREFIX so every rank "
                          "flight-records its trace on a RankFailedError "
@@ -107,10 +125,22 @@ def main(argv=None) -> int:
                          "(tools/obs_trace_merge.py) — every chaos-gate "
                          "failure yields ONE mergeable timeline instead "
                          "of nothing")
-    ap.add_argument("script", help="python script to run")
+    ap.add_argument("script", nargs="?", default="",
+                    help="python script to run (omit with --tenants)")
     ap.add_argument("args", nargs=argparse.REMAINDER,
                     help="argv for the script (prefix with --)")
     ns = ap.parse_args(argv)
+    if ns.tenants > 0:
+        if ns.soak <= 0:
+            ap.error("--tenants requires --soak (the multi-tenant "
+                     "driver is a sustained-load leg)")
+        # the driver serves through a SessionServer: arm the knob so
+        # the obs_live implication + tenant attribution take the same
+        # path a production serving context does
+        os.environ["PARSEC_MCA_serve"] = "1"
+    elif not ns.script:
+        ap.error("a target script is required (or --tenants N with "
+                 "--soak for the built-in serving driver)")
 
     directives = []
     if ns.inject:
@@ -143,7 +173,7 @@ def main(argv=None) -> int:
         # dump destination
         os.environ["PARSEC_MCA_profile"] = ns.forensics
 
-    script = os.path.abspath(ns.script)
+    script = os.path.abspath(ns.script) if ns.script else ""
     # drop only the LEADING separator: a later "--" belongs to the
     # target script's own argv
     args = ns.args[1:] if ns.args[:1] == ["--"] else ns.args
@@ -223,9 +253,81 @@ def _append_health(path: str, srv, iteration: int, recovery_s: float,
            "stuck": counts.get("stuck", 0),
            "worst_link": fleet.get("worst_link"),
            "firing_events": fleet.get("firings", [])}
+    # per-tenant SLO attribution (serve/, ISSUE 18): present only when
+    # the iteration ran a SessionServer (e.g. the --tenants driver) —
+    # pre-serve iterations keep the pre-serve record shape
+    tenants = fleet.get("per_tenant")
+    if tenants:
+        rec["per_tenant"] = tenants
     srv.clear_health()
     with open(path, "a") as fh:
         fh.write(json.dumps(rec) + "\n")
+
+
+#: the --tenants soak leg: N tenants (weights 1,2,4,...) submitting
+#: concurrent DTD pools through one SessionServer on a persistent
+#: context; per-tenant p50/p99 print per iteration and, via the
+#: obs_live pushes --health arms, land in the fleet /health document
+#: each JSONL record condenses
+_TENANT_DRIVER = """
+import os, sys, threading
+sys.path.insert(0, os.environ.get("CHAOS_REPO", "."))
+import numpy as np
+import parsec_tpu
+from parsec_tpu import dtd
+from parsec_tpu.dsl.dtd import INOUT, VALUE, unpack_args
+from parsec_tpu.serve import SessionServer
+
+n_tenants, n_pools = int(sys.argv[1]), int(sys.argv[2])
+ctx = parsec_tpu.init(nb_cores=3, enable_tpu=False)
+srv = SessionServer(ctx)
+
+
+def mk_build(n):
+    def build():
+        tp = dtd.taskpool_new()
+        arr = np.zeros(1, dtype=np.int64)
+        tile = tp.tile_of_array(arr)
+
+        def body(es, task):
+            a, k = unpack_args(task)
+            a[0] += 1
+        for k in range(n):
+            tp.insert_task(body, (tile, INOUT), (k, VALUE))
+        return tp
+    return build
+
+
+failures = []
+
+
+def drive(name, tasks):
+    for _ in range(n_pools):
+        sub = srv.submit(name, mk_build(tasks), ntasks=tasks)
+        if not sub.wait(120) or sub.error is not None:
+            failures.append(f"{name}: {sub.error or 'timeout'}")
+            return
+
+
+threads = []
+for i in range(n_tenants):
+    name = f"tenant{i}"
+    srv.open_tenant(name, weight=1 << min(i, 7))
+    th = threading.Thread(target=drive, args=(name, 20 + 10 * i))
+    th.start()
+    threads.append(th)
+for th in threads:
+    th.join()
+stats = srv.stats()
+for name, cell in sorted(stats["tenants"].items()):
+    print(f"tenant {name}: pools_done={cell['pools_done']} "
+          f"p50={cell['p50_lat_us']:.0f}us "
+          f"p99={cell['p99_lat_us']:.0f}us", flush=True)
+srv.close()
+ctx.fini()
+if failures:
+    sys.exit("tenant driver failures: " + "; ".join(failures))
+"""
 
 
 def _soak(ns, script: str, args) -> int:
@@ -251,20 +353,30 @@ def _soak(ns, script: str, args) -> int:
               f"appending per-iteration records to {ns.health}",
               flush=True)
 
-    base = [sys.executable, os.path.abspath(__file__)]
-    if ns.inject:
-        base += ["--inject", ns.inject]
-    if ns.heartbeat > 0:
-        base += ["--heartbeat", str(ns.heartbeat)]
-    if ns.timeout > 0:
-        base += ["--timeout", str(ns.timeout)]
-    if ns.restart:
-        base += ["--restart", str(ns.restart)]
-    if ns.reconnect > 0:
-        base += ["--reconnect", str(ns.reconnect)]
-    if ns.forensics:
-        base += ["--forensics", ns.forensics]
-    base += [script, "--"] + list(args)
+    if ns.tenants > 0:
+        # built-in serving driver: the MCA env exported in main()
+        # (injection, serve=1, obs_live/sde_push from --health) is
+        # inherited, so the driver rides the same chaos knobs a target
+        # script would
+        os.environ["CHAOS_REPO"] = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))
+        base = [sys.executable, "-c", _TENANT_DRIVER,
+                str(ns.tenants), str(ns.tenant_pools)]
+    else:
+        base = [sys.executable, os.path.abspath(__file__)]
+        if ns.inject:
+            base += ["--inject", ns.inject]
+        if ns.heartbeat > 0:
+            base += ["--heartbeat", str(ns.heartbeat)]
+        if ns.timeout > 0:
+            base += ["--timeout", str(ns.timeout)]
+        if ns.restart:
+            base += ["--restart", str(ns.restart)]
+        if ns.reconnect > 0:
+            base += ["--reconnect", str(ns.reconnect)]
+        if ns.forensics:
+            base += ["--forensics", ns.forensics]
+        base += [script, "--"] + list(args)
 
     t_end = time.monotonic() + ns.soak
     it = 0

@@ -40,6 +40,10 @@ def main(argv=None) -> int:
                          "(enables the critical-path section)")
     ap.add_argument("--json", action="store_true",
                     help="emit the raw report as JSON instead of text")
+    ap.add_argument("--tenant", default=None, metavar="NAME",
+                    help="narrow the cross-rank section to flow edges a "
+                         "serve/ SessionServer attributed to NAME (the "
+                         "one-customer SLO view of a shared fleet)")
     ap.add_argument("--gate-overlap", type=float, default=None,
                     metavar="FRAC",
                     help="exit non-zero when any rank's compute/comm "
@@ -77,7 +81,7 @@ def main(argv=None) -> int:
         with open(args.dot) as fh:
             dot_text = fh.read()
 
-    report = analyze(docs, dot_text=dot_text)
+    report = analyze(docs, dot_text=dot_text, tenant=args.tenant)
     if args.json:
         json.dump(report, sys.stdout, indent=2, default=repr)
         print()

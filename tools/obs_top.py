@@ -49,6 +49,11 @@ def main(argv=None) -> int:
                     help="print one snapshot and exit")
     ap.add_argument("--json", action="store_true",
                     help="emit the raw fleet JSON instead of text")
+    ap.add_argument("--tenant", default=None, metavar="NAME",
+                    help="focus the per-tenant attribution section "
+                         "(serve/, ISSUE 18) on one tenant; snapshots "
+                         "from pre-serve builds simply have no such "
+                         "section and render unchanged")
     args = ap.parse_args(argv)
 
     url = args.url
@@ -66,6 +71,12 @@ def main(argv=None) -> int:
                 return 1
             time.sleep(args.interval)
             continue
+        if args.tenant is not None and isinstance(doc, dict):
+            tenants = doc.get("per_tenant")
+            if isinstance(tenants, dict):
+                doc = dict(doc)
+                doc["per_tenant"] = {k: v for k, v in tenants.items()
+                                     if k == args.tenant}
         if args.json:
             print(json.dumps(doc))
         else:

@@ -36,6 +36,11 @@ def main(argv=None) -> int:
     ap.add_argument("-o", "--output", default=None,
                     help="merged output path (default: "
                          "<first input's prefix>.merged.json)")
+    ap.add_argument("--tenant", default=None, metavar="NAME",
+                    help="keep only the flow halves a serve/ "
+                         "SessionServer attributed to tenant NAME "
+                         "(spans and counters are kept; other tenants' "
+                         "arrows are dropped from the merged timeline)")
     ap.add_argument("--strict", action="store_true",
                     help="exit non-zero when any stitched cross-rank "
                          "edge has a NEGATIVE offset-corrected lag "
@@ -48,6 +53,17 @@ def main(argv=None) -> int:
         with open(path) as fh:
             docs.append(json.load(fh))
     merged = merge_trace_docs(docs)
+    if args.tenant is not None:
+        # flow halves of OTHER tenants go; untagged halves (runtime
+        # traffic a server never owned) go too — what remains is one
+        # customer's arrows over the shared fleet's span rows
+        def _keep(e):
+            if e.get("ph") not in ("s", "f"):
+                return True
+            a = e.get("args")
+            return isinstance(a, dict) and a.get("tenant") == args.tenant
+        merged["traceEvents"] = [e for e in merged["traceEvents"]
+                                 if _keep(e)]
     edges, unmatched = stitch_flows(load_flow_events(merged))
     cross = [e for e in edges if e["src"] != e["dst"]]
     neg = [e for e in cross if e["lag_us"] < 0]
@@ -80,6 +96,14 @@ def main(argv=None) -> int:
           + (f", lag min/median/max = {lags[0]:.0f}/"
              f"{lags[len(lags) // 2]:.0f}/{lags[-1]:.0f} us"
              if lags else ""))
+    by_tenant = {}
+    for e in cross:
+        if "tenant" in e:
+            by_tenant[e["tenant"]] = by_tenant.get(e["tenant"], 0) + 1
+    if by_tenant:
+        print("tenant-attributed edges: "
+              + ", ".join(f"{t}={n}"
+                          for t, n in sorted(by_tenant.items())))
     if args.strict and (neg or unmatched):
         print(f"STRICT: {len(neg)} negative-lag edge(s), {unmatched} "
               f"unmatched flow half/halves", file=sys.stderr)
