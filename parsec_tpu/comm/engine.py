@@ -270,6 +270,14 @@ class CommEngine:
     def tag_unregister(self, tag: int) -> None:
         self._tag_cbs.pop(tag, None)
 
+    def tag_registered(self, tag: int) -> bool:
+        """True if ``tag`` already has a handler installed — consumers
+        that must own a tag exclusively (ServeClient on
+        TAG_SERVE_REPLY) check before registering, since
+        ``tag_register`` silently replaces."""
+        with self._deferred_lock:
+            return tag in self._tag_cbs
+
     def send_am(self, dst: int, tag: int, payload: Any) -> None:
         raise NotImplementedError
 

@@ -21,6 +21,8 @@ import threading
 import weakref
 from typing import Any, Callable, Dict, List, Optional
 
+from ..utils import logging as plog
+
 __all__ = ["Mempool", "ThreadMempool"]
 
 # intrusive owner back-pointer (the reference's parsec_thread_mempool_t
@@ -103,6 +105,11 @@ class Mempool:
         self.nb_hits = 0         # served from a freelist (no construction)
         self.nb_outstanding = 0  # allocated minus freed
         self.outstanding_hwm = 0
+        # fired (no args) after each free() returns an element, i.e.
+        # whenever nb_outstanding drops — quota consumers (serve/
+        # admission) re-evaluate queued work on it; must be cheap and
+        # must not raise (failures are logged and swallowed)
+        self.on_free: Optional[Callable[[], None]] = None
         self._gauges: List[tuple] = []  # (name, poll fn) for unregister
         if name:
             self._register_gauges(name)
@@ -212,6 +219,13 @@ class Mempool:
         if owner is not None:
             self.nb_outstanding = max(0, self.nb_outstanding - 1)
             owner.push(elt)
+            cb = self.on_free
+            if cb is not None:
+                try:
+                    cb()
+                except Exception as exc:  # noqa: BLE001 - never kill free
+                    plog.warning("mempool %s: on_free hook failed: %r",
+                                 self.name or "<anon>", exc)
         # unknown element: not pool-constructed; drop it (GC)
 
     def nb_cached(self) -> int:

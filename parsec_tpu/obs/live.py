@@ -206,6 +206,13 @@ class LiveHealth:
         self.lag_factor = float(lag_factor)
         self.min_lag_us = float(min_lag_us)
         self.stuck_windows = int(stuck_windows)
+        # per-tenant latency ring length (instance attr shadows the
+        # class default): sized by the same serve_latency_window knob
+        # the SessionServer reads, so server stats and health
+        # snapshots percentile over the same horizon
+        from ..utils.params import params
+        self.TENANT_LAT_RING = max(1, int(params.get_or(
+            "serve_latency_window", "int", type(self).TENANT_LAT_RING)))
         self._lock = threading.Lock()
         # rolling interval channels (µs pairs, monotonic-ns / 1e3)
         self._compute: List[Tuple[float, float]] = []
@@ -311,6 +318,7 @@ class LiveHealth:
                 self._compact_locked()
 
     #: per-tenant taskpool-latency samples kept for the p50/p99 rollup
+    #: (default; __init__ resizes from the serve_latency_window knob)
     TENANT_LAT_RING = 512
 
     def _tenant_cell_locked(

@@ -235,6 +235,10 @@ class Taskpool(Obj):
         self.on_complete: Optional[Callable] = None
         self.startup_hook: Optional[Callable] = None  # (context, tp) -> [ready tasks]
         self._complete_cbs: List[Callable] = []
+        # run from abort() (ft/ eviction), NOT on normal termination —
+        # observers that charge state per live pool (the serving
+        # layer's admission accounting) hook both lists
+        self._abort_cbs: List[Callable] = []
         self._lock = threading.Lock()
         self._completed = threading.Event()
         self.aborted = False    # ft/: rank eviction aborted this DAG
@@ -279,7 +283,9 @@ class Taskpool(Obj):
         """FT eviction path (ft/): the DAG cannot finish (a
         participating rank is gone). Unblock ``wait_completed`` WITHOUT
         running the completion callbacks — the pool did not complete,
-        and a waiter must consult the context's recorded errors. A late
+        and a waiter must consult the context's recorded errors. The
+        dedicated ``_abort_cbs`` DO run, so per-pool charges held by
+        observers (serve/ admission) are released either way. A late
         termination_detected (counters settling after the abort) is a
         no-op; losing the claim to a real termination is fine too (the
         pool DID complete — nothing to abort)."""
@@ -287,6 +293,8 @@ class Taskpool(Obj):
             return
         plog.warning("taskpool %d (%s) aborted (rank eviction)",
                      self.taskpool_id, self.name)
+        for cb in self._abort_cbs:
+            cb(self)
         ctx = self.context
         self._completed.set()
         if ctx is not None:

@@ -11,7 +11,12 @@ error instead of silence.
 The calling thread blocks on a condition variable until its reply is
 delivered — which happens on whichever thread drains the engine's
 progress (a comm thread, a scheduler idle cycle, or an explicit
-``progress()`` pump in engine-only tests)."""
+``progress()`` pump in engine-only tests).
+
+One client per engine: the engine keeps ONE handler per tag, so a
+second ServeClient would silently detach the first's reply path —
+construction raises instead, and :meth:`close` releases the tag (and
+wakes any parked callers) so a successor can attach."""
 from __future__ import annotations
 
 import threading
@@ -26,6 +31,7 @@ __all__ = ["ServeClient", "ServeTimeout"]
 _GUARDED_BY = {
     "ServeClient._replies": "_cond",
     "ServeClient._next_req": "_cond",
+    "ServeClient._closed": "_cond",
 }
 
 
@@ -42,7 +48,31 @@ class ServeClient:
         self._cond = threading.Condition()
         self._replies: Dict[int, Dict[str, Any]] = {}
         self._next_req = 0
+        self._closed = False
+        registered = getattr(ce, "tag_registered", None)
+        if registered is not None and registered(TAG_SERVE_REPLY):
+            raise RuntimeError(
+                "TAG_SERVE_REPLY already has a handler on this engine: "
+                "one ServeClient per engine (close() the previous "
+                "client before constructing another)")
         ce.tag_register(TAG_SERVE_REPLY, self._on_reply)
+
+    def close(self) -> None:
+        """Detach from the engine: release the reply tag for a
+        successor client and fail any calls still parked in
+        :meth:`_call` (they raise instead of riding their timeout)."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify_all()
+        self._ce.tag_unregister(TAG_SERVE_REPLY)
+
+    def __enter__(self) -> "ServeClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     def _on_reply(self, src: int, payload: Any) -> None:
         try:
@@ -60,19 +90,25 @@ class ServeClient:
                 f"rank {self._dst} did not negotiate the sv capability "
                 f"(serve knob unset on one end)")
         with self._cond:
+            if self._closed:
+                raise RuntimeError("ServeClient is closed")
             self._next_req += 1
             req = self._next_req
         self._ce.send_am(self._dst, TAG_SERVE,
                          wire.serve_request(op, req, **kw))
         budget = timeout if timeout is not None else self._timeout
         with self._cond:
-            ok = self._cond.wait_for(lambda: req in self._replies,
-                                     timeout=budget)
-            if not ok:
-                raise ServeTimeout(
-                    f"serve op {op!r} to rank {self._dst}: no reply "
-                    f"within {budget:.1f}s")
-            return self._replies.pop(req)
+            self._cond.wait_for(
+                lambda: req in self._replies or self._closed,
+                timeout=budget)
+            if req in self._replies:
+                return self._replies.pop(req)
+            if self._closed:
+                raise RuntimeError(
+                    f"ServeClient closed while op {op!r} was in flight")
+            raise ServeTimeout(
+                f"serve op {op!r} to rank {self._dst}: no reply "
+                f"within {budget:.1f}s")
 
     # -- API ----------------------------------------------------------------
     def open_tenant(self, tenant: str, weight: Optional[int] = None,

@@ -52,16 +52,19 @@ from .fairness import TenantFairness
 __all__ = ["AdmissionError", "SessionServer", "Submission", "Tenant"]
 
 # Tenant/Submission mutable fields (inflight_*, queued, lat_us,
-# waiters) are guarded by the owning SessionServer's _lock too — the
-# lint's recv.lock matching can only express same-receiver guards, so
-# those stay documentation (class docstrings) rather than declarations.
+# waiters, charged) are guarded by the owning SessionServer's _lock too
+# — the lint's recv.lock matching can only express same-receiver
+# guards, so those stay documentation (class docstrings) rather than
+# declarations.  _nq (global queued count) is written under _lock and
+# read lock-free as kick()'s fast-path early-out.
 _GUARDED_BY = {
     "SessionServer._tenants": "_lock",
     "SessionServer._subs": "_lock",
 }
 
-#: latency ring length per tenant (server-side; the live monitor keeps
-#: its own ring of the same default length for fleet merging)
+#: default per-tenant latency ring length (server-side; the live
+#: monitor keeps its own ring of the same default for fleet merging);
+#: both resize from the serve_latency_window knob at construction
 _LAT_RING = 512
 
 
@@ -81,7 +84,8 @@ class Tenant:
                  "queued", "lat_us", "mempools", "pools_done", "_gauges")
 
     def __init__(self, name: str, weight: int, quota_bytes: int,
-                 max_pools: int, max_tasks: int) -> None:
+                 max_pools: int, max_tasks: int,
+                 lat_ring: int = _LAT_RING) -> None:
         self.name = name
         self.weight = max(1, int(weight))
         self.quota_bytes = int(quota_bytes)   # 0 = unlimited
@@ -91,7 +95,7 @@ class Tenant:
         self.inflight_tasks = 0
         self.inflight_bytes = 0
         self.queued: deque = deque()          # queued Submissions (FIFO)
-        self.lat_us: deque = deque(maxlen=_LAT_RING)
+        self.lat_us: deque = deque(maxlen=max(1, int(lat_ring)))
         self.pools_done = 0
         # named-Mempool quota feeds: (mempool, item_bytes)
         self.mempools: List[Tuple[Any, int]] = []
@@ -109,7 +113,7 @@ class Submission:
 
     __slots__ = ("ticket", "tenant", "build", "nbytes", "ntasks", "name",
                  "t_submit_ns", "taskpool", "done", "error", "waiters",
-                 "lat_us")
+                 "lat_us", "charged")
 
     def __init__(self, ticket: int, tenant: str, build: Callable[[], Any],
                  nbytes: int, ntasks: int, name: Optional[str]) -> None:
@@ -124,6 +128,9 @@ class Submission:
         self.done = threading.Event()
         self.error: Optional[str] = None
         self.lat_us = 0.0
+        # admission currently charged against the tenant (server _lock);
+        # makes the release path idempotent against done/abort races
+        self.charged = False
         # deferred remote "wait" replies: (src_rank, req_id)
         self.waiters: List[Tuple[int, int]] = []
 
@@ -147,10 +154,14 @@ class SessionServer:
             params.get_or("serve_default_weight", "int", 1))
         self.default_quota = int(
             params.get_or("serve_default_quota_bytes", "sizet", 0))
+        self.lat_ring = max(1, int(
+            params.get_or("serve_latency_window", "int", _LAT_RING)))
         self._lock = threading.Lock()
         self._tenants: Dict[str, Tenant] = {}
         self._subs: Dict[int, Submission] = {}
         self._next_ticket = 0
+        self._nq = 0              # queued submissions across all tenants
+        self._hooked_mempools: List[Any] = []
         self._closed = False
         self._ce = None
         self.fairness = TenantFairness()
@@ -187,7 +198,8 @@ class SessionServer:
             if len(self._tenants) >= self.max_tenants:
                 raise AdmissionError(
                     f"tenant cap reached ({self.max_tenants})")
-            t = Tenant(name, weight, quota_bytes, max_pools, max_tasks)
+            t = Tenant(name, weight, quota_bytes, max_pools, max_tasks,
+                       lat_ring=self.lat_ring)
             self._tenants[name] = t
         self.fairness.register(name, t.weight)
         self._register_tenant_gauges(t)
@@ -196,21 +208,36 @@ class SessionServer:
     def close_tenant(self, name: str) -> None:
         with self._lock:
             t = self._tenants.pop(name, None)
+            if t is not None:
+                self._nq -= len(t.queued)
         if t is None:
             return
         self.fairness.forget(name)
         for gname, fn in t._gauges:
             self.ctx.sde.unregister(gname, fn)
         t._gauges.clear()
+        # queued submissions can never launch now: fail them so local
+        # and remote waiters unblock instead of timing out
+        for sub in t.queued:
+            self._finish(sub, error=f"tenant {name!r} closed")
+        t.queued.clear()
 
     def bind_mempool(self, tenant: str, mempool, item_bytes: int) -> None:
         """Feed a named Mempool's outstanding bytes into the tenant's
         quota: ``nb_outstanding * item_bytes`` counts against
         ``quota_bytes`` at admission time, so a tenant holding tiles
-        hostage admits less new work."""
+        hostage admits less new work.
+
+        The pool's ``on_free`` hook is pointed at :meth:`kick` so that
+        quota headroom appearing from a mempool free re-admits queued
+        submissions — a tenant with zero in-flight pools has no
+        ``_pool_done`` event to drain its queue otherwise."""
         with self._lock:
             t = self._tenants[tenant]
             t.mempools.append((mempool, int(item_bytes)))
+        if getattr(mempool, "on_free", None) is None:
+            mempool.on_free = self.kick
+            self._hooked_mempools.append(mempool)
 
     def _register_tenant_gauges(self, t: Tenant) -> None:
         name = t.name
@@ -263,6 +290,7 @@ class SessionServer:
                 self._charge_locked(t, sub)
             elif verdict == "queue":
                 t.queued.append(sub)
+                self._nq += 1
         if verdict == "admit":
             self.ctx.sde.inc(SERVE_ADMITTED)
             self._launch(sub)
@@ -296,9 +324,73 @@ class SessionServer:
 
     def _charge_locked(self, t: Tenant,
                        sub: Submission) -> None:  # holds: self._lock
+        sub.charged = True
         t.inflight_pools += 1
         t.inflight_tasks += sub.ntasks
         t.inflight_bytes += sub.nbytes
+
+    def _drain_locked(self, t: Tenant
+                      ) -> List[Submission]:  # holds: self._lock
+        """Pop + charge the tenant's queue head(s) that now fit; the
+        caller launches them OUTSIDE the lock."""
+        promoted: List[Submission] = []
+        while t.queued:
+            nxt = t.queued[0]
+            if self._admit_locked(t, nxt) != "admit":
+                break
+            t.queued.popleft()
+            self._nq -= 1
+            self._charge_locked(t, nxt)
+            promoted.append(nxt)
+        return promoted
+
+    def _release(self, sub: Submission, *,
+                 completed: bool) -> List[Submission]:
+        """Un-charge ``sub``'s admission and drain the tenant's queue.
+
+        Every path that charged a submission funnels here — normal
+        completion, build/enqueue failure, and taskpool abort — so the
+        tenant's capacity can never leak; the ``charged`` flag makes it
+        idempotent.  Returns the promoted submissions for the caller to
+        launch outside the lock."""
+        with self._lock:
+            if not sub.charged:
+                return []
+            sub.charged = False
+            t = self._tenants.get(sub.tenant)
+            if t is None:
+                return []
+            t.inflight_pools = max(0, t.inflight_pools - 1)
+            t.inflight_tasks = max(0, t.inflight_tasks - sub.ntasks)
+            t.inflight_bytes = max(0, t.inflight_bytes - sub.nbytes)
+            if completed:
+                t.pools_done += 1
+                t.lat_us.append(sub.lat_us)
+            return self._drain_locked(t)
+
+    def _launch_promoted(self, promoted: List[Submission]) -> None:
+        for nxt in promoted:
+            self.ctx.sde.inc(SERVE_ADMITTED)
+            self._launch(nxt)
+
+    def kick(self) -> None:
+        """Re-evaluate every tenant's queued submissions against the
+        CURRENT capacity.  Headroom can appear without any same-tenant
+        pool completing — a bound Mempool's outstanding bytes dropped —
+        and ``_pool_done``'s drain never fires for a tenant with zero
+        in-flight pools, so bound mempools invoke this from their free
+        path (callers with external quota feeds may call it directly).
+        Lock-free fast path: the plain global queued-count."""
+        if not self._nq:
+            return
+        promoted: List[Submission] = []
+        with self._lock:
+            if self._closed:
+                return
+            for t in self._tenants.values():
+                if t.queued:
+                    promoted.extend(self._drain_locked(t))
+        self._launch_promoted(promoted)
 
     def _launch(self, sub: Submission) -> None:
         """Build + enqueue OUTSIDE the server lock (add_taskpool takes
@@ -306,16 +398,21 @@ class SessionServer:
         try:
             tp = sub.build()
         except Exception as exc:  # noqa: BLE001 - surface on the waiter
+            promoted = self._release(sub, completed=False)
             self._finish(sub, error=f"build failed: {exc!r}")
+            self._launch_promoted(promoted)
             return
         sub.taskpool = tp
         self.fairness.bind_pool(tp.taskpool_id, sub.tenant)
         tp._complete_cbs.append(lambda _tp: self._pool_done(sub))
+        tp._abort_cbs.append(lambda _tp: self._pool_aborted(sub))
         try:
             self.ctx.add_taskpool(tp)
         except Exception as exc:  # noqa: BLE001
             self.fairness.release_pool(tp.taskpool_id)
+            promoted = self._release(sub, completed=False)
             self._finish(sub, error=f"enqueue failed: {exc!r}")
+            self._launch_promoted(promoted)
             return
         if getattr(tp, "_alive", False):
             # DTD pools hold a keep-alive runtime action for
@@ -333,36 +430,33 @@ class SessionServer:
     def _pool_done(self, sub: Submission) -> None:
         """Completion hook — fires on a worker thread inside taskpool
         termination; charge fairness, release admission, drain queue."""
+        self._settle(sub, error=None)
+
+    def _pool_aborted(self, sub: Submission) -> None:
+        """Abort hook (``Taskpool.abort``, the ft/ eviction path): the
+        pool will never terminate, but its admission charges must not
+        outlive it — release capacity, unbind fairness, and fail the
+        submission so local and remote waiters unblock instead of
+        riding their timeout."""
+        self._settle(sub, error="taskpool aborted (rank eviction)")
+
+    def _settle(self, sub: Submission, error: Optional[str]) -> None:
         lat_us = (time.monotonic_ns() - sub.t_submit_ns) / 1e3
         sub.lat_us = lat_us
         tp = sub.taskpool
         if tp is not None:
             self.fairness.release_pool(tp.taskpool_id)
+        # aborted pools still charge virtual runtime: an always-failing
+        # tenant must not accrue an unbounded deficit boost over
+        # healthy ones
         self.fairness.note_done(sub.tenant, sub.ntasks)
-        live = getattr(self.ctx.obs, "live", None)
-        if live is not None:
-            live.note_tenant_latency(sub.tenant, lat_us)
-        promoted: List[Submission] = []
-        with self._lock:
-            t = self._tenants.get(sub.tenant)
-            if t is not None:
-                t.inflight_pools = max(0, t.inflight_pools - 1)
-                t.inflight_tasks = max(0, t.inflight_tasks - sub.ntasks)
-                t.inflight_bytes = max(0, t.inflight_bytes - sub.nbytes)
-                t.pools_done += 1
-                t.lat_us.append(lat_us)
-                # drain the tenant's queue head(s) that now fit
-                while t.queued:
-                    nxt = t.queued[0]
-                    if self._admit_locked(t, nxt) != "admit":
-                        break
-                    t.queued.popleft()
-                    self._charge_locked(t, nxt)
-                    promoted.append(nxt)
-        self._finish(sub, error=None)
-        for nxt in promoted:
-            self.ctx.sde.inc(SERVE_ADMITTED)
-            self._launch(nxt)
+        if error is None:
+            live = getattr(self.ctx.obs, "live", None)
+            if live is not None:
+                live.note_tenant_latency(sub.tenant, lat_us)
+        promoted = self._release(sub, completed=error is None)
+        self._finish(sub, error)
+        self._launch_promoted(promoted)
 
     def _finish(self, sub: Submission, error: Optional[str]) -> None:
         sub.error = error
@@ -493,10 +587,18 @@ class SessionServer:
             self._closed = True
             tenants = list(self._tenants.values())
             self._tenants.clear()
+            self._nq = 0
+        for mp in self._hooked_mempools:
+            if getattr(mp, "on_free", None) == self.kick:
+                mp.on_free = None
+        self._hooked_mempools = []
         for t in tenants:
             self.fairness.forget(t.name)
             for gname, fn in t._gauges:
                 self.ctx.sde.unregister(gname, fn)
+            for sub in t.queued:
+                self._finish(sub, error="server closed")
+            t.queued.clear()
         self.ctx.sde.unregister(SERVE_TENANTS)
         self.ctx.serve_fairness = None
         ce = getattr(self.ctx.comm, "ce", self.ctx.comm) \

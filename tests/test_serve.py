@@ -115,6 +115,102 @@ def test_local_admission_errors(ctx):
         srv.submit("t", lambda: None)
 
 
+def test_failed_build_releases_admission(ctx):
+    """A submission whose build raises must un-charge the tenant's
+    in-flight counters (capacity would otherwise leak forever) and
+    still drain the queue."""
+    done = [0]
+    with SessionServer(ctx, admission="queue") as srv:
+        srv.open_tenant("t", max_pools=1)
+
+        def boom():
+            raise RuntimeError("nope")
+
+        bad = srv.submit("t", boom)
+        assert bad.wait(10), "failed build must finish the submission"
+        assert bad.error and "build failed" in bad.error
+        st = srv.stats()["tenants"]["t"]
+        assert st["inflight_pools"] == 0 and st["queued"] == 0
+        # capacity actually came back: the next submission admits + runs
+        ok = srv.submit("t", _count_build(ctx, done, n_tasks=2), ntasks=2)
+        assert ok.wait(30) and ok.error is None
+    assert done[0] == 2
+
+
+def test_abort_releases_admission_and_promotes_queue(ctx):
+    """Taskpool.abort (FT eviction) must run the serve abort hook:
+    charges release, the submission fails (waiters unblock), and the
+    tenant's queued work is promoted."""
+    gate = threading.Event()
+
+    def gated_build():
+        tp = dtd.taskpool_new()
+
+        def body(es, task):
+            gate.wait(10)
+
+        tp.insert_task(body, (0, VALUE))
+        return tp
+
+    done2 = [0]
+    with SessionServer(ctx, admission="queue") as srv:
+        srv.open_tenant("t", max_pools=1)
+        sub1 = srv.submit("t", gated_build)
+        sub2 = srv.submit("t", _count_build(ctx, done2, n_tasks=2))
+        assert srv.stats()["tenants"]["t"]["queued"] == 1
+        sub1.taskpool.abort()
+        assert sub1.wait(10), "abort must finish the submission"
+        assert sub1.error and "abort" in sub1.error
+        gate.set()                     # release the parked worker
+        assert sub2.wait(30), "queued pool must promote on abort"
+        assert sub2.error is None and done2[0] == 2
+        st = srv.stats()["tenants"]["t"]
+        assert st["inflight_pools"] == 0 and st["queued"] == 0
+    gate.set()
+
+
+class _Tile:
+    """Attribute-capable mempool element (owner back-pointer rides it)."""
+
+
+def test_mempool_free_kicks_queued_submission(ctx):
+    """A submission queued on the Mempool-fed byte quota while the
+    tenant has ZERO in-flight pools has no _pool_done event to drain
+    it — the bound pool's free path must kick re-admission."""
+    from parsec_tpu.core.mempool import Mempool
+    mp = Mempool(_Tile)
+    done = [0]
+    with SessionServer(ctx, admission="queue") as srv:
+        srv.open_tenant("t", quota_bytes=100)
+        srv.bind_mempool("t", mp, item_bytes=60)
+        elt = mp.allocate()            # 60 outstanding bytes
+        sub = srv.submit("t", _count_build(ctx, done, n_tasks=2),
+                         nbytes=50)    # 60 + 50 > 100 -> queued
+        assert srv.stats()["tenants"]["t"]["queued"] == 1
+        mp.free(elt)                   # headroom appears -> kick drains
+        assert sub.wait(30), "mempool free must re-admit queued work"
+        assert sub.error is None and done[0] == 2
+    assert mp.on_free is None          # close() unhooks the pool
+
+
+def test_latency_window_knob_sizes_rings(ctx):
+    """serve_latency_window must actually size the per-tenant latency
+    rings in both the server and the live monitor."""
+    with params.cmdline_override("serve_latency_window", "3"):
+        srv = SessionServer(ctx)
+        try:
+            t = srv.open_tenant("t")
+            assert t.lat_us.maxlen == 3
+        finally:
+            srv.close()
+        lh = LiveHealth(rank=0)
+        assert lh.TENANT_LAT_RING == 3
+        for us in (1.0, 2.0, 3.0, 4.0):
+            lh.note_tenant_latency("t", us)
+        assert lh._tenants["t"]["lat"].maxlen == 3
+        assert list(lh._tenants["t"]["lat"]) == [2.0, 3.0, 4.0]
+
+
 # ---------------------------------------------------------------------- #
 # remote client over the AM layer                                        #
 # ---------------------------------------------------------------------- #
@@ -192,6 +288,36 @@ def test_remote_capability_gate(ctx):
         stop.set()
         th.join(5)
         srv.close()
+
+
+def test_serve_client_owns_reply_tag_exclusively():
+    """The engine keeps one handler per tag: a second ServeClient
+    would silently detach the first, so construction refuses until the
+    first is closed; close() also fails parked callers promptly."""
+    fabric = LocalFabric(2)
+    e1 = fabric.engine(1)
+    c1 = ServeClient(e1, server_rank=0, timeout=30.0)
+    with pytest.raises(RuntimeError, match="one ServeClient per engine"):
+        ServeClient(e1, server_rank=0)
+    errs = []
+
+    def _blocked():
+        try:
+            c1.stats()                 # no server attached: never replies
+        except Exception as exc:       # noqa: BLE001
+            errs.append(exc)
+
+    th = threading.Thread(target=_blocked, daemon=True)
+    th.start()
+    time.sleep(0.05)
+    c1.close()
+    th.join(5)
+    assert errs and "closed" in str(errs[0])
+    with pytest.raises(RuntimeError, match="closed"):
+        c1.stats()
+    # the tag is free again: a successor attaches cleanly
+    with ServeClient(e1, server_rank=0) as c2:
+        assert c2 is not None
 
 
 # ---------------------------------------------------------------------- #
