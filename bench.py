@@ -78,6 +78,15 @@ Execution modes (BENCH_MODE):
   share.  The serve-knob wire differential (a ``serve``-on rank's data
   frames toward a knob-unset peer must be bit-identical to the unset
   legs) rides the ``trace`` capture-identity differential.
+- ``dplane``: device-plane transport + redistribution planner (ISSUE
+  19) — the SAME whole-matrix P x 1 -> 1 x Q reshard over real TCP
+  engines three ways (per-tile DTD GET storm; ``xfer_collective_
+  redist`` planned alltoall rounds; planned + ``xfer_dplane`` with the
+  loopback transfer backend carrying the bulk payload off the session
+  wire), scrubbed CPU subprocess; reports per-leg wall / host-wire
+  bytes / MB/s, round+transfer counts vs the per-tile move count,
+  bit-identity across all legs, and the two-level vs flat lane-reduce
+  timing at equal codec semantics.
 
 Every record carries ``schema_version`` + stable ``metric_id``/``mode``
 /``n``/``nb``/``dtype`` fields (schema 2): r01-r05 changed metric
@@ -780,6 +789,13 @@ def bench_all(n, nb, reps, cores, dtype):
         hl = _try("health", lambda: bench_health())
         if hl is not None:
             extras.update(hl)
+    # device-plane + redistribution planner (ISSUE 19): GET storm vs
+    # planned alltoall reshard vs device-plane payload route, plus the
+    # two-level vs flat lane reduce — scrubbed CPU subprocess
+    if os.environ.get("BENCH_DPLANE", "1") != "0":
+        dp = _try("dplane", lambda: bench_dplane())
+        if dp is not None:
+            extras.update(dp)
     # multi-tenant serving (ISSUE 18): weighted-fair latency tenant vs
     # a bulk saturator on one persistent context — scrubbed CPU
     # subprocess, link-independent
@@ -1905,6 +1921,201 @@ def bench_qwire(n=256, nb=64, delay_ms=2) -> dict:
 
 
 # ---------------------------------------------------------------------- #
+# device-plane + redistribution planner benchmark (ISSUE 19): the        #
+# per-tile GET storm vs the planned alltoall reshard vs the device-plane #
+# payload route, plus the two-level vs flat lane reduce                  #
+# ---------------------------------------------------------------------- #
+def bench_dplane_inner(n=64, tile=8, ranks=4) -> dict:
+    """BENCH_MODE=dplane payload: the SAME whole-matrix P x 1 -> 1 x Q
+    reshard of an ``n x n`` f64 matrix over REAL loopback TCP engines,
+    three legs:
+
+    - storm: classic DTD redistribute (one task + GET rendezvous per
+      target tile) — the per-tile baseline;
+    - planned: ``xfer_collective_redist`` routes the same reshard
+      through the xfer/plan.py alltoall rounds (same-(src,dst) tiles
+      coalesced into one transfer each);
+    - dplane: planned + ``xfer_dplane`` with a DeviceDataPlane on the
+      loopback transfer backend — bulk payload leaves the session
+      wire, only descriptor/ack control rides it.
+
+    Reports per leg: wall, host-TCP wire bytes (the engine fabric's
+    ``bytes_count`` delta around the reshard), reshard MB/s over the
+    logical payload volume, and for the planner legs the round/
+    transfer counts vs the per-tile move count.  All three legs must
+    land BIT-IDENTICAL tiles (reshard traffic is lossless by
+    contract).  A fourth, link-free leg times the hierarchical
+    ``two_level_allreduce`` against the flat quantize-every-
+    contribution reduction at equal residual semantics (both land the
+    wire-exact bf16 codec; the hierarchy pays ONE boundary hop per
+    group instead of one per contribution)."""
+    import concurrent.futures as cf
+    from contextlib import ExitStack
+
+    import parsec_tpu
+    from parsec_tpu.collections import TwoDimBlockCyclic
+    from parsec_tpu.collections.redistribute import redistribute
+    from parsec_tpu.comm import RemoteDepEngine
+    from parsec_tpu.comm.tcp import TCPCommEngine, free_ports
+    from parsec_tpu.utils.params import params as _params
+    from parsec_tpu.xfer import build_plan
+
+    src_np = np.random.RandomState(19).rand(n, n)
+    payload_mb = src_np.nbytes / 1e6
+
+    def leg(knobs, attach_plane=False):
+        import threading as _threading
+        ports = free_ports(ranks)
+        eps = [("127.0.0.1", p) for p in ports]
+        barrier = _threading.Barrier(ranks)
+        with ExitStack() as st:
+            for k, v in knobs.items():
+                st.enter_context(_params.cmdline_override(k, v))
+
+            def rank_fn(r):
+                ce = TCPCommEngine(r, eps)
+                eng = RemoteDepEngine(ce)
+                ctx = parsec_tpu.Context(nb_cores=1, comm=eng,
+                                         enable_tpu=False)
+                try:
+                    if attach_plane:
+                        from parsec_tpu.comm.xfer import DeviceDataPlane
+                        DeviceDataPlane(ce).exchange(timeout=60.0)
+                    Y = TwoDimBlockCyclic(
+                        n, n, tile, tile, P=ranks, Q=1, nodes=ranks,
+                        rank=r, dtype=np.float64).from_numpy(src_np)
+                    T = TwoDimBlockCyclic(
+                        n, n, tile, tile, P=1, Q=ranks, nodes=ranks,
+                        rank=r, dtype=np.float64).from_numpy(
+                            np.zeros((n, n)))
+                    barrier.wait(60)
+                    b0 = ce.fabric.bytes_count
+                    t0 = time.perf_counter()
+                    tp = redistribute(Y, T, n, n, context=ctx)
+                    wall = time.perf_counter() - t0
+                    barrier.wait(60)   # both directions fully flushed
+                    stats = {
+                        "wall": wall,
+                        "host_wire_bytes": ce.fabric.bytes_count - b0,
+                        "rounds": getattr(tp, "redist_rounds", 0),
+                        "transfers": getattr(tp, "redist_transfers", 0),
+                        "dplane": dict(ce.dplane_stats),
+                    }
+                    owned = {c: np.array(T.tile(*c))
+                             for c in T.local_tiles()}
+                    return stats, owned
+                finally:
+                    ctx.fini()
+
+            with cf.ThreadPoolExecutor(ranks) as ex:
+                results = list(ex.map(rank_fn, range(ranks)))
+        got = np.zeros((n, n))
+        for (_s, owned) in results:
+            for (m, k), t in owned.items():
+                got[m * tile:m * tile + t.shape[0],
+                    k * tile:k * tile + t.shape[1]] = t
+        agg = {
+            "wall_s": round(max(s["wall"] for s, _o in results), 4),
+            "host_wire_bytes": sum(s["host_wire_bytes"]
+                                   for s, _o in results),
+            "rounds": max(s["rounds"] for s, _o in results),
+            "transfers": max(s["transfers"] for s, _o in results),
+            "dplane_xfers": sum(s["dplane"]["dplane_xfers"]
+                                for s, _o in results),
+            "dplane_bytes": sum(s["dplane"]["dplane_bytes"]
+                                for s, _o in results),
+            "mb_s": round(payload_mb
+                          / max(max(s["wall"] for s, _o in results),
+                                1e-9), 1),
+        }
+        return agg, got
+
+    # the per-tile transfer count the storm pays — a pure function of
+    # the two distributions, identical for every leg
+    plan = build_plan(
+        TwoDimBlockCyclic(n, n, tile, tile, P=ranks, Q=1, nodes=ranks),
+        TwoDimBlockCyclic(n, n, tile, tile, P=1, Q=ranks, nodes=ranks))
+    out = {"dplane_n": n, "dplane_tile": tile, "dplane_ranks": ranks,
+           "tile_moves": plan.tile_moves,
+           "plan_rounds": plan.n_rounds,
+           "plan_transfers": plan.n_transfers}
+
+    storm, got_storm = leg({})
+    planned, got_planned = leg({"xfer_collective_redist": "1"})
+    dplane, got_dplane = leg({"xfer_collective_redist": "1",
+                              "xfer_dplane": "1",
+                              "xfer_backend": "loopback"},
+                             attach_plane=True)
+    out.update({f"storm_{k}": v for k, v in storm.items()
+                if not k.startswith(("rounds", "transfers", "dplane"))})
+    out.update({f"planned_{k}": v for k, v in planned.items()})
+    out.update({f"dplane_{k}": v for k, v in dplane.items()})
+    out["storm_bit_identical"] = bool(np.array_equal(got_storm, src_np))
+    out["planned_bit_identical"] = bool(
+        np.array_equal(got_planned, src_np))
+    out["dplane_bit_identical"] = bool(np.array_equal(got_dplane, src_np))
+    out["planned_bytes_vs_storm"] = round(
+        planned["host_wire_bytes"] / max(1, storm["host_wire_bytes"]), 4)
+    out["dplane_host_bytes_vs_planned"] = round(
+        dplane["host_wire_bytes"]
+        / max(1, planned["host_wire_bytes"]), 4)
+
+    # link-free two-level vs flat lane reduce at equal codec semantics
+    from parsec_tpu.parallel.mesh import (reduced_precision_sum,
+                                          two_level_allreduce)
+    rng = np.random.RandomState(23)
+    shards = [rng.randn(1 << 18).astype(np.float32) for _ in range(8)]
+    g = 2
+    reduced_precision_sum(shards[:2], "bf16")          # jit warmup
+    t0 = time.perf_counter()
+    flat = reduced_precision_sum(shards, "bf16")
+    flat_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    two = two_level_allreduce(shards, g, "bf16")
+    two_s = time.perf_counter() - t0
+    out["twolevel_flat_ms"] = round(flat_s * 1e3, 2)
+    out["twolevel_ms"] = round(two_s * 1e3, 2)
+    out["twolevel_flat_qdq_hops"] = len(shards)
+    out["twolevel_qdq_hops"] = (len(shards) + g - 1) // g
+    out["twolevel_results_differ"] = bool(not np.array_equal(flat, two))
+    return out
+
+
+_DPLANE_DRIVER = r"""
+import json, os, sys
+sys.path.insert(0, os.environ["BENCH_REPO"])
+import bench
+
+print(json.dumps(bench.bench_dplane_inner(
+    n=int(os.environ.get("BENCH_DPLANE_N", "64")),
+    tile=int(os.environ.get("BENCH_DPLANE_TILE", "8")),
+    ranks=int(os.environ.get("BENCH_DPLANE_RANKS", "4")))))
+"""
+
+
+def bench_dplane(n=64, tile=8, ranks=4) -> dict:
+    """BENCH_MODE=dplane: the reshard legs in a scrubbed CPU
+    subprocess (same pattern as bench_qwire: numbers must not depend
+    on the tunnel session's TPU plugin)."""
+    import subprocess
+    import sys as _sys
+
+    env = _scrubbed_bench_env(
+        n_devices=2,
+        BENCH_DPLANE_N=n, BENCH_DPLANE_TILE=tile,
+        BENCH_DPLANE_RANKS=ranks)
+    try:
+        p = subprocess.run([_sys.executable, "-c", _DPLANE_DRIVER],
+                           env=env, capture_output=True, text=True,
+                           timeout=1200)
+        if p.returncode != 0:
+            return {"dplane_error": p.stdout[-200:] + p.stderr[-200:]}
+        return json.loads(p.stdout.strip().splitlines()[-1])
+    except Exception as exc:  # noqa: BLE001
+        return {"dplane_error": repr(exc)[:200]}
+
+
+# ---------------------------------------------------------------------- #
 # cross-rank flow tracing benchmark (ISSUE 15): throttled-TCP dpotrf,    #
 # obs_flow off vs on + the knob-unset wire byte-capture differential     #
 # ---------------------------------------------------------------------- #
@@ -1944,6 +2155,11 @@ def bench_trace_capture_identity() -> dict:
       advertises ``"sv"`` (nor ``"lv"``), so neither tenant-extended
       trace contexts nor serve control frames may travel and rank 0's
       data frames stay byte-identical to the unset legs.
+    - G (ISSUE 19): ``xfer_dplane`` SET on rank 0 only — the device
+      data plane's knob: rank 1 never advertises ``"dp"``, so the link
+      negotiates DOWN to the session wire and rank 0's data frames
+      stay byte-identical to the unset legs (no transfer-server
+      address exchange, no descriptor envelopes).
     """
     import threading as _threading
     from contextlib import ExitStack
@@ -1956,7 +2172,8 @@ def bench_trace_capture_identity() -> dict:
 
     chunk = 4096
 
-    def leg(flow_r0, live_r0=False, tune_r0=False, serve_r0=False):
+    def leg(flow_r0, live_r0=False, tune_r0=False, serve_r0=False,
+            dplane_r0=False):
         captured = {}
         orig = tcpmod._sendall_vec
 
@@ -1982,7 +2199,8 @@ def bench_trace_capture_identity() -> dict:
                         r, eps, obs_flow=(flow_r0 and r == 0),
                         obs_live=(live_r0 and r == 0),
                         tune_auto=(tune_r0 and r == 0),
-                        serve=(serve_r0 and r == 0))
+                        serve=(serve_r0 and r == 0),
+                        dplane=(dplane_r0 and r == 0))
                 ts = [_threading.Thread(target=boot, args=(r,))
                       for r in (0, 1)]
                 for t in ts:
@@ -2056,6 +2274,7 @@ def bench_trace_capture_identity() -> dict:
     d = leg(False, live_r0=True)
     e = leg(False, tune_r0=True)
     f = leg(False, serve_r0=True)
+    g = leg(False, dplane_r0=True)
     return {
         "trace_frames_captured": len(a),
         "trace_unset_bit_identical": bool(a and a == b),
@@ -2063,6 +2282,7 @@ def bench_trace_capture_identity() -> dict:
         "live_mixed_version_bit_identical": bool(a and a == d),
         "tune_mixed_version_bit_identical": bool(a and a == e),
         "serve_mixed_version_bit_identical": bool(a and a == f),
+        "dplane_mixed_version_bit_identical": bool(a and a == g),
     }
 
 
@@ -3220,6 +3440,17 @@ def main() -> None:
             "metric_id": "serve_weighted_p99_vs_fifo", "mode": mode,
             "value": extras.get("serve_weighted_p99_vs_fifo", -1.0),
             "unit": "x", "extras": extras})
+        return
+    if mode == "dplane":
+        extras = bench_dplane(
+            n=int(os.environ.get("BENCH_DPLANE_N", "64")),
+            tile=int(os.environ.get("BENCH_DPLANE_TILE", "8")),
+            ranks=int(os.environ.get("BENCH_DPLANE_RANKS", "4")))
+        emit_json({
+            "metric": "redist_planned_bytes_vs_storm(tcp_reshard)",
+            "metric_id": "redist_planned_bytes_vs_storm", "mode": mode,
+            "value": extras.get("planned_bytes_vs_storm", -1.0),
+            "unit": "fraction", "extras": extras})
         return
     if mode == "health":
         extras = bench_health(

@@ -81,6 +81,17 @@ def _whole_matrix_applicable(source: TiledMatrix, target: TiledMatrix,
             and size_row == source.lm and size_col == source.ln)
 
 
+def _engine_of(context: Any) -> Optional[Any]:
+    """The rank's comm engine, unwrapped from a Context's RemoteDep
+    layer (mirrors ft/elastic._engine_of)."""
+    if context is None:
+        return None
+    comm = getattr(context, "comm", None)
+    if comm is None:
+        return None
+    return getattr(comm, "ce", comm)
+
+
 def redistribute(source: TiledMatrix, target: TiledMatrix,
                  size_row: int, size_col: int,
                  disi_Y: int = 0, disj_Y: int = 0,
@@ -128,6 +139,22 @@ def redistribute(source: TiledMatrix, target: TiledMatrix,
         raise ValueError(
             "redistribute() needs a context (fresh pool, enqueued + waited) "
             "or an existing taskpool to compose into")
+    # collective-planner fast path (xfer/plan.py, ISSUE 19): behind the
+    # ``xfer_collective_redist`` knob the whole-matrix same-grid reshard
+    # (the checkpoint-reshard shape — ft/elastic.py rides this call) is
+    # compiled into coalesced alltoall rounds and executed directly over
+    # the comm engine instead of one DTD task per target tile.  Only on
+    # a fresh pool (``taskpool`` composition keeps DTD ordering) and
+    # only multi-rank — a single participant has nothing to coalesce.
+    if (taskpool is None and allow_reshuffle
+            and _whole_matrix_applicable(source, target, size_row, size_col,
+                                         disi_Y, disj_Y, disi_T, disj_T)):
+        from ..utils.params import params
+        if params.get_or("xfer_collective_redist", "bool", False):
+            ce = _engine_of(context)
+            if ce is not None and getattr(ce, "nb_ranks", 1) > 1:
+                from ..xfer.plan import run_redistribution
+                return run_redistribution(source, target, ce, tiles=tiles)
     tp = taskpool if taskpool is not None else dtd.taskpool_new(
         name=f"redistribute_{source.lm}x{source.ln}")
     # redistribution is pure data MOVEMENT — checkpoint-reshard restores

@@ -176,6 +176,13 @@ class CommEngine:
         self.elastic_stats: Dict[str, int] = {
             "elastic_resizes": 0, "reshard_bytes": 0, "reshard_us": 0,
             "elastic_joins": 0}
+        #: device-plane / planned-redistribution counters (xfer/, comm/
+        #: xfer.py); polled by obs.register_engine_gauges as the
+        #: COMM::DPLANE_* / COMM::REDIST_ROUNDS / COMM::TWO_LEVEL_*
+        #: gauges — plain dict, bumped off the hot path
+        self.dplane_stats: Dict[str, int] = {
+            "dplane_bytes": 0, "dplane_xfers": 0, "redist_rounds": 0,
+            "two_level_reduces": 0}
         #: injected-kill flag: the engine has gone dark (drops all
         #: traffic, answers no heartbeats) — simulates a crashed process
         self._ft_silenced = False
@@ -302,6 +309,15 @@ class CommEngine:
         TCP engine gates on the peer's HELLO ``"sv"`` capability so a
         live-only receiver never sees a 5-tuple."""
         return True
+
+    def dplane_to(self, dst: int) -> bool:
+        """May bulk payload bytes toward ``dst`` ride the device plane
+        (ISSUE 19)?  In-process fabrics: yes whenever a plane is
+        attached (same build both ends); the TCP engine additionally
+        gates on the peer's HELLO ``"dp"`` capability — both ends must
+        run with ``xfer_dplane`` set, or the bytes stay on the session
+        wire exactly as a knob-unset build would send them."""
+        return getattr(self, "device_plane", None) is not None
 
     def _flow_stamp(self, dst: int, tag: int,
                     payload: Any) -> Tuple[Any, Optional[Tuple[int, int]]]:

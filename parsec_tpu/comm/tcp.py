@@ -234,7 +234,7 @@ class _Peer:
                  "rs_rx_partial", "rx_xfers", "recv_thread", "rs_dup_next",
                  "rs_resuming", "qz_codec", "q_pre", "q_post",
                  "comp_pre", "comp_post", "tn_ok", "qrx_pre", "qrx_post",
-                 "sv_ok")
+                 "sv_ok", "dp_ok")
 
     def __init__(self, rank: int, sock: socket.socket) -> None:
         self.rank = rank
@@ -263,6 +263,7 @@ class _Peer:
         self.lv_ok = False         # HELLO advertised obs_live ("lv")
         self.tn_ok = False         # HELLO advertised runtime tuning ("tn")
         self.sv_ok = False         # HELLO advertised serving ("sv")
+        self.dp_ok = False         # HELLO advertised device plane ("dp")
         # -- closed-loop tuning (ISSUE 17) ------------------------------
         self.qrx_pre = 0           # raw bytes of RECEIVED quantized bufs
         self.qrx_post = 0          # encoded bytes that landed for them
@@ -317,7 +318,8 @@ class TCPCommEngine(LocalCommEngine):
                  obs_flow: Optional[bool] = None,
                  obs_live: Optional[bool] = None,
                  tune_auto: Optional[bool] = None,
-                 serve: Optional[bool] = None) -> None:
+                 serve: Optional[bool] = None,
+                 dplane: Optional[bool] = None) -> None:
         from ..utils.params import params
         self._inbox: Fifo = Fifo()
         # GET tokens whose reply has ARRIVED (pushed to the inbox by a
@@ -425,6 +427,15 @@ class TCPCommEngine(LocalCommEngine):
         # wire bytes exactly what the unset build would produce.
         if serve is None:
             serve = bool(params.get_or("serve", "bool", False))
+        # device-plane transport (ISSUE 19): a symmetric "dp" capability
+        # — bulk planner payloads toward dp-peers may ride an attached
+        # DeviceDataPlane (descriptor/ack control stays on the session
+        # wire, so replay and flap semantics are untouched).  Unset on
+        # EITHER end keeps every wire byte, HELLO included, bit-for-bit
+        # what the unset build would send.
+        if dplane is None:
+            dplane = bool(params.get_or("xfer_dplane", "bool", False))
+        self._dp_enabled = bool(dplane)
         self._serve_enabled = bool(serve)
         self._tune_enabled = bool(tune_auto)
         self._live_enabled = (bool(obs_live) or self._tune_enabled
@@ -583,6 +594,13 @@ class TCPCommEngine(LocalCommEngine):
             # HELLO stays bit-identical and a mixed-version peer never
             # sees a 5-tuple or a serve control frame
             info["sv"] = True
+        if self._dp_enabled:
+            # device-plane transport (ISSUE 19): this end may pull bulk
+            # planner payloads over an attached DeviceDataPlane — gated
+            # like "tr"/"lv"/"tn"/"sv" so an unset knob's HELLO stays
+            # bit-identical and a mixed-version peer's bulk bytes stay
+            # on the session wire
+            info["dp"] = True
         if self._quantize is not None:
             # quantized codecs are advertised ONLY when the local knob
             # is set — symmetric like "rs", so a knob-unset build keeps
@@ -752,6 +770,14 @@ class TCPCommEngine(LocalCommEngine):
                 except Exception:  # noqa: BLE001 - sampling must not die
                     pass
 
+    def mesh_local_with(self, peer: int) -> bool:
+        """Cross-process ranks NEVER share an XLA client — the
+        in-process fabric's ship-by-reference fast path (inherited from
+        LocalCommEngine) must not fire here, or device-array payloads
+        get silently pickled inside the activation instead of riding
+        the device plane / GET rendezvous."""
+        return False
+
     def flow_to(self, dst: int) -> bool:
         """Trace contexts travel only toward peers whose HELLO
         advertised ``"tr"`` — a mixed-version (or knob-unset) peer
@@ -776,6 +802,18 @@ class TCPCommEngine(LocalCommEngine):
         with self._conn_cond:
             p = self._peers.get(dst)
         return p is not None and p.sv_ok
+
+    def dplane_to(self, dst: int) -> bool:
+        """Bulk planner payloads toward ``dst`` may leave the session
+        wire for the device plane only when a plane is attached AND the
+        peer's HELLO advertised ``"dp"`` (ISSUE 19) — a mixed-version
+        or knob-unset peer keeps receiving the full payload on the
+        session wire, byte-identical to an unset build."""
+        if getattr(self, "device_plane", None) is None:
+            return False
+        with self._conn_cond:
+            p = self._peers.get(dst)
+        return p is not None and p.dp_ok
 
     # -- reliable sessions (ISSUE 10) -----------------------------------
     def peer_suspect(self, peer: int) -> bool:
@@ -2004,6 +2042,10 @@ class TCPCommEngine(LocalCommEngine):
             # contexts (and serve control AMs) travel only on links
             # whose BOTH ends run with the serve knob set
             p.sv_ok = bool(info.get("sv")) and self._serve_enabled
+            # the device plane is symmetric the same way: bulk planner
+            # payloads leave the session wire only on links whose BOTH
+            # ends run with xfer_dplane set (and a plane attached)
+            p.dp_ok = bool(info.get("dp")) and self._dp_enabled
             with p.cond:
                 # quantize capability is symmetric like "rs": only a
                 # peer that advertised the requested codec under "qz"

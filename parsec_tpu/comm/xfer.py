@@ -42,6 +42,30 @@ TAG_XFER_ACK = TAG_USER_BASE - 3   # consumer pulled: release the park
 from ..data.data import is_device_array as _is_device_array  # noqa: E402,F401
 
 
+def _resolve_backend(backend: Optional[str] = None) -> Tuple[Any, str]:
+    """Pick the transfer-server implementation.  MCA ``xfer_backend``:
+    ``native`` requires ``jax.experimental.transfer`` (TPU/GPU builds),
+    ``loopback`` forces the in-process socket backend
+    (parsec_tpu/xfer/loopback.py — what CI runs), ``auto`` (default)
+    prefers native and falls back exactly when the jax API is absent,
+    so the same DeviceDataPlane code path runs everywhere."""
+    if backend is None:
+        from ..utils.params import params
+        backend = str(params.get_or("xfer_backend", "string", "auto"))
+    if backend not in ("auto", "native", "loopback"):
+        raise ValueError(f"xfer_backend={backend!r}: expected "
+                         f"auto/native/loopback")
+    if backend != "loopback":
+        try:
+            from jax.experimental import transfer
+            return transfer, "native"
+        except ImportError:
+            if backend == "native":
+                raise
+    from ..xfer import loopback
+    return loopback, "loopback"
+
+
 class DeviceDataPlane:
     """One per rank: a transfer server + connections to the peers.
 
@@ -51,10 +75,11 @@ class DeviceDataPlane:
     memory (async — jax arrays materialize when the transfer lands).
     """
 
-    def __init__(self, ce, device=None, host: str = "127.0.0.1") -> None:
+    def __init__(self, ce, device=None, host: str = "127.0.0.1",
+                 backend: Optional[str] = None) -> None:
         import jax
-        from jax.experimental import transfer
 
+        transfer, self.backend_name = _resolve_backend(backend)
         self.ce = ce
         self.device = device if device is not None else jax.devices()[0]
         # separate bulk-transport sockets are REQUIRED: without explicit
@@ -108,6 +133,10 @@ class DeviceDataPlane:
         with self._lock:
             self._parked.pop(uuid, None)
 
+    def is_parked(self, uuid: int) -> bool:
+        with self._lock:
+            return uuid in self._parked
+
     def pull(self, src_rank: int, uuid: int, shape: Tuple,
              dtype: str, device=None) -> Any:
         """Fetch a parked array from ``src_rank`` device-to-device;
@@ -143,15 +172,24 @@ class DeviceDataPlane:
             sharding=SingleDeviceSharding(
                 device if device is not None else self.device))
         out = conn.pull(uuid, [spec])[0]
+        nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
         with self._lock:
             self.stats["pulls"] += 1
-            self.stats["bytes_pulled"] += (int(np.prod(shape))
-                                           * np.dtype(dtype).itemsize)
+            self.stats["bytes_pulled"] += nbytes
+        # DPLANE_BYTES / DPLANE_XFERS gauges (obs.register_engine_gauges
+        # polls the engine-owned dict; observability only — no wire bytes)
+        ds = getattr(self.ce, "dplane_stats", None)
+        if ds is not None:
+            ds["dplane_xfers"] += 1
+            ds["dplane_bytes"] += nbytes
         return out
 
     def fini(self) -> None:
         with self._lock:
             self._parked.clear()
         self._conns.clear()
+        closer = getattr(self.server, "close", None)
+        if callable(closer):   # the native server may not expose close
+            closer()
         plog.debug.verbose(3, "device plane rank %d: %s", self.ce.rank,
                            self.stats)

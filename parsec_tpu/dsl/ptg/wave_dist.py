@@ -283,7 +283,8 @@ class _CollectiveLane:
     def __init__(self, mode: str, nb_ranks: int, rank: int,
                  rendezvous=None, timeout: float = 120.0,
                  dead_fn=None, devices=None,
-                 reduce_dtype: Optional[str] = None) -> None:
+                 reduce_dtype: Optional[str] = None,
+                 shared_feedback=None, stats=None) -> None:
         import jax
 
         self.mode = mode
@@ -306,6 +307,32 @@ class _CollectiveLane:
         self._qcodec = _wire.normalize_quant_codec(reduce_dtype or "")
         self._efb = ErrorFeedback()
         self.quantized_reduces = 0
+        # hierarchical reduction (ISSUE 19, ``xfer_collective_redist``):
+        # instead of quantizing EVERY contribution at the boundary,
+        # deposits stay full precision and the issuer reduces through
+        # parallel/mesh.two_level_allreduce — full-precision partial
+        # sums inside each ``xfer_group_size``-wide group (the intra-
+        # mesh psum on real chips), one jit-native qdq hop per GROUP at
+        # the inter-group boundary. Fewer quantize events, strictly
+        # less rounding, same wire-exact codec. A pure function of
+        # params + contribution dtype + member count, so every SPMD
+        # depositor derives the same routing for the same collective.
+        # ``shared_feedback`` (fabric-owned, _setup_collective_lane)
+        # keeps the per-group residual in ONE place no matter which
+        # rank thread happens to issue; ``stats`` mirrors issue counts
+        # into the engine-owned dplane_stats gauges.
+        from ...utils.params import params as _params
+        self._two_level = bool(_params.get_or(
+            "xfer_collective_redist", "bool", False))
+        gs = int(_params.get_or("xfer_group_size", "int", 0))
+        if gs <= 0:
+            geom = _rank_mesh_geometry()
+            gs = geom[0] * geom[1] if geom is not None else 2
+        self._group_size = max(2, gs)
+        self._efb_shared = (shared_feedback if shared_feedback
+                            is not None else ErrorFeedback())
+        self._stats = stats
+        self.two_level_reduces = 0
         # liveness probe for the rendezvous wait (ft/): a callable
         # returning the CE's dead_peers so an evicted member aborts the
         # collective NOW instead of burning the whole timeout
@@ -368,6 +395,20 @@ class _CollectiveLane:
         self.quantized_reduces += 1
         return out
 
+    def _two_level_issue(self, deposits, fb_key):
+        """Issuer-side hierarchical reduction: strip the rank axis off
+        every deposit, partial-sum full precision inside each group,
+        quantize once per group at the boundary through the jit-native
+        qdq hop, sum the partials. Error feedback keys per (fb_key,
+        group) live in the FABRIC-shared accumulator, so the residual
+        carry is identical no matter which rank thread issues."""
+        from ...parallel.mesh import two_level_allreduce
+        shards = [np.asarray(d)[0] for d in deposits]
+        return two_level_allreduce(
+            shards, self._group_size, self._qcodec,
+            feedback=self._efb_shared if fb_key is not None else None,
+            key=fb_key, native=True)
+
     def reduce(self, key: Tuple, contrib,
                members: Optional[Tuple[int, ...]] = None,
                fb_key=None) -> Any:
@@ -382,10 +423,19 @@ class _CollectiveLane:
         reduced-precision lane (see __init__; None = quantize-only)."""
         import jax
 
-        if self._qcodec is not None:
-            contrib = self._quantize_contrib(contrib, fb_key)
         full = members is None or len(members) == self.nb_ranks
         parts = tuple(range(self.nb_ranks)) if full else members
+        # two-level routing decision — SPMD-pure (params + dtype +
+        # member count), so depositors and issuer always agree on
+        # whether deposits are full precision or pre-quantized
+        two_level = (self._qcodec is not None and self._two_level
+                     and self.mode != "multiproc"
+                     and np.dtype(getattr(contrib, "dtype",
+                                          np.float32)).name
+                     in ("float32", "float64")
+                     and len(parts) > self._group_size)
+        if self._qcodec is not None and not two_level:
+            contrib = self._quantize_contrib(contrib, fb_key)
         in_sh, sum_fn = ((self._in_sh, self._sum) if full
                          else self._group_sharding(parts))
         # each rank's deposit is its slice of the [participants, ...]
@@ -407,9 +457,14 @@ class _CollectiveLane:
             mine[self.rank] = contrib
             if len(mine) == len(parts):
                 try:
-                    garr = jax.make_array_from_single_device_arrays(
-                        gshape, in_sh, [mine[r] for r in parts])
-                    results[key] = [sum_fn(garr), len(parts)]
+                    if two_level:
+                        results[key] = [self._two_level_issue(
+                            [mine[r] for r in parts], fb_key),
+                            len(parts)]
+                    else:
+                        garr = jax.make_array_from_single_device_arrays(
+                            gshape, in_sh, [mine[r] for r in parts])
+                        results[key] = [sum_fn(garr), len(parts)]
                 except BaseException:
                     # peers-only refcount: the issuer re-raises and
                     # never reaches the pickup decrement below
@@ -453,6 +508,14 @@ class _CollectiveLane:
         if out is None:
             raise WaveError(f"rank {self.rank}: collective-lane issuer "
                             f"failed for {key}")
+        if two_level:
+            # host-reduced replicated result: every member lands its
+            # own device copy; count per member so the per-rank
+            # TWO_LEVEL_REDUCES gauge stays comparable across ranks
+            self.two_level_reduces += 1
+            if self._stats is not None:
+                self._stats["two_level_reduces"] += 1
+            return jax.device_put(out, self.device)
         return next(s.data for s in out.addressable_shards
                     if s.device == self.device)
 
@@ -566,22 +629,33 @@ class DistWaveRunner(WaveRunner):
                 self._lane = _CollectiveLane(
                     "multiproc", self.nb_ranks, self.rank,
                     timeout=self.comm_timeout,
-                    reduce_dtype=reduce_dtype)
+                    reduce_dtype=reduce_dtype,
+                    stats=getattr(self.ce, "dplane_stats", None))
             elif mode == "on" and jax.process_count() == 1 and \
                     len(_lane_local_devices(self.nb_ranks)) >= self.nb_ranks:
+                from ...parallel.mesh import ErrorFeedback
                 fab = getattr(self.ce, "fabric", None) or self.ce
                 with _LANE_RDV_LOCK:   # SPMD threads race the attach
                     rdv = getattr(fab, "_lane_rdv", None)
                     if rdv is None:
                         rdv = ({}, {}, threading.Condition())
                         fab._lane_rdv = rdv
+                    # two-level residuals are per GROUP, applied by
+                    # whichever rank thread issues — one fabric-owned
+                    # accumulator keeps the carry deterministic
+                    efb = getattr(fab, "_lane_efb", None)
+                    if efb is None:
+                        efb = ErrorFeedback()
+                        fab._lane_efb = efb
                 self._lane = _CollectiveLane(
                     "inproc", self.nb_ranks, self.rank, rendezvous=rdv,
                     timeout=self.comm_timeout,
                     dead_fn=lambda ce=self.ce: getattr(
                         ce, "dead_peers", ()),
                     devices=_lane_device_pool(self.nb_ranks),
-                    reduce_dtype=reduce_dtype)
+                    reduce_dtype=reduce_dtype,
+                    shared_feedback=efb,
+                    stats=getattr(self.ce, "dplane_stats", None))
         except Exception:
             if mode == "on":
                 raise
@@ -611,8 +685,15 @@ class DistWaveRunner(WaveRunner):
         # its lane contributions while a peer does not silently skews
         # results — better a loud setup failure
         rdt = str(params.get_or("wave_reduce_dtype", "string", ""))
-        digest = hashlib.sha1(
-            repr((mode, min_pct, rdt)).encode()).hexdigest()
+        sig = (mode, min_pct, rdt)
+        # the two-level knob changes what every depositor contributes
+        # (full precision vs pre-quantized) — it must ride the digest.
+        # Appended ONLY when set, so an unset knob leaves the exchanged
+        # bytes bit-for-bit identical to the pre-ISSUE-19 wire.
+        if bool(params.get_or("xfer_collective_redist", "bool", False)):
+            sig = sig + (True,
+                         int(params.get_or("xfer_group_size", "int", 0)))
+        digest = hashlib.sha1(repr(sig).encode()).hexdigest()
         check_lane_schedule_uniformity(
             self.ce, digest, timeout=min(30.0, self.comm_timeout))
 
@@ -1046,6 +1127,9 @@ class DistWaveRunner(WaveRunner):
                     else None),
                 "collective_quantized": (
                     self._lane.quantized_reduces
+                    if self._lane is not None else 0),
+                "collective_two_level": (
+                    self._lane.two_level_reduces
                     if self._lane is not None else 0),
                 "device_plane": (getattr(self.ce, "device_plane",
                                          None) is not None

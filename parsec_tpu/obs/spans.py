@@ -66,6 +66,15 @@ COMM_RECONNECTS = "PARSEC::COMM::RECONNECTS"
 COMM_REPLAYED_FRAMES = "PARSEC::COMM::REPLAYED_FRAMES"
 COMM_DUP_DROPPED = "PARSEC::COMM::DUP_DROPPED"
 COMM_SUSPECT_MS = "PARSEC::COMM::SUSPECT_MS"
+# device-plane / planned-redistribution telemetry (xfer/, ISSUE 19):
+# bulk bytes and pull count that left the session wire for the device
+# plane, alltoall rounds the redistribution planner executed, and
+# two-level hierarchical reductions the wave collective lane issued —
+# engine-owned counters (ce.dplane_stats), polled like elastic_stats
+COMM_DPLANE_BYTES = "PARSEC::COMM::DPLANE_BYTES"
+COMM_DPLANE_XFERS = "PARSEC::COMM::DPLANE_XFERS"
+COMM_REDIST_ROUNDS = "PARSEC::COMM::REDIST_ROUNDS"
+COMM_TWO_LEVEL_REDUCES = "PARSEC::COMM::TWO_LEVEL_REDUCES"
 # fault-tolerance telemetry (ft/detector.py): peers currently confirmed
 # alive, and the per-peer heartbeat round-trip EWMA in milliseconds
 # (PARSEC::FT::HB_RTT::R<peer>, 0 until measured)
@@ -543,6 +552,16 @@ class CommObs:
                     f"{OBS_CLOCK_OFFSET_PREFIX}::R{peer}",
                     lambda c=ce, p=peer: (lambda o: 0.0 if o is None
                                           else o)(c.clock_offset_us(p)))
+        ds = getattr(ce, "dplane_stats", None)
+        if ds is not None:
+            sde.register_poll(COMM_DPLANE_BYTES,
+                              lambda s=ds: s["dplane_bytes"])
+            sde.register_poll(COMM_DPLANE_XFERS,
+                              lambda s=ds: s["dplane_xfers"])
+            sde.register_poll(COMM_REDIST_ROUNDS,
+                              lambda s=ds: s["redist_rounds"])
+            sde.register_poll(COMM_TWO_LEVEL_REDUCES,
+                              lambda s=ds: s["two_level_reduces"])
         es = getattr(ce, "elastic_stats", None)
         if es is not None:
             sde.register_poll(FT_ELASTIC_RESIZES,

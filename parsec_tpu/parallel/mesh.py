@@ -217,7 +217,7 @@ def reduced_precision_sum(contribs: Sequence[np.ndarray],
                           reduce_dtype: Optional[str] = None,
                           feedback: Optional[ErrorFeedback] = None,
                           keys: Optional[Sequence[Any]] = None,
-                          native: bool = False) -> np.ndarray:
+                          native: bool = True) -> np.ndarray:
     """Sum of per-participant contributions with quantize-at-the-
     boundary: each contribution is quantized (bf16 / int8 blockwise,
     exactly the wire codecs) before it enters the reduction —
@@ -226,9 +226,10 @@ def reduced_precision_sum(contribs: Sequence[np.ndarray],
     enable per-contributor error feedback (``keys[i]`` names
     contributor i's logical buffer). ``reduce_dtype`` None/"" keeps the
     exact full-precision sum (bit-for-bit the naive sum).  ``native``
-    routes the boundary quantize through the jit-compiled
-    :func:`qdq_jax` hop instead of host numpy — bit-identical values
-    (the parity contract), XLA-lowered arithmetic."""
+    (the default) routes the boundary quantize through the jit-compiled
+    :func:`qdq_jax` hop — bit-identical values (the parity contract),
+    XLA-lowered arithmetic; ``native=False`` falls back to the eager
+    host-numpy wire codec (kept for parity testing only)."""
     from ..comm import wire
     codec = _quant_codec_of(reduce_dtype)
     if codec is None:
@@ -253,7 +254,7 @@ def two_level_allreduce(shards: Sequence[np.ndarray],
                         reduce_dtype: Optional[str] = None,
                         feedback: Optional[ErrorFeedback] = None,
                         key: Any = None,
-                        native: bool = False) -> np.ndarray:
+                        native: bool = True) -> np.ndarray:
     """Hierarchical all-reduce: contributions reduce FULL-precision
     inside each ``group_size``-wide group (level 1 — the intra-mesh
     XLA psum over ICI, where bandwidth is plentiful), each group's
@@ -263,8 +264,9 @@ def two_level_allreduce(shards: Sequence[np.ndarray],
     group's boundary residual is carried into its next partial under
     ``(key, group index)`` — the EQuARX error-feedback recipe. With
     ``reduce_dtype`` None/"" this is exactly the flat sum.  ``native``
-    lowers the boundary quantize through the jit-compiled
-    :func:`qdq_jax` hop (bit-identical values, XLA arithmetic)."""
+    (the default) lowers the boundary quantize through the jit-compiled
+    :func:`qdq_jax` hop (bit-identical values, XLA arithmetic);
+    ``native=False`` is the eager host-numpy reference path."""
     n = len(shards)
     groups = [list(range(g, min(g + group_size, n)))
               for g in range(0, n, group_size)]

@@ -606,6 +606,33 @@ def register_core_params() -> None:
     params.reg_int("serve_latency_window", 512,
                    "per-tenant taskpool-latency samples kept for the "
                    "P99_LATENCY_US gauge and health snapshots")
+    # device-plane transport + collective redistribution (xfer/, ISSUE 19)
+    params.reg_bool("xfer_dplane", False,
+                    "device-plane tile transport (xfer/): advertise the "
+                    "\"dp\" HELLO capability and move bulk tile payloads "
+                    "chip-to-chip over the transfer plane when both link "
+                    "ends negotiated it; the session envelope still "
+                    "carries the control half (header/ack) so replay and "
+                    "flap semantics are unchanged. Off (default) keeps "
+                    "the wire bit-for-bit")
+    params.reg_bool("xfer_collective_redist", False,
+                    "plan collections/redistribute as coalesced "
+                    "alltoall-style collective rounds (xfer/plan.py) "
+                    "instead of the per-tile GET storm, and switch the "
+                    "wave collective lane to the two-level hierarchical "
+                    "reduction (parallel/mesh.two_level_allreduce). Off "
+                    "(default) constructs nothing and keeps the wire "
+                    "bit-for-bit")
+    params.reg_string("xfer_backend", "auto",
+                      "device-plane transfer backend: \"auto\" (use "
+                      "jax.experimental.transfer when the platform "
+                      "provides it, else the in-process loopback), "
+                      "\"native\" (require jax transfer), \"loopback\" "
+                      "(force the socket loopback backend — what CI runs)")
+    params.reg_int("xfer_group_size", 0,
+                   "two-level collective group size (ranks per "
+                   "intra-group psum before the quantized boundary hop); "
+                   "0 = derive from the rank-mesh geometry, else 2")
 
 
 register_core_params()

@@ -1,0 +1,19 @@
+"""xfer/: transport- and topology-aware data movement (ISSUE 19).
+
+Three pillars: the negotiated device-plane transport ladder (HELLO
+``"dp"`` — comm/tcp.py + comm/xfer.py, with :mod:`.loopback` as the
+everywhere-available backend), hierarchical two-level collectives for
+the wave lane (dsl/ptg/wave_dist.py riding
+parallel/mesh.two_level_allreduce), and the redistribution planner
+(:mod:`.plan` — reshards as coalesced alltoall rounds instead of
+per-tile GET storms).  Everything is gated behind the
+``xfer_dplane`` / ``xfer_collective_redist`` MCA knob pair; unset, no
+code here runs and the wire stays bit-for-bit identical.
+"""
+from .loopback import LoopbackTransferServer, start_transfer_server
+from .plan import (RedistPlan, Transfer, TAG_REDIST, build_plan,
+                   run_redistribution, PlannedRedistribution)
+
+__all__ = ["LoopbackTransferServer", "start_transfer_server",
+           "RedistPlan", "Transfer", "TAG_REDIST", "build_plan",
+           "run_redistribution", "PlannedRedistribution"]

@@ -406,3 +406,63 @@ def test_dpotrf_2rank_flap_matches_failure_free():
     for c, f in zip(clean, flapped):
         assert f["max_err"] == c["max_err"]   # bit-identical factor
     assert all(o["wire"]["reconnects"] == 0 for o in clean)
+
+
+def test_redistribution_survives_flap_bit_identical():
+    """ISSUE 19 chaos leg: a ``flap:rank=*`` landing in the MIDDLE of
+    a planned collective redistribution (xfer/plan.py rounds over the
+    session wire) is absorbed by reconnect + replay — the reshard
+    completes bit-identical to the source, the exchanged plan digests
+    agree, and nobody is declared dead."""
+    from parsec_tpu.collections import TwoDimBlockCyclic
+    from parsec_tpu.xfer import run_redistribution
+    nb = 2
+    lm = ln = 32
+    src_np = np.random.RandomState(11).rand(lm, ln)
+    engines = _engines(nb, reconnect_timeout=10.0)
+    e0, e1 = engines
+    try:
+        _wait_session(e0, e1)
+        # rank 0's 2nd post-install send is its round-1 bulk transfer:
+        # the link tears with the frame unflushed — replay must carry it
+        e0._ft = FaultInjector.from_spec(
+            "flap:rank=*:nth=2:duration=0.05", rank=0)
+        outs = [None] * nb
+        errs = []
+
+        def run(r):
+            try:
+                src = TwoDimBlockCyclic(
+                    lm, ln, 4, 4, P=nb, Q=1, nodes=nb, rank=r,
+                    dtype=np.float64).from_numpy(src_np)
+                tgt = TwoDimBlockCyclic(
+                    lm, ln, 4, 4, P=1, Q=nb, nodes=nb, rank=r,
+                    dtype=np.float64).from_numpy(np.zeros((lm, ln)))
+                tp = run_redistribution(src, tgt, engines[r],
+                                        timeout=30.0)
+                outs[r] = (tp.plan_digest,
+                           {c: np.array(tgt.tile(*c))
+                            for c in tgt.local_tiles()})
+            except BaseException as exc:
+                errs.append(exc)
+
+        ts = [threading.Thread(target=run, args=(r,)) for r in range(nb)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(60)
+        assert not any(t.is_alive() for t in ts), "redistribution hung"
+        assert not errs, errs
+        got = np.zeros((lm, ln))
+        for r in range(nb):
+            for (m, n), arr in outs[r][1].items():
+                got[m * 4:m * 4 + arr.shape[0],
+                    n * 4:n * 4 + arr.shape[1]] = arr
+        np.testing.assert_array_equal(got, src_np)   # bit-identical
+        assert outs[0][0] == outs[1][0]              # digests agree
+        assert e0._ft.stats["flaps"] >= 1            # the fault fired
+        assert e0.wire_stats["reconnects"] >= 1
+        assert not e0.dead_peers and not e1.dead_peers
+    finally:
+        for e in engines:
+            e.fini()

@@ -148,9 +148,9 @@ def test_xfer_stress_across_processes():
     """Device-plane soak (round-2 VERDICT item 7): ~100 concurrent
     MB-scale device-to-device pulls over one connection from a thread
     pool; producer asserts zero leaked parks, consumer asserts every
-    byte arrived intact."""
-    pytest.importorskip("jax.experimental.transfer",
-                        reason="device plane needs the PJRT transfer API")
+    byte arrived intact.  Runs everywhere: xfer_backend=auto rides the
+    PJRT transfer API when the build has it, else the loopback backend
+    (parsec_tpu/xfer/loopback.py) carries the identical code path."""
     outs = _run_ranks(2, 0, mode="xfer_stress", timeout=420)
     prod = next(o for o in outs if o["rank"] == 0)
     cons = next(o for o in outs if o["rank"] == 1)
@@ -177,9 +177,8 @@ def test_wave_dpotrf_device_plane_across_processes():
     DEFAULT on cross-process transports (the runner auto-attaches;
     nothing opts in): tile exchanges move device-to-device through the
     transfer plane, TCP carries only descriptors and park acks; zero
-    leaked parks, same numerics."""
-    pytest.importorskip("jax.experimental.transfer",
-                        reason="device plane needs the PJRT transfer API")
+    leaked parks, same numerics.  xfer_backend=auto falls back to the
+    loopback transfer backend on builds without the PJRT API."""
     outs = _run_ranks(2, 0, mode="wave_xfer", timeout=300)
     assert all(o["max_err"] < 5e-3 for o in outs), outs
     tile_bytes = 64 * 64 * 8
@@ -195,9 +194,9 @@ def test_wave_bcast_tree_device_resident_forwards():
     """Binomial-tree broadcast over 4 ranks with the device plane (the
     cross-process default): interior tree nodes re-forward from the
     DEVICE arrays the plane pulled — zero host np.stack on the forward
-    path (round-4 VERDICT Weak #5; stats counters prove the route)."""
-    pytest.importorskip("jax.experimental.transfer",
-                        reason="device plane needs the PJRT transfer API")
+    path (round-4 VERDICT Weak #5; stats counters prove the route).
+    xfer_backend=auto falls back to the loopback transfer backend on
+    builds without the PJRT API."""
     outs = _run_ranks(4, 0, mode="wave_bcast_xfer", timeout=300)
     assert all(o["max_err"] < 1e-6 for o in outs), outs
     st = [o["stats"] for o in outs]
@@ -311,9 +310,8 @@ def test_dposv_device_plane_across_processes():
     DEVICE-to-device through the jax transfer server (comm/xfer.py);
     TCP carries only control traffic. Every rank must have pulled real
     device bytes (ref role: parsec_mpi_funnelled.c:245-365's data plane,
-    re-landed on the PJRT transfer fabric)."""
-    pytest.importorskip("jax.experimental.transfer",
-                        reason="device plane needs the PJRT transfer API")
+    re-landed on the PJRT transfer fabric; xfer_backend=auto rides the
+    loopback backend on builds without the PJRT API)."""
     outs = _run_ranks(2, 0, mode="dposv_xfer", timeout=300)
     assert all(o["max_err"] < 5e-3 for o in outs), outs
     total_pulled = sum(o["xfer"]["bytes_pulled"] for o in outs)

@@ -173,6 +173,85 @@ def test_lane_error_feedback_needs_stable_key():
     assert tot[0, 1] > 0, "feedback never emitted the accumulated signal"
 
 
+def test_lane_two_level_reduction_engages_and_differs_from_flat():
+    """``xfer_collective_redist`` (ISSUE 19): deposits stay FULL
+    precision and the issuer reduces hierarchically — full-precision
+    partial sums inside each ``xfer_group_size`` group, ONE jit-native
+    qdq per group at the boundary. Crafted input where flat
+    per-contribution quantize and two-level round DIFFERENTLY:
+    256 + 1 accumulates exactly inside a group, but 257 is not a bf16
+    value, so the boundary hop rounds each partial to 256 (total 512)
+    while the flat path delivers 514. Every member picks up the
+    bit-identical replicated result; TWO_LEVEL_REDUCES accounting
+    fires once per member and the per-contribution counter stays 0."""
+    pytest.importorskip("jax")
+    import threading
+    from parsec_tpu.dsl.ptg.wave_dist import _CollectiveLane
+    n = 4
+    contribs = [np.full((2, 8), v, np.float32)
+                for v in (256.0, 1.0, 256.0, 1.0)]
+    params.set_cmdline("xfer_collective_redist", "1")
+    params.set_cmdline("xfer_group_size", "2")
+    try:
+        rdv = ({}, {}, threading.Condition())
+        efb = ErrorFeedback()
+        stats = [{"two_level_reduces": 0} for _ in range(n)]
+        lanes = [_CollectiveLane("inproc", n, r, rendezvous=rdv,
+                                 reduce_dtype="bf16",
+                                 shared_feedback=efb, stats=stats[r])
+                 for r in range(n)]
+        outs = [None] * n
+        errs = []
+
+        def run(r):
+            try:
+                outs[r] = np.asarray(
+                    lanes[r].reduce(("p", 1, 0, 0), contribs[r]))
+            except BaseException as e:
+                errs.append(e)
+
+        ts = [threading.Thread(target=run, args=(r,)) for r in range(n)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(60)
+        assert not errs, errs
+    finally:
+        params.unset_cmdline("xfer_collective_redist")
+        params.unset_cmdline("xfer_group_size")
+    exp = two_level_allreduce(contribs, 2, "bf16")
+    flat = reduced_precision_sum(contribs, "bf16")
+    assert not np.array_equal(exp, flat), "input must discriminate"
+    for r in range(n):
+        np.testing.assert_array_equal(outs[r], exp)
+    assert all(ln.two_level_reduces == 1 for ln in lanes)
+    assert all(ln.quantized_reduces == 0 for ln in lanes)
+    assert all(s["two_level_reduces"] == 1 for s in stats)
+
+
+def test_lane_two_level_group_size_gates_engagement():
+    """len(members) must EXCEED the group size for the hierarchy to
+    buy anything — at group_size >= member count the lane keeps the
+    flat per-contribution quantize (and its counter)."""
+    pytest.importorskip("jax")
+    import threading
+    from parsec_tpu.dsl.ptg.wave_dist import _CollectiveLane
+    params.set_cmdline("xfer_collective_redist", "1")
+    params.set_cmdline("xfer_group_size", "4")
+    try:
+        rdv = ({}, {}, threading.Condition())
+        lane = _CollectiveLane("inproc", 1, 0, rendezvous=rdv,
+                               reduce_dtype="bf16")
+        x = np.full((2, 4), 256.0, np.float32) + 1.0
+        out = np.asarray(lane.reduce(("p", 1, 0, 0), x))
+        np.testing.assert_array_equal(out, wire.qdq_array(x, "qbf16"))
+        assert lane.two_level_reduces == 0
+        assert lane.quantized_reduces == 1
+    finally:
+        params.unset_cmdline("xfer_collective_redist")
+        params.unset_cmdline("xfer_group_size")
+
+
 def test_wave_reduce_dtype_dpotrf_within_bound(nb_ranks=4):
     """End to end: the 4-rank row-cyclic dist-wave dpotrf whose panel
     broadcasts ride the compiled collective lane, with the lane
@@ -293,25 +372,27 @@ def test_native_qdq_bit_parity_with_numpy():
 
 
 def test_native_two_level_allreduce_bit_parity():
-    """two_level_allreduce(native=True) — the XLA-lowered boundary
-    quantize — is bit-identical to the numpy path, with and without
-    error feedback across iterations (the residual carry must see the
-    exact same quantized values, or feedback states diverge)."""
+    """two_level_allreduce's DEFAULT boundary quantize is now the
+    XLA-lowered native hop (ISSUE 19 satellite: no host-side numpy
+    quantize left on the default path) — it must stay bit-identical to
+    the eager wire codec (``native=False``), with and without error
+    feedback across iterations (the residual carry must see the exact
+    same quantized values, or feedback states diverge)."""
     pytest.importorskip("jax")
     rng = np.random.RandomState(8)
     shards = [rng.randn(300).astype(np.float32) for _ in range(8)]
     for rd in wire.available_quant_codecs():
         np.testing.assert_array_equal(
-            two_level_allreduce(shards, 4, rd),
-            two_level_allreduce(shards, 4, rd, native=True))
+            two_level_allreduce(shards, 4, rd, native=False),
+            two_level_allreduce(shards, 4, rd))
         fb_np, fb_jx = ErrorFeedback(), ErrorFeedback()
         for _ in range(3):
-            r_np = two_level_allreduce(shards, 4, rd,
-                                       feedback=fb_np, key="k")
-            r_jx = two_level_allreduce(shards, 4, rd, feedback=fb_jx,
-                                       key="k", native=True)
+            r_np = two_level_allreduce(shards, 4, rd, feedback=fb_np,
+                                       key="k", native=False)
+            r_jx = two_level_allreduce(shards, 4, rd,
+                                       feedback=fb_jx, key="k")
             np.testing.assert_array_equal(r_np, r_jx)
-    # unset knob: native flag must not disturb the exact sum
+    # no codec: the native default must not disturb the exact sum
     np.testing.assert_array_equal(
-        two_level_allreduce(shards, 4, None),
-        two_level_allreduce(shards, 4, None, native=True))
+        two_level_allreduce(shards, 4, None, native=False),
+        two_level_allreduce(shards, 4, None))

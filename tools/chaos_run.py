@@ -43,6 +43,13 @@ without editing it::
     python tools/chaos_run.py --soak 300 --tenants 3 \\
         --health /tmp/serve_soak.jsonl
 
+    # planned-redistribution soak (xfer/): every iteration runs a
+    # 4-rank collective reshard over real TCP sessions with a link
+    # flap landing mid-rounds; the iteration fails unless the reshard
+    # is BIT-IDENTICAL and the flap was absorbed by session replay
+    python tools/chaos_run.py --soak 300 --redist 4 --reconnect 10 \\
+        --inject "flap:rank=*:nth=2:duration=0.05"
+
 Everything after ``--`` is the script and ITS argv. Exit status: the
 script's (an uncaught injected failure exits non-zero — which is the
 point: chaos_run makes "does it fail loudly instead of hanging?"
@@ -116,6 +123,18 @@ def main(argv=None) -> int:
     ap.add_argument("--tenant-pools", type=int, default=4, metavar="P",
                     help="pools each driver tenant submits per "
                          "iteration (default 4)")
+    ap.add_argument("--redist", type=int, default=0, metavar="N",
+                    help="soak mode only: replace the target script "
+                         "with the built-in planned-redistribution "
+                         "driver (xfer/plan.py) — N TCP ranks reshard "
+                         "a matrix P x 1 -> 1 x Q through alltoall "
+                         "rounds under the injected faults; the "
+                         "iteration fails unless the result is "
+                         "bit-identical to the source and any flap "
+                         "was absorbed by session replay")
+    ap.add_argument("--redist-size", type=int, default=48, metavar="M",
+                    help="redistribution driver matrix extent "
+                         "(default 48)")
     ap.add_argument("--forensics", default="", metavar="PREFIX",
                     help="activate profiling at PREFIX so every rank "
                          "flight-records its trace on a RankFailedError "
@@ -130,6 +149,9 @@ def main(argv=None) -> int:
     ap.add_argument("args", nargs=argparse.REMAINDER,
                     help="argv for the script (prefix with --)")
     ns = ap.parse_args(argv)
+    if ns.tenants > 0 and ns.redist > 0:
+        ap.error("--tenants and --redist are mutually exclusive "
+                 "built-in drivers")
     if ns.tenants > 0:
         if ns.soak <= 0:
             ap.error("--tenants requires --soak (the multi-tenant "
@@ -138,9 +160,15 @@ def main(argv=None) -> int:
         # the obs_live implication + tenant attribution take the same
         # path a production serving context does
         os.environ["PARSEC_MCA_serve"] = "1"
+    elif ns.redist > 0:
+        if ns.soak <= 0:
+            ap.error("--redist requires --soak (the redistribution "
+                     "driver is a sustained-load leg)")
+        if ns.redist < 2:
+            ap.error("--redist needs at least 2 ranks")
     elif not ns.script:
-        ap.error("a target script is required (or --tenants N with "
-                 "--soak for the built-in serving driver)")
+        ap.error("a target script is required (or --tenants/--redist N "
+                 "with --soak for a built-in driver)")
 
     directives = []
     if ns.inject:
@@ -330,6 +358,83 @@ if failures:
 """
 
 
+#: the --redist soak leg (ISSUE 19): N TCP ranks execute ONE planned
+#: collective redistribution (xfer/plan.py alltoall rounds, digest
+#: handshake included) per iteration while the exported ft_inject /
+#: comm_reconnect_timeout knobs tear links underneath it; exits
+#: non-zero unless the reshard is bit-identical to the source
+_REDIST_DRIVER = """
+import os, sys, threading
+sys.path.insert(0, os.environ.get("CHAOS_REPO", "."))
+import numpy as np
+from parsec_tpu.collections import TwoDimBlockCyclic
+from parsec_tpu.comm.tcp import TCPCommEngine, free_ports
+from parsec_tpu.xfer import run_redistribution
+
+nb, lm = int(sys.argv[1]), int(sys.argv[2])
+ln, tile = lm, 4
+src_np = np.random.RandomState(11).rand(lm, ln)
+eps = [("127.0.0.1", p) for p in free_ports(nb)]
+import concurrent.futures as cf
+with cf.ThreadPoolExecutor(nb) as ex:
+    engines = list(ex.map(lambda r: TCPCommEngine(r, eps), range(nb)))
+outs = [None] * nb
+errs = []
+
+
+def run(r):
+    try:
+        src = TwoDimBlockCyclic(lm, ln, tile, tile, P=nb, Q=1,
+                                nodes=nb, rank=r,
+                                dtype=np.float64).from_numpy(src_np)
+        tgt = TwoDimBlockCyclic(lm, ln, tile, tile, P=1, Q=nb,
+                                nodes=nb, rank=r,
+                                dtype=np.float64).from_numpy(
+                                    np.zeros((lm, ln)))
+        tp = run_redistribution(src, tgt, engines[r], timeout=60.0)
+        outs[r] = (tp, {c: np.array(tgt.tile(*c))
+                        for c in tgt.local_tiles()})
+    except BaseException as exc:
+        errs.append(f"rank {r}: {exc!r}")
+
+
+threads = [threading.Thread(target=run, args=(r,)) for r in range(nb)]
+for th in threads:
+    th.start()
+for th in threads:
+    th.join(120)
+if any(th.is_alive() for th in threads):
+    sys.exit("redist driver: redistribution hung")
+if errs:
+    sys.exit("redist driver failures: " + "; ".join(errs))
+got = np.zeros((lm, ln))
+for r in range(nb):
+    for (m, n), arr in outs[r][1].items():
+        got[m * tile:m * tile + arr.shape[0],
+            n * tile:n * tile + arr.shape[1]] = arr
+reconnects = sum(e.wire_stats["reconnects"] for e in engines)
+flaps = sum(e._ft.stats["flaps"] for e in engines if e._ft is not None)
+dead = [sorted(e.dead_peers) for e in engines if e.dead_peers]
+for e in engines:
+    e.fini()
+tp0 = outs[0][0]
+print(f"redist driver: rounds={tp0.redist_rounds} "
+      f"transfers={tp0.redist_transfers} moves={tp0.redist_tile_moves} "
+      f"bytes={tp0.redist_bytes} digest={tp0.plan_digest[:12]} "
+      f"reconnects={reconnects} flaps={flaps}", flush=True)
+if dead:
+    sys.exit(f"redist driver: rank evictions under a transient fault: "
+             f"{dead}")
+if len({o[0].plan_digest for o in outs}) != 1:
+    sys.exit("redist driver: plan digests diverged across ranks")
+if not np.array_equal(got, src_np):
+    sys.exit("redist driver: reshard NOT bit-identical to the source")
+if flaps and not reconnects:
+    sys.exit("redist driver: flap fired but no session reconnect — "
+             "replay path never engaged")
+"""
+
+
 def _soak(ns, script: str, args) -> int:
     """Sustained-load loop: one fresh subprocess per iteration (the MCA
     env is already exported above, and re-execing chaos_run itself
@@ -362,6 +467,14 @@ def _soak(ns, script: str, args) -> int:
             os.path.dirname(os.path.abspath(__file__)))
         base = [sys.executable, "-c", _TENANT_DRIVER,
                 str(ns.tenants), str(ns.tenant_pools)]
+    elif ns.redist > 0:
+        # built-in redistribution driver: same env-inheritance contract
+        # as --tenants (ft_inject + comm_reconnect_timeout land in the
+        # TCP engines the driver constructs)
+        os.environ["CHAOS_REPO"] = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))
+        base = [sys.executable, "-c", _REDIST_DRIVER,
+                str(ns.redist), str(ns.redist_size)]
     else:
         base = [sys.executable, os.path.abspath(__file__)]
         if ns.inject:
