@@ -2160,6 +2160,11 @@ def bench_trace_capture_identity() -> dict:
       negotiates DOWN to the session wire and rank 0's data frames
       stay byte-identical to the unset legs (no transfer-server
       address exchange, no descriptor envelopes).
+    - H (ISSUE 20): ``stage_compile_xrank``'s "xs" capability SET on
+      rank 0 only — rank 1 never advertises the process token, so
+      rank 0 negotiates DOWN and no cross-rank digest/boundary control
+      frames may travel; data frames stay byte-identical to the unset
+      legs.
     """
     import threading as _threading
     from contextlib import ExitStack
@@ -2173,7 +2178,7 @@ def bench_trace_capture_identity() -> dict:
     chunk = 4096
 
     def leg(flow_r0, live_r0=False, tune_r0=False, serve_r0=False,
-            dplane_r0=False):
+            dplane_r0=False, xstage_r0=False):
         captured = {}
         orig = tcpmod._sendall_vec
 
@@ -2200,7 +2205,8 @@ def bench_trace_capture_identity() -> dict:
                         obs_live=(live_r0 and r == 0),
                         tune_auto=(tune_r0 and r == 0),
                         serve=(serve_r0 and r == 0),
-                        dplane=(dplane_r0 and r == 0))
+                        dplane=(dplane_r0 and r == 0),
+                        xstage=(xstage_r0 and r == 0))
                 ts = [_threading.Thread(target=boot, args=(r,))
                       for r in (0, 1)]
                 for t in ts:
@@ -2275,6 +2281,7 @@ def bench_trace_capture_identity() -> dict:
     e = leg(False, tune_r0=True)
     f = leg(False, serve_r0=True)
     g = leg(False, dplane_r0=True)
+    h = leg(False, xstage_r0=True)
     return {
         "trace_frames_captured": len(a),
         "trace_unset_bit_identical": bool(a and a == b),
@@ -2283,6 +2290,10 @@ def bench_trace_capture_identity() -> dict:
         "tune_mixed_version_bit_identical": bool(a and a == e),
         "serve_mixed_version_bit_identical": bool(a and a == f),
         "dplane_mixed_version_bit_identical": bool(a and a == g),
+        # ISSUE 20: "xs" SET on rank 0 only — rank 1 never advertises
+        # the token, rank 0 negotiates DOWN and no cross-rank control
+        # frames may travel; data frames stay byte-identical
+        "xstage_mixed_version_bit_identical": bool(a and a == h),
     }
 
 
@@ -3280,11 +3291,173 @@ print(json.dumps(bench.bench_stagec_inner(
 """
 
 
+def bench_stagec_xrank_inner(n=192, nb=32, delay_ms=2, reps=2) -> dict:
+    """BENCH_MODE=stagec cross-rank leg (ISSUE 20): the SAME 2-rank
+    classic-runtime dpotrf over REAL loopback TCP sockets on a
+    throttled link (every data message pays an injected ``delay_ms``
+    sleep), stage-compiled with the ACTIVATION path (a cross-rank
+    dependency edge serializes the boundary tile onto the wire) vs
+    with CROSS-RANK LOWERING ON (``stage_compile_xrank``: every
+    spanning wave compiles into ONE shard_map program whose inter-rank
+    edges are an in-program all-gather; the wire carries control
+    only).  Reported: µs/task per leg, per-rank host wire bytes (TCP
+    serializes every shipped payload, so the byte drop is the proof
+    the collective replaced the wire), the xstage engagement gauges,
+    and bit-exactness of BOTH legs against an interpreted reference —
+    the cross-rank program must reproduce the serialized schedule's
+    floats exactly."""
+    import concurrent.futures as cf
+    from contextlib import ExitStack
+
+    import parsec_tpu
+    from parsec_tpu.collections import TwoDimBlockCyclic
+    from parsec_tpu.comm import RemoteDepEngine
+    from parsec_tpu.comm.tcp import TCPCommEngine, free_ports
+    from parsec_tpu.ops import dpotrf_taskpool, make_spd
+    from parsec_tpu.utils.params import params as _params
+
+    ranks = 2
+    M = make_spd(n)
+    ntasks = _dpotrf_task_count((n + nb - 1) // nb)
+
+    def run_once(stagec, xrank):
+        with ExitStack() as ov:
+            # overrides wrap ENGINE construction: the xs token rides
+            # the HELLO, so the knob must be set before the dial
+            ov.enter_context(_params.cmdline_override(
+                "comm_mesh_local", "0"))   # payloads must ride the wire
+            ov.enter_context(_params.cmdline_override(
+                "ft_inject", f"delay:pct=100:ms={delay_ms}"))
+            if stagec:
+                ov.enter_context(
+                    _params.cmdline_override("stage_compile", "1"))
+            if xrank:
+                ov.enter_context(_params.cmdline_override(
+                    "stage_compile_xrank", "1"))
+            eps = [("127.0.0.1", p) for p in free_ports(ranks)]
+            with cf.ThreadPoolExecutor(ranks) as ex:
+                engines = list(ex.map(
+                    lambda r: TCPCommEngine(r, eps), range(ranks)))
+
+            def rank_fn(rank):
+                eng = RemoteDepEngine(engines[rank])
+                ctx = parsec_tpu.Context(nb_cores=2, comm=eng)
+                try:
+                    A = TwoDimBlockCyclic(
+                        n, n, nb, nb, P=ranks, Q=1, nodes=ranks,
+                        rank=rank, dtype=np.float64
+                        ).from_numpy(M.copy())
+                    A.name = "descA"
+                    tp = dpotrf_taskpool(A, rank=rank, nb_ranks=ranks)
+                    t0 = time.perf_counter()
+                    ctx.add_taskpool(tp)
+                    ctx.wait()
+                    wall = time.perf_counter() - t0
+                    owned = {c: np.asarray(
+                        A.data_of(*c).sync_to_host().payload)
+                        for c in A.tiles() if A.rank_of(*c) == rank}
+                    return (owned, wall, dict(ctx.stage_stats),
+                            engines[rank].fabric.bytes_count)
+                finally:
+                    ctx.fini()
+
+            with cf.ThreadPoolExecutor(ranks) as ex:
+                results = list(ex.map(rank_fn, range(ranks)))
+        L = np.zeros((n, n))
+        stats, wire = [], []
+        wall = 0.0
+        for owned, w, st_, bts in results:
+            wall = max(wall, w)
+            stats.append(st_)
+            wire.append(bts)
+            for (m, k), t in owned.items():
+                L[m * nb:m * nb + t.shape[0],
+                  k * nb:k * nb + t.shape[1]] = t
+        return np.tril(L), wall, stats, wire
+
+    def leg(stagec, xrank):
+        best = None
+        for _ in range(max(1, reps)):   # rep 1 pays the compiles
+            r = run_once(stagec, xrank)
+            best = r if best is None or r[1] < best[1] else best
+        return best
+
+    L0, _w0, _s0, _b0 = leg(False, False)
+    La, wa, sa, ba = leg(True, False)
+    Lx, wx, sx, bx = leg(True, True)
+    out = {
+        "stagec_xrank_n": n, "stagec_xrank_nb": nb,
+        "stagec_xrank_ranks": ranks, "stagec_xrank_tasks": ntasks,
+        "stagec_xrank_link_delay_ms": delay_ms,
+        "stagec_xrank_act_us_per_task": round(wa / ntasks * 1e6, 1),
+        "stagec_xrank_us_per_task": round(wx / ntasks * 1e6, 1),
+        "stagec_xrank_speedup_vs_act": round(wa / wx, 2),
+        "stagec_xrank_wire_bytes_act": ba,
+        "stagec_xrank_wire_bytes": bx,
+        "stagec_xrank_wire_bytes_saved_frac": round(
+            1.0 - sum(bx) / max(1, sum(ba)), 3),
+        "stagec_xrank_xstage_tasks": sum(
+            s["xstage_tasks"] for s in sx),
+        "stagec_xrank_xstage_compiles": sum(
+            s["xstage_compiles"] for s in sx),
+        "stagec_xrank_xstage_fallbacks": sum(
+            s["xstage_fallbacks"] for s in sx),
+        "stagec_xrank_collective_bytes": sum(
+            s["xstage_collective_bytes"] for s in sx),
+        "stagec_xrank_act_xstage_tasks": sum(
+            s["xstage_tasks"] for s in sa),
+        "stagec_xrank_bit_exact_act_vs_interpreted": bool(
+            np.array_equal(La, L0)),
+        "stagec_xrank_bit_exact_vs_interpreted": bool(
+            np.array_equal(Lx, L0)),
+    }
+    return out
+
+
+_STAGEC_XRANK_DRIVER = r"""
+import json, os, sys
+sys.path.insert(0, os.environ["BENCH_REPO"])
+import bench
+
+print(json.dumps(bench.bench_stagec_xrank_inner(
+    n=int(os.environ.get("BENCH_STAGEC_XRANK_N", "192")),
+    nb=int(os.environ.get("BENCH_STAGEC_XRANK_NB", "32")),
+    delay_ms=int(os.environ.get("BENCH_STAGEC_XRANK_DELAY_MS", "2")))))
+"""
+
+
+def bench_stagec_xrank(n=192, nb=32, delay_ms=2) -> dict:
+    """The cross-rank stagec leg in its OWN scrubbed CPU subprocess:
+    it needs a 4-device host mesh (2 ranks x 2 lanes for the shard_map
+    program) which must not leak into the single-device dispatch
+    measurement the main stagec subprocess makes."""
+    import subprocess
+    import sys as _sys
+
+    env = _scrubbed_bench_env(
+        n_devices=4,
+        BENCH_STAGEC_XRANK_N=n, BENCH_STAGEC_XRANK_NB=nb,
+        BENCH_STAGEC_XRANK_DELAY_MS=delay_ms)
+    try:
+        p = subprocess.run([_sys.executable, "-c",
+                            _STAGEC_XRANK_DRIVER],
+                           env=env, capture_output=True, text=True,
+                           timeout=1200)
+        if p.returncode != 0:
+            return {"stagec_xrank_error":
+                    p.stdout[-200:] + p.stderr[-200:]}
+        return json.loads(p.stdout.strip().splitlines()[-1])
+    except Exception as exc:  # noqa: BLE001
+        return {"stagec_xrank_error": repr(exc)[:200]}
+
+
 def bench_stagec(n=768, nb=64, reps=3) -> dict:
     """BENCH_MODE=stagec: the compiled-stage vs interpreted runtime
     comparison in a scrubbed CPU subprocess (bench_mesh pattern — the
     ratio is a host-dispatch measurement and must not depend on the
-    tunnel session's TPU plugin or link health)."""
+    tunnel session's TPU plugin or link health).  The cross-rank leg
+    (ISSUE 20) rides the same record from its own subprocess;
+    BENCH_STAGEC_XRANK=0 skips it."""
     import subprocess
     import sys as _sys
 
@@ -3295,10 +3468,18 @@ def bench_stagec(n=768, nb=64, reps=3) -> dict:
                            env=env, capture_output=True, text=True,
                            timeout=1200)
         if p.returncode != 0:
-            return {"stagec_error": p.stdout[-200:] + p.stderr[-200:]}
-        return json.loads(p.stdout.strip().splitlines()[-1])
+            rec = {"stagec_error": p.stdout[-200:] + p.stderr[-200:]}
+        else:
+            rec = json.loads(p.stdout.strip().splitlines()[-1])
     except Exception as exc:  # noqa: BLE001
-        return {"stagec_error": repr(exc)[:200]}
+        rec = {"stagec_error": repr(exc)[:200]}
+    if os.environ.get("BENCH_STAGEC_XRANK", "1") != "0":
+        rec.update(bench_stagec_xrank(
+            n=int(os.environ.get("BENCH_STAGEC_XRANK_N", "192")),
+            nb=int(os.environ.get("BENCH_STAGEC_XRANK_NB", "32")),
+            delay_ms=int(os.environ.get(
+                "BENCH_STAGEC_XRANK_DELAY_MS", "2"))))
+    return rec
 
 
 def dgeqrf_flops(n: int, m: int = None) -> float:

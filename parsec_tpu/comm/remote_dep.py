@@ -146,7 +146,7 @@ class RemoteDepEngine:
         self.stats = {"activates_sent": 0, "activates_recv": 0,
                       "dtd_sends": 0, "dtd_recvs": 0, "forwards": 0,
                       "mem_puts_sent": 0, "mem_puts_recv": 0,
-                      "mesh_local_sends": 0,
+                      "mesh_local_sends": 0, "xs_elisions": 0,
                       # prefetched-GET outcomes, DISTINCT from plain
                       # GETs so the overlap gauges stay debuggable
                       "prefetch_gets": 0, "prefetch_hits": 0,
@@ -326,7 +326,43 @@ class RemoteDepEngine:
             # agreeable to all of them — take the most conservative
             limit = min(self.short_limit_for(r) for r in ranks)
             inline = payload_arr is None or payload_arr.nbytes <= limit
-            if (self._mesh_local and payload_arr is not None
+            xs_targets = getattr(tp, "_xs_targets", None)
+            if xs_targets and payload_arr is not None:
+                from ..stagec.xrank import (XSTORE, stage_donation_active,
+                                            xs_negotiated, xstore_key)
+                if (all(xs_negotiated(self.ce, r) for r in ranks)
+                        and all((tp.task_classes[e[0]].ast.name,
+                                 tuple(e[1])) in xs_targets
+                                for r in ranks for e in dsts[r])):
+                    # cross-rank stage elision (ISSUE 20): every
+                    # consumer edge of this flow lands in a cross-rank
+                    # SPMD wave, so the payload parks in the process-
+                    # global XStore and the wire carries CONTROL ONLY —
+                    # the in-program all_gather is what moves the tile.
+                    # Each consumer rank pulls the SAME array at
+                    # delivery, so any downstream fallback (decline,
+                    # build failure, timeout) still holds a real
+                    # payload.
+                    arr = payload_arr
+                    if _is_device_array(arr):
+                        if stage_donation_active(tp):
+                            # donate-by-default could invalidate this
+                            # buffer before the consumer's wave runs
+                            import jax.numpy as jnp
+                            arr = jnp.array(arr, copy=True)
+                    else:
+                        # host payload: a local successor may mutate
+                        # the live copy in place (the rendezvous-path
+                        # snapshot argument)
+                        arr = np.array(arr)
+                        arr.setflags(write=False)
+                    key = xstore_key(self.rank, tp.comm_tp_id)
+                    XSTORE.put(key, arr, len(ranks))
+                    msg["xs"] = list(key)
+                    self.stats["xs_elisions"] += 1
+            if "xs" in msg:
+                pass   # control-only: no data/handle/xfer on the wire
+            elif (self._mesh_local and payload_arr is not None
                     and _is_device_array(payload_arr)
                     and all(self.ce.mesh_local_with(r) for r in ranks)):
                 # mesh-local fast path: every participant addresses the
@@ -335,7 +371,16 @@ class RemoteDepEngine:
                 # dependency costs a pointer, and any chip hop is an
                 # XLA transfer at the consumer's stage-in, not a wire
                 # round-trip through serialize/deserialize
-                msg["data"] = payload_arr
+                arr = payload_arr
+                from ..stagec.xrank import stage_donation_active
+                if stage_donation_active(tp):
+                    # donate-by-default (ISSUE 20c) may later donate
+                    # the tile buffer this reference aliases — ship a
+                    # defensive device copy instead of disabling the
+                    # whole path the way device_donate does
+                    import jax.numpy as jnp
+                    arr = jnp.array(arr, copy=True)
+                msg["data"] = arr
                 self.stats["mesh_local_sends"] += 1
             elif (plane is not None and not inline
                     and _is_device_array(payload_arr)):
@@ -451,6 +496,26 @@ class RemoteDepEngine:
                     return
                 raise
             self.ce.send_am(xf["src"], TAG_XFER_ACK, {"uuid": uuid})
+            self._deliver_activation(tp, my_edges, arr, msg.get("dtt"),
+                                     tr=msg.get("_tr"))
+            return
+        xs = msg.get("xs")
+        if xs is not None:
+            # cross-rank stage elision (ISSUE 20): the payload was
+            # parked in the process-global XStore by the (co-resident)
+            # producer — pull it at delivery so this rank holds a real
+            # array whatever its stage's fate (compiled wave, decline,
+            # or full fallback)
+            from ..stagec.xrank import XSTORE
+            arr = XSTORE.take(tuple(xs))
+            if arr is None:
+                exc = RuntimeError(
+                    f"cross-rank stage payload {tuple(xs)} missing "
+                    f"from the in-process XStore")
+                if self.context is not None:
+                    self.context.record_task_error(exc)
+                    return
+                raise exc
             self._deliver_activation(tp, my_edges, arr, msg.get("dtt"),
                                      tr=msg.get("_tr"))
             return

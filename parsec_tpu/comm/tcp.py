@@ -82,6 +82,24 @@ from .wire import GOODBYE
 
 TAG_BARRIER = TAG_USER_BASE - 1  # reserved by the transport for sync()
 
+#: per-PROCESS random token advertised under the HELLO "xs" capability
+#: (ISSUE 20): equality on the receive side proves two ranks share this
+#: process — and therefore one XLA device pool, the precondition for
+#: lowering a wave-front stage into ONE shard_map program across them.
+#: Lazily minted so an unset knob never even generates it.
+_XS_TOKEN: Optional[str] = None
+_XS_TOKEN_LOCK = threading.Lock()  # lock: guards module-global _XS_TOKEN lazy init, not a class field
+
+
+def _xs_proc_token() -> str:
+    global _XS_TOKEN
+    with _XS_TOKEN_LOCK:
+        if _XS_TOKEN is None:
+            import os
+            import uuid
+            _XS_TOKEN = f"xs-{os.getpid()}-{uuid.uuid4().hex}"
+        return _XS_TOKEN
+
 #: bandwidth EWMA smoothing and the minimum send size that counts as a
 #: bandwidth sample (smaller sends measure syscall latency, not the link)
 _BW_ALPHA = 0.2
@@ -234,7 +252,7 @@ class _Peer:
                  "rs_rx_partial", "rx_xfers", "recv_thread", "rs_dup_next",
                  "rs_resuming", "qz_codec", "q_pre", "q_post",
                  "comp_pre", "comp_post", "tn_ok", "qrx_pre", "qrx_post",
-                 "sv_ok", "dp_ok")
+                 "sv_ok", "dp_ok", "xs_ok")
 
     def __init__(self, rank: int, sock: socket.socket) -> None:
         self.rank = rank
@@ -264,6 +282,7 @@ class _Peer:
         self.tn_ok = False         # HELLO advertised runtime tuning ("tn")
         self.sv_ok = False         # HELLO advertised serving ("sv")
         self.dp_ok = False         # HELLO advertised device plane ("dp")
+        self.xs_ok = False         # HELLO proved co-resident xrank ("xs")
         # -- closed-loop tuning (ISSUE 17) ------------------------------
         self.qrx_pre = 0           # raw bytes of RECEIVED quantized bufs
         self.qrx_post = 0          # encoded bytes that landed for them
@@ -319,7 +338,8 @@ class TCPCommEngine(LocalCommEngine):
                  obs_live: Optional[bool] = None,
                  tune_auto: Optional[bool] = None,
                  serve: Optional[bool] = None,
-                 dplane: Optional[bool] = None) -> None:
+                 dplane: Optional[bool] = None,
+                 xstage: Optional[bool] = None) -> None:
         from ..utils.params import params
         self._inbox: Fifo = Fifo()
         # GET tokens whose reply has ARRIVED (pushed to the inbox by a
@@ -436,6 +456,17 @@ class TCPCommEngine(LocalCommEngine):
         if dplane is None:
             dplane = bool(params.get_or("xfer_dplane", "bool", False))
         self._dp_enabled = bool(dplane)
+        # cross-rank SPMD stages (ISSUE 20): the "xs" capability rides a
+        # per-PROCESS random token, so it only negotiates between ranks
+        # that share this process's XLA device pool (the one-program
+        # lowering needs a common mesh); a knob-unset or mixed-version
+        # peer simply never matches and keeps the activation path
+        # bit-for-bit.  Symmetric like "dp": unset on EITHER end leaves
+        # that end's HELLO bytes exactly what the unset build sends.
+        if xstage is None:
+            xstage = bool(params.get_or("stage_compile_xrank", "bool",
+                                        False))
+        self._xs_enabled = bool(xstage)
         self._serve_enabled = bool(serve)
         self._tune_enabled = bool(tune_auto)
         self._live_enabled = (bool(obs_live) or self._tune_enabled
@@ -601,6 +632,15 @@ class TCPCommEngine(LocalCommEngine):
             # bit-identical and a mixed-version peer's bulk bytes stay
             # on the session wire
             info["dp"] = True
+        if self._xs_enabled:
+            # cross-rank SPMD stages (ISSUE 20): the advertised value is
+            # a per-process random token, not a bare True — the receive
+            # side negotiates "xs" only on token EQUALITY, which proves
+            # both ranks live in THIS process (shared XLA device pool,
+            # the precondition for lowering one program across them).
+            # Gated like "dp" so an unset knob's HELLO stays
+            # bit-identical and a mixed-version peer never negotiates.
+            info["xs"] = _xs_proc_token()
         if self._quantize is not None:
             # quantized codecs are advertised ONLY when the local knob
             # is set — symmetric like "rs", so a knob-unset build keeps
@@ -814,6 +854,30 @@ class TCPCommEngine(LocalCommEngine):
         with self._conn_cond:
             p = self._peers.get(dst)
         return p is not None and p.dp_ok
+
+    def xstage_to(self, dst: int, wait_s: float = 5.0) -> bool:
+        """Cross-rank SPMD stages may span ``dst`` only when the peer's
+        HELLO carried THIS process's "xs" token (ISSUE 20) — i.e. both
+        ends run with ``stage_compile_xrank`` set AND share one XLA
+        device pool.  A mixed-version or knob-unset peer keeps today's
+        activation path bit-for-bit.  The HELLO is the link's first
+        frame but lands on the receiver thread, so a caller racing the
+        dial waits (bounded) for it — answering from a not-yet-seen
+        HELLO would negotiate DOWN spuriously and strand the peers on
+        asymmetric plans until the install timeout."""
+        with self._conn_cond:
+            p = self._peers.get(dst)
+        if p is None:
+            return False
+        if self._xs_enabled and not p.hello_seen:
+            deadline = time.time() + wait_s
+            with p.cond:
+                while not p.hello_seen:
+                    left = deadline - time.time()
+                    if left <= 0:
+                        break
+                    p.cond.wait(min(0.1, left))
+        return p.xs_ok
 
     # -- reliable sessions (ISSUE 10) -----------------------------------
     def peer_suspect(self, peer: int) -> bool:
@@ -2046,6 +2110,13 @@ class TCPCommEngine(LocalCommEngine):
             # payloads leave the session wire only on links whose BOTH
             # ends run with xfer_dplane set (and a plane attached)
             p.dp_ok = bool(info.get("dp")) and self._dp_enabled
+            # "xs" negotiates on token EQUALITY, not truthiness: equal
+            # tokens prove the peer lives in THIS process (shared XLA
+            # device pool — the cross-rank lowering precondition); a
+            # separate-process, mixed-version, or knob-unset peer never
+            # matches and keeps the activation path bit-for-bit
+            p.xs_ok = (self._xs_enabled
+                       and info.get("xs") == _xs_proc_token())
             with p.cond:
                 # quantize capability is symmetric like "rs": only a
                 # peer that advertised the requested codec under "qz"

@@ -220,6 +220,15 @@ def _lane_device_pool(nb_ranks: int):
     return devs[:nb_ranks]
 
 
+def lane_device_pool(nb_ranks: int):
+    """Public seam over the lane's rank -> device mapping: the
+    cross-rank stage compiler (stagec/xrank.py, ISSUE 20) builds its
+    one-axis global mesh from the SAME pool the two-level collective
+    lane rides, so a wave's rank positions and the lane's agree on
+    which device each in-process rank owns."""
+    return _lane_device_pool(nb_ranks)
+
+
 def rank_mesh_sharding(rank: int, shape: Optional[str] = None,
                        devices: Optional[List] = None):
     """NamedSharding spreading a rank's sliced tile pools over its OWN

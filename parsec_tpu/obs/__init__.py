@@ -172,6 +172,16 @@ class ContextObs:
             ctx.sde.register_poll(
                 "PARSEC::STAGEC::RESIDUE_BATCH_TASKS",
                 lambda s=ss: s["residue_batch_tasks"])
+            # ISSUE 20 gauges: cross-rank SPMD stages (guide §9.1)
+            ctx.sde.register_poll("PARSEC::STAGEC::XSTAGE_COMPILES",
+                                  lambda s=ss: s["xstage_compiles"])
+            ctx.sde.register_poll("PARSEC::STAGEC::XSTAGE_TASKS",
+                                  lambda s=ss: s["xstage_tasks"])
+            ctx.sde.register_poll(
+                "PARSEC::STAGEC::XSTAGE_COLLECTIVE_BYTES",
+                lambda s=ss: s["xstage_collective_bytes"])
+            ctx.sde.register_poll("PARSEC::STAGEC::XSTAGE_FALLBACKS",
+                                  lambda s=ss: s["xstage_fallbacks"])
         # device pull gauges always (poll-only, no hot-path cost); the
         # span/histogram sink only when telemetry is on
         for dev in ctx.devices:

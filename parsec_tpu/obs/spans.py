@@ -41,6 +41,8 @@ __all__ = ["CommObs", "DeviceObs", "OverlapTracker",
            "SERVE_TENANTS", "SERVE_ADMITTED", "SERVE_REJECTED",
            "SERVE_QUEUED", "SERVE_INFLIGHT_PREFIX",
            "SERVE_QUOTA_BYTES_PREFIX", "SERVE_P99_LATENCY_PREFIX",
+           "XSTAGE_COMPILES", "XSTAGE_TASKS",
+           "XSTAGE_COLLECTIVE_BYTES", "XSTAGE_FALLBACKS",
            "flow_event_id", "inbound_flow_ctx", "set_inbound_flow_ctx",
            "payload_nbytes"]
 
@@ -128,6 +130,16 @@ TUNE_DECISIONS = "PARSEC::TUNE::DECISIONS"
 TUNE_REVERTS = "PARSEC::TUNE::REVERTS"
 TUNE_ACTIVE_CODEC_PREFIX = "PARSEC::TUNE::ACTIVE_CODEC"
 TUNE_OBJECTIVE_US = "PARSEC::TUNE::OBJECTIVE_US"
+# cross-rank SPMD stages (ISSUE 20, stagec/xrank.py, guide §6.4/§9.1):
+# wave-front stages compiled as ONE shard_map program over the spanning
+# ranks' lane devices — programs built, member tasks they retired,
+# boundary-tile bytes moved by the in-program all-gather (per rank:
+# payload bytes received from peers inside the program), and planned
+# cross-rank dispatches that downgraded to the rank-local ladder
+XSTAGE_COMPILES = "PARSEC::STAGEC::XSTAGE_COMPILES"
+XSTAGE_TASKS = "PARSEC::STAGEC::XSTAGE_TASKS"
+XSTAGE_COLLECTIVE_BYTES = "PARSEC::STAGEC::XSTAGE_COLLECTIVE_BYTES"
+XSTAGE_FALLBACKS = "PARSEC::STAGEC::XSTAGE_FALLBACKS"
 # multi-tenant persistent serving (ISSUE 18, serve/server.py, ``serve``
 # knob family): open tenant sessions, admission outcomes (admitted /
 # rejected / queued submissions across all tenants), and per-tenant

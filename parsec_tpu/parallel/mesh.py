@@ -367,6 +367,18 @@ def shard_map_fwd(f, mesh, in_specs, out_specs):
                check_rep=False)
 
 
+def xrank_mesh(devices):
+    """One-axis ("xr") mesh over per-rank lane devices: the global
+    mesh a cross-rank SPMD stage (stagec/xrank.py, ISSUE 20) compiles
+    its shard_map program over.  Position p of the axis IS the p-th
+    participating rank, so an ``all_gather`` over "xr" moves boundary
+    tiles from producer-rank lanes to every participant in-program —
+    the collective that replaces the serialized wire activation."""
+    import numpy as _np
+    from jax.sharding import Mesh
+    return Mesh(_np.array(list(devices)), ("xr",))
+
+
 def has_shard_map() -> bool:
     """True when SOME shard_map spelling exists (the gate for
     forward-only mesh dispatch; gradient-correct code must instead

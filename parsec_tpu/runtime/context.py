@@ -218,7 +218,14 @@ class Context:
                             "prestage_issued": 0, "prestage_hits": 0,
                             "chain_links": 0, "chain_fallbacks": 0,
                             "residue_batches": 0,
-                            "residue_batch_tasks": 0}
+                            "residue_batch_tasks": 0,
+                            # ISSUE 20: cross-rank SPMD stages — one
+                            # shard_map program across the ranks a
+                            # wave front spans, boundary tiles moved
+                            # by in-program collectives
+                            "xstage_compiles": 0, "xstage_tasks": 0,
+                            "xstage_collective_bytes": 0,
+                            "xstage_fallbacks": 0}
         # cross-pool stage chain registry (stagec/chain.declare_chain
         # attaches a ChainState when a pool sequence is declared)
         self._stage_chain = None

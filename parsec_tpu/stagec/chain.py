@@ -51,13 +51,18 @@ def _canon(coll: Any, coords: Tuple) -> Tuple:
 
 
 class ChainLink:
-    """One rider: a later pool's first stage fused into the chained
-    program of an earlier pool's final stage."""
+    """One rider: a later pool's stage fused into the chained program
+    of an earlier pool's final stage.  ISSUE 20a lets a rider
+    contribute a multi-stage PREFIX: stage 0 must be memory-fed (the
+    original proof), stages 1..k may await ACTIVATIONS as long as every
+    producer is itself fused — ``act_binds`` names, per act slot, the
+    in-program (producer_key, producer_flow) whose post-body value
+    feeds it through the chained program's edge store."""
 
     __slots__ = ("tp", "stage", "layout", "codes", "mem_canon",
-                 "colls", "n_out")
+                 "colls", "n_out", "act_binds")
 
-    def __init__(self, tp, stage, layout) -> None:
+    def __init__(self, tp, stage, layout, act_binds=()) -> None:
         from .lower import spec_codes
         self.tp = tp
         self.stage = stage
@@ -71,6 +76,8 @@ class ChainLink:
             _canon(self.colls[name], coords)
             for (name, coords), _a in layout.mem_slots]
         self.n_out = len(layout.out_mem) + len(layout.edge_outs)
+        #: layout.act_slots order -> (producer member key, flow name)
+        self.act_binds = list(act_binds)
 
 
 class HostChain:
@@ -98,7 +105,9 @@ class ChainState:
 
     def __init__(self) -> None:
         self.hosts: Dict[int, HostChain] = {}       # id(host_tp) ->
-        self.consumes: Dict[int, ChainLink] = {}    # id(rider_tp) ->
+        #: id(rider_tp) -> fused ChainLinks in stage order (a rider may
+        #: contribute a multi-stage prefix, ISSUE 20a)
+        self.consumes: Dict[int, List[ChainLink]] = {}
         self.stash: Dict[int, Any] = {}             # id(rider_tp) ->
         self.rejects: List[Tuple[str, str, str]] = []
         self._keep: List[Any] = []   # strong refs: ids stay valid
@@ -121,20 +130,14 @@ def _pool_writers_canon(tp, plan: StagePlan) -> Dict[Tuple, List[Tuple]]:
     return out
 
 
-def boundary_verdict(seg: List[Tuple[Any, StagePlan, Any]],
-                     tp_b, plan_b: StagePlan) -> Optional[str]:
-    """Is pool B's first stage fusable onto the segment ``seg``
-    (``[(tp, plan, in_program_stage)]``, host first)?  None = fusable;
-    else the chain-rejection reason (``parsec_lint --lower-report``
-    prints it verbatim)."""
-    if plan_b is None or not plan_b.stages or not plan_b.prepared:
-        return "no compilable first stage in the next pool"
-    stage_b, layout_b, _prio = plan_b.prepared[0]
-    if layout_b.goal or layout_b.act_slots:
-        return (f"first stage awaits {layout_b.goal} task-sourced "
-                f"activation(s) — only memory-fed stages chain")
-    seg_writers = [(tp_a, _pool_writers_canon(tp_a, plan_a), stage_a)
-                   for tp_a, plan_a, stage_a in seg]
+def _tiles_verdict(seg: List[Tuple[Any, StagePlan, Any]],
+                   tp_b, layout_b) -> Optional[str]:
+    """The tile half of the dataflow proof: every tile the candidate
+    stage touches must be rank-local, and every segment-pool writer of
+    a tile it reads must be FUSED in-program (``seg`` carries each
+    pool's fused member-key set).  None = fusable; else the reason."""
+    seg_writers = [(tp_a, _pool_writers_canon(tp_a, plan_a), fused_a)
+                   for tp_a, plan_a, fused_a in seg]
     for (name, coords), _access in layout_b.mem_slots:
         coll = tp_b.global_env.get(name)
         if coll is None or not hasattr(coll, "rank_of"):
@@ -144,13 +147,126 @@ def boundary_verdict(seg: List[Tuple[Any, StagePlan, Any]],
                     f"{coll.rank_of(*coords)} — cross-rank dataflow "
                     f"is not fusable")
         ck = _canon(coll, coords)
-        for tp_a, writers_a, stage_a in seg_writers:
+        for tp_a, writers_a, fused_a in seg_writers:
             for wk in writers_a.get(ck, ()):
-                if wk not in stage_a.member_keys:
+                if wk not in fused_a:
                     return (f"tile {name}{coords} is written by "
                             f"{wk[0]}{wk[1]} of {tp_a.name}, outside "
-                            f"its fused final stage")
+                            f"its fused stage(s)")
     return None
+
+
+def boundary_verdict(seg: List[Tuple[Any, StagePlan, Any]],
+                     tp_b, plan_b: StagePlan) -> Optional[str]:
+    """Is pool B's first stage fusable onto the segment ``seg``
+    (``[(tp, plan, fused_member_keys)]``, host first)?  None = fusable;
+    else the chain-rejection reason (``parsec_lint --lower-report``
+    prints it verbatim)."""
+    if plan_b is None or not plan_b.stages or not plan_b.prepared:
+        return "no compilable first stage in the next pool"
+    stage_b, layout_b, _prio = plan_b.prepared[0]
+    if layout_b.goal or layout_b.act_slots:
+        return (f"first stage awaits {layout_b.goal} task-sourced "
+                f"activation(s) — only memory-fed stages chain")
+    return _tiles_verdict(seg, tp_b, layout_b)
+
+
+def _act_binds(tp_b, plan_b: StagePlan, stage_b, layout_b,
+               fused_b: set, eavail: set):
+    """The activation half of the proof (ISSUE 20a): a NON-FIRST stage
+    of pool B may await task-sourced activations as long as EVERY
+    producer is an already-fused stage of the same pool — its value
+    then flows through the chained program's edge store instead of a
+    runtime activation.  Returns the per-act-slot (producer_key,
+    producer_flow) bind list, or a reason string.
+
+    Conservatism mirrors ``lower.build_stage_fn``'s first-applicable
+    binding walk: each act slot must be bound by its flow's FIRST
+    resolvable dep, and that dep must name exactly the in-program
+    producer (an act slot the fused walk would never read has no
+    provable in-program value — reject)."""
+    from ..dsl.ptg.runtime import _expand_args
+    from .lower import _producer_locals
+    class_ast = {tc.ast.name: tc.ast for tc in tp_b.task_classes}
+    insts = plan_b.inst_by_key
+    mkeys = stage_b.member_keys
+    binds: Dict[Tuple, Tuple] = {}
+    for inst in stage_b.members:
+        env = inst.env
+        for f in inst.tc.ast.flows:
+            first = None
+            try:
+                for d in f.deps_in():
+                    t = d.resolve(env)
+                    if t is None:
+                        continue
+                    if first is None:
+                        first = t
+                    if t.kind == "task":
+                        for args in _expand_args(t.args, env):
+                            pk = (t.task_class, _producer_locals(
+                                class_ast, t.task_class, args))
+                            if pk in insts and pk not in mkeys \
+                                    and pk not in fused_b:
+                                return (
+                                    f"{inst.key[0]}{inst.key[1]}."
+                                    f"{f.name} awaits {pk[0]}{pk[1]}, "
+                                    f"which is not fused in-program")
+            except Exception as exc:  # noqa: BLE001 - proof, not error
+                return (f"unresolvable binding on "
+                        f"{inst.key[0]}{inst.key[1]}.{f.name} ({exc})")
+            if f.is_ctl:
+                continue
+            ak = (inst.key, f.name)
+            if ak not in layout_b.act_index:
+                continue
+            if first is None or first.kind != "task":
+                return (f"act slot {inst.key[0]}{inst.key[1]}."
+                        f"{f.name} is not bound by its first dep — "
+                        f"no provable in-program value")
+            try:
+                pk = (first.task_class, _producer_locals(
+                    class_ast, first.task_class,
+                    tuple(a(env) for a in first.args)))
+            except Exception as exc:  # noqa: BLE001 - proof, not error
+                return (f"unresolvable producer of "
+                        f"{inst.key[0]}{inst.key[1]}.{f.name} ({exc})")
+            if pk in mkeys:
+                # intra-stage edge: build_stage_fn resolves it through
+                # its own out_store, not an act slot
+                continue
+            if pk not in fused_b:
+                return (f"act slot {inst.key[0]}{inst.key[1]}."
+                        f"{f.name} binds {pk[0]}{pk[1]}, which is not "
+                        f"fused in-program")
+            if (pk, first.flow) not in eavail:
+                return (f"act slot {inst.key[0]}{inst.key[1]}."
+                        f"{f.name} binds {pk[0]}{pk[1]}.{first.flow}, "
+                        f"which is not an in-program edge output")
+            binds[ak] = (pk, first.flow)
+    out = []
+    for ak in layout_b.act_slots:
+        b = binds.get(ak)
+        if b is None:
+            return (f"act slot {ak[0][0]}{ak[0][1]}.{ak[1]} has no "
+                    f"in-program bind")
+        out.append(b)
+    return out
+
+
+def _stage_verdict(seg: List[Tuple[Any, StagePlan, Any]], tp_b,
+                   plan_b: StagePlan, stage_b, layout_b, fused_b: set,
+                   eavail: set):
+    """Full verdict for fusing a NON-FIRST stage of pool B: its tiles
+    must stay in-program — counting pool B's OWN earlier writers, which
+    must be fused or stage members — and every task input must bind to
+    an already-fused stage.  Returns the act bind list or a reason."""
+    reason = _tiles_verdict(
+        seg + [(tp_b, plan_b, fused_b | stage_b.member_keys)],
+        tp_b, layout_b)
+    if reason is not None:
+        return reason
+    return _act_binds(tp_b, plan_b, stage_b, layout_b, fused_b, eavail)
 
 
 def declare_chain(context, tps: List[Any]) -> Optional[ChainState]:
@@ -183,9 +299,11 @@ def declare_chain(context, tps: List[Any]) -> Optional[ChainState]:
                                tp.name, exc)
             plans.append(None)
 
-    # segment walk: host = a pool whose final stage DISPATCHES; riders
-    # extend while each boundary proves and the consumed pool is
-    # single-stage (so its final stage is in-program for the cascade)
+    # segment walk: host = a pool whose final stage DISPATCHES; each
+    # rider contributes its longest provable stage PREFIX (stage 0
+    # memory-fed, later stages bound to already-fused producers —
+    # ISSUE 20a), and the segment cascades through a pool only when
+    # ALL of its stages fused (its final stage is then in-program)
     seg: List[Tuple[Any, StagePlan, Any]] = []
     seg_links: List[ChainLink] = []
     host_idx: Optional[int] = None
@@ -200,7 +318,7 @@ def declare_chain(context, tps: List[Any]) -> Optional[ChainState]:
             state.hosts[id(host_tp)] = HostChain(
                 host_stage.index, list(seg_links), extra)
             for link in seg_links:
-                state.consumes[id(link.tp)] = link
+                state.consumes.setdefault(id(link.tp), []).append(link)
             plog.debug.verbose(
                 2, "stagec chain: %s hosts %d rider stage(s) [%s]",
                 host_tp.name, len(seg_links),
@@ -216,20 +334,34 @@ def declare_chain(context, tps: List[Any]) -> Optional[ChainState]:
                     (tp_a.name, tp_b.name,
                      "no compilable final stage in the earlier pool"))
                 continue
-            seg = [(tp_a, plan_a, plan_a.stages[-1])]
+            seg = [(tp_a, plan_a, set(plan_a.stages[-1].member_keys))]
             host_idx = k
         reason = boundary_verdict(seg, tp_b, plan_b)
         if reason is not None:
             state.rejects.append((tp_a.name, tp_b.name, reason))
             close_segment()
             continue
-        stage_b, layout_b, _prio = plan_b.prepared[0]
-        link = ChainLink(tp_b, stage_b, layout_b)
-        seg_links.append(link)
-        if len(plan_b.stages) == 1:
-            # single-stage rider: its (only) stage is in-program, so
-            # the segment cascades through it
-            seg.append((tp_b, plan_b, stage_b))
+        fused_b: set = set()
+        eavail_b: set = set()
+        b_links: List[ChainLink] = []
+        for (stage_k, layout_k, _prio) in plan_b.prepared:
+            if not b_links:
+                binds: Any = []   # first stage: memory-fed, proved above
+            else:
+                binds = _stage_verdict(seg, tp_b, plan_b, stage_k,
+                                       layout_k, fused_b, eavail_b)
+                if isinstance(binds, str):
+                    state.rejects.append(
+                        (tp_a.name, tp_b.name,
+                         f"stage#{stage_k.index}: {binds}"))
+                    break
+            b_links.append(ChainLink(tp_b, stage_k, layout_k, binds))
+            fused_b |= stage_k.member_keys
+            eavail_b.update(layout_k.edge_outs)
+        seg_links.extend(b_links)
+        if len(b_links) == len(plan_b.stages):
+            # whole pool in-program: the segment cascades through it
+            seg.append((tp_b, plan_b, fused_b))
         else:
             close_segment()
     close_segment()
@@ -287,14 +419,23 @@ def build_chain_run(host_tp, host_stage, host_layout, host_codes,
         store = {ck: bufs[i] for i, ck in enumerate(host_canon)}
         for j, ck in enumerate(extra_canon):
             store[ck] = bufs[n_host + j]
+        # in-program edge store: (pool id, producer key, flow) -> value,
+        # feeding later links' activation slots (multi-stage prefixes)
+        estore: Dict[Tuple, Any] = {}
         host_outs = host_run(*bufs[:n_host])
         for oi, si in enumerate(host_layout.out_mem):
             store[host_canon[si]] = host_outs[oi]
         outs = list(host_outs)
         for link, rfn in rider_runs:
-            routs = rfn(*(store[ck] for ck in link.mem_canon))
+            tpid = id(link.tp)
+            acts = tuple(estore[(tpid,) + bind] for bind in link.act_binds)
+            routs = rfn(*(tuple(store[ck] for ck in link.mem_canon)
+                          + acts))
             for oi, si in enumerate(link.layout.out_mem):
                 store[link.mem_canon[si]] = routs[oi]
+            n_t = len(link.layout.out_mem)
+            for ek, val in zip(link.layout.edge_outs, routs[n_t:]):
+                estore[(tpid, ek[0], ek[1])] = val
             outs.extend(routs)
         return tuple(outs)
 
@@ -307,7 +448,8 @@ def chain_signature(rec_shapes: Tuple, host_stage, chain: HostChain,
     token): host stage signature over the FULL arg shapes, each rider's
     (spec token, stage signature), the donate mask."""
     riders = tuple(
-        (spec_token(link.tp), stage_signature(link.stage, ()))
+        (spec_token(link.tp), stage_signature(link.stage, ()),
+         tuple(link.act_binds))
         for link in chain.riders)
     return (stage_signature(host_stage, rec_shapes), riders, donate,
             "chain")

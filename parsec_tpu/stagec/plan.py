@@ -126,9 +126,9 @@ class StagePlan:
 
     __slots__ = ("order", "stages", "member_stage", "verdicts",
                  "inst_by_key", "n_local", "n_residue", "prepared",
-                 "levels", "residue_groups",
+                 "levels", "residue_groups", "residue_groups_host",
                  "mem_writers", "local_keys", "startup_goal0",
-                 "startup_mem_puts")
+                 "startup_mem_puts", "xwaves", "xwave_report")
 
     def __init__(self, order, stages, member_stage, verdicts,
                  n_local: int, n_residue: int) -> None:
@@ -151,6 +151,17 @@ class StagePlan:
         #: and hands the complete group to the device batching pipeline
         #: as one burst (zero per-task scheduler round-trips)
         self.residue_groups: List[List[Tuple]] = []
+        #: host-bodied residue groups (ISSUE 20b): same per-(level,
+        #: class) pre-planning for classes the HOST interpreter owns —
+        #: the runtime schedules each complete group as one pre-planned
+        #: burst instead of a per-task activate/schedule round-trip
+        self.residue_groups_host: List[List[Tuple]] = []
+        #: cross-rank SPMD waves (ISSUE 20, stagec/xrank.py): filled by
+        #: plan_xwaves when stage_compile_xrank is on and nb_ranks > 1
+        self.xwaves: List[Any] = []
+        #: [(level, class, text)] — per-(level, class) cross-rank
+        #: eligibility verdicts (the parsec_lint --lower-report column)
+        self.xwave_report: List[Tuple] = []
         #: (collection name, coords) -> ordered instance keys with a
         #: memory out-dep landing on that tile, over the FULL (all-rank)
         #: instance order — the chain planner's dataflow proof and the
@@ -472,17 +483,25 @@ def plan_stages(tp, rank: int = 0, max_tasks: int = 256,
     device_cls = {tc.ast.name for tc in tp.task_classes
                   if any(b.device_type not in ("cpu", "recursive")
                          for b in tc.ast.bodies)}
+    # host-bodied residue joins the same pre-planning (ISSUE 20b): a
+    # complete (level, class) group of HOST tasks schedules as one
+    # pre-planned burst instead of per-task scheduler round-trips
     per_group: Dict[Tuple, List[Tuple]] = {}
+    per_group_host: Dict[Tuple, List[Tuple]] = {}
     for inst in order:
         k = inst.key
-        if k not in local or k in member_stage \
-                or level[k] < 2 or k[0] not in device_cls:
+        if k not in local or k in member_stage or level[k] < 2:
             continue
-        per_group.setdefault((level[k], k[0]), []).append(k)
+        tgt = per_group if k[0] in device_cls else per_group_host
+        tgt.setdefault((level[k], k[0]), []).append(k)
     for gk in sorted(per_group):
         keys = per_group[gk]
         if len(keys) >= 2:
             plan.residue_groups.append(keys)
+    for gk in sorted(per_group_host):
+        keys = per_group_host[gk]
+        if len(keys) >= 2:
+            plan.residue_groups_host.append(keys)
     return plan
 
 
@@ -527,4 +546,14 @@ def stage_report(tp, rank: int = 0, max_tasks: int = 256,
         f"{plan.n_local} local task(s), {plan.n_residue} residue"
         + (f" ({len(plan.residue_groups)} residue group(s) pre-planned "
            f"over {n_grouped} task(s))" if plan.residue_groups else ""))
+    if plan.residue_groups_host:
+        n_host = sum(len(g) for g in plan.residue_groups_host)
+        lines.append(
+            f"  -- {len(plan.residue_groups_host)} host residue "
+            f"group(s) pre-planned over {n_host} task(s)")
+    # cross-rank eligibility column (ISSUE 20): one line per (level,
+    # class) wave group — spanning ranks + boundary edges + collective
+    # kind, or the reason it stays rank-local
+    for (lv, cls, text) in plan.xwave_report:
+        lines.append(f"  xrank level {lv} {cls}: {text}")
     return lines

@@ -134,6 +134,7 @@ class _StageRec:
         self.shapes: Tuple = ()
         self.donate: Tuple = ()
         self.chain = None               # HostChain when this rec hosts
+        self.xwave = None               # XWave when this rec joins one
         #: Data objects prestaged for this stage and not yet counted
         #: (single-owner by lifecycle: the buffering/spawn path)
         self.prestaged: List[Any] = []
@@ -192,6 +193,22 @@ class StageCompiler:
         self._codes = spec_codes(tp)
         self._token = spec_token(tp)
         self._donate_on = bool(params.get("device_donate"))
+        # donate-by-default (ISSUE 20c): donation is ON inside compiled
+        # stages without the device_donate opt-in, EXCEPT for stages
+        # whose member classes carry a BDY204 verdict (two flows read
+        # the same tile — donating would hand XLA a buffer another
+        # flow still needs)
+        self._donate_default = bool(params.get("stage_compile_donate"))
+        self._bdy_aliased: set = set()
+        if self._donate_default:
+            try:
+                from ..analysis.body_check import check_jdf_bodies
+                from .plan import _finding_class
+                self._bdy_aliased = {
+                    _finding_class(f) for f in check_jdf_bodies(tp.jdf)
+                    if f.code == "BDY204"}
+            except Exception:  # noqa: BLE001 - analysis is advisory
+                self._donate_default = False
         # the mesh device, when this rank's accelerator is one (PR 6):
         # wave-front stages then compile through shard_map over it
         self._mesh_dev = next(
@@ -211,8 +228,10 @@ class StageCompiler:
                 self._member_rec[m.key] = rec
 
         # cross-pool chaining (ISSUE 13, stagec/chain.py): does this
-        # pool HOST a chained program, or CONSUME a stash?
-        self._consume_rec: Optional[_StageRec] = None
+        # pool HOST a chained program, or CONSUME a stash?  A rider may
+        # contribute a multi-stage prefix (ISSUE 20a): one rec per
+        # fused link, in stage order, all-or-nothing
+        self._consume_recs: List[_StageRec] = []
         chain_state = getattr(context, "_stage_chain", None)
         if chain_state is not None:
             # pop: the HostChain moves onto the rec, so the registry
@@ -222,11 +241,16 @@ class StageCompiler:
                 host_rec = self._rec_by_index.get(hc.host_stage_index)
                 if host_rec is not None:
                     host_rec.chain = hc
-            link = chain_state.consumes.get(id(tp))
-            if link is not None:
-                rec0 = self._rec_by_index.get(link.stage.index)
-                if rec0 is not None and rec0.stage is link.stage:
-                    self._consume_rec = rec0
+            links = chain_state.consumes.get(id(tp))
+            if links:
+                recs = []
+                for link in links:
+                    rec0 = self._rec_by_index.get(link.stage.index)
+                    if rec0 is None or rec0.stage is not link.stage:
+                        recs = []
+                        break
+                    recs.append(rec0)
+                self._consume_recs = recs
 
         # compiled residue schedule (ISSUE 13): per-(level, class)
         # groups pre-planned by the lowerability pass — ready members
@@ -235,19 +259,27 @@ class StageCompiler:
         self._rg_of: Dict[Tuple, int] = {}
         self._rg_left: List[int] = []
         self._rg_buf: List[List[Task]] = []
-        if params.get("stage_residue_batch") and plan.residue_groups:
+        self._rg_host: List[bool] = []
+        if params.get("stage_residue_batch") and \
+                (plan.residue_groups or plan.residue_groups_host):
             eligible = {
                 tc.ast.name for tc in tp.task_classes
                 if any(c.device_type == "tpu" and c.dyld_fn is not None
                        for c in tc.incarnations)}
-            for keys in plan.residue_groups:
-                if keys[0][0] not in eligible:
-                    continue
-                gi = len(self._rg_left)
-                self._rg_left.append(len(keys))
-                self._rg_buf.append([])
-                for k in keys:
-                    self._rg_of[k] = gi
+            host_ok = {
+                tc.ast.name for tc in tp.task_classes
+                if any(c.device_type == "cpu" for c in tc.incarnations)}
+            for host, groups in ((False, plan.residue_groups),
+                                 (True, plan.residue_groups_host)):
+                for keys in groups:
+                    if keys[0][0] not in (host_ok if host else eligible):
+                        continue
+                    gi = len(self._rg_left)
+                    self._rg_left.append(len(keys))
+                    self._rg_buf.append([])
+                    self._rg_host.append(host)
+                    for k in keys:
+                        self._rg_of[k] = gi
 
         # prestage/execute overlap (ISSUE 13): early H2D of stage
         # inputs through the §6.1 prefetcher's device seam, bounded by
@@ -255,6 +287,28 @@ class StageCompiler:
         self._prestage_depth = int(getattr(self._dev, "prefetch_depth",
                                            0))
         self._prestage_recs: set = set()
+
+        # cross-rank SPMD stages (ISSUE 20): negotiate "xs" with every
+        # spanning peer, exchange + assert the plan digest, wire the
+        # planned waves onto their stage recs.  Any soft failure keeps
+        # every stage rank-local; a DIGEST mismatch raises (ranks
+        # disagreeing on the wave partition is a plan bug, the
+        # xfer/plan.py loud-failure contract).
+        self._xrank = None
+        if getattr(plan, "xwaves", None):
+            from .xrank import install_xrank
+            try:
+                install_xrank(self)
+            except RuntimeError:
+                raise
+            except Exception as exc:  # noqa: BLE001 - rank-local stands by
+                plog.warning(
+                    "stagec xrank: install failed on %s (%s: %s); "
+                    "rank-local stages", tp.name, type(exc).__name__,
+                    str(exc)[:200])
+                self._xrank = None
+                for r_ in self._recs:
+                    r_.xwave = None
 
     def _tc(self, inst):
         """The LIVE taskpool's class for a (possibly cached-plan)
@@ -319,7 +373,7 @@ class StageCompiler:
         taskpool's counts are credited."""
         out: List[Task] = []
         for rec in self._recs:
-            if rec is self._consume_rec:
+            if rec in self._consume_recs:
                 continue
             with rec._lock:
                 if rec.status != _PENDING or rec.remaining > 0:
@@ -346,15 +400,22 @@ class StageCompiler:
         completion before ``set_nb_tasks`` would go negative).  A
         missing stash (the host program downgraded, or never ran)
         falls back to spawning the stage normally."""
-        rec = self._consume_rec
-        if rec is None:
+        recs = self._consume_recs
+        if not recs:
             return []
-        self._consume_rec = None
+        self._consume_recs = []
         st = getattr(self.context, "_stage_chain", None)
         stash = st.stash.pop(id(self.tp), _NO_STASH) if st is not None \
             else _NO_STASH
         if st is not None:
             st.consumes.pop(id(self.tp), None)
+        if not isinstance(stash, list) and stash is not None \
+                and stash is not _NO_STASH:
+            stash = [stash]
+        if isinstance(stash, list) and len(stash) != len(recs):
+            # the host lowered a different prefix than this pool fused
+            # (stale registry entry): dispatch everything normally
+            stash = _NO_STASH
         if stash is None or stash is _NO_STASH:
             if stash is _NO_STASH:
                 # the host program never ran at all (downgrade, knob
@@ -364,31 +425,44 @@ class StageCompiler:
             plog.debug.verbose(
                 2, "stagec chain: %s found no stash for stage %d; "
                 "dispatching it normally", self.tp.name,
-                rec.stage.index)
+                recs[0].stage.index)
+            out: List[Task] = []
+            for rec in recs:
+                # later prefix recs with remaining > 0 stay PENDING and
+                # spawn through the ordinary activation path
+                with rec._lock:
+                    if rec.status != _PENDING or rec.remaining > 0:
+                        continue
+                    rec.status = _SPAWNED
+                out.extend(self._spawn(rec))
+            return out
+        # mark EVERY fused rec spawned up front: an earlier rec's
+        # release walk must not re-dispatch a later fused rec through
+        # the activation path (its activations are in-program)
+        for rec in recs:
             with rec._lock:
-                if rec.status != _PENDING or rec.remaining > 0:
-                    return []
                 rec.status = _SPAWNED
-            return self._spawn(rec)
-        lay = rec.layout
-        for arr, si in zip(stash["tiles"], lay.out_mem):
-            (coll_name, coords), _a = lay.mem_slots[si]
-            data = self.tp.global_env[coll_name].data_of(*coords)
-            self._dev.adopt_output(data, arr)
-        for ek, arr in zip(lay.edge_outs, stash["edges"]):
-            if arr is not None:
-                rec.edge_copies[ek] = _edge_copy(arr)
-        n = rec.stage.n_tasks
-        self.stats["chain_links"] += 1
-        self.stats["stage_tasks"] += n
-        self._dev.stats["tasks"] += n
-        with rec._lock:
-            rec.status = _SPAWNED
-        ready = self._release(es, rec)
-        self.tp.task_completed(n)
+        ready: List[Task] = []
+        total = 0
+        for rec, part in zip(recs, stash):
+            lay = rec.layout
+            for arr, si in zip(part["tiles"], lay.out_mem):
+                (coll_name, coords), _a = lay.mem_slots[si]
+                data = self.tp.global_env[coll_name].data_of(*coords)
+                self._dev.adopt_output(data, arr)
+            for ek, arr in zip(lay.edge_outs, part["edges"]):
+                if arr is not None:
+                    rec.edge_copies[ek] = _edge_copy(arr)
+            n = rec.stage.n_tasks
+            self.stats["chain_links"] += 1
+            self.stats["stage_tasks"] += n
+            self._dev.stats["tasks"] += n
+            ready.extend(self._release(es, rec))
+            self.tp.task_completed(n)
+            total += n
         plog.debug.verbose(
-            3, "stagec chain: %s consumed stage %d (%d task(s)) from "
-            "the chained program", self.tp.name, rec.stage.index, n)
+            3, "stagec chain: %s consumed %d stage(s) (%d task(s)) "
+            "from the chained program", self.tp.name, len(recs), total)
         return ready
 
     # ------------------------------------------------------------------ #
@@ -410,7 +484,10 @@ class StageCompiler:
             if self._rg_left[gi] > 0:
                 return None
             group, self._rg_buf[gi] = self._rg_buf[gi], []
-        self._dispatch_residue_group(group)
+        if self._rg_host[gi]:
+            self._dispatch_host_group(group)
+        else:
+            self._dispatch_residue_group(group)
         return None
 
     def _dispatch_residue_group(self, tasks: List[Task]) -> None:
@@ -438,6 +515,19 @@ class StageCompiler:
             dev.pending.push_back((task, est))
         # no inline progress: the next idle worker's manager cycle
         # drains the whole burst with ITS execution stream
+        self.context.wake_workers(len(tasks))
+
+    def _dispatch_host_group(self, tasks: List[Task]) -> None:
+        """Host-bodied counterpart (ISSUE 20b): a complete pre-planned
+        group of HOST residue tasks enters the scheduler as ONE
+        contiguous burst — same-(level, class) members are an
+        antichain, so nothing in the group depends on anything else in
+        it and the whole batch is ready at once."""
+        es0 = self.context.execution_streams[0]
+        self.stats["residue_batches"] += 1
+        self.stats["residue_batch_tasks"] += len(tasks)
+        from ..runtime.scheduling import schedule
+        schedule(es0, tasks)
         self.context.wake_workers(len(tasks))
 
     # ------------------------------------------------------------------ #
@@ -652,9 +742,13 @@ class StageCompiler:
         rec.shapes = self._slot_shapes(rec, bindings)
         if rec.chain is not None:
             rec.shapes = rec.shapes + self._extra_shapes(rec)
+        donate_ok = self._donate_on or (
+            self._donate_default
+            and not any(m.tc.ast.name in self._bdy_aliased
+                        for m in rec.stage.members))
         rec.donate = tuple(
             i for i, (_k, acc) in enumerate(rec.layout.mem_slots)
-            if self._donate_on and (acc & FlowAccess.WRITE))
+            if donate_ok and (acc & FlowAccess.WRITE))
         from ..devices.batching import cached_stage_callable
         try:
             if rec.chain is not None:
@@ -778,6 +872,13 @@ class StageCompiler:
         with rec._lock:
             rec.status = _DOWNGRADED
             events, rec.events = rec.events, []
+        if rec.xwave is not None:
+            # peers are (or will be) waiting at this wave's rendezvous:
+            # decline NOW so they fall back instead of timing out
+            from .xrank import decline_rec
+            decline_rec(self, rec)
+            rec.xwave = None
+            self.stats["xstage_fallbacks"] += 1
         rec.prestaged = []
         self._prestage_recs.discard(id(rec))
         self.stats["stage_fallbacks"] += 1
@@ -805,7 +906,21 @@ class StageCompiler:
                        arrays: List[Any]):
         lay = rec.layout
         tile_outs = edge_outs = None
-        if rec.sharded is not None:
+        if rec.xwave is not None:
+            from .xrank import decline_rec, dispatch_xrank
+            try:
+                tile_outs, edge_outs = dispatch_xrank(self, rec, arrays)
+                self.stats["xstage_tasks"] += rec.stage.n_tasks
+            except Exception as exc:  # noqa: BLE001 - rank-local ladder
+                plog.warning(
+                    "stagec xrank: cross-rank dispatch of stage %d "
+                    "failed (%s: %s); rank-local path",
+                    rec.stage.index, type(exc).__name__, str(exc)[:200])
+                decline_rec(self, rec)
+                rec.xwave = None
+                self.stats["xstage_fallbacks"] += 1
+                tile_outs = None
+        if tile_outs is None and rec.sharded is not None:
             from .sharded import dispatch_sharded
             fn, sharding, info = rec.sharded
             try:
@@ -833,15 +948,21 @@ class StageCompiler:
             if rec.chain is not None:
                 # stash each rider stage's outputs for its pool's
                 # consume_chain (stagec/chain.py): tiles + edge
-                # live-outs, still (possibly in-flight) device arrays
+                # live-outs, still (possibly in-flight) device arrays.
+                # A rider pool may own SEVERAL links (multi-stage
+                # prefix, ISSUE 20a): its stash is the per-link list
+                # in stage order
                 st = getattr(self.context, "_stage_chain", None)
                 rest = list(outs[nhost:])
+                stash_by_tp: Dict[int, List[Dict[str, Any]]] = {}
                 for link in rec.chain.riders:
                     nt = len(link.layout.out_mem)
                     part, rest = rest[:link.n_out], rest[link.n_out:]
-                    if st is not None:
-                        st.stash[id(link.tp)] = {"tiles": part[:nt],
-                                                 "edges": part[nt:]}
+                    stash_by_tp.setdefault(id(link.tp), []).append(
+                        {"tiles": part[:nt], "edges": part[nt:]})
+                if st is not None:
+                    for tpid, parts in stash_by_tp.items():
+                        st.stash[tpid] = parts
         dev = task.selected_device
         for ek, arr in zip(lay.edge_outs, edge_outs):
             if arr is None:
@@ -902,7 +1023,12 @@ def prepared_plan(tp, context) -> StagePlan:
     which therefore always agree on stage identity."""
     from ..devices.batching import cached_stage_callable
     from .plan import _excluded_classes
-    wavefront = any(
+    # cross-rank SPMD stages (ISSUE 20) need the wave-front partition
+    # even without a local chip mesh: every rank must cut the SAME
+    # (level, class) waves for the global program to line up
+    xrank = bool(params.get("stage_compile_xrank")) \
+        and tp.nb_ranks > 1 and bool(params.get("stage_compile_shard"))
+    wavefront = xrank or any(
         d.device_type == "tpu" and getattr(d, "mesh", None) is not None
         and len(getattr(d, "chips", ())) > 1 for d in context.devices)
     max_tasks = int(params.get("stage_compile_max_tasks"))
@@ -933,11 +1059,15 @@ def prepared_plan(tp, context) -> StagePlan:
             else:
                 plan.startup_mem_puts += tp._count_mem_puts_to_me(
                     tp.class_by_name(k[0]), inst.env)
+        if xrank:
+            from .xrank import plan_xwaves
+            plan_xwaves(tp, plan, max_tasks)
         return plan
 
     return cached_stage_callable(
         spec_token(tp),
-        ("stageplan", wavefront, max_tasks, _excluded_classes()),
+        ("stageplan", wavefront, xrank, max_tasks,
+         _excluded_classes()),
         build_plan)
 
 

@@ -495,6 +495,33 @@ def register_core_params() -> None:
                       "run as interpreted residue — a debugging / "
                       "measurement knob (the residue-heavy bench leg "
                       "rides it)")
+    params.reg_bool("stage_compile_xrank", False,
+                    "cross-rank SPMD stages (stagec/xrank.py, ISSUE 20): "
+                    "lower a wave-front stage that spans ranks into ONE "
+                    "shard_map program over a global mesh of the "
+                    "participating ranks' lane devices, turning inter-"
+                    "rank dependency edges into in-program collectives "
+                    "(all-gather of the boundary tiles) with control-"
+                    "only activations on the wire; negotiated per peer "
+                    "via the HELLO \"xs\" capability — mixed-version or "
+                    "knob-unset peers keep the activation path bit-for-"
+                    "bit; off (default) keeps every stage rank-local")
+    params.reg_string("stage_xrank_timeout", "60",
+                      "seconds a rank waits at a cross-rank stage "
+                      "rendezvous before downgrading that stage to its "
+                      "rank-local fallback (the peers decline and fall "
+                      "back too — the ladder never hangs termdet)")
+    params.reg_bool("stage_compile_donate", True,
+                    "donate-by-default inside compiled stages (ISSUE "
+                    "20c): donate stale device buffers of WRITE slots "
+                    "whose member classes the BDY204 analysis proves "
+                    "free of intra-stage tile aliasing — no "
+                    "device_donate opt-in needed; by-reference payload "
+                    "shipping (mesh-local / cross-rank parks) switches "
+                    "to defensive device copies while stage donation "
+                    "is live so no shipped buffer is invalidated under "
+                    "a consumer; off restores the PR 12 opt-in-only "
+                    "donation")
     params.reg_int("comm_prefetch_inflight", 8,
                    "max rendezvous GETs prefetched for activations that "
                    "arrived ahead of their taskpool's registration/"

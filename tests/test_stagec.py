@@ -366,7 +366,10 @@ def test_stagec_cache_token_covers_donate_and_max_tasks():
     _clear_stage_cache()
     L0, s0, _x, M = _run_dpotrf(128, 32, stagec=False)
 
-    with params.cmdline_override("stage_compile", "1"):
+    # pin donate-by-default (ISSUE 20c) OFF so the device_donate flip
+    # below actually changes the donate mask
+    with params.cmdline_override("stage_compile", "1"), \
+            params.cmdline_override("stage_compile_donate", "0"):
         ctx = parsec_tpu.init(nb_cores=2)
         try:
             def one(donate=None, max_tasks=None):
@@ -996,3 +999,258 @@ END
         assert "awaits" in out and "activation" in out, out
     finally:
         os.unlink(path)
+
+
+# ---------------------------------------------------------------------- #
+# donate-by-default (ISSUE 20c)                                          #
+# ---------------------------------------------------------------------- #
+
+def test_stagec_donate_by_default_under_eviction_pressure():
+    """ISSUE 20c differential: inside compiled stages donation is ON
+    WITHOUT the ``device_donate`` opt-in.  Under a 4 KiB device budget
+    with small stages the arena evicts mid-run — donated-then-evicted
+    stage buffers — and the factor must stay bit-exact vs interpreted
+    on BOTH legs: a donated buffer that later served stale bytes would
+    corrupt the donate-on leg only."""
+    from contextlib import ExitStack
+
+    _clear_stage_cache()
+    L0, _s0, _x, M = _run_dpotrf(160, 32, stagec=False)
+
+    def leg(donate_default):
+        with ExitStack() as st:
+            st.enter_context(params.cmdline_override("stage_compile", "1"))
+            st.enter_context(
+                params.cmdline_override("stage_compile_max_tasks", "4"))
+            if not donate_default:
+                st.enter_context(params.cmdline_override(
+                    "stage_compile_donate", "0"))
+            ctx = parsec_tpu.init(nb_cores=2)
+            try:
+                for d in ctx.devices:
+                    if d.device_type == "tpu":
+                        d.mem_budget = 4 * 1024
+                A = TwoDimBlockCyclic(160, 160, 32, 32,
+                                      dtype=np.float32
+                                      ).from_numpy(M.copy())
+                ctx.add_taskpool(dpotrf_taskpool(A))
+                ctx.wait()
+                ev = sum(d.stats["evictions"] for d in ctx.devices
+                         if d.device_type == "tpu")
+                return np.tril(A.to_numpy()), ev, dict(ctx.stage_stats)
+            finally:
+                ctx.fini()
+
+    Lon, ev_on, s_on = leg(True)
+    Loff, ev_off, s_off = leg(False)
+    assert ev_on > 0 and ev_off > 0, (ev_on, ev_off)   # pressure was real
+    assert s_on["stage_tasks"] > 0 and s_on["stage_fallbacks"] == 0, s_on
+    np.testing.assert_array_equal(Lon, L0)
+    np.testing.assert_array_equal(Loff, L0)
+
+
+ALIASED_JDF = """
+descA [ type="collection" ]
+NT [ type="int" ]
+
+Add(m)
+m = 0 .. NT-1
+: descA( m, 0 )
+READ U <- descA( m, 0 )
+RW   X <- descA( m, 0 )
+       -> descA( m, 0 )
+BODY [type=tpu]
+{
+    X = X + U
+}
+END
+"""
+
+
+def test_stagec_bdy204_alias_keeps_donation_suppressed():
+    """The BDY204-predicted aliased case (two flows read the same
+    tile) must keep donation OFF even under donate-by-default: the
+    same device buffer sits at two argument slots, so donating either
+    would hand XLA a buffer the other flow still reads.  Observable:
+    the donate mask is part of the AOT stage-cache key, so flipping
+    ``stage_compile_donate`` around the aliased class must be a pure
+    cache HIT (the mask is empty on both legs) — while the clean
+    dpotrf control recompiles on the same flip."""
+    from contextlib import ExitStack
+
+    from parsec_tpu.analysis.body_check import check_jdf_bodies
+    from parsec_tpu.dsl import ptg
+    from parsec_tpu.dsl.ptg.parser import parse_jdf
+
+    assert any(f.code == "BDY204"
+               for f in check_jdf_bodies(parse_jdf(ALIASED_JDF,
+                                                   name="aliased")))
+    _clear_stage_cache()
+    nb, nt = 8, 4
+    A0 = np.random.RandomState(3).rand(nt * nb, nb).astype(np.float32)
+    factory = ptg.compile_jdf(ALIASED_JDF, name="aliased")
+    M = make_spd(128)
+
+    with params.cmdline_override("stage_compile", "1"):
+        ctx = parsec_tpu.init(nb_cores=2)
+        try:
+            def aliased(donate_knob):
+                with ExitStack() as st:
+                    if donate_knob is not None:
+                        st.enter_context(params.cmdline_override(
+                            "stage_compile_donate", donate_knob))
+                    A = TwoDimBlockCyclic(
+                        nt * nb, nb, nb, nb,
+                        dtype=np.float32).from_numpy(A0.copy())
+                    ctx.add_taskpool(factory.new(descA=A, NT=nt))
+                    ctx.wait()
+                    return A.to_numpy()
+
+            R1 = aliased(None)            # donate-by-default leg
+            c1 = ctx.stage_stats["stage_compiles"]
+            assert c1 > 0
+            R2 = aliased("0")             # donation knob OFF
+            assert ctx.stage_stats["stage_compiles"] == c1, (
+                "BDY204 class recompiled on a donate flip — donation "
+                "was not suppressed")
+            np.testing.assert_array_equal(R1, A0 * 2)
+            np.testing.assert_array_equal(R2, A0 * 2)
+
+            # clean control: dpotrf's mask really flips with the knob
+            def clean(donate_knob):
+                with ExitStack() as st:
+                    if donate_knob is not None:
+                        st.enter_context(params.cmdline_override(
+                            "stage_compile_donate", donate_knob))
+                    A = TwoDimBlockCyclic(
+                        128, 128, 32, 32,
+                        dtype=np.float32).from_numpy(M.copy())
+                    ctx.add_taskpool(dpotrf_taskpool(A))
+                    ctx.wait()
+
+            clean(None)
+            c2 = ctx.stage_stats["stage_compiles"]
+            clean("0")
+            assert ctx.stage_stats["stage_compiles"] > c2, (
+                "clean class did NOT recompile on the donate flip — "
+                "the control is broken")
+        finally:
+            ctx.fini()
+
+
+# ---------------------------------------------------------------------- #
+# cross-rank SPMD stages (ISSUE 20): negotiation + knob gating           #
+# ---------------------------------------------------------------------- #
+
+def _run_xrank_tcp(n, nb, nr, M, stagec, xrank, xstage_ctor=None):
+    """2-rank dpotrf over loopback TCP.  ``xstage_ctor`` overrides the
+    per-rank engine constructor's ``xstage`` kwarg (None: follow the
+    knob) — the "xs" token rides the HELLO, so the knobs wrap engine
+    CONSTRUCTION."""
+    import concurrent.futures as cf
+    from contextlib import ExitStack
+
+    from parsec_tpu.comm import RemoteDepEngine
+    from parsec_tpu.comm.tcp import TCPCommEngine, free_ports
+
+    with ExitStack() as ov:
+        if stagec:
+            ov.enter_context(
+                params.cmdline_override("stage_compile", "1"))
+        if xrank:
+            ov.enter_context(
+                params.cmdline_override("stage_compile_xrank", "1"))
+        eps = [("127.0.0.1", p) for p in free_ports(nr)]
+        with cf.ThreadPoolExecutor(nr) as ex:
+            engines = list(ex.map(
+                lambda r: TCPCommEngine(
+                    r, eps,
+                    **({} if xstage_ctor is None or xstage_ctor[r] is None
+                       else {"xstage": xstage_ctor[r]})),
+                range(nr)))
+        xs_links = [[engines[r].xstage_to(p) for p in range(nr) if p != r]
+                    for r in range(nr)]
+
+        def rank_fn(rank):
+            eng = RemoteDepEngine(engines[rank])
+            ctx = parsec_tpu.Context(nb_cores=2, comm=eng)
+            try:
+                A = TwoDimBlockCyclic(
+                    n, n, nb, nb, P=nr, Q=1, nodes=nr, rank=rank,
+                    dtype=np.float64).from_numpy(M.copy())
+                A.name = "descA"
+                tp = dpotrf_taskpool(A, rank=rank, nb_ranks=nr)
+                ctx.add_taskpool(tp)
+                ctx.wait()
+                owned = {c: np.asarray(
+                    A.data_of(*c).sync_to_host().payload)
+                    for c in A.tiles() if A.rank_of(*c) == rank}
+                return owned, dict(ctx.stage_stats)
+            finally:
+                ctx.fini()
+
+        with cf.ThreadPoolExecutor(nr) as ex:
+            results = list(ex.map(rank_fn, range(nr)))
+    L = np.zeros((n, n))
+    stats = []
+    for owned, st_ in results:
+        stats.append(st_)
+        for (m, k), t in owned.items():
+            L[m * nb:m * nb + t.shape[0], k * nb:k * nb + t.shape[1]] = t
+    return np.tril(L), stats, xs_links
+
+
+def test_stagec_xrank_engages_and_is_bit_exact():
+    """Both ranks knob-on over loopback TCP: the spanning waves lower
+    into ONE shard_map program per wave (XSTAGE_TASKS > 0 on every
+    rank, zero fallbacks) and the distributed factor is bit-exact vs
+    the interpreted run — the in-program all-gather must reproduce the
+    serialized schedule's floats exactly."""
+    n, nb, nr = 128, 32, 2
+    M = make_spd(n)
+    L0, _s0, _l0 = _run_xrank_tcp(n, nb, nr, M, False, False)
+    Lx, sx, links = _run_xrank_tcp(n, nb, nr, M, True, True)
+    assert all(all(l) for l in links), links   # xs negotiated both ways
+    assert all(s["xstage_tasks"] > 0 for s in sx), sx
+    assert all(s["xstage_fallbacks"] == 0 for s in sx), sx
+    np.testing.assert_array_equal(Lx, L0)
+
+
+def test_stagec_xrank_mixed_version_negotiates_down():
+    """Mixed-version leg: rank 1's engine predates "xs" (ctor
+    ``xstage=False`` — what an old build's HELLO looks like) while
+    BOTH ranks run with the knob on.  Rank 0 must negotiate DOWN on
+    the link — a one-sided cross-rank program would hang the stage
+    rendezvous — and every wave keeps today's activation path:
+    per-rank compiled stages, zero XSTAGE engagement, bit-for-bit."""
+    n, nb, nr = 128, 32, 2
+    M = make_spd(n)
+    L0, _s0, _l0 = _run_xrank_tcp(n, nb, nr, M, False, False)
+    L1, s1, links = _run_xrank_tcp(n, nb, nr, M, True, True,
+                                   xstage_ctor=[None, False])
+    assert not any(links[0]), links    # rank 0 sees no "xs" on the link
+    for s in s1:
+        assert s["xstage_tasks"] == 0 and s["xstage_compiles"] == 0, s1
+    assert all(s["stage_tasks"] > 0 for s in s1), s1
+    np.testing.assert_array_equal(L1, L0)
+
+
+def test_stagec_xrank_knob_unset_keeps_activation_path():
+    """Knob-unset inertness: with only ``stage_compile`` on, no engine
+    advertises "xs" (the capability defaults from the
+    ``stage_compile_xrank`` knob), no cross-rank program ever builds
+    (all XSTAGE gauges stay zero), and the factor matches the
+    interpreted run bit-for-bit — the feature is invisible until BOTH
+    the knob and the peer agree."""
+    n, nb, nr = 128, 32, 2
+    M = make_spd(n)
+    L0, _s0, _l0 = _run_xrank_tcp(n, nb, nr, M, False, False)
+    L1, s1, links = _run_xrank_tcp(n, nb, nr, M, True, False)
+    assert not any(any(l) for l in links), links
+    for s in s1:
+        assert s["xstage_tasks"] == 0, s1
+        assert s["xstage_compiles"] == 0, s1
+        assert s["xstage_collective_bytes"] == 0, s1
+        assert s["xstage_fallbacks"] == 0, s1
+    assert all(s["stage_tasks"] > 0 for s in s1), s1
+    np.testing.assert_array_equal(L1, L0)

@@ -50,6 +50,18 @@ without editing it::
     python tools/chaos_run.py --soak 300 --redist 4 --reconnect 10 \\
         --inject "flap:rank=*:nth=2:duration=0.05"
 
+    # cross-rank SPMD stage soak (stagec/xrank, ISSUE 20): every
+    # iteration runs a 2-rank stage-compiled dpotrf whose spanning
+    # waves execute as ONE shard_map program, with a link flap landing
+    # mid-cross-rank-stage; the iteration fails unless the run
+    # terminates (never hangs termdet) and the factor is BIT-IDENTICAL
+    # to a clean interpreted reference — by session replay OR by the
+    # fallback ladder downgrading the wave, both are legal outcomes
+    # (keep nth small: the collective leaves control-only traffic on
+    # the wire, so a high nth never fires)
+    python tools/chaos_run.py --soak 300 --xstage 2 --reconnect 10 \\
+        --inject "flap:rank=1:nth=5:duration=0.1"
+
 Everything after ``--`` is the script and ITS argv. Exit status: the
 script's (an uncaught injected failure exits non-zero — which is the
 point: chaos_run makes "does it fail loudly instead of hanging?"
@@ -135,6 +147,22 @@ def main(argv=None) -> int:
     ap.add_argument("--redist-size", type=int, default=48, metavar="M",
                     help="redistribution driver matrix extent "
                          "(default 48)")
+    ap.add_argument("--xstage", type=int, default=0, metavar="N",
+                    help="soak mode only: replace the target script "
+                         "with the built-in cross-rank SPMD stage "
+                         "driver (stagec/xrank.py) — N thread-ranks "
+                         "(one process: the \"xs\" token negotiates "
+                         "only between co-resident ranks) factor a "
+                         "dpotrf whose spanning waves run as ONE "
+                         "shard_map program while the injected faults "
+                         "land mid-stage; the iteration fails unless "
+                         "the run terminates and the factor is "
+                         "bit-identical to a clean interpreted "
+                         "reference (downgrade and replay-recovery "
+                         "both pass; a hang or corruption does not)")
+    ap.add_argument("--xstage-size", type=int, default=192, metavar="M",
+                    help="cross-rank stage driver matrix extent "
+                         "(default 192)")
     ap.add_argument("--forensics", default="", metavar="PREFIX",
                     help="activate profiling at PREFIX so every rank "
                          "flight-records its trace on a RankFailedError "
@@ -149,9 +177,9 @@ def main(argv=None) -> int:
     ap.add_argument("args", nargs=argparse.REMAINDER,
                     help="argv for the script (prefix with --)")
     ns = ap.parse_args(argv)
-    if ns.tenants > 0 and ns.redist > 0:
-        ap.error("--tenants and --redist are mutually exclusive "
-                 "built-in drivers")
+    if sum(1 for k in (ns.tenants, ns.redist, ns.xstage) if k > 0) > 1:
+        ap.error("--tenants, --redist and --xstage are mutually "
+                 "exclusive built-in drivers")
     if ns.tenants > 0:
         if ns.soak <= 0:
             ap.error("--tenants requires --soak (the multi-tenant "
@@ -166,9 +194,16 @@ def main(argv=None) -> int:
                      "driver is a sustained-load leg)")
         if ns.redist < 2:
             ap.error("--redist needs at least 2 ranks")
+    elif ns.xstage > 0:
+        if ns.soak <= 0:
+            ap.error("--xstage requires --soak (the cross-rank stage "
+                     "driver is a sustained-load leg)")
+        if ns.xstage < 2:
+            ap.error("--xstage needs at least 2 ranks (a single rank "
+                     "never plans a cross-rank wave)")
     elif not ns.script:
-        ap.error("a target script is required (or --tenants/--redist N "
-                 "with --soak for a built-in driver)")
+        ap.error("a target script is required (or --tenants/--redist/"
+                 "--xstage N with --soak for a built-in driver)")
 
     directives = []
     if ns.inject:
@@ -435,6 +470,131 @@ if flaps and not reconnects:
 """
 
 
+#: the --xstage soak leg (ISSUE 20): N thread-ranks in ONE process
+#: (the "xs" HELLO token only matches between co-resident ranks) run a
+#: stage-compiled dpotrf over real loopback TCP with cross-rank
+#: lowering ON while the exported ft_inject / comm_reconnect_timeout
+#: knobs tear links mid-stage.  A clean interpreted reference runs
+#: FIRST with injection suppressed; the chaos leg must then TERMINATE
+#: (daemon rank threads + a hard deadline: a wedged rendezvous or
+#: termdet is an explicit failure, never a silent hang) and produce a
+#: bit-identical factor — whether the fault was absorbed by session
+#: replay (reconnects > 0) or the wave downgraded through the fallback
+#: ladder (xstage_fallbacks > 0), both of which are printed per run.
+_XSTAGE_DRIVER = """
+import os, sys, threading, time
+sys.path.insert(0, os.environ.get("CHAOS_REPO", "."))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in \\
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8"
+                               ).strip()
+import concurrent.futures as cf
+from contextlib import ExitStack
+import numpy as np
+import parsec_tpu
+from parsec_tpu.collections import TwoDimBlockCyclic
+from parsec_tpu.comm import RemoteDepEngine
+from parsec_tpu.comm.tcp import TCPCommEngine, free_ports
+from parsec_tpu.ops import dpotrf_taskpool, make_spd
+from parsec_tpu.utils.params import params
+
+nr, n = int(sys.argv[1]), int(sys.argv[2])
+nb = 32
+M = make_spd(n)
+
+
+def run(xrank, inject, deadline_s):
+    with ExitStack() as ov:
+        if not inject:
+            # the reference leg must be clean: cmdline overrides beat
+            # the exported MCA env
+            ov.enter_context(params.cmdline_override("ft_inject", ""))
+        if xrank:
+            ov.enter_context(params.cmdline_override("stage_compile", "1"))
+            ov.enter_context(
+                params.cmdline_override("stage_compile_xrank", "1"))
+        eps = [("127.0.0.1", p) for p in free_ports(nr)]
+        with cf.ThreadPoolExecutor(nr) as ex:
+            engines = list(ex.map(lambda r: TCPCommEngine(r, eps),
+                                  range(nr)))
+        outs = [None] * nr
+        errs = []
+
+        def rank_fn(rank):
+            try:
+                eng = RemoteDepEngine(engines[rank])
+                ctx = parsec_tpu.Context(nb_cores=2, comm=eng)
+                try:
+                    A = TwoDimBlockCyclic(
+                        n, n, nb, nb, P=nr, Q=1, nodes=nr, rank=rank,
+                        dtype=np.float64).from_numpy(M.copy())
+                    A.name = "descA"
+                    tp = dpotrf_taskpool(A, rank=rank, nb_ranks=nr)
+                    ctx.add_taskpool(tp)
+                    ctx.wait()
+                    owned = {c: np.asarray(
+                        A.data_of(*c).sync_to_host().payload)
+                        for c in A.tiles() if A.rank_of(*c) == rank}
+                    outs[rank] = (owned, dict(ctx.stage_stats))
+                finally:
+                    ctx.fini()
+            except BaseException as exc:
+                errs.append(f"rank {rank}: {exc!r}")
+
+        # daemon threads + a hard join deadline: "never hang termdet"
+        # is part of the contract under test, so a wedged rank must
+        # surface as a LOUD failed iteration, not a soak-timeout kill
+        threads = [threading.Thread(target=rank_fn, args=(r,),
+                                    daemon=True) for r in range(nr)]
+        for th in threads:
+            th.start()
+        t_end = time.monotonic() + deadline_s
+        for th in threads:
+            th.join(max(0.1, t_end - time.monotonic()))
+        if any(th.is_alive() for th in threads):
+            sys.exit(f"xstage driver: cross-rank run HUNG "
+                     f"(> {deadline_s:.0f}s) — termdet or the stage "
+                     f"rendezvous wedged under injection")
+        if errs:
+            sys.exit("xstage driver failures: " + "; ".join(errs))
+        reconnects = sum(e.wire_stats["reconnects"] for e in engines)
+        flaps = sum(e._ft.stats["flaps"] for e in engines
+                    if e._ft is not None)
+        dead = [sorted(e.dead_peers) for e in engines if e.dead_peers]
+        for e in engines:
+            e.fini()
+        L = np.zeros((n, n))
+        for owned, _st in outs:
+            for (m, k), t in owned.items():
+                L[m * nb:m * nb + t.shape[0],
+                  k * nb:k * nb + t.shape[1]] = t
+        stats = [st for _o, st in outs]
+        return np.tril(L), stats, reconnects, flaps, dead
+
+
+L0, _s0, _r0, _f0, _d0 = run(xrank=False, inject=False, deadline_s=120)
+Lx, sx, reconnects, flaps, dead = run(xrank=True, inject=True,
+                                      deadline_s=120)
+xtasks = sum(s["xstage_tasks"] for s in sx)
+xfall = sum(s["xstage_fallbacks"] for s in sx)
+print(f"xstage driver: ranks={nr} n={n} xstage_tasks={xtasks} "
+      f"xstage_fallbacks={xfall} "
+      f"stage_tasks={[s['stage_tasks'] for s in sx]} "
+      f"reconnects={reconnects} flaps={flaps}", flush=True)
+if dead:
+    sys.exit(f"xstage driver: rank evictions under a transient fault: "
+             f"{dead}")
+if xtasks == 0 and xfall == 0:
+    sys.exit("xstage driver: cross-rank lowering never engaged AND "
+             "never downgraded — the chaos leg exercised nothing")
+if not np.array_equal(Lx, L0):
+    sys.exit("xstage driver: factor NOT bit-identical to the clean "
+             "interpreted reference")
+"""
+
+
 def _soak(ns, script: str, args) -> int:
     """Sustained-load loop: one fresh subprocess per iteration (the MCA
     env is already exported above, and re-execing chaos_run itself
@@ -475,6 +635,14 @@ def _soak(ns, script: str, args) -> int:
             os.path.dirname(os.path.abspath(__file__)))
         base = [sys.executable, "-c", _REDIST_DRIVER,
                 str(ns.redist), str(ns.redist_size)]
+    elif ns.xstage > 0:
+        # built-in cross-rank stage driver: same env-inheritance
+        # contract (ft_inject + comm_reconnect_timeout reach the TCP
+        # engines and the stagec runtime the driver constructs)
+        os.environ["CHAOS_REPO"] = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))
+        base = [sys.executable, "-c", _XSTAGE_DRIVER,
+                str(ns.xstage), str(ns.xstage_size)]
     else:
         base = [sys.executable, os.path.abspath(__file__)]
         if ns.inject:

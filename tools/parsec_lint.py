@@ -221,26 +221,49 @@ def lower_report_main(paths: List[str], quiet: bool = False) -> int:
             except Exception as exc:  # noqa: BLE001 - informational
                 print(f"  (stage partition not enumerable: "
                       f"{type(exc).__name__}: {exc})")
+                continue
+            try:
+                for line in _xrank_column(text, spec_name):
+                    print(line)
+            except Exception as exc:  # noqa: BLE001 - informational
+                print(f"  (xrank column not enumerable: "
+                      f"{type(exc).__name__}: {exc})")
         # chain verdicts over consecutive specs of the same file (the
         # declared-sequence analog: dtrsm.py's FWD ; BWD), walking the
         # SAME cumulative segments declare_chain builds — a boundary is
         # proven against every pool already fused into the segment, so
         # the report cannot claim a cascade the runtime would reject
-        from parsec_tpu.stagec.chain import boundary_verdict
-        seg = []   # [(tp, plan, in-program stage)], host first
+        from parsec_tpu.stagec.chain import _stage_verdict, \
+            boundary_verdict
+        seg = []   # [(tp, plan, fused member-key set)], host first
         for (na, tpa, pa), (nb_, tpb, pb) in zip(planned, planned[1:]):
             if not seg:
                 if pa is None or not pa.stages:
                     print(f"  chain {na} -> {nb_}: rejected — no "
                           f"compilable final stage in the earlier pool")
                     continue
-                seg = [(tpa, pa, pa.stages[-1])]
+                seg = [(tpa, pa, set(pa.stages[-1].member_keys))]
             reason = boundary_verdict(seg, tpb, pb)
             if reason is None:
+                # walk the fusable stage PREFIX exactly like
+                # declare_chain (ISSUE 20a): stage 0 memory-fed,
+                # later stages bound to already-fused producers
+                fused_b, eavail_b = set(), set()
+                n_fused = 0
+                for (stage_k, layout_k, _prio) in pb.prepared:
+                    if n_fused:
+                        v = _stage_verdict(seg, tpb, pb, stage_k,
+                                           layout_k, fused_b, eavail_b)
+                        if isinstance(v, str):
+                            break
+                    n_fused += 1
+                    fused_b |= stage_k.member_keys
+                    eavail_b.update(layout_k.edge_outs)
                 print(f"  chain {na} -> {nb_}: fusable "
-                      f"(one chained program)")
-                if len(pb.stages) == 1:
-                    seg.append((tpb, pb, pb.stages[0]))
+                      f"({n_fused}/{len(pb.stages)} stage(s) "
+                      f"in-program)")
+                if n_fused == len(pb.stages):
+                    seg.append((tpb, pb, fused_b))
                 else:
                     seg = []   # segment ends; next pool hosts anew
             else:
@@ -249,6 +272,46 @@ def lower_report_main(paths: List[str], quiet: bool = False) -> int:
     if not quiet:
         print(f"parsec_lint --lower-report: {n_specs} spec(s)")
     return 0
+
+
+def _xrank_column(text: str, spec_name: str) -> List[str]:
+    """Cross-rank eligibility column (ISSUE 20 satellite): replay the
+    spec over a 2-rank row-cyclic toy instantiation and run the SAME
+    cross-rank planner pass the runtime uses (stagec/xrank.plan_xwaves)
+    — one line per (level, class) wave: spanning ranks (participant
+    and boundary-edge counts, collective kind) or the reason the wave
+    stays rank-local."""
+    from parsec_tpu.analysis.ptg_check import (_load_dagenum,
+                                               default_enum_env)
+    from parsec_tpu.dsl import ptg
+    from parsec_tpu.stagec.plan import plan_stages
+    from parsec_tpu.stagec.xrank import plan_xwaves
+    from parsec_tpu.utils.params import params
+    dagenum = _load_dagenum()
+
+    class _TwoRankDummy(dagenum._DummyCollection):
+        """Row-cyclic over 2 ranks, so every multi-row wave front has
+        members on both — the eligibility question becomes purely
+        structural (body/layout/boundary), like the runtime's."""
+
+        def rank_of(self, *a) -> int:
+            return int(a[0]) % 2 if a else 0
+
+        def tile_shape(self, *a):
+            return (4, 4)
+
+    factory = ptg.compile_jdf(text, name=f"{spec_name}@2r")
+    env = default_enum_env(factory.jdf)
+    for g in factory.jdf.globals:
+        if g.properties.get("type") == "collection":
+            env[g.name] = _TwoRankDummy(4, 4)
+    tp2 = factory.new(rank=0, nb_ranks=2, **env)
+    max_tasks = int(params.get("stage_compile_max_tasks"))
+    plan2 = plan_stages(tp2, rank=0, max_tasks=max_tasks,
+                        wavefront=True)
+    plan_xwaves(tp2, plan2, max_tasks)
+    return [f"  xrank level {lv} {cls}: {txt}"
+            for (lv, cls, txt) in plan2.xwave_report]
 
 
 def _prepared_toy_plan(tp):
